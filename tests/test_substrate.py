@@ -1,0 +1,149 @@
+"""Substrate tests: config, score maps, bounded top-k queue, workflow, DHT math."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.utils.config import Config, NetworkUnit
+from yacy_search_server_tpu.utils.scoremap import ScoreMap
+from yacy_search_server_tpu.utils.topk import WeakPriorityQueue
+from yacy_search_server_tpu.utils.workflow import WorkflowProcessor, BusyThread
+from yacy_search_server_tpu.utils import hashes
+from yacy_search_server_tpu.utils.base64order import hashes_to_uint8
+from yacy_search_server_tpu.parallel.distribution import (
+    Distribution, horizontal_dht_position, horizontal_dht_distance, LONG_MAX,
+)
+
+
+class TestConfig:
+    def test_overlay_and_persist(self, tmp_path):
+        p = str(tmp_path / "settings.conf")
+        c = Config({"a": "1", "b": "x"}, settings_path=p)
+        assert c.get("a") == "1"
+        c.set("a", "2")
+        assert c.get("a") == "2"
+        c2 = Config({"a": "1"}, settings_path=p)
+        assert c2.get("a") == "2"          # overlay survived restart
+
+    def test_typed_getters(self):
+        c = Config({"i": "42", "f": "2.5", "t": "true"})
+        assert c.get_int("i") == 42
+        assert c.get_float("f") == 2.5
+        assert c.get_bool("t") is True
+        assert c.get_int("missing", 7) == 7
+
+    def test_network_unit(self):
+        u = NetworkUnit("freeworld")
+        assert u.partition_exponent == 4
+        assert u.redundancy_senior == 3
+        assert u.dht_enabled
+        assert NetworkUnit("intranet").dht_enabled is False
+
+
+class TestScoreMap:
+    def test_inc_and_order(self):
+        m = ScoreMap()
+        m.inc("a", 3); m.inc("b", 1); m.inc("a", 2)
+        assert m.get("a") == 5
+        assert m.top(2) == [("a", 5), ("b", 1)]
+        assert list(m.keys(up=False))[0] == "a"
+
+    def test_concurrent_inc(self):
+        m = ScoreMap()
+        def worker():
+            for _ in range(1000):
+                m.inc("k")
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in ts]; [t.join() for t in ts]
+        assert m.get("k") == 8000
+
+
+class TestWeakPriorityQueue:
+    def test_keeps_best_n(self):
+        q = WeakPriorityQueue(3)
+        for w in [5, 1, 9, 7, 3]:
+            q.put(f"p{w}", w)
+        assert q.misses == 2
+        drained = [q.poll().weight for _ in range(3)]
+        assert drained == [9, 7, 5]
+        assert q.poll() is None
+
+    def test_element_paging(self):
+        q = WeakPriorityQueue(10)
+        for w in [2, 8, 4]:
+            q.put(w, w)
+        assert q.element(0).weight == 8
+        assert q.element(2).weight == 2
+        assert q.element(0).weight == 8  # re-read stays stable
+
+    def test_blocking_take(self):
+        q = WeakPriorityQueue(4)
+        def producer():
+            time.sleep(0.05)
+            q.put("x", 1)
+        threading.Thread(target=producer).start()
+        el = q.take(timeout_s=2.0)
+        assert el is not None and el.payload == "x"
+
+
+class TestWorkflow:
+    def test_two_stage_pipeline(self):
+        results = []
+        stage2 = WorkflowProcessor("double", lambda x: results.append(x) or None, workers=1)
+        stage1 = WorkflowProcessor("inc", lambda x: x + 1, workers=2, next_stage=stage2)
+        for i in range(50):
+            stage1.enqueue(i)
+        stage1.join(); stage2.join()
+        assert sorted(results) == list(range(1, 51))
+        assert stage1.metrics.processed == 50
+        stage1.shutdown(); stage2.shutdown()
+
+    def test_busy_thread_idle_busy(self):
+        calls = []
+        def job():
+            calls.append(1)
+            return len(calls) < 3
+        bt = BusyThread("t", job, idle_sleep_s=5.0, busy_sleep_s=0.01).start()
+        time.sleep(0.3)
+        bt.terminate()
+        assert len(calls) == 3  # 2 busy cycles then idle-parked
+
+
+class TestDistribution:
+    def test_ring_distance_wraps(self):
+        assert horizontal_dht_distance(10, 20) == 10
+        # closed ring: distance back around, matching the reference formula
+        # (LONG_MAX - from) + to + 1 (Distribution.java:103-105)
+        assert horizontal_dht_distance(20, 10) == LONG_MAX - 9
+        assert horizontal_dht_distance(5, 5) == 0
+
+    def test_vertical_partition_in_range(self):
+        d = Distribution(4)
+        assert d.vertical_partitions() == 16
+        for url in ["http://a.com/x", "http://b.org/y", "http://c.net/z"]:
+            p = d.vertical_dht_partition(hashes.url2hash(url))
+            assert 0 <= p < 16
+
+    def test_vertical_position_stays_in_partition_segment(self):
+        d = Distribution(4)
+        wh = hashes.word2hash("network")
+        for part in range(16):
+            pos = d.vertical_dht_position(wh, part)
+            assert pos >> d.shift_length == part
+
+    def test_bulk_matches_scalar(self):
+        d = Distribution(4)
+        urls = [f"http://host{i}.com/p{i}" for i in range(50)]
+        uhashes = [hashes.url2hash(u) for u in urls]
+        bulk = d.vertical_partitions_bulk(hashes_to_uint8(uhashes))
+        scalar = [d.vertical_dht_partition(h) for h in uhashes]
+        assert bulk.tolist() == scalar
+
+    def test_same_url_same_partition_any_word(self):
+        # vertical selection depends only on the url hash — this is the
+        # property that keeps one url's postings co-located per partition
+        d = Distribution(4)
+        uh = hashes.url2hash("http://example.com/page")
+        assert d.vertical_dht_partition(uh) == d.vertical_dht_partition(uh)

@@ -1,0 +1,1 @@
+"""Distribution & device-mesh layer: DHT math, meshes, sharded execution."""

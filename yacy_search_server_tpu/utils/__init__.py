@@ -1,0 +1,1 @@
+"""Foundation substrate (cora-equivalent): orders, hashes, config, queues."""

@@ -1,0 +1,66 @@
+"""Appearance-flag bitfield for postings and query constraints.
+
+The reference stores a 4-byte bitfield per posting (reference:
+source/net/yacy/kelondro/util/Bitfield.java used by
+kelondro/data/word/WordReferenceRow.java:49-69 column "z"). Here flags are a
+plain int32 so whole postings blocks carry them as one dense device column
+and constraint checks become vectorized AND-compare masks.
+
+Flag positions (identical to the reference so wire/ranking semantics match):
+- category flags (document/Tokenizer.java:51-56)
+- appearance flags (kelondro/data/word/WordReferenceRow.java:104-110)
+"""
+
+from __future__ import annotations
+
+# category flags (Tokenizer.java:51-56)
+FLAG_CAT_INDEXOF = 0        # directory-listing page ("index of")
+FLAG_CAT_HASLOCATION = 19   # page has location metadata
+FLAG_CAT_HASIMAGE = 20      # page references image(s)
+FLAG_CAT_HASAUDIO = 21      # page references audio
+FLAG_CAT_HASVIDEO = 22      # page references video
+FLAG_CAT_HASAPP = 23        # page references application files
+
+# appearance flags (WordReferenceRow.java:104-110)
+FLAG_APP_DC_DESCRIPTION = 24  # word appears in anchor/alt description text
+FLAG_APP_DC_TITLE = 25        # word appears in title/headline
+FLAG_APP_DC_CREATOR = 26      # word appears in author
+FLAG_APP_DC_SUBJECT = 27      # word appears in header tags / descriptive part
+FLAG_APP_DC_IDENTIFIER = 28   # word appears in url
+FLAG_APP_EMPHASIZED = 29      # word is bold/italic/emphasized
+
+ALL_FLAGS = 30
+
+
+class Bitfield:
+    """Mutable flag set backed by one int; `.value` is the dense column cell."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: int = 0):
+        self.value = int(value)
+
+    def set(self, pos: int, on: bool = True) -> None:
+        if on:
+            self.value |= 1 << pos
+        else:
+            self.value &= ~(1 << pos)
+
+    def get(self, pos: int) -> bool:
+        return bool(self.value & (1 << pos))
+
+    def or_(self, other: "Bitfield") -> None:
+        self.value |= other.value
+
+    def matches(self, constraint: int) -> bool:
+        """True if every bit of `constraint` is set here (query constraints)."""
+        return (self.value & constraint) == constraint
+
+    def __int__(self) -> int:
+        return self.value
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Bitfield) and self.value == other.value
+
+    def __repr__(self) -> str:
+        return f"Bitfield({self.value:#x})"

@@ -1,0 +1,144 @@
+"""Two-layer key-value configuration + network-unit definitions.
+
+Reproduces the reference's config model (reference:
+source/net/yacy/server/serverSwitch.java:273-334,453): an immutable defaults
+layer overlaid by a mutable settings file that is persisted on every change,
+plus separate *network unit* definitions that rewire DHT/crawl behavior
+(reference: defaults/yacy.network.freeworld.unit selected by
+`network.unit.definition`).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Iterator
+
+
+def _parse_kv(text: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "=" not in line:
+            continue
+        k, v = line.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+class Config:
+    """defaults (read-only) overlaid by settings (mutable, persisted)."""
+
+    def __init__(self, defaults: dict[str, str] | None = None,
+                 settings_path: str | None = None):
+        self._defaults: dict[str, str] = dict(defaults or {})
+        self._settings: dict[str, str] = {}
+        self._path = settings_path
+        self._lock = threading.RLock()
+        if settings_path and os.path.exists(settings_path):
+            with open(settings_path, "r", encoding="utf-8") as f:
+                self._settings = _parse_kv(f.read())
+
+    @classmethod
+    def from_files(cls, defaults_path: str, settings_path: str | None = None) -> "Config":
+        with open(defaults_path, "r", encoding="utf-8") as f:
+            defaults = _parse_kv(f.read())
+        return cls(defaults, settings_path)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, key: str, default: str = "") -> str:
+        with self._lock:
+            if key in self._settings:
+                return self._settings[key]
+            return self._defaults.get(key, default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        try:
+            return int(self.get(key, str(default)))
+        except ValueError:
+            return default
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        try:
+            return float(self.get(key, str(default)))
+        except ValueError:
+            return default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key, "true" if default else "false").lower()
+        return v in ("true", "1", "yes", "on")
+
+    def keys(self) -> Iterator[str]:
+        with self._lock:
+            return iter(sorted(set(self._defaults) | set(self._settings)))
+
+    # -- writes --------------------------------------------------------------
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        with self._lock:
+            self._settings[key] = str(value)
+            self._persist()
+
+    def _persist(self) -> None:
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            for k in sorted(self._settings):
+                f.write(f"{k}={self._settings[k]}\n")
+        os.replace(tmp, self._path)
+
+
+# Default network unit, mirroring the operational constants of the
+# reference's freeworld unit (defaults/yacy.network.freeworld.unit):
+# 2^4 = 16 vertical partitions, redundancy junior=1/senior=3,
+# 3000 ms / 10 results remote-search budget.
+FREEWORLD_UNIT: dict[str, str] = {
+    "network.unit.name": "freeworld",
+    "network.unit.description": "Public YaCy-equivalent network",
+    "network.unit.dht": "true",
+    "network.unit.dht.partitionExponent": "4",
+    "network.unit.dhtredundancy.junior": "1",
+    "network.unit.dhtredundancy.senior": "3",
+    "network.unit.remotesearch.maxcount": "10",
+    "network.unit.remotesearch.maxtime": "3000",
+    "network.unit.remotecrawl.speed": "60",
+}
+
+INTRANET_UNIT: dict[str, str] = {
+    "network.unit.name": "intranet",
+    "network.unit.description": "Closed intranet network",
+    "network.unit.dht": "false",
+    "network.unit.dht.partitionExponent": "0",
+    "network.unit.dhtredundancy.junior": "1",
+    "network.unit.dhtredundancy.senior": "1",
+    "network.unit.remotesearch.maxcount": "100",
+    "network.unit.remotesearch.maxtime": "3000",
+    "network.unit.remotecrawl.speed": "0",
+}
+
+NETWORK_UNITS = {"freeworld": FREEWORLD_UNIT, "intranet": INTRANET_UNIT}
+
+
+class NetworkUnit:
+    """Selected network definition; switching rewires DHT + crawl behavior."""
+
+    def __init__(self, name: str = "freeworld", overrides: dict[str, str] | None = None):
+        base = dict(NETWORK_UNITS.get(name, FREEWORLD_UNIT))
+        if overrides:
+            base.update(overrides)
+        self.props = base
+        self.name = base["network.unit.name"]
+        self.dht_enabled = base.get("network.unit.dht", "false") == "true"
+        self.partition_exponent = int(base.get("network.unit.dht.partitionExponent", "0"))
+        self.redundancy_junior = int(base.get("network.unit.dhtredundancy.junior", "1"))
+        self.redundancy_senior = int(base.get("network.unit.dhtredundancy.senior", "1"))
+        self.remotesearch_maxcount = int(base.get("network.unit.remotesearch.maxcount", "10"))
+        self.remotesearch_maxtime_ms = int(base.get("network.unit.remotesearch.maxtime", "3000"))
+        self.remotecrawl_speed_ppm = int(base.get("network.unit.remotecrawl.speed", "0"))

@@ -1,0 +1,69 @@
+"""Per-stage event tracking — the tracing surface of the framework.
+
+Capability equivalent of the reference's EventTracker (reference:
+source/net/yacy/search/EventTracker.java:41): bounded in-memory time-series
+per event class; every pipeline/search stage reports (label, count,
+duration) and dashboards render them. Kept deliberately cheap: a deque per
+class, no locks on the hot path beyond deque's own thread safety.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from enum import Enum
+
+
+class EClass(Enum):
+    SEARCH = "search"
+    WORDCACHE = "wordcache"
+    MEMORY = "memory"
+    PPM = "ppm"
+    INDEX = "index"
+    DHT = "dht"
+    PEERPING = "peerping"
+
+
+@dataclass(frozen=True)
+class Event:
+    ts: float
+    label: str
+    count: int
+    duration_ms: float
+
+
+_MAX_EVENTS = 4096
+_series: dict[EClass, deque] = {c: deque(maxlen=_MAX_EVENTS) for c in EClass}
+
+
+def update(eclass: EClass, label: str, count: int = 0, duration_ms: float = 0.0) -> None:
+    _series[eclass].append(Event(time.time(), label, count, duration_ms))
+
+
+def events(eclass: EClass) -> list[Event]:
+    return list(_series[eclass])
+
+
+def clear(eclass: EClass | None = None) -> None:
+    if eclass is None:
+        for d in _series.values():
+            d.clear()
+    else:
+        _series[eclass].clear()
+
+
+class StageTimer:
+    """Context manager reporting one stage's wall time on exit."""
+
+    def __init__(self, eclass: EClass, label: str, count: int = 0):
+        self.eclass, self.label, self.count = eclass, label, count
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        update(self.eclass, self.label, self.count,
+               (time.monotonic() - self._t0) * 1000.0)
+        return False

@@ -1,0 +1,74 @@
+"""Memory governance — heap watermarks consulted by queues and caches.
+
+Capability equivalent of the reference's memory governor (reference:
+source/net/yacy/kelondro/util/MemoryControl.java:35,150): central place that
+answers "is there room for this allocation" and "are we in short status",
+so buffers flush and caches shed before the process OOMs. Here it watches
+process RSS against a configurable budget (cgroup/system limits are read
+when available).
+"""
+
+from __future__ import annotations
+
+import os
+import resource
+import sys
+import threading
+
+
+def _read_int(path: str) -> int | None:
+    try:
+        with open(path, "r") as f:
+            txt = f.read().strip()
+        if txt == "max":
+            return None
+        return int(txt)
+    except (OSError, ValueError):
+        return None
+
+
+def _detect_limit() -> int:
+    # cgroup v2, then v1, then /proc/meminfo total
+    for p in ("/sys/fs/cgroup/memory.max", "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        v = _read_int(p)
+        if v and v < (1 << 50):
+            return v
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 8 << 30
+
+
+class _MemoryControl:
+    def __init__(self):
+        self.limit = _detect_limit()
+        self.short_threshold = 0.9  # fraction of limit considered "short"
+        self._lock = threading.Lock()
+
+    def used(self) -> int:
+        """Current process RSS in bytes (peak RSS on non-/proc platforms)."""
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, ValueError):
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss is KiB on Linux but bytes on macOS
+            return rss if sys.platform == "darwin" else rss * 1024
+
+    def available(self) -> int:
+        return max(0, self.limit - self.used())
+
+    def short_status(self) -> bool:
+        return self.used() > self.limit * self.short_threshold
+
+    def request(self, size: int, force_flush: bool = False) -> bool:
+        """True if `size` bytes can likely be allocated."""
+        return self.available() >= size
+
+
+MemoryControl = _MemoryControl()

@@ -1,0 +1,82 @@
+"""Score maps — counting maps behind facets, navigators and authority scores.
+
+Equivalent capability to the reference's score-map family (reference:
+source/net/yacy/cora/sorting/ConcurrentScoreMap.java, ClusteredScoreMap.java,
+OrderedScoreMap.java). One thread-safe implementation covers all three roles;
+iteration order is produced on demand (Python's sort is cheap relative to the
+map sizes these hold: facet dimensions, host counts, top words).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Generic, Hashable, Iterator, TypeVar
+
+K = TypeVar("K", bound=Hashable)
+
+
+class ScoreMap(Generic[K]):
+    def __init__(self):
+        self._map: dict[K, int] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, key: K, amount: int = 1) -> int:
+        with self._lock:
+            v = self._map.get(key, 0) + amount
+            self._map[key] = v
+            return v
+
+    def dec(self, key: K, amount: int = 1) -> int:
+        return self.inc(key, -amount)
+
+    def set(self, key: K, score: int) -> None:
+        with self._lock:
+            self._map[key] = score
+
+    def get(self, key: K) -> int:
+        with self._lock:
+            return self._map.get(key, 0)
+
+    def delete(self, key: K) -> int:
+        with self._lock:
+            return self._map.pop(key, 0)
+
+    def contains(self, key: K) -> bool:
+        with self._lock:
+            return key in self._map
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def is_empty(self) -> bool:
+        return len(self) == 0
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+    def max_score(self) -> int:
+        with self._lock:
+            return max(self._map.values(), default=0)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._map.values())
+
+    def keys(self, up: bool = True) -> Iterator[K]:
+        """Keys ordered by score (then key, for determinism)."""
+        with self._lock:
+            items = list(self._map.items())
+        items.sort(key=lambda kv: (kv[1], str(kv[0])), reverse=not up)
+        return iter(k for k, _ in items)
+
+    def top(self, n: int) -> list[tuple[K, int]]:
+        with self._lock:
+            items = list(self._map.items())
+        items.sort(key=lambda kv: (-kv[1], str(kv[0])))
+        return items[:n]
+
+    def items(self) -> list[tuple[K, int]]:
+        with self._lock:
+            return list(self._map.items())

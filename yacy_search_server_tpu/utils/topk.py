@@ -1,0 +1,163 @@
+"""Bounded best-N priority queue — the result-merge structure.
+
+Capability equivalent of the reference's WeakPriorityBlockingQueue
+(reference: source/net/yacy/cora/sorting/WeakPriorityBlockingQueue.java:43):
+a fixed-capacity ordered container that keeps the best N elements by weight,
+counts evictions ("misses"), supports blocking take with timeout, and keeps a
+drained list so earlier elements remain addressable by index (the paging
+path of a live search event re-reads them).
+
+Implementation: two heaps over the same alive-entry set with lazy deletion —
+a min-heap (worst-first, drives eviction when full) and a negated max-heap
+(best-first, drives poll) — giving O(log n) put/poll under interleaved
+streaming producers and consumers.
+
+On the device side this structure collapses into batched top-k kernels
+(ops/topk.py); this host-side variant is the fusion point where asynchronous
+producers (local device results, remote peers) meet.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Element(Generic[T]):
+    __slots__ = ("payload", "weight")
+
+    def __init__(self, payload: T, weight: int):
+        self.payload = payload
+        self.weight = weight
+
+
+class WeakPriorityQueue(Generic[T]):
+    """Keeps the best `maxsize` elements; largest weight = best."""
+
+    def __init__(self, maxsize: int):
+        assert maxsize > 0
+        self.maxsize = maxsize
+        self._alive: dict[int, tuple[int, T]] = {}   # seq -> (weight, payload)
+        self._worst: list[tuple[int, int]] = []       # min-heap (weight, seq)
+        self._best: list[tuple[int, int]] = []        # min-heap (-weight, seq)
+        self._seq = itertools.count()
+        self._drained: list[Element[T]] = []
+        self._misses = 0
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+
+    # -- internal helpers (hold lock) ---------------------------------------
+
+    def _prune(self, heap: list[tuple[int, int]]) -> None:
+        while heap and heap[0][1] not in self._alive:
+            heapq.heappop(heap)
+
+    def _evict_worst(self) -> None:
+        self._prune(self._worst)
+        if self._worst:
+            _, seq = heapq.heappop(self._worst)
+            del self._alive[seq]
+
+    # -- producers -----------------------------------------------------------
+
+    def put(self, payload: T, weight: int) -> bool:
+        """Insert; returns False if the element was rejected (too weak)."""
+        with self._not_empty:
+            if len(self._alive) >= self.maxsize:
+                self._prune(self._worst)
+                if self._worst and self._worst[0][0] >= weight:
+                    self._misses += 1
+                    return False
+                self._evict_worst()
+                self._misses += 1
+            seq = next(self._seq)
+            self._alive[seq] = (weight, payload)
+            heapq.heappush(self._worst, (weight, seq))
+            heapq.heappush(self._best, (-weight, seq))
+            self._not_empty.notify()
+            return True
+
+    # -- consumers -----------------------------------------------------------
+
+    def _poll_locked(self) -> Optional[Element[T]]:
+        self._prune(self._best)
+        if not self._best:
+            return None
+        _, seq = heapq.heappop(self._best)
+        weight, payload = self._alive.pop(seq)
+        el = Element(payload, weight)
+        self._drained.append(el)
+        return el
+
+    def poll(self) -> Optional[Element[T]]:
+        """Remove and return the best element, or None if empty."""
+        with self._lock:
+            return self._poll_locked()
+
+    def take(self, timeout_s: float | None = None) -> Optional[Element[T]]:
+        """Blocking poll: wait up to timeout for an element."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._not_empty:
+            while not self._alive:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                if not self._not_empty.wait(remaining):
+                    return None
+            return self._poll_locked()
+
+    def element(self, index: int, timeout_s: float | None = None) -> Optional[Element[T]]:
+        """The index'th best element ever drained; drains more as needed."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._not_empty:
+            while len(self._drained) <= index:
+                if self._alive:
+                    self._poll_locked()
+                    continue
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                if remaining is None and timeout_s is not None:
+                    return None
+                if not self._not_empty.wait(remaining):
+                    return None
+            return self._drained[index]
+
+    # -- introspection -------------------------------------------------------
+
+    def peek_weight(self) -> Optional[int]:
+        with self._lock:
+            self._prune(self._best)
+            return -self._best[0][0] if self._best else None
+
+    def size_queue(self) -> int:
+        with self._lock:
+            return len(self._alive)
+
+    def size_drained(self) -> int:
+        with self._lock:
+            return len(self._drained)
+
+    def size_available(self) -> int:
+        with self._lock:
+            return len(self._alive) + len(self._drained)
+
+    @property
+    def misses(self) -> int:
+        with self._lock:
+            return self._misses
+
+    def is_empty(self) -> bool:
+        return self.size_queue() == 0
+
+    def list_all(self) -> list[Element[T]]:
+        """Drain everything and return drained history (ranked order)."""
+        with self._lock:
+            while self._alive:
+                self._poll_locked()
+            return list(self._drained)

@@ -1,0 +1,180 @@
+"""Host-side async pipeline scaffolding: WorkflowProcessor + BusyThread.
+
+Capability equivalent of the reference's thread-pipeline substrate
+(reference: source/net/yacy/kelondro/workflow/WorkflowProcessor.java and
+AbstractBusyThread.java / InstantBusyThread.java): named bounded queues with
+worker pools chained into a pipeline with backpressure, and periodic jobs
+with idle/busy sleep plus memory preconditions. In the TPU build this is the
+host pipeline that batches parse/condense work and feeds device step
+functions; stages expose live metrics for the performance dashboard.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Generic, Optional, TypeVar
+
+from .memory import MemoryControl
+
+T = TypeVar("T")
+
+_POISON = object()
+
+
+@dataclass
+class StageMetrics:
+    name: str = ""
+    enqueued: int = 0
+    processed: int = 0
+    errors: int = 0
+    total_exec_ns: int = 0
+    queue_size: int = 0
+    workers: int = 0
+
+    @property
+    def avg_exec_ms(self) -> float:
+        return (self.total_exec_ns / self.processed / 1e6) if self.processed else 0.0
+
+
+class WorkflowProcessor(Generic[T]):
+    """Named bounded queue + worker pool; `next_stage` receives results."""
+
+    def __init__(self, name: str, task: Callable[[T], Optional[object]],
+                 workers: int = 1, queue_size: int = 200,
+                 next_stage: "WorkflowProcessor | None" = None):
+        self.name = name
+        self.task = task
+        self.next_stage = next_stage
+        self.queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self.metrics = StageMetrics(name=name, workers=workers)
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._running = True
+        for i in range(workers):
+            t = threading.Thread(target=self._loop, name=f"{name}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def enqueue(self, item: T, block: bool = True, timeout: float | None = None) -> None:
+        self.queue.put(item, block=block, timeout=timeout)
+        with self._lock:
+            self.metrics.enqueued += 1
+
+    def _loop(self) -> None:
+        while True:
+            item = self.queue.get()
+            if item is _POISON:
+                self.queue.task_done()
+                return
+            t0 = time.monotonic_ns()
+            try:
+                result = self.task(item)
+                if result is not None and self.next_stage is not None:
+                    self.next_stage.enqueue(result)
+                with self._lock:
+                    self.metrics.processed += 1
+            except Exception:
+                with self._lock:
+                    self.metrics.errors += 1
+            finally:
+                with self._lock:
+                    self.metrics.total_exec_ns += time.monotonic_ns() - t0
+                self.queue.task_done()
+
+    def queue_size(self) -> int:
+        return self.queue.qsize()
+
+    def join(self) -> None:
+        self.queue.join()
+
+    def shutdown(self, drain: bool = True) -> None:
+        if not self._running:
+            return
+        self._running = False
+        if drain:
+            self.queue.join()
+        for _ in self._threads:
+            self.queue.put(_POISON)
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+class BusyThread:
+    """Periodic job with idle/busy sleep and memory preconditions.
+
+    `job` returns True when it did work (busy sleep next) and False when idle
+    (idle sleep next) — the idle/busy pacing model of the reference's busy
+    threads (AbstractBusyThread).
+    """
+
+    def __init__(self, name: str, job: Callable[[], bool],
+                 idle_sleep_s: float = 10.0, busy_sleep_s: float = 1.0,
+                 memory_floor_bytes: int = 0, start_delay_s: float = 0.0):
+        self.name = name
+        self.job = job
+        self.idle_sleep_s = idle_sleep_s
+        self.busy_sleep_s = busy_sleep_s
+        self.memory_floor_bytes = memory_floor_bytes
+        self.start_delay_s = start_delay_s
+        self.busy_cycles = 0
+        self.idle_cycles = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+
+    def start(self) -> "BusyThread":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        if self.start_delay_s and self._stop.wait(self.start_delay_s):
+            return
+        while not self._stop.is_set():
+            did_work = False
+            if self.memory_floor_bytes and not MemoryControl.available() >= self.memory_floor_bytes:
+                did_work = False
+            else:
+                try:
+                    did_work = bool(self.job())
+                except Exception:
+                    self.errors += 1
+            if did_work:
+                self.busy_cycles += 1
+                self._stop.wait(self.busy_sleep_s)
+            else:
+                self.idle_cycles += 1
+                self._stop.wait(self.idle_sleep_s)
+
+    def terminate(self, wait: bool = True) -> None:
+        self._stop.set()
+        if wait and self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+
+class ThreadRegistry:
+    """Named registry of busy threads (the switchboard's deployThread model)."""
+
+    def __init__(self):
+        self._threads: dict[str, BusyThread] = {}
+
+    def deploy(self, thread: BusyThread) -> BusyThread:
+        self._threads[thread.name] = thread
+        return thread.start()
+
+    def get(self, name: str) -> BusyThread | None:
+        return self._threads.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._threads)
+
+    def terminate_all(self) -> None:
+        for t in self._threads.values():
+            t._stop.set()
+        for t in self._threads.values():
+            t.terminate()
