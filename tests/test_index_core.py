@@ -1,0 +1,375 @@
+"""Index-core tests: postings blocks, RWI LSM, metadata store, Segment.
+
+Mirrors the reference's embedded-integration style (SURVEY.md §4:
+SegmentTest boots a real Segment on a temp dir, indexes synthetic docs and
+runs TermSearch queries; ReferenceContainerTest exercises add/search/join).
+"""
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.document.condenser import Condenser, words_of, phrases_of
+from yacy_search_server_tpu.document.document import Anchor, Document
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.metadata import DocumentMetadata, MetadataStore
+from yacy_search_server_tpu.index.postings import PostingsList, merge, remove_docids
+from yacy_search_server_tpu.index.rwi import RWIIndex
+from yacy_search_server_tpu.index.segment import (
+    Segment, exclude_destructive, join_constructive,
+)
+from yacy_search_server_tpu.utils.bitfield import (
+    Bitfield, FLAG_APP_DC_IDENTIFIER, FLAG_APP_DC_TITLE, FLAG_CAT_HASIMAGE,
+)
+from yacy_search_server_tpu.utils.hashes import url2hash, word2hash
+
+
+def plist(ids, cols=None):
+    """Helper: postings list with given docids and {feature col: values}."""
+    d = np.asarray(ids, dtype=np.int32)
+    f = np.zeros((len(d), P.NF), dtype=np.int32)
+    for col, vals in (cols or {}).items():
+        f[:, col] = vals
+    return PostingsList(d, f)
+
+
+class TestPostings:
+    def test_sort_dedupe_last_wins(self):
+        pl = PostingsList.from_rows(
+            [5, 3, 5], np.array([[1] * P.NF, [2] * P.NF, [9] * P.NF]))
+        assert pl.docids.tolist() == [3, 5]
+        assert pl.feats[1, 0] == 9  # later row for docid 5 won
+
+    def test_merge_override(self):
+        a = plist([1, 2], {P.F_HITCOUNT: [10, 10]})
+        b = plist([2, 3], {P.F_HITCOUNT: [99, 7]})
+        m = merge([a, b])
+        assert m.docids.tolist() == [1, 2, 3]
+        assert m.feats[1, P.F_HITCOUNT] == 99  # b overrides a for docid 2
+
+    def test_remove_docids(self):
+        pl = plist([1, 2, 3, 4])
+        out = remove_docids(pl, np.array([2, 4], dtype=np.int32))
+        assert out.docids.tolist() == [1, 3]
+
+    def test_language_pack(self):
+        assert P.unpack_language(P.pack_language("en")) == "en"
+        assert P.pack_language("") == 0
+
+
+class TestRWI:
+    def test_add_flush_get(self, tmp_path):
+        rwi = RWIIndex(str(tmp_path / "rwi"), max_ram_postings=10)
+        th = word2hash("hello")
+        for docid in [4, 1, 7]:
+            rwi.add(th, docid, np.full(P.NF, docid, dtype=np.int32))
+        got = rwi.get(th)
+        assert got.docids.tolist() == [1, 4, 7]
+        rwi.flush()
+        assert rwi.ram_postings_count == 0
+        assert rwi.get(th).docids.tolist() == [1, 4, 7]
+
+    def test_persistence_roundtrip(self, tmp_path):
+        d = str(tmp_path / "rwi")
+        rwi = RWIIndex(d)
+        th = word2hash("persist")
+        rwi.add(th, 42, np.arange(P.NF, dtype=np.int32))
+        rwi.close()  # flushes
+        rwi2 = RWIIndex(d)
+        got = rwi2.get(th)
+        assert got.docids.tolist() == [42]
+        assert got.feats[0].tolist() == list(range(P.NF))
+
+    def test_ram_overrides_run(self, tmp_path):
+        rwi = RWIIndex(None)
+        th = word2hash("w")
+        rwi.add(th, 1, np.full(P.NF, 1, dtype=np.int32))
+        rwi.flush()
+        rwi.add(th, 1, np.full(P.NF, 2, dtype=np.int32))  # re-index same doc
+        assert rwi.get(th).feats[0, 0] == 2
+
+    def test_tombstone_and_merge(self):
+        rwi = RWIIndex(None)
+        th = word2hash("w")
+        for i in range(6):
+            rwi.add(th, i, np.zeros(P.NF, dtype=np.int32))
+            rwi.flush()  # 6 runs of 1 posting
+        rwi.delete_doc(3)
+        assert rwi.get(th).docids.tolist() == [0, 1, 2, 4, 5]
+        assert rwi.merge_runs(max_runs=2) is True
+        assert rwi.run_count() <= 2
+        assert rwi.get(th).docids.tolist() == [0, 1, 2, 4, 5]
+
+    def test_remove_term_ownership_move(self):
+        rwi = RWIIndex(None)
+        th = word2hash("moved")
+        rwi.add(th, 1, np.zeros(P.NF, dtype=np.int32))
+        rwi.flush()
+        rwi.add(th, 2, np.zeros(P.NF, dtype=np.int32))
+        taken = rwi.remove_term(th)
+        assert taken.docids.tolist() == [1, 2]
+        assert rwi.count(th) == 0  # delete-on-select: gone locally
+
+    def test_ring_segment_selection(self):
+        rwi = RWIIndex(None)
+        hashes = [word2hash(w) for w in ("alpha", "beta", "gamma", "delta")]
+        for th in hashes:
+            rwi.add(th, 1, np.zeros(P.NF, dtype=np.int32))
+        from yacy_search_server_tpu.parallel.distribution import horizontal_dht_position
+        positions = sorted(horizontal_dht_position(th) for th in hashes)
+        sel = rwi.terms_in_ring_segment(positions[0], positions[2])
+        assert len(sel) == 2  # two of four fall in [p0, p2)
+
+
+class TestJoin:
+    def test_conjunction_intersects(self):
+        a = plist([1, 2, 3], {P.F_POSINTEXT: [10, 20, 30]})
+        b = plist([2, 3, 4], {P.F_POSINTEXT: [25, 31, 99]})
+        j = join_constructive([a, b])
+        assert j.docids.tolist() == [2, 3]
+        # worddistance = span of posintext across terms
+        assert j.feats[:, P.F_WORDDISTANCE].tolist() == [5, 1]
+
+    def test_exclusion(self):
+        j = exclude_destructive(plist([1, 2, 3]), plist([2]))
+        assert j.docids.tolist() == [1, 3]
+
+    def test_flags_or_merged(self):
+        a = plist([1], {P.F_FLAGS: [1 << FLAG_APP_DC_TITLE]})
+        b = plist([1], {P.F_FLAGS: [1 << FLAG_CAT_HASIMAGE]})
+        j = join_constructive([a, b])
+        assert j.feats[0, P.F_FLAGS] == (1 << FLAG_APP_DC_TITLE) | (1 << FLAG_CAT_HASIMAGE)
+
+
+class TestMetadata:
+    def test_put_get_overwrite(self, tmp_path):
+        m = MetadataStore(str(tmp_path / "meta"))
+        uh = url2hash("http://a.com/x")
+        d1 = m.put(DocumentMetadata(uh, sku="http://a.com/x", title="one"))
+        d2 = m.put(DocumentMetadata(uh, sku="http://a.com/x", title="two"))
+        assert d1 == d2
+        assert m.get(d1).get("title") == "two"
+        assert len(m) == 1
+
+    def test_journal_replay(self, tmp_path):
+        p = str(tmp_path / "meta")
+        m = MetadataStore(p)
+        uh = url2hash("http://a.com/x")
+        m.put(DocumentMetadata(uh, title="hello", wordcount_i=7))
+        m.delete(url2hash("http://a.com/x"))
+        m.put(DocumentMetadata(url2hash("http://b.com/y"), title="b"))
+        m.close()
+        m2 = MetadataStore(p)
+        assert m2.get_by_urlhash(uh) is None          # delete survived
+        assert m2.get_by_urlhash(url2hash("http://b.com/y")).get("title") == "b"
+
+    def test_int_column(self):
+        m = MetadataStore()
+        m.put(DocumentMetadata(url2hash("http://a.com/1"), wordcount_i=5))
+        m.put(DocumentMetadata(url2hash("http://a.com/2"), wordcount_i=9))
+        assert m.int_column("wordcount_i").tolist() == [5, 9]
+
+
+class TestCondenser:
+    def make_doc(self):
+        return Document(
+            url="http://example.com/products/page.html",
+            title="Example products",
+            description="All the example products",
+            text="This page lists products. Products are examples! Contact us.",
+            anchors=[Anchor("http://example.com/about", "about"),
+                     Anchor("http://other.org/x", "elsewhere")],
+        )
+
+    def test_word_stats(self):
+        c = Condenser(self.make_doc())
+        assert "products" in c.words
+        st = c.words["products"]
+        assert st.count == 2            # body occurrences counted
+        assert st.posintext == 4        # first occurrence position
+        assert c.phrase_count == 3
+
+    def test_appearance_flags(self):
+        c = Condenser(self.make_doc())
+        assert c.words["products"].flags.get(FLAG_APP_DC_TITLE)
+        assert c.words["example"].flags.get(FLAG_APP_DC_TITLE)
+        assert c.words["page"].flags.get(FLAG_APP_DC_IDENTIFIER)  # in url
+        assert not c.words["contact"].flags.get(FLAG_APP_DC_TITLE)
+
+    def test_postings_rows_shape(self):
+        c = Condenser(self.make_doc())
+        hashes, rows = c.postings_rows()
+        assert len(hashes) == len(c.words)
+        assert rows.shape == (len(c.words), P.NF)
+        assert rows[0, P.F_LOTHER] == 1 and rows[0, P.F_LLOCAL] == 1
+
+    def test_tokenizer(self):
+        assert words_of("Hello, World! 42 foo_bar") == ["hello", "world", "foo_bar"]
+        assert len(phrases_of("One. Two! Three?")) == 3
+
+
+class TestSegment:
+    def docs(self):
+        return [
+            Document(url="http://alpha.com/jax", title="JAX on TPU",
+                     text="JAX compiles numerical programs for TPU hardware. "
+                          "The compiler fuses operations."),
+            Document(url="http://beta.org/tpu", title="TPU architecture",
+                     text="A TPU has a systolic array. Matrix units do the work.",
+                     anchors=[Anchor("http://alpha.com/jax", "jax article")]),
+            Document(url="http://gamma.net/cpu", title="CPU history",
+                     text="The CPU is a general processor. History is long."),
+        ]
+
+    def test_store_and_search(self, tmp_path):
+        seg = Segment(str(tmp_path / "seg"))
+        for d in self.docs():
+            seg.store_document(d)
+        assert seg.doc_count() == 3
+
+        hits = seg.term_search(include_words=["tpu"])
+        assert len(hits) == 2
+        # "jax" also matches beta via its anchor text pointing at alpha —
+        # anchor-text words are indexed on the citing page with the
+        # description flag; "compiler" is body-only on alpha
+        hits = seg.term_search(include_words=["tpu", "compiler"])
+        assert len(hits) == 1
+        meta = seg.get_metadata(int(hits.docids[0]))
+        assert meta.get("sku") == "http://alpha.com/jax"
+
+    def test_all_or_nothing_rule(self, tmp_path):
+        seg = Segment(None)
+        for d in self.docs():
+            seg.store_document(d)
+        # "tpu" matches but "zebra" has no postings -> empty (TermSearch:56-58)
+        assert len(seg.term_search(include_words=["tpu", "zebra"])) == 0
+
+    def test_exclusion(self):
+        seg = Segment(None)
+        for d in self.docs():
+            seg.store_document(d)
+        hits = seg.term_search(include_words=["tpu"], exclude_words=["systolic"])
+        assert len(hits) == 1  # beta excluded, alpha remains
+
+    def test_citation_postprocessing(self):
+        seg = Segment(None)
+        for d in self.docs():
+            seg.store_document(d)
+        # beta.org/tpu cites alpha.com/jax after alpha was indexed; the
+        # reference-count postprocessing must have updated alpha's row
+        uh = url2hash("http://alpha.com/jax")
+        meta = seg.metadata.get_by_urlhash(uh)
+        assert meta.get("references_i") == 1
+        assert meta.get("references_exthosts_i") == 1
+
+    def test_remove_document(self):
+        seg = Segment(None)
+        for d in self.docs():
+            seg.store_document(d)
+        assert seg.remove_document(url2hash("http://beta.org/tpu"))
+        assert len(seg.term_search(include_words=["tpu"])) == 1
+        assert seg.doc_count() == 2
+
+    def test_reindex_same_url_no_dup(self):
+        seg = Segment(None)
+        d = self.docs()[0]
+        seg.store_document(d)
+        seg.store_document(d)
+        assert seg.doc_count() == 1
+        assert len(seg.term_search(include_words=["jax"])) == 1
+
+    def test_persistence(self, tmp_path):
+        p = str(tmp_path / "seg")
+        seg = Segment(p)
+        for d in self.docs():
+            seg.store_document(d)
+        seg.close()
+        seg2 = Segment(p)
+        assert seg2.doc_count() == 3
+        assert len(seg2.term_search(include_words=["tpu"])) == 2
+
+
+class TestRWIRegressions:
+    """Regressions for review findings: empty-bucket flush, merge ordering,
+    deletion persistence, counter integrity, malformed urls."""
+
+    def test_flush_after_delete_emptied_bucket(self):
+        rwi = RWIIndex(None)
+        th = word2hash("w")
+        rwi.add(th, 1, np.zeros(P.NF, dtype=np.int32))
+        rwi.delete_doc(1)
+        assert rwi.ram_postings_count == 0      # counter decremented
+        rwi.flush()                              # must not raise
+        assert rwi.count(th) == 0
+
+    def test_merge_preserves_newest_write(self):
+        rwi = RWIIndex(None)
+        th = word2hash("w")
+        rwi.add(th, 5, np.full(P.NF, 111, dtype=np.int32)); rwi.flush()
+        rwi.add(th, 9, np.zeros(P.NF, dtype=np.int32)); rwi.flush()  # big run
+        rwi.add(th, 5, np.full(P.NF, 222, dtype=np.int32)); rwi.flush()
+        assert rwi.get(th).feats[0, 0] == 222
+        rwi.merge_runs(max_runs=2)
+        assert rwi.get(th).feats[0, 0] == 222   # newest write survives merge
+
+    def test_deletions_survive_restart(self, tmp_path):
+        d = str(tmp_path / "rwi")
+        rwi = RWIIndex(d)
+        th = word2hash("w")
+        rwi.add(th, 1, np.zeros(P.NF, dtype=np.int32))
+        rwi.add(th, 2, np.zeros(P.NF, dtype=np.int32))
+        rwi.flush()
+        rwi.delete_doc(1)
+        rwi.close()
+        rwi2 = RWIIndex(d)
+        assert rwi2.get(th).docids.tolist() == [2]
+
+    def test_term_removal_survives_restart_and_readd(self, tmp_path):
+        d = str(tmp_path / "rwi")
+        rwi = RWIIndex(d)
+        th = word2hash("moved")
+        rwi.add(th, 1, np.zeros(P.NF, dtype=np.int32))
+        rwi.flush()
+        rwi.remove_term(th)                      # DHT handoff
+        rwi.add(th, 7, np.zeros(P.NF, dtype=np.int32))  # re-added later
+        rwi.close()
+        rwi2 = RWIIndex(d)
+        assert rwi2.get(th).docids.tolist() == [7]  # removal held, re-add kept
+
+    def test_merge_persists_correct_order(self, tmp_path):
+        d = str(tmp_path / "rwi")
+        rwi = RWIIndex(d)
+        th = word2hash("w")
+        for val in (1, 2, 3):
+            rwi.add(th, 5, np.full(P.NF, val, dtype=np.int32))
+            rwi.flush()
+        rwi.merge_runs(max_runs=2)
+        rwi.close()
+        rwi2 = RWIIndex(d)
+        assert rwi2.get(th).feats[0, 0] == 3    # manifest kept history order
+
+
+class TestMetadataRegressions:
+    def test_set_field_survives_restart(self, tmp_path):
+        p = str(tmp_path / "meta")
+        m = MetadataStore(p)
+        uh = url2hash("http://a.com/x")
+        d = m.put(DocumentMetadata(uh, title="a", references_i=0))
+        m.set_field(d, "references_i", 5)
+        m.close()
+        m2 = MetadataStore(p)
+        assert m2.get_by_urlhash(uh).get("references_i") == 5
+
+
+class TestMalformedUrls:
+    def test_store_document_with_bad_anchor(self):
+        from yacy_search_server_tpu.utils.hashes import url2hash as u2h
+        seg = Segment(None)
+        seg.store_document(Document(
+            url="http://ok.com/x", title="t", text="body words here.",
+            anchors=[Anchor("http://[broken", "bad"),
+                     Anchor("http://example.com:99999/y", "bad port")]))
+        assert seg.doc_count() == 1
+
+    def test_url2hash_malformed(self):
+        assert len(url2hash("http://[broken")) == 12
+        assert len(url2hash("http://example.com:bad/x")) == 12

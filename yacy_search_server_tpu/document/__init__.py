@@ -1,0 +1,1 @@
+"""Document understanding: parsers -> Document -> Condenser -> postings."""

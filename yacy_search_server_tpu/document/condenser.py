@@ -1,0 +1,180 @@
+"""Tokenizer + Condenser — document -> per-word posting attributes.
+
+Capability equivalent of the reference's NLP condensing stage (reference:
+source/net/yacy/document/Condenser.java:60-183 and Tokenizer.java:43):
+tokenize into phrases (sentences) and words, record per-word statistics
+(hitcount, first position in text / in phrase / phrase number), set
+appearance flags for words occurring in title / author / description /
+headlines / url (Tokenizer.java flag semantics, WordReferenceRow.java:104-110),
+and doc-level content-category flags (Tokenizer.java:51-56).
+
+Output is designed for the dense write path: `postings_rows()` emits the
+int32 feature vector of index/postings.py per word in one shot, so
+Segment.store_document turns one document into a [n_words, NF] block append.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..utils.bitfield import (
+    Bitfield,
+    FLAG_APP_DC_CREATOR, FLAG_APP_DC_DESCRIPTION, FLAG_APP_DC_IDENTIFIER,
+    FLAG_APP_DC_SUBJECT, FLAG_APP_DC_TITLE, FLAG_APP_EMPHASIZED,
+    FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO, FLAG_CAT_HASIMAGE, FLAG_CAT_HASLOCATION,
+    FLAG_CAT_HASVIDEO, FLAG_CAT_INDEXOF,
+)
+from ..utils.hashes import word2hash
+from .document import Document
+from ..index import postings as P
+
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+_PHRASE_SPLIT_RE = re.compile(r"[.!?:;\n\r]+")
+
+MAX_WORD_LENGTH = 128
+
+
+def words_of(text: str) -> list[str]:
+    return [w.lower() for w in _WORD_RE.findall(text)
+            if 0 < len(w) <= MAX_WORD_LENGTH and not w.isdigit()]
+
+
+def phrases_of(text: str) -> list[str]:
+    return [p for p in (s.strip() for s in _PHRASE_SPLIT_RE.split(text)) if p]
+
+
+@dataclass
+class WordStat:
+    count: int = 0
+    posintext: int = 0      # first appearance, 1-based word position
+    posinphrase: int = 0    # position inside its first phrase
+    posofphrase: int = 0    # index of the first phrase containing the word
+    flags: Bitfield = field(default_factory=Bitfield)
+
+
+class Condenser:
+    """Single-pass condensation of one Document."""
+
+    def __init__(self, doc: Document, index_text: bool = True,
+                 index_media: bool = True):
+        self.doc = doc
+        self.words: dict[str, WordStat] = {}
+        self.content_flags = Bitfield()
+        self.word_count = 0
+        self.phrase_count = 0
+        self._condense(index_text, index_media)
+
+    # -- core pass -----------------------------------------------------------
+
+    def _condense(self, index_text: bool, index_media: bool) -> None:
+        doc = self.doc
+
+        if index_text:
+            phrases = phrases_of(doc.text)
+            self.phrase_count = len(phrases)
+            pos = 0
+            for pnum, phrase in enumerate(phrases):
+                for pip, w in enumerate(words_of(phrase)):
+                    pos += 1
+                    st = self.words.get(w)
+                    if st is None:
+                        self.words[w] = WordStat(
+                            count=1, posintext=pos, posinphrase=pip + 1,
+                            posofphrase=pnum)
+                    else:
+                        st.count += 1
+            self.word_count = pos
+
+        # appearance-flagged zones (each word occurrence OR-merges its flag)
+        self._flag_zone(doc.title, FLAG_APP_DC_TITLE)
+        self._flag_zone(doc.author, FLAG_APP_DC_CREATOR)
+        self._flag_zone(doc.description, FLAG_APP_DC_DESCRIPTION)
+        for section in doc.sections:
+            self._flag_zone(section, FLAG_APP_DC_SUBJECT)
+        for kw in doc.keywords:
+            self._flag_zone(kw, FLAG_APP_DC_SUBJECT)
+        self._flag_zone(re.sub(r"[/._\-?=&]", " ", doc.url), FLAG_APP_DC_IDENTIFIER)
+        if index_media:
+            for img in doc.images:
+                self._flag_zone(img.alt, FLAG_APP_DC_DESCRIPTION)
+            for a in doc.anchors:
+                self._flag_zone(a.text, FLAG_APP_DC_DESCRIPTION)
+
+        # doc-level category flags, propagated onto every word like the
+        # reference's RESULT_FLAGS OR-merge
+        cf = self.content_flags
+        if "index of" in doc.title.lower() or "index of" in doc.text[:512].lower():
+            cf.set(FLAG_CAT_INDEXOF)
+        if doc.images:
+            cf.set(FLAG_CAT_HASIMAGE)
+        if doc.audio_links:
+            cf.set(FLAG_CAT_HASAUDIO)
+        if doc.video_links:
+            cf.set(FLAG_CAT_HASVIDEO)
+        if doc.app_links:
+            cf.set(FLAG_CAT_HASAPP)
+        if doc.lat or doc.lon:
+            cf.set(FLAG_CAT_HASLOCATION)
+        for st in self.words.values():
+            st.flags.or_(cf)
+
+    def _flag_zone(self, text: str, flag: int) -> None:
+        if not text:
+            return
+        for w in words_of(text):
+            st = self.words.get(w)
+            if st is None:
+                # zone-only word (e.g. title word not in body): still indexed
+                self.word_count += 1
+                st = WordStat(count=1, posintext=self.word_count)
+                self.words[w] = st
+            st.flags.set(flag)
+
+    # -- dense output --------------------------------------------------------
+
+    def postings_rows(self, urlhash_feats: dict | None = None
+                      ) -> tuple[list[bytes], np.ndarray]:
+        """(term hashes, int32 [n_words, NF] feature rows), write-path ready.
+
+        Doc-level columns (url length, link counts, language, ...) are
+        broadcast into every row; `urlhash_feats` overrides them.
+        """
+        doc = self.doc
+        base = np.zeros(P.NF, dtype=np.int32)
+        base[P.F_LASTMOD] = doc.publish_date_days or int(time.time() // 86400)
+        base[P.F_WORDS_IN_TITLE] = len(words_of(doc.title))
+        base[P.F_WORDS_IN_TEXT] = min(self.word_count, 2**31 - 1)
+        base[P.F_PHRASES_IN_TEXT] = self.phrase_count
+        base[P.F_DOCTYPE] = doc.doctype
+        base[P.F_LANGUAGE] = P.pack_language(doc.language)
+        llocal = lother = 0
+        from ..utils.hashes import safe_host
+        own_host = safe_host(doc.url)
+        for a in doc.anchors:
+            host = safe_host(a.url)
+            if host and host == own_host:
+                llocal += 1
+            else:
+                lother += 1
+        base[P.F_LLOCAL] = min(llocal, 255)
+        base[P.F_LOTHER] = min(lother, 255)
+        base[P.F_URL_LENGTH] = min(len(doc.url), 255)
+        base[P.F_URL_COMPS] = min(len([c for c in doc.url.split("/") if c]), 255)
+        if urlhash_feats:
+            for k, v in urlhash_feats.items():
+                base[k] = v
+
+        hashes: list[bytes] = []
+        rows = np.tile(base, (len(self.words), 1))
+        for i, (w, st) in enumerate(self.words.items()):
+            hashes.append(word2hash(w))
+            rows[i, P.F_FLAGS] = st.flags.value
+            rows[i, P.F_HITCOUNT] = min(st.count, 255)
+            rows[i, P.F_POSINTEXT] = min(st.posintext, 2**15)
+            rows[i, P.F_POSINPHRASE] = min(st.posinphrase, 255)
+            rows[i, P.F_POSOFPHRASE] = min(st.posofphrase, 255)
+        return hashes, rows

@@ -1,0 +1,64 @@
+"""Normalized parse result — what every parser emits.
+
+Capability equivalent of the reference's Document model (reference:
+source/net/yacy/document/Document.java): text, anchors, images, dc_*
+metadata, geo position — the single currency between the parser zoo, the
+condenser, and the index write path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Anchor:
+    url: str
+    text: str = ""
+    rel: str = ""
+
+
+@dataclass
+class Image:
+    url: str
+    alt: str = ""
+    width: int = 0
+    height: int = 0
+
+
+@dataclass
+class Document:
+    url: str
+    mime_type: str = "text/plain"
+    charset: str = "utf-8"
+    title: str = ""
+    author: str = ""
+    description: str = ""       # dc:description / meta description
+    keywords: list[str] = field(default_factory=list)
+    sections: list[str] = field(default_factory=list)   # headlines h1..h6
+    text: str = ""
+    anchors: list[Anchor] = field(default_factory=list)
+    images: list[Image] = field(default_factory=list)
+    audio_links: list[str] = field(default_factory=list)
+    video_links: list[str] = field(default_factory=list)
+    app_links: list[str] = field(default_factory=list)
+    language: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    publish_date_days: int = 0  # days since epoch; 0 = unknown
+    doctype: int = 0            # document/parsers/__init__.py doctype codes
+
+    def hyperlinks(self) -> list[Anchor]:
+        return self.anchors
+
+    def text_length(self) -> int:
+        return len(self.text)
+
+    def merge(self, other: "Document") -> None:
+        """Fold a sub-document (archive member, multi-doc parse) into this."""
+        self.text = (self.text + "\n" + other.text).strip()
+        self.anchors.extend(other.anchors)
+        self.images.extend(other.images)
+        self.sections.extend(other.sections)
+        if not self.title:
+            self.title = other.title

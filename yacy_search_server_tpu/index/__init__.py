@@ -1,0 +1,1 @@
+"""Index core (Segment): RWI postings store, metadata columns, citations."""

@@ -1,0 +1,67 @@
+"""Citation index — inbound-link postings per target URL.
+
+Capability equivalent of the reference's citation IndexCell (reference:
+source/net/yacy/kelondro/data/citation/CitationReference.java wired in
+search/index/Segment.java:178-214,666-704): for every target url hash, the
+set of citing documents. Feeds the `references_i` / `references_exthosts_i`
+ranking signals and the host-level web structure graph.
+
+Targets are keyed by url hash (not docid) because cited pages are usually
+not yet indexed locally; citing side is a (docid, hosthash) pair so external
+-host counting works without metadata lookups.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.hashes import hosthash
+from ..utils.scoremap import ScoreMap
+
+
+class CitationIndex:
+    def __init__(self):
+        self._lock = threading.RLock()
+        # target urlhash -> {citing docid: citing hosthash}
+        self._cites: dict[bytes, dict[int, bytes]] = {}
+
+    def add(self, target_urlhash: bytes, citing_docid: int,
+            citing_urlhash: bytes) -> None:
+        with self._lock:
+            self._cites.setdefault(target_urlhash, {})[citing_docid] = \
+                hosthash(citing_urlhash)
+
+    def references(self, target_urlhash: bytes) -> int:
+        """Total inbound citation count (ranking signal references_i)."""
+        with self._lock:
+            return len(self._cites.get(target_urlhash, ()))
+
+    def references_exthosts(self, target_urlhash: bytes) -> int:
+        """Distinct citing hosts other than the target's own host."""
+        own = hosthash(target_urlhash)
+        with self._lock:
+            hosts = set(self._cites.get(target_urlhash, {}).values())
+        hosts.discard(own)
+        return len(hosts)
+
+    def citing_docids(self, target_urlhash: bytes) -> list[int]:
+        with self._lock:
+            return sorted(self._cites.get(target_urlhash, ()))
+
+    def remove_citing_doc(self, docid: int) -> None:
+        with self._lock:
+            for cites in self._cites.values():
+                cites.pop(docid, None)
+
+    def host_authority(self) -> ScoreMap:
+        """hosthash -> citation mass; the authority() domain score input
+        (reference: search/ranking/ReferenceOrder.java:213-216)."""
+        m = ScoreMap()
+        with self._lock:
+            for target, cites in self._cites.items():
+                m.inc(hosthash(target), len(cites))
+        return m
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cites)

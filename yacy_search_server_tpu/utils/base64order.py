@@ -107,6 +107,8 @@ class Base64Order:
         elif rem == 2:
             x = self.decode_long(key[i : i + 2]) << 12
             out += bytes(((x >> 16) & 0xFF,))
+        elif rem == 1:
+            raise ValueError(f"truncated base64 input (length % 4 == 1): {key!r}")
         return bytes(out)
 
     def decode_byte(self, b: int) -> int:

@@ -70,14 +70,35 @@ def _split_host(host: str) -> tuple[str, str]:
     return dom[:p], dom[p + 1 :]
 
 
-def normalform(url: str) -> str:
-    parts = urlsplit(url)
+def _split(url: str):
+    """(scheme, host, port, path, query) with malformed urls tolerated —
+    scraped hrefs must never crash the identity layer."""
+    try:
+        parts = urlsplit(url)
+    except ValueError:
+        # e.g. unbalanced-bracket IPv6 literal; treat as opaque path
+        return "http", "", 80, "/" + url, ""
     scheme = (parts.scheme or "http").lower()
-    host = (parts.hostname or "").lower()
-    port = parts.port or default_port(scheme)
-    path = parts.path or "/"
+    try:
+        host = (parts.hostname or "").lower()
+    except ValueError:
+        host = ""
+    try:
+        port = parts.port or default_port(scheme)
+    except ValueError:
+        port = default_port(scheme)
+    return scheme, host, port, parts.path or "/", parts.query
+
+
+def safe_host(url: str) -> str:
+    """Hostname of a possibly-malformed url, lowercased; '' when absent."""
+    return _split(url)[1]
+
+
+def normalform(url: str) -> str:
+    scheme, host, port, path, query = _split(url)
     netloc = host if port == default_port(scheme) else f"{host}:{port}"
-    q = f"?{parts.query}" if parts.query else ""
+    q = f"?{query}" if query else ""
     return f"{scheme}://{netloc}{path}{q}"
 
 
@@ -87,11 +108,7 @@ def default_port(scheme: str) -> int:
 
 def url2hash(url: str) -> bytes:
     """12-char url hash with the reference's positional layout."""
-    parts = urlsplit(url)
-    scheme = (parts.scheme or "http").lower()
-    host = (parts.hostname or "").lower()
-    port = parts.port or default_port(scheme)
-    path = parts.path or "/"
+    scheme, host, port, path, _ = _split(url)
     subdom, dom = _split_host(host)
 
     rootpath_start = 1 if path.startswith("/") else 0

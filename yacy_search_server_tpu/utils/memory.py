@@ -10,10 +10,10 @@ when available).
 
 from __future__ import annotations
 
+import gc
 import os
 import resource
 import sys
-import threading
 
 
 def _read_int(path: str) -> int | None:
@@ -47,7 +47,11 @@ class _MemoryControl:
     def __init__(self):
         self.limit = _detect_limit()
         self.short_threshold = 0.9  # fraction of limit considered "short"
-        self._lock = threading.Lock()
+        # caches register shed hooks; request(force_flush=True) invokes them
+        self._shed_hooks: list = []
+
+    def register_shed_hook(self, hook) -> None:
+        self._shed_hooks.append(hook)
 
     def used(self) -> int:
         """Current process RSS in bytes (peak RSS on non-/proc platforms)."""
@@ -67,8 +71,19 @@ class _MemoryControl:
         return self.used() > self.limit * self.short_threshold
 
     def request(self, size: int, force_flush: bool = False) -> bool:
-        """True if `size` bytes can likely be allocated."""
-        return self.available() >= size
+        """True if `size` bytes can likely be allocated; with force_flush,
+        shed registered caches and gc before giving up."""
+        if self.available() >= size:
+            return True
+        if force_flush:
+            for hook in self._shed_hooks:
+                try:
+                    hook()
+                except Exception:
+                    pass
+            gc.collect()
+            return self.available() >= size
+        return False
 
 
 MemoryControl = _MemoryControl()

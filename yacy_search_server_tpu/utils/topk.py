@@ -122,8 +122,6 @@ class WeakPriorityQueue(Generic[T]):
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     return None
-                if remaining is None and timeout_s is not None:
-                    return None
                 if not self._not_empty.wait(remaining):
                     return None
             return self._drained[index]

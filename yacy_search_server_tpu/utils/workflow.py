@@ -73,7 +73,14 @@ class WorkflowProcessor(Generic[T]):
             try:
                 result = self.task(item)
                 if result is not None and self.next_stage is not None:
-                    self.next_stage.enqueue(result)
+                    # bounded retry so a shut-down downstream stage cannot
+                    # block this worker (and a later shutdown) forever
+                    while self.next_stage._running:
+                        try:
+                            self.next_stage.enqueue(result, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
                 with self._lock:
                     self.metrics.processed += 1
             except Exception:
@@ -93,9 +100,9 @@ class WorkflowProcessor(Generic[T]):
     def shutdown(self, drain: bool = True) -> None:
         if not self._running:
             return
-        self._running = False
         if drain:
             self.queue.join()
+        self._running = False
         for _ in self._threads:
             self.queue.put(_POISON)
         for t in self._threads:
