@@ -142,12 +142,17 @@ class TestJoin:
 
 class TestMetadata:
     def test_put_get_overwrite(self, tmp_path):
+        # re-put allocates a NEW docid (versioned append): the old version's
+        # identity stays dead so stale RWI postings can never answer for the
+        # re-indexed document
         m = MetadataStore(str(tmp_path / "meta"))
         uh = url2hash("http://a.com/x")
         d1 = m.put(DocumentMetadata(uh, sku="http://a.com/x", title="one"))
         d2 = m.put(DocumentMetadata(uh, sku="http://a.com/x", title="two"))
-        assert d1 == d2
-        assert m.get(d1).get("title") == "two"
+        assert d2 != d1
+        assert m.docid(uh) == d2
+        assert m.is_deleted(d1)
+        assert m.get(d2).get("title") == "two"
         assert len(m) == 1
 
     def test_journal_replay(self, tmp_path):

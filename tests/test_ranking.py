@@ -1,0 +1,209 @@
+"""Ranking kernel tests — device cardinal/BM25 vs pure-Python oracles.
+
+Mirrors the reference's ReferenceOrderTest style (monotonicity between a
+default and an all-zero ranking profile,
+test/java/net/yacy/search/ranking/ReferenceOrderTest.java:24-52) plus
+bit-exact comparison of the batched kernel against a per-row loop oracle
+implementing ReferenceOrder.cardinal semantics.
+"""
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.ops import ranking as R
+from yacy_search_server_tpu.utils.bitfield import (
+    FLAG_APP_DC_TITLE, FLAG_APP_DC_IDENTIFIER, FLAG_CAT_HASIMAGE,
+)
+
+
+def _rand_plist(n, seed=0):
+    rng = np.random.default_rng(seed)
+    docids = np.arange(n, dtype=np.int32)
+    feats = np.zeros((n, P.NF), np.int32)
+    feats[:, P.F_LASTMOD] = rng.integers(18000, 21000, n)
+    feats[:, P.F_WORDS_IN_TITLE] = rng.integers(0, 12, n)
+    feats[:, P.F_WORDS_IN_TEXT] = rng.integers(10, 5000, n)
+    feats[:, P.F_PHRASES_IN_TEXT] = rng.integers(1, 300, n)
+    feats[:, P.F_LANGUAGE] = np.where(rng.random(n) < 0.5,
+                                      P.pack_language("en"),
+                                      P.pack_language("de"))
+    feats[:, P.F_LLOCAL] = rng.integers(0, 50, n)
+    feats[:, P.F_LOTHER] = rng.integers(0, 50, n)
+    feats[:, P.F_URL_LENGTH] = rng.integers(10, 255, n)
+    feats[:, P.F_URL_COMPS] = rng.integers(1, 12, n)
+    feats[:, P.F_FLAGS] = (
+        (rng.random(n) < 0.3) * (1 << FLAG_APP_DC_TITLE)
+        | (rng.random(n) < 0.2) * (1 << FLAG_APP_DC_IDENTIFIER)
+        | (rng.random(n) < 0.4) * (1 << FLAG_CAT_HASIMAGE)).astype(np.int32)
+    feats[:, P.F_HITCOUNT] = rng.integers(1, 100, n)
+    feats[:, P.F_POSINTEXT] = rng.integers(1, 4000, n)
+    feats[:, P.F_POSINPHRASE] = rng.integers(1, 40, n)
+    feats[:, P.F_POSOFPHRASE] = rng.integers(0, 200, n)
+    feats[:, P.F_WORDDISTANCE] = rng.integers(0, 500, n)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    return PostingsList(docids, feats)
+
+
+def oracle_cardinal(feats, profile: R.RankingProfile, lang="en",
+                    hostids=None):
+    """Per-row loop implementing the reference's cardinal formula."""
+    n = len(feats)
+    fmin = feats.min(axis=0)
+    fmax = feats.max(axis=0)
+
+    def norm(row, col):
+        lo, hi = fmin[col], fmax[col]
+        if hi == lo:
+            return 0
+        return (int(row[col]) - int(lo)) * 256 // (int(hi) - int(lo))
+
+    tfv = feats[:, P.F_HITCOUNT] / (
+        feats[:, P.F_WORDS_IN_TEXT] + feats[:, P.F_WORDS_IN_TITLE] + 1)
+    tf_lo, tf_hi = tfv.min(), tfv.max()
+
+    counts = None
+    if hostids is not None:
+        counts = np.bincount(hostids, minlength=n)
+
+    out = np.zeros(n, dtype=np.int64)
+    for i, row in enumerate(feats):
+        s = 0
+        s += (256 - int(row[P.F_DOMLENGTH])) << profile.domlength
+        for col, coeff, invert in [
+            (P.F_URL_COMPS, profile.urlcomps, True),
+            (P.F_URL_LENGTH, profile.urllength, True),
+            (P.F_POSINTEXT, profile.posintext, True),
+            (P.F_POSOFPHRASE, profile.posofphrase, True),
+            (P.F_POSINPHRASE, profile.posinphrase, True),
+            (P.F_WORDDISTANCE, profile.worddistance, True),
+            (P.F_LASTMOD, profile.date, False),
+            (P.F_WORDS_IN_TITLE, profile.wordsintitle, False),
+            (P.F_WORDS_IN_TEXT, profile.wordsintext, False),
+            (P.F_PHRASES_IN_TEXT, profile.phrasesintext, False),
+            (P.F_LLOCAL, profile.llocal, False),
+            (P.F_LOTHER, profile.lother, False),
+            (P.F_HITCOUNT, profile.hitcount, False),
+        ]:
+            if fmax[col] == fmin[col]:
+                continue
+            v = norm(row, col)
+            s += ((256 - v) if invert else v) << coeff
+        if tf_hi > tf_lo:
+            s += int((tfv[i] - tf_lo) * 256.0 / (tf_hi - tf_lo)) << profile.tf
+        if row[P.F_LANGUAGE] == P.pack_language(lang):
+            s += 255 << profile.language
+        flags = int(row[P.F_FLAGS])
+        for bit, coeff in zip(*profile.flag_coeffs()):
+            if flags >> int(bit) & 1:
+                s += 255 << int(coeff)
+        if profile.authority > 12 and counts is not None:
+            s += ((int(counts[hostids[i]]) << 8) // (1 + int(counts.max()))) \
+                << profile.authority
+        out[i] = s
+    return out
+
+
+def _kernel_scores(plist, profile, lang="en", hostids=None):
+    import jax.numpy as jnp
+    n = len(plist)
+    r = R.CardinalRanker(profile, lang)
+    feats = jnp.asarray(plist.feats)
+    valid = jnp.ones(n, bool)
+    hi = jnp.asarray(hostids if hostids is not None else np.zeros(n, np.int32))
+    s = R.cardinal_scores(feats, valid, hi, r._norm, r._bits, r._shifts,
+                          r._dl, r._tf, r._lang_c, r._auth, r._lang)
+    return np.asarray(s)
+
+
+def test_cardinal_matches_oracle():
+    plist = _rand_plist(500, seed=1)
+    prof = R.RankingProfile()
+    got = _kernel_scores(plist, prof)
+    want = oracle_cardinal(plist.feats, prof)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_cardinal_authority_matches_oracle():
+    plist = _rand_plist(300, seed=2)
+    rng = np.random.default_rng(3)
+    hostids = rng.integers(0, 12, len(plist)).astype(np.int32)
+    prof = R.RankingProfile()
+    prof.authority = 13  # above the >12 activation guard
+    got = _kernel_scores(plist, prof, hostids=hostids)
+    want = oracle_cardinal(plist.feats, prof, hostids=hostids)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_default_profile_dominates_zero_profile():
+    # ReferenceOrderTest monotonicity: all-zero coefficients rank lower
+    plist = _rand_plist(100, seed=4)
+    default = _kernel_scores(plist, R.RankingProfile())
+    zero_prof = R.RankingProfile(**{f.name: 0 for f in
+                                    __import__("dataclasses").fields(R.RankingProfile)})
+    zero = _kernel_scores(plist, zero_prof)
+    assert (default >= zero).all()
+    assert default.sum() > zero.sum()
+
+
+def test_topk_returns_best_first():
+    plist = _rand_plist(1000, seed=5)
+    ranker = R.CardinalRanker()
+    scores, docids = ranker.rank(plist, k=10)
+    assert len(scores) == 10
+    assert (np.diff(scores) <= 0).all()
+    all_scores = _kernel_scores(plist, R.RankingProfile())
+    np.testing.assert_array_equal(np.sort(all_scores)[-10:][::-1], scores)
+
+
+def test_topk_k_larger_than_n():
+    plist = _rand_plist(5, seed=6)
+    scores, docids = R.CardinalRanker().rank(plist, k=50)
+    assert len(scores) == 5
+    assert set(docids) == set(plist.docids)
+
+
+def test_profile_roundtrip():
+    p = R.RankingProfile()
+    p.worddistance = 3
+    p.cathasimage = 15
+    q = R.RankingProfile.from_external_string(p.to_external_string())
+    assert q == p
+
+
+def test_profile_contentdom_presets():
+    img = R.RankingProfile.for_contentdom(R.CD_IMAGE)
+    assert img.cathasimage == 15 and img.catindexof == 15
+    txt = R.RankingProfile.for_contentdom(R.CD_TEXT)
+    assert txt.cathasimage == 0 and txt.catindexof == 0
+
+
+def test_bm25_matches_numpy_oracle():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    n, t = 400, 3
+    tf = rng.integers(0, 20, (n, t)).astype(np.int32)
+    doclen = rng.integers(20, 3000, n).astype(np.int32)
+    df = rng.integers(1, n, t).astype(np.int32)
+    docids = np.arange(n, dtype=np.int32)
+    want = R.bm25_scores_np(tf, doclen, df, n)
+    s, d = R.bm25_topk(jnp.asarray(tf), jnp.asarray(doclen), jnp.asarray(df),
+                       jnp.int32(n), jnp.ones(n, bool), jnp.asarray(docids),
+                       10)
+    order = np.argsort(-want)[:10]
+    np.testing.assert_array_equal(np.asarray(d), docids[order])
+    np.testing.assert_allclose(np.asarray(s), want[order], rtol=1e-4)
+
+
+def test_bm25_invalid_rows_never_win():
+    import jax.numpy as jnp
+    n, t = 64, 2
+    tf = np.full((n, t), 5, np.int32)
+    valid = np.zeros(n, bool)
+    valid[:3] = True
+    s, d = R.bm25_topk(jnp.asarray(tf), jnp.full(n, 100, np.int32),
+                       jnp.asarray(np.array([2, 2], np.int32)), jnp.int32(n),
+                       jnp.asarray(valid), jnp.arange(n, dtype=jnp.int32), 5)
+    assert set(np.asarray(d)[:3]) == {0, 1, 2}
+    assert np.isinf(np.asarray(s)[3:]).all()

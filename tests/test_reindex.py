@@ -1,0 +1,88 @@
+"""Delete/re-index lifecycle tests — tombstone correctness.
+
+Covers the subtle mutable-LSM-vs-immutable-runs surface (SURVEY.md §7 hard
+part #2): deletes are docid tombstones; re-indexing a URL must produce a
+fresh searchable identity, and the old version's postings must never
+answer for the new version.
+"""
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.search.query import QueryParams
+from yacy_search_server_tpu.search.searchevent import SearchEvent
+from yacy_search_server_tpu.utils.hashes import url2hash
+
+
+def _search_urls(seg, q):
+    return [r.url for r in SearchEvent(QueryParams.parse(q), seg).results()]
+
+
+def test_reindex_after_delete_is_searchable():
+    seg = Segment(max_ram_postings=1_000_000)
+    url = "http://site.example.org/page"
+    seg.store_document(Document(url=url, title="Cats", text="all about cats"))
+    assert _search_urls(seg, "cats") == [url]
+    assert seg.remove_document(url2hash(url))
+    assert _search_urls(seg, "cats") == []
+    seg.store_document(Document(url=url, title="Cats again",
+                                text="all about cats, again"))
+    assert _search_urls(seg, "cats") == [url]
+    seg.close()
+
+
+def test_reindex_drops_stale_words():
+    seg = Segment(max_ram_postings=1_000_000)
+    url = "http://site.example.org/page"
+    seg.store_document(Document(url=url, title="Old", text="ancient walrus"))
+    assert _search_urls(seg, "walrus") == [url]
+    seg.store_document(Document(url=url, title="New", text="modern penguin"))
+    # the old version's words no longer match this URL
+    assert _search_urls(seg, "walrus") == []
+    assert _search_urls(seg, "penguin") == [url]
+    assert seg.doc_count() == 1
+    seg.close()
+
+
+def test_reindex_survives_flush_and_restart(tmp_path):
+    d = str(tmp_path / "seg")
+    seg = Segment(d, max_ram_postings=1_000_000)
+    url = "http://site.example.org/page"
+    seg.store_document(Document(url=url, title="Old", text="ancient walrus"))
+    seg.rwi.flush()
+    seg.store_document(Document(url=url, title="New", text="modern penguin"))
+    seg.rwi.flush()
+    seg.close()
+
+    seg2 = Segment(d, max_ram_postings=1_000_000)
+    assert _search_urls(seg2, "walrus") == []
+    assert _search_urls(seg2, "penguin") == [url]
+    seg2.close()
+
+
+def test_delete_only_buffer_flush_writes_no_run(tmp_path):
+    seg = Segment(str(tmp_path / "seg"), max_ram_postings=1_000_000)
+    url = "http://site.example.org/only"
+    seg.store_document(Document(url=url, title="T", text="ephemeral words"))
+    seg.rwi.flush()
+    runs_before = seg.rwi.run_count()
+    seg.remove_document(url2hash(url))
+    assert seg.rwi.flush() is None  # buffer holds only emptied buckets
+    assert seg.rwi.run_count() == runs_before
+    seg.close()
+
+
+def test_reindex_refreshes_dropped_citation_counts():
+    from yacy_search_server_tpu.document.document import Anchor
+    seg = Segment(max_ram_postings=1_000_000)
+    target = "http://b.example.org/page"
+    seg.store_document(Document(url=target, title="B", text="target banana"))
+    citer = "http://a.example.org/page"
+    seg.store_document(Document(url=citer, title="A", text="citing apple",
+                                anchors=[Anchor(target, "b link")]))
+    tid = seg.metadata.docid(url2hash(target))
+    assert seg.metadata.get(tid).get("references_i") == 1
+    # re-crawl of A without the link: B's count must drop back to 0
+    seg.store_document(Document(url=citer, title="A2", text="citing apple"))
+    tid = seg.metadata.docid(url2hash(target))
+    assert seg.metadata.get(tid).get("references_i") == 0
+    seg.close()

@@ -1,0 +1,228 @@
+"""Query model + SearchEvent tests — end-to-end local search semantics.
+
+Style follows the reference's embedded-integration tests (SURVEY.md §4:
+real subsystems on temp dirs, e.g. SegmentTest boots a real Segment and
+queries it); here a real Segment is filled with synthetic docs and queried
+through the full SearchEvent path including the device ranking kernel.
+"""
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.document.document import Anchor, Document
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.search.query import (QueryGoal, QueryParams,
+                                                 parse_modifiers)
+from yacy_search_server_tpu.search.searchevent import (ResultEntry,
+                                                       SearchEvent,
+                                                       SearchEventCache)
+
+
+# -- query model -------------------------------------------------------------
+
+def test_parse_modifiers_site_filetype_language():
+    bare, m = parse_modifiers("banana site:www.example.org filetype:.pdf /language/de")
+    assert bare == "banana"
+    assert m.sitehost == "example.org"
+    assert m.filetype == "pdf"
+    assert m.language == "de"
+
+
+def test_parse_modifiers_author_parenthesized():
+    bare, m = parse_modifiers("cake author:(Jane Doe) tld:de")
+    assert bare == "cake"
+    assert m.author == "Jane Doe"
+    assert m.tld == "de"
+
+
+def test_parse_modifiers_roundtrip_string():
+    _, m = parse_modifiers("x site:a.org filetype:pdf /date")
+    assert "site:a.org" in m.to_string()
+    assert m.date_sort
+
+
+def test_querygoal_include_exclude_phrase():
+    g = QueryGoal.parse('apple -banana "juicy fruit" cherry')
+    assert "apple" in g.include_words and "cherry" in g.include_words
+    assert "juicy" in g.include_words and "fruit" in g.include_words
+    assert g.exclude_words == ["banana"]
+    assert g.phrases == ["juicy fruit"]
+    assert len(g.include_hashes) == len(g.include_words)
+
+
+def test_querygoal_matches():
+    g = QueryGoal.parse('apple -banana')
+    assert g.matches("An apple a day")
+    assert not g.matches("apple and banana")
+    assert not g.matches("just cherries")
+
+
+def test_queryparams_id_stable_and_page_independent():
+    a = QueryParams.parse("apple site:x.org", offset=0)
+    b = QueryParams.parse("apple site:x.org", offset=10)
+    c = QueryParams.parse("apple site:y.org")
+    assert a.query_id() == b.query_id()
+    assert a.query_id() != c.query_id()
+
+
+# -- search event ------------------------------------------------------------
+
+def _doc(url, title, text, **kw):
+    return Document(url=url, title=title, text=text, mime_type="text/html",
+                    language=kw.pop("language", "en"), **kw)
+
+
+@pytest.fixture
+def corpus_segment():
+    seg = Segment(max_ram_postings=1_000_000)
+    docs = [
+        _doc("http://fruit.example.org/apple", "Apple Pie Recipes",
+             "The apple is a sweet fruit. Apple pie needs apples and sugar. "
+             "Bake the apple pie for one hour."),
+        _doc("http://fruit.example.org/banana", "Banana Bread",
+             "The banana is a yellow fruit. Banana bread is easy to bake."),
+        _doc("http://veg.example.com/carrot", "Carrot Cake",
+             "The carrot is a root vegetable. Carrot cake with apple sauce "
+             "is delicious.", anchors=[Anchor("http://fruit.example.org/apple",
+                                              "great apple recipes")]),
+        _doc("http://de.example.de/apfel", "Apfelkuchen",
+             "Der Apfel ist eine Frucht. Apple strudel recipe in german.",
+             language="de"),
+        _doc("http://files.example.net/apple.pdf", "Apple Datasheet",
+             "Technical apple document with specifications."),
+    ]
+    for d in docs:
+        seg.store_document(d)
+    yield seg
+    seg.close()
+
+
+def test_search_basic_ranking(corpus_segment):
+    q = QueryParams.parse("apple")
+    ev = SearchEvent(q, corpus_segment)
+    res = ev.results()
+    assert len(res) == 4  # all docs containing "apple" except banana-only
+    urls = [r.url for r in res]
+    assert "http://fruit.example.org/apple" in urls
+    # scores strictly ordered best-first
+    scores = [r.score for r in res]
+    assert scores == sorted(scores, reverse=True)
+    # snippet contains the query word
+    assert any("apple" in r.snippet.lower() for r in res)
+
+
+def test_search_conjunction_and_exclusion(corpus_segment):
+    ev = SearchEvent(QueryParams.parse("apple pie"), corpus_segment)
+    assert [r.url for r in ev.results()] == ["http://fruit.example.org/apple"]
+    ev2 = SearchEvent(QueryParams.parse("fruit -banana"), corpus_segment)
+    urls = [r.url for r in ev2.results()]
+    assert "http://fruit.example.org/banana" not in urls
+    assert len(urls) >= 1
+
+
+def test_search_all_or_nothing_rule(corpus_segment):
+    # any unknown conjunct empties the result (TermSearch.java:56-58)
+    ev = SearchEvent(QueryParams.parse("apple zzzunknownzzz"), corpus_segment)
+    assert ev.results() == []
+
+
+def test_search_site_modifier(corpus_segment):
+    ev = SearchEvent(QueryParams.parse("apple site:fruit.example.org"),
+                     corpus_segment)
+    urls = [r.url for r in ev.results()]
+    assert urls and all("fruit.example.org" in u for u in urls)
+
+
+def test_search_filetype_and_tld_modifier(corpus_segment):
+    ev = SearchEvent(QueryParams.parse("apple filetype:pdf"), corpus_segment)
+    assert [r.url for r in ev.results()] == ["http://files.example.net/apple.pdf"]
+    ev2 = SearchEvent(QueryParams.parse("apple tld:de"), corpus_segment)
+    assert [r.url for r in ev2.results()] == ["http://de.example.de/apfel"]
+
+
+def test_search_language_modifier(corpus_segment):
+    ev = SearchEvent(QueryParams.parse("apple /language/de"), corpus_segment)
+    assert [r.url for r in ev.results()] == ["http://de.example.de/apfel"]
+
+
+def test_search_phrase_recheck(corpus_segment):
+    ev = SearchEvent(QueryParams.parse('"apple pie"'), corpus_segment)
+    assert [r.url for r in ev.results()] == ["http://fruit.example.org/apple"]
+
+
+def test_search_facets(corpus_segment):
+    ev = SearchEvent(QueryParams.parse("apple"), corpus_segment)
+    ev.results()
+    hosts = dict(ev.facet("hosts"))
+    assert hosts.get("fruit.example.org", 0) >= 1
+    langs = dict(ev.facet("language"))
+    assert "en" in langs and "de" in langs
+
+
+def test_search_citation_postranking(corpus_segment):
+    # the apple page is cited by carrot page -> references_i boost exists
+    ev = SearchEvent(QueryParams.parse("apple"), corpus_segment)
+    top = ev.results()[0]
+    assert top.url == "http://fruit.example.org/apple"
+    assert top.references >= 1
+
+
+def test_remote_results_merge(corpus_segment):
+    ev = SearchEvent(QueryParams.parse("apple"), corpus_segment)
+    before = len(ev.results(offset=0, count=20))
+    remote = ResultEntry(docid=-1, urlhash=b"remotehash01", score=2**30,
+                         url="http://peer.example/apple", title="Remote Apple",
+                         snippet="apple from a peer", source="peerX")
+    added = ev.add_remote_results([remote])
+    assert added == 1
+    res = ev.results(offset=0, count=20)
+    assert len(res) == before + 1
+    assert any(r.source == "peerX" for r in res)
+    # dedup on second insert
+    assert ev.add_remote_results([remote]) == 0
+
+
+def test_host_diversity_diversion():
+    seg = Segment(max_ram_postings=1_000_000)
+    for i in range(20):
+        seg.store_document(_doc(f"http://one.example.org/p{i}",
+                                f"Apple page {i}",
+                                f"apple content number {i} about apples."))
+    seg.store_document(_doc("http://two.example.org/x", "Apple elsewhere",
+                            "apple on another host."))
+    q = QueryParams.parse("apple")
+    q.max_per_host = 3
+    ev = SearchEvent(q, seg)
+    res = ev.results(offset=0, count=4)
+    hosts = [r.host for r in res]
+    assert hosts.count("one.example.org") == 3
+    assert "two.example.org" in hosts
+    # asking deeper refills from the diverted pool
+    deep = ev.results(offset=0, count=10)
+    assert len(deep) == 10
+    seg.close()
+
+
+def test_event_cache_reuse(corpus_segment):
+    cache = SearchEventCache()
+    a = cache.get_event(QueryParams.parse("apple"), corpus_segment)
+    b = cache.get_event(QueryParams.parse("apple", offset=10), corpus_segment)
+    assert a is b
+    c = cache.get_event(QueryParams.parse("banana"), corpus_segment)
+    assert c is not a
+    assert len(cache) == 2
+
+
+def test_operator_inside_word_not_parsed():
+    # `parasite:` must not be read as a site: operator mid-token
+    bare, m = parse_modifiers("parasite:treatment")
+    assert bare == "parasite:treatment" and m.sitehost == ""
+    bare2, m2 = parse_modifiers("website:down site:real.org")
+    assert m2.sitehost == "real.org"
+    assert "website:down" in bare2
+
+
+def test_phrase_and_unquoted_get_distinct_cache_ids():
+    a = QueryParams.parse('"apple pie"')
+    b = QueryParams.parse("apple pie")
+    assert a.query_id() != b.query_id()

@@ -28,7 +28,7 @@ from ..utils.bitfield import (
     FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO, FLAG_CAT_HASIMAGE, FLAG_CAT_HASLOCATION,
     FLAG_CAT_HASVIDEO, FLAG_CAT_INDEXOF,
 )
-from ..utils.hashes import word2hash
+from ..utils.hashes import url_comps, word2hash
 from .document import Document
 from ..index import postings as P
 
@@ -66,6 +66,7 @@ class Condenser:
         self.content_flags = Bitfield()
         self.word_count = 0
         self.phrase_count = 0
+        self._zone_extra = 0  # zone-only words, counted apart from the body
         self._condense(index_text, index_media)
 
     # -- core pass -----------------------------------------------------------
@@ -128,21 +129,22 @@ class Condenser:
         for w in words_of(text):
             st = self.words.get(w)
             if st is None:
-                # zone-only word (e.g. title word not in body): still indexed
-                self.word_count += 1
-                st = WordStat(count=1, posintext=self.word_count)
+                # zone-only word (e.g. title word not in body): still indexed,
+                # positioned past the body — but it must not inflate
+                # word_count, which feeds the wordcount_i / F_WORDS_IN_TEXT
+                # body-size signal
+                self._zone_extra += 1
+                st = WordStat(count=1,
+                              posintext=self.word_count + self._zone_extra)
                 self.words[w] = st
             st.flags.set(flag)
 
     # -- dense output --------------------------------------------------------
 
-    def postings_rows(self, urlhash_feats: dict | None = None
-                      ) -> tuple[list[bytes], np.ndarray]:
-        """(term hashes, int32 [n_words, NF] feature rows), write-path ready.
-
-        Doc-level columns (url length, link counts, language, ...) are
-        broadcast into every row; `urlhash_feats` overrides them.
-        """
+    def doc_row(self, urlhash_feats: dict | None = None) -> np.ndarray:
+        """Neutral doc-level feature row: the catchall-term posting and the
+        base every per-word row derives from. Word-specific columns (flags,
+        hitcount, positions) stay zero."""
         doc = self.doc
         base = np.zeros(P.NF, dtype=np.int32)
         base[P.F_LASTMOD] = doc.publish_date_days or int(time.time() // 86400)
@@ -163,11 +165,23 @@ class Condenser:
         base[P.F_LLOCAL] = min(llocal, 255)
         base[P.F_LOTHER] = min(lother, 255)
         base[P.F_URL_LENGTH] = min(len(doc.url), 255)
-        base[P.F_URL_COMPS] = min(len([c for c in doc.url.split("/") if c]), 255)
+        base[P.F_URL_COMPS] = url_comps(doc.url)
         if urlhash_feats:
             for k, v in urlhash_feats.items():
                 base[k] = v
+        return base
 
+    def postings_rows(self, urlhash_feats: dict | None = None,
+                      base_row: np.ndarray | None = None
+                      ) -> tuple[list[bytes], np.ndarray]:
+        """(term hashes, int32 [n_words, NF] feature rows), write-path ready.
+
+        Doc-level columns (url length, link counts, language, ...) are
+        broadcast into every row; `urlhash_feats` overrides them. A caller
+        that already computed `doc_row()` passes it as `base_row` to skip
+        recomputing the per-anchor/url derivations.
+        """
+        base = self.doc_row(urlhash_feats) if base_row is None else base_row
         hashes: list[bytes] = []
         rows = np.tile(base, (len(self.words), 1))
         for i, (w, st) in enumerate(self.words.items()):

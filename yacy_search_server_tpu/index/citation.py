@@ -48,10 +48,15 @@ class CitationIndex:
         with self._lock:
             return sorted(self._cites.get(target_urlhash, ()))
 
-    def remove_citing_doc(self, docid: int) -> None:
+    def remove_citing_doc(self, docid: int) -> list[bytes]:
+        """Drop a citing document's outedges; returns the affected target
+        urlhashes so callers can refresh their reference counts."""
+        affected = []
         with self._lock:
-            for cites in self._cites.values():
-                cites.pop(docid, None)
+            for target, cites in self._cites.items():
+                if cites.pop(docid, None) is not None:
+                    affected.append(target)
+        return affected
 
     def host_authority(self) -> ScoreMap:
         """hosthash -> citation mass; the authority() domain score input

@@ -24,7 +24,7 @@ import time
 
 import numpy as np
 
-from ..utils.hashes import dom_length_normalized, hosthash
+from ..utils.hashes import dom_length_normalized, hosthash, url_comps
 
 # Load-bearing schema fields (name -> default), subset of CollectionSchema.
 # Text-like fields live in python lists; numeric ranking signals get numpy
@@ -107,44 +107,65 @@ class MetadataStore:
     # -- write ---------------------------------------------------------------
 
     def put(self, doc: DocumentMetadata) -> int:
-        """Insert or overwrite by urlhash; returns the docid."""
+        """Insert by urlhash; returns the docid.
+
+        Re-putting an existing urlhash allocates a NEW docid and marks the
+        old row deleted (versioned append). This keeps RWI tombstones for
+        the old docid valid forever: postings of the previous document
+        version can never resurface under the new version's identity, and a
+        deleted-then-reindexed URL becomes searchable again under its fresh
+        docid. The caller (Segment.store_document) tombstones the old
+        docid's postings.
+        """
         with self._lock:
-            docid = self._urlhash_to_docid.get(doc.urlhash)
-            if docid is None:
-                docid = len(self._urlhashes)
-                self._urlhash_to_docid[doc.urlhash] = docid
-                self._urlhashes.append(doc.urlhash)
+            old = self._urlhash_to_docid.get(doc.urlhash)
+            if old is not None:
+                self._deleted.add(old)
+                # blank the dead row's payload columns: no reader can see a
+                # deleted docid, and keeping N crawl-cycles of full text_t
+                # alive would grow memory without bound
                 for f in TEXT_FIELDS:
-                    self._text[f].append(doc.get(f, ""))
-                for f in INT_FIELDS:
-                    self._ints[f].append(int(doc.get(f, 0)))
-                for f in DOUBLE_FIELDS:
-                    self._doubles[f].append(float(doc.get(f, 0.0)))
-            else:
-                self._deleted.discard(docid)
-                for f in TEXT_FIELDS:
-                    self._text[f][docid] = doc.get(f, "")
-                for f in INT_FIELDS:
-                    self._ints[f][docid] = int(doc.get(f, 0))
-                for f in DOUBLE_FIELDS:
-                    self._doubles[f][docid] = float(doc.get(f, 0.0))
+                    self._text[f][old] = ""
+            docid = len(self._urlhashes)
+            self._urlhash_to_docid[doc.urlhash] = docid
+            self._urlhashes.append(doc.urlhash)
+            for f in TEXT_FIELDS:
+                self._text[f].append(doc.get(f, ""))
+            for f in INT_FIELDS:
+                self._ints[f].append(int(doc.get(f, 0)))
+            for f in DOUBLE_FIELDS:
+                self._doubles[f].append(float(doc.get(f, 0.0)))
             self._journal_write(doc)
             return docid
 
     def set_field(self, docid: int, field: str, value) -> None:
         """Postprocessing update (e.g. references_i from the citation index)."""
+        self.set_fields(docid, **{field: value})
+
+    def set_fields(self, docid: int, **fields) -> None:
+        """Batched postprocessing update: one journal record for all fields;
+        unchanged values are skipped (write-amplification guard for
+        link-heavy pages updating citation counts per anchor)."""
         with self._lock:
-            if field in INT_FIELDS:
-                self._ints[field][docid] = int(value)
-            elif field in DOUBLE_FIELDS:
-                self._doubles[field][docid] = float(value)
-            elif field in TEXT_FIELDS:
-                self._text[field][docid] = value
-            else:
-                raise KeyError(field)
-            if self._journal:
-                self._journal.write(json.dumps(
-                    {"_upd": self._urlhashes[docid].decode(), field: value}) + "\n")
+            changed = {}
+            for field, value in fields.items():
+                if field in INT_FIELDS:
+                    value = int(value)
+                    col = self._ints[field]
+                elif field in DOUBLE_FIELDS:
+                    value = float(value)
+                    col = self._doubles[field]
+                elif field in TEXT_FIELDS:
+                    col = self._text[field]
+                else:
+                    raise KeyError(field)
+                if col[docid] != value:
+                    col[docid] = value
+                    changed[field] = value
+            if changed and self._journal:
+                rec = {"_upd": self._urlhashes[docid].decode()}
+                rec.update(changed)
+                self._journal.write(json.dumps(rec) + "\n")
                 self._journal.flush()
 
     def delete(self, urlhash: bytes) -> int | None:
@@ -158,6 +179,11 @@ class MetadataStore:
             return docid
 
     # -- read ----------------------------------------------------------------
+
+    def text_value(self, docid: int, field: str) -> str:
+        """Single text column read — the query-path accessor (no full-row
+        DocumentMetadata materialization)."""
+        return self._text[field][docid]
 
     def docid(self, urlhash: bytes) -> int | None:
         with self._lock:
@@ -283,7 +309,7 @@ def metadata_from_parsed(urlhash: bytes, url: str, title: str, text: str,
         text_t=text,
         domlength_i=dom_length_normalized(urlhash),
         urllength_i=len(url),
-        urlcomps_i=max(0, len([c for c in url.split("/") if c]) - 1),
+        urlcomps_i=url_comps(url),
         load_date_days_i=int(time.time() // 86400),
     )
     fields.update(extra)

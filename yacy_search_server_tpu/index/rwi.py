@@ -85,6 +85,7 @@ class RWIIndex:
         self._ram_count = 0
         self._runs: list[FrozenRun] = []
         self._tombstones: set[int] = set()
+        self._dead_arr: np.ndarray | None = None  # cached sorted tombstones
         self._lock = threading.RLock()
         self._run_seq = 0
         self._dels = None  # deletion journal: "D <docid>" / "T <termhash> <seq>"
@@ -169,10 +170,13 @@ class RWIIndex:
         return self._ram_count >= self.max_ram_postings
 
     def flush(self) -> FrozenRun | None:
-        """Freeze the RAM buffer into an immutable run (and persist it)."""
+        """Freeze the RAM buffer into an immutable run (and persist it).
+
+        The compressed disk write happens OUTSIDE the lock: queries and
+        writers proceed against the already-appended in-memory run while
+        the .npz is being written (the reference's FlushThread dumps in the
+        background for the same reason, IndexCell.java:115-160)."""
         with self._lock:
-            if not self._ram:
-                return None
             terms: dict[bytes, PostingsList] = {}
             for th, rows in self._ram.items():
                 if not rows:  # bucket emptied by delete_doc
@@ -180,16 +184,21 @@ class RWIIndex:
                 d = np.fromiter((r[0] for r in rows), dtype=np.int32, count=len(rows))
                 f = np.stack([r[1] for r in rows]).astype(np.int32)
                 terms[th] = sort_dedupe(d, f)
-            run = FrozenRun(terms)
             n = self._ram_count
             self._ram = {}
             self._ram_count = 0
+            if not terms:  # only emptied buckets: nothing to persist
+                return None
+            run = FrozenRun(terms)
+            path = None
             if self.data_dir:
                 path = os.path.join(self.data_dir, f"run-{self._run_seq:06d}.npz")
-                run.save(path)
             self._run_seq += 1
             self._runs.append(run)
-            self._write_manifest()
+        if path:
+            run.save(path)
+            with self._lock:
+                self._write_manifest()
         track(EClass.WORDCACHE, "flush", n)
         return run
 
@@ -209,8 +218,7 @@ class RWIIndex:
             all_terms: set[bytes] = set()
             for r in victims:
                 all_terms.update(r.terms.keys())
-            dead = np.fromiter(sorted(self._tombstones), dtype=np.int32,
-                               count=len(self._tombstones))
+            dead = self._dead_sorted()
             merged: dict[bytes, PostingsList] = {}
             for th in all_terms:
                 parts = [r.terms[th] for r in victims if th in r.terms]
@@ -218,16 +226,21 @@ class RWIIndex:
                 if len(m):
                     merged[th] = m
             new_run = FrozenRun(merged)
+            save_path = None
             if self.data_dir:
                 # fresh sequence number: keeps it past every journaled T-line
                 # horizon (its term removals are physically folded in);
                 # chronological position is preserved by the manifest instead
-                new_run.save(os.path.join(self.data_dir,
-                                          f"run-{self._run_seq:06d}.npz"))
+                save_path = os.path.join(self.data_dir,
+                                         f"run-{self._run_seq:06d}.npz")
             self._run_seq += 1
             victim_paths = [r.path for r in victims if r.path]
             # merged run replaces the victims at the FRONT (oldest position)
             self._runs = [new_run] + [r for r in self._runs if r not in victims]
+        # compressed write outside the lock; manifest after the file exists
+        if save_path:
+            new_run.save(save_path)
+        with self._lock:
             self._write_manifest()
         for p in victim_paths:
             try:
@@ -241,6 +254,7 @@ class RWIIndex:
         """Tombstone a document everywhere (blacklist/url removal path)."""
         with self._lock:
             self._tombstones.add(docid)
+            self._dead_arr = None  # invalidate the sorted-array cache
             for rows in self._ram.values():
                 kept = [r for r in rows if r[0] != docid]
                 self._ram_count -= len(rows) - len(kept)
@@ -276,12 +290,18 @@ class RWIIndex:
         f = np.stack([r[1] for r in rows]).astype(np.int32)
         return sort_dedupe(d, f)
 
+    def _dead_sorted(self) -> np.ndarray:
+        """Sorted tombstone array, cached (rebuilt only after delete_doc)."""
+        if self._dead_arr is None:
+            self._dead_arr = np.fromiter(sorted(self._tombstones),
+                                         dtype=np.int32,
+                                         count=len(self._tombstones))
+        return self._dead_arr
+
     def _apply_tombstones(self, p: PostingsList) -> PostingsList:
         if not self._tombstones or len(p) == 0:
             return p
-        dead = np.fromiter(sorted(self._tombstones), dtype=np.int32,
-                           count=len(self._tombstones))
-        return remove_docids(p, dead)
+        return remove_docids(p, self._dead_sorted())
 
     def get(self, termhash: bytes) -> PostingsList:
         """A term's full postings: RAM + all runs merged, tombstones applied.

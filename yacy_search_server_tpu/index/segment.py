@@ -89,7 +89,18 @@ class Segment:
                 lat_d=doc.lat, lon_d=doc.lon,
             )
             with self._lock:
+                # re-index: retire the previous version's identity so its
+                # postings can never answer for the new version (put()
+                # allocates a fresh docid and dead-marks the old row)
+                old_docid = self.metadata.docid(urlhash)
                 docid = self.metadata.put(meta)
+                if old_docid is not None:
+                    self.rwi.delete_doc(old_docid)
+                    # targets the old version cited lose one reference;
+                    # refresh their counts (the new version's own anchors are
+                    # refreshed below)
+                    for target in self.citations.remove_citing_doc(old_docid):
+                        self._refresh_references(target)
 
                 # citations: this doc cites its anchors
                 for a in doc.anchors:
@@ -98,29 +109,33 @@ class Segment:
                     except Exception:
                         continue
                     self.citations.add(target, docid, urlhash)
-                    # keep cited-and-indexed docs' reference counts fresh
-                    cited_docid = self.metadata.docid(target)
-                    if cited_docid is not None:
-                        self.metadata.set_field(
-                            cited_docid, "references_i",
-                            self.citations.references(target))
-                        self.metadata.set_field(
-                            cited_docid, "references_exthosts_i",
-                            self.citations.references_exthosts(target))
+                    self._refresh_references(target)
 
-                # RWI block append
-                term_hashes, rows = condenser.postings_rows(
+                # RWI block append; the catchall term gets the neutral
+                # doc-level row (not any word's flags/positions)
+                doc_row = condenser.doc_row(
                     {P.F_DOMLENGTH: meta.get("domlength_i")})
+                term_hashes, rows = condenser.postings_rows(base_row=doc_row)
                 for th, row in zip(term_hashes, rows):
                     self.rwi.add(th, docid, row)
-                self.rwi.add(word2hash(CATCHALL_WORD), docid,
-                             rows[0] if len(rows) else np.zeros(P.NF, np.int32))
+                self.rwi.add(word2hash(CATCHALL_WORD), docid, doc_row)
 
             # flush outside the segment lock: the compressed run write must
             # not stall concurrent readers/other writers on this facade
             if self.rwi.needs_flush():
                 self.rwi.flush()
             return docid
+
+    def _refresh_references(self, target_urlhash: bytes) -> None:
+        """Sync a target's references_i/_exthosts_i metadata columns with
+        the citation index (no-op when the target is not indexed here)."""
+        cited_docid = self.metadata.docid(target_urlhash)
+        if cited_docid is not None:
+            self.metadata.set_fields(
+                cited_docid,
+                references_i=self.citations.references(target_urlhash),
+                references_exthosts_i=(
+                    self.citations.references_exthosts(target_urlhash)))
 
     def remove_document(self, urlhash: bytes) -> bool:
         """Blacklist/url-delete path: tombstone everywhere."""
@@ -129,7 +144,8 @@ class Segment:
             if docid is None:
                 return False
             self.rwi.delete_doc(docid)
-            self.citations.remove_citing_doc(docid)
+            for target in self.citations.remove_citing_doc(docid):
+                self._refresh_references(target)
             return True
 
     # -- read path -----------------------------------------------------------

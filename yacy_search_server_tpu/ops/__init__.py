@@ -1,0 +1,7 @@
+"""Device compute kernels: ranking, top-k, joins.
+
+This package is the TPU compute path of the framework — batched JAX/XLA
+kernels replacing the reference's concurrent Java scoring code
+(reference: source/net/yacy/search/ranking/ReferenceOrder.java,
+source/net/yacy/cora/sorting/WeakPriorityBlockingQueue.java).
+"""

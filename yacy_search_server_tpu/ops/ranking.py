@@ -1,0 +1,368 @@
+"""Batched ranking kernel — ReferenceOrder as one XLA program.
+
+Capability equivalent of the reference's query-time scorer (reference:
+source/net/yacy/search/ranking/ReferenceOrder.java:51-265 and
+RankingProfile.java:82-341). The reference normalizes posting attributes
+with a distributor thread + N NormalizeWorker threads that stream-decode
+rows and accumulate global min/max under benign races, then scores each
+posting with `cardinal` = sum over ~25 signals of
+(normalized-to-0..255 value << coefficient). Here the entire construct is
+one batched kernel:
+
+    min/max  = masked column reduce over the postings block
+    norm     = (x - min) * 256 // (max - min)        (0 when max == min)
+    cardinal = sum_s (norm_s or 255-flag) << coeff_s
+    top-k    = jax.lax.top_k over the scores
+
+which XLA fuses into a few passes over HBM; there are no threads, no
+poison pills, and no tolerated min/max races (SURVEY.md §5: the reference
+catches ArithmeticException from concurrent min/max mutation —
+SearchEvent.java:811-815; batching removes the race by construction).
+
+Scores are int32: max single signal is 256 << 15 (~8.4e6), ~30 signals
+never exceeds 2^31. Integer division matches Java semantics for the
+non-negative attribute values involved (both truncate toward zero).
+
+A BM25 kernel (ops/bm25.py semantics inline here) complements cardinal for
+the BASELINE.json configs: the reference has no BM25 of its own (scoring
+is cardinal + Solr-side relevance); BM25 over the same dense blocks is the
+TPU build's first-stage text relevance.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index import postings as P
+from ..utils.bitfield import (
+    FLAG_APP_DC_CREATOR, FLAG_APP_DC_DESCRIPTION, FLAG_APP_DC_IDENTIFIER,
+    FLAG_APP_DC_SUBJECT, FLAG_APP_DC_TITLE, FLAG_APP_EMPHASIZED,
+    FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO, FLAG_CAT_HASIMAGE,
+    FLAG_CAT_HASVIDEO, FLAG_CAT_INDEXOF,
+)
+
+# content domains (reference: cora/document/analysis/Classification.ContentDomain)
+CD_ALL, CD_TEXT, CD_IMAGE, CD_AUDIO, CD_VIDEO, CD_APP = -1, 0, 1, 2, 3, 4
+
+
+@dataclass
+class RankingProfile:
+    """The 32 shift coefficients, defaults per content domain.
+
+    Names and default values follow the reference
+    (RankingProfile.java:92-124); (de)serialization uses the same
+    `name=value,...` external form so profiles survive the P2P search wire
+    (reference: toExternalString, used in Protocol.java:957).
+    """
+
+    domlength: int = 10
+    date: int = 9
+    wordsintitle: int = 2
+    wordsintext: int = 3
+    phrasesintext: int = 0
+    llocal: int = 0
+    lother: int = 7
+    urllength: int = 6
+    urlcomps: int = 7
+    hitcount: int = 1
+    posintext: int = 4
+    posofphrase: int = 0
+    posinphrase: int = 0
+    authority: int = 5
+    worddistance: int = 10
+    appurl: int = 12
+    appdescr: int = 14      # app_dc_title ("description of page" legacy name)
+    appauthor: int = 1      # app_dc_creator
+    apptags: int = 2        # app_dc_subject
+    appref: int = 10        # app_dc_description (anchor text)
+    appemph: int = 5
+    catindexof: int = 0
+    cathasimage: int = 0
+    cathasaudio: int = 0
+    cathasvideo: int = 0
+    cathasapp: int = 0
+    tf: int = 8
+    language: int = 2
+    citation: int = 10
+    # post-ranking predicates (applied host-side in SearchEvent.post_ranking)
+    urlcompintoplist: int = 2
+    descrcompintoplist: int = 2
+    prefer: int = 0
+
+    @staticmethod
+    def for_contentdom(cd: int) -> "RankingProfile":
+        p = RankingProfile()
+        p.cathasapp = 15 if cd == CD_APP else 0
+        p.cathasaudio = 15 if cd == CD_AUDIO else 0
+        p.cathasimage = 15 if cd == CD_IMAGE else 0
+        p.cathasvideo = 15 if cd == CD_VIDEO else 0
+        p.catindexof = 0 if cd in (CD_TEXT, CD_ALL) else 15
+        return p
+
+    def to_external_string(self) -> str:
+        return ",".join(f"{f.name}={getattr(self, f.name)}" for f in fields(self))
+
+    @staticmethod
+    def from_external_string(s: str) -> "RankingProfile":
+        p = RankingProfile()
+        if not s:
+            return p
+        s = s.strip()
+        if s.startswith("{") and s.endswith("}"):
+            s = s[1:-1].strip()
+        parts = s.split("&") if "&" in s else s.split(",")
+        valid = {f.name for f in fields(p)}
+        for part in parts:
+            if "=" not in part:
+                continue
+            k, _, v = part.strip().partition("=")
+            if k in valid:
+                try:
+                    setattr(p, k, max(0, min(15, int(v))))
+                except ValueError:
+                    pass
+        return p
+
+    # -- kernel parameter vectors -------------------------------------------
+
+    def norm_coeffs(self) -> np.ndarray:
+        """int32 [NF]-aligned shift coefficients for normalized attributes.
+
+        Index i applies to feature column i of index/postings.py. Sign
+        convention: positive = higher-is-better (direct), negative =
+        lower-is-better (the reference's `256 - norm` inversion).
+        """
+        c = np.zeros(P.NF, dtype=np.int32)
+        c[P.F_LASTMOD] = self.date
+        c[P.F_WORDS_IN_TITLE] = self.wordsintitle
+        c[P.F_WORDS_IN_TEXT] = self.wordsintext
+        c[P.F_PHRASES_IN_TEXT] = self.phrasesintext
+        c[P.F_LLOCAL] = self.llocal
+        c[P.F_LOTHER] = self.lother
+        c[P.F_URL_LENGTH] = -self.urllength
+        c[P.F_URL_COMPS] = -self.urlcomps
+        c[P.F_HITCOUNT] = self.hitcount
+        c[P.F_POSINTEXT] = -self.posintext
+        c[P.F_POSINPHRASE] = -self.posinphrase
+        c[P.F_POSOFPHRASE] = -self.posofphrase
+        c[P.F_WORDDISTANCE] = -self.worddistance
+        return c
+
+    def flag_coeffs(self) -> tuple[np.ndarray, np.ndarray]:
+        """(flag bit positions, shift coefficients) for the 255<<coeff terms."""
+        pairs = [
+            (FLAG_APP_DC_IDENTIFIER, self.appurl),
+            (FLAG_APP_DC_TITLE, self.appdescr),
+            (FLAG_APP_DC_CREATOR, self.appauthor),
+            (FLAG_APP_DC_SUBJECT, self.apptags),
+            (FLAG_APP_DC_DESCRIPTION, self.appref),
+            (FLAG_APP_EMPHASIZED, self.appemph),
+            (FLAG_CAT_INDEXOF, self.catindexof),
+            (FLAG_CAT_HASIMAGE, self.cathasimage),
+            (FLAG_CAT_HASAUDIO, self.cathasaudio),
+            (FLAG_CAT_HASVIDEO, self.cathasvideo),
+            (FLAG_CAT_HASAPP, self.cathasapp),
+        ]
+        bits = np.array([b for b, _ in pairs], dtype=np.int32)
+        shifts = np.array([s for _, s in pairs], dtype=np.int32)
+        return bits, shifts
+
+
+# direct (higher-is-better) columns never invert; flags column is special
+_NORM_DIRECT = np.zeros(P.NF, dtype=bool)
+for _i in (P.F_LASTMOD, P.F_WORDS_IN_TITLE, P.F_WORDS_IN_TEXT,
+           P.F_PHRASES_IN_TEXT, P.F_LLOCAL, P.F_LOTHER, P.F_HITCOUNT):
+    _NORM_DIRECT[_i] = True
+
+
+def _masked_minmax(feats: jnp.ndarray, valid: jnp.ndarray):
+    """Column-wise min/max over valid rows (int32 sentinels elsewhere)."""
+    big = jnp.int32(2**31 - 1)
+    small = jnp.int32(-(2**31 - 1))
+    v = valid[:, None]
+    col_min = jnp.min(jnp.where(v, feats, big), axis=0)
+    col_max = jnp.max(jnp.where(v, feats, small), axis=0)
+    return col_min, col_max
+
+
+def cardinal_scores(feats: jnp.ndarray, valid: jnp.ndarray,
+                    hostids: jnp.ndarray, norm_coeffs: jnp.ndarray,
+                    flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
+                    domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
+                    language_coeff: jnp.ndarray, authority_coeff: jnp.ndarray,
+                    language_pref: jnp.ndarray) -> jnp.ndarray:
+    """int32 cardinal score per posting row (invalid rows score MIN).
+
+    Vectorized ReferenceOrder.cardinal (ReferenceOrder.java:223-265):
+    every `(x-min)<<8 / (max-min) << coeff` term becomes a masked column
+    op; the authority signal's ConcurrentScoreMap of host counts
+    (ReferenceOrder.java:213-216) becomes a segment-sum over hostids.
+    """
+    n = feats.shape[0]
+    col_min, col_max = _masked_minmax(feats, valid)
+    span = col_max - col_min
+    safe_span = jnp.maximum(span, 1)
+
+    norm = ((feats - col_min[None, :]) * 256) // safe_span[None, :]
+    norm = jnp.where(span[None, :] == 0, 0, norm)
+    direct = jnp.asarray(_NORM_DIRECT)
+    # inverted attributes score (256 - norm), but stay 0 when span == 0
+    inv = jnp.where(span[None, :] == 0, 0, 256 - norm)
+    contrib = jnp.where(direct[None, :], norm, inv)
+    shifts = jnp.abs(norm_coeffs)
+    per_col = contrib << shifts[None, :]
+    # columns with no coefficient at all (flags, doctype, language, domlength)
+    active = jnp.asarray(
+        np.array([True] * P.NF, dtype=bool)
+        & ~np.isin(np.arange(P.NF), [P.F_FLAGS, P.F_DOCTYPE, P.F_LANGUAGE,
+                                     P.F_DOMLENGTH]))
+    score = jnp.sum(jnp.where(active[None, :], per_col, 0), axis=1)
+
+    # domlength: stored pre-normalized 0..255; (256 - v) << coeff
+    score = score + ((256 - feats[:, P.F_DOMLENGTH]) << domlength_coeff)
+
+    # term frequency: hitcount / (wordsintext + wordsintitle + 1), min/max
+    # normalized to 0..255 (WordReferenceVars.termFrequency semantics)
+    tf = feats[:, P.F_HITCOUNT].astype(jnp.float32) / (
+        feats[:, P.F_WORDS_IN_TEXT] + feats[:, P.F_WORDS_IN_TITLE] + 1
+    ).astype(jnp.float32)
+    tf_valid = jnp.where(valid, tf, jnp.inf)
+    tf_min = jnp.min(tf_valid)
+    tf_max = jnp.max(jnp.where(valid, tf, -jnp.inf))
+    tf_span = tf_max - tf_min
+    tf_norm = jnp.where(
+        tf_span > 0, ((tf - tf_min) * 256.0 / jnp.maximum(tf_span, 1e-9)),
+        0.0).astype(jnp.int32)
+    score = score + (tf_norm << tf_coeff)
+
+    # language preference match: 255 << coeff
+    score = score + jnp.where(feats[:, P.F_LANGUAGE] == language_pref,
+                              jnp.int32(255) << language_coeff, 0)
+
+    # appearance/category flags: 255 << coeff each
+    flags = feats[:, P.F_FLAGS]
+    flag_hit = (flags[:, None] >> flag_bits[None, :]) & 1
+    score = score + jnp.sum(flag_hit * (255 << flag_shifts[None, :]), axis=1)
+
+    # authority: domain-frequency score, only when coeff > 12
+    # (ReferenceOrder.java:255 guard); counts via segment_sum over hostids
+    counts = jax.ops.segment_sum(valid.astype(jnp.int32), hostids,
+                                 num_segments=n)
+    maxdom = jnp.max(counts)
+    auth = (counts[hostids] << 8) // (1 + maxdom)
+    score = score + jnp.where(authority_coeff > 12, auth << authority_coeff, 0)
+
+    return jnp.where(valid, score, jnp.int32(-(2**31 - 1)))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def score_topk(feats: jnp.ndarray, docids: jnp.ndarray, valid: jnp.ndarray,
+               hostids: jnp.ndarray, norm_coeffs: jnp.ndarray,
+               flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
+               domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
+               language_coeff: jnp.ndarray, authority_coeff: jnp.ndarray,
+               language_pref: jnp.ndarray, k: int):
+    """Fused cardinal + top-k: the device replacement for the rwiStack heap
+    (reference: SearchEvent.java:809 bounded WeakPriorityBlockingQueue)."""
+    scores = cardinal_scores(feats, valid, hostids, norm_coeffs, flag_bits,
+                             flag_shifts, domlength_coeff, tf_coeff,
+                             language_coeff, authority_coeff, language_pref)
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    return top_scores, docids[top_idx], top_idx
+
+
+def pad_to(n: int, tile: int = 128) -> int:
+    """Round up to a tile multiple (lane dimension friendly); min one tile."""
+    return max(tile, ((n + tile - 1) // tile) * tile)
+
+
+def hostid_array(docids: np.ndarray, hosthashes: list[bytes] | np.ndarray) -> np.ndarray:
+    """Map per-row host hashes to dense int ids (for the authority kernel)."""
+    _, ids = np.unique(np.asarray(hosthashes), return_inverse=True)
+    return ids.astype(np.int32)
+
+
+class CardinalRanker:
+    """Host-side wrapper: pad → upload → score_topk, profile baked in."""
+
+    def __init__(self, profile: RankingProfile | None = None,
+                 language: str = "en"):
+        self.profile = profile or RankingProfile()
+        self._norm = jnp.asarray(self.profile.norm_coeffs())
+        bits, shifts = self.profile.flag_coeffs()
+        self._bits, self._shifts = jnp.asarray(bits), jnp.asarray(shifts)
+        self._dl = jnp.int32(self.profile.domlength)
+        self._tf = jnp.int32(self.profile.tf)
+        self._lang_c = jnp.int32(self.profile.language)
+        self._auth = jnp.int32(self.profile.authority)
+        self._lang = jnp.int32(P.pack_language(language))
+
+    def rank(self, plist, hosthashes=None, k: int = 10):
+        """(scores, docids) best-first over a PostingsList."""
+        n = len(plist)
+        if n == 0:
+            return np.empty(0, np.int32), np.empty(0, np.int32)
+        npad = pad_to(n)
+        feats = np.zeros((npad, P.NF), np.int32)
+        feats[:n] = plist.feats
+        docids = np.full(npad, -1, np.int32)
+        docids[:n] = plist.docids
+        valid = np.zeros(npad, bool)
+        valid[:n] = True
+        hostids = np.zeros(npad, np.int32)
+        if hosthashes is not None:
+            hostids[:n] = hostid_array(plist.docids, hosthashes)
+        kk = min(k, npad)
+        s, d, _ = score_topk(jnp.asarray(feats), jnp.asarray(docids),
+                             jnp.asarray(valid), jnp.asarray(hostids),
+                             self._norm, self._bits, self._shifts,
+                             self._dl, self._tf, self._lang_c, self._auth,
+                             self._lang, kk)
+        s, d = np.asarray(s), np.asarray(d)
+        keep = d >= 0
+        keep &= s > -(2**31 - 1)
+        return s[keep][:k], d[keep][:k]
+
+
+# ---------------------------------------------------------------------------
+# BM25 — dense doc×term first-stage relevance (BASELINE.json configs)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("k",))
+def bm25_topk(tf: jnp.ndarray, doclen: jnp.ndarray, df: jnp.ndarray,
+              ndocs: jnp.ndarray, valid: jnp.ndarray, docids: jnp.ndarray,
+              k: int, k1: float = 1.2, b: float = 0.75):
+    """BM25 over a dense [docs, terms] tf block + top-k.
+
+    tf:     float32/int32 [n, t] term frequencies for the query terms
+    doclen: int32 [n] document lengths (words)
+    df:     int32 [t] document frequencies of the query terms
+    ndocs:  scalar corpus size
+    """
+    tf = tf.astype(jnp.float32)
+    dl = doclen.astype(jnp.float32)
+    avgdl = jnp.sum(jnp.where(valid, dl, 0.0)) / jnp.maximum(
+        jnp.sum(valid.astype(jnp.float32)), 1.0)
+    idf = jnp.log(1.0 + (ndocs.astype(jnp.float32) - df + 0.5) / (df + 0.5))
+    denom = tf + k1 * (1.0 - b + b * (dl / jnp.maximum(avgdl, 1e-6))[:, None])
+    score = jnp.sum(idf[None, :] * tf * (k1 + 1.0) / jnp.maximum(denom, 1e-9),
+                    axis=1)
+    score = jnp.where(valid, score, -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(score, k)
+    return top_scores, docids[top_idx]
+
+
+def bm25_scores_np(tf: np.ndarray, doclen: np.ndarray, df: np.ndarray,
+                   ndocs: int, k1: float = 1.2, b: float = 0.75) -> np.ndarray:
+    """Numpy oracle for tests/benchmarks (identical math)."""
+    tf = tf.astype(np.float64)
+    dl = doclen.astype(np.float64)
+    avgdl = dl.mean() if len(dl) else 1.0
+    idf = np.log(1.0 + (ndocs - df + 0.5) / (df + 0.5))
+    denom = tf + k1 * (1.0 - b + b * (dl / max(avgdl, 1e-6))[:, None])
+    return (idf[None, :] * tf * (k1 + 1.0) / np.maximum(denom, 1e-9)).sum(axis=1)

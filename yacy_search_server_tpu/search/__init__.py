@@ -1,0 +1,5 @@
+"""Query model, search orchestration and result ranking (L5 equivalent).
+
+Reference layer: source/net/yacy/search/query/ + search/ranking/ +
+search/navigator/ + search/snippet/ (SURVEY.md §1 L5).
+"""

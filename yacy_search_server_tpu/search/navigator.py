@@ -1,0 +1,60 @@
+"""Navigators — facet counters over the result candidate set.
+
+Capability equivalent of the reference's navigator plugin registry
+(reference: source/net/yacy/search/navigator/ — RestrictedStringNavigator,
+HostNavigator, LanguageNavigator, YearNavigator, ...; assembled by
+NavigatorPlugins.java and accumulated per result in
+SearchEvent.java:1131+). Each navigator is a score map keyed by a facet
+value; the UI renders the top entries as refinement links.
+"""
+
+from __future__ import annotations
+
+from ..utils.scoremap import ScoreMap
+
+DEFAULT_NAVIGATORS = ("hosts", "language", "filetype", "authors", "year")
+
+
+class Navigator:
+    """One facet dimension: counts of facet values over seen results."""
+
+    def __init__(self, name: str, field: str):
+        self.name = name
+        self.field = field
+        self.counts = ScoreMap()
+
+    def add(self, value) -> None:
+        if value is None:
+            return
+        v = str(value).strip()
+        if v:
+            self.counts.inc(v)
+
+    def top(self, n: int = 10) -> list[tuple[str, int]]:
+        return self.counts.top(n)
+
+    def __len__(self) -> int:
+        return len(self.counts)
+
+
+def make_navigators(names=DEFAULT_NAVIGATORS) -> dict[str, Navigator]:
+    fields = {
+        "hosts": "host_s",
+        "language": "language_s",
+        "filetype": "url_file_ext_s",
+        "authors": "author",
+        "year": "last_modified_days_i",
+        "collections": "collection_sxt",
+    }
+    return {n: Navigator(n, fields[n]) for n in names if n in fields}
+
+
+def accumulate(navigators: dict[str, Navigator], meta) -> None:
+    """Count one result document into every active navigator."""
+    for nav in navigators.values():
+        v = meta.get(nav.field)
+        if nav.name == "year" and v:
+            import datetime
+            v = datetime.date.fromordinal(
+                datetime.date(1970, 1, 1).toordinal() + int(v)).year
+        nav.add(v)

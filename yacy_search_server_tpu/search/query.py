@@ -1,0 +1,251 @@
+"""Query model: goal, modifiers, parameters.
+
+Capability equivalent of the reference's query model (reference:
+source/net/yacy/search/query/QueryGoal.java — include/exclude word sets
+with +/- operators and quoted phrases; QueryModifier.java — in-string
+operators site:, filetype:, author:, keyword:, tld:, protocol:, inurl:,
+intitle:, daterange:, /language/xx, /date sorting; QueryParams.java —
+the full query state handed to the SearchEvent, including the constraint
+bitfield and the cache id used to reuse a live event for paging).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+
+from ..ops.ranking import CD_ALL, CD_AUDIO, CD_APP, CD_IMAGE, CD_TEXT, CD_VIDEO, RankingProfile
+from ..utils.hashes import word2hash
+
+CONTENTDOM_NAMES = {
+    "all": CD_ALL, "text": CD_TEXT, "image": CD_IMAGE,
+    "audio": CD_AUDIO, "video": CD_VIDEO, "app": CD_APP,
+}
+
+_LANG_MOD = re.compile(r"/language/(\w\w)\b")
+_DATE_SORT = re.compile(r"(^|\s)/date(\s|$)")
+
+
+@dataclass
+class QueryModifier:
+    """Operators stripped out of the query string (QueryModifier.java)."""
+
+    sitehost: str = ""
+    sitehash: str = ""
+    filetype: str = ""
+    author: str = ""
+    keyword: str = ""
+    tld: str = ""
+    protocol: str = ""
+    inurl: str = ""
+    intitle: str = ""
+    language: str = ""
+    date_sort: bool = False
+
+    def is_empty(self) -> bool:
+        return not (self.sitehost or self.filetype or self.author
+                    or self.keyword or self.tld or self.protocol
+                    or self.inurl or self.intitle or self.language
+                    or self.date_sort)
+
+    def to_string(self) -> str:
+        parts = []
+        if self.sitehost:
+            parts.append(f"site:{self.sitehost}")
+        if self.filetype:
+            parts.append(f"filetype:{self.filetype}")
+        if self.author:
+            parts.append(f"author:{self.author}")
+        if self.keyword:
+            parts.append(f"keyword:{self.keyword}")
+        if self.tld:
+            parts.append(f"tld:{self.tld}")
+        if self.protocol:
+            parts.append(f"protocol:{self.protocol}")
+        if self.inurl:
+            parts.append(f"inurl:{self.inurl}")
+        if self.intitle:
+            parts.append(f"intitle:{self.intitle}")
+        if self.language:
+            parts.append(f"/language/{self.language}")
+        if self.date_sort:
+            parts.append("/date")
+        return " ".join(parts)
+
+
+def _strip_prefix_op(q: str, prefix: str) -> tuple[str, str]:
+    """Remove `prefix:value` from the query; return (rest, value).
+
+    The prefix must start a token (string start or after whitespace), so
+    words merely containing it — `parasite:...`, `website:...` — are not
+    mis-parsed as operators.
+    """
+    i = q.find(prefix)
+    while i > 0 and not q[i - 1].isspace():
+        i = q.find(prefix, i + 1)
+    if i < 0:
+        return q, ""
+    j = i + len(prefix)
+    if j < len(q) and q[j] == "(":
+        end = q.find(")", j)
+        if end < 0:
+            end = len(q)
+        value = q[j + 1:end]
+        rest = q[:i] + q[end + 1:]
+    else:
+        end = q.find(" ", j)
+        if end < 0:
+            end = len(q)
+        value = q[j:end]
+        rest = q[:i] + q[end:]
+    return re.sub(r"\s+", " ", rest).strip(), value.strip()
+
+
+def parse_modifiers(querystring: str) -> tuple[str, QueryModifier]:
+    """Split in-string operators out, returning (bare query, modifier)."""
+    q = querystring
+    m = QueryModifier()
+    q, m.sitehost = _strip_prefix_op(q, "site:")
+    if m.sitehost.startswith("www."):
+        m.sitehost = m.sitehost[4:]
+    q, m.filetype = _strip_prefix_op(q, "filetype:")
+    if m.filetype.startswith("."):
+        m.filetype = m.filetype[1:]
+    m.filetype = m.filetype.lower()
+    q, m.author = _strip_prefix_op(q, "author:")
+    q, m.keyword = _strip_prefix_op(q, "keyword:")
+    q, m.tld = _strip_prefix_op(q, "tld:")
+    if m.tld.startswith("."):
+        m.tld = m.tld[1:]
+    q, m.protocol = _strip_prefix_op(q, "protocol:")
+    q, m.inurl = _strip_prefix_op(q, "inurl:")
+    q, m.intitle = _strip_prefix_op(q, "intitle:")
+    lang = _LANG_MOD.search(q)
+    if lang:
+        m.language = lang.group(1).lower()
+        q = _LANG_MOD.sub("", q)
+    if _DATE_SORT.search(q):
+        m.date_sort = True
+        q = _DATE_SORT.sub(" ", q)
+    return re.sub(r"\s+", " ", q).strip(), m
+
+
+@dataclass
+class QueryGoal:
+    """Include/exclude word sets parsed from the bare query string.
+
+    Reference semantics (QueryGoal.java): words split on whitespace;
+    a leading '-' excludes; "quoted phrases" keep their words in the
+    include set and remember the phrase for snippet/post filtering;
+    include hashes are the search keys for the RWI lookup.
+    """
+
+    include_words: list[str] = field(default_factory=list)
+    exclude_words: list[str] = field(default_factory=list)
+    phrases: list[str] = field(default_factory=list)
+
+    @staticmethod
+    def parse(bare_query: str) -> "QueryGoal":
+        g = QueryGoal()
+        q = bare_query
+        # pull out quoted phrases first
+        for phrase in re.findall(r'"([^"]*)"', q):
+            phrase = phrase.strip()
+            if phrase:
+                g.phrases.append(phrase.lower())
+                for w in _words(phrase):
+                    if w not in g.include_words:
+                        g.include_words.append(w)
+        q = re.sub(r'"[^"]*"', " ", q)
+        for tok in q.split():
+            if tok.startswith("-") and len(tok) > 1:
+                for w in _words(tok[1:]):
+                    if w not in g.exclude_words:
+                        g.exclude_words.append(w)
+            else:
+                for w in _words(tok):
+                    if w not in g.include_words and w not in g.exclude_words:
+                        g.include_words.append(w)
+        return g
+
+    @property
+    def include_hashes(self) -> list[bytes]:
+        return [word2hash(w) for w in self.include_words]
+
+    @property
+    def exclude_hashes(self) -> list[bytes]:
+        return [word2hash(w) for w in self.exclude_words]
+
+    def is_catchall(self) -> bool:
+        return self.include_words == ["*"] or not self.include_words
+
+    def matches(self, text: str) -> bool:
+        """All include words (and phrases) present, no exclude word."""
+        t = text.lower()
+        for w in self.include_words:
+            if w not in t:
+                return False
+        for w in self.exclude_words:
+            if w in t:
+                return False
+        for p in self.phrases:
+            if p not in t:
+                return False
+        return True
+
+
+def _words(s: str) -> list[str]:
+    return [w.lower() for w in re.findall(r"\w+", s, re.UNICODE) if w]
+
+
+@dataclass
+class QueryParams:
+    """Full query state (QueryParams.java:1232 equivalent, load-bearing
+    subset): goal + modifier + paging + content domain + ranking profile +
+    site/tld constraints; `query_id()` keys the SearchEventCache."""
+
+    goal: QueryGoal
+    modifier: QueryModifier
+    querystring: str = ""
+    item_count: int = 10
+    offset: int = 0
+    contentdom: int = CD_TEXT
+    max_results_rwi: int = 3000
+    max_results_node: int = 300
+    timeout_ms: int = 3000
+    lang: str = "en"
+    profile: RankingProfile | None = None
+    snippet_fetch: bool = True
+    facets: tuple = ("hosts", "language", "filetype", "authors", "year")
+    # domain diversity: max results per host before diversion
+    # (doubledom handling, SearchEvent.java:1297-1412)
+    max_per_host: int = 6
+
+    @staticmethod
+    def parse(querystring: str, **kw) -> "QueryParams":
+        bare, modifier = parse_modifiers(querystring)
+        goal = QueryGoal.parse(bare)
+        p = QueryParams(goal=goal, modifier=modifier, querystring=querystring,
+                        **kw)
+        if modifier.language:
+            p.lang = modifier.language
+        if p.profile is None:
+            p.profile = RankingProfile.for_contentdom(p.contentdom)
+        return p
+
+    def query_id(self) -> str:
+        """Stable id for event caching — same semantics as the reference's
+        QueryParams.id(): identical query state reuses the live event, so
+        paging does not re-run the search."""
+        key = "|".join((
+            ",".join(sorted(self.include_words())),
+            ",".join(sorted(self.goal.exclude_words)),
+            ",".join(sorted(self.goal.phrases)),
+            self.modifier.to_string(), str(self.contentdom), self.lang,
+            self.profile.to_external_string() if self.profile else "",
+        ))
+        return hashlib.md5(key.encode()).hexdigest()  # nosec: cache key only
+
+    def include_words(self) -> list[str]:
+        return self.goal.include_words

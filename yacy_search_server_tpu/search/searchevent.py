@@ -1,0 +1,359 @@
+"""SearchEvent — scatter-gather search orchestrator, TPU-first.
+
+Capability equivalent of the reference's SearchEvent
+(reference: source/net/yacy/search/query/SearchEvent.java:112-2563, the
+2,563-line orchestrator) and SearchEventCache.java:42-199. The reference
+runs a local Solr thread + a local RWI thread + N remote-peer threads, all
+feeding two bounded priority heaps, then drains the heaps through filters,
+doubledom diversion and post-ranking per `oneResult` call. Here the local
+path is batched:
+
+    term_search (sorted join)  →  constraint masks  →  device cardinal
+    + top-K kernel (ops/ranking.score_topk)  →  metadata join  →
+    host-diversity drain  →  post-ranking  →  result list
+
+Remote feeders (M5, peers/) later call `add_remote_postings` /
+`add_remote_results` on a live event — the heaps survive as host-side
+fusion points for asynchronous WAN producers, exactly the straggler
+strategy of SURVEY.md §7 ("deadline + late-merge into the cached event").
+
+Filters are applied as masks BEFORE the kernel (the reference interleaves
+them into its heap-insert loop, SearchEvent.java:673-836): contentdom
+flag constraint, language, site host, tld, filetype, inurl/intitle/author
+modifier checks. Host diversity (max N per host, then diversion —
+`doubledom`, SearchEvent.java:1297-1412) runs host-side over the oversized
+top-K so result *quality* matches, not just speed (SURVEY.md §7 hard part
+#1: two-stage top-k with domain-diversity constraints).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..index import postings as P
+from ..index.segment import Segment
+from ..ops.ranking import (CD_ALL, CD_APP, CD_AUDIO, CD_IMAGE, CD_TEXT,
+                           CD_VIDEO, CardinalRanker)
+from ..utils.bitfield import (FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO,
+                              FLAG_CAT_HASIMAGE, FLAG_CAT_HASVIDEO)
+from ..utils.eventtracker import EClass, StageTimer
+from ..utils.hashes import hosthash
+from ..utils.topk import WeakPriorityQueue
+from .navigator import accumulate, make_navigators
+from .query import QueryParams
+from .snippet import extract_snippet
+
+# oversampling factor for the device top-k so host-side diversity/filter
+# rechecks still fill the page (reference pulls from an unbounded-ish heap)
+TOPK_OVERSAMPLE = 8
+
+_CD_FLAG = {CD_IMAGE: FLAG_CAT_HASIMAGE, CD_AUDIO: FLAG_CAT_HASAUDIO,
+            CD_VIDEO: FLAG_CAT_HASVIDEO, CD_APP: FLAG_CAT_HASAPP}
+
+
+@dataclass
+class ResultEntry:
+    """One search result row (URIMetadataNode-equivalent surface)."""
+
+    docid: int
+    urlhash: bytes
+    score: int
+    url: str = ""
+    title: str = ""
+    snippet: str = ""
+    host: str = ""
+    filetype: str = ""
+    language: str = ""
+    size: int = 0
+    wordcount: int = 0
+    lastmod_days: int = 0
+    references: int = 0
+    source: str = "local"   # local | peer hash
+
+    def to_json(self) -> dict:
+        return {
+            "link": self.url, "title": self.title, "description": self.snippet,
+            "urlhash": self.urlhash.decode("ascii", "replace"),
+            "host": self.host, "size": self.size, "sizename": _sizename(self.size),
+            "ranking": int(self.score), "source": self.source,
+        }
+
+
+def _sizename(n: int) -> str:
+    for unit in ("bytes", "kB", "MB", "GB"):
+        if n < 1024:
+            return f"{n} {unit}"
+        n //= 1024
+    return f"{n} TB"
+
+
+class SearchEvent:
+    """One live search: executes locally at construction, accepts remote
+    feeder inserts afterwards, serves pages via `one_result`/`results`."""
+
+    def __init__(self, query: QueryParams, segment: Segment):
+        self.query = query
+        self.segment = segment
+        self.created = time.time()
+        self.touched = time.time()
+        self._lock = threading.RLock()
+        self.navigators = make_navigators(query.facets)
+        # host-side fusion heap for asynchronous (remote) producers; local
+        # batched results are inserted at construction
+        self.result_heap: WeakPriorityQueue[ResultEntry] = WeakPriorityQueue(
+            max(query.max_results_node, query.item_count * 10))
+        self._seen_urlhashes: set[bytes] = set()
+        self._host_counts: dict[bytes, int] = {}
+        self._diverted: list[tuple[int, ResultEntry]] = []
+        self.local_rwi_considered = 0
+        self.local_rwi_evicted = 0
+        self.remote_peers_asked = 0
+        self.remote_results = 0
+        self._ranker = CardinalRanker(query.profile, query.lang)
+        self._run_local()
+
+    # -- local batched path --------------------------------------------------
+
+    def _run_local(self) -> None:
+        q = self.query
+        with StageTimer(EClass.SEARCH, "JOIN"):
+            joined = self.segment.term_search(
+                include_hashes=q.goal.include_hashes or None,
+                exclude_hashes=q.goal.exclude_hashes or None)
+        self.local_rwi_considered = len(joined)
+        if len(joined) == 0:
+            return
+
+        with StageTimer(EClass.SEARCH, "PRESORT"):
+            mask = self._constraint_mask(joined)
+            cand = joined.select(mask)
+        if len(cand) == 0:
+            return
+
+        hosthashes = [hosthash(self.segment.metadata.urlhash_of(d))
+                      for d in cand.docids.tolist()]
+        k = min(len(cand),
+                max(q.item_count + q.offset, 10) * TOPK_OVERSAMPLE)
+        with StageTimer(EClass.SEARCH, "NORMALIZING", len(cand)):
+            scores, docids = self._ranker.rank(cand, hosthashes, k=k)
+
+        with StageTimer(EClass.SEARCH, "RESULTLIST", len(docids)):
+            for score, docid in zip(scores.tolist(), docids.tolist()):
+                made = self._make_entry(int(docid), int(score))
+                if made is None:
+                    self.local_rwi_evicted += 1
+                    continue
+                entry, meta = made
+                self._insert(entry, meta)
+
+    def _constraint_mask(self, plist) -> np.ndarray:
+        """Vector filters replacing the reference's per-row checks in
+        addRWIs (flags/contentdom/language constraints) and the metadata
+        recheck in pullOneFilteredFromRWI (site/tld/filetype)."""
+        q = self.query
+        n = len(plist)
+        mask = np.ones(n, dtype=bool)
+        # contentdom flag constraint
+        flag = _CD_FLAG.get(q.contentdom)
+        if flag is not None:
+            mask &= (plist.feats[:, P.F_FLAGS] >> flag) & 1 == 1
+        # language modifier is a hard filter (reference: language handled
+        # both as filter for /language/ modifier and as ranking preference)
+        if q.modifier.language:
+            mask &= plist.feats[:, P.F_LANGUAGE] == P.pack_language(
+                q.modifier.language)
+        # metadata-column constraints: direct column reads, not full-row
+        # DocumentMetadata materialization (hot path over up to 100k rows)
+        meta = self.segment.metadata
+        if q.modifier.sitehost or q.modifier.tld or q.modifier.filetype \
+                or q.modifier.protocol:
+            for i, docid in enumerate(plist.docids.tolist()):
+                if not mask[i]:
+                    continue
+                host = (meta.text_value(docid, "host_s") or "").lower()
+                if q.modifier.sitehost:
+                    want = q.modifier.sitehost.lower()
+                    if not (host == want or host.endswith("." + want)):
+                        mask[i] = False
+                        continue
+                if q.modifier.tld and not host.endswith("." + q.modifier.tld):
+                    mask[i] = False
+                    continue
+                if q.modifier.filetype and \
+                        meta.text_value(docid, "url_file_ext_s").lower() \
+                        != q.modifier.filetype:
+                    mask[i] = False
+                    continue
+                if q.modifier.protocol and not meta.text_value(
+                        docid, "sku").startswith(q.modifier.protocol + ":"):
+                    mask[i] = False
+        return mask
+
+    def _make_entry(self, docid: int, score: int):
+        """Metadata join + modifier recheck + snippet; returns
+        (ResultEntry, DocumentMetadata) or None when evicted."""
+        q = self.query
+        m = self.segment.metadata.get(docid)
+        if m is None:
+            return None
+        url = m.get("sku", "")
+        title = m.get("title", "") or url
+        if q.modifier.inurl and q.modifier.inurl.lower() not in url.lower():
+            return None
+        if q.modifier.intitle and q.modifier.intitle.lower() not in title.lower():
+            return None
+        if q.modifier.author:
+            if q.modifier.author.lower() not in (m.get("author") or "").lower():
+                return None
+        if q.modifier.keyword:
+            if q.modifier.keyword.lower() not in (m.get("keywords") or "").lower():
+                return None
+        text = m.get("text_t", "")
+        snippet = ""
+        if q.snippet_fetch:
+            snippet, _all = extract_snippet(text, q.goal.include_words)
+        # quoted phrases must literally appear (QueryGoal phrase recheck)
+        if q.goal.phrases:
+            tl = text.lower()
+            for ph in q.goal.phrases:
+                if ph not in tl and ph not in title.lower():
+                    return None
+        return ResultEntry(
+            docid=docid, urlhash=self.segment.metadata.urlhash_of(docid),
+            score=score, url=url, title=title, snippet=snippet,
+            host=m.get("host_s", ""), filetype=m.get("url_file_ext_s", ""),
+            language=m.get("language_s", ""), size=m.get("size_i", 0),
+            wordcount=m.get("wordcount_i", 0),
+            lastmod_days=m.get("last_modified_days_i", 0),
+            references=m.get("references_i", 0)), m
+
+    # -- fusion (local batch now, remote feeders in M5) ----------------------
+
+    def _insert(self, entry: ResultEntry, meta=None) -> bool:
+        """Dedup + host-diversity + post-ranking + heap insert. `meta` is
+        the already-joined metadata row for local results (None for remote
+        entries, which carry no local row)."""
+        with self._lock:
+            if entry.urlhash in self._seen_urlhashes:
+                return False
+            self._seen_urlhashes.add(entry.urlhash)
+            hh = hosthash(entry.urlhash)
+            cnt = self._host_counts.get(hh, 0)
+            if cnt >= self.query.max_per_host:
+                # doubledom diversion: parked, re-merged if page underfills
+                self._diverted.append((entry.score, entry))
+                return False
+            self._host_counts[hh] = cnt + 1
+            score = self._post_ranking(entry)
+            entry.score = score
+            self.result_heap.put(entry, score)
+            if meta is not None:
+                accumulate(self.navigators, meta)
+            return True
+
+    def _post_ranking(self, entry: ResultEntry) -> int:
+        """Post-sort boosts (reference: SearchEvent.postRanking,
+        SearchEvent.java:1963-2021): query appearing in title/url and
+        citation references raise the pre-sorted score."""
+        q, score = self.query, entry.score
+        prof = q.profile
+        tl = entry.title.lower()
+        ul = entry.url.lower()
+        for w in q.goal.include_words:
+            if w in tl:
+                score += 128 << prof.descrcompintoplist
+            if w in ul:
+                score += 128 << prof.urlcompintoplist
+        if entry.references > 0:
+            score += min(entry.references, 255) << prof.citation
+        return score
+
+    def add_remote_results(self, entries: list[ResultEntry]) -> int:
+        """Feeder entry point for remote peers (M5): merge asynchronously
+        into the live event (the reference's addNodes path)."""
+        added = 0
+        for e in entries:
+            if self._insert(e):
+                added += 1
+        self.remote_results += added
+        self.touched = time.time()
+        return added
+
+    # -- consumption ---------------------------------------------------------
+
+    def results(self, offset: int | None = None,
+                count: int | None = None) -> list[ResultEntry]:
+        """One page of results, best-first (oneResult loop equivalent)."""
+        self.touched = time.time()
+        q = self.query
+        offset = q.offset if offset is None else offset
+        count = q.item_count if count is None else count
+        need = offset + count
+        with self._lock:
+            avail = self.result_heap.size_available()
+            if avail < need and self._diverted:
+                # page underfills: merge back diverted same-host entries
+                # (the reference re-admits doubledom-parked results when the
+                # drained stacks run dry, SearchEvent.java:1376-1412)
+                self._diverted.sort(key=lambda t: -t[0])
+                refill = need - avail
+                for score, entry in self._diverted[:refill]:
+                    self.result_heap.put(entry, score)
+                del self._diverted[:refill]
+        got: list[ResultEntry] = []
+        for i in range(offset, need):
+            el = self.result_heap.element(i, timeout_s=0)
+            if el is None:
+                break
+            got.append(el.payload)
+        return got
+
+    def one_result(self, item: int) -> ResultEntry | None:
+        page = self.results(offset=item, count=1)
+        return page[0] if page else None
+
+    def facet(self, name: str, n: int = 10) -> list[tuple[str, int]]:
+        nav = self.navigators.get(name)
+        return nav.top(n) if nav else []
+
+
+class SearchEventCache:
+    """query-id → live SearchEvent, so paging reuses the executed search
+    (reference: SearchEventCache.java:42-199, incl. memory-pressure
+    cleanup — here a simple TTL + max-size policy)."""
+
+    def __init__(self, max_events: int = 100, ttl_s: float = 600.0):
+        self.max_events = max_events
+        self.ttl_s = ttl_s
+        self._events: dict[str, SearchEvent] = {}
+        self._lock = threading.Lock()
+
+    def get_event(self, query: QueryParams, segment: Segment) -> SearchEvent:
+        qid = query.query_id()
+        with self._lock:
+            ev = self._events.get(qid)
+            if ev is not None:
+                ev.touched = time.time()
+                return ev
+        ev = SearchEvent(query, segment)
+        with self._lock:
+            self.cleanup_locked()
+            self._events[qid] = ev
+        return ev
+
+    def cleanup_locked(self) -> None:
+        now = time.time()
+        dead = [k for k, e in self._events.items()
+                if now - e.touched > self.ttl_s]
+        for k in dead:
+            del self._events[k]
+        while len(self._events) >= self.max_events:
+            oldest = min(self._events, key=lambda k: self._events[k].touched)
+            del self._events[oldest]
+
+    def __len__(self) -> int:
+        return len(self._events)

@@ -1,0 +1,47 @@
+"""Snippet extraction — best sentence window for the query words.
+
+Capability equivalent of the reference's snippet machinery (reference:
+source/net/yacy/search/snippet/TextSnippet.java and
+source/net/yacy/document/SnippetExtractor.java): pick the shortest
+sentence combination containing the most query words, trim to a maximum
+length around the match, and mark whether all words matched. The reference
+may fetch the page live (cacheStrategy) — here the condensed text is in the
+metadata store (`text_t`), so extraction is always cache-local; a live
+re-fetch path can layer on the crawler's loader later.
+"""
+
+from __future__ import annotations
+
+import re
+
+_SENTENCE_RE = re.compile(r"[^.!?\n\r]+[.!?]?")
+MAX_SNIPPET_LENGTH = 220
+
+
+def extract_snippet(text: str, words: list[str],
+                    max_length: int = MAX_SNIPPET_LENGTH) -> tuple[str, bool]:
+    """(snippet, all_words_matched) — best-coverage shortest sentence set."""
+    if not text or not words:
+        return text[:max_length], False
+    lw = [w.lower() for w in words]
+    best, best_hits, best_len = "", 0, 1 << 30
+    for m in _SENTENCE_RE.finditer(text):
+        s = m.group().strip()
+        if not s:
+            continue
+        sl = s.lower()
+        hits = sum(1 for w in lw if w in sl)
+        if hits > best_hits or (hits == best_hits and 0 < hits
+                                and len(s) < best_len):
+            best, best_hits, best_len = s, hits, len(s)
+            if hits == len(lw) and len(s) <= max_length:
+                break
+    if not best:
+        best = text[:max_length]
+    if len(best) > max_length:
+        # center the window on the first matching word
+        pos = min((best.lower().find(w) for w in lw
+                   if best.lower().find(w) >= 0), default=0)
+        start = max(0, pos - max_length // 3)
+        best = ("..." if start else "") + best[start:start + max_length] + "..."
+    return best, best_hits == len(lw)

@@ -138,6 +138,13 @@ def hosthash(urlhash: bytes) -> bytes:
     return urlhash[6:12]
 
 
+def url_comps(url: str) -> int:
+    """Number of url path/host components — the single source for the
+    `urlcomps` ranking signal (postings column and metadata column must
+    agree, or the same doc scores differently per read path)."""
+    return min(len([c for c in url.split("/") if c]), 255)
+
+
 def dom_length_estimation(urlhash: bytes) -> int:
     """Estimated domain length from the url-hash flag byte."""
     flagbyte = enhanced_coder.decode_byte(urlhash[11])
