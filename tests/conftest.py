@@ -12,3 +12,15 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# On dev boxes where a remote-TPU plugin is force-registered at interpreter
+# start (before this file runs), the env vars above cannot demote it — and
+# backend discovery would block on tunnel liveness.  Restrict jax to the
+# CPU platform before any backend initializes: the suite must never depend
+# on the tunnel; multi-device tests use the 8 virtual CPU devices.
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,302 @@
+"""M3 crawler tests — profiles, politeness, robots, frontier, cache, loader.
+
+Style follows the reference's embedded-integration approach (SURVEY.md §4:
+real subsystems over temp dirs, no mocks except the network transport).
+"""
+
+import os
+import time
+
+import pytest
+
+from yacy_search_server_tpu.crawler.cache import HTCache
+from yacy_search_server_tpu.crawler.frontier import (HostBalancer, HostQueue,
+                                                     NoticedURL, StackType)
+from yacy_search_server_tpu.crawler.latency import Latency
+from yacy_search_server_tpu.crawler.loader import (CacheStrategy,
+                                                   LoaderDispatcher)
+from yacy_search_server_tpu.crawler.profile import CrawlProfile
+from yacy_search_server_tpu.crawler.queues import ErrorCache
+from yacy_search_server_tpu.crawler.request import Request, Response
+from yacy_search_server_tpu.crawler.robots import RobotsTxt, parse_robots
+from yacy_search_server_tpu.crawler.stacker import CrawlStacker
+
+
+# -- profile ----------------------------------------------------------------
+
+def test_profile_match_rules():
+    p = CrawlProfile("t", crawler_url_must_match=r"https?://example\.org/.*",
+                     crawler_url_must_not_match=r".*\.gif$")
+    assert p.crawl_allowed("http://example.org/page.html")
+    assert not p.crawl_allowed("http://other.org/page.html")
+    assert not p.crawl_allowed("http://example.org/x.gif")
+
+
+def test_profile_query_urls():
+    p = CrawlProfile("t", crawling_q=False)
+    assert not p.crawl_allowed("http://a.test/x?y=1")
+    assert CrawlProfile("t2").crawl_allowed("http://a.test/x?y=1")
+
+
+def test_profile_recrawl_due():
+    p = CrawlProfile("t", recrawl_if_older_s=3600)
+    assert p.recrawl_due(None)
+    assert p.recrawl_due(time.time() - 7200)
+    assert not p.recrawl_due(time.time() - 60)
+    never = CrawlProfile("t2")          # recrawl_if_older_s = -1
+    assert not never.recrawl_due(time.time() - 10**9)
+
+
+def test_profile_roundtrip():
+    p = CrawlProfile("t", depth=3, collections=("a", "b"))
+    q = CrawlProfile.from_dict(p.to_dict())
+    assert q.handle == p.handle and q.depth == 3 and q.collections == ("a", "b")
+
+
+# -- latency ----------------------------------------------------------------
+
+def test_latency_politeness():
+    lat = Latency(min_delta_s=0.2)
+    assert lat.waiting_remaining_s("h.test") == 0.0
+    lat.update_after_load("h.test", 0.1)
+    assert lat.waiting_remaining_s("h.test") > 0.0
+    lat2 = Latency(min_delta_s=0.0)
+    lat2.update_robots_delay("h.test", 2.0)
+    lat2.update_after_load("h.test", 0.0)
+    assert 1.5 < lat2.waiting_remaining_s("h.test") <= 2.0
+
+
+# -- robots -----------------------------------------------------------------
+
+ROBOTS = """
+User-agent: *
+Disallow: /private/
+Allow: /private/ok.html
+Crawl-delay: 1.5
+Sitemap: http://h.test/sitemap.xml
+
+User-agent: evilbot
+Disallow: /
+"""
+
+
+def test_robots_parse_rules():
+    e = parse_robots(ROBOTS, agent="yacy-tpu")
+    assert not e.is_allowed("/private/secret.html")
+    assert e.is_allowed("/private/ok.html")       # longest-match allow wins
+    assert e.is_allowed("/public/x")
+    assert e.crawl_delay_s == 1.5
+    assert "http://h.test/sitemap.xml" in e.sitemaps
+
+
+def test_robots_specific_agent_group():
+    e = parse_robots(ROBOTS, agent="evilbot")
+    assert not e.is_allowed("/anything")
+
+
+def test_robots_wildcards():
+    e = parse_robots("User-agent: *\nDisallow: /*.pdf$\n")
+    assert not e.is_allowed("/doc/file.pdf")
+    assert e.is_allowed("/doc/file.pdf.html")
+
+
+def test_robots_cache_and_missing(tmp_path):
+    calls = []
+
+    def fetcher(url):
+        calls.append(url)
+        return b"User-agent: *\nDisallow: /no\n"
+
+    r = RobotsTxt(fetcher=fetcher)
+    assert not r.is_allowed("http://h.test/no/x")
+    assert r.is_allowed("http://h.test/yes")
+    assert len(calls) == 1                      # cached
+    r2 = RobotsTxt(fetcher=lambda url: None)    # no robots.txt: allow all
+    assert r2.is_allowed("http://h.test/anything")
+
+
+# -- frontier ---------------------------------------------------------------
+
+def test_hostqueue_depth_order():
+    q = HostQueue("h.test")
+    q.push(Request("http://h.test/deep", depth=2))
+    q.push(Request("http://h.test/shallow", depth=0))
+    q.push(Request("http://h.test/mid", depth=1))
+    assert q.pop().url.endswith("shallow")
+    assert q.pop().url.endswith("mid")
+    assert q.pop().url.endswith("deep")
+    assert q.pop() is None
+
+
+def test_hostqueue_dedup():
+    q = HostQueue("h.test")
+    assert q.push(Request("http://h.test/a"))
+    assert not q.push(Request("http://h.test/a"))
+    assert len(q) == 1
+
+
+def test_hostqueue_persistence(tmp_path):
+    d = str(tmp_path)
+    q = HostQueue("h.test", d)
+    q.push(Request("http://h.test/a"))
+    q.push(Request("http://h.test/b"))
+    q.pop()
+    q.close()
+    q2 = HostQueue("h.test", d)
+    r = q2.pop()
+    assert r is not None and r.url == "http://h.test/b"
+    assert q2.pop() is None
+    q2.close()
+
+
+def test_balancer_politeness_rotation():
+    lat = Latency(min_delta_s=10.0)
+    b = HostBalancer(lat)
+    b.push(Request("http://a.test/1"))
+    b.push(Request("http://a.test/2"))
+    b.push(Request("http://b.test/1"))
+    b.push(Request("http://b.test/2"))
+    r1, _ = b.pop()
+    assert r1 is not None
+    lat.update_after_load(r1.host, 0.01)     # a.test now cooling down
+    r2, _ = b.pop()
+    assert r2 is not None and r2.host != r1.host
+    lat.update_after_load(r2.host, 0.01)
+    r3, sleep_s = b.pop()                    # both cooling down
+    assert r3 is None and sleep_s > 0
+
+
+def test_noticed_url_stacks():
+    n = NoticedURL(Latency(min_delta_s=0.0))
+    n.push(StackType.LOCAL, Request("http://a.test/1"))
+    n.push(StackType.GLOBAL, Request("http://a.test/2"))
+    assert n.size(StackType.LOCAL) == 1
+    assert n.size(StackType.GLOBAL) == 1
+    assert n.exists_in_any("http://a.test/2")
+    r, _ = n.pop(StackType.LOCAL)
+    assert r.url == "http://a.test/1"
+
+
+# -- cache ------------------------------------------------------------------
+
+def test_htcache_ram_and_disk(tmp_path):
+    c = HTCache(str(tmp_path))
+    assert c.store("http://h.test/x", b"hello world",
+                   {"content-type": "text/plain"})
+    got = c.get("http://h.test/x")
+    assert got is not None and got[0] == b"hello world"
+    assert got[1]["content-type"] == "text/plain"
+    assert c.age_s("http://h.test/x") < 5.0
+    # survives a fresh instance (disk path)
+    c2 = HTCache(str(tmp_path))
+    got2 = c2.get("http://h.test/x")
+    assert got2 is not None and got2[0] == b"hello world"
+    c2.delete("http://h.test/x")
+    assert c2.get("http://h.test/x") is None
+
+
+def test_htcache_size_cap():
+    c = HTCache(max_content_bytes=10)
+    assert not c.store("http://h.test/big", b"x" * 11)
+
+
+# -- loader -----------------------------------------------------------------
+
+def _transport_for(site):
+    def transport(url, headers):
+        if url in site:
+            return 200, {"content-type": "text/html"}, site[url]
+        return 404, {}, b""
+    return transport
+
+
+def test_loader_cache_strategies(tmp_path):
+    site = {"http://h.test/a": b"content-a"}
+    hits = []
+
+    def transport(url, headers):
+        hits.append(url)
+        return _transport_for(site)(url, headers)
+
+    loader = LoaderDispatcher(HTCache(), Latency(min_delta_s=0),
+                              transport=transport)
+    r1 = loader.load(Request("http://h.test/a"), CacheStrategy.NOCACHE)
+    assert r1.status == 200 and r1.content == b"content-a"
+    r2 = loader.load(Request("http://h.test/a"), CacheStrategy.IFEXIST)
+    assert r2.from_cache and len(hits) == 1
+    r3 = loader.load(Request("http://h.test/a"), CacheStrategy.NOCACHE)
+    assert not r3.from_cache and len(hits) == 2
+    r4 = loader.load(Request("http://h.test/missing"),
+                     CacheStrategy.CACHEONLY)
+    assert r4.status == 404
+
+
+def test_loader_file_scheme(tmp_path):
+    p = tmp_path / "doc.html"
+    p.write_text("<html><title>T</title></html>")
+    loader = LoaderDispatcher(HTCache(), Latency(min_delta_s=0))
+    r = loader.load(Request(f"file://{p}"))
+    assert r.status == 200 and b"<title>T</title>" in r.content
+    assert r.mime_type() == "text/html"
+
+
+def test_loader_unsupported_scheme():
+    loader = LoaderDispatcher(HTCache(), Latency(min_delta_s=0))
+    r = loader.load(Request("gopher://old.test/x"))
+    assert r.status == 501
+
+
+# -- stacker ----------------------------------------------------------------
+
+def _stacker(profiles=None, **kw):
+    noticed = NoticedURL(Latency(min_delta_s=0.0))
+    profiles = profiles or {}
+    return CrawlStacker(noticed, profiles, **kw), noticed
+
+
+def test_stacker_accept_and_route():
+    p = CrawlProfile("t", depth=2)
+    st, noticed = _stacker({p.handle: p})
+    assert st.stack(Request("http://a.test/x", profile_handle=p.handle)) is None
+    assert noticed.size(StackType.LOCAL) == 1
+
+
+def test_stacker_rejections():
+    p = CrawlProfile("t", depth=1,
+                     crawler_url_must_not_match=r".*forbidden.*")
+    st, _ = _stacker({p.handle: p})
+    assert "unknown profile" in st.stack(Request("http://a.test/x",
+                                                 profile_handle="nope"))
+    assert "depth" in st.stack(
+        Request("http://a.test/x", profile_handle=p.handle, depth=5))
+    assert "must(not)match" in st.stack(
+        Request("http://a.test/forbidden/x", profile_handle=p.handle))
+    assert "scheme" in st.stack(
+        Request("gopher://a.test/x", profile_handle=p.handle))
+    # duplicate
+    assert st.stack(Request("http://a.test/ok",
+                            profile_handle=p.handle)) is None
+    assert "frontier" in st.stack(Request("http://a.test/ok",
+                                          profile_handle=p.handle))
+
+
+def test_stacker_blacklist():
+    p = CrawlProfile("t")
+    st, _ = _stacker({p.handle: p},
+                     blacklist=lambda url: "bad host"
+                     if "evil" in url else None)
+    assert "blacklisted" in st.stack(
+        Request("http://evil.test/x", profile_handle=p.handle))
+    assert st.stack(Request("http://good.test/x",
+                            profile_handle=p.handle)) is None
+
+
+# -- error cache ------------------------------------------------------------
+
+def test_error_cache_bounded():
+    ec = ErrorCache(max_entries=5)
+    for i in range(10):
+        ec.push(bytes([i]), f"http://h.test/{i}", "reason")
+    assert len(ec) == 5
+    assert ec.has(bytes([9]))
+    assert not ec.has(bytes([0]))

@@ -1,0 +1,100 @@
+"""M4 — sharded mesh query path: parity with the single-device kernels.
+
+The sharded kernels must produce results identical to the single-device
+path (same stats-merge math, SURVEY.md §7 build plan M4 "ranking parity
+tests vs M2"). Runs on the 8-device virtual CPU pool (conftest).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.ops.ranking import (CardinalRanker,
+                                                RankingProfile,
+                                                bm25_scores_np)
+from yacy_search_server_tpu.parallel.mesh import (MeshBM25, MeshRanker,
+                                                  make_mesh, pad_to_shards)
+
+
+def _cpu8():
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    return devs
+
+
+def _random_postings(n, seed=0):
+    rng = np.random.default_rng(seed)
+    docids = np.arange(n, dtype=np.int32)
+    feats = rng.integers(0, 500, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2**20, n)
+    feats[:, P.F_LANGUAGE] = np.where(rng.random(n) < 0.5,
+                                      P.pack_language("en"),
+                                      P.pack_language("de"))
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    hosts = [bytes([i % 13, 7]) for i in range(n)]
+    return PostingsList(docids, feats), hosts
+
+
+def test_pad_to_shards():
+    assert pad_to_shards(1, 8) == 8 * 128
+    assert pad_to_shards(8 * 128, 8) == 8 * 128
+    assert pad_to_shards(8 * 128 + 1, 8) == 8 * 256
+
+
+@pytest.mark.parametrize("n_term,n_doc", [(1, 8), (2, 4)])
+def test_cardinal_parity_across_mesh_shapes(n_term, n_doc):
+    devs = _cpu8()
+    pl, hosts = _random_postings(1000, seed=1)
+    s1, d1 = CardinalRanker().rank(pl, hosts, k=10)
+    mesh = make_mesh(n_doc=n_doc, n_term=n_term, devices=devs)
+    s2, d2 = MeshRanker(mesh).rank(pl, hosts, k=10)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(d1, d2)
+
+
+def test_cardinal_parity_with_profile():
+    devs = _cpu8()
+    pl, hosts = _random_postings(600, seed=2)
+    prof = RankingProfile(authority=15, language=5)  # authority kernel active
+    s1, d1 = CardinalRanker(prof).rank(pl, hosts, k=20)
+    mesh = make_mesh(n_doc=8, devices=devs)
+    s2, d2 = MeshRanker(mesh, prof).rank(pl, hosts, k=20)
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(d1, d2)
+
+
+def test_bm25_sharded_matches_numpy_oracle():
+    devs = _cpu8()
+    rng = np.random.default_rng(3)
+    n, t = 777, 6
+    tf = rng.integers(0, 9, (n, t)).astype(np.float32)
+    dl = rng.integers(40, 800, n).astype(np.int32)
+    df = rng.integers(1, n, t).astype(np.int32)
+    docids = np.arange(n, dtype=np.int32)
+    mesh = make_mesh(n_doc=4, n_term=2, devices=devs)
+    s, d = MeshBM25(mesh).topk(tf, dl, df, n, docids, k=15)
+    ref = bm25_scores_np(tf, dl, df, n)
+    order = np.argsort(-ref)[:15]
+    assert set(d.tolist()) == set(order.tolist())
+    np.testing.assert_allclose(np.sort(s)[::-1], np.sort(ref[order])[::-1],
+                               rtol=1e-4)
+
+
+def test_small_input_smaller_than_k():
+    devs = _cpu8()
+    pl, hosts = _random_postings(5, seed=4)
+    mesh = make_mesh(n_doc=8, devices=devs)
+    s, d = MeshRanker(mesh).rank(pl, hosts, k=10)
+    assert len(s) == 5 and len(d) == 5
+    assert set(d.tolist()) <= set(range(5))
+
+
+def test_empty_postings():
+    devs = _cpu8()
+    mesh = make_mesh(n_doc=8, devices=devs)
+    s, d = MeshRanker(mesh).rank(PostingsList.empty(), None, k=10)
+    assert len(s) == 0 and len(d) == 0

@@ -1,0 +1,181 @@
+"""M3 parser-zoo tests — fixture-style behavioral checks per format
+(the reference's parser test model: parse a fixture, assert extracted
+title/text/links/charset — SURVEY.md §4)."""
+
+import gzip
+import io
+import zipfile
+import zlib
+
+import pytest
+
+from yacy_search_server_tpu.document.parser import (ParserError, parse_source,
+                                                    supports)
+from yacy_search_server_tpu.document.parser.htmlparser import parse_html
+from yacy_search_server_tpu.document.parser.pdfparser import parse_pdf
+from yacy_search_server_tpu.document.parser.xmlparsers import (parse_feed,
+                                                               parse_sitemap)
+
+HTML = b"""<!DOCTYPE html>
+<html lang="en"><head>
+<meta charset="utf-8">
+<title>The Test Page</title>
+<meta name="description" content="A page for testing">
+<meta name="keywords" content="alpha, beta">
+<meta name="author" content="Ann Author">
+<link rel="canonical" href="http://ex.test/canonical.html">
+<base href="http://ex.test/sub/">
+</head><body>
+<h1>Main Headline</h1>
+<script>ignored();</script>
+<p>Visible body text here.</p>
+<a href="other.html" rel="nofollow">other page</a>
+<a href="http://abs.test/x">absolute link</a>
+<img src="pic.png" alt="a picture" width="10" height="20">
+</body></html>"""
+
+
+def test_html_scraper_fields():
+    doc = parse_html("http://ex.test/page.html", HTML)[0]
+    assert doc.url == "http://ex.test/canonical.html"
+    assert doc.title == "The Test Page"
+    assert doc.description == "A page for testing"
+    assert doc.keywords == ["alpha", "beta"]
+    assert doc.author == "Ann Author"
+    assert doc.language == "en"
+    assert "Visible body text here." in doc.text
+    assert "ignored()" not in doc.text
+    assert doc.sections == ["Main Headline"]
+    urls = [a.url for a in doc.anchors]
+    assert "http://ex.test/sub/other.html" in urls      # base href resolution
+    assert "http://abs.test/x" in urls
+    assert doc.images[0].url == "http://ex.test/sub/pic.png"
+    assert doc.images[0].alt == "a picture"
+    assert doc.images[0].width == 10
+
+
+def test_html_noindex_nofollow():
+    html = b"<html><head><meta name='robots' content='noindex,nofollow'>" \
+           b"<title>T</title></head><body>secret <a href='/x'>l</a></body>"
+    doc = parse_html("http://ex.test/", html)[0]
+    assert doc.text == ""
+    assert doc.anchors == []
+    assert doc.noindex
+
+
+def test_html_charset_meta():
+    html = "<html><head><meta charset='iso-8859-1'><title>caf\xe9</title>" \
+           "</head><body>caf\xe9</body></html>".encode("iso-8859-1")
+    doc = parse_html("http://ex.test/", html)[0]
+    assert doc.title == "café"
+
+
+def test_text_csv_json_vcf():
+    docs = parse_source("http://h.test/a.txt", "text/plain",
+                        b"First line title\nmore body text")
+    assert docs[0].title == "First line title"
+    docs = parse_source("http://h.test/a.csv", "text/csv",
+                        b"name,age\nann,30\nbob,40")
+    assert "ann 30" in docs[0].text
+    docs = parse_source("http://h.test/a.json", "application/json",
+                        b'{"title": "J", "items": ["x", "y"]}')
+    assert docs[0].title == "J" and "x" in docs[0].text
+    docs = parse_source("http://h.test/a.vcf", "text/vcard",
+                        b"BEGIN:VCARD\nFN:Ann Author\nTEL:123\nEND:VCARD")
+    assert docs[0].title == "Ann Author"
+
+
+RSS = b"""<?xml version="1.0"?>
+<rss version="2.0"><channel><title>Chan</title>
+<item><title>Item One</title><link>http://h.test/1</link>
+<description>first &lt;b&gt;item&lt;/b&gt; text</description></item>
+<item><title>Item Two</title><link>http://h.test/2</link></item>
+</channel></rss>"""
+
+
+def test_rss_feed():
+    docs = parse_feed("http://h.test/feed.rss", RSS)
+    assert len(docs) == 2
+    assert docs[0].url == "http://h.test/1"
+    assert docs[0].title == "Item One"
+    assert "first" in docs[0].text and "<b>" not in docs[0].description
+
+
+def test_atom_feed():
+    atom = b"""<feed xmlns="http://www.w3.org/2005/Atom">
+    <title>F</title><entry><title>E1</title>
+    <link href="http://h.test/e1"/><summary>sum</summary></entry></feed>"""
+    docs = parse_feed("http://h.test/feed.atom", atom)
+    assert len(docs) == 1 and docs[0].url == "http://h.test/e1"
+
+
+def test_sitemap():
+    sm = b"""<urlset xmlns="http://www.sitemaps.org/schemas/sitemap/0.9">
+    <url><loc>http://h.test/a</loc></url>
+    <url><loc>http://h.test/b</loc></url></urlset>"""
+    pages, nested = parse_sitemap(sm)
+    assert pages == ["http://h.test/a", "http://h.test/b"] and nested == []
+    idx = b"""<sitemapindex><sitemap><loc>http://h.test/s1.xml</loc>
+    </sitemap></sitemapindex>"""
+    pages, nested = parse_sitemap(idx)
+    assert nested == ["http://h.test/s1.xml"] and pages == []
+
+
+def _tiny_pdf(text: str = "Hello PDF world") -> bytes:
+    stream = f"BT /F1 12 Tf 72 700 Td ({text}) Tj ET".encode()
+    comp = zlib.compress(stream)
+    return (b"%PDF-1.4\n1 0 obj\n<< /Title (Doc Title) /Author (Ann) >>\n"
+            b"endobj\n2 0 obj\n<< /Length " + str(len(comp)).encode()
+            + b" /Filter /FlateDecode >>\nstream\n" + comp
+            + b"\nendstream\nendobj\n%%EOF")
+
+
+def test_pdf_text_and_info():
+    doc = parse_pdf("http://h.test/a.pdf", _tiny_pdf())[0]
+    assert "Hello PDF world" in doc.text
+    assert doc.title == "Doc Title"
+    assert doc.author == "Ann"
+
+
+def test_pdf_uncompressed_stream():
+    pdf = (b"%PDF-1.4\n1 0 obj\n<< /Length 40 >>\nstream\n"
+           b"BT (plain stream text) Tj ET\nendstream\nendobj\n%%EOF")
+    doc = parse_pdf("http://h.test/b.pdf", pdf)[0]
+    assert "plain stream text" in doc.text
+
+
+def test_zip_recursion():
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("inner.html", "<html><title>Inner</title>"
+                                  "<body>zipped page</body></html>")
+        zf.writestr("notes.txt", "plain note text")
+    docs = parse_source("http://h.test/arch.zip", "application/zip",
+                        buf.getvalue())
+    titles = {d.title for d in docs}
+    assert "Inner" in titles
+    assert any("plain note text" in d.text for d in docs)
+    assert all("#" in d.url for d in docs)       # member urls
+
+
+def test_gzip_recursion():
+    inner = b"<html><title>GZ</title><body>gz page</body></html>"
+    docs = parse_source("http://h.test/page.html.gz", "application/gzip",
+                        gzip.compress(inner))
+    assert docs[0].title == "GZ"
+
+
+def test_mime_sniffing():
+    docs = parse_source("http://h.test/unknown", None,
+                        b"<!DOCTYPE html><html><title>S</title></html>")
+    assert docs[0].title == "S"
+    docs = parse_source("http://h.test/unknown2", None, _tiny_pdf("sniffed"))
+    assert "sniffed" in docs[0].text
+
+
+def test_supports_and_errors():
+    assert supports("http://h.test/x.html")
+    assert supports("http://h.test/x", mime="text/html")
+    assert supports("http://h.test/x.pdf")
+    with pytest.raises(ParserError):
+        parse_source("http://h.test/x.html", "text/html", b"")

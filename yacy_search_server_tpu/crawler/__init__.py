@@ -1,0 +1,11 @@
+"""Crawler — acquisition layer: frontier, politeness, loaders, cache.
+
+Capability equivalent of the reference's crawler layer (reference:
+source/net/yacy/crawler/ + repository/LoaderDispatcher.java, SURVEY.md §1
+L3): host-balanced frontier queues, per-host politeness from measured
+latency + robots.txt, admission control, protocol loaders with a shared
+page cache, and crawl profiles.
+"""
+
+from .profile import CrawlProfile
+from .request import Request, Response

@@ -1,0 +1,123 @@
+"""HTCache — the shared page cache (compressed content + response headers).
+
+Capability equivalent of the reference's HTCache (reference:
+source/net/yacy/crawler/data/Cache.java:59-130: gzip-compressed content in
+an ArrayStack BLOB plus response headers in a MapHeap). Here: content is
+gzip-compressed into sharded files keyed by url-hash, headers are a json
+sidecar, and a bounded in-RAM ARC-ish buffer fronts the disk store. A
+pure-RAM mode (data_dir=None) backs tests and proxy-only setups.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import threading
+import time
+from base64 import urlsafe_b64encode
+
+from ..utils.hashes import url2hash
+
+RAM_BUFFER_MAX = 256
+
+
+def _keys(urlhash: bytes) -> tuple[str, str]:
+    k = urlsafe_b64encode(urlhash).decode("ascii").rstrip("=")
+    return k[:2], k
+
+
+class HTCache:
+    def __init__(self, data_dir: str | None = None,
+                 max_content_bytes: int = 10 * 1024 * 1024):
+        self.data_dir = data_dir
+        self.max_content_bytes = max_content_bytes
+        self._ram: dict[bytes, tuple[bytes, dict, float]] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+
+    # -- store ---------------------------------------------------------------
+
+    def store(self, url: str, content: bytes, headers: dict | None = None) -> bool:
+        if len(content) > self.max_content_bytes:
+            return False
+        h = url2hash(url)
+        headers = dict(headers or {})
+        headers["x-cache-date"] = time.time()
+        headers["x-cache-url"] = url
+        with self._lock:
+            self._ram[h] = (content, headers, time.time())
+            while len(self._ram) > RAM_BUFFER_MAX:
+                self._ram.pop(next(iter(self._ram)))
+        if self.data_dir:
+            shard, key = _keys(h)
+            d = os.path.join(self.data_dir, shard)
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, key + ".gz"), "wb") as f:
+                f.write(gzip.compress(content))
+            with open(os.path.join(d, key + ".json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(headers, f)
+        return True
+
+    # -- load ----------------------------------------------------------------
+
+    def _paths(self, urlhash: bytes) -> tuple[str, str] | None:
+        if not self.data_dir:
+            return None
+        shard, key = _keys(urlhash)
+        d = os.path.join(self.data_dir, shard)
+        return os.path.join(d, key + ".gz"), os.path.join(d, key + ".json")
+
+    def has(self, url: str) -> bool:
+        h = url2hash(url)
+        with self._lock:
+            if h in self._ram:
+                return True
+        p = self._paths(h)
+        return p is not None and os.path.exists(p[0])
+
+    def get(self, url: str) -> tuple[bytes, dict] | None:
+        h = url2hash(url)
+        with self._lock:
+            hit = self._ram.get(h)
+            if hit is not None:
+                self.hits += 1
+                return hit[0], hit[1]
+        p = self._paths(h)
+        if p and os.path.exists(p[0]):
+            try:
+                with open(p[0], "rb") as f:
+                    content = gzip.decompress(f.read())
+                headers = {}
+                if os.path.exists(p[1]):
+                    with open(p[1], encoding="utf-8") as f:
+                        headers = json.load(f)
+                self.hits += 1
+                return content, headers
+            except (OSError, json.JSONDecodeError):
+                pass
+        self.misses += 1
+        return None
+
+    def age_s(self, url: str) -> float | None:
+        got = self.get(url)
+        if got is None:
+            return None
+        ts = got[1].get("x-cache-date")
+        return (time.time() - ts) if ts else None
+
+    def delete(self, url: str) -> None:
+        h = url2hash(url)
+        with self._lock:
+            self._ram.pop(h, None)
+        p = self._paths(h)
+        if p:
+            for path in p:
+                try:
+                    os.remove(path)
+                except FileNotFoundError:
+                    pass

@@ -1,0 +1,226 @@
+"""Crawl frontier — per-host queues balanced by politeness.
+
+Capability equivalent of the reference's frontier (reference:
+source/net/yacy/crawler/HostBalancer.java:64, HostQueue.java:64 and
+data/NoticedURL.java): one depth-ordered queue per host, a balancer that
+round-robins over hosts honoring each host's politeness cool-down, and
+the NoticedURL facade with LOCAL / GLOBAL / REMOTE / NOLOAD stacks.
+
+Persistence: each host queue journals pushes/pops to a jsonl file under
+`data_dir/<hostkey>/` and compacts on close, replacing the reference's
+per-depth kelondro Table stacks with the same recover-on-restart
+guarantee.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from urllib.parse import urlsplit
+
+from .latency import Latency
+from .request import Request
+
+
+def host_key(url: str) -> str:
+    netloc = urlsplit(url).netloc.lower()
+    return netloc.replace(":", "_") or "_nohost"
+
+
+class HostQueue:
+    """Depth-ordered FIFO per host: smallest depth first (breadth-first
+    crawling, HostQueue.java depth-stack semantics)."""
+
+    def __init__(self, hostkey: str, data_dir: str | None = None):
+        self.hostkey = hostkey
+        self._depths: dict[int, deque[Request]] = {}
+        self._known: set[bytes] = set()
+        self._size = 0
+        self._lock = threading.Lock()
+        self._journal = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._journal_path = os.path.join(data_dir, f"{hostkey}.jsonl")
+            self._replay()
+            self._journal = open(self._journal_path, "a", encoding="utf-8")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._journal_path):
+            return
+        alive: dict[str, Request] = {}
+        with open(self._journal_path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("op") == "push":
+                    r = Request.from_dict(rec["req"])
+                    alive[r.url] = r
+                elif rec.get("op") == "pop":
+                    alive.pop(rec.get("url", ""), None)
+        for r in alive.values():
+            self._push_mem(r)
+
+    def _push_mem(self, req: Request) -> bool:
+        h = req.urlhash()
+        if h in self._known:
+            return False
+        self._known.add(h)
+        self._depths.setdefault(req.depth, deque()).append(req)
+        self._size += 1
+        return True
+
+    def push(self, req: Request) -> bool:
+        with self._lock:
+            if not self._push_mem(req):
+                return False
+            if self._journal:
+                self._journal.write(json.dumps(
+                    {"op": "push", "req": req.to_dict()}) + "\n")
+                self._journal.flush()
+            return True
+
+    def pop(self) -> Request | None:
+        with self._lock:
+            for depth in sorted(self._depths):
+                q = self._depths[depth]
+                if q:
+                    req = q.popleft()
+                    self._size -= 1
+                    self._known.discard(req.urlhash())
+                    if not q:
+                        del self._depths[depth]
+                    if self._journal:
+                        self._journal.write(json.dumps(
+                            {"op": "pop", "url": req.url}) + "\n")
+                        self._journal.flush()
+                    return req
+            return None
+
+    def __len__(self) -> int:
+        return self._size
+
+    def close(self) -> None:
+        with self._lock:
+            if self._journal:
+                self._journal.close()
+                # compact: rewrite only alive entries
+                reqs = [r for d in sorted(self._depths)
+                        for r in self._depths[d]]
+                with open(self._journal_path, "w", encoding="utf-8") as f:
+                    for r in reqs:
+                        f.write(json.dumps(
+                            {"op": "push", "req": r.to_dict()}) + "\n")
+                self._journal = None
+
+
+class HostBalancer:
+    """Round-robin over host queues weighted by politeness cool-down
+    (HostBalancer.java:341-532 semantics: prefer hosts whose wait is 0,
+    skip sleeping hosts, never starve)."""
+
+    def __init__(self, latency: Latency | None = None,
+                 data_dir: str | None = None):
+        self.latency = latency or Latency()
+        self.data_dir = data_dir
+        self._queues: dict[str, HostQueue] = {}
+        self._rr: deque[str] = deque()
+        self._lock = threading.Lock()
+
+    def push(self, req: Request) -> bool:
+        hk = host_key(req.url)
+        with self._lock:
+            q = self._queues.get(hk)
+            if q is None:
+                q = self._queues[hk] = HostQueue(hk, self.data_dir)
+                self._rr.append(hk)
+        return q.push(req)
+
+    def pop(self) -> tuple[Request | None, float]:
+        """(request, suggested_sleep_s). request None when all hosts are
+        cooling down (sleep>0) or the frontier is empty (sleep==0)."""
+        with self._lock:
+            n = len(self._rr)
+            if n == 0:
+                return None, 0.0
+            best_wait = float("inf")
+            for _ in range(n):
+                hk = self._rr[0]
+                self._rr.rotate(-1)
+                q = self._queues.get(hk)
+                if q is None or len(q) == 0:
+                    continue
+                host = hk.replace("_", ":")
+                wait = self.latency.waiting_remaining_s(host)
+                if wait <= 0.0:
+                    req = q.pop()
+                    if req is not None:
+                        return req, 0.0
+                else:
+                    best_wait = min(best_wait, wait)
+            if best_wait != float("inf"):
+                return None, best_wait
+            return None, 0.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def has_url(self, url: str) -> bool:
+        hk = host_key(url)
+        with self._lock:
+            q = self._queues.get(hk)
+        if q is None:
+            return False
+        h = Request(url).urlhash()
+        with q._lock:
+            return h in q._known
+
+    def close(self) -> None:
+        with self._lock:
+            for q in self._queues.values():
+                q.close()
+
+
+class StackType:
+    LOCAL = "local"
+    GLOBAL = "global"
+    REMOTE = "remote"
+    NOLOAD = "noload"
+
+
+class NoticedURL:
+    """The four-stack frontier facade (NoticedURL.java): LOCAL for our own
+    crawls, GLOBAL for urls destined for other peers' crawl delegation,
+    REMOTE for urls other peers asked us to crawl, NOLOAD for urls whose
+    metadata is indexed without fetching."""
+
+    def __init__(self, latency: Latency | None = None,
+                 data_dir: str | None = None):
+        self.latency = latency or Latency()
+        sub = (lambda s: os.path.join(data_dir, s)) if data_dir else (
+            lambda s: None)
+        self.stacks: dict[str, HostBalancer] = {
+            s: HostBalancer(self.latency, sub(s))
+            for s in (StackType.LOCAL, StackType.GLOBAL, StackType.REMOTE,
+                      StackType.NOLOAD)}
+
+    def push(self, stack: str, req: Request) -> bool:
+        return self.stacks[stack].push(req)
+
+    def pop(self, stack: str) -> tuple[Request | None, float]:
+        return self.stacks[stack].pop()
+
+    def size(self, stack: str) -> int:
+        return len(self.stacks[stack])
+
+    def exists_in_any(self, url: str) -> bool:
+        return any(b.has_url(url) for b in self.stacks.values())
+
+    def close(self) -> None:
+        for b in self.stacks.values():
+            b.close()

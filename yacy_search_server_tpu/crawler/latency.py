@@ -1,0 +1,77 @@
+"""Per-host politeness — measured latency drives the crawl delay.
+
+Capability equivalent of the reference's latency model (reference:
+source/net/yacy/crawler/data/Latency.java:43,149): per-host record of
+measured fetch times, last-access timestamp, and robots crawl-delay; the
+frontier asks `waiting_remaining(host)` before popping a url for that
+host and skips hosts still in their cool-down.
+
+Delay model (Latency.waitingRemainingGuessed semantics): the politeness
+delay is max(minimum_delta, robots crawl-delay, flux-factor * average
+fetch time), counted from the last access.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+MIN_DELTA_S = 0.5          # minimumLocalDelta analog (intranet: lower)
+MAX_DELAY_S = 30.0         # never wait longer than this
+FLUX_FACTOR = 1.5          # multiple of avg fetch time to wait
+
+
+@dataclass
+class HostStats:
+    count: int = 0
+    time_sum_s: float = 0.0
+    last_access_s: float = 0.0
+    robots_delay_s: float = 0.0
+    dns_s: float = 0.0
+
+    @property
+    def average_s(self) -> float:
+        return self.time_sum_s / self.count if self.count else 0.0
+
+
+class Latency:
+    def __init__(self, min_delta_s: float = MIN_DELTA_S):
+        self.min_delta_s = min_delta_s
+        self._hosts: dict[str, HostStats] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, host: str) -> HostStats:
+        with self._lock:
+            st = self._hosts.get(host)
+            if st is None:
+                st = self._hosts[host] = HostStats()
+            return st
+
+    def update_after_load(self, host: str, elapsed_s: float) -> None:
+        st = self._get(host)
+        with self._lock:
+            st.count += 1
+            st.time_sum_s += elapsed_s
+            st.last_access_s = time.time()
+
+    def update_robots_delay(self, host: str, delay_s: float) -> None:
+        self._get(host).robots_delay_s = min(delay_s, MAX_DELAY_S)
+
+    def wanted_delay_s(self, host: str) -> float:
+        st = self._get(host)
+        delay = max(self.min_delta_s, st.robots_delay_s,
+                    FLUX_FACTOR * st.average_s)
+        return min(delay, MAX_DELAY_S)
+
+    def waiting_remaining_s(self, host: str) -> float:
+        """Seconds until `host` may be accessed again (0 = now)."""
+        st = self._get(host)
+        if st.last_access_s == 0.0:
+            return 0.0
+        due = st.last_access_s + self.wanted_delay_s(host)
+        return max(0.0, due - time.time())
+
+    def snapshot(self) -> dict[str, HostStats]:
+        with self._lock:
+            return dict(self._hosts)

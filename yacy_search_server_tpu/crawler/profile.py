@@ -1,0 +1,117 @@
+"""Crawl profiles — per-crawl configuration and URL admission patterns.
+
+Capability equivalent of the reference's CrawlProfile (reference:
+source/net/yacy/crawler/data/CrawlProfile.java): must(not)match regexes
+for crawling and indexing, depth, recrawl age, per-domain page limit,
+index/store flags, agent, collections. Profiles serialize to plain dicts
+(the reference stores them row-encoded in a MapHeap; here the profile
+registry persists them as json — crawler/switchboard.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import time
+from dataclasses import asdict, dataclass, field
+
+MATCH_ALL = ".*"
+MATCH_NEVER = ""
+
+
+def _compile(pattern: str):
+    if pattern in ("", None):
+        return None
+    return re.compile(pattern)
+
+
+@dataclass
+class CrawlProfile:
+    name: str
+    start_url: str = ""
+    depth: int = 0
+    crawler_url_must_match: str = MATCH_ALL
+    crawler_url_must_not_match: str = MATCH_NEVER
+    indexing_url_must_match: str = MATCH_ALL
+    indexing_url_must_not_match: str = MATCH_NEVER
+    recrawl_if_older_s: int = -1          # -1: never re-load known urls
+    domain_max_pages: int = -1            # -1: unlimited
+    crawling_q: bool = True               # allow urls with query strings
+    follow_frames: bool = True
+    obey_html_robots_noindex: bool = True
+    index_text: bool = True
+    index_media: bool = True
+    store_ht_cache: bool = True
+    remote_indexing: bool = False         # push discovered urls to peers
+    snapshot_depth: int = -1
+    agent_name: str = "yacy-tpu"
+    collections: tuple[str, ...] = ("user",)
+    handle: str = ""
+    created_s: float = field(default_factory=time.time)
+
+    def __post_init__(self):
+        if not self.handle:
+            seed = f"{self.name}|{self.start_url}|{self.created_s}"
+            self.handle = hashlib.sha1(seed.encode()).hexdigest()[:12]
+        self._cm = _compile(self.crawler_url_must_match)
+        self._cn = _compile(self.crawler_url_must_not_match)
+        self._im = _compile(self.indexing_url_must_match)
+        self._in = _compile(self.indexing_url_must_not_match)
+
+    # -- admission ----------------------------------------------------------
+
+    def crawl_allowed(self, url: str) -> bool:
+        if not self.crawling_q and "?" in url:
+            return False
+        if self._cm is not None and not self._cm.search(url):
+            return False
+        if self._cn is not None and self._cn.search(url):
+            return False
+        return True
+
+    def index_allowed(self, url: str) -> bool:
+        if self._im is not None and not self._im.search(url):
+            return False
+        if self._in is not None and self._in.search(url):
+            return False
+        return True
+
+    def recrawl_due(self, last_seen_s: float | None) -> bool:
+        """Should a url already in the index be loaded again?"""
+        if last_seen_s is None:
+            return True
+        if self.recrawl_if_older_s < 0:
+            return False
+        return (time.time() - last_seen_s) > self.recrawl_if_older_s
+
+    # -- persistence --------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["collections"] = list(self.collections)
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "CrawlProfile":
+        d = dict(d)
+        d["collections"] = tuple(d.get("collections", ("user",)))
+        return CrawlProfile(**d)
+
+
+def default_profiles() -> dict[str, CrawlProfile]:
+    """The reference's built-in profile set (CrawlSwitchboard defaults)."""
+    defaults = {
+        "snippetLocalText": CrawlProfile(
+            "snippetLocalText", depth=0, index_text=True, index_media=True,
+            store_ht_cache=True),
+        "snippetGlobalText": CrawlProfile(
+            "snippetGlobalText", depth=0, index_text=True, index_media=True,
+            recrawl_if_older_s=30 * 24 * 3600),
+        "remote": CrawlProfile(
+            "remote", depth=0, index_text=True, index_media=True,
+            remote_indexing=False),
+        "surrogate": CrawlProfile(
+            "surrogate", depth=0, index_text=True, index_media=True,
+            store_ht_cache=False),
+    }
+    return defaults

@@ -1,0 +1,127 @@
+"""CrawlQueues — the busy-thread crawl jobs and the error cache.
+
+Capability equivalent of the reference's crawl driver (reference:
+source/net/yacy/crawler/data/CrawlQueues.java:73-460: `coreCrawlJob`
+pulls from the frontier into loader worker threads, robots re-checks,
+error-cache bookkeeping; remote-crawl jobs arrive in M5's peer layer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ..utils.eventtracker import EClass, StageTimer
+from .frontier import NoticedURL, StackType
+from .loader import CacheStrategy, LoaderDispatcher
+from .profile import CrawlProfile
+from .request import Request, Response
+
+
+class ErrorCache:
+    """Failed-url store for the crawl monitor (reference:
+    source/net/yacy/search/index/ErrorCache.java — Solr-backed there,
+    bounded in-RAM map with the same (url, reason, ts) surface here)."""
+
+    def __init__(self, max_entries: int = 1000):
+        self.max_entries = max_entries
+        self._entries: dict[bytes, tuple[str, str, float]] = {}
+        self._lock = threading.Lock()
+
+    def push(self, urlhash: bytes, url: str, reason: str) -> None:
+        with self._lock:
+            self._entries[urlhash] = (url, reason, time.time())
+            while len(self._entries) > self.max_entries:
+                self._entries.pop(next(iter(self._entries)))
+
+    def has(self, urlhash: bytes) -> bool:
+        with self._lock:
+            return urlhash in self._entries
+
+    def recent(self, n: int = 100) -> list[tuple[str, str, float]]:
+        with self._lock:
+            return list(self._entries.values())[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class CrawlQueues:
+    def __init__(self, noticed: NoticedURL, loader: LoaderDispatcher,
+                 profiles: dict[str, CrawlProfile], robots=None,
+                 indexer=None, workers: int = 4):
+        self.noticed = noticed
+        self.loader = loader
+        self.profiles = profiles
+        self.robots = robots
+        self.indexer = indexer          # callable(Response, CrawlProfile)
+        self.error_cache = ErrorCache()
+        self.pool = ThreadPoolExecutor(max_workers=workers,
+                                       thread_name_prefix="crawl-worker")
+        self.loaded = 0
+        self._open = True
+        self._lock = threading.Lock()
+
+    # -- the busy-thread job (CrawlQueues.coreCrawlJob) ---------------------
+
+    def core_crawl_job(self, stack: str = StackType.LOCAL) -> bool:
+        """Pop one url and schedule its load; True if work was done."""
+        req, _sleep = self.noticed.pop(stack)
+        if req is None:
+            return False
+        self.pool.submit(self._load_and_index, req)
+        return True
+
+    def _load_and_index(self, req: Request) -> None:
+        profile = self.profiles.get(req.profile_handle)
+        if profile is None:
+            self.error_cache.push(req.urlhash(), req.url, "unknown profile")
+            return
+        try:
+            with StageTimer(EClass.CRAWL, "load", 1):
+                if self.robots is not None and \
+                        not self.robots.is_allowed(req.url):
+                    self.error_cache.push(req.urlhash(), req.url,
+                                          "robots disallow")
+                    return
+                strategy = (CacheStrategy.IFFRESH
+                            if profile.recrawl_if_older_s >= 0
+                            else CacheStrategy.IFEXIST)
+                resp = self.loader.load(req, strategy)
+            if resp.status != 200:
+                self.error_cache.push(
+                    req.urlhash(), req.url,
+                    resp.headers.get("x-error", f"status {resp.status}"))
+                return
+            with self._lock:
+                self.loaded += 1
+            if self.indexer is not None:
+                self.indexer(resp, profile)
+        except Exception as e:       # worker threads must never die silently
+            self.error_cache.push(req.urlhash(), req.url,
+                                  f"{type(e).__name__}: {e}")
+
+    def drain(self, stack: str = StackType.LOCAL,
+              max_urls: int = 10_000, timeout_s: float = 60.0) -> int:
+        """Synchronously crawl until the stack is empty (test/CLI path)."""
+        t_end = time.time() + timeout_s
+        n = 0
+        while time.time() < t_end and n < max_urls:
+            req, sleep_s = self.noticed.pop(stack)
+            if req is None:
+                if sleep_s <= 0:
+                    break
+                time.sleep(min(sleep_s, 0.2))
+                continue
+            self._load_and_index(req)
+            n += 1
+        return n
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._open:
+                return
+            self._open = False
+        self.pool.shutdown(wait=True)

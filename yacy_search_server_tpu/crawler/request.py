@@ -1,0 +1,96 @@
+"""Crawl work items — Request (frontier entry) and Response (fetch result).
+
+Capability equivalent of the reference's crawl entry pair (reference:
+source/net/yacy/crawler/retrieval/Request.java and Response.java): the
+request is the serializable frontier row (url, referrer, anchor name,
+depth, profile handle, scheduling info); the response couples it with
+fetch outcome and decides document type and indexability.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from urllib.parse import urlsplit
+
+from ..utils.hashes import url2hash
+
+
+@dataclass
+class Request:
+    url: str
+    profile_handle: str = ""
+    referrer_hash: bytes = b""
+    name: str = ""                 # anchor text that discovered the url
+    depth: int = 0
+    appdate_s: float = field(default_factory=time.time)
+
+    def urlhash(self) -> bytes:
+        return url2hash(self.url)
+
+    @property
+    def host(self) -> str:
+        return urlsplit(self.url).netloc.lower()
+
+    def to_dict(self) -> dict:
+        return {"url": self.url, "profile_handle": self.profile_handle,
+                "referrer_hash": self.referrer_hash.decode("ascii", "replace"),
+                "name": self.name, "depth": self.depth,
+                "appdate_s": self.appdate_s}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Request":
+        return Request(url=d["url"], profile_handle=d.get("profile_handle", ""),
+                       referrer_hash=d.get("referrer_hash", "").encode("ascii"),
+                       name=d.get("name", ""), depth=int(d.get("depth", 0)),
+                       appdate_s=float(d.get("appdate_s", 0.0)))
+
+
+# mime prefixes that the parser registry can turn into indexable documents
+_INDEXABLE_MIME_PREFIXES = (
+    "text/", "application/xhtml", "application/xml", "application/rss",
+    "application/atom", "application/json", "application/pdf",
+    "application/zip", "application/gzip", "application/x-tar",
+    "application/warc",
+)
+
+
+@dataclass
+class Response:
+    request: Request
+    status: int = 200
+    headers: dict = field(default_factory=dict)
+    content: bytes = b""
+    from_cache: bool = False
+    fetch_time_s: float = 0.0
+
+    @property
+    def url(self) -> str:
+        return self.request.url
+
+    def mime_type(self) -> str:
+        ct = self.headers.get("content-type", "") or self.headers.get(
+            "Content-Type", "")
+        return ct.split(";", 1)[0].strip().lower()
+
+    def charset(self) -> str | None:
+        ct = self.headers.get("content-type", "") or self.headers.get(
+            "Content-Type", "")
+        for part in ct.split(";")[1:]:
+            k, _, v = part.strip().partition("=")
+            if k.lower() == "charset":
+                return v.strip("'\" ").lower() or None
+        return None
+
+    def indexable(self) -> str | None:
+        """None if indexable, else the denial reason (Response.shallIndex
+        semantics)."""
+        if self.status != 200:
+            return f"bad status {self.status}"
+        if not self.content:
+            return "empty content"
+        mime = self.mime_type()
+        if mime and not any(mime.startswith(p)
+                            for p in _INDEXABLE_MIME_PREFIXES):
+            return f"unindexable mime {mime}"
+        return None

@@ -1,0 +1,109 @@
+"""CrawlStacker — admission control for discovered URLs.
+
+Capability equivalent of the reference's stacker (reference:
+source/net/yacy/crawler/CrawlStacker.java:65-415: the WorkflowTask that
+checks every discovered url — protocol support, profile match, depth,
+double-occurrence against frontier and index, recrawl age — then routes
+it to the LOCAL / GLOBAL / NOLOAD frontier stack; GLOBAL urls are the
+DHT-vertical-partition remote-crawl delegation path).
+"""
+
+from __future__ import annotations
+
+import time
+from urllib.parse import urlsplit
+
+from ..utils.eventtracker import EClass, StageTimer
+from .frontier import NoticedURL, StackType
+from .profile import CrawlProfile
+from .request import Request
+
+SUPPORTED_SCHEMES = {"http", "https", "file"}
+
+
+class CrawlStacker:
+    def __init__(self, noticed: NoticedURL, profiles: dict[str, CrawlProfile],
+                 segment=None, blacklist=None, robots=None,
+                 accept_global: bool = False):
+        self.noticed = noticed
+        self.profiles = profiles
+        self.segment = segment          # index/segment.Segment (url dedup)
+        self.blacklist = blacklist      # callable(url) -> str | None reason
+        self.robots = robots            # robots.RobotsTxt
+        self.accept_global = accept_global
+        self.stacked = 0
+        self.rejected: dict[str, int] = {}
+
+    def _reject(self, reason: str) -> str:
+        self.rejected[reason] = self.rejected.get(reason, 0) + 1
+        return reason
+
+    # -- checks (checkAcceptanceInitially / checkAcceptanceChangeable) ------
+
+    def check_acceptance(self, req: Request,
+                         profile: CrawlProfile) -> str | None:
+        url = req.url
+        parts = urlsplit(url)
+        if parts.scheme.lower() not in SUPPORTED_SCHEMES:
+            return self._reject(f"unsupported scheme {parts.scheme}")
+        if not parts.netloc and parts.scheme.lower() != "file":
+            return self._reject("no host")
+        if len(url) > 2048:
+            return self._reject("url too long")
+        if req.depth > profile.depth:
+            return self._reject("depth limit")
+        if not profile.crawl_allowed(url):
+            return self._reject("profile must(not)match")
+        if self.blacklist is not None:
+            reason = self.blacklist(url)
+            if reason:
+                return self._reject(f"blacklisted: {reason}")
+        if self.noticed.exists_in_any(url):
+            return self._reject("already in frontier")
+        if self.segment is not None:
+            from ..utils.hashes import url2hash
+            meta = self.segment.metadata.get_by_urlhash(url2hash(url))
+            if meta is not None:
+                days = meta.get("load_date_days_i")
+                last_s = days * 86400.0 if days else None
+                if not profile.recrawl_due(last_s):
+                    return self._reject("already indexed, not due")
+        if self.robots is not None and not self.robots.is_allowed(url):
+            return self._reject("robots disallow")
+        return None
+
+    # -- stacking -----------------------------------------------------------
+
+    def stack(self, req: Request) -> str | None:
+        """Admit one url; returns None on success else rejection reason."""
+        with StageTimer(EClass.CRAWL, "stackCrawl", 1):
+            profile = self.profiles.get(req.profile_handle)
+            if profile is None:
+                return self._reject("unknown profile")
+            reason = self.check_acceptance(req, profile)
+            if reason:
+                return reason
+            # routing (CrawlStacker.stackCrawl: local vs global): urls for
+            # other peers' DHT ranges go GLOBAL when remote indexing is on
+            stack = StackType.LOCAL
+            if profile.remote_indexing and self.accept_global \
+                    and req.depth > 0:
+                stack = StackType.GLOBAL
+            self.noticed.push(stack, req)
+            self.stacked += 1
+            return None
+
+    def enqueue_entries(self, anchors, source_urlhash: bytes,
+                        profile_handle: str, depth: int) -> int:
+        """Stack every hyperlink discovered in a parsed document
+        (CrawlStacker.enqueueEntries)."""
+        n = 0
+        for a in anchors:
+            url = a.url if hasattr(a, "url") else str(a)
+            name = getattr(a, "text", "")
+            req = Request(url=url, profile_handle=profile_handle,
+                          referrer_hash=source_urlhash, name=name,
+                          depth=depth)
+            if self.stack(req) is None:
+                n += 1
+        return n

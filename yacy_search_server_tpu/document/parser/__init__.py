@@ -1,0 +1,10 @@
+"""Parser zoo — MIME/extension-dispatched parsers producing Documents.
+
+Capability equivalent of the reference's TextParser registry (reference:
+source/net/yacy/document/TextParser.java:78-95+ registering ~30 parsers,
+archive recursion, `parseSource` entry). `parse_source(url, mime, content)`
+dispatches on mime then extension, recurses into archives, and returns a
+list of normalized Documents (document/document.py).
+"""
+
+from .registry import ParserError, parse_source, supported_mime, supports

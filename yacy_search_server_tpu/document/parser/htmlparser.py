@@ -1,0 +1,220 @@
+"""HTML parser — streaming content scraper.
+
+Capability equivalent of the reference's html parser (reference:
+source/net/yacy/document/parser/htmlParser.java and
+document/parser/html/ContentScraper.java): extract title, headline
+sections, meta description/keywords/robots, canonical + base href, anchors
+with text, images with alt, visible text with script/style skipped,
+charset detection (http header, meta, BOM), html lang, and geo position
+from meta tags.
+"""
+
+from __future__ import annotations
+
+import re
+from html import unescape
+from html.parser import HTMLParser
+from urllib.parse import urljoin
+
+from ..document import Anchor, Document, Image
+
+_CHARSET_META_RE = re.compile(
+    rb"""<meta[^>]+charset\s*=\s*["']?([\w-]+)""", re.IGNORECASE)
+_WS_RE = re.compile(r"\s+")
+
+_IGNORE_CONTENT = {"script", "style", "noscript", "template"}
+_SECTION_TAGS = {"h1", "h2", "h3", "h4", "h5", "h6"}
+_MEDIA_EXT_AUDIO = {"mp3", "ogg", "oga", "flac", "wav", "m4a", "aac"}
+_MEDIA_EXT_VIDEO = {"mp4", "webm", "mkv", "avi", "mov", "mpg", "mpeg", "m4v"}
+_MEDIA_EXT_APP = {"apk", "exe", "msi", "dmg", "jar", "deb", "rpm", "zip",
+                  "tar", "gz", "7z"}
+
+
+class ContentScraper(HTMLParser):
+    def __init__(self, base_url: str):
+        super().__init__(convert_charrefs=True)
+        self.base_url = base_url
+        self.title_parts: list[str] = []
+        self.sections: list[str] = []
+        self.text_parts: list[str] = []
+        self.anchors: list[Anchor] = []
+        self.images: list[Image] = []
+        self.meta: dict[str, str] = {}
+        self.lang = ""
+        self.canonical = ""
+        self.favicon = ""
+        self._base = base_url
+        self._in_title = False
+        self._section_stack: list[list[str]] = []
+        self._ignore_depth = 0
+        self._cur_anchor: Anchor | None = None
+        self._cur_anchor_text: list[str] = []
+        self.embeds: list[str] = []       # audio/video/app media links
+
+    # -- tag handling --------------------------------------------------------
+
+    def handle_starttag(self, tag, attrs):
+        a = dict(attrs)
+        if tag in _IGNORE_CONTENT:
+            self._ignore_depth += 1
+            return
+        if tag == "html" and a.get("lang"):
+            self.lang = a["lang"][:2].lower()
+        elif tag == "base" and a.get("href"):
+            self._base = urljoin(self.base_url, a["href"])
+        elif tag == "title":
+            self._in_title = True
+        elif tag in _SECTION_TAGS:
+            self._section_stack.append([])
+        elif tag == "meta":
+            name = (a.get("name") or a.get("property") or "").lower()
+            if name and a.get("content") is not None:
+                self.meta[name] = a["content"]
+            if a.get("http-equiv", "").lower() == "content-type":
+                self.meta.setdefault("content-type", a.get("content", ""))
+        elif tag == "link":
+            rel = a.get("rel", "").lower()
+            href = a.get("href", "")
+            if href:
+                if "canonical" in rel:
+                    self.canonical = urljoin(self._base, href)
+                elif "icon" in rel:
+                    self.favicon = urljoin(self._base, href)
+        elif tag == "a":
+            href = a.get("href", "")
+            if href and not href.startswith(("javascript:", "#", "mailto:",
+                                            "data:")):
+                self._cur_anchor = Anchor(urljoin(self._base, href),
+                                          rel=a.get("rel", ""))
+                self._cur_anchor_text = []
+        elif tag == "img":
+            src = a.get("src", "")
+            if src and not src.startswith("data:"):
+                def _int(v):
+                    try:
+                        return int(v)
+                    except (TypeError, ValueError):
+                        return 0
+                self.images.append(Image(urljoin(self._base, src),
+                                         alt=a.get("alt", ""),
+                                         width=_int(a.get("width")),
+                                         height=_int(a.get("height"))))
+        elif tag in ("audio", "video", "source", "embed", "object"):
+            src = a.get("src") or a.get("data") or ""
+            if src:
+                self.embeds.append(urljoin(self._base, src))
+        elif tag in ("frame", "iframe"):
+            src = a.get("src", "")
+            if src:
+                self.anchors.append(Anchor(urljoin(self._base, src),
+                                           text="", rel="frame"))
+        elif tag in ("br", "p", "div", "li", "td", "tr"):
+            self.text_parts.append(" ")
+
+    def handle_endtag(self, tag):
+        if tag in _IGNORE_CONTENT:
+            self._ignore_depth = max(0, self._ignore_depth - 1)
+            return
+        if tag == "title":
+            self._in_title = False
+        elif tag in _SECTION_TAGS and self._section_stack:
+            text = _WS_RE.sub(" ", " ".join(self._section_stack.pop())).strip()
+            if text:
+                self.sections.append(text)
+        elif tag == "a" and self._cur_anchor is not None:
+            self._cur_anchor.text = _WS_RE.sub(
+                " ", " ".join(self._cur_anchor_text)).strip()[:500]
+            self.anchors.append(self._cur_anchor)
+            self._cur_anchor = None
+            self._cur_anchor_text = []
+
+    def handle_data(self, data):
+        if self._ignore_depth:
+            return
+        if self._in_title:
+            self.title_parts.append(data)
+            return
+        if self._section_stack:
+            self._section_stack[-1].append(data)
+        if self._cur_anchor is not None:
+            self._cur_anchor_text.append(data)
+        self.text_parts.append(data)
+
+
+def _detect_charset(content: bytes, header_charset: str | None) -> str:
+    if header_charset:
+        return header_charset
+    if content.startswith(b"\xef\xbb\xbf"):
+        return "utf-8"
+    if content.startswith((b"\xff\xfe", b"\xfe\xff")):
+        return "utf-16"
+    m = _CHARSET_META_RE.search(content[:4096])
+    if m:
+        return m.group(1).decode("ascii", "replace").lower()
+    return "utf-8"
+
+
+def parse_html(url: str, content: bytes,
+               charset: str | None = None) -> list[Document]:
+    cs = _detect_charset(content, charset)
+    try:
+        html = content.decode(cs, "replace")
+    except LookupError:
+        html = content.decode("utf-8", "replace")
+        cs = "utf-8"
+    scraper = ContentScraper(url)
+    try:
+        scraper.feed(html)
+        scraper.close()
+    except Exception:
+        pass   # salvage whatever was scraped before the failure
+
+    text = _WS_RE.sub(" ", "".join(scraper.text_parts)).strip()
+    title = _WS_RE.sub(" ", "".join(scraper.title_parts)).strip()
+    robots = scraper.meta.get("robots", "").lower()
+    noindex = "noindex" in robots
+    nofollow = "nofollow" in robots
+
+    audio, video, apps = [], [], []
+    for link in scraper.embeds:
+        ext = link.rsplit(".", 1)[-1].lower() if "." in link else ""
+        if ext in _MEDIA_EXT_AUDIO:
+            audio.append(link)
+        elif ext in _MEDIA_EXT_VIDEO:
+            video.append(link)
+        elif ext in _MEDIA_EXT_APP:
+            apps.append(link)
+
+    lat = lon = 0.0
+    for key in ("geo.position", "icbm"):
+        if key in scraper.meta:
+            parts = re.split(r"[;,]", scraper.meta[key])
+            if len(parts) == 2:
+                try:
+                    lat, lon = float(parts[0]), float(parts[1])
+                except ValueError:
+                    pass
+            break
+
+    doc = Document(
+        url=scraper.canonical or url,
+        mime_type="text/html",
+        charset=cs,
+        title=title or scraper.meta.get("og:title", ""),
+        author=scraper.meta.get("author", ""),
+        description=scraper.meta.get("description",
+                                     scraper.meta.get("og:description", "")),
+        keywords=[k.strip() for k in
+                  scraper.meta.get("keywords", "").split(",") if k.strip()],
+        sections=scraper.sections,
+        text="" if noindex else text,
+        anchors=[] if nofollow else scraper.anchors,
+        images=scraper.images,
+        language=scraper.lang,
+        lat=lat, lon=lon,
+    )
+    doc.audio_links = audio
+    doc.video_links = video
+    doc.app_links = apps
+    doc.noindex = noindex
+    return [doc]

@@ -1,0 +1,111 @@
+"""PDF parser — pure-Python text extraction for Flate/plain streams.
+
+Capability equivalent of the reference's pdfParser (reference:
+source/net/yacy/document/parser/pdfParser.java, which delegates to
+pdfbox). No PDF library is baked into this image, so this is a minimal
+but real extractor: it walks PDF objects, inflates FlateDecode content
+streams, tokenizes text operators (Tj, TJ, '), unescapes PDF string
+literals, and pulls /Title /Author /Subject from the Info dictionary.
+Covers the common simple-generator PDFs (the fixture corpus); exotic
+encodings (CID fonts, encryption) degrade to empty text rather than
+erroring.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+
+from ..document import Document
+
+_STREAM_RE = re.compile(rb"stream\r?\n(.*?)\r?\nendstream", re.DOTALL)
+_INFO_FIELD_RE = {
+    "title": re.compile(rb"/Title\s*\((.*?)(?<!\\)\)", re.DOTALL),
+    "author": re.compile(rb"/Author\s*\((.*?)(?<!\\)\)", re.DOTALL),
+    "subject": re.compile(rb"/Subject\s*\((.*?)(?<!\\)\)", re.DOTALL),
+}
+# text-showing operators inside BT..ET blocks
+_TJ_RE = re.compile(rb"\((?:\\.|[^()\\])*\)\s*(?:Tj|')", re.DOTALL)
+_TJ_ARRAY_RE = re.compile(rb"\[((?:[^\[\]\\]|\\.)*?)\]\s*TJ", re.DOTALL)
+_STR_RE = re.compile(rb"\((?:\\.|[^()\\])*\)", re.DOTALL)
+
+_ESCAPES = {b"n": b"\n", b"r": b"\r", b"t": b"\t", b"b": b"\b",
+            b"f": b"\f", b"(": b"(", b")": b")", b"\\": b"\\"}
+
+
+def _unescape(raw: bytes) -> bytes:
+    out = bytearray()
+    i = 0
+    while i < len(raw):
+        c = raw[i:i + 1]
+        if c == b"\\" and i + 1 < len(raw):
+            nxt = raw[i + 1:i + 2]
+            if nxt in _ESCAPES:
+                out += _ESCAPES[nxt]
+                i += 2
+                continue
+            if nxt.isdigit():   # octal escape
+                j = i + 1
+                while j < len(raw) and j < i + 4 and raw[j:j + 1].isdigit():
+                    j += 1
+                try:
+                    out.append(int(raw[i + 1:j], 8) & 0xFF)
+                except ValueError:
+                    pass
+                i = j
+                continue
+            i += 2
+            continue
+        out += c
+        i += 1
+    return bytes(out)
+
+
+def _decode_pdf_text(raw: bytes) -> str:
+    if raw.startswith(b"\xfe\xff"):
+        try:
+            return raw[2:].decode("utf-16-be", "replace")
+        except Exception:
+            pass
+    return raw.decode("latin-1", "replace")
+
+
+def _extract_strings(stream: bytes) -> list[str]:
+    texts: list[str] = []
+    for m in _TJ_RE.finditer(stream):
+        s = _STR_RE.match(m.group(0))
+        if s:
+            texts.append(_decode_pdf_text(_unescape(s.group(0)[1:-1])))
+    for m in _TJ_ARRAY_RE.finditer(stream):
+        parts = [_decode_pdf_text(_unescape(s.group(0)[1:-1]))
+                 for s in _STR_RE.finditer(m.group(1))]
+        texts.append("".join(parts))
+    return texts
+
+
+def parse_pdf(url: str, content: bytes,
+              charset: str | None = None) -> list[Document]:
+    texts: list[str] = []
+    for m in _STREAM_RE.finditer(content):
+        data = m.group(1)
+        # try inflate; fall back to treating it as a plain content stream
+        for candidate in (data,):
+            try:
+                inflated = zlib.decompress(candidate)
+            except zlib.error:
+                inflated = candidate
+            if b"Tj" in inflated or b"TJ" in inflated:
+                texts.extend(_extract_strings(inflated))
+
+    meta = {}
+    for key, rx in _INFO_FIELD_RE.items():
+        m = rx.search(content)
+        if m:
+            meta[key] = _decode_pdf_text(_unescape(m.group(1))).strip()
+
+    text = " ".join(t for t in texts if t.strip())
+    return [Document(url=url, mime_type="application/pdf",
+                     title=meta.get("title", "") or text[:120],
+                     author=meta.get("author", ""),
+                     description=meta.get("subject", ""),
+                     text=text)]

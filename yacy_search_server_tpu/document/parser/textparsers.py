@@ -1,0 +1,92 @@
+"""Plain-text family parsers — txt, csv, json, vcf, torrent-ish.
+
+Capability equivalents of the reference's simple parsers (reference:
+source/net/yacy/document/parser/txtParser.java, csvParser.java,
+vcfParser.java — behavioral: decode, extract title/first line, full text).
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+
+from ..document import Document
+
+
+def _decode(content: bytes, charset: str | None) -> str:
+    for cs in (charset, "utf-8", "latin-1"):
+        if not cs:
+            continue
+        try:
+            return content.decode(cs)
+        except (UnicodeDecodeError, LookupError):
+            continue
+    return content.decode("utf-8", "replace")
+
+
+def parse_text(url: str, content: bytes,
+               charset: str | None = None) -> list[Document]:
+    text = _decode(content, charset)
+    first = text.strip().split("\n", 1)[0][:120]
+    return [Document(url=url, mime_type="text/plain", title=first,
+                     text=text)]
+
+
+def parse_csv(url: str, content: bytes,
+              charset: str | None = None) -> list[Document]:
+    raw = _decode(content, charset)
+    try:
+        rows = list(csv.reader(io.StringIO(raw)))
+    except csv.Error:
+        rows = [line.split(",") for line in raw.splitlines()]
+    text = "\n".join(" ".join(cell for cell in row) for row in rows)
+    title = " ".join(rows[0])[:120] if rows else ""
+    return [Document(url=url, mime_type="text/csv", title=title, text=text)]
+
+
+def _json_strings(obj, out: list[str]) -> None:
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.append(str(k))
+            _json_strings(v, out)
+    elif isinstance(obj, list):
+        for v in obj:
+            _json_strings(v, out)
+    elif isinstance(obj, str):
+        out.append(obj)
+    elif obj is not None:
+        out.append(str(obj))
+
+
+def parse_json(url: str, content: bytes,
+               charset: str | None = None) -> list[Document]:
+    try:
+        obj = json.loads(_decode(content, charset))
+    except json.JSONDecodeError:
+        return parse_text(url, content, charset)
+    strings: list[str] = []
+    _json_strings(obj, strings)
+    title = ""
+    if isinstance(obj, dict):
+        for key in ("title", "name", "id"):
+            if isinstance(obj.get(key), str):
+                title = obj[key]
+                break
+    return [Document(url=url, mime_type="application/json", title=title,
+                     text=" ".join(strings))]
+
+
+def parse_vcf(url: str, content: bytes,
+              charset: str | None = None) -> list[Document]:
+    raw = _decode(content, charset)
+    names, lines = [], []
+    for line in raw.splitlines():
+        key, _, value = line.partition(":")
+        key = key.split(";", 1)[0].upper()
+        if key in ("FN", "N"):
+            names.append(value.replace(";", " ").strip())
+        if key not in ("BEGIN", "END", "VERSION") and value:
+            lines.append(value.replace(";", " ").strip())
+    return [Document(url=url, mime_type="text/vcard",
+                     title=names[0] if names else "", text=" ".join(lines))]

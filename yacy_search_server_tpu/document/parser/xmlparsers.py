@@ -1,0 +1,111 @@
+"""XML-family parsers — generic XML, RSS/Atom feeds, sitemaps.
+
+Capability equivalents of the reference's XML parsers (reference:
+source/net/yacy/document/parser/GenericXMLParser.java, rssParser.java
+via cora/document/feed, and crawler/retrieval/SitemapImporter.java):
+generic XML extracts all character data; rss/atom produce one Document
+per item with link anchors; sitemap parsing yields the url list for the
+crawler.
+"""
+
+from __future__ import annotations
+
+import re
+import xml.etree.ElementTree as ET
+
+from ..document import Anchor, Document
+
+_WS_RE = re.compile(r"\s+")
+
+
+def _localname(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1].lower()
+
+
+def _parse_tree(content: bytes) -> ET.Element | None:
+    try:
+        return ET.fromstring(content)
+    except ET.ParseError:
+        return None
+
+
+def parse_generic_xml(url: str, content: bytes,
+                      charset: str | None = None) -> list[Document]:
+    root = _parse_tree(content)
+    if root is None:
+        from .textparsers import parse_text
+        return parse_text(url, content, charset)
+    texts: list[str] = []
+    for el in root.iter():
+        if el.text and el.text.strip():
+            texts.append(el.text.strip())
+        if el.tail and el.tail.strip():
+            texts.append(el.tail.strip())
+    text = _WS_RE.sub(" ", " ".join(texts))
+    return [Document(url=url, mime_type="application/xml",
+                     title=text[:120], text=text)]
+
+
+def is_feed(content: bytes) -> bool:
+    head = content[:512].lstrip()
+    return (b"<rss" in head or b"<feed" in head or b"<rdf:RDF" in head)
+
+
+def parse_feed(url: str, content: bytes,
+               charset: str | None = None) -> list[Document]:
+    """RSS 2.0 / Atom -> one Document per entry (rssParser semantics)."""
+    root = _parse_tree(content)
+    if root is None:
+        return []
+    docs: list[Document] = []
+    channel_title = ""
+    items = []
+    for el in root.iter():
+        ln = _localname(el.tag)
+        if ln in ("item", "entry"):
+            items.append(el)
+        elif ln == "title" and not items and not channel_title:
+            channel_title = (el.text or "").strip()
+    for item in items:
+        title = link = desc = author = date = ""
+        for el in item.iter():
+            ln = _localname(el.tag)
+            txt = (el.text or "").strip()
+            if ln == "title" and not title:
+                title = txt
+            elif ln == "link" and not link:
+                link = txt or el.get("href", "")
+            elif ln in ("description", "summary", "content") and not desc:
+                desc = re.sub(r"<[^>]+>", " ", txt)
+            elif ln in ("author", "creator") and not author:
+                author = txt
+            elif ln in ("pubdate", "published", "updated", "date") and not date:
+                date = txt
+        docs.append(Document(
+            url=link or url, mime_type="text/html", title=title,
+            description=_WS_RE.sub(" ", desc).strip(),
+            author=author,
+            text=_WS_RE.sub(" ", f"{title} {desc}").strip(),
+            anchors=[Anchor(link, text=title)] if link else []))
+    if not docs:
+        docs = [Document(url=url, mime_type="application/rss+xml",
+                         title=channel_title, text=channel_title)]
+    return docs
+
+
+def parse_sitemap(content: bytes) -> tuple[list[str], list[str]]:
+    """(page urls, nested sitemap urls) from urlset/sitemapindex."""
+    root = _parse_tree(content)
+    if root is None:
+        return [], []
+    pages, nested = [], []
+    root_ln = _localname(root.tag)
+    for loc in root.iter():
+        if _localname(loc.tag) != "loc" or not loc.text:
+            continue
+        u = loc.text.strip()
+        if root_ln == "sitemapindex":
+            nested.append(u)
+        else:
+            pages.append(u)
+    return pages, nested

@@ -190,21 +190,43 @@ def _masked_minmax(feats: jnp.ndarray, valid: jnp.ndarray):
     return col_min, col_max
 
 
-def cardinal_scores(feats: jnp.ndarray, valid: jnp.ndarray,
-                    hostids: jnp.ndarray, norm_coeffs: jnp.ndarray,
-                    flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
-                    domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
-                    language_coeff: jnp.ndarray, authority_coeff: jnp.ndarray,
-                    language_pref: jnp.ndarray) -> jnp.ndarray:
-    """int32 cardinal score per posting row (invalid rows score MIN).
+def local_stats(feats: jnp.ndarray, valid: jnp.ndarray, hostids: jnp.ndarray,
+                num_hosts: int) -> dict:
+    """Per-block normalization statistics (pure shard-local reduces).
 
-    Vectorized ReferenceOrder.cardinal (ReferenceOrder.java:223-265):
-    every `(x-min)<<8 / (max-min) << coeff` term becomes a masked column
-    op; the authority signal's ConcurrentScoreMap of host counts
-    (ReferenceOrder.java:213-216) becomes a segment-sum over hostids.
+    Returned stats combine across shards with (min, max, min, max, sum):
+    the sharded path (parallel/mesh.py) runs this per doc-shard, merges via
+    lax.pmin/pmax/psum over the mesh axis, and feeds the merged stats to
+    `cardinal_from_stats` — bitwise identical to the single-device path.
     """
-    n = feats.shape[0]
     col_min, col_max = _masked_minmax(feats, valid)
+    tfv = _term_frequency(feats)
+    tf_min = jnp.min(jnp.where(valid, tfv, jnp.inf))
+    tf_max = jnp.max(jnp.where(valid, tfv, -jnp.inf))
+    host_counts = jax.ops.segment_sum(valid.astype(jnp.int32), hostids,
+                                      num_segments=num_hosts)
+    return {"col_min": col_min, "col_max": col_max,
+            "tf_min": tf_min, "tf_max": tf_max, "host_counts": host_counts}
+
+
+def _term_frequency(feats: jnp.ndarray) -> jnp.ndarray:
+    """hitcount / (wordsintext + wordsintitle + 1)
+    (WordReferenceVars.termFrequency semantics)."""
+    return feats[:, P.F_HITCOUNT].astype(jnp.float32) / (
+        feats[:, P.F_WORDS_IN_TEXT] + feats[:, P.F_WORDS_IN_TITLE] + 1
+    ).astype(jnp.float32)
+
+
+def cardinal_from_stats(feats: jnp.ndarray, valid: jnp.ndarray,
+                        hostids: jnp.ndarray, stats: dict,
+                        norm_coeffs: jnp.ndarray,
+                        flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
+                        domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
+                        language_coeff: jnp.ndarray,
+                        authority_coeff: jnp.ndarray,
+                        language_pref: jnp.ndarray) -> jnp.ndarray:
+    """Score rows against precomputed (possibly cross-shard) statistics."""
+    col_min, col_max = stats["col_min"], stats["col_max"]
     span = col_max - col_min
     safe_span = jnp.maximum(span, 1)
 
@@ -228,12 +250,8 @@ def cardinal_scores(feats: jnp.ndarray, valid: jnp.ndarray,
 
     # term frequency: hitcount / (wordsintext + wordsintitle + 1), min/max
     # normalized to 0..255 (WordReferenceVars.termFrequency semantics)
-    tf = feats[:, P.F_HITCOUNT].astype(jnp.float32) / (
-        feats[:, P.F_WORDS_IN_TEXT] + feats[:, P.F_WORDS_IN_TITLE] + 1
-    ).astype(jnp.float32)
-    tf_valid = jnp.where(valid, tf, jnp.inf)
-    tf_min = jnp.min(tf_valid)
-    tf_max = jnp.max(jnp.where(valid, tf, -jnp.inf))
+    tf = _term_frequency(feats)
+    tf_min, tf_max = stats["tf_min"], stats["tf_max"]
     tf_span = tf_max - tf_min
     tf_norm = jnp.where(
         tf_span > 0, ((tf - tf_min) * 256.0 / jnp.maximum(tf_span, 1e-9)),
@@ -250,14 +268,35 @@ def cardinal_scores(feats: jnp.ndarray, valid: jnp.ndarray,
     score = score + jnp.sum(flag_hit * (255 << flag_shifts[None, :]), axis=1)
 
     # authority: domain-frequency score, only when coeff > 12
-    # (ReferenceOrder.java:255 guard); counts via segment_sum over hostids
-    counts = jax.ops.segment_sum(valid.astype(jnp.int32), hostids,
-                                 num_segments=n)
+    # (ReferenceOrder.java:255 guard); counts precomputed in stats so they
+    # can be psum'd across doc shards
+    counts = stats["host_counts"]
     maxdom = jnp.max(counts)
     auth = (counts[hostids] << 8) // (1 + maxdom)
     score = score + jnp.where(authority_coeff > 12, auth << authority_coeff, 0)
 
     return jnp.where(valid, score, jnp.int32(-(2**31 - 1)))
+
+
+def cardinal_scores(feats: jnp.ndarray, valid: jnp.ndarray,
+                    hostids: jnp.ndarray, norm_coeffs: jnp.ndarray,
+                    flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
+                    domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
+                    language_coeff: jnp.ndarray, authority_coeff: jnp.ndarray,
+                    language_pref: jnp.ndarray) -> jnp.ndarray:
+    """int32 cardinal score per posting row (invalid rows score MIN).
+
+    Vectorized ReferenceOrder.cardinal (ReferenceOrder.java:223-265):
+    every `(x-min)<<8 / (max-min) << coeff` term becomes a masked column
+    op; the authority signal's ConcurrentScoreMap of host counts
+    (ReferenceOrder.java:213-216) becomes a segment-sum over hostids.
+    Single-device composition of local_stats + cardinal_from_stats.
+    """
+    stats = local_stats(feats, valid, hostids, num_hosts=feats.shape[0])
+    return cardinal_from_stats(feats, valid, hostids, stats, norm_coeffs,
+                               flag_bits, flag_shifts, domlength_coeff,
+                               tf_coeff, language_coeff, authority_coeff,
+                               language_pref)
 
 
 @partial(jax.jit, static_argnames=("k",))

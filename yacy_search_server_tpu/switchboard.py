@@ -1,0 +1,254 @@
+"""Switchboard — the application kernel owning every subsystem.
+
+Capability equivalent of the reference's Switchboard (reference:
+source/net/yacy/search/Switchboard.java:— the singleton that owns
+sb.index / sb.crawler / sb.crawlQueues / sb.crawlStacker / sb.loader and
+the 4-stage concurrent indexing pipeline, Switchboard.java:1033-1101),
+minus the P2P subsystems that the peers/ layer wires in (M5).
+
+The indexing pipeline keeps the reference's exact 4-stage shape with
+per-stage WorkflowProcessors and backpressure:
+
+    parseDocument -> condenseDocument -> webStructureAnalysis
+        -> storeDocumentIndex (serialized)
+
+(stage semantics: Switchboard.parseDocument:2400, condenseDocument,
+webStructureAnalysis, storeDocumentIndex:2126). Stage 4 is the only
+writer into the Segment, matching the reference's 2-worker serialized
+store stage.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .crawler.cache import HTCache
+from .crawler.frontier import NoticedURL, StackType
+from .crawler.latency import Latency
+from .crawler.loader import CacheStrategy, LoaderDispatcher
+from .crawler.profile import CrawlProfile, default_profiles
+from .crawler.queues import CrawlQueues
+from .crawler.request import Request, Response
+from .crawler.robots import RobotsTxt
+from .crawler.stacker import CrawlStacker
+from .document.condenser import Condenser
+from .document.document import Document
+from .document.parser import ParserError, parse_source
+from .index.segment import Segment
+from .search.searchevent import SearchEvent, SearchEventCache
+from .search.query import QueryParams
+from .utils.config import Config
+from .utils.eventtracker import EClass, StageTimer
+from .utils.workflow import BusyThread, ThreadRegistry, WorkflowProcessor
+from .webstructure import WebStructureGraph
+
+
+@dataclass
+class IndexingEntry:
+    """The work item flowing through the 4 pipeline stages
+    (Switchboard.IndexingQueueEntry equivalent)."""
+    response: Response
+    profile: CrawlProfile
+    documents: list[Document] = field(default_factory=list)
+    condensers: list[Condenser] = field(default_factory=list)
+
+
+class Switchboard:
+    def __init__(self, data_dir: str | None = None,
+                 config: Config | None = None,
+                 transport=None, pipeline_workers: int = 2):
+        self.config = config or Config()
+        self.data_dir = data_dir
+        sub = (lambda s: os.path.join(data_dir, s)) if data_dir else (
+            lambda s: None)
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+
+        # core subsystems (Switchboard ctor parity)
+        self.index = Segment(sub("INDEX"))
+        self.latency = Latency()
+        self.htcache = HTCache(sub("HTCACHE"))
+        self.loader = LoaderDispatcher(self.htcache, self.latency,
+                                       transport=transport)
+        self.robots = RobotsTxt(
+            fetcher=lambda url: self._robots_fetch(url))
+        self.profiles: dict[str, CrawlProfile] = {}
+        for p in default_profiles().values():
+            self.profiles[p.handle] = p
+        self.noticed = NoticedURL(self.latency, sub("CRAWL"))
+        self.crawl_stacker = CrawlStacker(
+            self.noticed, self.profiles, segment=self.index,
+            robots=self.robots)
+        self.crawl_queues = CrawlQueues(
+            self.noticed, self.loader, self.profiles, robots=self.robots,
+            indexer=self.to_indexer)
+        self.web_structure = WebStructureGraph(sub("WEBSTRUCTURE"))
+        self.search_cache = SearchEventCache()
+        self.threads = ThreadRegistry()
+
+        self.indexed_count = 0
+        self._closed = False
+
+        # the 4-stage pipeline; stage 4 single-worker = serialized IO
+        self._store_proc = WorkflowProcessor(
+            "storeDocumentIndex", self._stage_store, workers=1,
+            queue_size=200)
+        self._structure_proc = WorkflowProcessor(
+            "webStructureAnalysis", self._stage_structure, workers=1,
+            queue_size=200, next_stage=self._store_proc)
+        self._condense_proc = WorkflowProcessor(
+            "condenseDocument", self._stage_condense,
+            workers=pipeline_workers, queue_size=200,
+            next_stage=self._structure_proc)
+        self._parse_proc = WorkflowProcessor(
+            "parseDocument", self._stage_parse, workers=pipeline_workers,
+            queue_size=200, next_stage=self._condense_proc)
+
+    # -- crawl control -------------------------------------------------------
+
+    def _robots_fetch(self, url: str):
+        resp = self.loader.load(Request(url), CacheStrategy.IFFRESH)
+        return resp.content if resp.status == 200 else None
+
+    def add_profile(self, profile: CrawlProfile) -> CrawlProfile:
+        self.profiles[profile.handle] = profile
+        return profile
+
+    def start_crawl(self, start_url: str, depth: int = 0,
+                    name: str | None = None, **profile_kwargs) -> CrawlProfile:
+        """Create a crawl profile and stack the start url
+        (Crawler_p servlet semantics)."""
+        profile = CrawlProfile(name or start_url, start_url=start_url,
+                               depth=depth, **profile_kwargs)
+        self.add_profile(profile)
+        req = Request(url=start_url, profile_handle=profile.handle, depth=0)
+        reason = self.crawl_stacker.stack(req)
+        if reason:
+            raise ValueError(f"start url rejected: {reason}")
+        return profile
+
+    def crawl_until_idle(self, timeout_s: float = 60.0) -> int:
+        """Drive the crawl synchronously until frontier + pipeline drain
+        (test/CLI surface; the busy-thread mode is deploy_threads).
+
+        Loops drain+flush because link discovery happens inside the async
+        parse stage: the frontier refills after the first drain empties."""
+        t_end = time.time() + timeout_s
+        total = 0
+        while time.time() < t_end:
+            n = self.crawl_queues.drain(
+                StackType.LOCAL, timeout_s=max(0.1, t_end - time.time()))
+            self.flush_pipeline()
+            total += n
+            if n == 0 and self.noticed.size(StackType.LOCAL) == 0:
+                break
+        return total
+
+    # -- indexing pipeline ---------------------------------------------------
+
+    def to_indexer(self, response: Response, profile: CrawlProfile) -> None:
+        """Pipeline entry (Switchboard.toIndexer)."""
+        reason = response.indexable()
+        if reason is not None:
+            self.crawl_queues.error_cache.push(
+                response.request.urlhash(), response.url, reason)
+            return
+        self._parse_proc.enqueue(IndexingEntry(response, profile))
+
+    def _stage_parse(self, entry: IndexingEntry):
+        with StageTimer(EClass.INDEX, "parseDocument", 1):
+            resp = entry.response
+            try:
+                entry.documents = parse_source(
+                    resp.url, resp.mime_type(), resp.content,
+                    resp.charset())
+            except ParserError as e:
+                self.crawl_queues.error_cache.push(
+                    resp.request.urlhash(), resp.url, f"parser: {e}")
+                return None
+            # discovered hyperlinks -> stacker (depth+1), the crawl loop
+            if entry.profile.depth > resp.request.depth:
+                for doc in entry.documents:
+                    self.crawl_stacker.enqueue_entries(
+                        doc.anchors, resp.request.urlhash(),
+                        entry.profile.handle, resp.request.depth + 1)
+            return entry
+
+    def _stage_condense(self, entry: IndexingEntry):
+        with StageTimer(EClass.INDEX, "condenseDocument", 1):
+            entry.documents = [d for d in entry.documents
+                               if not getattr(d, "noindex", False)
+                               and entry.profile.index_allowed(d.url)]
+            entry.condensers = [
+                Condenser(d, index_text=entry.profile.index_text,
+                          index_media=entry.profile.index_media)
+                for d in entry.documents]
+            return entry
+
+    def _stage_structure(self, entry: IndexingEntry):
+        with StageTimer(EClass.INDEX, "webStructureAnalysis", 1):
+            for doc in entry.documents:
+                self.web_structure.add_document(doc.url, [
+                    a.url for a in doc.anchors])
+            return entry
+
+    def _stage_store(self, entry: IndexingEntry):
+        with StageTimer(EClass.INDEX, "storeDocumentIndex", 1):
+            for doc in entry.documents:
+                self.index.store_document(
+                    doc, crawldepth=entry.response.request.depth,
+                    collection=entry.profile.collections[0])
+                self.indexed_count += 1
+            return None
+
+    def flush_pipeline(self, timeout_s: float = 30.0) -> None:
+        """Wait until all four stages are drained. Joining the stages in
+        order is sufficient: a stage enqueues downstream before marking its
+        own item done, so join(parse) implies every parse result reached
+        condense, and so on."""
+        for p in (self._parse_proc, self._condense_proc,
+                  self._structure_proc, self._store_proc):
+            p.join()
+
+    # -- search --------------------------------------------------------------
+
+    def search(self, query_string: str, count: int = 10,
+               offset: int = 0) -> SearchEvent:
+        q = QueryParams.parse(query_string)
+        q.item_count = count
+        q.offset = offset
+        return self.search_cache.get_event(q, self.index)
+
+    # -- busy threads (deployThread parity) ---------------------------------
+
+    def deploy_threads(self) -> None:
+        self.threads.deploy(BusyThread(
+            "50_localcrawl",
+            lambda: self.crawl_queues.core_crawl_job(StackType.LOCAL),
+            idle_sleep_s=1.0, busy_sleep_s=0.05))
+        self.threads.deploy(BusyThread(
+            "30_cleanup", self._cleanup_job,
+            idle_sleep_s=30.0, busy_sleep_s=30.0))
+
+    def _cleanup_job(self) -> bool:
+        self.search_cache.cleanup_locked()
+        return False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.threads.terminate_all()
+        self.crawl_queues.close()
+        self.flush_pipeline()
+        for p in (self._parse_proc, self._condense_proc,
+                  self._structure_proc, self._store_proc):
+            p.shutdown()
+        self.noticed.close()
+        self.web_structure.close()
+        self.index.close()

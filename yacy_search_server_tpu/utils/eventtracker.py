@@ -23,6 +23,7 @@ class EClass(Enum):
     INDEX = "index"
     DHT = "dht"
     PEERPING = "peerping"
+    CRAWL = "crawl"
 
 
 @dataclass(frozen=True)
