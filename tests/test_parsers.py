@@ -179,3 +179,26 @@ def test_supports_and_errors():
     assert supports("http://h.test/x.pdf")
     with pytest.raises(ParserError):
         parse_source("http://h.test/x.html", "text/html", b"")
+
+
+def test_html_tag_boundaries_are_word_separators():
+    # adjacent text nodes must not concatenate across element boundaries
+    # (reference ContentScraper emits whitespace between text chunks)
+    doc = parse_source(
+        "http://h.test/b.html", "text/html",
+        b"<html><body>foo<script>x()</script>bar "
+        b"indexing<a href='/d'>deeper</a> super<b>script</b></body></html>")[0]
+    assert "foobar" not in doc.text
+    assert "indexingdeeper" not in doc.text
+    for w in ("foo", "bar", "indexing", "deeper"):
+        assert w in doc.text.split()
+
+
+def test_html_valueless_attributes_do_not_truncate():
+    # <a href> / <link rel> parse with value None; the scraper must not
+    # crash mid-feed (which silently drops the rest of the document)
+    doc = parse_source(
+        "http://h.test/v.html", "text/html",
+        b"<html><body>before <a href>anchor</a> <link rel> "
+        b"<meta http-equiv> after</body></html>")[0]
+    assert "before" in doc.text and "after" in doc.text

@@ -54,9 +54,11 @@ class ContentScraper(HTMLParser):
     # -- tag handling --------------------------------------------------------
 
     def handle_starttag(self, tag, attrs):
-        a = dict(attrs)
+        # valueless attributes (<a href>) parse as value None
+        a = {k: (v if v is not None else "") for k, v in attrs}
         if tag in _IGNORE_CONTENT:
             self._ignore_depth += 1
+            self.text_parts.append(" ")
             return
         if tag == "html" and a.get("lang"):
             self.lang = a["lang"][:2].lower()
@@ -108,10 +110,12 @@ class ContentScraper(HTMLParser):
             if src:
                 self.anchors.append(Anchor(urljoin(self._base, src),
                                            text="", rel="frame"))
-        elif tag in ("br", "p", "div", "li", "td", "tr"):
-            self.text_parts.append(" ")
+        # every tag boundary is a word separator in the extracted text —
+        # adjacent text nodes ("indexing<a>deeper</a>") must not concatenate
+        self.text_parts.append(" ")
 
     def handle_endtag(self, tag):
+        self.text_parts.append(" ")
         if tag in _IGNORE_CONTENT:
             self._ignore_depth = max(0, self._ignore_depth - 1)
             return
