@@ -300,3 +300,38 @@ def test_error_cache_bounded():
     assert len(ec) == 5
     assert ec.has(bytes([9]))
     assert not ec.has(bytes([0]))
+
+
+def test_profile_must_match_is_anchored():
+    # reference uses Pattern.matches (whole-URL); a substring hit inside
+    # the query string must not admit an off-scope host
+    p = CrawlProfile("t", crawler_url_must_match=r"https?://example\.org/.*")
+    assert p.crawl_allowed("http://example.org/x")
+    assert not p.crawl_allowed("http://evil.test/p?r=http://example.org/x")
+
+
+def test_balancer_restart_recovers_journals(tmp_path):
+    d = str(tmp_path)
+    b = HostBalancer(data_dir=d)
+    b.push(Request("http://h.test/a"))
+    b.push(Request("http://h.test/b"))
+    b.push(Request("http://other.test/c"))
+    b.close()
+    b2 = HostBalancer(data_dir=d)
+    assert len(b2) == 3
+    got = set()
+    for _ in range(3):
+        r, _sleep = b2.pop()
+        assert r is not None
+        got.add(r.url)
+    assert got == {"http://h.test/a", "http://h.test/b",
+                   "http://other.test/c"}
+    b2.close()
+
+
+def test_host_key_roundtrip_with_underscore_and_port():
+    from yacy_search_server_tpu.crawler.frontier import host_key, host_of_key
+    for netloc in ("my_sub.example.test", "a.test:8090", "a_b.test"):
+        assert host_of_key(host_key("http://" + netloc + "/x")) == netloc
+    # distinct netlocs must not collide into one queue key
+    assert host_key("http://a_b.test/") != host_key("http://a:b.test/")

@@ -21,13 +21,20 @@ import time
 from collections import deque
 from urllib.parse import urlsplit
 
+from urllib.parse import quote, unquote
+
 from .latency import Latency
 from .request import Request
 
 
 def host_key(url: str) -> str:
+    """Filename-safe, bijective encoding of the URL's netloc."""
     netloc = urlsplit(url).netloc.lower()
-    return netloc.replace(":", "_") or "_nohost"
+    return quote(netloc, safe="") or "_nohost"
+
+
+def host_of_key(hk: str) -> str:
+    return unquote(hk)
 
 
 class HostQueue:
@@ -130,6 +137,17 @@ class HostBalancer:
         self._queues: dict[str, HostQueue] = {}
         self._rr: deque[str] = deque()
         self._lock = threading.Lock()
+        # recover journaled host queues from a previous run
+        if data_dir and os.path.isdir(data_dir):
+            for fn in sorted(os.listdir(data_dir)):
+                if fn.endswith(".jsonl"):
+                    hk = fn[:-len(".jsonl")]
+                    q = HostQueue(hk, data_dir)
+                    if len(q):
+                        self._queues[hk] = q
+                        self._rr.append(hk)
+                    else:
+                        q.close()
 
     def push(self, req: Request) -> bool:
         hk = host_key(req.url)
@@ -154,7 +172,7 @@ class HostBalancer:
                 q = self._queues.get(hk)
                 if q is None or len(q) == 0:
                     continue
-                host = hk.replace("_", ":")
+                host = host_of_key(hk)
                 wait = self.latency.waiting_remaining_s(host)
                 if wait <= 0.0:
                     req = q.pop()

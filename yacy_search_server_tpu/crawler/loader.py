@@ -15,8 +15,8 @@ import os
 import threading
 import time
 from urllib.parse import urlsplit
-from urllib.request import Request as UrlRequest
-from urllib.request import urlopen
+from urllib.request import HTTPRedirectHandler, Request as UrlRequest
+from urllib.request import build_opener
 
 from .cache import HTCache
 from .latency import Latency
@@ -32,6 +32,13 @@ class CacheStrategy:
 
 DEFAULT_AGENT = "yacy-tpu/1.0 (+https://yacy.net/bot.html)"
 MAX_REDIRECTS = 5
+
+
+class _CappedRedirectHandler(HTTPRedirectHandler):
+    max_redirections = MAX_REDIRECTS
+
+
+_OPENER = build_opener(_CappedRedirectHandler)
 
 
 class LoaderDispatcher:
@@ -74,7 +81,7 @@ class LoaderDispatcher:
         if self.transport is not None:
             return self.transport(url, {"User-Agent": self.agent})
         req = UrlRequest(url, headers={"User-Agent": self.agent})
-        with urlopen(req, timeout=self.timeout_s) as resp:  # nosec - crawler
+        with _OPENER.open(req, timeout=self.timeout_s) as resp:  # nosec - crawler
             content = resp.read(self.max_size + 1)
             if len(content) > self.max_size:
                 raise OSError(f"content exceeds max size {self.max_size}")
@@ -111,22 +118,25 @@ class LoaderDispatcher:
                             headers={"x-error": "not in cache"})
 
         # per-URL in-flight dedup (LoaderDispatcher.java:181-191): a second
-        # loader for the same url waits, then serves from cache
+        # loader for the same url waits, then serves from cache. Each loader
+        # only ever pops/sets the event it registered itself — a waiter that
+        # times out while the first fetch is still running proceeds without
+        # one, so it cannot release the first loader's waiters early.
+        my_ev = None
         with self._lock:
             ev = self._inflight.get(url)
             if ev is None:
-                self._inflight[url] = threading.Event()
-            waiter = ev
-        if waiter is not None:
-            waiter.wait(self.timeout_s)
+                my_ev = self._inflight[url] = threading.Event()
+        if my_ev is None:
+            ev.wait(self.timeout_s)
             cached = self._try_cache(url, CacheStrategy.IFEXIST)
             if cached is not None:
                 cached.request = request
                 return cached
-            # fall through: the first loader failed; try ourselves
+            # the first loader failed (or is still running): try ourselves
             with self._lock:
                 if url not in self._inflight:
-                    self._inflight[url] = threading.Event()
+                    my_ev = self._inflight[url] = threading.Event()
 
         scheme = urlsplit(url).scheme.lower()
         t0 = time.monotonic()
@@ -150,7 +160,8 @@ class LoaderDispatcher:
             return Response(request, status=599,
                             headers={"x-error": str(e)})
         finally:
-            with self._lock:
-                ev = self._inflight.pop(url, None)
-            if ev is not None:
-                ev.set()
+            if my_ev is not None:
+                with self._lock:
+                    if self._inflight.get(url) is my_ev:
+                        del self._inflight[url]
+                my_ev.set()

@@ -61,18 +61,21 @@ class CrawlProfile:
     # -- admission ----------------------------------------------------------
 
     def crawl_allowed(self, url: str) -> bool:
+        # fullmatch: the reference uses Pattern.matches, which anchors the
+        # pattern over the whole URL — a substring search would let
+        # `https?://example\.org/.*` admit any URL merely containing it
         if not self.crawling_q and "?" in url:
             return False
-        if self._cm is not None and not self._cm.search(url):
+        if self._cm is not None and not self._cm.fullmatch(url):
             return False
-        if self._cn is not None and self._cn.search(url):
+        if self._cn is not None and self._cn.fullmatch(url):
             return False
         return True
 
     def index_allowed(self, url: str) -> bool:
-        if self._im is not None and not self._im.search(url):
+        if self._im is not None and not self._im.fullmatch(url):
             return False
-        if self._in is not None and self._in.search(url):
+        if self._in is not None and self._in.fullmatch(url):
             return False
         return True
 

@@ -17,7 +17,7 @@ import threading
 from collections import defaultdict
 from urllib.parse import urlsplit
 
-from .utils.hashes import hosthash
+from .utils.hashes import hosthash, url2hash
 
 
 def host_of(url: str) -> str:
@@ -96,7 +96,9 @@ class WebStructureGraph:
         return sorted(counts.items(), key=lambda kv: -kv[1])[:n]
 
     def hosthash(self, host: str) -> bytes:
-        return hosthash("http://" + host)
+        # hashes.hosthash slices the host part out of a 12-byte url hash,
+        # so the host must be run through url2hash first
+        return hosthash(url2hash("http://" + host + "/"))
 
     # -- persistence ---------------------------------------------------------
 
