@@ -1,0 +1,351 @@
+"""Multi-peer P2P tests over the in-process loopback network.
+
+This is the simulated multi-peer harness the reference never had
+(SURVEY.md §4: "no multi-node/distributed tests and no fake network
+backend" — P2P correctness was only validated on the live freeworld
+network): N real nodes in one process, an injectable transport with
+failure injection, exercising hello gossip, DHT selection math,
+delete-on-select index transfer with the unknown-URL follow-up, remote
+scatter-gather search and straggler/dead-peer behavior.
+"""
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.parallel.distribution import (
+    LONG_MAX, Distribution)
+from yacy_search_server_tpu.peers.dht import (my_responsibility,
+                                              select_distribution_targets,
+                                              select_search_targets)
+from yacy_search_server_tpu.peers.node import P2PNode
+from yacy_search_server_tpu.peers.seed import (PeerType, Seed, SeedDB,
+                                               make_seed_hash)
+from yacy_search_server_tpu.peers.transport import (LoopbackNetwork,
+                                                    PeerUnreachable)
+from yacy_search_server_tpu.utils.hashes import word2hash
+
+
+def _doc(url, title, text):
+    return Document(url=url, title=title, text=text, mime_type="text/html",
+                    language="en")
+
+
+def _mknode(net, name, **kw):
+    kw.setdefault("partition_exponent", 2)   # 4 vertical partitions
+    kw.setdefault("redundancy", 1)
+    # deterministic port: python's hash() is salted per process, and the
+    # port feeds the seed hash -> ring position -> DHT selection
+    port = 8000 + sum(name.encode()) % 1000
+    return P2PNode(name, net, port=port, **kw)
+
+
+@pytest.fixture
+def trio():
+    net = LoopbackNetwork()
+    nodes = [_mknode(net, n) for n in ("alpha", "beta", "gamma")]
+    # full mesh membership via ping
+    for n in nodes:
+        n.bootstrap([m.seed for m in nodes if m is not n])
+        n.ping()
+    for n in nodes:
+        n.ping()
+    yield net, nodes
+    for n in nodes:
+        n.close()
+
+
+# -- seed model --------------------------------------------------------------
+
+def test_seed_dna_roundtrip():
+    s = Seed(make_seed_hash("x", "10.0.0.1", 8090), name="x", ip="10.0.0.1",
+             port=8090, peer_type=PeerType.PRINCIPAL)
+    s.link_count = 123
+    s2 = Seed.from_dna(s.dna())
+    assert s2.hash == s.hash and s2.name == "x" and s2.port == 8090
+    assert s2.peer_type == PeerType.PRINCIPAL and s2.link_count == 123
+    assert s2.ring_position() == s.ring_position()
+
+
+def test_seeddb_states(tmp_path):
+    me = Seed(make_seed_hash("me", "127.0.0.1", 1), name="me")
+    db = SeedDB(me, str(tmp_path))
+    a = Seed(make_seed_hash("a", "127.0.0.1", 2), name="a")
+    b = Seed(make_seed_hash("b", "127.0.0.1", 3), name="b")
+    db.hearsay(a)
+    assert a.hash in db.potential
+    db.connected(a)
+    assert a.hash in db.active and a.hash not in db.potential
+    db.disconnected(a.hash)
+    assert a.hash in db.passive
+    db.connected(b)
+    db.save()
+    db2 = SeedDB(Seed(make_seed_hash("me", "127.0.0.1", 1)), str(tmp_path))
+    # reloaded seeds start passive until re-proven by ping
+    assert b.hash in db2.passive and a.hash in db2.passive
+
+
+# -- DHT selection -----------------------------------------------------------
+
+def test_dht_selection_covers_ring():
+    me = Seed(make_seed_hash("me", "127.0.0.1", 1), name="me")
+    db = SeedDB(me)
+    for i in range(8):
+        db.connected(Seed(make_seed_hash(f"p{i}", "127.0.0.1", 100 + i),
+                          name=f"p{i}"))
+    dist = Distribution(2)
+    wh = word2hash("banana")
+    for part in range(dist.vertical_partitions()):
+        targets = select_distribution_targets(db, dist, wh, part, 3)
+        assert len(targets) == 3
+        # targets are the closest peers at-or-after the cell position
+        pos = dist.vertical_dht_position(wh, part)
+        from yacy_search_server_tpu.parallel.distribution import (
+            horizontal_dht_distance)
+        dists = sorted(horizontal_dht_distance(pos, s.ring_position())
+                       for s in db.active_seeds())
+        chosen = sorted(horizontal_dht_distance(pos, s.ring_position())
+                        for s in targets)
+        assert chosen == dists[:3]
+    # search side: all (word, partition) cells covered, bounded fan-out
+    targets = select_search_targets(db, dist, [wh, word2hash("apple")], 2)
+    assert 1 <= len(targets) <= 8
+
+
+def test_my_responsibility_consistent_with_selection():
+    me = Seed(make_seed_hash("me", "127.0.0.1", 1), name="me")
+    db = SeedDB(me)
+    for i in range(4):
+        db.connected(Seed(make_seed_hash(f"p{i}", "127.0.0.1", 100 + i)))
+    dist = Distribution(1)
+    wh = word2hash("cherry")
+    resp = my_responsibility(db, dist, wh, 0, 2)
+    targets = select_distribution_targets(db, dist, wh, 0, 2,
+                                          include_self=True)
+    assert resp == any(t.hash == me.hash for t in targets)
+
+
+# -- membership gossip -------------------------------------------------------
+
+def test_hello_gossip_full_mesh(trio):
+    _net, nodes = trio
+    for n in nodes:
+        others = {m.seed.hash for m in nodes if m is not n}
+        assert set(n.seeddb.active.keys()) == others
+
+
+def test_gossip_spreads_third_party(tmp_path):
+    net = LoopbackNetwork()
+    a = _mknode(net, "a1")
+    b = _mknode(net, "b1")
+    c = _mknode(net, "c1")
+    try:
+        # a knows b; c knows only a. c must learn b through a's gossip.
+        a.bootstrap([b.seed])
+        a.ping()
+        c.bootstrap([a.seed])
+        c.ping()
+        assert b.seed.hash in (set(c.seeddb.potential)
+                               | set(c.seeddb.active))
+        c.ping()   # potential seeds get pinged -> promoted active
+        assert b.seed.hash in c.seeddb.active
+    finally:
+        for n in (a, b, c):
+            n.close()
+
+
+def test_dead_peer_demoted(trio):
+    net, (a, b, c) = trio
+    net.kill(b.seed.hash)
+    a.ping()
+    assert b.seed.hash in a.seeddb.passive
+    assert b.seed.hash not in a.seeddb.active
+
+
+# -- index transfer ----------------------------------------------------------
+
+def _index_corpus(node):
+    docs = [
+        _doc("http://fruit.test/apple", "Apple Pie",
+             "the apple is a sweet fruit and apple pie needs sugar"),
+        _doc("http://fruit.test/banana", "Banana Bread",
+             "the banana is a yellow fruit easy to bake"),
+        _doc("http://veg.test/carrot", "Carrot Cake",
+             "the carrot is a root vegetable delicious with apple sauce"),
+    ]
+    for d in docs:
+        node.sb.index.store_document(d)
+    return docs
+
+
+def test_transfer_moves_ownership_and_metadata(trio):
+    _net, (a, b, c) = trio
+    _index_corpus(a)
+    before = a.sb.index.rwi_size()
+    assert before > 0
+    moved = a.distribute_all()
+    assert moved > 0
+    # delete-on-select: the shipped postings left a's index
+    assert a.sb.index.rwi_size() == 0
+    assert a.dispatcher.buffer_size() == 0
+    # every shipped posting landed somewhere, with metadata follow-up
+    received = (b.server.received_rwi_count + c.server.received_rwi_count)
+    assert received >= before
+    got_meta = (b.server.received_url_count + c.server.received_url_count)
+    assert got_meta > 0
+    # receiving side can resolve a transferred posting to its URL
+    wh = word2hash("banana")
+    for n in (b, c):
+        plist = n.sb.index.rwi.get(wh)
+        if len(plist):
+            uh = n.sb.index.metadata.urlhash_of(int(plist.docids[0]))
+            m = n.sb.index.metadata.get_by_urlhash(uh)
+            assert m.get("sku", "").startswith("http://fruit.test/")
+            return
+    pytest.fail("banana postings not found on any receiver")
+
+
+def test_transfer_failure_reenqueues_and_retries(trio):
+    net, (a, b, c) = trio
+    _index_corpus(a)
+    net.kill(b.seed.hash)
+    net.kill(c.seed.hash)
+    a.dispatcher.select_containers_to_buffer(0, LONG_MAX, 10**6, 10**9)
+    txs = a.dispatcher.dequeue_transmissions(max_chunks=64)
+    sent = a.dispatcher.transmit_all(txs)
+    assert sent == 0
+    assert a.dispatcher.failed_transmissions > 0
+    assert a.dispatcher.buffer_size() > 0    # re-enqueued, not lost
+    # revive the net: retry succeeds (dead peers demoted, reselection
+    # picks whoever answers)
+    net.revive(b.seed.hash)
+    net.revive(c.seed.hash)
+    a.ping()
+    moved = a.distribute_all()
+    assert moved > 0 and a.dispatcher.buffer_size() == 0
+
+
+def test_restore_buffer_on_close(tmp_path):
+    net = LoopbackNetwork()
+    a = _mknode(net, "solo")
+    try:
+        _index_corpus(a)
+        before = a.sb.index.rwi_size()
+        a.seeddb.connected(Seed(make_seed_hash("ghost", "127.0.0.1", 9),
+                                name="ghost"))
+        a.dispatcher.select_containers_to_buffer(0, LONG_MAX, 10**6, 10**9)
+        assert a.sb.index.rwi_size() == 0
+        restored = a.dispatcher.restore_buffer_to_index()
+        assert restored == before
+        assert a.sb.index.rwi_size() == before
+    finally:
+        a.close()
+
+
+# -- remote search -----------------------------------------------------------
+
+def test_remote_search_finds_distributed_postings(trio):
+    _net, (a, b, c) = trio
+    _index_corpus(a)
+    a.distribute_all()
+    assert a.sb.index.rwi_size() == 0     # everything moved away
+    ev = a.search("banana", remote=True, timeout_s=5.0)
+    urls = [r.url for r in ev.results()]
+    assert "http://fruit.test/banana" in urls
+    assert ev.remote_peers_asked >= 1
+
+
+def test_remote_search_merges_multiple_sources(trio):
+    _net, (a, b, c) = trio
+    # different docs live on different peers' local indexes
+    b.sb.index.store_document(_doc("http://b.test/doc", "Doc on B",
+                                   "zebra stripes pattern"))
+    c.sb.index.store_document(_doc("http://c.test/doc", "Doc on C",
+                                   "zebra crossing traffic"))
+    ev = a.search("zebra", remote=True, timeout_s=5.0)
+    urls = {r.url for r in ev.results()}
+    assert urls == {"http://b.test/doc", "http://c.test/doc"}
+    sources = {r.source for r in ev.results()}
+    assert len(sources) == 2
+
+
+def test_remote_search_survives_dead_peer(trio):
+    net, (a, b, c) = trio
+    b.sb.index.store_document(_doc("http://b.test/d", "B doc",
+                                   "quokka marsupial island"))
+    net.kill(c.seed.hash)
+    ev = a.search("quokka", remote=True, timeout_s=5.0)
+    urls = [r.url for r in ev.results()]
+    assert urls == ["http://b.test/d"]
+
+
+def test_rwi_count_rpc(trio):
+    _net, (a, b, c) = trio
+    b.sb.index.store_document(_doc("http://b.test/x", "X",
+                                   "wombat wombat wombat"))
+    n = a.protocol.query_rwi_count(b.seed, word2hash("wombat"))
+    assert n == 1
+
+
+def test_remote_crawl_delegation(trio):
+    _net, (a, b, c) = trio
+    from yacy_search_server_tpu.crawler.frontier import StackType
+    from yacy_search_server_tpu.crawler.request import Request
+    a.sb.noticed.push(StackType.GLOBAL, Request("http://delegate.test/p1"))
+    a.sb.noticed.push(StackType.GLOBAL, Request("http://delegate.test/p2"))
+    pulled = b.protocol.pull_crawl_urls(a.seed, count=5)
+    assert len(pulled) == 2
+    assert a.sb.noticed.size(StackType.GLOBAL) == 0
+    assert b.protocol.crawl_receipt(
+        a.seed, Request("http://delegate.test/p1").urlhash(), "fill")
+
+
+def test_large_transfer_chunks_without_loss(trio):
+    """>MAX_RWI_ENTRIES_PER_CALL postings must arrive via successive
+    chunked transferRWI calls — truncation would permanently lose data
+    under delete-on-select."""
+    _net, (a, b, c) = trio
+    # one term, 1500 synthetic postings (distinct urls)
+    from yacy_search_server_tpu.index import postings as P
+    wh = word2hash("bulk")
+    for i in range(1500):
+        d = _doc(f"http://bulk.test/p{i}", f"Bulk {i}", "bulk filler words")
+        a.sb.index.store_document(d)
+    before = a.sb.index.rwi.count(wh)
+    assert before == 1500
+    moved = a.distribute_all()
+    assert a.sb.index.rwi.count(wh) == 0
+    got = sum(len(n.sb.index.rwi.get(wh)) for n in (b, c))
+    assert got == 1500     # every posting landed exactly once (redundancy 1)
+
+
+def test_crashing_handler_counts_as_failed_call(trio):
+    """A remote handler raising (HTTP-500 equivalent) must not crash the
+    sender's transfer job; the chunk re-enqueues instead of being lost."""
+    net, (a, b, c) = trio
+    _index_corpus(a)
+
+    def broken(endpoint, payload):
+        raise RuntimeError("server bug")
+
+    net.register(b.seed.hash, broken)
+    net.register(c.seed.hash, broken)
+    a.dispatcher.select_containers_to_buffer(0, LONG_MAX, 10**6, 10**9)
+    txs = a.dispatcher.dequeue_transmissions(max_chunks=64)
+    sent = a.dispatcher.transmit_all(txs)     # must not raise
+    assert sent == 0
+    assert a.dispatcher.buffer_size() > 0
+    # both peers demoted after the failed calls
+    assert b.seed.hash not in a.seeddb.active
+    assert c.seed.hash not in a.seeddb.active
+
+
+def test_query_id_distinguishes_hash_level_excludes():
+    from yacy_search_server_tpu.search.query import QueryParams
+    q1 = QueryParams.parse("")
+    q1.goal._include_hashes_override = [word2hash("a")]
+    q1.goal._exclude_hashes_override = [word2hash("b")]
+    q2 = QueryParams.parse("")
+    q2.goal._include_hashes_override = [word2hash("a")]
+    q2.goal._exclude_hashes_override = [word2hash("c")]
+    assert q1.query_id() != q2.query_id()

@@ -1,0 +1,16 @@
+"""P2P network layer: peer identity, DHT selection, shard transfer,
+membership gossip and remote scatter-gather search.
+
+Capability equivalent of the reference's peers/ package (reference:
+source/net/yacy/peers/ — Seed.java, SeedDB.java, DHTSelection.java,
+Dispatcher.java, Transmission.java, Protocol.java, Network.java,
+RemoteSearch.java) re-designed around an injectable Transport so the whole
+network runs in-process for tests (the multi-peer harness the reference
+lacks, SURVEY.md §4) and over HTTP for real WAN federation (server/).
+"""
+
+from .seed import Seed, SeedDB, PeerType
+from .transport import LoopbackNetwork, PeerUnreachable, Transport
+
+__all__ = ["Seed", "SeedDB", "PeerType", "LoopbackNetwork",
+           "PeerUnreachable", "Transport"]

@@ -1,0 +1,69 @@
+"""DHT target selection — which peers hold/receive a term's postings.
+
+Capability equivalent of the reference's DHTSelection (reference:
+source/net/yacy/peers/DHTSelection.java:57-438 —
+selectDHTSearchTargets:141 picks `redundancy` peers per query word whose
+ring position covers the word, per vertical partition;
+selectDHTDistributionTargets:182 is the write-side counterpart). A peer
+"covers" a position by proximity on the closed base64-cardinal ring
+(Distribution.java:87-93 ring distance, forward direction).
+"""
+
+from __future__ import annotations
+
+from ..parallel.distribution import Distribution, horizontal_dht_distance
+from .seed import Seed, SeedDB
+
+
+def _closest(seeds: list[Seed], position: int, n: int) -> list[Seed]:
+    """The n peers closest at-or-after `position` on the ring."""
+    return sorted(
+        seeds, key=lambda s: horizontal_dht_distance(position,
+                                                     s.ring_position()))[:n]
+
+
+def select_distribution_targets(seeddb: SeedDB, dist: Distribution,
+                                wordhash: bytes, partition: int,
+                                redundancy: int,
+                                include_self: bool = False) -> list[Seed]:
+    """Write side: peers that should RECEIVE (wordhash, partition) postings.
+
+    Only active senior peers accepting DHT-in are eligible
+    (DHTSelection.java:182 skips non-active / robinson peers).
+    """
+    pos = dist.vertical_dht_position(wordhash, partition)
+    pool = [s for s in seeddb.active_seeds() if s.accepts_dht_in()]
+    if include_self:
+        pool = pool + [seeddb.my_seed]
+    return _closest(pool, pos, redundancy)
+
+def select_search_targets(seeddb: SeedDB, dist: Distribution,
+                          wordhashes: list[bytes], redundancy: int,
+                          max_peers: int = 64) -> list[Seed]:
+    """Read side: the union of peers covering any (word, partition) cell.
+
+    A query for word W must reach peers of ALL vertical partitions at W's
+    horizontal position (SURVEY.md §5: the "partitions" parameter of
+    Protocol.search), each cell with `redundancy` replicas.
+    """
+    chosen: dict[bytes, Seed] = {}
+    pool = [s for s in seeddb.active_seeds() if s.is_senior()]
+    if not pool:
+        return []
+    for wh in wordhashes:
+        for part in range(dist.vertical_partitions()):
+            pos = dist.vertical_dht_position(wh, part)
+            for s in _closest(pool, pos, redundancy):
+                chosen[s.hash] = s
+            if len(chosen) >= max_peers:
+                return list(chosen.values())
+    return list(chosen.values())
+
+
+def my_responsibility(seeddb: SeedDB, dist: Distribution, wordhash: bytes,
+                      partition: int, redundancy: int) -> bool:
+    """Is MY peer one of the `redundancy` owners of (wordhash, partition)?
+    Used to decide whether to keep postings locally vs hand them off."""
+    targets = select_distribution_targets(seeddb, dist, wordhash, partition,
+                                          redundancy, include_self=True)
+    return any(t.hash == seeddb.my_seed.hash for t in targets)

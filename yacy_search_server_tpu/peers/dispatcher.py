@@ -1,0 +1,217 @@
+"""DHT index distribution — continuous re-sharding of the RWI to the net.
+
+Capability equivalent of the reference's send pipeline (reference:
+source/net/yacy/peers/Dispatcher.java:53-381 —
+selectContainersEnqueueToBuffer:296 pulls containers OUT of the local
+index (ownership moves), splitContainer:234 splits each container by the
+vertical partition of each posting's URL hash, dequeueContainer:339 forms
+per-target Transmission.Chunks — and Transmission.java:77-276 with
+re-enqueue on failure).
+
+TPU-first difference: splitContainer is one bulk numpy projection over
+the whole container (Distribution.vertical_partitions_bulk) instead of a
+per-entry loop.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..index.metadata import DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS
+from ..index.postings import PostingsList
+from ..parallel.distribution import Distribution
+from .dht import select_distribution_targets
+from .protocol import Protocol
+from .seed import Seed, SeedDB
+
+# metadata columns shipped with transferURL — the URIMetadata surface,
+# deliberately excluding the full text body (the reference ships metadata
+# rows, not documents; snippets are re-fetched from the source URL)
+TRANSFER_TEXT_FIELDS = tuple(f for f in TEXT_FIELDS if f != "text_t")
+
+
+def merge_cells(a: tuple[PostingsList, list[bytes]],
+                b: tuple[PostingsList, list[bytes]]
+                ) -> tuple[PostingsList, list[bytes]]:
+    """Concatenate two (postings, urlhashes) cells (single definition of
+    the merge invariant — buffer and per-target chunks both use it)."""
+    ap, au = a
+    bp, bu = b
+    return (PostingsList(np.concatenate([ap.docids, bp.docids]),
+                         np.concatenate([ap.feats, bp.feats])),
+            au + bu)
+
+
+class Transmission:
+    """One per-target batch: containers + referenced URL metadata
+    (Transmission.Chunk equivalent)."""
+
+    def __init__(self, target: Seed,
+                 containers: dict[bytes, tuple[PostingsList, list[bytes]]],
+                 metadata_rows: dict[bytes, dict]):
+        self.target = target
+        self.containers = containers
+        self.metadata_rows = metadata_rows
+
+    def posting_count(self) -> int:
+        return sum(len(p) for p, _ in self.containers.values())
+
+    def transmit(self, protocol: Protocol) -> bool:
+        ok, _reply = protocol.transfer_index(
+            self.target, self.containers, self.metadata_rows)
+        return ok
+
+
+class Dispatcher:
+    """Buffer of (termhash, partition) -> postings awaiting transmission."""
+
+    def __init__(self, segment, seeddb: SeedDB, dist: Distribution,
+                 protocol: Protocol, redundancy: int = 3):
+        self.segment = segment
+        self.seeddb = seeddb
+        self.dist = dist
+        self.protocol = protocol
+        self.redundancy = redundancy
+        # (termhash, partition) -> (PostingsList, urlhashes)
+        self._buffer: dict[tuple[bytes, int],
+                           tuple[PostingsList, list[bytes]]] = {}
+        self._lock = threading.Lock()
+        self.transferred_postings = 0
+        self.failed_transmissions = 0
+
+    # -- select & split (ownership moves out of the index) -------------------
+
+    def select_containers_to_buffer(self, start_pos: int, limit_pos: int,
+                                    max_containers: int = 32,
+                                    max_refs: int = 2000) -> int:
+        """Pull containers in a ring segment out of the local RWI
+        (delete-on-select: Dispatcher.java:296), split them by vertical
+        partition, and buffer the pieces. Returns postings buffered."""
+        terms = self.segment.rwi.terms_in_ring_segment(start_pos, limit_pos)
+        total = 0
+        meta = self.segment.metadata
+        for th in terms[:max_containers]:
+            if total >= max_refs:
+                break
+            plist = self.segment.rwi.remove_term(th)
+            if len(plist) == 0:
+                continue
+            uhs = [meta.urlhash_of(int(d)) for d in plist.docids]
+            self._buffer_split(th, plist, uhs)
+            total += len(plist)
+        return total
+
+    def _buffer_split(self, th: bytes, plist: PostingsList,
+                      uhs: list[bytes]) -> None:
+        """Split a container by each posting's vertical partition and merge
+        the pieces into the buffer (splitContainer:234, one bulk numpy
+        projection). The single entry point for buffering — failure
+        re-enqueues go through the same split so every cell holds only
+        postings of ITS partition (the DHT placement invariant)."""
+        uh_arr = np.frombuffer(b"".join(uhs),
+                               dtype=np.uint8).reshape(len(uhs), 12)
+        parts = self.dist.vertical_partitions_bulk(uh_arr)
+        with self._lock:
+            for part in np.unique(parts):
+                sel = parts == int(part)
+                piece = PostingsList(plist.docids[sel], plist.feats[sel])
+                piece_uhs = [u for u, m in zip(uhs, sel) if m]
+                self._merge_into_buffer((th, int(part)), piece, piece_uhs)
+
+    def _merge_into_buffer(self, key, piece: PostingsList,
+                           uhs: list[bytes]) -> None:
+        old = self._buffer.get(key)
+        if old is None:
+            self._buffer[key] = (piece, uhs)
+        else:
+            self._buffer[key] = merge_cells(old, (piece, uhs))
+
+    def buffer_size(self) -> int:
+        with self._lock:
+            return len(self._buffer)
+
+    # -- dequeue & transmit --------------------------------------------------
+
+    def _metadata_row(self, uh: bytes) -> dict:
+        docid = self.segment.metadata.docid(uh)
+        if docid is None:
+            return {}
+        m = self.segment.metadata.get(docid)
+        if m is None:
+            return {}
+        row = {}
+        for f in TRANSFER_TEXT_FIELDS:
+            v = m.get(f, "")
+            if v:
+                row[f] = v
+        for f in INT_FIELDS + DOUBLE_FIELDS:
+            v = m.get(f, 0)
+            if v:
+                row[f] = v
+        return row
+
+    def dequeue_transmissions(self, max_chunks: int = 8) -> list[Transmission]:
+        """Form per-target chunks for up to max_chunks buffered cells
+        (dequeueContainer:339): each (term, partition) cell goes to its
+        `redundancy` DHT owners."""
+        with self._lock:
+            keys = list(self._buffer.keys())[:max_chunks]
+            cells = [(k, self._buffer.pop(k)) for k in keys]
+        per_target: dict[bytes, Transmission] = {}
+        unsendable = []
+        for (th, part), (plist, uhs) in cells:
+            targets = select_distribution_targets(
+                self.seeddb, self.dist, th, part, self.redundancy)
+            if not targets:
+                unsendable.append(((th, part), (plist, uhs)))
+                continue
+            rows = {uh: self._metadata_row(uh) for uh in set(uhs)}
+            for t in targets:
+                tx = per_target.get(t.hash)
+                if tx is None:
+                    tx = per_target[t.hash] = Transmission(t, {}, {})
+                # replicas ship the same container to multiple targets; a
+                # target owning several partitions of one term gets the
+                # pieces MERGED (keying by term alone must not drop any)
+                old = tx.containers.get(th)
+                tx.containers[th] = (plist, uhs) if old is None \
+                    else merge_cells(old, (plist, uhs))
+                tx.metadata_rows.update(rows)
+        if unsendable:
+            with self._lock:
+                for key, (plist, uhs) in unsendable:
+                    self._merge_into_buffer(key, plist, uhs)
+        return list(per_target.values())
+
+    def transmit_all(self, transmissions: list[Transmission]) -> int:
+        """Send chunks; failed chunks re-enqueue their containers
+        (Transmission.java failure path). Returns postings delivered."""
+        sent = 0
+        for tx in transmissions:
+            if tx.transmit(self.protocol):
+                sent += tx.posting_count()
+            else:
+                self.failed_transmissions += 1
+                for th, (plist, uhs) in tx.containers.items():
+                    # a per-target container may span several vertical
+                    # partitions: re-split so each piece re-enters the
+                    # buffer under its own (term, partition) cell
+                    self._buffer_split(th, plist, uhs)
+        self.transferred_postings += sent
+        return sent
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def restore_buffer_to_index(self) -> int:
+        """Shutdown path: postings still buffered go back into the local
+        index so ownership is never lost."""
+        with self._lock:
+            cells = list(self._buffer.items())
+            self._buffer.clear()
+        n = 0
+        for (th, _part), (plist, _uhs) in cells:
+            self.segment.rwi.add_many(th, plist)
+            n += len(plist)
+        return n

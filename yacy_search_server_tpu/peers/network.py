@@ -1,0 +1,62 @@
+"""Membership gossip — the peer-ping cycle.
+
+Capability equivalent of the reference's Network busy thread (reference:
+source/net/yacy/peers/Network.java:188-360 publishMySeed — hello to
+bootstrap/known peers, merge returned seed views, promote/demote peer
+states) plus seed-list bootstrap.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .protocol import Protocol
+from .seed import Seed, SeedDB
+
+
+class Network:
+    """One node's view of the P2P network + the ping job."""
+
+    def __init__(self, seeddb: SeedDB, protocol: Protocol,
+                 bootstrap: list[Seed] | None = None):
+        self.seeddb = seeddb
+        self.protocol = protocol
+        self.bootstrap = bootstrap or []
+        self.ping_rounds = 0
+
+    def peer_ping(self, fanout: int = 4) -> int:
+        """One ping cycle: hello a sample of (bootstrap | active |
+        potential) peers; potential peers that answer promote to active,
+        active peers that fail demote to passive (handled inside
+        Protocol._call / SeedDB). Returns peers reached."""
+        candidates: list[Seed] = []
+        if not self.seeddb.active:
+            candidates.extend(self.bootstrap)
+        active = self.seeddb.active_seeds()
+        random.shuffle(active)
+        candidates.extend(active[:fanout])
+        potential = list(self.seeddb.potential.values())
+        random.shuffle(potential)
+        candidates.extend(potential[:fanout])
+        # passive peers get a retry chance occasionally (the reference
+        # re-pings passive seeds at a lower rate)
+        passive = list(self.seeddb.passive.values())
+        if passive and self.ping_rounds % 4 == 0:
+            candidates.append(random.choice(passive))
+
+        reached = 0
+        seen: set[bytes] = set()
+        for target in candidates:
+            if target.hash in seen or target.hash == self.seeddb.my_seed.hash:
+                continue
+            seen.add(target.hash)
+            ok, _ = self.protocol.hello(target)
+            if ok:
+                reached += 1
+        self.ping_rounds += 1
+        return reached
+
+    def bootstrap_from_seedlist(self, source: Seed) -> int:
+        """Initial join: pull a peer directory from a principal peer."""
+        seeds = self.protocol.seedlist(source)
+        return len(seeds)

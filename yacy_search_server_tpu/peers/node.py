@@ -1,0 +1,152 @@
+"""P2PNode — a Switchboard plus the full peer stack, one per network node.
+
+The composition the reference builds inside Switchboard's constructor
+(reference: source/net/yacy/search/Switchboard.java:668 Dispatcher wiring,
+:1218-1230 peer ping deploy, :4133-4207 dhtTransferJob with its guard
+rails) — factored out so N nodes can live in one process over a
+LoopbackNetwork (the simulated multi-peer harness) or over HTTP (server/).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from ..parallel.distribution import LONG_MAX, Distribution
+from ..search.searchevent import SearchEvent
+from ..switchboard import Switchboard
+from .dispatcher import Dispatcher
+from .network import Network
+from .protocol import Protocol
+from .remotesearch import RemoteSearch
+from .seed import PeerType, Seed, SeedDB, make_seed_hash
+from .server import PeerServer
+from .transport import Transport
+
+# freeworld defaults (reference: defaults/yacy.network.freeworld.unit)
+DEFAULT_PARTITION_EXPONENT = 4     # 2^4 = 16 vertical partitions
+DEFAULT_REDUNDANCY = 3             # dhtredundancy.senior
+# dhtTransferJob guards (Switchboard.java:4147-4160)
+MIN_PEERS_FOR_DHT = 1
+
+
+class P2PNode:
+    """One peer: switchboard + seed identity + protocol client/server +
+    DHT dispatcher + membership gossip + remote search."""
+
+    def __init__(self, name: str, p2p_transport: Transport,
+                 data_dir: str | None = None,
+                 crawl_transport=None,
+                 port: int = 8090,
+                 partition_exponent: int = DEFAULT_PARTITION_EXPONENT,
+                 redundancy: int = DEFAULT_REDUNDANCY,
+                 peer_type: str = PeerType.SENIOR,
+                 accept_remote_index: bool = True,
+                 accept_remote_crawl: bool = False):
+        self.sb = Switchboard(data_dir=data_dir, transport=crawl_transport)
+        self.seed = Seed(make_seed_hash(name, "127.0.0.1", port), name=name,
+                         port=port, peer_type=peer_type)
+        self.seed.flags_accept_remote_index = accept_remote_index
+        self.seed.flags_accept_remote_crawl = accept_remote_crawl
+        self.seeddb = SeedDB(self.seed, data_dir)
+        self.dist = Distribution(partition_exponent)
+        self.redundancy = redundancy
+        self.protocol = Protocol(self.seeddb, p2p_transport)
+        self.server = PeerServer(self.sb, self.seeddb,
+                                 accept_remote_index=accept_remote_index,
+                                 accept_remote_crawl=accept_remote_crawl)
+        p2p_transport.register(self.seed.hash, self.server.handle)
+        self._transport = p2p_transport
+        self.dispatcher = Dispatcher(self.sb.index, self.seeddb, self.dist,
+                                     self.protocol, redundancy)
+        self.network = Network(self.seeddb, self.protocol)
+        self._rng = random.Random(self.seed.ring_position())
+
+    # -- membership ----------------------------------------------------------
+
+    def bootstrap(self, seeds: list[Seed]) -> None:
+        self.network.bootstrap = [s for s in seeds
+                                  if s.hash != self.seed.hash]
+
+    def ping(self) -> int:
+        return self.network.peer_ping()
+
+    # -- DHT distribution (the dhtTransferJob busy thread) -------------------
+
+    def dht_transfer_job(self, max_containers: int = 32,
+                         max_refs: int = 2000,
+                         segment_fraction: float = 1 / 64) -> bool:
+        """One transfer cycle over a random ring segment; returns True if
+        anything was shipped (BusyThread contract). Guards mirror
+        Switchboard.dhtShallTransfer: enough peers, something to send,
+        buffer not overfull."""
+        if len(self.seeddb.active) < MIN_PEERS_FOR_DHT:
+            return False
+        if self.sb.index.rwi_size() == 0 and self.dispatcher.buffer_size() == 0:
+            return False
+        if self.dispatcher.buffer_size() < self.dist.vertical_partitions():
+            start = self._rng.randrange(LONG_MAX)
+            span = max(1, int(LONG_MAX * segment_fraction))
+            limit = (start + span) % LONG_MAX
+            self.dispatcher.select_containers_to_buffer(
+                start, limit, max_containers, max_refs)
+        txs = self.dispatcher.dequeue_transmissions()
+        if not txs:
+            return False
+        return self.dispatcher.transmit_all(txs) > 0
+
+    def distribute_all(self, rounds: int = 512) -> int:
+        """Drive transfer to completion (test/CLI surface): sweep the whole
+        ring deterministically, then flush the buffer."""
+        total = 0
+        parts = 16
+        for i in range(parts):
+            start = i * (LONG_MAX // parts)
+            limit = (i + 1) * (LONG_MAX // parts) - 1
+            self.dispatcher.select_containers_to_buffer(
+                start, limit, max_containers=10**6, max_refs=10**9)
+        for _ in range(rounds):
+            txs = self.dispatcher.dequeue_transmissions(max_chunks=64)
+            if not txs:
+                break
+            total += self.dispatcher.transmit_all(txs)
+            if self.dispatcher.buffer_size() == 0:
+                break
+        return total
+
+    # -- search --------------------------------------------------------------
+
+    def search(self, query_string: str, count: int = 10,
+               remote: bool = True, timeout_s: float = 3.0,
+               secondary: bool = True) -> SearchEvent:
+        """Local batched search + remote scatter-gather into one event
+        (the yacysearch entry: local threads + primaryRemoteSearches)."""
+        event = self.sb.search(query_string, count=count)
+        if remote and self.seeddb.active:
+            rs = RemoteSearch(event, self.seeddb, self.dist, self.protocol,
+                              redundancy=self.redundancy,
+                              per_peer_count=count, timeout_s=timeout_s)
+            rs.start()
+            rs.join()
+            if secondary and rs.secondary_search():
+                rs.join(timeout_s / 2)
+        return event
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        self.dispatcher.restore_buffer_to_index()
+        self._transport.unregister(self.seed.hash)
+        self.seeddb.close()
+        self.sb.close()
+
+    def deploy_threads(self) -> None:
+        """Busy threads incl. the P2P jobs (deployThread parity)."""
+        from ..utils.workflow import BusyThread
+        self.sb.deploy_threads()
+        self.sb.threads.deploy(BusyThread(
+            "30_peerping", lambda: self.ping() > 0,
+            idle_sleep_s=30.0, busy_sleep_s=30.0))
+        self.sb.threads.deploy(BusyThread(
+            "70_dht_distribution", self.dht_transfer_job,
+            idle_sleep_s=15.0, busy_sleep_s=1.0))

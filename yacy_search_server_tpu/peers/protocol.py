@@ -1,0 +1,209 @@
+"""Client side of every peer RPC + the wire codecs.
+
+Capability equivalent of the reference's Protocol.java (reference:
+source/net/yacy/peers/Protocol.java — hello:190, queryRWICount:375,
+search:883-1025, transferIndex:1680) with the key=value multipart wire
+format replaced by JSON-able tables delivered through an injectable
+Transport. Postings travel keyed by URL HASH (as the reference's
+serialized WordReferenceRows are), not by peer-local docid — docids are
+a node-local notion.
+
+Every call returns (ok, reply_table); a transport failure demotes the
+peer in the caller's SeedDB (the reference's PeerActions.peerDeparture
+on failed RPCs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..index.postings import NF, PostingsList
+from .seed import Seed, SeedDB
+from .transport import PeerUnreachable, Transport
+
+# server-side cap on postings per transferRWI call
+# (reference: htroot/yacy/transferRWI.java:195)
+MAX_RWI_ENTRIES_PER_CALL = 1000
+
+
+# -- wire codecs -------------------------------------------------------------
+
+def encode_postings(plist: PostingsList, urlhashes: list[bytes]) -> dict:
+    """PostingsList + per-row urlhashes -> wire table."""
+    return {
+        "uh": [h.decode("ascii") for h in urlhashes],
+        "feats": plist.feats.tolist(),
+    }
+
+
+def decode_postings(table: dict) -> tuple[list[bytes], np.ndarray]:
+    uh = [h.encode("ascii") for h in table.get("uh", [])]
+    feats = np.asarray(table.get("feats", []), dtype=np.int32)
+    if feats.size == 0:
+        feats = feats.reshape(0, NF)
+    return uh, feats
+
+
+class Protocol:
+    """Stateless client methods bound to (my seeddb, transport)."""
+
+    def __init__(self, seeddb: SeedDB, transport: Transport):
+        self.seeddb = seeddb
+        self.transport = transport
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _call(self, target: Seed, endpoint: str, payload: dict
+              ) -> tuple[bool, dict]:
+        try:
+            reply = self.transport.rpc(target.hash, endpoint, payload)
+        except PeerUnreachable:
+            self.seeddb.disconnected(target.hash)
+            return False, {}
+        except Exception:
+            # a crashing remote handler (HTTP 500 equivalent) is a failed
+            # call, not a sender crash: callers rely on the False return to
+            # re-enqueue in-flight index transfers instead of losing them
+            self.seeddb.disconnected(target.hash)
+            return False, {}
+        self.seeddb.connected(target)
+        return True, reply
+
+    # -- membership ----------------------------------------------------------
+
+    def hello(self, target: Seed) -> tuple[bool, dict]:
+        """Publish my seed; harvest the target's seed view
+        (Protocol.java:190; Network.publishMySeed)."""
+        my = self.seeddb.my_seed
+        gossip = [s.dna() for s in self.seeddb.active_seeds()[:16]]
+        ok, reply = self._call(target, "hello",
+                               {"seed": my.dna(), "seeds": gossip})
+        if not ok:
+            return False, {}
+        if "seed" in reply:
+            self.seeddb.connected(Seed.from_dna(reply["seed"]))
+        for dna in reply.get("seeds", []):
+            try:
+                self.seeddb.hearsay(Seed.from_dna(dna))
+            except (KeyError, ValueError):
+                continue
+        return True, reply
+
+    def seedlist(self, target: Seed) -> list[Seed]:
+        """Bootstrap: fetch the peer directory of a (principal) peer."""
+        ok, reply = self._call(target, "seedlist", {})
+        if not ok:
+            return []
+        seeds = []
+        for dna in reply.get("seeds", []):
+            try:
+                s = Seed.from_dna(dna)
+            except (KeyError, ValueError):
+                continue
+            self.seeddb.hearsay(s)
+            seeds.append(s)
+        return seeds
+
+    # -- statistics ----------------------------------------------------------
+
+    def query_rwi_count(self, target: Seed, wordhash: bytes) -> int:
+        """How many postings does the peer hold for this term
+        (Protocol.queryRWICount)."""
+        ok, reply = self._call(
+            target, "query", {"object": "rwicount",
+                              "env": wordhash.decode("ascii")})
+        return int(reply.get("response", -1)) if ok else -1
+
+    # -- search --------------------------------------------------------------
+
+    def search(self, target: Seed, wordhashes: list[bytes],
+               exclude_hashes: list[bytes] | None = None,
+               count: int = 10, timeout_ms: int = 3000,
+               lang: str = "", contentdom: int = 0,
+               with_abstracts: bool = False) -> tuple[bool, dict]:
+        """Remote search RPC (Protocol.search / htroot/yacy/search.java):
+        the peer runs a local search and returns result rows + optional
+        per-word url-hash abstracts for the secondary join round."""
+        payload = {
+            "query": [h.decode("ascii") for h in wordhashes],
+            "exclude": [h.decode("ascii") for h in (exclude_hashes or [])],
+            "count": count, "time": timeout_ms, "lang": lang,
+            "contentdom": contentdom,
+            "abstracts": "words" if with_abstracts else "",
+        }
+        return self._call(target, "search", payload)
+
+    # -- index transfer ------------------------------------------------------
+
+    def transfer_index(self, target: Seed,
+                       containers: dict[bytes, tuple[PostingsList, list[bytes]]],
+                       metadata_rows: dict[bytes, dict]
+                       ) -> tuple[bool, dict]:
+        """transferRWI then transferURL for reported-unknown URLs
+        (Protocol.transferIndex:1680 two-RPC shape).
+
+        containers: termhash -> (postings, per-row urlhashes)
+        metadata_rows: urlhash -> metadata field table
+
+        Large transmissions are CHUNKED into successive transferRWI calls
+        of <=MAX_RWI_ENTRIES_PER_CALL postings each — postings here have
+        already been removed from the sender's index (delete-on-select),
+        so silently truncating would lose index data network-wide. Any
+        failed chunk fails the whole transmission; the caller re-enqueues
+        (the receive side dedups re-sent postings by docid).
+        """
+        # flatten into per-call batches of whole-or-split containers
+        batches: list[list[dict]] = [[]]
+        n = 0
+        for th, (plist, uhs) in containers.items():
+            off = 0
+            while off < len(plist):
+                take = min(len(plist) - off, MAX_RWI_ENTRIES_PER_CALL - n)
+                batches[-1].append({
+                    "term": th.decode("ascii"),
+                    "postings": encode_postings(
+                        PostingsList(plist.docids[off:off + take],
+                                     plist.feats[off:off + take]),
+                        uhs[off:off + take]),
+                })
+                off += take
+                n += take
+                if n >= MAX_RWI_ENTRIES_PER_CALL:
+                    batches.append([])
+                    n = 0
+        unknown: list[bytes] = []
+        reply: dict = {}
+        for entries in batches:
+            if not entries:
+                continue
+            ok, reply = self._call(target, "transferRWI",
+                                   {"entries": entries})
+            if not ok:
+                return False, {}
+            unknown.extend(u.encode("ascii")
+                           for u in reply.get("unknownURL", []))
+        if unknown:
+            rows = {u.decode("ascii"): metadata_rows[u]
+                    for u in set(unknown) if u in metadata_rows}
+            ok2, reply2 = self._call(target, "transferURL", {"rows": rows})
+            if not ok2:
+                return False, {}
+            reply = {**reply, **reply2}
+        return True, reply
+
+    # -- remote crawl delegation ---------------------------------------------
+
+    def pull_crawl_urls(self, target: Seed, count: int = 10) -> list[dict]:
+        """Pull crawl work from a peer publishing remote-crawl URLs
+        (htroot/yacy/urls.java server side)."""
+        ok, reply = self._call(target, "urls", {"count": count})
+        return reply.get("requests", []) if ok else []
+
+    def crawl_receipt(self, target: Seed, urlhash: bytes, result: str,
+                      reason: str = "") -> bool:
+        """Report a delegated crawl's outcome back to the delegating peer
+        (htroot/yacy/crawlReceipt.java)."""
+        ok, _ = self._call(target, "crawlReceipt",
+                           {"urlhash": urlhash.decode("ascii"),
+                            "result": result, "reason": reason})
+        return ok

@@ -1,0 +1,179 @@
+"""Remote scatter-gather search — the WAN fan-out feeding a live event.
+
+Capability equivalent of the reference's remote search (reference:
+source/net/yacy/peers/RemoteSearch.java:59-468 primaryRemoteSearches —
+one thread per DHT-selected peer, results merged asynchronously into the
+caller's SearchEvent — and SecondarySearchSuperviser.java:198 — the
+index-abstract-driven second round that closes multi-word join gaps).
+
+Stragglers: threads run as daemons against a deadline; answers landing
+after the deadline still merge into the live (cached) event — the
+reference's "deadline + late-merge" paging behavior (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+from ..parallel.distribution import Distribution
+from ..search.searchevent import ResultEntry, SearchEvent
+from .dht import select_search_targets
+from .protocol import Protocol
+from .seed import Seed, SeedDB
+
+
+def _entries_from_links(links: list[dict], source: str) -> list[ResultEntry]:
+    out = []
+    for row in links:
+        try:
+            out.append(ResultEntry(
+                docid=-1,
+                urlhash=row["urlhash"].encode("ascii"),
+                score=int(row.get("score", 0)),
+                url=row.get("url", ""), title=row.get("title", ""),
+                snippet=row.get("snippet", ""), host=row.get("host", ""),
+                filetype=row.get("filetype", ""),
+                language=row.get("language", ""),
+                size=int(row.get("size", 0)),
+                wordcount=int(row.get("wordcount", 0)),
+                lastmod_days=int(row.get("lastmod_days", 0)),
+                references=int(row.get("references", 0)),
+                source=source))
+        except (KeyError, ValueError):
+            continue
+    return out
+
+
+class RemoteSearch:
+    """Fan-out controller for one SearchEvent."""
+
+    def __init__(self, event: SearchEvent, seeddb: SeedDB,
+                 dist: Distribution, protocol: Protocol,
+                 redundancy: int = 3, per_peer_count: int = 10,
+                 timeout_s: float = 3.0):
+        self.event = event
+        self.seeddb = seeddb
+        self.dist = dist
+        self.protocol = protocol
+        self.redundancy = redundancy
+        self.per_peer_count = per_peer_count
+        self.timeout_s = timeout_s
+        self._threads: list[threading.Thread] = []
+        # per-word abstracts harvested for the secondary round:
+        # wordhash -> {urlhash -> set of peer hashes that hold it}
+        self._abstracts: dict[bytes, dict[bytes, set[bytes]]] = \
+            defaultdict(lambda: defaultdict(set))
+        self._abs_lock = threading.Lock()
+
+    # -- primary round -------------------------------------------------------
+
+    def start(self, with_abstracts: bool | None = None,
+              extra_peers: int = 8) -> int:
+        """Launch one search thread per selected peer; returns peer count
+        (RemoteSearch.primaryRemoteSearches:172).
+
+        Beyond the DHT RWI targets, up to `extra_peers` further senior
+        peers get a metadata search — the reference's per-peer Solr
+        searches (RemoteSearch.java:282,388) that catch content living
+        only in a peer's local index (robinson peers, not-yet-distributed
+        postings)."""
+        include = self.event.query.goal.include_hashes
+        if not include:
+            return 0
+        if with_abstracts is None:
+            with_abstracts = len(include) > 1
+        targets = select_search_targets(
+            self.seeddb, self.dist, include, self.redundancy)
+        have = {t.hash for t in targets}
+        extras = sorted((s for s in self.seeddb.active_seeds()
+                         if s.is_senior() and s.hash not in have),
+                        key=lambda s: s.hash)[:extra_peers]
+        targets = targets + extras
+        for t in targets:
+            th = threading.Thread(
+                target=self._one_peer, args=(t, with_abstracts),
+                name=f"remotesearch-{t.name}", daemon=True)
+            th.start()
+            self._threads.append(th)
+        self.event.remote_peers_asked += len(targets)
+        return len(targets)
+
+    def _one_peer(self, target: Seed, with_abstracts: bool,
+                  wordhashes: list[bytes] | None = None) -> None:
+        q = self.event.query
+        include = wordhashes or q.goal.include_hashes
+        ok, reply = self.protocol.search(
+            target, include, q.goal.exclude_hashes,
+            count=self.per_peer_count,
+            timeout_ms=int(self.timeout_s * 1000),
+            lang=q.lang, contentdom=q.contentdom,
+            with_abstracts=with_abstracts)
+        if not ok:
+            return
+        entries = _entries_from_links(
+            reply.get("links", []), source=target.hash.decode("ascii"))
+        self.event.add_remote_results(entries)
+        if with_abstracts:
+            with self._abs_lock:
+                for wh_s, uhs in reply.get("abstracts", {}).items():
+                    wh = wh_s.encode("ascii")
+                    for uh_s in uhs:
+                        self._abstracts[wh][uh_s.encode("ascii")].add(
+                            target.hash)
+
+    def join(self, timeout_s: float | None = None) -> None:
+        """Wait for the fan-out up to the deadline; stragglers keep running
+        as daemons and late-merge into the live event."""
+        deadline = timeout_s if timeout_s is not None else self.timeout_s
+        import time
+        t_end = time.monotonic() + deadline
+        for th in self._threads:
+            left = t_end - time.monotonic()
+            if left <= 0:
+                break
+            th.join(left)
+
+    # -- secondary round (abstract-driven join completion) -------------------
+
+    def secondary_search(self, max_peers: int = 8) -> int:
+        """Close multi-word join gaps: a URL listed in the abstracts of
+        every query word — but by DIFFERENT peers — is a conjunctive hit
+        no single peer could produce. Ask each peer that holds a partial
+        view to search again (it will join against the postings it has)
+        (SecondarySearchSuperviser.java:198 semantics, simplified)."""
+        include = self.event.query.goal.include_hashes
+        if len(include) < 2:
+            return 0
+        with self._abs_lock:
+            abstracts = {wh: dict(m) for wh, m in self._abstracts.items()}
+        if len(abstracts) < len(include):
+            return 0
+        # urls present for EVERY word somewhere in the network
+        common: set[bytes] | None = None
+        for wh in include:
+            urls = set(abstracts.get(wh, {}).keys())
+            common = urls if common is None else (common & urls)
+        if not common:
+            return 0
+        # peers that hold at least one word for a common url but were not
+        # able to join all words locally -> re-ask them
+        peers_to_ask: set[bytes] = set()
+        for uh in common:
+            holders: set[bytes] = set()
+            for wh in include:
+                holders |= abstracts[wh].get(uh, set())
+            if len(holders) > 1:      # the join spans peers
+                peers_to_ask |= holders
+        started = 0
+        for ph in list(peers_to_ask)[:max_peers]:
+            seed = self.seeddb.get(ph)
+            if seed is None:
+                continue
+            th = threading.Thread(
+                target=self._one_peer, args=(seed, False),
+                name=f"secondary-{seed.name}", daemon=True)
+            th.start()
+            self._threads.append(th)
+            started += 1
+        return started

@@ -1,0 +1,202 @@
+"""Peer identity (Seed) and the peer directory (SeedDB).
+
+Capability equivalent of the reference's peer DNA and seed database
+(reference: source/net/yacy/peers/Seed.java:139-237 — hash, IPs, port,
+flags, counts, PeerType junior/senior/principal — and SeedDB.java — three
+tables active/passive/potential plus mySeed). A seed serializes to a flat
+string map ("DNA") for the hello/seedlist gossip wire format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..utils.base64order import enhanced_coder
+from ..utils.hashes import word2hash
+
+
+class PeerType:
+    JUNIOR = "junior"        # not reachable from the WAN, no DHT-in
+    SENIOR = "senior"        # reachable, full DHT citizen
+    PRINCIPAL = "principal"  # senior + publishes seed lists
+
+
+def make_seed_hash(name: str, ip: str, port: int) -> bytes:
+    """Deterministic 12-char base64 peer hash (the reference draws a random
+    hash once and persists it; determinism here makes tests reproducible)."""
+    return word2hash(f"{name}|{ip}|{port}")
+
+
+class Seed:
+    """One peer's DNA. Field names follow the reference's Seed properties."""
+
+    def __init__(self, hash_b: bytes, name: str = "", ip: str = "127.0.0.1",
+                 port: int = 8090, peer_type: str = PeerType.SENIOR,
+                 version: str = "0.1"):
+        self.hash = hash_b                  # 12 ascii bytes, base64 alphabet
+        self.name = name
+        self.ip = ip
+        self.port = port
+        self.peer_type = peer_type
+        self.version = version
+        self.flags_accept_remote_crawl = False
+        self.flags_accept_remote_index = True   # "dhtIn"
+        self.link_count = 0                 # URLs in the local index
+        self.word_count = 0                 # RWI terms in the local index
+        self.uptime_s = 0.0
+        self.last_seen = time.time()
+        self.birth = time.time()
+        self.connects = 0
+
+    # -- ring placement ------------------------------------------------------
+
+    def ring_position(self) -> int:
+        """Cardinal position of this peer on the DHT ring."""
+        return enhanced_coder.cardinal(self.hash)
+
+    def is_senior(self) -> bool:
+        return self.peer_type in (PeerType.SENIOR, PeerType.PRINCIPAL)
+
+    def accepts_dht_in(self) -> bool:
+        return self.is_senior() and self.flags_accept_remote_index
+
+    # -- DNA wire format -----------------------------------------------------
+
+    def dna(self) -> dict:
+        return {
+            "Hash": self.hash.decode("ascii"),
+            "Name": self.name,
+            "IP": self.ip,
+            "Port": str(self.port),
+            "PeerType": self.peer_type,
+            "Version": self.version,
+            "CRWCnt": "1" if self.flags_accept_remote_crawl else "0",
+            "DhtIn": "1" if self.flags_accept_remote_index else "0",
+            "LCount": str(self.link_count),
+            "ICount": str(self.word_count),
+            "Uptime": str(int(self.uptime_s)),
+            "LastSeen": str(self.last_seen),
+        }
+
+    @staticmethod
+    def from_dna(d: dict) -> "Seed":
+        s = Seed(d["Hash"].encode("ascii"), name=d.get("Name", ""),
+                 ip=d.get("IP", "127.0.0.1"), port=int(d.get("Port", 8090)),
+                 peer_type=d.get("PeerType", PeerType.SENIOR),
+                 version=d.get("Version", "0"))
+        s.flags_accept_remote_crawl = d.get("CRWCnt") == "1"
+        s.flags_accept_remote_index = d.get("DhtIn", "1") == "1"
+        s.link_count = int(d.get("LCount", 0))
+        s.word_count = int(d.get("ICount", 0))
+        s.uptime_s = float(d.get("Uptime", 0))
+        s.last_seen = float(d.get("LastSeen", time.time()))
+        return s
+
+    def touch(self) -> None:
+        self.last_seen = time.time()
+
+    def __repr__(self) -> str:
+        return (f"Seed({self.hash.decode('ascii')}, {self.name!r}, "
+                f"{self.peer_type})")
+
+
+class SeedDB:
+    """active / passive / potential peer tables + my own seed.
+
+    State transitions mirror the reference's PeerActions: a peer we talked
+    to goes active; one that stops answering demotes to passive; hearsay
+    seeds (learned via gossip, never contacted) start potential.
+    """
+
+    def __init__(self, my_seed: Seed, data_dir: str | None = None):
+        self.my_seed = my_seed
+        self.active: dict[bytes, Seed] = {}
+        self.passive: dict[bytes, Seed] = {}
+        self.potential: dict[bytes, Seed] = {}
+        self._lock = threading.RLock()
+        self._path = os.path.join(data_dir, "seeds.jsonl") if data_dir else None
+        if self._path and os.path.exists(self._path):
+            self._load()
+
+    # -- ingestion (PeerActions.peerArrival semantics) -----------------------
+
+    def connected(self, seed: Seed) -> None:
+        """We exchanged an RPC with this peer: it is active."""
+        if seed.hash == self.my_seed.hash:
+            return
+        with self._lock:
+            seed.touch()
+            seed.connects += 1
+            self.passive.pop(seed.hash, None)
+            self.potential.pop(seed.hash, None)
+            self.active[seed.hash] = seed
+
+    def hearsay(self, seed: Seed) -> None:
+        """Seed learned from gossip: potential until we talk to it."""
+        if seed.hash == self.my_seed.hash:
+            return
+        with self._lock:
+            if seed.hash in self.active or seed.hash in self.passive:
+                return
+            self.potential[seed.hash] = seed
+
+    def disconnected(self, peer_hash: bytes) -> None:
+        """Peer failed to answer: demote active -> passive."""
+        with self._lock:
+            s = self.active.pop(peer_hash, None)
+            if s is not None:
+                self.passive[s.hash] = s
+
+    # -- lookup --------------------------------------------------------------
+
+    def get(self, peer_hash: bytes) -> Seed | None:
+        with self._lock:
+            return (self.active.get(peer_hash)
+                    or self.passive.get(peer_hash)
+                    or self.potential.get(peer_hash))
+
+    def active_seeds(self) -> list[Seed]:
+        with self._lock:
+            return list(self.active.values())
+
+    def all_seeds(self) -> list[Seed]:
+        with self._lock:
+            return (list(self.active.values()) + list(self.passive.values())
+                    + list(self.potential.values()))
+
+    def sizes(self) -> dict[str, int]:
+        with self._lock:
+            return {"active": len(self.active), "passive": len(self.passive),
+                    "potential": len(self.potential)}
+
+    # -- persistence ---------------------------------------------------------
+
+    def save(self) -> None:
+        if not self._path:
+            return
+        with self._lock, open(self._path, "w", encoding="utf-8") as f:
+            for table, seeds in (("active", self.active),
+                                 ("passive", self.passive),
+                                 ("potential", self.potential)):
+                for s in seeds.values():
+                    f.write(json.dumps({"t": table, "dna": s.dna()}) + "\n")
+
+    def _load(self) -> None:
+        with open(self._path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                    s = Seed.from_dna(rec["dna"])
+                except (json.JSONDecodeError, KeyError, ValueError):
+                    continue
+                # all reloaded seeds start passive: liveness is re-proven by
+                # the ping cycle after restart
+                table = self.passive if rec.get("t") != "potential" \
+                    else self.potential
+                table[s.hash] = s
+
+    def close(self) -> None:
+        self.save()

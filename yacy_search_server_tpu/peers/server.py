@@ -1,0 +1,213 @@
+"""Server side of every peer RPC — the htroot/yacy/* servlet equivalents.
+
+Capability equivalent of the reference's P2P wire endpoints (reference:
+htroot/yacy/hello.java, search.java:223-430, transferRWI.java:61-287,
+transferURL.java, query.java, urls.java, crawlReceipt.java,
+seedlist.java). One PeerServer instance is bound to a node's subsystems
+and registered with the Transport; the same handlers back the HTTP wire
+endpoints in server/ so loopback tests exercise the production logic.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..index.metadata import (DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS,
+                              DocumentMetadata)
+from ..index.postings import NF
+from .protocol import MAX_RWI_ENTRIES_PER_CALL, decode_postings
+from .seed import Seed, SeedDB
+
+# shed transferRWI load when the RWI RAM buffer is this full
+# (reference: transferRWI.java:121 checks the word cache flush threshold)
+RWI_BUFFER_SHED_FACTOR = 2.0
+
+
+class PeerServer:
+    """Dispatches endpoint name -> handler against one node's subsystems."""
+
+    def __init__(self, switchboard, seeddb: SeedDB,
+                 accept_remote_index: bool = True,
+                 accept_remote_crawl: bool = False,
+                 blacklist=None):
+        self.sb = switchboard
+        self.seeddb = seeddb
+        self.accept_remote_index = accept_remote_index
+        self.accept_remote_crawl = accept_remote_crawl
+        self.blacklist = blacklist     # callable(url) -> bool (denied)
+        self.received_rwi_count = 0
+        self.received_url_count = 0
+
+    # -- dispatch ------------------------------------------------------------
+
+    def handle(self, endpoint: str, payload: dict) -> dict:
+        fn = getattr(self, "do_" + endpoint, None)
+        if fn is None:
+            return {"error": f"unknown endpoint {endpoint}"}
+        return fn(payload)
+
+    # -- membership ----------------------------------------------------------
+
+    def do_hello(self, payload: dict) -> dict:
+        """Ingest the caller's seed (it reached us, so it is alive) plus its
+        gossip; answer with my seed + a gossip batch (hello.java)."""
+        try:
+            caller = Seed.from_dna(payload["seed"])
+            self.seeddb.connected(caller)
+        except (KeyError, ValueError):
+            pass
+        for dna in payload.get("seeds", []):
+            try:
+                self.seeddb.hearsay(Seed.from_dna(dna))
+            except (KeyError, ValueError):
+                continue
+        me = self.seeddb.my_seed
+        me.link_count = self.sb.index.doc_count()
+        me.word_count = self.sb.index.rwi_size()
+        return {"seed": me.dna(),
+                "seeds": [s.dna() for s in self.seeddb.active_seeds()[:16]]}
+
+    def do_seedlist(self, payload: dict) -> dict:
+        return {"seeds": [s.dna() for s in self.seeddb.all_seeds()[:256]]}
+
+    # -- statistics ----------------------------------------------------------
+
+    def do_query(self, payload: dict) -> dict:
+        if payload.get("object") == "rwicount":
+            wh = payload.get("env", "").encode("ascii")
+            return {"response": self.sb.index.rwi.count(wh)}
+        if payload.get("object") == "lurlcount":
+            return {"response": self.sb.index.doc_count()}
+        return {"response": -1}
+
+    # -- search (the remote side of scatter-gather) --------------------------
+
+    def do_search(self, payload: dict) -> dict:
+        """Run a local search on behalf of a remote peer
+        (htroot/yacy/search.java:330 creates its own SearchEvent)."""
+        from ..search.query import QueryParams
+        from ..search.searchevent import SearchEvent
+
+        include = [h.encode("ascii") for h in payload.get("query", [])]
+        exclude = [h.encode("ascii") for h in payload.get("exclude", [])]
+        count = min(int(payload.get("count", 10)), 100)
+        q = QueryParams.parse("")          # hash-level query: no words
+        q.goal.include_words = []
+        q.item_count = count
+        q.snippet_fetch = False
+        # patch hash-level search keys in (the wire carries hashes, never
+        # the words themselves — privacy property of the reference wire)
+        q.goal._include_hashes_override = include
+        q.goal._exclude_hashes_override = exclude
+        ev = SearchEvent(q, self.sb.index)
+        links = []
+        for e in ev.results(offset=0, count=count):
+            links.append({
+                "urlhash": e.urlhash.decode("ascii", "replace"),
+                "url": e.url, "title": e.title, "host": e.host,
+                "score": int(e.score), "filetype": e.filetype,
+                "language": e.language, "size": e.size,
+                "wordcount": e.wordcount, "lastmod_days": e.lastmod_days,
+                "references": e.references, "snippet": e.snippet,
+            })
+        reply = {"joincount": ev.local_rwi_considered, "links": links}
+        if payload.get("abstracts") == "words":
+            # per-word url-hash abstracts for the secondary join round
+            # (search.java:398-427 serializes compressed abstracts)
+            abstracts = {}
+            for wh in include:
+                plist = self.sb.index.rwi.get(wh)
+                uhs = [self.sb.index.metadata.urlhash_of(int(d)).decode(
+                    "ascii", "replace") for d in plist.docids[:512]]
+                abstracts[wh.decode("ascii")] = uhs
+            reply["abstracts"] = abstracts
+        return reply
+
+    # -- index transfer (receive) --------------------------------------------
+
+    def do_transferRWI(self, payload: dict) -> dict:
+        """Admission + store postings; reply lists unknown URLs and may ask
+        the sender to pause (transferRWI.java:61-287 semantics: granted
+        flag, load shedding, blacklist, storeRWI, unknownURL, pause)."""
+        if not self.accept_remote_index:
+            return {"result": "not granted", "unknownURL": [], "pause": 60}
+        rwi = self.sb.index.rwi
+        if rwi.ram_postings_count > \
+                rwi.max_ram_postings * RWI_BUFFER_SHED_FACTOR:
+            return {"result": "busy", "unknownURL": [], "pause": 60}
+
+        meta = self.sb.index.metadata
+        unknown: set[bytes] = set()
+        received = 0
+        entries = payload.get("entries", [])[:MAX_RWI_ENTRIES_PER_CALL]
+        for entry in entries:
+            th = entry.get("term", "").encode("ascii")
+            if len(th) != 12:
+                continue
+            uhs, feats = decode_postings(entry.get("postings", {}))
+            if feats.shape[1] != NF:
+                continue
+            for i, uh in enumerate(uhs):
+                if received >= MAX_RWI_ENTRIES_PER_CALL:
+                    break
+                docid = meta.docid(uh)
+                if docid is None or meta.is_deleted(docid):
+                    # stub row reserves the docid; transferURL fills it in
+                    docid = meta.put(DocumentMetadata(uh))
+                    unknown.add(uh)
+                elif not (meta.text_value(docid, "sku")):
+                    unknown.add(uh)   # stub from an earlier call, still bare
+                rwi.add(th, docid, feats[i])
+                received += 1
+        self.received_rwi_count += received
+        if rwi.needs_flush():
+            rwi.flush()
+        return {"result": "ok", "received": received,
+                "unknownURL": [u.decode("ascii") for u in unknown],
+                "pause": 0}
+
+    def do_transferURL(self, payload: dict) -> dict:
+        """Receive URL metadata for previously-unknown urlhashes
+        (transferURL.java). Fills stub rows IN PLACE so postings stored
+        against the stub docid stay valid."""
+        meta = self.sb.index.metadata
+        stored = 0
+        for uh_s, fields in payload.get("rows", {}).items():
+            uh = uh_s.encode("ascii")
+            if self.blacklist is not None and \
+                    self.blacklist(fields.get("sku", "")):
+                continue
+            clean = {k: v for k, v in fields.items()
+                     if k in TEXT_FIELDS or k in INT_FIELDS
+                     or k in DOUBLE_FIELDS}
+            docid = meta.docid(uh)
+            if docid is None or meta.is_deleted(docid):
+                meta.put(DocumentMetadata(uh, **clean))
+            else:
+                meta.set_fields(docid, **clean)
+            stored += 1
+        self.received_url_count += stored
+        return {"result": "ok", "stored": stored}
+
+    # -- remote crawl delegation ---------------------------------------------
+
+    def do_urls(self, payload: dict) -> dict:
+        """Publish crawl work from the GLOBAL stack to a pulling peer
+        (htroot/yacy/urls.java)."""
+        from ..crawler.frontier import StackType
+        count = min(int(payload.get("count", 10)), 100)
+        out = []
+        for _ in range(count):
+            req, _sleep = self.sb.noticed.pop(StackType.GLOBAL)
+            if req is None:
+                break
+            out.append(req.to_dict())
+        return {"requests": out}
+
+    def do_crawlReceipt(self, payload: dict) -> dict:
+        urlhash = payload.get("urlhash", "").encode("ascii")
+        result = payload.get("result", "")
+        if result != "fill" and urlhash:
+            self.sb.crawl_queues.error_cache.push(
+                urlhash, "", f"remote crawl: {payload.get('reason', result)}")
+        return {"result": "ok", "delay": 10}

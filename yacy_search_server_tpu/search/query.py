@@ -144,6 +144,11 @@ class QueryGoal:
     include_words: list[str] = field(default_factory=list)
     exclude_words: list[str] = field(default_factory=list)
     phrases: list[str] = field(default_factory=list)
+    # hash-level queries (P2P search wire carries word HASHES, never the
+    # words — the reference's privacy property): when set, these override
+    # the hashes derived from the word lists
+    _include_hashes_override: list[bytes] | None = None
+    _exclude_hashes_override: list[bytes] | None = None
 
     @staticmethod
     def parse(bare_query: str) -> "QueryGoal":
@@ -171,10 +176,14 @@ class QueryGoal:
 
     @property
     def include_hashes(self) -> list[bytes]:
+        if self._include_hashes_override is not None:
+            return self._include_hashes_override
         return [word2hash(w) for w in self.include_words]
 
     @property
     def exclude_hashes(self) -> list[bytes]:
+        if self._exclude_hashes_override is not None:
+            return self._exclude_hashes_override
         return [word2hash(w) for w in self.exclude_words]
 
     def is_catchall(self) -> bool:
@@ -239,6 +248,12 @@ class QueryParams:
         QueryParams.id(): identical query state reuses the live event, so
         paging does not re-run the search."""
         key = "|".join((
+            ",".join(sorted(
+                h.decode("ascii", "replace")
+                for h in self.goal.include_hashes)),
+            ",".join(sorted(
+                h.decode("ascii", "replace")
+                for h in self.goal.exclude_hashes)),
             ",".join(sorted(self.include_words())),
             ",".join(sorted(self.goal.exclude_words)),
             ",".join(sorted(self.goal.phrases)),
