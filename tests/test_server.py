@@ -1,0 +1,268 @@
+"""M6 — HTTP server, template engine, servlet surface.
+
+Embedded-integration style: a real Switchboard over a temp dir with a
+simulated transport, served by the real HTTP server on an ephemeral port,
+exercised with stdlib urllib — the reference tests its template engine and
+servlets the same direct way (YaCyDefaultServletTest, serverObjectsTest).
+"""
+
+import json
+import urllib.request
+import urllib.parse
+
+import pytest
+
+from yacy_search_server_tpu.server import (ServerObjects, TemplateEngine,
+                                           YaCyHttpServer)
+from yacy_search_server_tpu.switchboard import Switchboard
+
+SITE = {
+    "http://site.test/": (
+        b"<html><head><title>Kernel News</title></head>"
+        b"<body><p>jax tpu kernels for distributed ranking</p>"
+        b"<a href='/a.html'>alpha page</a></body></html>"),
+    "http://site.test/a.html": (
+        b"<html><head><title>Alpha</title></head>"
+        b"<body>sharded postings kernels on tpu hardware</body></html>"),
+    "http://site.test/robots.txt": b"User-agent: *\n",
+}
+
+
+def _transport(url, headers):
+    if url in SITE:
+        return 200, {"content-type": "text/html"}, SITE[url]
+    return 404, {}, b""
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("srv")
+    sb = Switchboard(data_dir=str(tmp / "DATA"), transport=_transport)
+    sb.latency.min_delta_s = 0.0
+    sb.start_crawl("http://site.test/", depth=1)
+    sb.crawl_until_idle(timeout_s=30)
+    srv = YaCyHttpServer(sb, port=0).start()
+    yield srv
+    srv.close()
+    sb.close()
+
+
+def _get(server, path: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(server.base_url + path, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+# -- template engine -----------------------------------------------------
+
+
+def test_template_fields_and_alternatives():
+    eng = TemplateEngine([])
+    p = ServerObjects({"name": "world", "state": 1})
+    assert eng.render("hello #[name]#!", p) == "hello world!"
+    assert eng.render("#(state)#off::on#(/state)#", p) == "on"
+    p.put("state", 0)
+    assert eng.render("#(state)#off::on#(/state)#", p) == "off"
+    # out-of-range selects alternative 0
+    p.put("state", 9)
+    assert eng.render("#(state)#off::on#(/state)#", p) == "off"
+
+
+def test_template_loops_nested():
+    eng = TemplateEngine([])
+    p = ServerObjects({"rows": 2})
+    p.put("rows_0_v", "a")
+    p.put("rows_0_sub", 2)
+    p.put("rows_0_sub_0_x", "1")
+    p.put("rows_0_sub_1_x", "2")
+    p.put("rows_1_v", "b")
+    p.put("rows_1_sub", 0)
+    out = eng.render("#{rows}#[#[v]#:#{sub}##[x]#,#{/sub}#]#{/rows}#", p)
+    assert out == "[a:1,2,][b:]"
+
+
+def test_template_loop_row_alternative():
+    # the eol idiom used by the json templates
+    eng = TemplateEngine([])
+    p = ServerObjects({"items": 2, "items_0_eol": 1, "items_1_eol": 0})
+    out = eng.render("#{items}#x#(eol)#::,#(/eol)##{/items}#", p)
+    assert out == "x,x"
+
+
+# -- search surface ------------------------------------------------------
+
+
+def test_json_search(server):
+    status, body = _get(server, "/yacysearch.json?query=kernels")
+    assert status == 200
+    data = json.loads(body)
+    ch = data["channels"][0]
+    assert int(ch["totalResults"]) >= 1
+    links = [item["link"] for item in ch["items"]]
+    assert any("site.test" in l for l in links)
+    # facets present
+    assert any(nav["facetname"] == "hosts" for nav in ch["navigation"])
+
+
+def test_html_search_page(server):
+    status, body = _get(server, "/yacysearch.html?query=kernels")
+    assert status == 200
+    assert "site.test" in body
+    assert "#[" not in body and "#{" not in body  # template fully resolved
+
+
+def test_rss_opensearch(server):
+    status, body = _get(server, "/yacysearch.rss?query=kernels")
+    assert status == 200
+    assert "<opensearch:totalResults>" in body
+    assert "<item>" in body
+
+
+def test_gsa_xml(server):
+    status, body = _get(server, "/gsasearch.xml?q=kernels&num=5")
+    assert status == 200
+    assert "<GSP" in body and "<U>" in body
+
+
+def test_empty_query(server):
+    status, body = _get(server, "/yacysearch.json?query=")
+    assert status == 200
+    assert json.loads(body)["channels"][0]["items"] == []
+
+
+def test_suggest(server):
+    # 'kernelz' is one edit from indexed 'kernels'
+    status, body = _get(server, "/suggest.json?query=kernelz")
+    assert status == 200
+    data = json.loads(body)
+    words = [s["word"] for s in data["suggestions"]]
+    assert "kernels" in words
+
+
+# -- status / admin ------------------------------------------------------
+
+
+def test_status(server):
+    status, body = _get(server, "/Status.json")
+    assert status == 200
+    data = json.loads(body)
+    assert int(data["urlpublictext"]) == 2
+    assert int(data["rwipublictext"]) > 0
+
+
+def test_admin_localhost_auto(server):
+    # localhost is auto-admin by default (reference security handler)
+    status, body = _get(server, "/ConfigProperties_p.json")
+    assert status == 200
+
+
+def test_admin_denied_without_localhost(server):
+    server.sb.config.set("adminAccountForLocalhost", "false")
+    try:
+        status, _ = _get(server, "/ConfigProperties_p.json")
+        assert status == 401
+    finally:
+        server.sb.config.set("adminAccountForLocalhost", "true")
+
+
+def test_index_control(server):
+    status, body = _get(server,
+                        "/IndexControlURLs_p.json?urlstring="
+                        + urllib.parse.quote("http://site.test/a.html"))
+    assert status == 200
+    data = json.loads(body)
+    assert data["found"] == "1"
+    assert data["url"] == "http://site.test/a.html"
+
+
+def test_rwi_control(server):
+    status, body = _get(server, "/IndexControlRWIs_p.json?keystring=kernels")
+    assert status == 200
+    data = json.loads(body)
+    assert int(data["count"]) >= 1
+
+
+def test_performance_queues(server):
+    status, body = _get(server, "/PerformanceQueues_p.json")
+    assert status == 200
+    data = json.loads(body)
+    assert int(data["table"]) == 4
+
+
+def test_hostbrowser(server):
+    status, body = _get(server, "/HostBrowser.json")
+    assert status == 200
+    data = json.loads(body)
+    assert data["hosts_0_host"] == "site.test"
+    status, body = _get(server, "/HostBrowser.json?path=site.test")
+    data = json.loads(body)
+    assert int(data["files"]) == 2
+
+
+def test_webstructure_api(server):
+    status, body = _get(server, "/webstructure.json")
+    assert status == 200
+
+
+def test_termlist(server):
+    status, body = _get(server, "/termlist_p.json")
+    assert status == 200
+    data = json.loads(body)
+    assert int(data["termcount"]) > 0
+
+
+def test_blacklist_crud(server):
+    status, _ = _get(server, "/blacklists_p.json?action=add&list=default&entry="
+                     + urllib.parse.quote("bad.test/.*"))
+    assert status == 200
+    assert server.sb.blacklist.is_listed("crawler", "http://bad.test/x")
+    assert not server.sb.blacklist.is_listed("crawler", "http://site.test/")
+    status, body = _get(server, "/blacklists_p.json")
+    data = json.loads(body)
+    assert data["lists_0_name"] == "default"
+    _get(server, "/blacklists_p.json?action=delete&list=default&entry="
+         + urllib.parse.quote("bad.test/.*"))
+    assert not server.sb.blacklist.is_listed("crawler", "http://bad.test/x")
+
+
+def test_getpageinfo(server):
+    status, body = _get(server, "/getpageinfo_p.json?url="
+                        + urllib.parse.quote("http://site.test/"))
+    assert status == 200
+    data = json.loads(body)
+    assert data["title"] == "Kernel News"
+    assert int(data["links"]) == 1
+
+
+def test_static_index(server):
+    status, body = _get(server, "/")
+    assert status == 200
+    assert "YaCy-TPU" in body
+
+
+def test_404(server):
+    status, _ = _get(server, "/NoSuchServlet.html")
+    assert status == 404
+
+
+def test_suggest_multiword(server):
+    status, body = _get(server, "/suggest.json?query="
+                        + urllib.parse.quote("tpu kernelz"))
+    assert status == 200
+    words = [s["word"] for s in json.loads(body)["suggestions"]]
+    assert "tpu kernels" in words
+
+
+def test_json_fallback_no_double_escape(server):
+    server.sb.config.set("testquote", 'va"lue')
+    try:
+        status, body = _get(server, "/ConfigProperties_p.json")
+        assert status == 200
+        data = json.loads(body)
+        kv = {data[f"options_{i}_key"]: data[f"options_{i}_value"]
+              for i in range(int(data["options"]))}
+        assert kv["testquote"] == 'va"lue'
+    finally:
+        server.sb.config.set("testquote", "")

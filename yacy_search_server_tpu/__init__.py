@@ -1,0 +1,9 @@
+"""yacy_search_server_tpu — TPU-native decentralized P2P web search engine.
+
+A from-scratch rebuild of the capabilities of YaCy (the reference Java
+implementation) designed TPU-first: postings as dense device blocks,
+ranking as fused JAX/Pallas kernels, DHT axes as jax.sharding mesh axes,
+and the P2P WAN protocol as a host-side RPC layer.
+"""
+
+__version__ = "0.2.0"

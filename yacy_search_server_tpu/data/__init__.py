@@ -1,0 +1,6 @@
+"""Data boards and operator-facing stores — blacklists, work tables,
+bookmarks, wiki, blog, messages, user accounts.
+
+Capability equivalents of the reference's `source/net/yacy/data/` package
+and `source/net/yacy/repository/Blacklist.java`.
+"""

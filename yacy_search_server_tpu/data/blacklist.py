@@ -1,0 +1,150 @@
+"""URL blacklists — named pattern lists filtering crawl/DHT/search/proxy.
+
+Capability equivalent of the reference's blacklist engine (reference:
+source/net/yacy/repository/Blacklist.java + data/ListManager.java):
+entries are `host/path` patterns where the host part may carry `*`
+wildcards and the path part is a regex; each named list can be activated
+for any of the blacklist *types* (crawler, dht, search, news, proxy,
+surftips).  A URL is denied for a type when any active list for that type
+contains a matching pattern.  Lists persist as one `<name>.black` text
+file per list, entries one per line — the reference's on-disk format.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from urllib.parse import urlsplit
+
+TYPES = ("crawler", "dht", "search", "news", "proxy", "surftips")
+
+
+def _host_pattern_to_regex(host: str) -> re.Pattern:
+    # host wildcards: `*.example.org`, `example.*` (Blacklist.java hostpath
+    # matching); translate * -> [^/]* on the escaped host
+    esc = re.escape(host.lower()).replace(r"\*", r"[^/]*")
+    return re.compile(rf"^{esc}$")
+
+
+class _Entry:
+    __slots__ = ("raw", "host_re", "path_re")
+
+    def __init__(self, raw: str):
+        self.raw = raw
+        host, _, path = raw.partition("/")
+        self.host_re = _host_pattern_to_regex(host)
+        if not path or path == "*":
+            path = ".*"
+        try:
+            self.path_re = re.compile(path)
+        except re.error:
+            self.path_re = re.compile(re.escape(path))
+
+    def matches(self, host: str, path: str) -> bool:
+        return bool(self.host_re.match(host)
+                    and self.path_re.fullmatch(path.lstrip("/")))
+
+
+class Blacklist:
+    def __init__(self, data_dir: str | None = None):
+        self.data_dir = data_dir
+        self._lists: dict[str, list[_Entry]] = {}
+        # list name -> set of types it is active for
+        self._active: dict[str, set[str]] = {}
+        # crawler busy-threads match while HTTP admin threads mutate
+        self._lock = threading.RLock()
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+
+    # -- persistence ---------------------------------------------------------
+
+    def _list_path(self, name: str) -> str:
+        return os.path.join(self.data_dir, f"{name}.black")
+
+    def _load(self) -> None:
+        for fn in os.listdir(self.data_dir):
+            if not fn.endswith(".black"):
+                continue
+            name = fn[:-6]
+            with open(os.path.join(self.data_dir, fn), encoding="utf-8") as f:
+                entries = [ln.strip() for ln in f if ln.strip()
+                           and not ln.startswith("#")]
+            self._lists[name] = [_Entry(e) for e in entries]
+            self._active[name] = set(TYPES)
+        actp = os.path.join(self.data_dir, "active.conf")
+        if os.path.isfile(actp):
+            with open(actp, encoding="utf-8") as f:
+                self._active = {}
+                for ln in f:
+                    if "=" in ln:
+                        name, types = ln.strip().split("=", 1)
+                        self._active[name] = set(
+                            t for t in types.split(",") if t in TYPES)
+
+    def _save_list(self, name: str) -> None:
+        if not self.data_dir:
+            return
+        with open(self._list_path(name), "w", encoding="utf-8") as f:
+            for e in self._lists.get(name, []):
+                f.write(e.raw + "\n")
+        with open(os.path.join(self.data_dir, "active.conf"), "w",
+                  encoding="utf-8") as f:
+            for n, types in sorted(self._active.items()):
+                f.write(f"{n}={','.join(sorted(types))}\n")
+
+    # -- management ----------------------------------------------------------
+
+    def add(self, list_name: str, pattern: str,
+            types: set[str] | None = None) -> None:
+        with self._lock:
+            entries = self._lists.setdefault(list_name, [])
+            if any(e.raw == pattern for e in entries):
+                return
+            entries.append(_Entry(pattern))
+            self._active.setdefault(list_name, set(types or TYPES))
+            self._save_list(list_name)
+
+    def remove(self, list_name: str, pattern: str) -> None:
+        with self._lock:
+            entries = self._lists.get(list_name, [])
+            self._lists[list_name] = [e for e in entries if e.raw != pattern]
+            self._save_list(list_name)
+
+    def set_active(self, list_name: str, types: set[str]) -> None:
+        with self._lock:
+            self._active[list_name] = set(t for t in types if t in TYPES)
+            self._save_list(list_name)
+
+    def list_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._lists)
+
+    def entries(self, list_name: str) -> list[str]:
+        with self._lock:
+            return [e.raw for e in self._lists.get(list_name, [])]
+
+    # -- matching ------------------------------------------------------------
+
+    def is_listed(self, btype: str, url: str) -> bool:
+        try:
+            parts = urlsplit(url if "://" in url else "http://" + url)
+        except ValueError:
+            return False
+        host = (parts.hostname or "").lower()
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        with self._lock:
+            for name, entries in self._lists.items():
+                if btype not in self._active.get(name, ()):
+                    continue
+                for e in entries:
+                    if e.matches(host, path):
+                        return True
+        return False
+
+    def crawler_reason(self, url: str) -> str | None:
+        """CrawlStacker-compatible callable: reason string or None."""
+        return "url in crawler blacklist" if self.is_listed("crawler", url) else None

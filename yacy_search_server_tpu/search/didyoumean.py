@@ -1,0 +1,84 @@
+"""DidYouMean — spelling/completion suggestions probed against the index.
+
+Capability equivalent of the reference's suggestion generator (reference:
+source/net/yacy/data/DidYouMean.java): generate candidate words by the
+four edit operations (change/add/delete/transpose letters) plus word
+splits, then keep only candidates that actually occur in the local term
+index, ranked by posting count.  The reference runs producer/consumer
+threads against the IndexCell; here candidate existence is a batched
+probe of the RWI (one `count` lookup per candidate — cheap dict/array
+lookups, no IO).
+"""
+
+from __future__ import annotations
+
+from ..utils.hashes import word2hash
+
+ALPHABET = "abcdefghijklmnopqrstuvwxyz0123456789"
+
+
+class DidYouMean:
+    def __init__(self, segment):
+        self.segment = segment
+
+    def _count(self, word: str) -> int:
+        return self.segment.rwi.count(word2hash(word))
+
+    def candidates(self, word: str) -> set[str]:
+        w = word.lower()
+        cand: set[str] = set()
+        # ChangingOneLetter / AddingOneLetter / DeletingOneLetter /
+        # ReversingTwoConsecutiveLetters (DidYouMean.java producer set)
+        for i in range(len(w)):
+            for c in ALPHABET:
+                cand.add(w[:i] + c + w[i + 1:])
+        for i in range(len(w) + 1):
+            for c in ALPHABET:
+                cand.add(w[:i] + c + w[i:])
+        for i in range(len(w)):
+            cand.add(w[:i] + w[i + 1:])
+        for i in range(len(w) - 1):
+            cand.add(w[:i] + w[i + 1] + w[i] + w[i + 2:])
+        cand.discard(w)
+        cand.discard("")
+        return cand
+
+    def suggest(self, word: str, count: int = 10,
+                include_exact: bool = True) -> list[str]:
+        """Best `count` suggestions by index posting count.  For a
+        multi-word query, the last token is completed and the head is
+        carried through verbatim (reference: suggest.java completes the
+        last token)."""
+        w = word.lower().strip()
+        if not w:
+            return []
+        if " " in w:
+            head, _, last = w.rpartition(" ")
+            return [f"{head} {s}"
+                    for s in self.suggest(last, count, include_exact)]
+        scored: list[tuple[int, str]] = []
+        if include_exact:
+            n = self._count(w)
+            if n:
+                scored.append((n, w))
+        for c in self.candidates(w):
+            n = self._count(c)
+            if n:
+                scored.append((n, c))
+        # word-split candidates: both halves must exist
+        for i in range(1, len(w)):
+            a, b = w[:i].strip(), w[i:].strip()
+            if not a or not b:
+                continue
+            na, nb = self._count(a), self._count(b)
+            if na and nb:
+                scored.append((min(na, nb), f"{a} {b}"))
+        scored.sort(key=lambda t: (-t[0], t[1]))
+        out, seen = [], set()
+        for _, s in scored:
+            if s not in seen:
+                seen.add(s)
+                out.append(s)
+            if len(out) >= count:
+                break
+        return out

@@ -1,0 +1,18 @@
+"""L8 — HTTP server, template engine, servlet dispatch.
+
+Capability equivalent of the reference's web layer (reference:
+source/net/yacy/http/Jetty9HttpServerImpl.java,
+source/net/yacy/http/servlets/YaCyDefaultServlet.java,
+source/net/yacy/server/http/TemplateEngine.java,
+source/net/yacy/server/serverObjects.java). The reference embeds Jetty and
+dispatches `/<Name>.html` to a compiled htroot class by reflection; here a
+stdlib threaded HTTP server dispatches to registered servlet functions and
+fills the matching template with the same #[x]# / #(alt)# / #{loop}#
+placeholder grammar.
+"""
+
+from .objects import ServerObjects
+from .templates import TemplateEngine
+from .httpd import YaCyHttpServer
+
+__all__ = ["ServerObjects", "TemplateEngine", "YaCyHttpServer"]

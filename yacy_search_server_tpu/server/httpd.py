@@ -1,0 +1,232 @@
+"""Threaded HTTP server with servlet dispatch, templates, auth, and the
+P2P wire endpoints.
+
+Capability equivalent of the reference's Jetty embedding (reference:
+source/net/yacy/http/Jetty9HttpServerImpl.java:112-233 handler chain;
+source/net/yacy/http/servlets/YaCyDefaultServlet.java — static files +
+template dispatch; source/net/yacy/http/Jetty9YaCySecurityHandler.java —
+admin auth with localhost auto-admin).  Dispatch rules:
+
+- ``/yacy/<endpoint>.html``  → the node's PeerServer RPC handler (the
+  htroot/yacy/* wire servlets), JSON body in/out (our DCN wire format)
+- ``/<Name>.<ext>``          → registered servlet ``Name``; the response
+  property map fills template ``<Name>.<ext>`` from the htroot template
+  roots; a missing template for ``.json`` serializes the map directly
+- anything else             → static file from the template roots
+- names ending ``_p``       → admin-only (localhost auto-admin or
+  HTTP Basic against config ``adminAccountName``/``adminAccountPassword``)
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, unquote, urlsplit
+
+from .objects import ServerObjects
+from .templates import TemplateEngine
+from . import servlets
+
+_CONTENT_TYPES = {
+    "html": "text/html; charset=utf-8",
+    "json": "application/json; charset=utf-8",
+    "rss": "application/rss+xml; charset=utf-8",
+    "xml": "text/xml; charset=utf-8",
+    "csv": "text/plain; charset=utf-8",
+    "css": "text/css",
+    "js": "application/javascript",
+    "png": "image/png",
+    "ico": "image/x-icon",
+    "txt": "text/plain; charset=utf-8",
+}
+
+DEFAULT_HTROOT = os.path.join(os.path.dirname(__file__), "htroot")
+
+
+class YaCyHttpServer:
+    """One node's HTTP face: UI/API servlets + P2P wire endpoints."""
+
+    def __init__(self, sb, port: int = 8090, host: str = "127.0.0.1",
+                 peer_server=None, htroot_dirs: list[str] | None = None):
+        self.sb = sb
+        self.peer_server = peer_server
+        roots = list(htroot_dirs or [])
+        roots.append(DEFAULT_HTROOT)
+        self.templates = TemplateEngine(roots)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                outer._handle(self, {})
+
+            def do_POST(self):
+                length = int(self.headers.get("content-length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                ctype = self.headers.get("content-type", "")
+                if "application/json" in ctype:
+                    try:
+                        post = json.loads(body.decode("utf-8"))
+                    except ValueError:
+                        post = {}
+                else:
+                    post = dict(parse_qsl(body.decode("utf-8", "replace"),
+                                          keep_blank_values=True))
+                outer._handle(self, post)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "YaCyHttpServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="httpd", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- auth ----------------------------------------------------------------
+
+    def _is_admin(self, handler) -> bool:
+        client_ip = handler.client_address[0]
+        cfg = self.sb.config
+        if client_ip in ("127.0.0.1", "::1") and cfg.get_bool(
+                "adminAccountForLocalhost", True):
+            return True
+        auth = handler.headers.get("authorization", "")
+        if auth.lower().startswith("basic "):
+            try:
+                user, _, pw = base64.b64decode(
+                    auth[6:]).decode("utf-8").partition(":")
+            except Exception:
+                return False
+            return (user == cfg.get("adminAccountName", "admin")
+                    and pw != "" and pw == cfg.get("adminAccountPassword", ""))
+        return False
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _handle(self, handler, post_params: dict) -> None:
+        try:
+            parts = urlsplit(handler.path)
+            path = unquote(parts.path)
+            params = dict(parse_qsl(parts.query, keep_blank_values=True))
+            params.update(post_params)
+
+            if path.startswith("/yacy/"):
+                self._handle_wire(handler, path, params)
+                return
+
+            if path in ("", "/"):
+                path = "/index.html"
+            name, _, ext = path.lstrip("/").rpartition(".")
+            if not name:
+                name, ext = ext, "html"
+
+            fn = servlets.lookup(name)
+            if fn is None:
+                self._serve_static(handler, path.lstrip("/"))
+                return
+            if name.endswith("_p") and not self._is_admin(handler):
+                self._send(handler, 401, "text/plain",
+                           b"admin authorization required",
+                           extra={"WWW-Authenticate": 'Basic realm="YaCy"'})
+                return
+
+            post = ServerObjects(params)
+            header = {"ext": ext, "path": path,
+                      "client_ip": handler.client_address[0],
+                      "method": handler.command}
+            prop = fn(header, post, self.sb)
+            body = self._render(name, ext, prop)
+            ctype = _CONTENT_TYPES.get(ext, "text/html; charset=utf-8")
+            self._send(handler, 200, ctype, body.encode("utf-8"))
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # CrashProtectionHandler parity
+            try:
+                self._send(handler, 500, "text/plain",
+                           f"server error: {e}".encode("utf-8"))
+            except Exception:
+                pass
+
+    def _render(self, name: str, ext: str, prop: ServerObjects) -> str:
+        tmpl = f"{name}.{ext}"
+        if self.templates.resolve(tmpl) is not None:
+            return self.templates.render_file(tmpl, prop)
+        # No template: serialize the property map directly. Values follow
+        # the template contract — the servlet already escaped them for the
+        # output medium — so insert them verbatim (json.dumps would
+        # double-escape what escape_json produced).
+        rows = ",\n".join(f' {json.dumps(k)}: "{v}"'
+                          for k, v in sorted(prop.items()))
+        return "{\n" + rows + "\n}"
+
+    def _handle_wire(self, handler, path: str, params: dict) -> None:
+        if self.peer_server is None:
+            self._send(handler, 404, "text/plain", b"p2p disabled")
+            return
+        endpoint = path[len("/yacy/"):]
+        if endpoint.endswith(".html"):
+            endpoint = endpoint[:-5]
+        result = self.peer_server.handle(endpoint, params)
+        body = json.dumps(result, default=_wire_default).encode("utf-8")
+        self._send(handler, 200, "application/json", body)
+
+    def _serve_static(self, handler, relpath: str) -> None:
+        if ".." in relpath:
+            self._send(handler, 403, "text/plain", b"forbidden")
+            return
+        path = self.templates.resolve(relpath)
+        if path is None:
+            self._send(handler, 404, "text/plain", b"not found")
+            return
+        ext = relpath.rpartition(".")[2]
+        with open(path, "rb") as f:
+            data = f.read()
+        self._send(handler, 200, _CONTENT_TYPES.get(ext, "application/octet-stream"), data)
+
+    @staticmethod
+    def _send(handler, status: int, ctype: str, body: bytes,
+              extra: dict | None = None) -> None:
+        handler.send_response(status)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            handler.send_header(k, v)
+        handler.end_headers()
+        handler.wfile.write(body)
+
+
+def _wire_default(obj):
+    """JSON fallback for wire payloads: bytes → base64 strings, numpy →
+    lists (the HTTP DCN transport's serialization rules)."""
+    import numpy as np
+    if isinstance(obj, bytes):
+        return base64.b64encode(obj).decode("ascii")
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(f"not serializable: {type(obj)}")

@@ -1,0 +1,47 @@
+"""Servlet registry — the htroot dispatch table.
+
+The reference compiles `htroot/<Name>.java` classes and invokes their
+static `respond(RequestHeader, serverObjects, serverSwitch)` by reflection
+(reference: source/net/yacy/http/servlets/YaCyDefaultServlet.java:658,
+765-785). Here servlets are plain functions with the same signature,
+registered by name; `/<Name>.<ext>` dispatches to the function and then
+fills the `<Name>.<ext>` template.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..objects import ServerObjects
+
+Servlet = Callable[[dict, ServerObjects, object], ServerObjects]
+
+_REGISTRY: dict[str, Servlet] = {}
+
+
+def servlet(name: str):
+    def deco(fn: Servlet) -> Servlet:
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def lookup(name: str) -> Servlet | None:
+    _ensure_loaded()
+    return _REGISTRY.get(name)
+
+
+def names() -> list[str]:
+    _ensure_loaded()
+    return sorted(_REGISTRY)
+
+
+_loaded = False
+
+
+def _ensure_loaded() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from . import yacysearch, status, admin, api  # noqa: F401

@@ -1,0 +1,180 @@
+"""Admin servlets — crawl control, index control, config, performance.
+
+Capability equivalents of the reference's admin surface (reference:
+htroot/Crawler_p.java:89 — crawl start/stop; htroot/IndexControlURLs_p.java
+— per-URL index inspection/deletion; htroot/IndexControlRWIs_p.java — term
+index control; htroot/ConfigProperties_p.java — raw config editor;
+htroot/PerformanceQueues_p.java — pipeline/busy-thread introspection;
+htroot/HostBrowser.java — index browsing by host).  The `_p` suffix marks
+admin-protected pages, enforced by the HTTP layer exactly as the
+reference's security handler does by path.
+"""
+
+from __future__ import annotations
+
+from ...utils.hashes import url2hash, word2hash
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+
+@servlet("Crawler_p")
+def respond_crawler(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    if "crawlingstart" in post and post.get("crawlingURL"):
+        url = post.get("crawlingURL")
+        depth = post.get_int("crawlingDepth", 0)
+        kwargs = {}
+        if post.get("mustmatch"):
+            kwargs["mustmatch"] = post.get("mustmatch")
+        if post.get("mustnotmatch"):
+            kwargs["mustnotmatch"] = post.get("mustnotmatch")
+        try:
+            profile = sb.start_crawl(url, depth=depth, **kwargs)
+            prop.put("started", 1)
+            prop.put("handle", profile.handle)
+            prop.put("info", "")
+        except ValueError as e:
+            prop.put("started", 0)
+            prop.put("info", escape_json(str(e)))
+    else:
+        prop.put("started", 0)
+        prop.put("info", "")
+    profiles = list(sb.profiles.values())
+    prop.put("crawlProfiles", len(profiles))
+    for i, p in enumerate(profiles):
+        pre = f"crawlProfiles_{i}_"
+        prop.put(pre + "handle", p.handle)
+        prop.put(pre + "name", escape_json(p.name))
+        prop.put(pre + "depth", p.depth)
+        prop.put(pre + "eol", 1 if i < len(profiles) - 1 else 0)
+    from ...crawler.frontier import StackType
+    prop.put("localCrawlSize", sb.noticed.size(StackType.LOCAL))
+    return prop
+
+
+@servlet("IndexControlURLs_p")
+def respond_urlcontrol(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    prop.put("found", 0)
+    prop.put("deleted", 0)
+    url = post.get("urlstring")
+    urlhash = post.get("urlhash")
+    if url and not urlhash:
+        urlhash = url2hash(url).decode("ascii")
+    if urlhash:
+        h = urlhash.encode("ascii")
+        meta = sb.index.metadata.get_by_urlhash(h)
+        if meta is not None:
+            prop.put("found", 1)
+            prop.put("url", escape_json(meta.get("sku", "")))
+            prop.put("title", escape_json(meta.get("title", "")))
+            prop.put("hash", urlhash)
+            prop.put("wordcount", meta.get("wordcount_i", 0))
+            if "urldelete" in post:
+                sb.index.remove_document(h)
+                prop.put("deleted", 1)
+    prop.put("urlcount", sb.index.doc_count())
+    return prop
+
+
+@servlet("IndexControlRWIs_p")
+def respond_rwicontrol(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    word = post.get("keystring", "").strip().lower()
+    prop.put("keystring", escape_json(word))
+    prop.put("count", 0)
+    prop.put("urls", 0)
+    if word:
+        th = word2hash(word)
+        prop.put("keyhash", th.decode("ascii", "replace"))
+        if "deleteterm" in post:
+            removed = sb.index.rwi.remove_term(th)
+            prop.put("deletedrefs", len(removed))
+        plist = sb.index.rwi.get(th)
+        prop.put("count", len(plist))
+        n = min(len(plist), post.get_int("maxlisted", 25))
+        prop.put("urls", n)
+        for i in range(n):
+            docid = int(plist.docids[i])
+            meta = sb.index.get_metadata(docid)
+            prop.put(f"urls_{i}_url",
+                     escape_json(meta.get("sku", "") if meta else ""))
+            prop.put(f"urls_{i}_eol", 1 if i < n - 1 else 0)
+    prop.put("rwicount", sb.index.rwi_size())
+    return prop
+
+
+@servlet("ConfigProperties_p")
+def respond_config(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    if post.get("key") and "set" in post:
+        sb.config.set(post.get("key"), post.get("value", ""))
+    keys = sorted(sb.config.keys())
+    prop.put("options", len(keys))
+    for i, k in enumerate(keys):
+        prop.put(f"options_{i}_key", escape_json(k))
+        prop.put(f"options_{i}_value", escape_json(sb.config.get(k)))
+        prop.put(f"options_{i}_eol", 1 if i < len(keys) - 1 else 0)
+    return prop
+
+
+@servlet("PerformanceQueues_p")
+def respond_queues(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    procs = [sb._parse_proc, sb._condense_proc, sb._structure_proc,
+             sb._store_proc]
+    prop.put("table", len(procs))
+    for i, p in enumerate(procs):
+        pre = f"table_{i}_"
+        m = p.metrics
+        prop.put(pre + "name", p.name)
+        prop.put(pre + "queued", p.queue.qsize())
+        prop.put(pre + "executed", m.processed)
+        prop.put(pre + "errors", m.errors)
+        prop.put(pre + "avgexecms", f"{m.avg_exec_ms:.3f}")
+        prop.put(pre + "workers", m.workers)
+        prop.put(pre + "eol", 1 if i < len(procs) - 1 else 0)
+    threads = getattr(sb, "threads", None)
+    names = threads.names() if threads else []
+    prop.put("busythreads", len(names))
+    for i, name in enumerate(names):
+        bt = threads.get(name)
+        pre = f"busythreads_{i}_"
+        prop.put(pre + "name", name)
+        prop.put(pre + "busycycles", bt.busy_cycles)
+        prop.put(pre + "idlecycles", bt.idle_cycles)
+        prop.put(pre + "errors", bt.errors)
+        prop.put(pre + "eol", 1 if i < len(names) - 1 else 0)
+    return prop
+
+
+@servlet("HostBrowser")
+def respond_hostbrowser(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    wanted = post.get("path", "").strip()
+    store = sb.index.metadata
+    hosts: dict[str, int] = {}
+    urls: list[str] = []
+    for d in range(store.capacity()):
+        m = store.get(d)
+        if m is None:
+            continue
+        h = m.get("host_s", "")
+        hosts[h] = hosts.get(h, 0) + 1
+        if wanted and h == wanted:
+            urls.append(m.get("sku", ""))
+    if not wanted:
+        top = sorted(hosts.items(), key=lambda t: -t[1])
+        prop.put("hosts", len(top))
+        for i, (h, c) in enumerate(top):
+            prop.put(f"hosts_{i}_host", escape_json(h))
+            prop.put(f"hosts_{i}_count", c)
+            prop.put(f"hosts_{i}_eol", 1 if i < len(top) - 1 else 0)
+        prop.put("files", 0)
+    else:
+        prop.put("hosts", 0)
+        prop.put("files", len(urls))
+        for i, u in enumerate(urls):
+            prop.put(f"files_{i}_url", escape_json(u))
+            prop.put(f"files_{i}_eol", 1 if i < len(urls) - 1 else 0)
+    return prop

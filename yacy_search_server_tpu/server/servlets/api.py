@@ -1,0 +1,138 @@
+"""Machine API servlets — htroot/api/* equivalents.
+
+Capability equivalents of the reference's machine endpoints (reference:
+htroot/api/status_p.java, termlist_p.java, webstructure.java,
+citation.java, linkstructure.java, timeline_p.java, latency_p.java).
+All emit JSON through templates or direct property maps.
+"""
+
+from __future__ import annotations
+
+from ...utils.hashes import url2hash, word2hash
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+
+@servlet("termlist_p")
+def respond_termlist(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Term census of the local RWI (reference: htroot/api/termlist_p.java)."""
+    prop = ServerObjects()
+    maxn = post.get_int("maxlisted", 100)
+    rows = []
+    rwi = sb.index.rwi
+    hashes = rwi.term_hashes()
+    for th in hashes:
+        rows.append((th, rwi.count(th)))
+    rows.sort(key=lambda t: -t[1])
+    rows = rows[:maxn]
+    prop.put("termcount", len(hashes))
+    prop.put("terms", len(rows))
+    for i, (th, c) in enumerate(rows):
+        prop.put(f"terms_{i}_hash", th.decode("ascii", "replace"))
+        prop.put(f"terms_{i}_count", c)
+        prop.put(f"terms_{i}_eol", 1 if i < len(rows) - 1 else 0)
+    return prop
+
+
+@servlet("webstructure")
+def respond_webstructure(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Host-level link structure (reference: htroot/api/webstructure.java)."""
+    prop = ServerObjects()
+    ws = sb.web_structure
+    about = post.get("about", "").strip()
+    if about:
+        hosts = [about] if ws.references_count(about) or ws.outgoing(about) else []
+    else:
+        hosts = [h for h, _ in ws.top_hosts(post.get_int("maxhosts", 50))]
+    prop.put("hosts", len(hosts))
+    for i, h in enumerate(hosts):
+        pre = f"hosts_{i}_"
+        out = ws.outgoing(h)
+        prop.put(pre + "host", escape_json(h))
+        prop.put(pre + "references", ws.references_count(h))
+        targets = sorted(out.items(), key=lambda t: -t[1])
+        prop.put(pre + "targets", len(targets))
+        for j, (t, c) in enumerate(targets):
+            prop.put(f"{pre}targets_{j}_host", escape_json(t))
+            prop.put(f"{pre}targets_{j}_count", c)
+            prop.put(f"{pre}targets_{j}_eol", 1 if j < len(targets) - 1 else 0)
+        prop.put(pre + "eol", 1 if i < len(hosts) - 1 else 0)
+    return prop
+
+
+@servlet("citation")
+def respond_citation(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Inbound citations of one URL (reference: htroot/api/citation.java)."""
+    prop = ServerObjects()
+    url = post.get("url", "").strip()
+    prop.put("url", escape_json(url))
+    prop.put("citations", 0)
+    if not url:
+        return prop
+    h = url2hash(url)
+    metas = []
+    for docid in sb.index.citations.citing_docids(h):
+        m = sb.index.metadata.get(docid)
+        if m is not None:
+            metas.append(m)
+    prop.put("citations", len(metas))
+    for i, m in enumerate(metas):
+        prop.put(f"citations_{i}_url", escape_json(m.get("sku", "")))
+        prop.put(f"citations_{i}_eol", 1 if i < len(metas) - 1 else 0)
+    return prop
+
+
+@servlet("blacklists_p")
+def respond_blacklists(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Blacklist CRUD (reference: htroot/Blacklist_p.java +
+    htroot/api/blacklists/*)."""
+    prop = ServerObjects()
+    bl = sb.blacklist
+    action = post.get("action", "")
+    if action == "add" and post.get("entry"):
+        bl.add(post.get("list", "default"), post.get("entry"))
+    elif action == "delete" and post.get("entry"):
+        bl.remove(post.get("list", "default"), post.get("entry"))
+    lists = bl.list_names()
+    prop.put("lists", len(lists))
+    for i, name in enumerate(lists):
+        entries = bl.entries(name)
+        pre = f"lists_{i}_"
+        prop.put(pre + "name", escape_json(name))
+        prop.put(pre + "entries", len(entries))
+        for j, e in enumerate(entries):
+            prop.put(f"{pre}entries_{j}_pattern", escape_json(e))
+            prop.put(f"{pre}entries_{j}_eol", 1 if j < len(entries) - 1 else 0)
+        prop.put(pre + "eol", 1 if i < len(lists) - 1 else 0)
+    return prop
+
+
+@servlet("getpageinfo_p")
+def respond_pageinfo(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Fetch+parse a page for the crawl-start UI preview (reference:
+    htroot/api/getpageinfo_p.java)."""
+    prop = ServerObjects()
+    url = post.get("url", "").strip()
+    prop.put("url", escape_json(url))
+    prop.put("title", "")
+    prop.put("robots-allowed", 1)
+    prop.put("links", 0)
+    if not url:
+        return prop
+    try:
+        from ...crawler.request import Request
+        resp = sb.loader.load(Request(url=url))
+        from ...document.parser.registry import parse_source
+        docs = parse_source(url, resp.mime_type(), resp.content)
+        if docs:
+            doc = docs[0]
+            prop.put("title", escape_json(doc.title))
+            n = min(len(doc.anchors), 200)
+            prop.put("links", n)
+            for i, a in enumerate(doc.anchors[:n]):
+                prop.put(f"links_{i}_url", escape_json(a.url))
+                prop.put(f"links_{i}_eol", 1 if i < n - 1 else 0)
+        prop.put("robots-allowed", 1 if sb.robots.is_allowed(url) else 0)
+    except Exception as e:
+        prop.put("error", escape_json(str(e)))
+    return prop

@@ -1,0 +1,59 @@
+"""Status + network overview servlets.
+
+Capability equivalent of the reference's dashboards (reference:
+htroot/Status.java — peer/index/memory summary; htroot/Network.java —
+peer table; htroot/api/status_p.java — machine-readable status).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ... import __version__
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+
+@servlet("Status")
+def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    prop.put("versionpp", __version__)
+    prop.put("uptime", int(time.time() - getattr(sb, "started", time.time())))
+    prop.put("urlpublictext", sb.index.doc_count())
+    prop.put("rwipublictext", sb.index.rwi_size())
+    prop.put("indexedcount", getattr(sb, "indexed_count", 0))
+    seeddb = getattr(sb, "seeddb", None)
+    prop.put("peername",
+             escape_json(seeddb.my_seed.name) if seeddb else "localpeer")
+    prop.put("activepeers", len(seeddb.active_seeds()) if seeddb else 0)
+    noticed = getattr(sb, "noticed", None)
+    from ...crawler.frontier import StackType
+    prop.put("crawlqueuesize",
+             noticed.size(StackType.LOCAL) if noticed else 0)
+    import os
+    try:
+        import resource
+        mem = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    except Exception:
+        mem = 0
+    prop.put("usedmemory", mem)
+    prop.put("pid", os.getpid())
+    return prop
+
+
+@servlet("Network")
+def respond_network(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    seeddb = getattr(sb, "seeddb", None)
+    seeds = list(seeddb.active_seeds()) if seeddb else []
+    prop.put("table", len(seeds))
+    for i, s in enumerate(seeds):
+        p = f"table_{i}_"
+        prop.put(p + "hash", s.hash.decode("ascii", "replace"))
+        prop.put(p + "name", escape_json(s.name))
+        prop.put(p + "address", escape_json(f"{s.ip}:{s.port}"))
+        prop.put(p + "urls", getattr(s, "link_count", 0))
+        prop.put(p + "rwis", getattr(s, "word_count", 0))
+        prop.put(p + "eol", 1 if i < len(seeds) - 1 else 0)
+    prop.put("activecount", len(seeds))
+    return prop

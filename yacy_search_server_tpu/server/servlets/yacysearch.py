@@ -1,0 +1,145 @@
+"""Search servlets — HTML/JSON/OpenSearch-RSS search surface + GSA XML.
+
+Capability equivalent of the reference's search UI/API servlets
+(reference: htroot/yacysearch.java:1059 — query parsing, event lookup,
+result paging, template fill; htroot/yacysearch.json + yacysearch.rss
+templates for the machine formats;
+source/net/yacy/http/servlets/GSAsearchServlet.java for the
+Google-Search-Appliance-compatible XML).  One `respond` backs all output
+formats — the template chosen by extension renders the same property set.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..objects import (ServerObjects, escape_html, escape_json, escape_xml)
+from . import servlet
+
+
+def _fill_items(prop: ServerObjects, results, esc) -> None:
+    prop.put("items", len(results))
+    for i, r in enumerate(results):
+        p = f"items_{i}_"
+        prop.put(p + "title", esc(r.title or r.url))
+        prop.put(p + "link", esc(r.url))
+        prop.put(p + "description", esc(r.snippet))
+        prop.put(p + "urlhash", r.urlhash.decode("ascii", "replace"))
+        prop.put(p + "host", esc(r.host))
+        prop.put(p + "size", r.size)
+        prop.put(p + "sizename", _sizename(r.size))
+        prop.put(p + "ranking", int(r.score))
+        prop.put(p + "source", esc(str(r.source)))
+        prop.put(p + "filetype", esc(r.filetype))
+        prop.put(p + "eol", 1 if i < len(results) - 1 else 0)
+
+
+def _sizename(n: int) -> str:
+    for unit in ("bytes", "kB", "MB", "GB"):
+        if n < 1024:
+            return f"{n} {unit}"
+        n //= 1024
+    return f"{n} TB"
+
+
+def _fill_navigation(prop: ServerObjects, event, esc) -> None:
+    navs = [(name, nav.top(10)) for name, nav in event.navigators.items()
+            if len(nav) > 0]
+    prop.put("navigation", len(navs))
+    for i, (name, entries) in enumerate(navs):
+        p = f"navigation_{i}_"
+        prop.put(p + "facetname", esc(name))
+        prop.put(p + "elements", len(entries))
+        for j, (value, count) in enumerate(entries):
+            q = f"{p}elements_{j}_"
+            prop.put(q + "name", esc(str(value)))
+            prop.put(q + "count", count)
+            prop.put(q + "eol", 1 if j < len(entries) - 1 else 0)
+        prop.put(p + "eol", 1 if i < len(navs) - 1 else 0)
+
+
+def _esc_for(ext: str):
+    return {"json": escape_json, "rss": escape_xml, "xml": escape_xml,
+            }.get(ext, escape_html)
+
+
+@servlet("yacysearch")
+def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    query = post.get("query", post.get("search", "")).strip()
+    count = min(max(post.get_int("maximumRecords", post.get_int("count", 10)), 1), 100)
+    offset = max(post.get_int("startRecord", post.get_int("offset", 0)), 0)
+    ext = header.get("ext", "html")
+    esc = _esc_for(ext)
+
+    prop.put("promoteSearchPageGreeting",
+             esc(sb.config.get("promoteSearchPageGreeting",
+                               "YaCy TPU P2P Web Search")))
+    prop.put("former", esc(query))
+    prop.put("count", count)
+    prop.put("offset", offset)
+    prop.put("searchtime", 0)
+    if not query:
+        prop.put("items", 0)
+        prop.put("found", 0)
+        prop.put("navigation", 0)
+        prop.put("totalcount", 0)
+        return prop
+
+    t0 = time.time()
+    event = sb.search(query, count=count, offset=offset)
+    results = event.results(offset=offset, count=count)
+    prop.put("searchtime", int((time.time() - t0) * 1000))
+    prop.put("totalcount", event.local_rwi_considered + event.remote_results)
+    prop.put("found", 1 if results else 0)
+    _fill_items(prop, results, esc)
+    _fill_navigation(prop, event, esc)
+    return prop
+
+
+@servlet("gsasearch")
+def respond_gsa(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """GSA-compatible parameter mapping: q, num, start → the same search
+    (reference: GSAsearchServlet.java maps the GSA request onto an
+    internal search and emits <GSP> XML)."""
+    prop = ServerObjects()
+    query = post.get("q", "").strip()
+    count = min(max(post.get_int("num", 10), 1), 100)
+    offset = max(post.get_int("start", 0), 0)
+    prop.put("q", escape_xml(query))
+    prop.put("count", count)
+    prop.put("offset", offset)
+    if not query:
+        prop.put("items", 0)
+        prop.put("totalcount", 0)
+        return prop
+    t0 = time.time()
+    event = sb.search(query, count=count, offset=offset)
+    results = event.results(offset=offset, count=count)
+    prop.put("searchtime", f"{time.time() - t0:.6f}")
+    prop.put("totalcount", event.local_rwi_considered + event.remote_results)
+    prop.put("items", len(results))
+    for i, r in enumerate(results):
+        p = f"items_{i}_"
+        prop.put(p + "rank", offset + i + 1)
+        prop.put(p + "link", escape_xml(r.url))
+        prop.put(p + "title", escape_xml(r.title or r.url))
+        prop.put(p + "description", escape_xml(r.snippet))
+        prop.put(p + "size", r.size)
+    return prop
+
+
+@servlet("suggest")
+def respond_suggest(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Word-completion suggestions against the indexed vocabulary
+    (reference: htroot/suggest.java backed by data/DidYouMean.java)."""
+    from ...search.didyoumean import DidYouMean
+    prop = ServerObjects()
+    q = post.get("query", post.get("q", "")).strip()
+    prop.put("query", escape_json(q))
+    sugg = DidYouMean(sb.index).suggest(q, count=10) if q else []
+    prop.put("suggestions", len(sugg))
+    for i, s in enumerate(sugg):
+        prop.put(f"suggestions_{i}_word", escape_json(s))
+        prop.put(f"suggestions_{i}_eol", 1 if i < len(sugg) - 1 else 0)
+    return prop

@@ -1,0 +1,184 @@
+"""Streaming template engine — #[x]#, #(alt)#, #{loop}#, #%include%#.
+
+Capability equivalent of the reference's template grammar (reference:
+source/net/yacy/server/http/TemplateEngine.java:84-146):
+
+- ``#[key]#``                      → value of ``key`` in the pattern map
+- ``#(key)#a::b::c#(/key)#``       → alternative selected by int(key)
+  (out-of-range or non-numeric selects alternative 0)
+- ``#{key}#body#{/key}#``          → body repeated int(key) times; inside
+  iteration i, ``#[field]#`` resolves ``key_i_field`` first (the
+  serverObjects loop-row convention), and nested alternatives resolve the
+  same prefixed keys
+- ``#%path%#``                     → include of another template file,
+  resolved against the template root
+
+The reference streams byte-wise; templates here are small enough to
+process as strings with one recursive-descent pass, which keeps nesting
+of loops and alternatives correct.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from .objects import ServerObjects
+
+_FIELD_RE = re.compile(r"#\[([A-Za-z0-9_.-]+)\]#")
+_INCLUDE_RE = re.compile(r"#%([A-Za-z0-9_./-]+)%#")
+
+
+class TemplateEngine:
+    def __init__(self, roots: list[str] | None = None):
+        # template search path: later roots are fallbacks (the reference
+        # overlays DATA/HTDOCS over htroot the same way)
+        self.roots = list(roots or [])
+
+    def resolve(self, name: str) -> str | None:
+        for root in self.roots:
+            p = os.path.join(root, name)
+            if os.path.isfile(p):
+                return p
+        return None
+
+    def render_file(self, name: str, props: ServerObjects) -> str:
+        path = self.resolve(name)
+        if path is None:
+            raise FileNotFoundError(name)
+        with open(path, encoding="utf-8") as f:
+            return self.render(f.read(), props)
+
+    def render(self, template: str, props: ServerObjects) -> str:
+        template = self._expand_includes(template, depth=0)
+        return self._render(template, props, prefix="")
+
+    # -- internals -----------------------------------------------------------
+
+    def _expand_includes(self, text: str, depth: int) -> str:
+        if depth > 8:
+            return text
+
+        def repl(m: re.Match) -> str:
+            path = self.resolve(m.group(1))
+            if path is None:
+                return ""
+            with open(path, encoding="utf-8") as f:
+                return self._expand_includes(f.read(), depth + 1)
+
+        return _INCLUDE_RE.sub(repl, text)
+
+    def _lookup(self, props: ServerObjects, prefix: str, key: str) -> str | None:
+        if prefix:
+            v = props.get(prefix + key, None) if (prefix + key) in props else None
+            if v is not None:
+                return v
+        return props.get(key) if key in props else None
+
+    def _render(self, text: str, props: ServerObjects, prefix: str) -> str:
+        out: list[str] = []
+        i = 0
+        n = len(text)
+        while i < n:
+            j = text.find("#", i)
+            if j < 0 or j + 1 >= n:
+                out.append(text[i:])
+                break
+            out.append(text[i:j])
+            tag = text[j + 1]
+            if tag == "[":
+                end = text.find("]#", j + 2)
+                if end < 0:
+                    out.append(text[j:])
+                    break
+                key = text[j + 2:end]
+                v = self._lookup(props, prefix, key)
+                out.append(v if v is not None else "")
+                i = end + 2
+            elif tag == "(":
+                end = text.find(")#", j + 2)
+                if end < 0:
+                    out.append(text[j:])
+                    break
+                key = text[j + 2:end]
+                close = f"#(/{key})#"
+                k = text.find(close, end + 2)
+                if k < 0:
+                    out.append(text[j:])
+                    break
+                body = text[end + 2:k]
+                alts = self._split_alternatives(body)
+                v = self._lookup(props, prefix, key) or "0"
+                try:
+                    sel = int(v)
+                except ValueError:
+                    sel = 0
+                if not 0 <= sel < len(alts):
+                    sel = 0
+                out.append(self._render(alts[sel], props, prefix))
+                i = k + len(close)
+            elif tag == "{":
+                end = text.find("}#", j + 2)
+                if end < 0:
+                    out.append(text[j:])
+                    break
+                key = text[j + 2:end]
+                close = f"#{{/{key}}}#"
+                k = self._find_matching_loop_close(text, end + 2, key)
+                if k < 0:
+                    out.append(text[j:])
+                    break
+                body = text[end + 2:k]
+                v = self._lookup(props, prefix, key) or "0"
+                try:
+                    count = int(v)
+                except ValueError:
+                    count = 0
+                for it in range(count):
+                    out.append(self._render(body, props,
+                                            prefix=f"{prefix}{key}_{it}_"))
+                i = k + len(close)
+            else:
+                out.append("#")
+                i = j + 1
+        return "".join(out)
+
+    @staticmethod
+    def _split_alternatives(body: str) -> list[str]:
+        """Split on :: at nesting depth 0 (alternatives may nest tags)."""
+        alts, cur, depth, i, n = [], [], 0, 0, len(body)
+        while i < n:
+            if body.startswith("#(", i) and not body.startswith("#(/", i):
+                depth += 1
+                cur.append(body[i:i + 2]); i += 2
+            elif body.startswith("#(/", i):
+                depth -= 1
+                cur.append(body[i:i + 3]); i += 3
+            elif depth == 0 and body.startswith("::", i):
+                alts.append("".join(cur)); cur = []; i += 2
+            else:
+                cur.append(body[i]); i += 1
+        alts.append("".join(cur))
+        return alts
+
+    @staticmethod
+    def _find_matching_loop_close(text: str, start: int, key: str) -> int:
+        """Index of the #{/key}# matching the loop opened before `start`,
+        honoring nested loops with the same key."""
+        open_tag = f"#{{{key}}}#"
+        close_tag = f"#{{/{key}}}#"
+        depth = 1
+        i = start
+        while True:
+            c = text.find(close_tag, i)
+            if c < 0:
+                return -1
+            o = text.find(open_tag, i)
+            if 0 <= o < c:
+                depth += 1
+                i = o + len(open_tag)
+                continue
+            depth -= 1
+            if depth == 0:
+                return c
+            i = c + len(close_tag)

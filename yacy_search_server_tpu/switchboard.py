@@ -34,6 +34,7 @@ from .crawler.queues import CrawlQueues
 from .crawler.request import Request, Response
 from .crawler.robots import RobotsTxt
 from .crawler.stacker import CrawlStacker
+from .data.blacklist import Blacklist
 from .document.condenser import Condenser
 from .document.document import Document
 from .document.parser import ParserError, parse_source
@@ -79,9 +80,10 @@ class Switchboard:
         for p in default_profiles().values():
             self.profiles[p.handle] = p
         self.noticed = NoticedURL(self.latency, sub("CRAWL"))
+        self.blacklist = Blacklist(sub("BLACKLISTS"))
         self.crawl_stacker = CrawlStacker(
             self.noticed, self.profiles, segment=self.index,
-            robots=self.robots)
+            robots=self.robots, blacklist=self.blacklist.crawler_reason)
         self.crawl_queues = CrawlQueues(
             self.noticed, self.loader, self.profiles, robots=self.robots,
             indexer=self.to_indexer)
@@ -90,6 +92,7 @@ class Switchboard:
         self.threads = ThreadRegistry()
 
         self.indexed_count = 0
+        self.started = time.time()
         self._closed = False
 
         # the 4-stage pipeline; stage 4 single-worker = serialized IO
