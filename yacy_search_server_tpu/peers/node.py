@@ -67,6 +67,12 @@ class P2PNode:
     def bootstrap(self, seeds: list[Seed]) -> None:
         self.network.bootstrap = [s for s in seeds
                                   if s.hash != self.seed.hash]
+        # over HTTP, bootstrap seeds carry the initial address book (the
+        # reference's seed-list files carry IP:port the same way)
+        if hasattr(self._transport, "set_address"):
+            for s in self.network.bootstrap:
+                self._transport.set_address(
+                    s.hash, f"http://{s.ip}:{s.port}")
 
     def ping(self) -> int:
         return self.network.peer_ping()
@@ -132,9 +138,35 @@ class P2PNode:
                 rs.join(timeout_s / 2)
         return event
 
+    # -- HTTP face (DCN deployment) ------------------------------------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Expose this node's UI/API + /yacy/* wire endpoints over a real
+        socket and advertise the bound address in the seed DNA (the
+        reference's Jetty startup + Seed IP/port publication). When the
+        node's transport is an HttpTransport without a resolver, wire the
+        SeedDB in as the address book — gossiped seeds become reachable."""
+        from ..server.httpd import YaCyHttpServer
+        from .transport import HttpTransport
+
+        self.http = YaCyHttpServer(self.sb, port=port, host=host,
+                                   peer_server=self.server).start()
+        self.seed.ip = host
+        self.seed.port = self.http.port
+        if isinstance(self._transport, HttpTransport) \
+                and self._transport.resolver is None:
+            def resolve(peer_hash: bytes) -> str | None:
+                s = self.seeddb.get(peer_hash)
+                return f"http://{s.ip}:{s.port}" if s else None
+            self._transport.resolver = resolve
+        return self.http
+
     # -- lifecycle -----------------------------------------------------------
 
     def close(self) -> None:
+        if getattr(self, "http", None) is not None:
+            self.http.close()
+            self.http = None
         self.dispatcher.restore_buffer_to_index()
         self._transport.unregister(self.seed.hash)
         self.seeddb.close()

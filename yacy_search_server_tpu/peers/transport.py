@@ -76,3 +76,66 @@ class LoopbackNetwork:
         if delay:
             time.sleep(delay)
         return handler(endpoint, payload)
+
+
+class HttpTransport:
+    """Real-socket transport: JSON POST to the target's /yacy/<endpoint>
+    wire servlet — the DCN leg of the communication backend (reference:
+    Protocol.java posts multipart forms to <peer>/yacy/<endpoint>.html;
+    here the body is one JSON table, same logical message set).
+
+    Address resolution: explicit address book first (bootstrap), then the
+    `resolver` callable (normally backed by the node's SeedDB, whose seed
+    DNA gossips IP:port exactly as the reference's does). A handler
+    registered locally short-circuits in-process — rpc-to-self never
+    touches a socket.
+    """
+
+    def __init__(self, resolver: Callable[[bytes], str | None] | None = None,
+                 timeout_s: float = 10.0):
+        self._local: dict[bytes, Callable[[str, dict], dict]] = {}
+        self._addresses: dict[bytes, str] = {}
+        self.resolver = resolver
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+
+    def register(self, peer_hash: bytes,
+                 handler: Callable[[str, dict], dict]) -> None:
+        with self._lock:
+            self._local[peer_hash] = handler
+
+    def unregister(self, peer_hash: bytes) -> None:
+        with self._lock:
+            self._local.pop(peer_hash, None)
+
+    def set_address(self, peer_hash: bytes, base_url: str) -> None:
+        with self._lock:
+            self._addresses[peer_hash] = base_url.rstrip("/")
+
+    def _resolve(self, peer_hash: bytes) -> str | None:
+        with self._lock:
+            addr = self._addresses.get(peer_hash)
+        if addr:
+            return addr
+        return self.resolver(peer_hash) if self.resolver else None
+
+    def rpc(self, target_hash: bytes, endpoint: str, payload: dict) -> dict:
+        import json as _json
+        import urllib.request
+        with self._lock:
+            handler = self._local.get(target_hash)
+        if handler is not None:
+            return handler(endpoint, payload)
+        base = self._resolve(target_hash)
+        if not base:
+            raise PeerUnreachable(target_hash.decode("ascii", "replace"))
+        body = _json.dumps(payload).encode("utf-8")
+        req = urllib.request.Request(
+            f"{base}/yacy/{endpoint}.html", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                reply = _json.loads(r.read().decode("utf-8"))
+        except Exception as e:
+            raise PeerUnreachable(f"{target_hash!r}: {e}") from e
+        return reply
