@@ -202,3 +202,140 @@ def test_html_valueless_attributes_do_not_truncate():
         b"<html><body>before <a href>anchor</a> <link rel> "
         b"<meta http-equiv> after</body></html>")[0]
     assert "before" in doc.text and "after" in doc.text
+
+
+# -- office containers (generated fixtures, like the reference's
+#    test/parsertest corpus but built in-test: zero binary blobs in repo) ----
+
+def _docx(paragraphs, title="", author=""):
+    buf = io.BytesIO()
+    w = "http://schemas.openxmlformats.org/wordprocessingml/2006/main"
+    body = "".join(f"<w:p><w:r><w:t>{p}</w:t></w:r></w:p>" for p in paragraphs)
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("word/document.xml",
+                    f'<w:document xmlns:w="{w}"><w:body>{body}</w:body></w:document>')
+        zf.writestr("docProps/core.xml",
+                    '<cp:coreProperties '
+                    'xmlns:cp="http://schemas.openxmlformats.org/package/2006/metadata/core-properties" '
+                    'xmlns:dc="http://purl.org/dc/elements/1.1/">'
+                    f'<dc:title>{title}</dc:title><dc:creator>{author}</dc:creator>'
+                    '</cp:coreProperties>')
+    return buf.getvalue()
+
+
+def test_docx():
+    data = _docx(["First paragraph words.", "Second paragraph words."],
+                 title="My Report", author="Rex Writer")
+    doc = parse_source("http://ex.test/report.docx", None, data)[0]
+    assert doc.title == "My Report"
+    assert doc.author == "Rex Writer"
+    assert "First paragraph words." in doc.text
+    assert "Second paragraph words." in doc.text
+
+
+def test_odt():
+    buf = io.BytesIO()
+    o = "urn:oasis:names:tc:opendocument:xmlns:office:1.0"
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("content.xml",
+                    f'<office:document-content xmlns:office="{o}">'
+                    '<office:body><text:p xmlns:text="t">odt body words</text:p>'
+                    '</office:body></office:document-content>')
+        zf.writestr("meta.xml",
+                    f'<office:document-meta xmlns:office="{o}" '
+                    'xmlns:dc="http://purl.org/dc/elements/1.1/">'
+                    '<office:meta><dc:title>An ODT</dc:title>'
+                    '<dc:creator>Olga</dc:creator></office:meta>'
+                    '</office:document-meta>')
+    doc = parse_source("http://ex.test/x.odt",
+                       "application/vnd.oasis.opendocument.text",
+                       buf.getvalue())[0]
+    assert doc.title == "An ODT"
+    assert doc.author == "Olga"
+    assert "odt body words" in doc.text
+
+
+def test_rtf():
+    rtf = (rb"{\rtf1\ansi{\fonttbl{\f0 Arial;}}"
+           rb"\f0 Hello \b bold\b0 world.\par Second line.}")
+    doc = parse_source("http://ex.test/x.rtf", "application/rtf", rtf)[0]
+    assert "Hello" in doc.text and "bold" in doc.text and "world." in doc.text
+    assert "Second line." in doc.text
+    assert "fonttbl" not in doc.text and "\\par" not in doc.text
+
+
+def test_epub():
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("mimetype", "application/epub+zip")
+        zf.writestr("OEBPS/ch1.xhtml",
+                    "<html><head><title>c1</title></head>"
+                    "<body><p>chapter one text</p></body></html>")
+        zf.writestr("OEBPS/ch2.xhtml",
+                    "<html><body><p>chapter two text</p></body></html>")
+        zf.writestr("OEBPS/content.opf",
+                    '<package xmlns="http://www.idpf.org/2007/opf" '
+                    'xmlns:dc="http://purl.org/dc/elements/1.1/">'
+                    '<metadata><dc:title>The Book</dc:title>'
+                    '<dc:creator>Bo Author</dc:creator></metadata></package>')
+    doc = parse_source("http://ex.test/b.epub", "application/epub+zip",
+                       buf.getvalue())[0]
+    assert doc.title == "The Book"
+    assert doc.author == "Bo Author"
+    assert "chapter one text" in doc.text and "chapter two text" in doc.text
+
+
+# -- media ---------------------------------------------------------------
+
+def test_png_metadata():
+    import struct
+    def chunk(ctype, data):
+        return (struct.pack(">I", len(data)) + ctype + data
+                + struct.pack(">I", zlib.crc32(ctype + data)))
+    ihdr = struct.pack(">IIBBBBB", 33, 44, 8, 2, 0, 0, 0)
+    png = (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+           + chunk(b"tEXt", b"Comment\x00a tiny test image")
+           + chunk(b"IEND", b""))
+    doc = parse_source("http://ex.test/pic.png", "image/png", png)[0]
+    assert "33x44" in doc.text
+    assert "a tiny test image" in doc.text
+
+
+def test_gif_dimensions():
+    gif = b"GIF89a" + bytes([7, 0, 9, 0]) + b"\x00" * 20
+    doc = parse_source("http://ex.test/x.gif", "image/gif", gif)[0]
+    assert "7x9" in doc.text
+
+
+def test_mp3_id3v2():
+    def frame(fid, text):
+        data = b"\x03" + text.encode()
+        import struct
+        return fid + struct.pack(">I", len(data)) + b"\x00\x00" + data
+    frames = frame(b"TIT2", "Song Title") + frame(b"TPE1", "The Band")
+    size = len(frames)
+    hdr = b"ID3\x04\x00\x00" + bytes([
+        (size >> 21) & 0x7F, (size >> 14) & 0x7F,
+        (size >> 7) & 0x7F, size & 0x7F])
+    mp3 = hdr + frames + b"\xff\xfb" + b"\x00" * 64
+    doc = parse_source("http://ex.test/song.mp3", "audio/mpeg", mp3)[0]
+    assert doc.title == "Song Title"
+    assert doc.author == "The Band"
+
+
+def test_torrent():
+    t = (b"d8:announce20:http://tracker.test/"
+         b"7:comment9:a comment"
+         b"4:infod4:name9:my.file.x5:filesl"
+         b"d4:pathl3:sub8:data.binee"
+         b"eee")
+    doc = parse_source("http://ex.test/f.torrent",
+                       "application/x-bittorrent", t)[0]
+    assert doc.title == "my.file.x"
+    assert "a comment" in doc.text
+    assert "data bin" in doc.text  # path words de-punctuated
+
+
+def test_image_bad_container_rejected():
+    with pytest.raises(ParserError):
+        parse_source("http://ex.test/x.png", "image/png", b"not an image!!")

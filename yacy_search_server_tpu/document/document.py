@@ -10,6 +10,16 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+# doctype codes carried into the posting rows (reference: Response.docType
+# char codes feeding WordReferenceRow's doctype column)
+DT_TEXT = 0
+DT_HTML = 1
+DT_PDF = 2
+DT_IMAGE = 3
+DT_AUDIO = 4
+DT_VIDEO = 5
+DT_APP = 6
+
 
 @dataclass
 class Anchor:

@@ -1,0 +1,15 @@
+"""Surrogate importers — bulk document ingestion bypassing the crawler.
+
+Capability equivalents of the reference's importer set (reference:
+source/net/yacy/document/importer/WarcImporter.java:59,
+MediawikiImporter.java, OAIPMHImporter.java). Each importer yields
+normalized Documents that feed the same Segment.store_document write path
+the crawler uses.
+"""
+
+from .warc import WarcImporter, parse_warc
+from .mediawiki import MediawikiImporter, wikitext_to_text
+from .oaipmh import OAIPMHHarvester
+
+__all__ = ["WarcImporter", "parse_warc", "MediawikiImporter",
+           "wikitext_to_text", "OAIPMHHarvester"]

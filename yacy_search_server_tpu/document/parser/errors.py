@@ -1,0 +1,5 @@
+"""Shared parser failure type (reference: net.yacy.document.Parser.Failure)."""
+
+
+class ParserError(Exception):
+    pass

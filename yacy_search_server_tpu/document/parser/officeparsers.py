@@ -1,0 +1,190 @@
+"""Office-format parsers — OOXML, OpenDocument, RTF, EPUB.
+
+Capability equivalents of the reference's office parser set (reference:
+source/net/yacy/document/parser/docParser.java, ooxmlParser.java,
+odtParser.java, rtfParser.java, epubParser.java — which lean on POI and
+odfutils jars).  OOXML and ODF are zip+XML containers, so they are parsed
+natively here: extract the content XML parts, strip tags, read the
+metadata part for title/author/keywords.  RTF is de-markup'd with a
+control-word stripper; EPUB is a zip of XHTML chapters fed through the
+html parser.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import zipfile
+import xml.etree.ElementTree as ET
+
+from ..document import Document
+from .errors import ParserError
+
+
+def _xml_text(data: bytes) -> str:
+    """All character data of an XML part, space-joined, tag-boundary safe."""
+    try:
+        root = ET.fromstring(data)
+    except ET.ParseError:
+        return ""
+    return " ".join(t.strip() for t in root.itertext() if t.strip())
+
+
+def _zip_of(content: bytes) -> zipfile.ZipFile:
+    try:
+        return zipfile.ZipFile(io.BytesIO(content))
+    except zipfile.BadZipFile as e:
+        raise ParserError(f"not a zip container: {e}") from e
+
+
+_DC_RE = ".//{http://purl.org/dc/elements/1.1/}"
+
+
+def _ooxml_core_props(zf: zipfile.ZipFile) -> dict:
+    out = {}
+    try:
+        root = ET.fromstring(zf.read("docProps/core.xml"))
+    except (KeyError, ET.ParseError):
+        return out
+    for k, tag in (("title", "title"), ("author", "creator"),
+                   ("description", "description"), ("keywords", "subject")):
+        el = root.find(_DC_RE + tag)
+        if el is not None and el.text:
+            out[k] = el.text
+    kw = root.find(".//{http://schemas.openxmlformats.org/package/2006/"
+                   "metadata/core-properties}keywords")
+    if kw is not None and kw.text:
+        out["keywords"] = kw.text
+    return out
+
+
+def parse_ooxml(url: str, content: bytes,
+                charset: str | None = None) -> list[Document]:
+    """docx/xlsx/pptx: concatenate the text of the content XML parts."""
+    zf = _zip_of(content)
+    names = zf.namelist()
+    parts = [n for n in names if
+             n == "word/document.xml"
+             or re.match(r"word/(header|footer)\d*\.xml$", n)
+             or re.match(r"xl/sharedStrings\.xml$", n)
+             or re.match(r"ppt/slides/slide\d+\.xml$", n)
+             or re.match(r"ppt/notesSlides/notesSlide\d+\.xml$", n)]
+    texts = []
+    for n in sorted(parts):
+        texts.append(_xml_text(zf.read(n)))
+    if not any(texts):
+        raise ParserError("no text parts in ooxml container")
+    props = _ooxml_core_props(zf)
+    text = "\n".join(t for t in texts if t)
+    mime = ("application/vnd.openxmlformats-officedocument"
+            ".wordprocessingml.document")
+    return [Document(url=url, mime_type=mime,
+                     title=props.get("title", "") or text[:120],
+                     author=props.get("author", ""),
+                     description=props.get("description", ""),
+                     keywords=[k.strip() for k in
+                               props.get("keywords", "").split(",")
+                               if k.strip()],
+                     text=text)]
+
+
+def parse_odf(url: str, content: bytes,
+              charset: str | None = None) -> list[Document]:
+    """odt/ods/odp: content.xml carries the body, meta.xml the metadata."""
+    zf = _zip_of(content)
+    try:
+        text = _xml_text(zf.read("content.xml"))
+    except KeyError as e:
+        raise ParserError("no content.xml in odf container") from e
+    title = author = description = ""
+    keywords: list[str] = []
+    try:
+        meta = ET.fromstring(zf.read("meta.xml"))
+        for el in meta.iter():
+            tag = el.tag.rsplit("}", 1)[-1]
+            if tag == "title" and el.text:
+                title = el.text
+            elif tag == "creator" and el.text:
+                author = el.text
+            elif tag == "description" and el.text:
+                description = el.text
+            elif tag == "keyword" and el.text:
+                keywords.append(el.text)
+    except (KeyError, ET.ParseError):
+        pass
+    if not text:
+        raise ParserError("empty odf document")
+    return [Document(url=url, mime_type="application/vnd.oasis.opendocument.text",
+                     title=title or text[:120], author=author,
+                     description=description, keywords=keywords, text=text)]
+
+
+_RTF_CONTROL = re.compile(rb"\\([a-z]{1,32})(-?\d{1,10})?[ ]?|\\'[0-9a-f]{2}"
+                          rb"|\\[^a-z]|[{}]|\r|\n")
+
+
+def parse_rtf(url: str, content: bytes,
+              charset: str | None = None) -> list[Document]:
+    if not content.startswith(b"{\\rtf"):
+        raise ParserError("not an rtf file")
+    # drop binary/skippable groups (fonttbl, pict, stylesheet...)
+    body = re.sub(rb"{\\(?:fonttbl|colortbl|stylesheet|info|pict)[^{}]*(?:{[^{}]*})*[^{}]*}",
+                  b" ", content)
+
+    def repl(m: re.Match) -> bytes:
+        tok = m.group(0)
+        if tok.startswith(b"\\'"):
+            try:
+                return bytes([int(tok[2:], 16)])
+            except ValueError:
+                return b""
+        if m.group(1) in (b"par", b"line", b"tab", b"sect", b"page"):
+            return b"\n"
+        return b""
+
+    raw = _RTF_CONTROL.sub(repl, body)
+    text = re.sub(r"[ \t]+", " ", raw.decode(charset or "latin-1", "replace")).strip()
+    if not text:
+        raise ParserError("empty rtf document")
+    return [Document(url=url, mime_type="application/rtf",
+                     title=text.split("\n", 1)[0][:120], text=text)]
+
+
+def parse_epub(url: str, content: bytes,
+               charset: str | None = None) -> list[Document]:
+    from .htmlparser import parse_html
+    zf = _zip_of(content)
+    chapters = [n for n in zf.namelist()
+                if n.lower().endswith((".xhtml", ".html", ".htm"))]
+    if not chapters:
+        raise ParserError("no xhtml chapters in epub")
+    main: Document | None = None
+    for n in sorted(chapters):
+        try:
+            docs = parse_html(f"{url}#{n}", zf.read(n), charset)
+        except ParserError:
+            continue
+        for d in docs:
+            if main is None:
+                main = d
+                main.url = url
+                main.mime_type = "application/epub+zip"
+            else:
+                main.merge(d)
+    if main is None:
+        raise ParserError("no parsable chapters in epub")
+    # OPF metadata (title/creator) overrides chapter-derived title
+    for n in zf.namelist():
+        if n.lower().endswith(".opf"):
+            try:
+                root = ET.fromstring(zf.read(n))
+                t = root.find(_DC_RE + "title")
+                c = root.find(_DC_RE + "creator")
+                if t is not None and t.text:
+                    main.title = t.text
+                if c is not None and c.text:
+                    main.author = c.text
+            except ET.ParseError:
+                pass
+            break
+    return [main]

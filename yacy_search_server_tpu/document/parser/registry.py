@@ -23,6 +23,8 @@ from urllib.parse import urlsplit
 from ..document import Document
 from .htmlparser import parse_html
 from .pdfparser import parse_pdf
+from .mediaparsers import parse_audio, parse_image, parse_torrent
+from .officeparsers import parse_epub, parse_odf, parse_ooxml, parse_rtf
 from .textparsers import parse_csv, parse_json, parse_text, parse_vcf
 from .xmlparsers import is_feed, parse_feed, parse_generic_xml
 
@@ -30,8 +32,7 @@ MAX_ARCHIVE_MEMBERS = 200
 MAX_RECURSION = 3
 
 
-class ParserError(Exception):
-    pass
+from .errors import ParserError  # noqa: E402  (re-export, shared type)
 
 
 def _ext(url: str) -> str:
@@ -55,6 +56,23 @@ _MIME_PARSERS = {
     "text/xml": parse_generic_xml,
     "application/rss+xml": parse_feed,
     "application/atom+xml": parse_feed,
+    # office containers
+    "application/vnd.openxmlformats-officedocument.wordprocessingml.document":
+        parse_ooxml,
+    "application/vnd.openxmlformats-officedocument.spreadsheetml.sheet":
+        parse_ooxml,
+    "application/vnd.openxmlformats-officedocument.presentationml.presentation":
+        parse_ooxml,
+    "application/vnd.oasis.opendocument.text": parse_odf,
+    "application/vnd.oasis.opendocument.spreadsheet": parse_odf,
+    "application/vnd.oasis.opendocument.presentation": parse_odf,
+    "application/rtf": parse_rtf, "text/rtf": parse_rtf,
+    "application/epub+zip": parse_epub,
+    # media
+    "image/png": parse_image, "image/jpeg": parse_image,
+    "image/gif": parse_image,
+    "audio/mpeg": parse_audio, "audio/mp3": parse_audio,
+    "application/x-bittorrent": parse_torrent,
 }
 
 _EXT_PARSERS = {
@@ -63,6 +81,13 @@ _EXT_PARSERS = {
     "csv": parse_csv, "json": parse_json, "vcf": parse_vcf,
     "pdf": parse_pdf, "xml": parse_generic_xml,
     "rss": parse_feed, "atom": parse_feed,
+    "docx": parse_ooxml, "xlsx": parse_ooxml, "pptx": parse_ooxml,
+    "odt": parse_odf, "ods": parse_odf, "odp": parse_odf,
+    "rtf": parse_rtf, "epub": parse_epub,
+    "png": parse_image, "jpg": parse_image, "jpeg": parse_image,
+    "gif": parse_image,
+    "mp3": parse_audio,
+    "torrent": parse_torrent,
 }
 
 _ARCHIVE_MIMES = {"application/zip", "application/x-zip-compressed",

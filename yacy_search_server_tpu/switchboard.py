@@ -225,6 +225,46 @@ class Switchboard:
         q.offset = offset
         return self.search_cache.get_event(q, self.index)
 
+    # -- surrogate import (Switchboard.java:1153-1174 busy thread) -----------
+
+    @property
+    def surrogates_in(self) -> str | None:
+        if not self.data_dir:
+            return None
+        p = os.path.join(self.data_dir, "SURROGATES", "in")
+        os.makedirs(p, exist_ok=True)
+        return p
+
+    def surrogate_process_job(self) -> bool:
+        """Import one pending surrogate file (WARC or MediaWiki dump) from
+        DATA/SURROGATES/in, then move it to ../out. Returns True if a file
+        was processed (BusyThread contract)."""
+        indir = self.surrogates_in
+        if indir is None:
+            return False
+        candidates = sorted(
+            f for f in os.listdir(indir)
+            if f.endswith((".warc", ".warc.gz", ".xml", ".xml.bz2",
+                           ".xml.gz")))
+        if not candidates:
+            return False
+        from .document.importer import MediawikiImporter, WarcImporter
+        name = candidates[0]
+        path = os.path.join(indir, name)
+        sink = lambda doc: (self.index.store_document(doc),
+                            setattr(self, "indexed_count",
+                                    self.indexed_count + 1))
+        try:
+            if ".warc" in name:
+                WarcImporter(sink).import_file(path)
+            else:
+                MediawikiImporter(sink).import_file(path)
+        finally:
+            outdir = os.path.join(self.data_dir, "SURROGATES", "out")
+            os.makedirs(outdir, exist_ok=True)
+            os.replace(path, os.path.join(outdir, name))
+        return True
+
     # -- busy threads (deployThread parity) ---------------------------------
 
     def deploy_threads(self) -> None:
@@ -235,6 +275,9 @@ class Switchboard:
         self.threads.deploy(BusyThread(
             "30_cleanup", self._cleanup_job,
             idle_sleep_s=30.0, busy_sleep_s=30.0))
+        self.threads.deploy(BusyThread(
+            "70_surrogates", self.surrogate_process_job,
+            idle_sleep_s=10.0, busy_sleep_s=0.1))
 
     def _cleanup_job(self) -> bool:
         self.search_cache.cleanup_locked()
