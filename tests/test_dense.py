@@ -1,0 +1,115 @@
+"""M7 — dense encoder, hybrid rerank kernel, end-to-end hybrid search."""
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.index.dense import DenseVectorStore
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.ops.dense import (HashingEncoder,
+                                              hybrid_rerank_topk,
+                                              hybrid_rerank_topk_np)
+
+
+def test_encoder_deterministic_and_normalized():
+    e = HashingEncoder()
+    a = e.encode("distributed tpu search kernels")
+    b = e.encode("distributed tpu search kernels")
+    assert np.array_equal(a, b)
+    assert abs(np.linalg.norm(a) - 1.0) < 1e-5
+
+
+def test_encoder_similarity_orders_topics():
+    e = HashingEncoder()
+    q = e.encode("tpu kernel ranking")
+    near = e.encode("fast tpu kernels for ranking documents")
+    far = e.encode("gardening tomatoes in spring weather")
+    assert float(q @ near) > float(q @ far)
+
+
+def test_rerank_kernel_matches_numpy_oracle():
+    rng = np.random.default_rng(3)
+    n, dim, k = 300, 64, 10
+    docs = rng.normal(size=(n, dim)).astype(np.float32)
+    docs /= np.linalg.norm(docs, axis=1, keepdims=True)
+    q = docs[17] * 0.9 + 0.1 * rng.normal(size=dim).astype(np.float32)
+    sparse = rng.integers(0, 1000, n).astype(np.float32)
+    valid = np.ones(n, bool)
+    import jax.numpy as jnp
+    s_dev, i_dev = hybrid_rerank_topk(
+        jnp.asarray(q), jnp.asarray(docs), jnp.asarray(sparse),
+        jnp.asarray(valid), jnp.float32(0.5), k)
+    s_np, i_np = hybrid_rerank_topk_np(q, docs, sparse, valid, 0.5, k)
+    # bf16 matmul tolerance: top sets must agree on >=8/10 and scores close
+    assert len(set(np.asarray(i_dev).tolist())
+               & set(i_np.tolist())) >= 8
+    assert np.allclose(np.asarray(s_dev)[:3], s_np[:3], atol=2e-2)
+
+
+def test_rerank_alpha_extremes():
+    import jax.numpy as jnp
+    n, dim = 50, 32
+    rng = np.random.default_rng(0)
+    docs = rng.normal(size=(n, dim)).astype(np.float32)
+    docs /= np.linalg.norm(docs, axis=1, keepdims=True)
+    sparse = np.arange(n, dtype=np.float32)
+    valid = np.ones(n, bool)
+    # alpha=0: pure sparse -> best is index n-1
+    _, idx = hybrid_rerank_topk(jnp.asarray(docs[7]), jnp.asarray(docs),
+                                jnp.asarray(sparse), jnp.asarray(valid),
+                                jnp.float32(0.0), 1)
+    assert int(idx[0]) == n - 1
+    # alpha=1: pure dense -> best is the query's own doc
+    _, idx = hybrid_rerank_topk(jnp.asarray(docs[7]), jnp.asarray(docs),
+                                jnp.asarray(sparse), jnp.asarray(valid),
+                                jnp.float32(1.0), 1)
+    assert int(idx[0]) == 7
+
+
+def test_vector_store_roundtrip(tmp_path):
+    st = DenseVectorStore(str(tmp_path / "dense"), dim=16)
+    v = np.arange(16, dtype=np.float32) / 16.0
+    st.put(5, v)
+    st.put(900, v * 2)          # forces growth
+    assert len(st) == 901
+    got = st.get_block(np.array([5, 900]))
+    assert np.allclose(got[0], v.astype(np.float16))
+    st.close()
+    st2 = DenseVectorStore(str(tmp_path / "dense"), dim=16)
+    assert len(st2) == 901
+    assert np.allclose(st2.get_block(np.array([900]))[0],
+                       (v * 2).astype(np.float16))
+
+
+def _doc(url, title, text):
+    return Document(url=url, title=title, text=text, mime_type="text/html",
+                    language="en")
+
+
+def test_hybrid_search_end_to_end(tmp_path):
+    from yacy_search_server_tpu.search.query import QueryParams
+    from yacy_search_server_tpu.search.searchevent import SearchEvent
+
+    seg = Segment(str(tmp_path / "idx"))
+    # both docs match the conjunctive query "fast kernels"; the OFF doc
+    # wins the sparse stage (query words in its title), the ON doc is the
+    # dense topical match (its text is almost entirely query n-gram mass)
+    seg.store_document(_doc("http://a.test/on", "page twelve",
+                            "fast kernels fast kernels fast kernels"))
+    seg.store_document(_doc(
+        "http://a.test/off", "Fast kernels cookbook",
+        "fast kernels " + " ".join(
+            f"unrelated word{i} gardening recipe" for i in range(40))))
+
+    sparse_q = QueryParams.parse("fast kernels")
+    sparse_first = SearchEvent(sparse_q, seg).results(count=2)[0].url
+
+    q = QueryParams.parse("fast kernels")
+    q.hybrid = True
+    q.hybrid_alpha = 0.95
+    res = SearchEvent(q, seg).results(count=2)
+    assert len(res) == 2
+    assert res[0].url == "http://a.test/on"
+    # the dense stage actually changed the decision
+    assert sparse_first == "http://a.test/off"
+    seg.close()

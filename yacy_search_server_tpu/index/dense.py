@@ -1,0 +1,70 @@
+"""Dense vector store — per-segment doc embeddings aligned to docids.
+
+The M7 hybrid-rerank companion of the metadata store: one growable
+``[capacity, dim]`` float16 block (the device-transfer unit for the
+rerank matmul), filled at ``store_document`` time by the segment's
+encoder.  Persistence is one .npy snapshot rewritten on flush/close —
+embeddings are derivable data (re-encodable from text_t), so a crash
+loses nothing irrecoverable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..ops.dense import DIM
+
+
+class DenseVectorStore:
+    def __init__(self, data_dir: str | None = None, dim: int = DIM):
+        self.dim = dim
+        self.data_dir = data_dir
+        self._vecs = np.zeros((256, dim), dtype=np.float16)
+        self._n = 0
+        self._lock = threading.Lock()
+        self._dirty = 0
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            p = self._path()
+            if os.path.isfile(p):
+                loaded = np.load(p)
+                if loaded.shape[1] == dim:
+                    self._vecs = loaded.copy()
+                    self._n = loaded.shape[0]
+
+    def _path(self) -> str:
+        return os.path.join(self.data_dir, "vectors.npy")
+
+    def put(self, docid: int, vec: np.ndarray) -> None:
+        with self._lock:
+            while docid >= self._vecs.shape[0]:
+                self._vecs = np.vstack(
+                    [self._vecs, np.zeros_like(self._vecs)])
+            self._vecs[docid] = vec.astype(np.float16)
+            self._n = max(self._n, docid + 1)
+            self._dirty += 1
+            if self.data_dir and self._dirty >= 512:
+                self._save_locked()
+
+    def get_block(self, docids: np.ndarray) -> np.ndarray:
+        """[len(docids), dim] float16 gather (device-transfer unit)."""
+        with self._lock:
+            return self._vecs[np.asarray(docids, dtype=np.int64)]
+
+    def __len__(self) -> int:
+        return self._n
+
+    def _save_locked(self) -> None:
+        tmp = self._path() + ".tmp"
+        with open(tmp, "wb") as f:
+            np.save(f, self._vecs[:max(self._n, 1)])
+        os.replace(tmp, self._path())
+        self._dirty = 0
+
+    def close(self) -> None:
+        if self.data_dir:
+            with self._lock:
+                self._save_locked()

@@ -55,6 +55,14 @@ class Segment:
         self.rwi = RWIIndex(rwi_dir, **kwargs)
         self.citations = CitationIndex()
         self.metadata = MetadataStore(meta_dir)
+        # M7 hybrid rerank: doc embeddings aligned to docids (new
+        # capability beyond the reference; ops/dense.py)
+        from ..ops.dense import HashingEncoder
+        from .dense import DenseVectorStore
+        self.encoder = HashingEncoder()
+        self.dense = DenseVectorStore(
+            f"{data_dir}/dense" if data_dir else None,
+            dim=self.encoder.dim)
         self._lock = threading.RLock()
 
     # -- write path ----------------------------------------------------------
@@ -119,6 +127,8 @@ class Segment:
                 for th, row in zip(term_hashes, rows):
                     self.rwi.add(th, docid, row)
                 self.rwi.add(word2hash(CATCHALL_WORD), docid, doc_row)
+                self.dense.put(docid, self.encoder.encode(
+                    f"{doc.title}\n{doc.text[:4096]}"))
 
             # flush outside the segment lock: the compressed run write must
             # not stall concurrent readers/other writers on this facade
@@ -189,6 +199,7 @@ class Segment:
     def close(self) -> None:
         self.rwi.close()
         self.metadata.close()
+        self.dense.close()
 
 
 def join_constructive(containers: list[PostingsList]) -> PostingsList:

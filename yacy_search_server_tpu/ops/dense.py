@@ -1,0 +1,110 @@
+"""Dense semantic encoding + hybrid rerank kernel — M7 (BASELINE config #5).
+
+New capability beyond the reference (aligned with PAPERS.md efficient
+neural-ranking techniques): a first-stage sparse search (RWI/BM25 or
+cardinal) followed by a dense cosine rerank on device.  TPU-first design:
+
+- document/query embeddings are fixed-dim float vectors; doc embeddings
+  live as one dense ``[n, dim]`` block per segment (MXU-friendly),
+- the rerank is ONE fused kernel: bf16 matmul (query x doc block on the
+  MXU) -> blend with the normalized sparse score -> top-k,
+- the encoder is a deterministic hashed n-gram projection (a linear
+  "SBERT-shaped" text encoder with no learned weights — zero-egress
+  substitute; any [text -> dim-vector] model drops in, e.g. a flax
+  sentence encoder, without touching the kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DIM = 256
+_SEED = 0x5EED
+
+
+def _stable_hash(s: str) -> int:
+    """Deterministic 64-bit FNV-1a (python's hash() is salted)."""
+    h = 0xCBF29CE484222325
+    for ch in s.encode("utf-8"):
+        h = ((h ^ ch) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class HashingEncoder:
+    """Signed feature-hashing of word + char-trigram features into `dim`
+    buckets, L2-normalized — deterministic across processes/peers (doc
+    vectors computed at index time on one node must match query vectors
+    computed on another)."""
+
+    def __init__(self, dim: int = DIM):
+        self.dim = dim
+
+    def _features(self, text: str):
+        words = [w for w in text.lower().split() if w]
+        for w in words[:512]:
+            yield "w:" + w, 1.0
+            padded = f"^{w}$"
+            for i in range(len(padded) - 2):
+                yield "t:" + padded[i:i + 3], 0.5
+
+    def encode(self, text: str) -> np.ndarray:
+        v = np.zeros(self.dim, dtype=np.float32)
+        for feat, weight in self._features(text):
+            h = _stable_hash(feat)
+            bucket = (h >> 1) % self.dim
+            sign = 1.0 if (h & 1) else -1.0
+            v[bucket] += sign * weight
+        n = float(np.linalg.norm(v))
+        return v / n if n > 0 else v
+
+    def encode_batch(self, texts: list[str]) -> np.ndarray:
+        if not texts:
+            return np.zeros((0, self.dim), dtype=np.float32)
+        return np.stack([self.encode(t) for t in texts])
+
+
+# -- fused rerank kernel -----------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def hybrid_rerank_topk(qvec: jnp.ndarray, doc_vecs: jnp.ndarray,
+                       sparse_scores: jnp.ndarray, valid: jnp.ndarray,
+                       alpha: jnp.ndarray, k: int):
+    """One fused device step: cosine(q, docs) on the MXU in bf16, blended
+    with min/max-normalized sparse scores, masked top-k.
+
+        final = (1-alpha) * norm(sparse) + alpha * cosine
+
+    Returns (scores[k], indices[k]).  Replaces nothing in the reference —
+    this is the hybrid second stage the reference lacks.
+    """
+    sims = jnp.dot(doc_vecs.astype(jnp.bfloat16),
+                   qvec.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+    s = sparse_scores.astype(jnp.float32)
+    big = jnp.float32(1e30)
+    smin = jnp.min(jnp.where(valid, s, big))
+    smax = jnp.max(jnp.where(valid, s, -big))
+    span = jnp.maximum(smax - smin, 1e-6)
+    s_norm = jnp.where(valid, (s - smin) / span, 0.0)
+    final = (1.0 - alpha) * s_norm + alpha * sims
+    final = jnp.where(valid, final, -jnp.inf)
+    return jax.lax.top_k(final, k)
+
+
+def hybrid_rerank_topk_np(qvec, doc_vecs, sparse_scores, valid, alpha, k):
+    """CPU oracle with identical math (float32 cosine)."""
+    sims = doc_vecs.astype(np.float32) @ qvec.astype(np.float32)
+    s = sparse_scores.astype(np.float32)
+    sv = s[valid]
+    smin = sv.min() if sv.size else 0.0
+    smax = sv.max() if sv.size else 0.0
+    span = max(smax - smin, 1e-6)
+    s_norm = np.where(valid, (s - smin) / span, 0.0)
+    final = (1.0 - alpha) * s_norm + alpha * sims
+    final = np.where(valid, final, -np.inf)
+    idx = np.argsort(-final, kind="stable")[:k]
+    return final[idx], idx

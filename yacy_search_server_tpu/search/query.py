@@ -230,6 +230,10 @@ class QueryParams:
     # domain diversity: max results per host before diversion
     # (doubledom handling, SearchEvent.java:1297-1412)
     max_per_host: int = 6
+    # M7 hybrid rerank: blend dense cosine into the sparse first stage
+    # (ops/dense.py; new capability beyond the reference)
+    hybrid: bool = False
+    hybrid_alpha: float = 0.5
 
     @staticmethod
     def parse(querystring: str, **kw) -> "QueryParams":
@@ -259,6 +263,7 @@ class QueryParams:
             ",".join(sorted(self.goal.phrases)),
             self.modifier.to_string(), str(self.contentdom), self.lang,
             self.profile.to_external_string() if self.profile else "",
+            f"h{int(self.hybrid)}a{self.hybrid_alpha}" if self.hybrid else "",
         ))
         return hashlib.md5(key.encode()).hexdigest()  # nosec: cache key only
 

@@ -141,6 +141,10 @@ class SearchEvent:
         with StageTimer(EClass.SEARCH, "NORMALIZING", len(cand)):
             scores, docids = self._ranker.rank(cand, hosthashes, k=k)
 
+        if q.hybrid and len(docids):
+            with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
+                scores, docids = self._dense_rerank(scores, docids)
+
         with StageTimer(EClass.SEARCH, "RESULTLIST", len(docids)):
             for score, docid in zip(scores.tolist(), docids.tolist()):
                 made = self._make_entry(int(docid), int(score))
@@ -149,6 +153,32 @@ class SearchEvent:
                     continue
                 entry, meta = made
                 self._insert(entry, meta)
+
+    def _dense_rerank(self, scores, docids):
+        """M7 second stage: blend dense cosine similarity into the sparse
+        candidate scores on device (ops/dense.hybrid_rerank_topk).  The
+        returned scores are rescaled into the cardinal range so remote
+        fusion and post-ranking keep working on one scale."""
+        import jax.numpy as jnp
+
+        from ..ops.dense import hybrid_rerank_topk
+
+        q = self.query
+        qtext = " ".join(self.query.include_words())
+        qvec = self.segment.encoder.encode(qtext)
+        doc_vecs = self.segment.dense.get_block(np.asarray(docids))
+        k = int(len(docids))
+        final, order = hybrid_rerank_topk(
+            jnp.asarray(qvec), jnp.asarray(doc_vecs),
+            jnp.asarray(np.asarray(scores, dtype=np.float32)),
+            jnp.ones(k, dtype=bool), jnp.float32(q.hybrid_alpha), k)
+        order = np.asarray(order)
+        # blended scores are in [0,~2); rescale onto the cardinal scale of
+        # the incoming sparse scores for heap compatibility
+        smax = float(np.max(scores)) if len(scores) else 1.0
+        rescaled = (np.asarray(final, dtype=np.float64)
+                    * max(smax, 1.0) / 2.0).astype(np.int64)
+        return rescaled, np.asarray(docids)[order]
 
     def _constraint_mask(self, plist) -> np.ndarray:
         """Vector filters replacing the reference's per-row checks in

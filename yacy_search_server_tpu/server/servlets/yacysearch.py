@@ -87,7 +87,8 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
         return prop
 
     t0 = time.time()
-    event = sb.search(query, count=count, offset=offset)
+    event = sb.search(query, count=count, offset=offset,
+                      hybrid=post.get_bool("hybrid", False))
     results = event.results(offset=offset, count=count)
     prop.put("searchtime", int((time.time() - t0) * 1000))
     prop.put("totalcount", event.local_rwi_considered + event.remote_results)

@@ -219,10 +219,11 @@ class Switchboard:
     # -- search --------------------------------------------------------------
 
     def search(self, query_string: str, count: int = 10,
-               offset: int = 0) -> SearchEvent:
+               offset: int = 0, hybrid: bool = False) -> SearchEvent:
         q = QueryParams.parse(query_string)
         q.item_count = count
         q.offset = offset
+        q.hybrid = hybrid
         return self.search_cache.get_event(q, self.index)
 
     # -- surrogate import (Switchboard.java:1153-1174 busy thread) -----------
