@@ -28,7 +28,7 @@ from ..utils.bitfield import (
     FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO, FLAG_CAT_HASIMAGE, FLAG_CAT_HASLOCATION,
     FLAG_CAT_HASVIDEO, FLAG_CAT_INDEXOF,
 )
-from ..utils.hashes import url_comps, word2hash
+from ..utils.hashes import url_comps, word_hashes
 from .document import Document
 from ..index import postings as P
 
@@ -182,10 +182,9 @@ class Condenser:
         recomputing the per-anchor/url derivations.
         """
         base = self.doc_row(urlhash_feats) if base_row is None else base_row
-        hashes: list[bytes] = []
         rows = np.tile(base, (len(self.words), 1))
-        for i, (w, st) in enumerate(self.words.items()):
-            hashes.append(word2hash(w))
+        hashes = word_hashes(list(self.words.keys()))
+        for i, st in enumerate(self.words.values()):
             rows[i, P.F_FLAGS] = st.flags.value
             rows[i, P.F_HITCOUNT] = min(st.count, 255)
             rows[i, P.F_POSINTEXT] = min(st.posintext, 2**15)

@@ -94,6 +94,11 @@ class PostingsList:
 
 def sort_dedupe(docids: np.ndarray, feats: np.ndarray) -> PostingsList:
     """Sort by docid; on duplicates the *last* row wins (newest write)."""
+    from ..utils import native
+    order = native.sort_dedupe_order(docids)
+    if order is not None:
+        return PostingsList(docids[order].astype(np.int32, copy=False),
+                            feats[order].astype(np.int32, copy=False))
     order = np.argsort(docids, kind="stable")
     d, f = docids[order], feats[order]
     if len(d) > 1:
@@ -121,6 +126,10 @@ def remove_docids(p: PostingsList, dead: np.ndarray) -> PostingsList:
     """Drop postings whose docid is in the sorted `dead` array (tombstones)."""
     if len(p) == 0 or len(dead) == 0:
         return p
+    from ..utils import native
+    alive = native.alive_mask(p.docids, dead)
+    if alive is not None:
+        return p.select(alive)
     idx = np.searchsorted(dead, p.docids)
     idx = np.clip(idx, 0, len(dead) - 1)
     alive = dead[idx] != p.docids

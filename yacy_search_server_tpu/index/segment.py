@@ -220,8 +220,13 @@ def join_constructive(containers: list[PostingsList]) -> PostingsList:
     containers = sorted(containers, key=len)
     base = containers[0]
     common = base.docids
+    from ..utils.native import intersect as native_intersect
     for c in containers[1:]:
-        common = np.intersect1d(common, c.docids, assume_unique=True)
+        hit = native_intersect(common, c.docids)
+        if hit is not None:
+            common = common[hit[0]]
+        else:
+            common = np.intersect1d(common, c.docids, assume_unique=True)
         if len(common) == 0:
             return PostingsList.empty()
 

@@ -45,6 +45,17 @@ def word2hash(word: str) -> bytes:
     return h
 
 
+def word_hashes(words: list[str]) -> list[bytes]:
+    """Batch word2hash — the condense/store hot path. Uses the native C++
+    MD5+base64 kernel (utils/native.py) when available; small batches stay
+    on the lru-cached Python path."""
+    from .native import word_hash_batch
+    out = word_hash_batch(words)
+    if out is not None:
+        return out
+    return [word2hash(w) for w in words]
+
+
 def _md5_b64(s: str) -> bytes:
     return enhanced_coder.encode(hashlib.md5(s.encode("utf-8")).digest())
 
