@@ -1,0 +1,120 @@
+"""M10: news gossip over hello, remote crawl delegation, cluster mode."""
+
+import pytest
+
+from yacy_search_server_tpu.peers.news import (CAT_CRAWL_START, NewsPool,
+                                               NewsRecord)
+from yacy_search_server_tpu.peers.node import P2PNode
+from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+
+
+@pytest.fixture()
+def three_nodes(tmp_path):
+    net = LoopbackNetwork()
+    nodes = [P2PNode(f"node{i}", net, data_dir=str(tmp_path / f"n{i}"))
+             for i in range(3)]
+    seeds = [n.seed for n in nodes]
+    for n in nodes:
+        n.bootstrap(seeds)
+        n.ping()
+    yield nodes
+    for n in nodes:
+        n.close()
+
+
+def test_news_pool_identity_and_expiry():
+    pool = NewsPool()
+    rec = pool.publish(CAT_CRAWL_START, "abcdefghijkl",
+                       {"startURL": "http://x.test/"})
+    assert pool.size() == (0, 0, 1)
+    # ingest bounces my own records and dedups
+    assert pool.ingest_batch([rec.to_dict()], "abcdefghijkl") == 0
+    other = NewsRecord(CAT_CRAWL_START, "otherpeer0000",
+                       {"startURL": "http://y.test/"})
+    assert pool.ingest_batch([other.to_dict()], "abcdefghijkl") == 1
+    assert pool.ingest_batch([other.to_dict()], "abcdefghijkl") == 0
+    assert pool.incoming(CAT_CRAWL_START)[0].attributes["startURL"] \
+        == "http://y.test/"
+    pool.mark_processed(other.id)
+    assert pool.size() == (0, 1, 1)
+
+
+def test_news_flood_via_hello(three_nodes):
+    a, b, c = three_nodes
+    a.news.publish(CAT_CRAWL_START, a.seed.hash.decode("ascii"),
+                   {"startURL": "http://announce.test/"})
+    # a pings b -> b learns; b pings c -> c learns via relay
+    assert a.protocol.hello(b.seed)[0]
+    assert b.news.incoming(CAT_CRAWL_START)
+    assert b.protocol.hello(c.seed)[0]
+    got = c.news.incoming(CAT_CRAWL_START)
+    assert got and got[0].attributes["startURL"] == "http://announce.test/"
+    assert got[0].originator == a.seed.hash.decode("ascii")
+
+
+def test_start_crawl_publishes_news(tmp_path):
+    net = LoopbackNetwork()
+    node = P2PNode("solo", net, data_dir=str(tmp_path / "solo"),
+                   crawl_transport=lambda url, headers: (404, {}, b""))
+    try:
+        node.start_crawl("http://mysite.test/", depth=1, name="my crawl")
+        _, _, mine = node.news.size()
+        assert mine == 1
+        batch = node.news.outgoing_batch()
+        assert batch[0]["cat"] == CAT_CRAWL_START
+        assert batch[0]["attr"]["startURL"] == "http://mysite.test/"
+    finally:
+        node.close()
+
+
+def test_remote_crawl_delegation(tmp_path):
+    SITE = {"http://delegated.test/": (200, {"content-type": "text/html"},
+            b"<html><title>Delegated</title><body>delegated corpus page"
+            b"</body></html>")}
+
+    def transport(url, headers):
+        return SITE.get(url, (404, {}, b""))
+
+    net = LoopbackNetwork()
+    provider = P2PNode("provider", net, data_dir=str(tmp_path / "p"),
+                       crawl_transport=transport, accept_remote_crawl=True)
+    worker = P2PNode("worker", net, data_dir=str(tmp_path / "w"),
+                     crawl_transport=transport)
+    try:
+        worker.bootstrap([provider.seed])
+        worker.ping()
+        # provider stacks remote crawl work onto its GLOBAL stack
+        from yacy_search_server_tpu.crawler.frontier import StackType
+        from yacy_search_server_tpu.crawler.request import Request
+        prof = next(iter(provider.sb.profiles.values()))
+        provider.sb.noticed.push(
+            StackType.GLOBAL,
+            Request(url="http://delegated.test/", profile_handle=prof.handle))
+        assert worker.remote_crawl_loader_job() is True
+        worker.sb.flush_pipeline()
+        # the page landed in the WORKER's index
+        ev = worker.search("delegated", remote=False)
+        assert any("delegated.test" in r.url for r in ev.results())
+        # provider's global stack is drained
+        assert provider.sb.noticed.size(StackType.GLOBAL) == 0
+    finally:
+        worker.close()
+        provider.close()
+
+
+def test_cluster_mode_scatters_to_fixed_peers(three_nodes, tmp_path):
+    a, b, c = three_nodes
+    # index a doc only on b and only on c
+    from yacy_search_server_tpu.document.document import Document
+    b.sb.index.store_document(Document(
+        url="http://b.test/doc.html", title="b doc",
+        text="clusterterm payload from node b"))
+    c.sb.index.store_document(Document(
+        url="http://c.test/doc.html", title="c doc",
+        text="clusterterm payload from node c"))
+    # cluster restricted to node1 (=b): only b's doc may arrive remotely
+    a.cluster_peers = ["node1"]
+    ev = a.search("clusterterm", timeout_s=5.0)
+    urls = {r.url for r in ev.results()}
+    assert "http://b.test/doc.html" in urls
+    assert "http://c.test/doc.html" not in urls

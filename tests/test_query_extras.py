@@ -1,0 +1,105 @@
+"""M9 extras: daterange modifier, /date sort, AccessTracker, site heuristic."""
+
+import datetime
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.search.accesstracker import (AccessTracker,
+                                                         QueryLogEntry)
+from yacy_search_server_tpu.search.query import QueryParams, parse_modifiers
+from yacy_search_server_tpu.switchboard import Switchboard
+
+
+def _days(y, m, d):
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+def test_daterange_modifier_parsing():
+    bare, m = parse_modifiers("news daterange:2020-01-01..2020-12-31")
+    assert bare == "news"
+    assert m.from_days == _days(2020, 1, 1)
+    assert m.to_days == _days(2020, 12, 31)
+    # single date = exact day; compact format accepted
+    _, m2 = parse_modifiers("x daterange:20210615")
+    assert m2.from_days == m2.to_days == _days(2021, 6, 15)
+    # invalid dates are ignored, not crashes
+    _, m3 = parse_modifiers("x daterange:notadate")
+    assert m3.from_days is None and m3.to_days is None
+    # round-trips through to_string for the event-cache id
+    assert "daterange:" in m.to_string()
+
+
+@pytest.fixture()
+def dated_sb(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    for i, (year, word) in enumerate([(2018, "old"), (2020, "mid"),
+                                      (2023, "new")]):
+        doc = Document(url=f"http://d{i}.test/p.html", title=f"doc {year}",
+                       text=f"shared corpus token {word} year",
+                       publish_date_days=_days(year, 6, 1))
+        sb.index.store_document(doc)
+    yield sb
+    sb.close()
+
+
+def test_daterange_filters_results(dated_sb):
+    ev = dated_sb.search("shared daterange:2019-01-01..2021-12-31")
+    urls = [r.url for r in ev.results()]
+    assert urls == ["http://d1.test/p.html"]
+
+
+def test_date_sort_orders_by_recency(dated_sb):
+    ev = dated_sb.search("shared /date")
+    urls = [r.url for r in ev.results()]
+    assert urls == ["http://d2.test/p.html", "http://d1.test/p.html",
+                    "http://d0.test/p.html"]
+
+
+def test_access_tracker_logs_queries(tmp_path, dated_sb):
+    ev = dated_sb.search("shared corpus", client="127.0.0.1")
+    assert ev is not None
+    latest = dated_sb.access_tracker.latest(5)
+    assert latest and latest[0].query == "shared corpus"
+    assert latest[0].query_count == 2
+    assert latest[0].result_count >= 1
+
+
+def test_access_tracker_dump_and_host_window(tmp_path):
+    path = str(tmp_path / "LOG" / "queries.log")
+    tr = AccessTracker(path)
+    for i in range(3):
+        tr.add(QueryLogEntry(query=f"q{i}", timestamp=1000.0 + i,
+                             query_count=1, result_count=i, time_ms=1.5))
+    tr.dump()
+    lines = open(path, encoding="utf-8").read().strip().splitlines()
+    assert len(lines) == 3 and lines[0].endswith("q0")
+    assert tr.track_access("1.2.3.4") == 1
+    assert tr.track_access("1.2.3.4") == 2
+    assert tr.access_hosts()[0] == ("1.2.3.4", 2)
+
+
+def test_site_heuristic_stacks_crawl(tmp_path):
+    seen = []
+
+    def transport(url, headers):
+        seen.append(url)
+        return 404, {}, b""
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), transport=transport)
+    sb.config.set("heuristic.site", "true")
+    try:
+        sb.search("missing site:unknown.test")
+        # the heuristic fires in the background (it must not stall the
+        # search request): poll for the stacked site root
+        import time
+        from yacy_search_server_tpu.crawler.frontier import StackType
+        deadline = time.time() + 10.0
+        while time.time() < deadline \
+                and sb.noticed.size(StackType.LOCAL) == 0:
+            time.sleep(0.05)
+        assert sb.noticed.size(StackType.LOCAL) == 1
+        # cooldown: an immediate re-query must not fire again
+        assert sb.heuristic_site("unknown.test") is False
+    finally:
+        sb.close()

@@ -1,0 +1,221 @@
+"""News gossip — the network's event channel, piggybacked on peer pings.
+
+Capability equivalent of the reference's news system (reference:
+source/net/yacy/peers/NewsDB.java — persistent news records with id =
+originator+created+category, attribute maps, distribution counters — and
+NewsPool.java:598 — incoming/processed/outgoing/published queues with
+per-category expiry, fed and drained by the hello exchange). Categories
+carry decentralized announcements: crawl starts, profile updates,
+bookmark/wiki/blog changes, votes. Peers relay a bounded sample of fresh
+records with every hello, so news floods the network without any broker.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+# category names follow the reference (NewsPool.java constants)
+CAT_CRAWL_START = "crwlstrt"
+CAT_CRAWL_STOP = "crwlstop"
+CAT_PROFILE_UPDATE = "prfleupd"
+CAT_BOOKMARK_ADD = "bkmrkadd"
+CAT_WIKI_UPDATE = "wiki_upd"
+CAT_BLOG_ADD = "blog_add"
+CAT_VOTE_ADD = "stippadd"
+
+MAX_NEWS_PER_HELLO = 8          # gossip batch bound per exchange
+MAX_INCOMING = 1000             # pool bound (NewsPool maxsize semantics)
+NEWS_TTL_S = 3 * 24 * 3600.0    # records expire (per-category in reference)
+MAX_RELAY_SENDS = 32            # stop re-gossiping a record after N sends
+
+
+class NewsRecord:
+    """One announcement: identity is (originator, created, category)."""
+
+    def __init__(self, category: str, originator: str, attributes: dict,
+                 created: float | None = None, record_id: str | None = None):
+        self.category = category
+        self.originator = originator          # peer hash (ascii)
+        self.created = created if created is not None else time.time()
+        self.attributes = dict(attributes)
+        self.id = record_id or self._make_id()
+        self.distributed = 0                  # times gossiped onward by us
+
+    def _make_id(self) -> str:
+        key = f"{self.originator}|{self.created:.3f}|{self.category}"
+        return hashlib.md5(key.encode("utf-8")).hexdigest()[:24]  # nosec
+
+    def age_s(self) -> float:
+        return time.time() - self.created
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "cat": self.category, "orig": self.originator,
+                "created": self.created, "attr": self.attributes}
+
+    @staticmethod
+    def from_dict(d: dict) -> "NewsRecord":
+        return NewsRecord(d["cat"], d["orig"], d.get("attr", {}),
+                          created=float(d["created"]), record_id=d["id"])
+
+
+class NewsPool:
+    """Incoming/processed news queues + my own outgoing records."""
+
+    def __init__(self, data_dir: str | None = None):
+        self._incoming: dict[str, NewsRecord] = {}
+        self._processed: set[str] = set()
+        self._mine: dict[str, NewsRecord] = {}
+        self._lock = threading.Lock()
+        self._path = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._path = os.path.join(data_dir, "news.jsonl")
+            self._load()
+
+    # -- publish (my own announcements) --------------------------------------
+
+    def publish(self, category: str, originator: str,
+                attributes: dict) -> NewsRecord:
+        rec = NewsRecord(category, originator, attributes)
+        with self._lock:
+            self._mine[rec.id] = rec
+            self._append(rec, "mine")
+        return rec
+
+    # -- gossip exchange ------------------------------------------------------
+
+    def outgoing_batch(self, n: int = MAX_NEWS_PER_HELLO) -> list[dict]:
+        """Fresh records to attach to a hello: my own first, then relayed
+        incoming ones that have not been re-sent too often."""
+        with self._lock:
+            self._expire_locked()
+            out: list[NewsRecord] = []
+            mine = sorted(self._mine.values(), key=lambda r: -r.created)
+            out.extend(r for r in mine if r.distributed < MAX_RELAY_SENDS)
+            relay = sorted((r for r in self._incoming.values()
+                            if r.distributed < MAX_RELAY_SENDS),
+                           key=lambda r: -r.created)
+            out.extend(relay)
+            out = out[:n]
+            for r in out:
+                r.distributed += 1
+            return [r.to_dict() for r in out]
+
+    def ingest_batch(self, records: list[dict], my_hash: str) -> int:
+        """Merge gossip received with a hello; my own records bounce off."""
+        added = 0
+        with self._lock:
+            for d in records:
+                try:
+                    rec = NewsRecord.from_dict(d)
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if rec.originator == my_hash or rec.id in self._processed \
+                        or rec.id in self._incoming or rec.id in self._mine:
+                    continue
+                if rec.age_s() > NEWS_TTL_S:
+                    continue
+                if len(self._incoming) >= MAX_INCOMING:
+                    oldest = min(self._incoming.values(),
+                                 key=lambda r: r.created)
+                    del self._incoming[oldest.id]
+                self._incoming[rec.id] = rec
+                self._append(rec, "in")
+                added += 1
+        return added
+
+    # -- consumption ----------------------------------------------------------
+
+    def incoming(self, category: str | None = None) -> list[NewsRecord]:
+        with self._lock:
+            recs = [r for r in self._incoming.values()
+                    if category is None or r.category == category]
+            return sorted(recs, key=lambda r: -r.created)
+
+    MAX_PROCESSED_IDS = 4096   # TTL bounds replays; ids older than that
+                               # can be forgotten safely
+
+    def mark_processed(self, record_id: str) -> None:
+        with self._lock:
+            if self._incoming.pop(record_id, None) is not None:
+                self._processed.add(record_id)
+                while len(self._processed) > self.MAX_PROCESSED_IDS:
+                    self._processed.pop()
+                if self._path:
+                    try:
+                        with open(self._path, "a", encoding="utf-8") as f:
+                            f.write(json.dumps({"k": "proc",
+                                                "id": record_id}) + "\n")
+                    except OSError:
+                        pass
+
+    def size(self) -> tuple[int, int, int]:
+        with self._lock:
+            return len(self._incoming), len(self._processed), len(self._mine)
+
+    # -- internals ------------------------------------------------------------
+
+    def _expire_locked(self) -> None:
+        for pool in (self._incoming, self._mine):
+            dead = [rid for rid, r in pool.items() if r.age_s() > NEWS_TTL_S]
+            for rid in dead:
+                del pool[rid]
+
+    def _append(self, rec: NewsRecord, kind: str) -> None:
+        if not self._path:
+            return
+        try:
+            with open(self._path, "a", encoding="utf-8") as f:
+                f.write(json.dumps({"k": kind, **rec.to_dict()}) + "\n")
+        except OSError:
+            pass
+
+    def _load(self) -> None:
+        if not self._path or not os.path.exists(self._path):
+            return
+        try:
+            with open(self._path, "r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue
+                    if d.get("k") == "proc":
+                        rid = d.get("id", "")
+                        self._processed.add(rid)
+                        self._incoming.pop(rid, None)
+                        continue
+                    try:
+                        rec = NewsRecord.from_dict(d)
+                    except (KeyError, ValueError):
+                        continue
+                    if rec.age_s() > NEWS_TTL_S or rec.id in self._processed:
+                        continue
+                    pool = self._mine if d.get("k") == "mine" else self._incoming
+                    pool[rec.id] = rec
+        except OSError:
+            pass
+        self._compact()
+
+    def _compact(self) -> None:
+        """Rewrite the append-only journal with only live state — expired,
+        superseded and processed-and-forgotten lines drop out, bounding the
+        file across restarts."""
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in self._mine.values():
+                    f.write(json.dumps({"k": "mine", **rec.to_dict()}) + "\n")
+                for rec in self._incoming.values():
+                    f.write(json.dumps({"k": "in", **rec.to_dict()}) + "\n")
+                for rid in self._processed:
+                    f.write(json.dumps({"k": "proc", "id": rid}) + "\n")
+            os.replace(tmp, self._path)
+        except OSError:
+            pass

@@ -17,6 +17,7 @@ from ..search.searchevent import SearchEvent
 from ..switchboard import Switchboard
 from .dispatcher import Dispatcher
 from .network import Network
+from .news import CAT_CRAWL_START, NewsPool
 from .protocol import Protocol
 from .remotesearch import RemoteSearch
 from .seed import PeerType, Seed, SeedDB, make_seed_hash
@@ -42,7 +43,8 @@ class P2PNode:
                  redundancy: int = DEFAULT_REDUNDANCY,
                  peer_type: str = PeerType.SENIOR,
                  accept_remote_index: bool = True,
-                 accept_remote_crawl: bool = False):
+                 accept_remote_crawl: bool = False,
+                 cluster_peers: list[str] | None = None):
         self.sb = Switchboard(data_dir=data_dir, transport=crawl_transport)
         self.seed = Seed(make_seed_hash(name, "127.0.0.1", port), name=name,
                          port=port, peer_type=peer_type)
@@ -51,15 +53,18 @@ class P2PNode:
         self.seeddb = SeedDB(self.seed, data_dir)
         self.dist = Distribution(partition_exponent)
         self.redundancy = redundancy
-        self.protocol = Protocol(self.seeddb, p2p_transport)
+        self.news = NewsPool(data_dir)
+        self.protocol = Protocol(self.seeddb, p2p_transport, news=self.news)
         self.server = PeerServer(self.sb, self.seeddb,
                                  accept_remote_index=accept_remote_index,
-                                 accept_remote_crawl=accept_remote_crawl)
+                                 accept_remote_crawl=accept_remote_crawl,
+                                 news=self.news)
         p2p_transport.register(self.seed.hash, self.server.handle)
         self._transport = p2p_transport
         self.dispatcher = Dispatcher(self.sb.index, self.seeddb, self.dist,
                                      self.protocol, redundancy)
         self.network = Network(self.seeddb, self.protocol)
+        self.cluster_peers = list(cluster_peers or [])
         self._rng = random.Random(self.seed.ring_position())
 
     # -- membership ----------------------------------------------------------
@@ -120,19 +125,82 @@ class P2PNode:
                 break
         return total
 
+    # -- crawl (news-announcing wrapper + remote crawl delegation) -----------
+
+    def start_crawl(self, start_url: str, depth: int = 0, **kw):
+        """Start a crawl and announce it on the news channel
+        (reference: Switchboard publishes a crwlstrt record on crawl start)."""
+        profile = self.sb.start_crawl(start_url, depth=depth, **kw)
+        self.news.publish(CAT_CRAWL_START,
+                          self.seed.hash.decode("ascii", "replace"),
+                          {"startURL": start_url, "intention":
+                           kw.get("name", ""), "generalDepth": str(depth)})
+        return profile
+
+    def remote_crawl_loader_job(self, max_urls: int = 10) -> bool:
+        """Pull delegated crawl work from a peer that publishes it, load
+        the pages into MY index, and report receipts back (reference:
+        CrawlQueues.remoteCrawlLoaderJob:444 + crawlReceipt round-trip).
+        Returns True if any URL was processed (BusyThread contract)."""
+        providers = [s for s in self.seeddb.active_seeds()
+                     if s.flags_accept_remote_crawl]
+        if not providers:
+            return False
+        provider = self._rng.choice(providers)
+        requests = self.protocol.pull_crawl_urls(provider, count=max_urls)
+        worked = False
+        from ..crawler.loader import CacheStrategy
+        from ..crawler.request import Request
+        for rd in requests:
+            try:
+                req = Request.from_dict(rd)
+            except (KeyError, ValueError):
+                continue
+            try:
+                resp = self.sb.loader.load(req, CacheStrategy.IFFRESH)
+            except Exception:
+                self.protocol.crawl_receipt(provider, req.urlhash(),
+                                            "exception", "load failed")
+                continue
+            if resp.status == 200:
+                # the delegator's profile handle never resolves here (handles
+                # hash node-local creation state); fall back to the dedicated
+                # "remote" default profile, not an arbitrary one
+                profile = self.sb.profiles.get(req.profile_handle) or \
+                    next((p for p in self.sb.profiles.values()
+                          if p.name == "remote"),
+                         next(iter(self.sb.profiles.values())))
+                self.sb.to_indexer(resp, profile)
+                self.protocol.crawl_receipt(provider, req.urlhash(), "fill")
+                worked = True
+            else:
+                self.protocol.crawl_receipt(provider, req.urlhash(),
+                                            "reject", f"status {resp.status}")
+        return worked
+
     # -- search --------------------------------------------------------------
 
     def search(self, query_string: str, count: int = 10,
                remote: bool = True, timeout_s: float = 3.0,
                secondary: bool = True) -> SearchEvent:
         """Local batched search + remote scatter-gather into one event
-        (the yacysearch entry: local threads + primaryRemoteSearches)."""
+        (the yacysearch entry: local threads + primaryRemoteSearches).
+
+        Cluster mode (reference: cluster.peers.yacydomain allowlist ->
+        Searchdom.CLUSTER): when `cluster_peers` is set, the scatter goes to
+        exactly that fixed peer set instead of DHT-selected targets."""
         event = self.sb.search(query_string, count=count)
         if remote and self.seeddb.active:
             rs = RemoteSearch(event, self.seeddb, self.dist, self.protocol,
                               redundancy=self.redundancy,
                               per_peer_count=count, timeout_s=timeout_s)
-            rs.start()
+            if self.cluster_peers:
+                allowed = {n.lower() for n in self.cluster_peers}
+                targets = [s for s in self.seeddb.active_seeds()
+                           if s.name.lower() in allowed]
+                rs.start_fixed(targets)
+            else:
+                rs.start()
             rs.join()
             if secondary and rs.secondary_search():
                 rs.join(timeout_s / 2)
@@ -182,3 +250,6 @@ class P2PNode:
         self.sb.threads.deploy(BusyThread(
             "70_dht_distribution", self.dht_transfer_job,
             idle_sleep_s=15.0, busy_sleep_s=1.0))
+        self.sb.threads.deploy(BusyThread(
+            "62_remotetriggeredcrawl", self.remote_crawl_loader_job,
+            idle_sleep_s=10.0, busy_sleep_s=1.0))

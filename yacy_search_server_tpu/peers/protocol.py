@@ -47,9 +47,10 @@ def decode_postings(table: dict) -> tuple[list[bytes], np.ndarray]:
 class Protocol:
     """Stateless client methods bound to (my seeddb, transport)."""
 
-    def __init__(self, seeddb: SeedDB, transport: Transport):
+    def __init__(self, seeddb: SeedDB, transport: Transport, news=None):
         self.seeddb = seeddb
         self.transport = transport
+        self.news = news            # NewsPool | None (peers/news.py)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -76,8 +77,12 @@ class Protocol:
         (Protocol.java:190; Network.publishMySeed)."""
         my = self.seeddb.my_seed
         gossip = [s.dna() for s in self.seeddb.active_seeds()[:16]]
-        ok, reply = self._call(target, "hello",
-                               {"seed": my.dna(), "seeds": gossip})
+        payload = {"seed": my.dna(), "seeds": gossip}
+        if self.news is not None:
+            # news rides the ping (reference: hello exchange carries the
+            # news queues, NewsPool feed/drain in PeerActions)
+            payload["news"] = self.news.outgoing_batch()
+        ok, reply = self._call(target, "hello", payload)
         if not ok:
             return False, {}
         if "seed" in reply:
@@ -87,6 +92,9 @@ class Protocol:
                 self.seeddb.hearsay(Seed.from_dna(dna))
             except (KeyError, ValueError):
                 continue
+        if self.news is not None and reply.get("news"):
+            self.news.ingest_batch(reply["news"],
+                                   my.hash.decode("ascii", "replace"))
         return True, reply
 
     def seedlist(self, target: Seed) -> list[Seed]:

@@ -81,15 +81,24 @@ class RemoteSearch:
         include = self.event.query.goal.include_hashes
         if not include:
             return 0
-        if with_abstracts is None:
-            with_abstracts = len(include) > 1
         targets = select_search_targets(
             self.seeddb, self.dist, include, self.redundancy)
         have = {t.hash for t in targets}
         extras = sorted((s for s in self.seeddb.active_seeds()
                          if s.is_senior() and s.hash not in have),
                         key=lambda s: s.hash)[:extra_peers]
-        targets = targets + extras
+        return self.start_fixed(targets + extras, with_abstracts)
+
+    def start_fixed(self, targets: list[Seed],
+                    with_abstracts: bool | None = None) -> int:
+        """Scatter to an explicit peer set — the shared spawn loop, and the
+        cluster-mode entry (reference: QueryParams.Searchdom.CLUSTER over
+        the cluster allowlist)."""
+        include = self.event.query.goal.include_hashes
+        if not include or not targets:
+            return 0
+        if with_abstracts is None:
+            with_abstracts = len(include) > 1
         for t in targets:
             th = threading.Thread(
                 target=self._one_peer, args=(t, with_abstracts),

@@ -29,12 +29,13 @@ class PeerServer:
     def __init__(self, switchboard, seeddb: SeedDB,
                  accept_remote_index: bool = True,
                  accept_remote_crawl: bool = False,
-                 blacklist=None):
+                 blacklist=None, news=None):
         self.sb = switchboard
         self.seeddb = seeddb
         self.accept_remote_index = accept_remote_index
         self.accept_remote_crawl = accept_remote_crawl
         self.blacklist = blacklist     # callable(url) -> bool (denied)
+        self.news = news               # NewsPool | None
         self.received_rwi_count = 0
         self.received_url_count = 0
 
@@ -64,8 +65,14 @@ class PeerServer:
         me = self.seeddb.my_seed
         me.link_count = self.sb.index.doc_count()
         me.word_count = self.sb.index.rwi_size()
-        return {"seed": me.dna(),
-                "seeds": [s.dna() for s in self.seeddb.active_seeds()[:16]]}
+        reply = {"seed": me.dna(),
+                 "seeds": [s.dna() for s in self.seeddb.active_seeds()[:16]]}
+        if self.news is not None:
+            if payload.get("news"):
+                self.news.ingest_batch(payload["news"],
+                                       me.hash.decode("ascii", "replace"))
+            reply["news"] = self.news.outgoing_batch()
+        return reply
 
     def do_seedlist(self, payload: dict) -> dict:
         return {"seeds": [s.dna() for s in self.seeddb.all_seeds()[:256]]}

@@ -1,0 +1,100 @@
+"""AccessTracker — per-query log + host access accounting.
+
+Capability equivalent of the reference's search access tracking (reference:
+source/net/yacy/search/query/AccessTracker.java:50-172 — a bounded
+in-memory list of executed queries with timing/result counts, dumped to a
+log file for statistics, plus host-level access counts used for abuse
+control on the public search surface; host access also in
+server/serverAccessTracker.java).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+MAX_FINISHED = 500          # bounded history (reference minSize/maxSize trim)
+DUMP_BATCH = 50             # entries buffered before a dump append
+
+
+@dataclass
+class QueryLogEntry:
+    query: str
+    timestamp: float
+    query_count: int        # include-word count
+    result_count: int
+    time_ms: float
+    offset: int = 0
+    client: str = ""
+
+    def dump_line(self) -> str:
+        # one line per query: unixtime, client, words, results, millis, query
+        return (f"{int(self.timestamp)} {self.client or '-'} "
+                f"{self.query_count} {self.result_count} "
+                f"{self.time_ms:.1f} {self.query}")
+
+
+class AccessTracker:
+    """Bounded query history with optional file dump + per-host counters."""
+
+    def __init__(self, dump_path: str | None = None):
+        self.dump_path = dump_path
+        self._finished: deque[QueryLogEntry] = deque(maxlen=MAX_FINISHED)
+        self._undumped: list[str] = []
+        self._host_access: dict[str, list[float]] = {}
+        self._lock = threading.Lock()
+        if dump_path:
+            os.makedirs(os.path.dirname(dump_path), exist_ok=True)
+
+    # -- query log -----------------------------------------------------------
+
+    def add(self, entry: QueryLogEntry) -> None:
+        with self._lock:
+            self._finished.append(entry)
+            if self.dump_path:
+                self._undumped.append(entry.dump_line())
+                if len(self._undumped) >= DUMP_BATCH:
+                    self._dump_locked()
+
+    def latest(self, n: int = 50) -> list[QueryLogEntry]:
+        with self._lock:
+            return list(self._finished)[-n:][::-1]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._finished)
+
+    def _dump_locked(self) -> None:
+        lines, self._undumped = self._undumped, []
+        try:
+            with open(self.dump_path, "a", encoding="utf-8") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError:
+            pass
+
+    def dump(self) -> None:
+        with self._lock:
+            if self._undumped:
+                self._dump_locked()
+
+    # -- host access (abuse control surface) ---------------------------------
+
+    def track_access(self, client_host: str, window_s: float = 600.0) -> int:
+        """Record one access from `client_host`; returns accesses within the
+        window (callers throttle above a threshold)."""
+        now = time.time()
+        with self._lock:
+            times = self._host_access.setdefault(client_host, [])
+            times.append(now)
+            cutoff = now - window_s
+            while times and times[0] < cutoff:
+                times.pop(0)
+            return len(times)
+
+    def access_hosts(self) -> list[tuple[str, int]]:
+        with self._lock:
+            return sorted(((h, len(t)) for h, t in self._host_access.items()),
+                          key=lambda x: -x[1])

@@ -42,12 +42,16 @@ class QueryModifier:
     intitle: str = ""
     language: str = ""
     date_sort: bool = False
+    # daterange:YYYY-MM-DD..YYYY-MM-DD -> inclusive bounds, days since epoch
+    from_days: int | None = None
+    to_days: int | None = None
 
     def is_empty(self) -> bool:
         return not (self.sitehost or self.filetype or self.author
                     or self.keyword or self.tld or self.protocol
                     or self.inurl or self.intitle or self.language
-                    or self.date_sort)
+                    or self.date_sort or self.from_days is not None
+                    or self.to_days is not None)
 
     def to_string(self) -> str:
         parts = []
@@ -71,6 +75,8 @@ class QueryModifier:
             parts.append(f"/language/{self.language}")
         if self.date_sort:
             parts.append("/date")
+        if self.from_days is not None or self.to_days is not None:
+            parts.append(f"daterange:{self.from_days}..{self.to_days}")
         return " ".join(parts)
 
 
@@ -121,6 +127,9 @@ def parse_modifiers(querystring: str) -> tuple[str, QueryModifier]:
     q, m.protocol = _strip_prefix_op(q, "protocol:")
     q, m.inurl = _strip_prefix_op(q, "inurl:")
     q, m.intitle = _strip_prefix_op(q, "intitle:")
+    q, dr = _strip_prefix_op(q, "daterange:")
+    if dr:
+        m.from_days, m.to_days = _parse_daterange(dr)
     lang = _LANG_MOD.search(q)
     if lang:
         m.language = lang.group(1).lower()
@@ -206,6 +215,27 @@ class QueryGoal:
 
 def _words(s: str) -> list[str]:
     return [w.lower() for w in re.findall(r"\w+", s, re.UNICODE) if w]
+
+
+def _days_since_epoch(datestr: str) -> int | None:
+    """'YYYY-MM-DD' or 'YYYYMMDD' -> days since 1970-01-01; None if invalid."""
+    import datetime
+    s = datestr.strip().replace("-", "")
+    if len(s) != 8 or not s.isdigit():
+        return None
+    try:
+        d = datetime.date(int(s[:4]), int(s[4:6]), int(s[6:8]))
+    except ValueError:
+        return None
+    return d.toordinal() - datetime.date(1970, 1, 1).toordinal()
+
+
+def _parse_daterange(spec: str) -> tuple[int | None, int | None]:
+    """'from..to' (either side optional) -> inclusive day bounds."""
+    parts = spec.split("..") if ".." in spec else [spec, spec]
+    lo = _days_since_epoch(parts[0]) if parts[0] else None
+    hi = _days_since_epoch(parts[1]) if len(parts) > 1 and parts[1] else None
+    return lo, hi
 
 
 @dataclass

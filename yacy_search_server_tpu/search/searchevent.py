@@ -138,10 +138,17 @@ class SearchEvent:
                       for d in cand.docids.tolist()]
         k = min(len(cand),
                 max(q.item_count + q.offset, 10) * TOPK_OVERSAMPLE)
-        with StageTimer(EClass.SEARCH, "NORMALIZING", len(cand)):
-            scores, docids = self._ranker.rank(cand, hosthashes, k=k)
+        if q.modifier.date_sort:
+            # /date modifier: recency replaces the cardinal as the sort key
+            # (reference: QueryModifier /date -> Solr sort last_modified desc)
+            lastmod = cand.feats[:, P.F_LASTMOD].astype(np.int64)
+            order = np.argsort(-lastmod, kind="stable")[:k]
+            scores, docids = lastmod[order], cand.docids[order]
+        else:
+            with StageTimer(EClass.SEARCH, "NORMALIZING", len(cand)):
+                scores, docids = self._ranker.rank(cand, hosthashes, k=k)
 
-        if q.hybrid and len(docids):
+        if q.hybrid and len(docids) and not q.modifier.date_sort:
             with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
                 scores, docids = self._dense_rerank(scores, docids)
 
@@ -196,6 +203,11 @@ class SearchEvent:
         if q.modifier.language:
             mask &= plist.feats[:, P.F_LANGUAGE] == P.pack_language(
                 q.modifier.language)
+        # daterange: inclusive bounds on last-modified days
+        if q.modifier.from_days is not None:
+            mask &= plist.feats[:, P.F_LASTMOD] >= q.modifier.from_days
+        if q.modifier.to_days is not None:
+            mask &= plist.feats[:, P.F_LASTMOD] <= q.modifier.to_days
         # metadata-column constraints: direct column reads, not full-row
         # DocumentMetadata materialization (hot path over up to 100k rows)
         meta = self.segment.metadata
@@ -267,13 +279,27 @@ class SearchEvent:
         """Dedup + host-diversity + post-ranking + heap insert. `meta` is
         the already-joined metadata row for local results (None for remote
         entries, which carry no local row)."""
+        q = self.query
+        # remote entries never went through _constraint_mask: recheck the
+        # daterange bounds on the metadata they carry (local entries were
+        # already filtered; their recheck is a no-op)
+        if q.modifier.from_days is not None \
+                and entry.lastmod_days < q.modifier.from_days:
+            return False
+        if q.modifier.to_days is not None \
+                and entry.lastmod_days > q.modifier.to_days:
+            return False
+        if q.modifier.date_sort:
+            # one sort key for every producer: recency (remote cardinal
+            # scores are on an incomparable scale)
+            entry.score = entry.lastmod_days
         with self._lock:
             if entry.urlhash in self._seen_urlhashes:
                 return False
             self._seen_urlhashes.add(entry.urlhash)
             hh = hosthash(entry.urlhash)
             cnt = self._host_counts.get(hh, 0)
-            if cnt >= self.query.max_per_host:
+            if cnt >= q.max_per_host:
                 # doubledom diversion: parked, re-merged if page underfills
                 self._diverted.append((entry.score, entry))
                 return False
@@ -290,6 +316,8 @@ class SearchEvent:
         SearchEvent.java:1963-2021): query appearing in title/url and
         citation references raise the pre-sorted score."""
         q, score = self.query, entry.score
+        if q.modifier.date_sort:
+            return score  # recency IS the sort key; boosts would distort it
         prof = q.profile
         tl = entry.title.lower()
         ul = entry.url.lower()

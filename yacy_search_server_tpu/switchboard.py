@@ -89,6 +89,10 @@ class Switchboard:
             indexer=self.to_indexer)
         self.web_structure = WebStructureGraph(sub("WEBSTRUCTURE"))
         self.search_cache = SearchEventCache()
+        from .search.accesstracker import AccessTracker
+        self.access_tracker = AccessTracker(
+            os.path.join(data_dir, "LOG", "queries.log") if data_dir else None)
+        self._heuristic_fired: dict[str, float] = {}
         self.threads = ThreadRegistry()
 
         self.indexed_count = 0
@@ -130,6 +134,8 @@ class Switchboard:
         req = Request(url=start_url, profile_handle=profile.handle, depth=0)
         reason = self.crawl_stacker.stack(req)
         if reason:
+            # rejected start never crawls: do not leak its profile
+            self.profiles.pop(profile.handle, None)
             raise ValueError(f"start url rejected: {reason}")
         return profile
 
@@ -219,12 +225,54 @@ class Switchboard:
     # -- search --------------------------------------------------------------
 
     def search(self, query_string: str, count: int = 10,
-               offset: int = 0, hybrid: bool = False) -> SearchEvent:
+               offset: int = 0, hybrid: bool = False,
+               client: str = "") -> SearchEvent:
         q = QueryParams.parse(query_string)
         q.item_count = count
         q.offset = offset
         q.hybrid = hybrid
-        return self.search_cache.get_event(q, self.index)
+        t0 = time.time()
+        event = self.search_cache.get_event(q, self.index)
+        from .search.accesstracker import QueryLogEntry
+        self.access_tracker.add(QueryLogEntry(
+            query=query_string, timestamp=t0,
+            query_count=len(q.goal.include_words),
+            result_count=event.result_heap.size_available(),
+            time_ms=(time.time() - t0) * 1000.0,
+            offset=offset, client=client))
+        # site heuristic (reference: Switchboard.heuristicSite:4209): a
+        # site:-restricted query that finds little triggers a shallow crawl
+        # of that site so the next query round can answer from the index
+        if q.modifier.sitehost and self.config.get_bool(
+                "heuristic.site", False) \
+                and event.result_heap.size_available() < count:
+            self.heuristic_site(q.modifier.sitehost)
+        return event
+
+    # heuristic re-fire cooldown per host (the reference's heuristics are
+    # one-shot per search event; a cached event pages without re-searching)
+    HEURISTIC_COOLDOWN_S = 600.0
+
+    def heuristic_site(self, host: str) -> bool:
+        """Stack a shallow heuristic crawl of `host` in the background
+        (fire-and-forget; robots.txt fetch must not stall the search
+        request that triggered it). Per-host cooldown stops underfilled
+        repeat queries from re-firing."""
+        now = time.time()
+        last = self._heuristic_fired.get(host, 0.0)
+        if now - last < self.HEURISTIC_COOLDOWN_S:
+            return False
+        self._heuristic_fired[host] = now
+
+        def _fire():
+            try:
+                self.start_crawl(f"http://{host}/", depth=1,
+                                 name=f"heuristic:{host}")
+            except ValueError:
+                pass
+        threading.Thread(target=_fire, name=f"heuristic-{host}",
+                         daemon=True).start()
+        return True
 
     # -- surrogate import (Switchboard.java:1153-1174 busy thread) -----------
 
@@ -298,4 +346,5 @@ class Switchboard:
             p.shutdown()
         self.noticed.close()
         self.web_structure.close()
+        self.access_tracker.dump()
         self.index.close()
