@@ -1,0 +1,11 @@
+#!/bin/sh
+# Start a node in the background (reference: startYACY.sh).
+# Usage: bin/startYACY.sh [DATA_DIR] [PORT]
+DATA="${1:-DATA}"
+PORT="${2:-8090}"
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p "$DATA/LOG"
+nohup python -m yacy_search_server_tpu.yacy -start \
+    --data "$DATA" --port "$PORT" \
+    >> "$DATA/LOG/yacy.out" 2>&1 &
+echo "started (pid $!), log: $DATA/LOG/yacy.out, ui: http://127.0.0.1:$PORT"

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Stop the running node via its Steering servlet (reference: stopYACY.sh).
+# Usage: bin/stopYACY.sh [PORT]
+PORT="${1:-8090}"
+cd "$(dirname "$0")/.." || exit 1
+exec python -m yacy_search_server_tpu.yacy -shutdown --port "$PORT"

@@ -1,0 +1,69 @@
+"""M12 — launcher lifecycle: lock file, migration, startup/shutdown verbs."""
+
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu import yacy as launcher
+from yacy_search_server_tpu.migration import migrate
+from yacy_search_server_tpu.utils.config import Config
+
+
+def test_lock_file_lifecycle(tmp_path):
+    d = str(tmp_path / "DATA")
+    lock = launcher.acquire_lock(d)
+    assert open(lock).read() == str(os.getpid())
+    # a second acquire against a LIVE pid refuses
+    with pytest.raises(RuntimeError):
+        launcher.acquire_lock(d)
+    launcher.release_lock(lock)
+    # stale lock (dead pid) is cleaned up
+    with open(lock, "w") as f:
+        f.write("999999999")
+    lock2 = launcher.acquire_lock(d)
+    assert open(lock2).read() == str(os.getpid())
+    launcher.release_lock(lock2)
+
+
+def test_migration_steps(tmp_path):
+    cfg = Config(settings_path=str(tmp_path / "yacy.conf"))
+    ran = migrate(cfg, launcher.VERSION)
+    assert ran == 2
+    assert cfg.get("version") == launcher.VERSION
+    assert cfg.get("network.unit.definition") == "freeworld"
+    # second run is a no-op
+    assert migrate(cfg, launcher.VERSION) == 0
+
+
+def test_startup_serve_shutdown(tmp_path):
+    d = str(tmp_path / "DATA")
+    node, http, lock = launcher.startup(d, port=0, p2p=False)
+    try:
+        sb = getattr(node, "sb", node)
+        assert os.path.exists(os.path.join(d, "yacy.running"))
+        with urllib.request.urlopen(http.base_url + "/Status.json",
+                                    timeout=10) as r:
+            assert r.status == 200
+        # Steering servlet fires the shutdown event (the -shutdown verb)
+        with urllib.request.urlopen(
+                http.base_url + "/Steering_p.json?shutdown=1",
+                timeout=10) as r:
+            assert r.status == 200
+        assert sb.shutdown_event.wait(5.0)
+    finally:
+        node.close()
+        http.close()
+        launcher.release_lock(lock)
+
+
+def test_cli_version():
+    out = subprocess.run(
+        [sys.executable, "-m", "yacy_search_server_tpu.yacy", "-version"],
+        capture_output=True, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), timeout=60)
+    assert out.returncode == 0
+    assert out.stdout.strip() == launcher.VERSION

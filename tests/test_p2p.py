@@ -293,6 +293,10 @@ def test_remote_crawl_delegation(trio):
     from yacy_search_server_tpu.crawler.request import Request
     a.sb.noticed.push(StackType.GLOBAL, Request("http://delegate.test/p1"))
     a.sb.noticed.push(StackType.GLOBAL, Request("http://delegate.test/p2"))
+    # without consent the stack must NOT be drainable by other peers
+    assert b.protocol.pull_crawl_urls(a.seed, count=5) == []
+    assert a.sb.noticed.size(StackType.GLOBAL) == 2
+    a.server.accept_remote_crawl = True
     pulled = b.protocol.pull_crawl_urls(a.seed, count=5)
     assert len(pulled) == 2
     assert a.sb.noticed.size(StackType.GLOBAL) == 0

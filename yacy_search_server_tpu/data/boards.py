@@ -1,0 +1,193 @@
+"""Data boards — wiki, blog, peer messages.
+
+Capability equivalents of the reference's community-data subsystems
+(reference: source/net/yacy/data/wiki/WikiBoard.java + WikiCode.java
+markup renderer, data/BlogBoard.java, data/MessageBoard.java — each a
+MapHeap of dated, authored records; wiki keeps a version history in a
+separate bkp store). All three sit on the generic Tables substrate here.
+"""
+
+from __future__ import annotations
+
+import html
+import re
+import time
+
+from .tables import Tables
+
+
+# -- WikiCode markup (subset of reference WikiCode.java) ----------------------
+
+_RE_H = [(re.compile(rf"^{'=' * n}\s*(.+?)\s*{'=' * n}\s*$"), f"h{8 - n}")
+         for n in (6, 5, 4, 3, 2)]
+_RE_BOLD = re.compile(r"'''(.+?)'''")
+_RE_ITALIC = re.compile(r"''(.+?)''")
+_RE_LINK_EXT = re.compile(r"\[(https?://[^\s\]]+)(?:\s+([^\]]+))?\]")
+_RE_LINK_WIKI = re.compile(r"\[\[([^\]|]+)(?:\|([^\]]+))?\]\]")
+
+
+def wikicode_to_html(text: str) -> str:
+    """Render the load-bearing WikiCode subset: == headings ==, '''bold''',
+    ''italic'', [[page]] / [[page|label]], [url label], * / # lists,
+    ---- rules, blank-line paragraphs."""
+    out: list[str] = []
+    in_list: str | None = None
+
+    def close_list():
+        nonlocal in_list
+        if in_list:
+            out.append(f"</{in_list}>")
+            in_list = None
+
+    def _attr(v: str) -> str:
+        # tags are escaped below with quote=False; attribute values must
+        # still neutralize quotes so hrefs cannot break out
+        return v.replace('"', "%22").replace("'", "%27")
+
+    for raw in text.splitlines():
+        line = html.escape(raw.rstrip(), quote=False)
+        line = _RE_BOLD.sub(r"<b>\1</b>", line)
+        line = _RE_ITALIC.sub(r"<i>\1</i>", line)
+        line = _RE_LINK_WIKI.sub(
+            lambda m: f'<a href="Wiki.html?page={_attr(m.group(1).strip())}">'
+                      f'{m.group(2) or m.group(1)}</a>', line)
+        line = _RE_LINK_EXT.sub(
+            lambda m: f'<a href="{_attr(m.group(1))}">'
+                      f'{m.group(2) or m.group(1)}</a>',
+            line)
+        if line.strip() == "----":
+            close_list()
+            out.append("<hr/>")
+            continue
+        matched_h = False
+        for rex, tag in _RE_H:
+            m = rex.match(line)
+            if m:
+                close_list()
+                out.append(f"<{tag}>{m.group(1)}</{tag}>")
+                matched_h = True
+                break
+        if matched_h:
+            continue
+        if line.startswith(("* ", "# ")):
+            want = "ul" if line[0] == "*" else "ol"
+            if in_list != want:
+                close_list()
+                out.append(f"<{want}>")
+                in_list = want
+            out.append(f"<li>{line[2:]}</li>")
+            continue
+        close_list()
+        if not line.strip():
+            out.append("<p/>")
+        else:
+            out.append(line + "<br/>")
+    close_list()
+    return "\n".join(out)
+
+
+class WikiBoard:
+    """Named pages with full version history (WikiBoard + bkp semantics)."""
+
+    TABLE = "wiki"
+    TABLE_BKP = "wiki_bkp"
+
+    def __init__(self, tables: Tables):
+        self.tables = tables
+
+    def put(self, page: str, content: str, author: str = "anonymous") -> None:
+        key = page.strip().lower()
+        old = self.tables.get(self.TABLE, key)
+        if old is not None:
+            self.tables.insert(self.TABLE_BKP, old, pk=None)
+        self.tables.insert(self.TABLE, {
+            "page": page.strip(), "content": content, "author": author,
+            "date": time.time()}, pk=key)
+
+    def get(self, page: str) -> dict | None:
+        return self.tables.get(self.TABLE, page.strip().lower())
+
+    def render(self, page: str) -> str:
+        row = self.get(page)
+        return wikicode_to_html(row["content"]) if row else ""
+
+    def pages(self) -> list[str]:
+        return sorted(r["page"] for r in self.tables.rows(self.TABLE))
+
+    def history(self, page: str) -> list[dict]:
+        key = page.strip().lower()
+        return sorted((r for r in self.tables.rows(self.TABLE_BKP)
+                       if r.get("page", "").strip().lower() == key),
+                      key=lambda r: r.get("date", 0))
+
+
+class BlogBoard:
+    """Dated entries, newest first (BlogBoard semantics)."""
+
+    TABLE = "blog"
+
+    def __init__(self, tables: Tables):
+        self.tables = tables
+
+    def add(self, subject: str, content: str, author: str = "anonymous",
+            wikicode: bool = True) -> str:
+        return self.tables.insert(self.TABLE, {
+            "subject": subject, "content": content, "author": author,
+            "date": time.time(), "wikicode": bool(wikicode), "comments": []})
+
+    def entries(self, n: int = 20) -> list[dict]:
+        rows = sorted(self.tables.rows(self.TABLE),
+                      key=lambda r: -r.get("date", 0))
+        return rows[:n]
+
+    def get(self, pk: str) -> dict | None:
+        return self.tables.get(self.TABLE, pk)
+
+    def render(self, pk: str) -> str:
+        row = self.get(pk)
+        if row is None:
+            return ""
+        if row.get("wikicode"):
+            return wikicode_to_html(row["content"])
+        return html.escape(row["content"]).replace("\n", "<br/>")
+
+    def comment(self, pk: str, author: str, content: str) -> bool:
+        row = self.get(pk)
+        if row is None:
+            return False
+        row.setdefault("comments", []).append(
+            {"author": author, "content": content, "date": time.time()})
+        return self.tables.update(self.TABLE, pk, row)
+
+    def delete(self, pk: str) -> bool:
+        return self.tables.delete(self.TABLE, pk)
+
+
+class MessageBoard:
+    """Peer-to-peer messages (MessageBoard semantics; the wire delivery is
+    the yacy/message RPC — this is the mailbox)."""
+
+    TABLE = "messages"
+
+    def __init__(self, tables: Tables):
+        self.tables = tables
+
+    def send(self, to: str, from_: str, subject: str, content: str) -> str:
+        return self.tables.insert(self.TABLE, {
+            "to": to, "from": from_, "subject": subject, "content": content,
+            "date": time.time(), "read": False})
+
+    def inbox(self, user: str, unread_only: bool = False) -> list[dict]:
+        rows = [r for r in self.tables.rows(self.TABLE) if r.get("to") == user
+                and (not unread_only or not r.get("read"))]
+        return sorted(rows, key=lambda r: -r.get("date", 0))
+
+    def mark_read(self, pk: str) -> bool:
+        row = self.tables.get(self.TABLE, pk)
+        if row is None:
+            return False
+        row["read"] = True
+        return self.tables.update(self.TABLE, pk, row)
+
+    def delete(self, pk: str) -> bool:
+        return self.tables.delete(self.TABLE, pk)

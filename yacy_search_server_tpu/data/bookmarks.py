@@ -1,0 +1,62 @@
+"""Bookmarks — tagged, dated, public/private URL records.
+
+Capability equivalent of the reference's bookmark database (reference:
+source/net/yacy/data/BookmarksDB.java — bookmark records keyed by URL
+hash with tag sets, public flag and date folders, plus tag and date
+indexes; the ymark successor keeps the same shape). Tag queries drive
+the bookmark UI and the ContentControl filter source (data/contentcontrol).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.hashes import url2hash
+from .tables import Tables
+
+
+class BookmarksDB:
+    TABLE = "bookmarks"
+
+    def __init__(self, tables: Tables):
+        self.tables = tables
+
+    def add(self, url: str, title: str = "", description: str = "",
+            tags: list[str] | None = None, public: bool = False,
+            owner: str = "admin") -> str:
+        pk = url2hash(url).decode("ascii", "replace")
+        self.tables.insert(self.TABLE, {
+            "url": url, "title": title or url, "description": description,
+            "tags": sorted({t.strip().lower() for t in (tags or [])
+                            if t.strip()}),
+            "public": bool(public), "owner": owner, "date": time.time()},
+            pk=pk)
+        return pk
+
+    def get(self, url_or_pk: str) -> dict | None:
+        row = self.tables.get(self.TABLE, url_or_pk)
+        if row is None and "://" in url_or_pk:
+            row = self.tables.get(
+                self.TABLE, url2hash(url_or_pk).decode("ascii", "replace"))
+        return row
+
+    def remove(self, url_or_pk: str) -> bool:
+        row = self.get(url_or_pk)
+        return bool(row) and self.tables.delete(self.TABLE, row["_pk"])
+
+    def all(self, public_only: bool = False) -> list[dict]:
+        rows = self.tables.rows(self.TABLE)
+        if public_only:
+            rows = [r for r in rows if r.get("public")]
+        return sorted(rows, key=lambda r: -r.get("date", 0))
+
+    def by_tag(self, tag: str, public_only: bool = False) -> list[dict]:
+        t = tag.strip().lower()
+        return [r for r in self.all(public_only) if t in r.get("tags", [])]
+
+    def tags(self) -> list[tuple[str, int]]:
+        counts: dict[str, int] = {}
+        for r in self.tables.rows(self.TABLE):
+            for t in r.get("tags", []):
+                counts[t] = counts.get(t, 0) + 1
+        return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))

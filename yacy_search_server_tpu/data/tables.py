@@ -1,0 +1,147 @@
+"""Tables — generic named row tables, the application data substrate.
+
+Capability equivalent of the reference's Tables machinery (reference:
+source/net/yacy/kelondro/blob/Tables.java — named tables of string-keyed
+rows over BEncodedHeap files, used by the API-call recorder, bookmarks
+and every other small application store; BEncodedHeap.java row codec).
+Here each table is an append-only JSONL journal compacted at load: the
+row dict IS the record, `_pk` is the primary key, and updates/deletes are
+journal entries that later lines supersede — the same LSM-lite shape as
+the RWI runs, sized for thousands of rows, not millions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class Tables:
+    """Named tables of dict rows with stable string pks."""
+
+    def __init__(self, data_dir: str | None = None):
+        self.data_dir = data_dir
+        self._tables: dict[str, dict[str, dict]] = {}
+        self._seq: dict[str, int] = {}
+        self._lock = threading.RLock()
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            for fn in os.listdir(data_dir):
+                if fn.endswith(".jsonl"):
+                    self._load(fn[:-6])
+
+    # -- io -------------------------------------------------------------------
+
+    def _path(self, table: str) -> str | None:
+        if not self.data_dir:
+            return None
+        return os.path.join(self.data_dir, table + ".jsonl")
+
+    def _load(self, table: str) -> None:
+        path = self._path(table)
+        rows: dict[str, dict] = {}
+        seq = 0
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        d = json.loads(line)
+                    except ValueError:
+                        continue
+                    pk = d.get("_pk")
+                    if not pk:
+                        continue
+                    if d.get("_del"):
+                        rows.pop(pk, None)
+                    else:
+                        rows[pk] = d
+                    if pk.isdigit():
+                        seq = max(seq, int(pk) + 1)
+        except OSError:
+            return
+        self._tables[table] = rows
+        self._seq[table] = seq
+        self._compact(table)
+
+    def _compact(self, table: str) -> None:
+        path = self._path(table)
+        if not path:
+            return
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for row in self._tables.get(table, {}).values():
+                    f.write(json.dumps(row) + "\n")
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _append(self, table: str, row: dict) -> None:
+        path = self._path(table)
+        if not path:
+            return
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError:
+            pass
+
+    # -- CRUD -----------------------------------------------------------------
+
+    def insert(self, table: str, row: dict, pk: str | None = None) -> str:
+        with self._lock:
+            t = self._tables.setdefault(table, {})
+            if pk is None:
+                pk = str(self._seq.get(table, 0))
+                self._seq[table] = int(pk) + 1
+            stored = {**row, "_pk": pk}
+            t[pk] = stored
+            self._append(table, stored)
+            return pk
+
+    def update(self, table: str, pk: str, row: dict) -> bool:
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None or pk not in t:
+                return False
+            stored = {**t[pk], **row, "_pk": pk}
+            t[pk] = stored
+            self._append(table, stored)
+            return True
+
+    def get(self, table: str, pk: str) -> dict | None:
+        with self._lock:
+            row = self._tables.get(table, {}).get(pk)
+            return dict(row) if row else None
+
+    def delete(self, table: str, pk: str) -> bool:
+        with self._lock:
+            t = self._tables.get(table)
+            if t is None or t.pop(pk, None) is None:
+                return False
+            self._append(table, {"_pk": pk, "_del": 1})
+            return True
+
+    def rows(self, table: str) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._tables.get(table, {}).values()]
+
+    def select(self, table: str, **match) -> list[dict]:
+        """Rows whose columns equal every given value."""
+        with self._lock:
+            return [dict(r) for r in self._tables.get(table, {}).values()
+                    if all(r.get(k) == v for k, v in match.items())]
+
+    def size(self, table: str) -> int:
+        with self._lock:
+            return len(self._tables.get(table, {}))
+
+    def tables(self) -> list[str]:
+        with self._lock:
+            return sorted(self._tables)
+
+    def clear(self, table: str) -> None:
+        with self._lock:
+            self._tables[table] = {}
+            self._compact(table)

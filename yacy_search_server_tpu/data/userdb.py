@@ -1,0 +1,92 @@
+"""User database — accounts with hashed credentials and right flags.
+
+Capability equivalent of the reference's user administration (reference:
+source/net/yacy/data/UserDB.java — user entries with MD5(user:pw)
+credential hashes and per-right flags consumed by the servlet security
+layer; http/YaCyLegacyCredential.java hash form). The admin account
+itself lives in config (adminAccountBase64MD5) exactly like the
+reference; this DB is for additional named users.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+from .tables import Tables
+
+# right flags (UserDB.AccessRight subset)
+RIGHT_ADMIN = "admin"
+RIGHT_DOWNLOAD = "download"
+RIGHT_UPLOAD = "upload"
+RIGHT_PROXY = "proxy"
+RIGHT_BLOG = "blog"
+RIGHT_WIKI = "wiki"
+RIGHT_BOOKMARK = "bookmark"
+ALL_RIGHTS = (RIGHT_ADMIN, RIGHT_DOWNLOAD, RIGHT_UPLOAD, RIGHT_PROXY,
+              RIGHT_BLOG, RIGHT_WIKI, RIGHT_BOOKMARK)
+
+
+def credential_hash(user: str, password: str) -> str:
+    """MD5(user:pw) hex — the reference's legacy credential form
+    (YaCyLegacyCredential)."""
+    return hashlib.md5(f"{user}:{password}".encode("utf-8")).hexdigest()  # nosec
+
+
+class UserDB:
+    TABLE = "users"
+
+    def __init__(self, tables: Tables):
+        self.tables = tables
+
+    def create(self, user: str, password: str,
+               rights: list[str] | None = None) -> bool:
+        if not user or self.tables.get(self.TABLE, user) is not None:
+            return False
+        self.tables.insert(self.TABLE, {
+            "name": user, "credential": credential_hash(user, password),
+            "rights": [r for r in (rights or []) if r in ALL_RIGHTS],
+            "created": time.time(), "last_access": 0.0}, pk=user)
+        return True
+
+    def authenticate(self, user: str, password: str) -> bool:
+        row = self.tables.get(self.TABLE, user)
+        if row is None or row["credential"] != credential_hash(user, password):
+            return False
+        row["last_access"] = time.time()
+        self.tables.update(self.TABLE, user, row)
+        return True
+
+    def has_right(self, user: str, right: str) -> bool:
+        row = self.tables.get(self.TABLE, user)
+        return bool(row) and (right in row.get("rights", [])
+                              or RIGHT_ADMIN in row.get("rights", []))
+
+    def grant(self, user: str, right: str) -> bool:
+        row = self.tables.get(self.TABLE, user)
+        if row is None or right not in ALL_RIGHTS:
+            return False
+        if right not in row["rights"]:
+            row["rights"].append(right)
+        return self.tables.update(self.TABLE, user, row)
+
+    def revoke(self, user: str, right: str) -> bool:
+        row = self.tables.get(self.TABLE, user)
+        if row is None or right not in row.get("rights", []):
+            return False
+        row["rights"].remove(right)
+        return self.tables.update(self.TABLE, user, row)
+
+    def set_password(self, user: str, password: str) -> bool:
+        row = self.tables.get(self.TABLE, user)
+        if row is None:
+            return False
+        row["credential"] = credential_hash(user, password)
+        return self.tables.update(self.TABLE, user, row)
+
+    def delete(self, user: str) -> bool:
+        return self.tables.delete(self.TABLE, user)
+
+    def users(self) -> list[dict]:
+        return sorted(self.tables.rows(self.TABLE),
+                      key=lambda r: r.get("name", ""))

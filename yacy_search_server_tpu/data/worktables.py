@@ -1,0 +1,110 @@
+"""WorkTables — recorded API calls with repeat schedules.
+
+Capability equivalent of the reference's action recorder + scheduler
+(reference: source/net/yacy/data/WorkTables.java:66-232 — every admin
+action is written into the `api` table with its servlet path, comment and
+optional repeat schedule; the scheduler busy thread re-executes due rows
+via a self-HTTP call, Switchboard.java:1131-1151 schedulerJob). Replaying
+through the HTTP surface (not an internal function call) is load-bearing:
+the recorded URL IS the action, surviving restarts and code changes.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .tables import Tables
+
+TABLE_API = "api"
+
+# schedule units in seconds (WorkTables scheme: minutes/hours/days)
+_UNITS = {"minutes": 60, "hours": 3600, "days": 86400}
+
+
+class WorkTables:
+    def __init__(self, tables: Tables):
+        self.tables = tables
+
+    # -- recording ------------------------------------------------------------
+
+    def record_api_call(self, path: str, servlet_name: str, comment: str,
+                        repeat_count: int = 0,
+                        repeat_unit: str = "days") -> str:
+        """Record one executed admin action; `path` is the full local URL
+        path incl. query (the replayable action).
+
+        Re-recording the same URL UPDATES the existing row (bumping its
+        exec bookkeeping) instead of inserting — scheduled replays re-enter
+        the recording servlet, and must not grow the table (the reference
+        dedups recorded actions by URL the same way)."""
+        now = time.time()
+        existing = self.tables.select(TABLE_API, url=path)
+        if existing:
+            row = existing[0]
+            row["date_last_exec"] = now
+            row["exec_count"] = int(row.get("exec_count", 0)) + 1
+            if repeat_count:        # replay URLs carry no schedule params;
+                row["repeat_count"] = int(repeat_count)   # keep the stored one
+                row["repeat_unit"] = (repeat_unit if repeat_unit in _UNITS
+                                      else "days")
+            row["date_next_exec"] = self._next_exec(row)
+            self.tables.update(TABLE_API, row["_pk"], row)
+            return row["_pk"]
+        row = {
+            "url": path, "type": servlet_name, "comment": comment,
+            "date_recording": now, "date_last_exec": now,
+            "exec_count": 1,
+            "repeat_count": int(repeat_count),
+            "repeat_unit": repeat_unit if repeat_unit in _UNITS else "days",
+        }
+        row["date_next_exec"] = self._next_exec(row)
+        return self.tables.insert(TABLE_API, row)
+
+    def set_schedule(self, pk: str, repeat_count: int,
+                     repeat_unit: str = "days") -> bool:
+        row = self.tables.get(TABLE_API, pk)
+        if row is None:
+            return False
+        row["repeat_count"] = int(repeat_count)
+        row["repeat_unit"] = repeat_unit if repeat_unit in _UNITS else "days"
+        row["date_next_exec"] = self._next_exec(row)
+        return self.tables.update(TABLE_API, pk, row)
+
+    @staticmethod
+    def _next_exec(row: dict) -> float:
+        n = int(row.get("repeat_count", 0))
+        if n <= 0:
+            return 0.0
+        unit_s = _UNITS.get(row.get("repeat_unit", "days"), 86400)
+        return float(row.get("date_last_exec", time.time())) + n * unit_s
+
+    # -- scheduler ------------------------------------------------------------
+
+    def due_rows(self, now: float | None = None) -> list[dict]:
+        now = time.time() if now is None else now
+        return [r for r in self.tables.rows(TABLE_API)
+                if r.get("date_next_exec", 0) and r["date_next_exec"] <= now]
+
+    def scheduler_job(self, execute, now: float | None = None) -> bool:
+        """Re-execute every due recorded call through `execute(path) ->
+        bool` (the self-HTTP GET); update bookkeeping. Returns True if
+        anything ran (BusyThread contract)."""
+        ran = False
+        now = time.time() if now is None else now
+        for row in self.due_rows(now):
+            ok = False
+            try:
+                ok = bool(execute(row["url"]))
+            except Exception:
+                ok = False
+            row["date_last_exec"] = now
+            row["exec_count"] = int(row.get("exec_count", 0)) + 1
+            row["last_exec_ok"] = ok
+            row["date_next_exec"] = self._next_exec(row)
+            self.tables.update(TABLE_API, row["_pk"], row)
+            ran = True
+        return ran
+
+    def calls(self) -> list[dict]:
+        return sorted(self.tables.rows(TABLE_API),
+                      key=lambda r: -r.get("date_recording", 0))

@@ -1,0 +1,48 @@
+"""Config/data migrations across versions.
+
+Capability equivalent of the reference's migration module (reference:
+source/net/yacy/migration.java — version-gated config rewrites run once
+at startup, yacy.java:285). Steps are (from_version, fn) pairs applied in
+order when the stored config version is older; the stored version is then
+bumped to the current release.
+"""
+
+from __future__ import annotations
+
+
+def _v(version: str) -> tuple[int, ...]:
+    try:
+        return tuple(int(p) for p in version.split("."))
+    except ValueError:
+        return (0,)
+
+
+def _m_0_1_0(config) -> None:
+    """0.1.0: heuristics default off; scheduler enabled."""
+    if not config.get("heuristic.site"):
+        config.set("heuristic.site", "false")
+
+
+def _m_0_2_0(config) -> None:
+    """0.2.0: network unit selection key introduced."""
+    if not config.get("network.unit.definition"):
+        config.set("network.unit.definition", "freeworld")
+
+
+MIGRATIONS: list[tuple[str, object]] = [
+    ("0.1.0", _m_0_1_0),
+    ("0.2.0", _m_0_2_0),
+]
+
+
+def migrate(config, current_version: str) -> int:
+    """Apply every step newer than the stored version; returns steps run."""
+    stored = config.get("version", "0.0.0")
+    ran = 0
+    for step_version, fn in MIGRATIONS:
+        if _v(stored) < _v(step_version) <= _v(current_version):
+            fn(config)
+            ran += 1
+    if stored != current_version:
+        config.set("version", current_version)
+    return ran

@@ -68,6 +68,7 @@ class NewsPool:
     def __init__(self, data_dir: str | None = None):
         self._incoming: dict[str, NewsRecord] = {}
         self._processed: set[str] = set()
+        self._processed_order: list[str] = []   # FIFO eviction order
         self._mine: dict[str, NewsRecord] = {}
         self._lock = threading.Lock()
         self._path = None
@@ -142,9 +143,7 @@ class NewsPool:
     def mark_processed(self, record_id: str) -> None:
         with self._lock:
             if self._incoming.pop(record_id, None) is not None:
-                self._processed.add(record_id)
-                while len(self._processed) > self.MAX_PROCESSED_IDS:
-                    self._processed.pop()
+                self._remember_processed_locked(record_id)
                 if self._path:
                     try:
                         with open(self._path, "a", encoding="utf-8") as f:
@@ -152,6 +151,16 @@ class NewsPool:
                                                 "id": record_id}) + "\n")
                     except OSError:
                         pass
+
+    def _remember_processed_locked(self, record_id: str) -> None:
+        if record_id in self._processed:
+            return
+        self._processed.add(record_id)
+        self._processed_order.append(record_id)
+        # FIFO eviction: forget the OLDEST ids, never the one just added —
+        # a still-circulating record must stay deduplicated until its TTL
+        while len(self._processed_order) > self.MAX_PROCESSED_IDS:
+            self._processed.discard(self._processed_order.pop(0))
 
     def size(self) -> tuple[int, int, int]:
         with self._lock:
@@ -186,7 +195,7 @@ class NewsPool:
                         continue
                     if d.get("k") == "proc":
                         rid = d.get("id", "")
-                        self._processed.add(rid)
+                        self._remember_processed_locked(rid)
                         self._incoming.pop(rid, None)
                         continue
                     try:

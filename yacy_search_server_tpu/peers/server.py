@@ -200,7 +200,11 @@ class PeerServer:
 
     def do_urls(self, payload: dict) -> dict:
         """Publish crawl work from the GLOBAL stack to a pulling peer
-        (htroot/yacy/urls.java)."""
+        (htroot/yacy/urls.java). Only nodes that opted into remote-crawl
+        delegation hand out work — otherwise any peer could drain the
+        GLOBAL stack of a node that never consented."""
+        if not self.accept_remote_crawl:
+            return {"requests": []}
         from ..crawler.frontier import StackType
         count = min(int(payload.get("count", 10)), 100)
         out = []

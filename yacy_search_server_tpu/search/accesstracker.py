@@ -44,7 +44,8 @@ class AccessTracker:
         self.dump_path = dump_path
         self._finished: deque[QueryLogEntry] = deque(maxlen=MAX_FINISHED)
         self._undumped: list[str] = []
-        self._host_access: dict[str, list[float]] = {}
+        self._host_access: dict[str, deque[float]] = {}
+        self._access_calls = 0
         self._lock = threading.Lock()
         if dump_path:
             os.makedirs(os.path.dirname(dump_path), exist_ok=True)
@@ -87,14 +88,30 @@ class AccessTracker:
         window (callers throttle above a threshold)."""
         now = time.time()
         with self._lock:
-            times = self._host_access.setdefault(client_host, [])
+            times = self._host_access.setdefault(client_host, deque())
             times.append(now)
             cutoff = now - window_s
             while times and times[0] < cutoff:
-                times.pop(0)
+                times.popleft()
+            # bound the dict itself: one-off client IPs must not accumulate
+            # keys forever on a public node
+            self._access_calls += 1
+            if self._access_calls % 256 == 0:
+                self._prune_hosts_locked(cutoff)
             return len(times)
 
-    def access_hosts(self) -> list[tuple[str, int]]:
+    def _prune_hosts_locked(self, cutoff: float) -> None:
+        dead = []
+        for host, times in self._host_access.items():
+            while times and times[0] < cutoff:
+                times.popleft()
+            if not times:
+                dead.append(host)
+        for host in dead:
+            del self._host_access[host]
+
+    def access_hosts(self, window_s: float = 600.0) -> list[tuple[str, int]]:
         with self._lock:
+            self._prune_hosts_locked(time.time() - window_s)
             return sorted(((h, len(t)) for h, t in self._host_access.items()),
                           key=lambda x: -x[1])

@@ -92,6 +92,20 @@ class YaCyHttpServer:
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="httpd", daemon=True)
         self._thread.start()
+        # recorded-API replay goes through our own HTTP surface (the
+        # reference's WorkTables.execAPICall self-call), so the recorded
+        # URL stays the replayable action across restarts
+        if getattr(self.sb, "api_executor", None) is None:
+            def _exec(path: str) -> bool:
+                import urllib.request
+                url = self.base_url + (path if path.startswith("/")
+                                       else "/" + path)
+                try:
+                    with urllib.request.urlopen(url, timeout=60) as r:
+                        return r.status == 200
+                except Exception:
+                    return False
+            self.sb.api_executor = _exec
         return self
 
     def close(self) -> None:

@@ -33,6 +33,21 @@ def respond_crawler(header: dict, post: ServerObjects, sb) -> ServerObjects:
             prop.put("started", 1)
             prop.put("handle", profile.handle)
             prop.put("info", "")
+            # record the action for replay/scheduling (WorkTables parity:
+            # every admin action lands in the api table)
+            from urllib.parse import quote
+            replay = (f"/Crawler_p.html?crawlingstart=1&crawlingURL="
+                      f"{quote(url)}&crawlingDepth={depth}")
+            # the replay URL must carry the full crawl spec, or scheduled
+            # re-crawls would run unfiltered
+            if kwargs.get("mustmatch"):
+                replay += f"&mustmatch={quote(kwargs['mustmatch'])}"
+            if kwargs.get("mustnotmatch"):
+                replay += f"&mustnotmatch={quote(kwargs['mustnotmatch'])}"
+            sb.work_tables.record_api_call(
+                replay, "Crawler_p", f"crawl start for {url}",
+                repeat_count=post.get_int("repeat_count", 0),
+                repeat_unit=post.get("repeat_unit", "days"))
         except ValueError as e:
             prop.put("started", 0)
             prop.put("info", escape_json(str(e)))
@@ -49,6 +64,22 @@ def respond_crawler(header: dict, post: ServerObjects, sb) -> ServerObjects:
         prop.put(pre + "eol", 1 if i < len(profiles) - 1 else 0)
     from ...crawler.frontier import StackType
     prop.put("localCrawlSize", sb.noticed.size(StackType.LOCAL))
+    return prop
+
+
+@servlet("Steering_p")
+def respond_steering(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Shutdown/restart control (reference: htroot/Steering.java; the
+    -shutdown CLI verb POSTs here, yacy.java:503-509)."""
+    prop = ServerObjects()
+    if post.get("shutdown"):
+        # delay so this response can leave the socket first
+        import threading
+        threading.Timer(0.5, sb.shutdown_event.set).start()
+        prop.put("info", "shutdown in 0.5s")
+    else:
+        prop.put("info", "")
+    prop.put("uptime_s", int(__import__("time").time() - sb.started))
     return prop
 
 

@@ -1,0 +1,189 @@
+"""Board + data servlets: wiki, blog, messages, bookmarks, user admin,
+table CRUD API, recorded-API table.
+
+Capability equivalents of the reference's community/data servlets
+(reference: htroot/Wiki.java, Blog.java, Messages_p.java,
+Bookmarks.java, ConfigAccounts_p.java, htroot/api/table_p.java,
+Table_API_p.java). JSON-shaped property maps; admin-only where the
+reference gates (_p suffix)."""
+
+from __future__ import annotations
+
+import json
+
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+
+@servlet("Wiki")
+def respond_wiki(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    page = post.get("page", "start")
+    if post.get("content"):
+        sb.wiki.put(page, post.get("content"),
+                    author=post.get("author", "anonymous"))
+    row = sb.wiki.get(page)
+    prop.put("page", escape_json(page))
+    prop.put("content", escape_json(row["content"] if row else ""))
+    prop.put("html", escape_json(sb.wiki.render(page)))
+    prop.put("author", escape_json(row["author"] if row else ""))
+    prop.put("pages", escape_json(",".join(sb.wiki.pages())))
+    prop.put("versions", len(sb.wiki.history(page)))
+    return prop
+
+
+@servlet("Blog")
+def respond_blog(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    if post.get("subject") and post.get("content"):
+        sb.blog.add(post.get("subject"), post.get("content"),
+                    author=post.get("author", "anonymous"))
+    entries = sb.blog.entries(post.get_int("count", 20))
+    prop.put("entries", len(entries))
+    for i, e in enumerate(entries):
+        prop.put(f"entries_{i}_pk", e["_pk"])
+        prop.put(f"entries_{i}_subject", escape_json(e.get("subject", "")))
+        prop.put(f"entries_{i}_author", escape_json(e.get("author", "")))
+        prop.put(f"entries_{i}_date", int(e.get("date", 0)))
+        prop.put(f"entries_{i}_html", escape_json(sb.blog.render(e["_pk"])))
+        prop.put(f"entries_{i}_comments", len(e.get("comments", [])))
+    return prop
+
+
+@servlet("Messages_p")
+def respond_messages(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    action = post.get("action", "list")
+    user = post.get("user", "admin")
+    if action == "send" and post.get("to"):
+        sb.messages.send(post.get("to"), user, post.get("subject", ""),
+                         post.get("content", ""))
+    elif action == "read" and post.get("pk"):
+        sb.messages.mark_read(post.get("pk"))
+    elif action == "delete" and post.get("pk"):
+        sb.messages.delete(post.get("pk"))
+    inbox = sb.messages.inbox(user)
+    prop.put("messages", len(inbox))
+    for i, m in enumerate(inbox):
+        prop.put(f"messages_{i}_pk", m["_pk"])
+        prop.put(f"messages_{i}_from", escape_json(m.get("from", "")))
+        prop.put(f"messages_{i}_subject", escape_json(m.get("subject", "")))
+        prop.put(f"messages_{i}_read", 1 if m.get("read") else 0)
+        prop.put(f"messages_{i}_date", int(m.get("date", 0)))
+    return prop
+
+
+@servlet("Bookmarks")
+def respond_bookmarks(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    if post.get("add"):
+        sb.bookmarks.add(
+            post.get("add"), title=post.get("title", ""),
+            description=post.get("description", ""),
+            tags=post.get("tags", "").split(","),
+            public=post.get("public", "") in ("1", "true", "on"))
+    if post.get("delete"):
+        sb.bookmarks.remove(post.get("delete"))
+    tag = post.get("tag", "")
+    rows = sb.bookmarks.by_tag(tag) if tag else sb.bookmarks.all()
+    prop.put("bookmarks", len(rows))
+    for i, b in enumerate(rows):
+        prop.put(f"bookmarks_{i}_url", escape_json(b.get("url", "")))
+        prop.put(f"bookmarks_{i}_title", escape_json(b.get("title", "")))
+        prop.put(f"bookmarks_{i}_tags", escape_json(",".join(b.get("tags", []))))
+        prop.put(f"bookmarks_{i}_public", 1 if b.get("public") else 0)
+    prop.put("tags", escape_json(",".join(t for t, _ in sb.bookmarks.tags())))
+    return prop
+
+
+@servlet("ConfigAccounts_p")
+def respond_accounts(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    action = post.get("action", "list")
+    user = post.get("user", "")
+    if action == "create" and user:
+        ok = sb.userdb.create(user, post.get("password", ""),
+                              rights=post.get("rights", "").split(","))
+        prop.put("created", 1 if ok else 0)
+    elif action == "delete" and user:
+        prop.put("deleted", 1 if sb.userdb.delete(user) else 0)
+    elif action == "grant" and user:
+        sb.userdb.grant(user, post.get("right", ""))
+    elif action == "revoke" and user:
+        sb.userdb.revoke(user, post.get("right", ""))
+    users = sb.userdb.users()
+    prop.put("users", len(users))
+    for i, u in enumerate(users):
+        prop.put(f"users_{i}_name", escape_json(u.get("name", "")))
+        prop.put(f"users_{i}_rights", escape_json(",".join(u.get("rights", []))))
+    return prop
+
+
+@servlet("table_p")
+def respond_table(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Generic table CRUD API (reference: htroot/api/table_p.java)."""
+    prop = ServerObjects()
+    table = post.get("table", "")
+    action = post.get("action", "list")
+    if not table:
+        prop.put("tables", escape_json(",".join(sb.tables.tables())))
+        return prop
+    if action == "insert":
+        try:
+            row = json.loads(post.get("row", "{}"))
+        except ValueError:
+            row = {}
+        prop.put("pk", sb.tables.insert(table, row))
+    elif action == "update" and post.get("pk"):
+        try:
+            row = json.loads(post.get("row", "{}"))
+        except ValueError:
+            row = {}
+        prop.put("updated", 1 if sb.tables.update(table, post.get("pk"), row)
+                 else 0)
+    elif action == "delete" and post.get("pk"):
+        prop.put("deleted", 1 if sb.tables.delete(table, post.get("pk"))
+                 else 0)
+    rows = sb.tables.rows(table)
+    prop.put("table", escape_json(table))
+    prop.put("count", len(rows))
+    for i, r in enumerate(rows[: post.get_int("maxrows", 100)]):
+        prop.put(f"rows_{i}_pk", escape_json(str(r.get("_pk", ""))))
+        prop.put(f"rows_{i}_row", escape_json(json.dumps(r)))
+    return prop
+
+
+@servlet("Table_API_p")
+def respond_api_table(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Recorded API calls + schedule editing (reference:
+    htroot/Table_API_p.java over the WorkTables api table)."""
+    prop = ServerObjects()
+    if post.get("schedule_pk"):
+        sb.work_tables.set_schedule(
+            post.get("schedule_pk"), post.get_int("repeat_count", 0),
+            post.get("repeat_unit", "days"))
+    calls = sb.work_tables.calls()
+    prop.put("calls", len(calls))
+    for i, c in enumerate(calls[: post.get_int("maxrows", 100)]):
+        prop.put(f"calls_{i}_pk", c["_pk"])
+        prop.put(f"calls_{i}_url", escape_json(c.get("url", "")))
+        prop.put(f"calls_{i}_type", escape_json(c.get("type", "")))
+        prop.put(f"calls_{i}_comment", escape_json(c.get("comment", "")))
+        prop.put(f"calls_{i}_exec_count", c.get("exec_count", 0))
+        prop.put(f"calls_{i}_repeat_count", c.get("repeat_count", 0))
+        prop.put(f"calls_{i}_repeat_unit", escape_json(c.get("repeat_unit", "")))
+    return prop
+
+
+@servlet("AccessTracker_p")
+def respond_accesstracker(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Query log view (reference: htroot/AccessTracker_p.java)."""
+    prop = ServerObjects()
+    latest = sb.access_tracker.latest(post.get_int("count", 50))
+    prop.put("queries", len(latest))
+    for i, e in enumerate(latest):
+        prop.put(f"queries_{i}_query", escape_json(e.query))
+        prop.put(f"queries_{i}_time", int(e.timestamp))
+        prop.put(f"queries_{i}_results", e.result_count)
+        prop.put(f"queries_{i}_ms", round(e.time_ms, 1))
+    return prop

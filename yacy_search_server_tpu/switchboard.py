@@ -93,11 +93,32 @@ class Switchboard:
         self.access_tracker = AccessTracker(
             os.path.join(data_dir, "LOG", "queries.log") if data_dir else None)
         self._heuristic_fired: dict[str, float] = {}
+        # application data substrate: generic tables + the stores above them
+        # (reference: sb.tables / WorkTables / boards / BookmarksDB / UserDB)
+        from .data.boards import BlogBoard, MessageBoard, WikiBoard
+        from .data.bookmarks import BookmarksDB
+        from .data.tables import Tables
+        from .data.userdb import UserDB
+        from .data.worktables import WorkTables
+        self.tables = Tables(sub("TABLES"))
+        self.work_tables = WorkTables(self.tables)
+        self.wiki = WikiBoard(self.tables)
+        self.blog = BlogBoard(self.tables)
+        self.messages = MessageBoard(self.tables)
+        self.bookmarks = BookmarksDB(self.tables)
+        self.userdb = UserDB(self.tables)
+        # self-HTTP executor for the scheduler; the HTTP server sets this
+        # when it binds (the reference re-executes recorded API calls
+        # through its own HTTP port, WorkTables.execAPICall)
+        self.api_executor = None
         self.threads = ThreadRegistry()
 
         self.indexed_count = 0
         self.started = time.time()
         self._closed = False
+        # set by signal handlers or the Steering servlet; the launcher's
+        # waitForShutdown blocks on it (yacy.java:393)
+        self.shutdown_event = threading.Event()
 
         # the 4-stage pipeline; stage 4 single-worker = serialized IO
         self._store_proc = WorkflowProcessor(
@@ -327,6 +348,16 @@ class Switchboard:
         self.threads.deploy(BusyThread(
             "70_surrogates", self.surrogate_process_job,
             idle_sleep_s=10.0, busy_sleep_s=0.1))
+        self.threads.deploy(BusyThread(
+            "20_scheduler", self.scheduler_job,
+            idle_sleep_s=60.0, busy_sleep_s=10.0))
+
+    def scheduler_job(self) -> bool:
+        """Re-execute due recorded API calls via self-HTTP
+        (Switchboard.schedulerJob, Switchboard.java:1131-1151)."""
+        if self.api_executor is None:
+            return False
+        return self.work_tables.scheduler_job(self.api_executor)
 
     def _cleanup_job(self) -> bool:
         self.search_cache.cleanup_locked()
