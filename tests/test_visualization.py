@@ -1,0 +1,172 @@
+"""M14 — raster/PNG, graph servlets, bayes, vocabularies, content control."""
+
+import struct
+import urllib.request
+import zlib
+
+import pytest
+
+from yacy_search_server_tpu.data.contentcontrol import ContentControl
+from yacy_search_server_tpu.document.vocabulary import (TripleStore,
+                                                        Vocabulary,
+                                                        VocabularyLibrary)
+from yacy_search_server_tpu.utils.bayes import BayesClassifier
+from yacy_search_server_tpu.visualization.raster import RasterPlotter
+
+
+def _decode_png(data: bytes):
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    w, h = struct.unpack(">II", data[16:24])
+    # IDAT payload decompresses to h*(1+w*3) filter-0 scanlines
+    idat = b""
+    off = 8
+    while off < len(data):
+        ln, tag = struct.unpack(">I4s", data[off:off + 8])
+        if tag == b"IDAT":
+            idat += data[off + 8:off + 8 + ln]
+        off += 12 + ln
+    raw = zlib.decompress(idat)
+    assert len(raw) == h * (1 + w * 3)
+    return w, h, raw
+
+
+def test_raster_primitives_and_png():
+    img = RasterPlotter(64, 48, background=(0, 0, 0))
+    img.dot(10, 10, (255, 0, 0), radius=3)
+    img.line(0, 0, 63, 47, (0, 255, 0))
+    img.circle(32, 24, 10, (0, 0, 255))
+    img.rect(2, 2, 20, 12, (255, 255, 0))
+    img.text(4, 30, "YACY 42", (255, 255, 255))
+    assert tuple(img.pix[10, 10]) == (255, 0, 0)
+    assert tuple(img.pix[0, 0]) == (0, 255, 0)
+    w, h, raw = _decode_png(img.png_bytes())
+    assert (w, h) == (64, 48)
+    # first scanline: filter byte then pixel 0 = green
+    assert raw[0] == 0 and raw[1:4] == bytes((0, 255, 0))
+
+
+def test_bayes_classifier():
+    c = BayesClassifier()
+    for t in ("jax tpu kernels compile mesh sharding",
+              "pallas kernels tile mxu matmul jax",
+              "device mesh collective sharding"):
+        c.learn("tech", t)
+    for t in ("pasta tomato basil olive oil recipe",
+              "bake oven flour sugar recipe dessert",
+              "grill salt pepper steak dinner"):
+        c.learn("cooking", t)
+    assert c.classify("tpu mesh kernels") == "tech"
+    assert c.classify("tomato basil dinner recipe") == "cooking"
+    assert set(c.scores("anything")) == {"tech", "cooking"}
+    # an unsure margin yields None
+    assert c.classify("zzz qqq", min_margin=1000.0) is None
+
+
+def test_vocabulary_and_triplestore(tmp_path):
+    lib = VocabularyLibrary(str(tmp_path / "DICT"))
+    v = Vocabulary("animals")
+    v.put("bird", ["sparrow", "eagle"])
+    v.put("fish", ["salmon"])
+    lib.put(v)
+    tags = lib.tag_document("The eagle flew over the salmon river")
+    assert tags == {"animals": {"bird", "fish"}}
+    # persisted: a new library instance reloads it
+    lib2 = VocabularyLibrary(str(tmp_path / "DICT"))
+    assert lib2.names() == ["animals"]
+    assert lib2.tag_document("a sparrow") == {"animals": {"bird"}}
+
+    ts = TripleStore(str(tmp_path / "triples.jsonl"))
+    ts.add("doc:1", "dc:creator", "alice")
+    ts.add("doc:1", "dc:subject", "search")
+    ts.add("doc:2", "dc:creator", "alice")
+    assert len(ts.query(None, "dc:creator", "alice")) == 2
+    assert ts.query("doc:1", None, None)[0][0] == "doc:1"
+    ts2 = TripleStore(str(tmp_path / "triples.jsonl"))
+    assert len(ts2) == 3
+    assert ts2.remove("doc:1", None, None) == 2
+    assert len(ts2) == 1
+
+
+def test_vocabulary_autotagging_into_index(tmp_path):
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.switchboard import Switchboard
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    v = Vocabulary("topics")
+    v.put("searchtech", ["ranking", "postings"])
+    sb.vocabularies.put(v)
+    try:
+        docid = sb.index.store_document(Document(
+            url="http://voc.test/x.html", title="Ranking",
+            text="postings and ranking on device"))
+        m = sb.index.metadata.get(docid)
+        assert m.get("vocabulary_sxt") == "topics:searchtech"
+    finally:
+        sb.close()
+
+
+def test_content_control_filters_results(tmp_path):
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.switchboard import Switchboard
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        sb.index.store_document(Document(
+            url="http://good.test/a.html", title="good",
+            text="ccword page"))
+        sb.index.store_document(Document(
+            url="http://blocked.test/b.html", title="bad",
+            text="ccword page"))
+        sb.bookmarks.add("http://blocked.test/", tags=["contentcontrol"])
+        sb.content_control.enabled = True
+        assert sb.content_control.update_filter_job() is True
+        assert sb.content_control.excluded("http://blocked.test/b.html")
+        urls = {r.url for r in sb.search("ccword").results()}
+        assert urls == {"http://good.test/a.html"}
+    finally:
+        sb.close()
+
+
+@pytest.fixture(scope="module")
+def gfx_node(tmp_path_factory):
+    from yacy_search_server_tpu.peers.node import P2PNode
+    from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+    tmp = tmp_path_factory.mktemp("gfx")
+    net = LoopbackNetwork()
+    a = P2PNode("gfxa", net, data_dir=str(tmp / "a"))
+    b = P2PNode("gfxb", net, data_dir=str(tmp / "b"))
+    a.bootstrap([b.seed])
+    a.ping()
+    a.sb.web_structure.add_document("http://h1.test/", ["http://h2.test/x"])
+    a.serve_http()
+    yield a
+    b.close()
+    a.close()
+
+
+def test_graphics_servlets(gfx_node):
+    with urllib.request.urlopen(
+            gfx_node.http.base_url + "/NetworkPicture.png", timeout=10) as r:
+        assert r.headers["Content-Type"] == "image/png"
+        w, h, _ = _decode_png(r.read())
+        assert (w, h) == (480, 480)
+    with urllib.request.urlopen(
+            gfx_node.http.base_url + "/WebStructurePicture_p.png",
+            timeout=10) as r:
+        w, h, _ = _decode_png(r.read())
+        assert (w, h) == (640, 480)
+
+
+def test_vocabulary_servlet(gfx_node):
+    import json
+    from urllib.parse import quote
+    base = gfx_node.http.base_url
+    with urllib.request.urlopen(
+            base + "/Vocabulary_p.json?create=colors&terms=" +
+            quote("warm:red,orange;cold:blue"), timeout=10) as r:
+        out = json.loads(r.read())
+    assert out["vocabularies"] == "1"
+    with urllib.request.urlopen(
+            base + "/Vocabulary_p.json?test=" + quote("a red and blue flag"),
+            timeout=10) as r:
+        out = json.loads(r.read())
+    assert out["matches"] == "1"
+    assert set(out["matches_0_tags"].split(",")) == {"cold", "warm"}

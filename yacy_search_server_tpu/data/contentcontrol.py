@@ -1,0 +1,72 @@
+"""ContentControl — bookmark-driven URL filtering.
+
+Capability equivalent of the reference's content-control subsystem
+(reference: source/net/yacy/contentcontrol/ — ContentControlFilterUpdateThread
+compiles bookmarks carrying the control tag into an in-memory URL filter
+consulted by the search result drain; SMWListSyncThread pulls external
+lists into the same bookmark folder). Here the source is the local
+BookmarksDB: bookmarks tagged with the control tag become block entries,
+recompiled by a busy thread when the bookmark set changes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.hashes import safe_host
+
+DEFAULT_CONTROL_TAG = "contentcontrol"
+
+
+class ContentControl:
+    def __init__(self, bookmarks, control_tag: str = DEFAULT_CONTROL_TAG):
+        self.bookmarks = bookmarks
+        self.control_tag = control_tag
+        self.enabled = False
+        self._hosts: set[str] = set()
+        self._urls: set[str] = set()
+        self._stamp: int = -1
+        self._lock = threading.Lock()
+
+    def update_filter_job(self) -> bool:
+        """Recompile the filter when the bookmark set changed (the
+        reference's ContentControlFilterUpdateThread busy job)."""
+        rows = self.bookmarks.by_tag(self.control_tag)
+        stamp = hash(tuple(sorted(r.get("url", "") for r in rows)))
+        with self._lock:
+            if stamp == self._stamp:
+                return False
+            hosts: set[str] = set()
+            urls: set[str] = set()
+            for r in rows:
+                url = r.get("url", "")
+                if not url:
+                    continue
+                if url.endswith("/*") or url.endswith("/"):
+                    host = safe_host(url)
+                    if host:
+                        hosts.add(host)
+                else:
+                    urls.add(url)
+                    host = safe_host(url)
+                    # a bare host bookmark blocks the whole host
+                    if host and url.rstrip("/").endswith(host):
+                        hosts.add(host)
+            self._hosts = hosts
+            self._urls = urls
+            self._stamp = stamp
+            return True
+
+    def excluded(self, url: str) -> bool:
+        """Is this result URL blocked by the active filter?"""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if url in self._urls:
+                return True
+            host = safe_host(url)
+            return bool(host) and host in self._hosts
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._hosts) + len(self._urls)

@@ -1,0 +1,170 @@
+"""Vocabularies, autotagging and a triple store.
+
+Capability equivalents of the reference's linked-data layer (reference:
+source/net/yacy/cora/lod/ — an RDF-ish triple store (JenaTripleStore/
+TripleStore) and vocabulary model (lod/vocabulary/*); document
+autotagging from term vocabularies in document/Tokenizer + LibraryProvider
+vocabularies loaded from DATA/DICTIONARIES; ProbabilisticClassifier
+bridges bayes-trained context models). A Vocabulary maps literal terms
+and synonyms onto tags; `tag_document` yields the vocabulary facets that
+the reference writes into vocabulary_* Solr fields.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+_TOKEN_RE = re.compile(r"\w+", re.UNICODE)
+
+
+class Vocabulary:
+    """term/synonym -> object (tag) mapping, matched against documents."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._term2tag: dict[str, str] = {}
+
+    def put(self, tag: str, terms: list[str]) -> None:
+        for t in terms:
+            t = t.strip().lower()
+            if t:
+                self._term2tag[t] = tag
+
+    def tags(self) -> set[str]:
+        return set(self._term2tag.values())
+
+    def match(self, text: str) -> set[str]:
+        found: set[str] = set()
+        for tok in _TOKEN_RE.findall(text.lower()):
+            tag = self._term2tag.get(tok)
+            if tag:
+                found.add(tag)
+        return found
+
+    def to_dict(self) -> dict:
+        inv: dict[str, list[str]] = {}
+        for term, tag in self._term2tag.items():
+            inv.setdefault(tag, []).append(term)
+        return {"name": self.name, "tags": inv}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Vocabulary":
+        v = Vocabulary(d.get("name", ""))
+        for tag, terms in d.get("tags", {}).items():
+            v.put(tag, terms)
+        return v
+
+
+class VocabularyLibrary:
+    """Named vocabularies persisted under DATA/DICTIONARIES
+    (LibraryProvider.vocabularies equivalent)."""
+
+    def __init__(self, data_dir: str | None = None):
+        self.data_dir = data_dir
+        self._vocs: dict[str, Vocabulary] = {}
+        self._lock = threading.Lock()
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            for fn in os.listdir(data_dir):
+                if fn.endswith(".vocab.json"):
+                    try:
+                        with open(os.path.join(data_dir, fn),
+                                  encoding="utf-8") as f:
+                            v = Vocabulary.from_dict(json.load(f))
+                        self._vocs[v.name] = v
+                    except (OSError, ValueError):
+                        continue
+
+    def put(self, voc: Vocabulary) -> None:
+        with self._lock:
+            self._vocs[voc.name] = voc
+            if self.data_dir:
+                path = os.path.join(self.data_dir, voc.name + ".vocab.json")
+                with open(path, "w", encoding="utf-8") as f:
+                    json.dump(voc.to_dict(), f, ensure_ascii=False)
+
+    def get(self, name: str) -> Vocabulary | None:
+        return self._vocs.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._vocs)
+
+    def tag_document(self, text: str) -> dict[str, set[str]]:
+        """vocabulary name -> matched tags (the vocabulary_* facet values)."""
+        with self._lock:   # snapshot: indexing races vocabulary admin
+            vocs = list(self._vocs.items())
+        out: dict[str, set[str]] = {}
+        for name, voc in vocs:
+            tags = voc.match(text)
+            if tags:
+                out[name] = tags
+        return out
+
+
+class TripleStore:
+    """Minimal (subject, predicate, object) store with pattern queries
+    (cora/lod TripleStore equivalent; None = wildcard)."""
+
+    def __init__(self, path: str | None = None):
+        self._triples: set[tuple[str, str, str]] = set()
+        self._path = path
+        self._lock = threading.Lock()
+        if path and os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            s, p, o = json.loads(line)
+                            self._triples.add((s, p, o))
+                        except ValueError:
+                            continue
+            except OSError:
+                pass
+
+    def add(self, s: str, p: str, o: str) -> None:
+        with self._lock:
+            if (s, p, o) in self._triples:
+                return
+            self._triples.add((s, p, o))
+            if self._path:
+                try:
+                    with open(self._path, "a", encoding="utf-8") as f:
+                        f.write(json.dumps([s, p, o], ensure_ascii=False)
+                                + "\n")
+                except OSError:
+                    pass
+
+    def query(self, s: str | None = None, p: str | None = None,
+              o: str | None = None) -> list[tuple[str, str, str]]:
+        with self._lock:
+            return [t for t in self._triples
+                    if (s is None or t[0] == s)
+                    and (p is None or t[1] == p)
+                    and (o is None or t[2] == o)]
+
+    def remove(self, s: str | None = None, p: str | None = None,
+               o: str | None = None) -> int:
+        with self._lock:
+            victims = [t for t in self._triples
+                       if (s is None or t[0] == s)
+                       and (p is None or t[1] == p)
+                       and (o is None or t[2] == o)]
+            for t in victims:
+                self._triples.discard(t)
+            if victims and self._path:
+                try:
+                    tmp = self._path + ".tmp"
+                    with open(tmp, "w", encoding="utf-8") as f:
+                        for t in self._triples:
+                            f.write(json.dumps(list(t), ensure_ascii=False)
+                                    + "\n")
+                    os.replace(tmp, self._path)
+                except OSError:
+                    pass
+            return len(victims)
+
+    def __len__(self) -> int:
+        return len(self._triples)

@@ -1,0 +1,87 @@
+"""Index export/import dumps.
+
+Capability equivalent of the reference's Fulltext dump machinery
+(reference: source/net/yacy/search/index/Fulltext.java export/import
+methods — full-index XML/jsonl dumps written under DATA/EXPORT, restored
+by re-feeding documents) and the surrogate import path. The dump carries
+the metadata rows (incl. the stored full text); import re-condenses each
+row through the normal store path, so the RWI/citation/dense structures
+are REBUILT, not copied — a dump is portable across index formats.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import time
+
+from ..document.document import Document
+from .metadata import DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS
+from .segment import Segment
+
+
+def export_dump(segment: Segment, path: str,
+                query_host: str | None = None) -> int:
+    """Write every live metadata row as one JSON line (gzip when the path
+    ends .gz). Returns rows written. `query_host` restricts to one host
+    (the reference's export offers Solr-query filtering)."""
+    meta = segment.metadata
+    opener = gzip.open if path.endswith(".gz") else open
+    n = 0
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with opener(tmp, "wt", encoding="utf-8") as f:
+        f.write(json.dumps({"dump": "yacy-tpu", "version": 1,
+                            "date": time.time()}) + "\n")
+        for docid in range(meta.capacity()):
+            if meta.is_deleted(docid):
+                continue
+            row = meta.get(docid)
+            if row is None:
+                continue
+            if query_host and row.get("host_s") != query_host:
+                continue
+            rec = {"id": row.urlhash.decode("ascii", "replace")}
+            for k in (*TEXT_FIELDS, *INT_FIELDS, *DOUBLE_FIELDS):
+                v = row.get(k)
+                if v not in (None, "", 0, 0.0):
+                    rec[k] = v
+            f.write(json.dumps(rec, ensure_ascii=False) + "\n")
+            n += 1
+    os.replace(tmp, path)
+    return n
+
+
+def import_dump(segment: Segment, path: str) -> int:
+    """Re-index every dumped row through Segment.store_document (text is
+    re-condensed; RWI/citations/dense rebuilt). Returns docs imported."""
+    opener = gzip.open if path.endswith(".gz") else open
+    n = 0
+    with opener(path, "rt", encoding="utf-8") as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "dump" in rec:       # header line
+                continue
+            url = rec.get("sku")
+            if not url:
+                continue
+            doc = Document(
+                url=url,
+                title=rec.get("title", ""),
+                text=rec.get("text_t", ""),
+                author=rec.get("author", ""),
+                description=rec.get("description_txt", ""),
+                keywords=[k for k in rec.get("keywords", "").split(",") if k],
+                language=rec.get("language_s", ""),
+                publish_date_days=rec.get("last_modified_days_i", 0),
+                lat=rec.get("lat_d", 0.0), lon=rec.get("lon_d", 0.0),
+            )
+            segment.store_document(
+                doc, crawldepth=rec.get("crawldepth_i", 0),
+                collection=(rec.get("collection_sxt") or "user").split(",")[0])
+            n += 1
+    return n

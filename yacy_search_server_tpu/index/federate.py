@@ -1,0 +1,250 @@
+"""Fulltext federation — connectors, mirroring and client-side sharding.
+
+Capability equivalent of the reference's Solr federation layer
+(reference: source/net/yacy/cora/federate/solr/ — EmbeddedSolrConnector
+over the in-process core, RemoteSolrConnector over HTTP,
+MirrorSolrConnector dual-writing embedded+remote with read preference,
+ShardSelection.java:40-121 MODULO_HOST_MD5 / ROUND_ROBIN write policies
+with read-all scatter). The embedded core maps to the local Segment; the
+remote protocol is this framework's /select + /push_p servlets instead
+of solrj.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import threading
+import urllib.parse
+import urllib.request
+
+from ..document.document import Document
+from ..utils.hashes import safe_host, url2hash
+from .metadata import DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS
+from .segment import Segment
+
+
+def _doc_to_row(doc: Document) -> dict:
+    return {
+        "sku": doc.url, "title": doc.title, "text_t": doc.text,
+        "author": doc.author, "description_txt": doc.description,
+        "keywords": ",".join(doc.keywords), "language_s": doc.language,
+        "last_modified_days_i": doc.publish_date_days,
+        "lat_d": doc.lat, "lon_d": doc.lon,
+    }
+
+
+def _row_to_doc(row: dict) -> Document:
+    return Document(
+        url=row.get("sku", ""), title=row.get("title", ""),
+        text=row.get("text_t", ""), author=row.get("author", ""),
+        description=row.get("description_txt", ""),
+        keywords=[k for k in row.get("keywords", "").split(",") if k],
+        language=row.get("language_s", ""),
+        publish_date_days=int(row.get("last_modified_days_i", 0) or 0),
+        lat=float(row.get("lat_d", 0.0) or 0.0),
+        lon=float(row.get("lon_d", 0.0) or 0.0))
+
+
+class LocalConnector:
+    """The embedded core: a Segment behind the connector interface
+    (EmbeddedSolrConnector equivalent)."""
+
+    def __init__(self, segment: Segment):
+        self.segment = segment
+
+    def add(self, doc: Document) -> None:
+        self.segment.store_document(doc)
+
+    def delete_by_id(self, urlhash: bytes) -> bool:
+        return self.segment.remove_document(urlhash)
+
+    def exists(self, urlhash: bytes) -> bool:
+        return self.segment.metadata.exists(urlhash)
+
+    def count(self) -> int:
+        return self.segment.doc_count()
+
+    def query(self, querystring: str, rows: int = 10,
+              start: int = 0) -> list[dict]:
+        ev_rows = []
+        from ..search.query import QueryParams
+        from ..search.searchevent import SearchEvent
+        q = QueryParams.parse(querystring)
+        q.item_count = rows
+        q.offset = start
+        ev = SearchEvent(q, self.segment)
+        for r in ev.results(offset=start, count=rows):
+            m = self.segment.metadata.get(r.docid) if r.docid >= 0 else None
+            row = {"id": r.urlhash.decode("ascii", "replace"),
+                   "sku": r.url, "title": r.title, "score": int(r.score),
+                   "host_s": r.host, "language_s": r.language,
+                   "description_txt": r.snippet}
+            if m is not None:
+                for k in (*TEXT_FIELDS, *INT_FIELDS, *DOUBLE_FIELDS):
+                    v = m.get(k)
+                    if v not in (None, "") and k not in row:
+                        row[k] = v
+            ev_rows.append(row)
+        return ev_rows
+
+
+class RemoteConnector:
+    """HTTP client to another node's /select + /push_p servlets
+    (RemoteSolrConnector equivalent). Writes hit the peer's admin-gated
+    push servlet: pass (user, password) for non-localhost peers — they
+    go out as HTTP basic auth, the peer admin surface's scheme."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 user: str = "", password: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._auth = None
+        if user:
+            import base64
+            self._auth = "Basic " + base64.b64encode(
+                f"{user}:{password}".encode("utf-8")).decode("ascii")
+
+    def _request(self, path: str, data: dict | None = None) -> dict:
+        body = urllib.parse.urlencode(data).encode("utf-8") \
+            if data is not None else None
+        req = urllib.request.Request(self.base_url + path, data=body)
+        if self._auth:
+            req.add_header("Authorization", self._auth)
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def _get(self, path: str) -> dict:
+        return self._request(path)
+
+    def add(self, doc: Document) -> None:
+        # POST body: document text routinely exceeds GET request-line limits
+        row = _doc_to_row(doc)
+        self._request("/api/push_p.json", data={
+            "url": doc.url, "title": doc.title, "content": doc.text,
+            "author": doc.author, "description": doc.description,
+            "keywords": row["keywords"], "language": doc.language,
+            "lastmod_days": row["last_modified_days_i"],
+            "lat": row["lat_d"], "lon": row["lon_d"]})
+
+    def delete_by_id(self, urlhash: bytes) -> bool:
+        out = self._get("/api/push_p.json?delete="
+                        + urlhash.decode("ascii", "replace"))
+        return out.get("deleted") in (1, "1")
+
+    def exists(self, urlhash: bytes) -> bool:
+        out = self._get("/select.json?q=id:"
+                        + urlhash.decode("ascii", "replace") + "&rows=1")
+        return bool(out.get("response", {}).get("docs"))
+
+    def count(self) -> int:
+        out = self._get("/select.json?q=*:*&rows=0")
+        return int(out.get("response", {}).get("numFound", 0))
+
+    def query(self, querystring: str, rows: int = 10,
+              start: int = 0) -> list[dict]:
+        params = urllib.parse.urlencode(
+            {"q": querystring, "rows": rows, "start": start})
+        out = self._get(f"/select.json?{params}")
+        return out.get("response", {}).get("docs", [])
+
+
+class MirrorConnector:
+    """Dual-write to two connectors, read preference first-then-second
+    (InstanceMirror / MirrorSolrConnector equivalent)."""
+
+    def __init__(self, primary, secondary):
+        self.primary = primary
+        self.secondary = secondary
+
+    def add(self, doc: Document) -> None:
+        self.primary.add(doc)
+        self.secondary.add(doc)
+
+    def delete_by_id(self, urlhash: bytes) -> bool:
+        a = self.primary.delete_by_id(urlhash)
+        b = self.secondary.delete_by_id(urlhash)
+        return a or b
+
+    def exists(self, urlhash: bytes) -> bool:
+        return self.primary.exists(urlhash) or self.secondary.exists(urlhash)
+
+    def count(self) -> int:
+        return max(self.primary.count(), self.secondary.count())
+
+    def query(self, querystring: str, rows: int = 10,
+              start: int = 0) -> list[dict]:
+        out = self.primary.query(querystring, rows=rows, start=start)
+        if out:
+            return out
+        return self.secondary.query(querystring, rows=rows, start=start)
+
+
+class ShardSelection:
+    """Write-routing policies (ShardSelection.java:40-121)."""
+
+    MODULO_HOST_MD5 = "MODULO_HOST_MD5"
+    ROUND_ROBIN = "ROUND_ROBIN"
+
+    def __init__(self, method: str, shard_count: int):
+        self.method = method
+        self.shard_count = shard_count
+        self._rr = itertools.count()
+        self._lock = threading.Lock()
+
+    def select(self, url: str) -> int:
+        if self.method == self.ROUND_ROBIN:
+            with self._lock:
+                return next(self._rr) % self.shard_count
+        # MODULO_HOST_MD5: same host -> same shard (host-local joins stay
+        # shard-local, the reference's write-to-one/read-all default)
+        host = safe_host(url) or url
+        h = hashlib.md5(host.encode("utf-8")).digest()  # nosec
+        return int.from_bytes(h[:8], "big") % self.shard_count
+
+
+class ShardConnector:
+    """Client-side sharding: write to the selected shard, read scatter to
+    all (ShardInstance equivalent)."""
+
+    def __init__(self, connectors: list, method: str = ShardSelection.MODULO_HOST_MD5):
+        if not connectors:
+            raise ValueError("need at least one shard connector")
+        self.connectors = list(connectors)
+        self.selection = ShardSelection(method, len(connectors))
+
+    def shard_for(self, url: str):
+        return self.connectors[self.selection.select(url)]
+
+    def add(self, doc: Document) -> None:
+        self.shard_for(doc.url).add(doc)
+
+    def delete_by_id(self, urlhash: bytes) -> bool:
+        return any([c.delete_by_id(urlhash) for c in self.connectors])
+
+    def exists(self, urlhash: bytes) -> bool:
+        return any(c.exists(urlhash) for c in self.connectors)
+
+    def count(self) -> int:
+        return sum(c.count() for c in self.connectors)
+
+    def query(self, querystring: str, rows: int = 10,
+              start: int = 0) -> list[dict]:
+        merged: list[dict] = []
+        for c in self.connectors:
+            try:
+                merged.extend(c.query(querystring, rows=rows + start))
+            except Exception:
+                continue        # a dead shard degrades, not fails, the read
+        merged.sort(key=lambda r: -int(r.get("score", 0)))
+        # dedup by id across shards (mirrored writes / moved hosts)
+        seen: set[str] = set()
+        out = []
+        for r in merged:
+            rid = r.get("id", r.get("sku", ""))
+            if rid in seen:
+                continue
+            seen.add(rid)
+            out.append(r)
+        return out[start:start + rows]

@@ -40,6 +40,7 @@ TEXT_FIELDS = (
     "language_s",
     "url_file_ext_s",
     "collection_sxt",  # crawl collections (comma-joined)
+    "vocabulary_sxt",  # autotagging facets "voc:tag,..." (vocabulary_* fields)
 )
 INT_FIELDS = (
     "size_i",          # byte size

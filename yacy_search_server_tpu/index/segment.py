@@ -63,6 +63,10 @@ class Segment:
         self.dense = DenseVectorStore(
             f"{data_dir}/dense" if data_dir else None,
             dim=self.encoder.dim)
+        # optional autotagging source (document/vocabulary.py); when set,
+        # store_document writes vocabulary facets into vocabulary_sxt
+        # (the reference's vocabulary_* Solr fields from Tokenizer tagging)
+        self.vocabularies = None
         self._lock = threading.RLock()
 
     # -- write path ----------------------------------------------------------
@@ -74,6 +78,13 @@ class Segment:
             urlhash = url2hash(doc.url)
             condenser = Condenser(doc)
 
+            vocab_sxt = ""
+            if self.vocabularies is not None:
+                tagmap = self.vocabularies.tag_document(
+                    f"{doc.title}\n{doc.text[:8192]}")
+                vocab_sxt = ",".join(
+                    f"{voc}:{tag}" for voc in sorted(tagmap)
+                    for tag in sorted(tagmap[voc]))
             meta = metadata_from_parsed(
                 urlhash, doc.url, doc.title, doc.text,
                 author=doc.author,
@@ -95,6 +106,7 @@ class Segment:
                 references_i=self.citations.references(urlhash),
                 references_exthosts_i=self.citations.references_exthosts(urlhash),
                 lat_d=doc.lat, lon_d=doc.lon,
+                vocabulary_sxt=vocab_sxt,
             )
             with self._lock:
                 # re-index: retire the previous version's identity so its

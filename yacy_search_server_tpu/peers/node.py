@@ -51,6 +51,7 @@ class P2PNode:
         self.seed.flags_accept_remote_index = accept_remote_index
         self.seed.flags_accept_remote_crawl = accept_remote_crawl
         self.seeddb = SeedDB(self.seed, data_dir)
+        self.sb.seeddb = self.seeddb     # status/graphics servlets read it
         self.dist = Distribution(partition_exponent)
         self.redundancy = redundancy
         self.news = NewsPool(data_dir)

@@ -162,6 +162,10 @@ class SeedDB:
         with self._lock:
             return list(self.active.values())
 
+    def passive_seeds(self) -> list[Seed]:
+        with self._lock:
+            return list(self.passive.values())
+
     def all_seeds(self) -> list[Seed]:
         with self._lock:
             return (list(self.active.values()) + list(self.passive.values())

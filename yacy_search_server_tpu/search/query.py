@@ -264,6 +264,9 @@ class QueryParams:
     # (ops/dense.py; new capability beyond the reference)
     hybrid: bool = False
     hybrid_alpha: float = 0.5
+    # optional result URL veto (ContentControl filter; reference consults
+    # it in the SearchEvent drain) — callable(url) -> True when blocked
+    url_filter: object = None
 
     @staticmethod
     def parse(querystring: str, **kw) -> "QueryParams":
@@ -294,6 +297,7 @@ class QueryParams:
             self.modifier.to_string(), str(self.contentdom), self.lang,
             self.profile.to_external_string() if self.profile else "",
             f"h{int(self.hybrid)}a{self.hybrid_alpha}" if self.hybrid else "",
+            "cc" if self.url_filter is not None else "",
         ))
         return hashlib.md5(key.encode()).hexdigest()  # nosec: cache key only
 

@@ -244,6 +244,8 @@ class SearchEvent:
             return None
         url = m.get("sku", "")
         title = m.get("title", "") or url
+        if q.url_filter is not None and q.url_filter(url):
+            return None
         if q.modifier.inurl and q.modifier.inurl.lower() not in url.lower():
             return None
         if q.modifier.intitle and q.modifier.intitle.lower() not in title.lower():
@@ -280,6 +282,8 @@ class SearchEvent:
         the already-joined metadata row for local results (None for remote
         entries, which carry no local row)."""
         q = self.query
+        if q.url_filter is not None and entry.url and q.url_filter(entry.url):
+            return False
         # remote entries never went through _constraint_mask: recheck the
         # daterange bounds on the metadata they carry (local entries were
         # already filtered; their recheck is a no-op)
@@ -412,6 +416,12 @@ class SearchEventCache:
         while len(self._events) >= self.max_events:
             oldest = min(self._events, key=lambda k: self._events[k].touched)
             del self._events[oldest]
+
+    def clear(self) -> None:
+        """Drop every cached event (filter-set changes invalidate results
+        computed under the old filter)."""
+        with self._lock:
+            self._events.clear()
 
     def __len__(self) -> int:
         return len(self._events)

@@ -171,8 +171,14 @@ class YaCyHttpServer:
                       "client_ip": handler.client_address[0],
                       "method": handler.command}
             prop = fn(header, post, self.sb)
+            if isinstance(prop.raw_body, bytes):    # binary (PNG graphics)
+                self._send(handler, 200,
+                           prop.raw_ctype or "application/octet-stream",
+                           prop.raw_body)
+                return
             body = self._render(name, ext, prop)
-            ctype = _CONTENT_TYPES.get(ext, "text/html; charset=utf-8")
+            ctype = prop.raw_ctype or _CONTENT_TYPES.get(
+                ext, "text/html; charset=utf-8")
             self._send(handler, 200, ctype, body.encode("utf-8"))
         except BrokenPipeError:
             pass
@@ -184,6 +190,8 @@ class YaCyHttpServer:
                 pass
 
     def _render(self, name: str, ext: str, prop: ServerObjects) -> str:
+        if prop.raw_body is not None:
+            return prop.raw_body
         tmpl = f"{name}.{ext}"
         if self.templates.resolve(tmpl) is not None:
             return self.templates.render_file(tmpl, prop)

@@ -49,6 +49,12 @@ class ServerObjects:
 
     def __init__(self, initial: dict | None = None):
         self._map: dict[str, str] = {}
+        # when set, the HTTP layer sends this body verbatim instead of
+        # rendering a template (structured responses like Solr-shape JSON
+        # or PNG graphics, the reference's custom response writers);
+        # bytes bodies use raw_ctype as their content type
+        self.raw_body: str | bytes | None = None
+        self.raw_ctype: str | None = None
         if initial:
             for k, v in initial.items():
                 self.put(k, v)

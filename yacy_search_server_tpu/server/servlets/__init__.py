@@ -44,4 +44,5 @@ def _ensure_loaded() -> None:
     if _loaded:
         return
     _loaded = True
-    from . import yacysearch, status, admin, api, boards  # noqa: F401
+    from . import (yacysearch, status, admin, api, boards,  # noqa: F401
+                   federate, graphics)

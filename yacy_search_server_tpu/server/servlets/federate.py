@@ -1,0 +1,159 @@
+"""Federation servlets: Solr-compatible select, external push, dumps.
+
+Capability equivalents of the reference's federation-facing endpoints
+(reference: source/net/yacy/http/servlets/SolrSelectServlet.java — the
+Solr-compatible /solr/select surface other peers and tools shard-read
+from; htroot/api/push_p.java — external document push; htroot/
+IndexExport_p.java — full-index dump export/restore)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ...document.document import Document
+from ...index.metadata import DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS
+from ...utils.hashes import url2hash
+from ..objects import ServerObjects
+from . import servlet
+
+
+@servlet("solr/select")      # the reference's mount point
+@servlet("select")
+def respond_select(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Solr-shaped select: q (free text, field:value, id:<hash>, *:*),
+    start/rows/fl; JSON body in the solrj wire shape so shard readers and
+    external Solr clients keep working (SolrSelectServlet parity)."""
+    prop = ServerObjects()
+    q = post.get("q", "*:*").strip()
+    rows = min(post.get_int("rows", 10), 1000)
+    start = post.get_int("start", 0)
+    fl = [f for f in post.get("fl", "").split(",") if f]
+
+    docs: list[dict] = []
+    num_found = 0
+    meta = sb.index.metadata
+
+    def row_of(docid: int, score: int = 0) -> dict | None:
+        m = meta.get(docid)
+        if m is None:
+            return None
+        row = {"id": m.urlhash.decode("ascii", "replace"), "score": score}
+        for k in (*TEXT_FIELDS, *INT_FIELDS, *DOUBLE_FIELDS):
+            v = m.get(k)
+            if v not in (None, ""):
+                row[k] = v
+        if fl:
+            row = {k: v for k, v in row.items() if k in fl or k == "id"}
+        return row
+
+    if q in ("*:*", "*", ""):
+        num_found = sb.index.doc_count()
+        taken = 0
+        for docid in range(meta.capacity()):
+            if meta.is_deleted(docid):
+                continue
+            if taken < start:
+                taken += 1
+                continue
+            if len(docs) >= rows:
+                break
+            r = row_of(docid)
+            if r is not None:
+                docs.append(r)
+            taken += 1
+    elif q.startswith("id:"):
+        uh = q[3:].strip().strip('"').encode("ascii", "replace")
+        docid = meta.docid(uh)
+        if docid is not None and not meta.is_deleted(docid):
+            r = row_of(docid)
+            if r is not None:
+                docs, num_found = [r], 1
+    else:
+        # field:value terms and free text both route through the normal
+        # query model (field queries map onto modifiers where they exist)
+        querystring = q.replace("host_s:", "site:") \
+                       .replace("url_file_ext_s:", "filetype:")
+        ev = sb.search(querystring, count=rows + start)
+        results = ev.results(offset=start, count=rows)
+        num_found = ev.result_heap.size_available()
+        for r in results:
+            if r.docid >= 0:
+                row = row_of(r.docid, score=int(r.score))
+            else:       # remote entry: serve the fields it carried
+                row = {"id": r.urlhash.decode("ascii", "replace"),
+                       "sku": r.url, "title": r.title, "host_s": r.host,
+                       "score": int(r.score)}
+                if fl:
+                    row = {k: v for k, v in row.items()
+                           if k in fl or k == "id"}
+            if row is not None:
+                docs.append(row)
+
+    prop.raw_body = json.dumps({
+        "responseHeader": {"status": 0, "QTime": 0,
+                           "params": {"q": q, "rows": str(rows),
+                                      "start": str(start)}},
+        "response": {"numFound": num_found, "start": start, "docs": docs},
+    }, ensure_ascii=False)
+    return prop
+
+
+@servlet("api/push_p")       # the reference's mount point
+@servlet("push_p")
+def respond_push(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """External document push/delete (htroot/api/push_p.java): index a
+    document supplied by an external producer, no crawl involved."""
+    prop = ServerObjects()
+    if post.get("delete"):
+        uh = post.get("delete").encode("ascii", "replace")
+        prop.put("deleted", 1 if sb.index.remove_document(uh) else 0)
+        return prop
+    url = post.get("url", "")
+    if not url:
+        prop.put("stored", 0)
+        prop.put("info", "missing url")
+        return prop
+    doc = Document(
+        url=url, title=post.get("title", ""),
+        text=post.get("content", ""), author=post.get("author", ""),
+        description=post.get("description", ""),
+        keywords=[k for k in post.get("keywords", "").split(",") if k],
+        language=post.get("language", ""),
+        publish_date_days=post.get_int("lastmod_days", 0),
+        lat=float(post.get("lat", "0") or 0),
+        lon=float(post.get("lon", "0") or 0))
+    docid = sb.index.store_document(doc, collection=post.get(
+        "collection", "api"))
+    prop.put("stored", 1)
+    prop.put("docid", docid)
+    prop.put("urlhash", url2hash(url).decode("ascii", "replace"))
+    return prop
+
+
+@servlet("IndexExport_p")
+def respond_export(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Dump export/import under DATA/EXPORT (htroot/IndexExport_p.java)."""
+    from ...index.dumps import export_dump, import_dump
+    prop = ServerObjects()
+    base = os.path.join(sb.data_dir, "EXPORT") if sb.data_dir else None
+    if base is None:
+        prop.put("info", "no data dir")
+        return prop
+    os.makedirs(base, exist_ok=True)
+    name = os.path.basename(post.get("file", "") or "dump.jsonl.gz")
+    path = os.path.join(base, name)
+    if post.get("action") == "export":
+        n = export_dump(sb.index, path,
+                        query_host=post.get("host", "") or None)
+        prop.put("exported", n)
+        prop.put("file", name)
+    elif post.get("action") == "import" and os.path.exists(path):
+        n = import_dump(sb.index, path)
+        prop.put("imported", n)
+    dumps = sorted(f for f in os.listdir(base))
+    prop.put("dumps", len(dumps))
+    for i, f in enumerate(dumps):
+        prop.put(f"dumps_{i}_file", f)
+        prop.put(f"dumps_{i}_size", os.path.getsize(os.path.join(base, f)))
+    return prop

@@ -1,0 +1,76 @@
+"""Graphics + linked-data servlets.
+
+Capability equivalents of the reference's image servlets and vocabulary
+admin (reference: htroot/NetworkPicture.java — the DHT ring PNG;
+htroot/WebStructurePicture_p.java — host link graph PNG;
+htroot/Vocabulary_p.java — vocabulary creation/editing + autotagging
+control; htroot/api/ymarks or triple-store surfaces via cora/lod)."""
+
+from __future__ import annotations
+
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+
+@servlet("NetworkPicture")
+def respond_network_picture(header: dict, post: ServerObjects,
+                            sb) -> ServerObjects:
+    from ...visualization.graphs import network_graph
+    from ...visualization.raster import RasterPlotter
+    prop = ServerObjects()
+    seeddb = getattr(sb, "seeddb", None)
+    if seeddb is None:
+        # still answer with a real PNG: the .png path fixes the content type
+        img = RasterPlotter(480, 480, background=(8, 8, 32))
+        img.text(140, 235, "P2P DISABLED", (200, 200, 220))
+        prop.raw_body = img.png_bytes()
+        prop.raw_ctype = "image/png"
+        return prop
+    img = network_graph(seeddb, width=post.get_int("width", 480),
+                        height=post.get_int("height", 480))
+    prop.raw_body = img.png_bytes()
+    prop.raw_ctype = "image/png"
+    return prop
+
+
+@servlet("WebStructurePicture_p")
+def respond_structure_picture(header: dict, post: ServerObjects,
+                              sb) -> ServerObjects:
+    from ...visualization.graphs import web_structure_graph
+    prop = ServerObjects()
+    img = web_structure_graph(
+        sb.web_structure, width=post.get_int("width", 640),
+        height=post.get_int("height", 480),
+        max_hosts=post.get_int("hosts", 24))
+    prop.raw_body = img.png_bytes()
+    prop.raw_ctype = "image/png"
+    return prop
+
+
+@servlet("Vocabulary_p")
+def respond_vocabulary(header: dict, post: ServerObjects,
+                       sb) -> ServerObjects:
+    from ...document.vocabulary import Vocabulary
+    prop = ServerObjects()
+    if post.get("create") and post.get("terms"):
+        voc = sb.vocabularies.get(post.get("create")) \
+            or Vocabulary(post.get("create"))
+        # terms format: tag1:term1,term2;tag2:term3 ...
+        for group in post.get("terms").split(";"):
+            if ":" not in group:
+                continue
+            tag, terms = group.split(":", 1)
+            voc.put(tag.strip(), terms.split(","))
+        sb.vocabularies.put(voc)
+    if post.get("test"):
+        tags = sb.vocabularies.tag_document(post.get("test"))
+        prop.put("matches", len(tags))
+        for i, (name, ts) in enumerate(sorted(tags.items())):
+            prop.put(f"matches_{i}_vocabulary", escape_json(name))
+            prop.put(f"matches_{i}_tags", escape_json(",".join(sorted(ts))))
+    names = sb.vocabularies.names()
+    prop.put("vocabularies", len(names))
+    for i, n in enumerate(names):
+        prop.put(f"vocabularies_{i}_name", escape_json(n))
+        prop.put(f"vocabularies_{i}_tags", len(sb.vocabularies.get(n).tags()))
+    return prop

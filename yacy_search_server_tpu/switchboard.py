@@ -107,6 +107,15 @@ class Switchboard:
         self.messages = MessageBoard(self.tables)
         self.bookmarks = BookmarksDB(self.tables)
         self.userdb = UserDB(self.tables)
+        from .data.contentcontrol import ContentControl
+        from .document.vocabulary import TripleStore, VocabularyLibrary
+        self.vocabularies = VocabularyLibrary(sub("DICTIONARIES"))
+        self.index.vocabularies = self.vocabularies
+        self.triplestore = TripleStore(
+            os.path.join(data_dir, "triplestore.jsonl") if data_dir else None)
+        self.content_control = ContentControl(self.bookmarks)
+        self.content_control.enabled = self.config.get_bool(
+            "contentcontrol.enabled", False)
         # self-HTTP executor for the scheduler; the HTTP server sets this
         # when it binds (the reference re-executes recorded API calls
         # through its own HTTP port, WorkTables.execAPICall)
@@ -252,6 +261,8 @@ class Switchboard:
         q.item_count = count
         q.offset = offset
         q.hybrid = hybrid
+        if self.content_control.enabled:
+            q.url_filter = self.content_control.excluded
         t0 = time.time()
         event = self.search_cache.get_event(q, self.index)
         from .search.accesstracker import QueryLogEntry
@@ -351,6 +362,16 @@ class Switchboard:
         self.threads.deploy(BusyThread(
             "20_scheduler", self.scheduler_job,
             idle_sleep_s=60.0, busy_sleep_s=10.0))
+        self.threads.deploy(BusyThread(
+            "25_contentcontrol", self._content_control_job,
+            idle_sleep_s=30.0, busy_sleep_s=5.0))
+
+    def _content_control_job(self) -> bool:
+        changed = self.content_control.update_filter_job()
+        if changed:
+            # cached events were computed under the old filter set
+            self.search_cache.clear()
+        return changed
 
     def scheduler_job(self) -> bool:
         """Re-execute due recorded API calls via self-HTTP

@@ -1,0 +1,90 @@
+"""Naive-bayes text classifier.
+
+Capability equivalent of the reference's bayes package (reference:
+source/net/yacy/cora/bayes/Classifier.java + BayesClassifier.java, ~715
+LoC — feature=word counting per category with Laplace smoothing, used by
+document/ProbabilisticClassifier to auto-tag documents from trained
+context vocabularies). Scoring is vectorized: the learned log-likelihood
+matrix is a numpy [category, vocab] array applied to a count vector.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+
+import numpy as np
+
+_TOKEN_RE = re.compile(r"\w+", re.UNICODE)
+
+
+def _tokens(text: str) -> list[str]:
+    return [t.lower() for t in _TOKEN_RE.findall(text) if len(t) > 2]
+
+
+class BayesClassifier:
+    def __init__(self):
+        self._counts: dict[str, Counter] = {}
+        self._docs: dict[str, int] = {}
+        self._vocab: dict[str, int] | None = None
+        self._loglik: np.ndarray | None = None
+        self._logprior: np.ndarray | None = None
+        self._cats: list[str] = []
+
+    # -- training -------------------------------------------------------------
+
+    def learn(self, category: str, text: str) -> None:
+        self._counts.setdefault(category, Counter()).update(_tokens(text))
+        self._docs[category] = self._docs.get(category, 0) + 1
+        self._vocab = None      # invalidate the compiled matrices
+
+    def categories(self) -> list[str]:
+        return sorted(self._counts)
+
+    def _compile(self) -> None:
+        self._cats = self.categories()
+        vocab_set: set[str] = set()
+        for c in self._cats:
+            vocab_set.update(self._counts[c])
+        self._vocab = {w: i for i, w in enumerate(sorted(vocab_set))}
+        v = len(self._vocab)
+        mat = np.zeros((len(self._cats), v), dtype=np.float64)
+        for ci, c in enumerate(self._cats):
+            for w, n in self._counts[c].items():
+                mat[ci, self._vocab[w]] = n
+        totals = mat.sum(axis=1, keepdims=True)
+        # Laplace smoothing
+        self._loglik = np.log((mat + 1.0) / (totals + v))
+        ndocs = sum(self._docs.values())
+        self._logprior = np.array(
+            [math.log(self._docs[c] / ndocs) for c in self._cats])
+
+    # -- classification -------------------------------------------------------
+
+    def scores(self, text: str) -> dict[str, float]:
+        if not self._counts:
+            return {}
+        if self._vocab is None:
+            self._compile()
+        vec = np.zeros(len(self._vocab), dtype=np.float64)
+        oov = 0
+        for t in _tokens(text):
+            i = self._vocab.get(t)
+            if i is None:
+                oov += 1
+            else:
+                vec[i] += 1
+        logp = self._logprior + self._loglik @ vec
+        return dict(zip(self._cats, logp.tolist()))
+
+    def classify(self, text: str, min_margin: float = 0.0) -> str | None:
+        """Best category, or None when the margin over the runner-up is
+        below `min_margin` (unsure)."""
+        s = self.scores(text)
+        if not s:
+            return None
+        ranked = sorted(s.items(), key=lambda kv: -kv[1])
+        if len(ranked) > 1 and ranked[0][1] - ranked[1][1] < min_margin:
+            return None
+        return ranked[0][0]

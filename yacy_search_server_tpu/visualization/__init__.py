@@ -1,0 +1,3 @@
+from .raster import RasterPlotter
+
+__all__ = ["RasterPlotter"]

@@ -1,0 +1,82 @@
+"""Network + web-structure graphics over the raster canvas.
+
+Capability equivalents of the reference's graph renderers (reference:
+source/net/yacy/peers/graphics/NetworkGraph.java — peers placed on the
+DHT ring circle by their hash position, my node highlighted, transfer
+beams; WebStructurePicture_p — host link graph with force-ish placement).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..parallel.distribution import LONG_MAX
+from .raster import RasterPlotter
+
+BG = (8, 8, 32)
+RING = (64, 96, 160)
+PEER = (80, 220, 120)
+PEER_PASSIVE = (150, 150, 90)
+ME = (255, 80, 80)
+TEXT = (200, 200, 220)
+EDGE = (70, 110, 70)
+NODE = (120, 200, 240)
+
+
+def network_graph(seeddb, width: int = 480, height: int = 480,
+                  dist=None) -> RasterPlotter:
+    """The DHT ring picture: every peer at angle = ring position / 2^63."""
+    img = RasterPlotter(width, height, background=BG)
+    cx, cy = width // 2, height // 2
+    r = min(width, height) // 2 - 40
+    img.circle(cx, cy, r, RING)
+
+    def place(seed, color, radius):
+        ang = 2 * math.pi * (seed.ring_position() / LONG_MAX) - math.pi / 2
+        x = int(cx + r * math.cos(ang))
+        y = int(cy + r * math.sin(ang))
+        img.dot(x, y, color, radius=radius)
+        img.text(x + 6, y - 3, seed.name[:12], TEXT)
+        return x, y
+
+    passive = seeddb.passive_seeds()   # locked copies: gossip threads
+    active = seeddb.active_seeds()     # mutate the underlying dicts
+    for s in passive:
+        place(s, PEER_PASSIVE, 2)
+    for s in active:
+        place(s, PEER, 3)
+    mx, my = place(seeddb.my_seed, ME, 5)
+    img.line(cx, cy, mx, my, ME)
+    img.text(10, 10, f"PEERS: {len(active)} ACTIVE "
+                     f"{len(passive)} PASSIVE", TEXT)
+    return img
+
+
+def web_structure_graph(web_structure, width: int = 640, height: int = 480,
+                        max_hosts: int = 24) -> RasterPlotter:
+    """Host link graph: top hosts on a circle, edges for host->host links."""
+    img = RasterPlotter(width, height, background=BG)
+    cx, cy = width // 2, height // 2
+    r = min(width, height) // 2 - 60
+    hosts = [h for h, _ in web_structure.top_hosts(max_hosts)]
+    if not hosts:
+        img.text(20, height // 2, "NO STRUCTURE DATA", TEXT)
+        return img
+    pos: dict[str, tuple[int, int]] = {}
+    for i, h in enumerate(hosts):
+        ang = 2 * math.pi * i / len(hosts) - math.pi / 2
+        pos[h] = (int(cx + r * math.cos(ang)), int(cy + r * math.sin(ang)))
+    for h in hosts:
+        hx, hy = pos[h]
+        for target, count in web_structure.outgoing(h).items():
+            if target in pos:
+                img.line(hx, hy, *pos[target], EDGE)
+    for h in hosts:
+        hx, hy = pos[h]
+        refs = web_structure.references_count(h)
+        img.dot(hx, hy, NODE, radius=min(3 + refs, 10))
+        img.text(hx + 8, hy - 3, h[:18], TEXT)
+    img.text(10, 10, f"HOSTS: {len(hosts)}", TEXT)
+    return img

@@ -1,0 +1,117 @@
+"""RasterPlotter — software raster canvas with PNG output.
+
+Capability equivalent of the reference's visualization substrate
+(reference: source/net/yacy/visualization/RasterPlotter.java — an int[]
+RGB canvas with dot/line/circle/text primitives and its own PNG encoder,
+backing the network graphics, access grids and profiling graphs). Here
+the canvas is a numpy uint8 [h, w, 3] array — drawing is vectorized where
+it matters — and the PNG encoder is a minimal stdlib-zlib implementation
+(no external imaging dependency).
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+import zlib
+
+import numpy as np
+
+# 5x7 bitmap font for the uppercase/digit subset the graphs label with
+_FONT = {
+    "A": "0E110E1F11", "B": "1E111E111E", "C": "0E1110110E", "D": "1E11111E00",
+    "E": "1F101E101F", "F": "1F101E1010", "G": "0E1013110F", "H": "11111F1111",
+    "I": "0E0404040E", "J": "010101110E", "K": "1112141212", "L": "1010101F00",
+    "M": "111B151111", "N": "1119151311", "O": "0E1111110E", "P": "1E111E1010",
+    "Q": "0E1111120D", "R": "1E111E1211", "S": "0F100E011E", "T": "1F04040404",
+    "U": "111111110E", "V": "1111110A04", "W": "1111151B11", "X": "110A040A11",
+    "Y": "110A040404", "Z": "1F0204081F", "0": "0E1915130E", "1": "040C04040E",
+    "2": "0E0106081F", "3": "1E010E011E", "4": "02060A1F02", "5": "1F101E011E",
+    "6": "0E101E110E", "7": "1F01020408", "8": "0E110E110E", "9": "0E110F010E",
+    ".": "0000000404", "-": "00001F0000", " ": "0000000000", ":": "0004000400",
+    "/": "0102040810", "_": "000000001F",
+}
+
+
+class RasterPlotter:
+    def __init__(self, width: int, height: int,
+                 background: tuple[int, int, int] = (255, 255, 255)):
+        self.width = width
+        self.height = height
+        self.pix = np.empty((height, width, 3), dtype=np.uint8)
+        self.pix[:] = background
+
+    # -- primitives ----------------------------------------------------------
+
+    def dot(self, x: int, y: int, color, radius: int = 0) -> None:
+        if radius <= 0:
+            if 0 <= x < self.width and 0 <= y < self.height:
+                self.pix[y, x] = color
+            return
+        y0, y1 = max(0, y - radius), min(self.height, y + radius + 1)
+        x0, x1 = max(0, x - radius), min(self.width, x + radius + 1)
+        if y0 >= y1 or x0 >= x1:
+            return
+        yy, xx = np.mgrid[y0:y1, x0:x1]
+        mask = (yy - y) ** 2 + (xx - x) ** 2 <= radius * radius
+        self.pix[y0:y1, x0:x1][mask] = color
+
+    def line(self, x0: int, y0: int, x1: int, y1: int, color) -> None:
+        n = max(abs(x1 - x0), abs(y1 - y0), 1)
+        xs = np.linspace(x0, x1, n + 1).round().astype(int)
+        ys = np.linspace(y0, y1, n + 1).round().astype(int)
+        ok = (xs >= 0) & (xs < self.width) & (ys >= 0) & (ys < self.height)
+        self.pix[ys[ok], xs[ok]] = color
+
+    def circle(self, cx: int, cy: int, radius: int, color) -> None:
+        steps = max(8, int(2 * math.pi * radius))
+        ang = np.linspace(0, 2 * math.pi, steps)
+        xs = (cx + radius * np.cos(ang)).round().astype(int)
+        ys = (cy + radius * np.sin(ang)).round().astype(int)
+        ok = (xs >= 0) & (xs < self.width) & (ys >= 0) & (ys < self.height)
+        self.pix[ys[ok], xs[ok]] = color
+
+    def rect(self, x0: int, y0: int, x1: int, y1: int, color,
+             fill: bool = False) -> None:
+        x0, x1 = sorted((max(0, x0), min(self.width - 1, x1)))
+        y0, y1 = sorted((max(0, y0), min(self.height - 1, y1)))
+        if fill:
+            self.pix[y0:y1 + 1, x0:x1 + 1] = color
+        else:
+            self.pix[y0, x0:x1 + 1] = color
+            self.pix[y1, x0:x1 + 1] = color
+            self.pix[y0:y1 + 1, x0] = color
+            self.pix[y0:y1 + 1, x1] = color
+
+    def text(self, x: int, y: int, s: str, color) -> None:
+        cx = x
+        for ch in s.upper():
+            glyph = _FONT.get(ch)
+            if glyph is None:
+                cx += 6
+                continue
+            rows = [int(glyph[i:i + 2], 16) for i in range(0, 10, 2)]
+            for ry, bits in enumerate(rows):
+                for rx in range(5):
+                    if bits & (1 << (4 - rx)):
+                        px, py = cx + rx, y + ry
+                        if 0 <= px < self.width and 0 <= py < self.height:
+                            self.pix[py, px] = color
+            cx += 6
+
+    # -- PNG output ----------------------------------------------------------
+
+    def png_bytes(self) -> bytes:
+        """Minimal PNG: 8-bit RGB, filter 0 rows, one zlib IDAT."""
+        def chunk(tag: bytes, data: bytes) -> bytes:
+            return (struct.pack(">I", len(data)) + tag + data
+                    + struct.pack(">I", zlib.crc32(tag + data) & 0xFFFFFFFF))
+
+        ihdr = struct.pack(">IIBBBBB", self.width, self.height, 8, 2, 0, 0, 0)
+        raw = np.concatenate(
+            [np.concatenate(([0], row.reshape(-1))).astype(np.uint8)
+             for row in self.pix]).tobytes()
+        return (b"\x89PNG\r\n\x1a\n"
+                + chunk(b"IHDR", ihdr)
+                + chunk(b"IDAT", zlib.compress(raw, 6))
+                + chunk(b"IEND", b""))
