@@ -1,0 +1,130 @@
+"""M16 — snapshots state machine, recrawl job, DocumentIndex, synonyms, ARC."""
+
+import time
+
+import pytest
+
+from yacy_search_server_tpu.crawler.snapshots import (ARCHIVE, INVENTORY,
+                                                      Snapshots)
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.document.synonyms import SynonymLibrary
+from yacy_search_server_tpu.index.documentindex import DocumentIndex
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.utils.arc import ARCCache
+
+
+def test_snapshots_inventory_replace_and_commit(tmp_path):
+    s = Snapshots(str(tmp_path / "SNAPSHOTS"))
+    url = "http://snap.test/page.html"
+    s.store(url, b"rev one", depth=1, date_s=1000.0)
+    s.store(url, b"rev two", depth=1, date_s=2000.0)
+    # INVENTORY keeps only the newest revision
+    inv = s.revisions(url, INVENTORY)
+    assert len(inv) == 1 and s.load(inv[0]) == b"rev two"
+    # commit moves it to ARCHIVE; new loads stack a fresh inventory copy
+    assert s.commit(url) == 1
+    assert s.size(INVENTORY) == 0 and s.size(ARCHIVE) == 1
+    s.store(url, b"rev three", depth=1, date_s=3000.0)
+    assert s.commit(url) == 1
+    assert len(s.revisions(url, ARCHIVE)) == 2      # archive accumulates
+    assert s.delete(url) == 2
+    assert s.revisions(url) == []
+
+
+def test_snapshot_taken_during_crawl(tmp_path):
+    from yacy_search_server_tpu.switchboard import Switchboard
+    SITE = {"http://snapcrawl.test/": (
+        200, {"content-type": "text/html"},
+        b"<html><title>Snap</title><body>snapword body</body></html>")}
+
+    def transport(url, headers):
+        return SITE.get(url, (404, {}, b""))
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), transport=transport)
+    sb.latency.min_delta_s = 0.0
+    try:
+        sb.start_crawl("http://snapcrawl.test/", depth=0, snapshot_depth=1)
+        sb.crawl_until_idle(timeout_s=20)
+        revs = sb.snapshots.revisions("http://snapcrawl.test/")
+        assert len(revs) == 1
+        assert b"snapword" in sb.snapshots.load(revs[0])
+    finally:
+        sb.close()
+
+
+def test_recrawl_job_restacks_stale_docs(tmp_path):
+    from yacy_search_server_tpu.crawler.recrawl import RecrawlJob
+    from yacy_search_server_tpu.crawler.frontier import StackType
+    from yacy_search_server_tpu.crawler.profile import CrawlProfile
+    from yacy_search_server_tpu.switchboard import Switchboard
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        today = int(time.time() // 86400)
+        fresh = sb.index.store_document(Document(
+            url="http://fresh.test/a.html", title="fresh", text="word one"))
+        stale = sb.index.store_document(Document(
+            url="http://stale.test/b.html", title="stale", text="word two"))
+        # age the stale doc's load date past the horizon
+        sb.index.metadata.set_fields(stale, load_date_days_i=today - 90)
+        sb.index.metadata.set_fields(fresh, load_date_days_i=today - 1)
+        prof = CrawlProfile("recrawl", recrawl_if_older_s=30 * 86400,
+                            store_ht_cache=False)
+        sb.add_profile(prof)
+        job = RecrawlJob(sb.index, sb.crawl_stacker, prof.handle,
+                         stale_age_days=30)
+        assert job.job() is True
+        assert sb.noticed.size(StackType.LOCAL) == 1
+        req, _ = sb.noticed.pop(StackType.LOCAL)
+        assert req.url == "http://stale.test/b.html"
+        # nothing else stale: next round idles
+        assert job.job() is False
+    finally:
+        sb.close()
+
+
+def test_document_index_mini_api(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "a.html").write_text(
+        "<html><title>Alpha</title><body>localfile alpha text</body></html>")
+    (tmp_path / "docs" / "b.txt").write_text("localfile beta plain text")
+    di = DocumentIndex(Segment())
+    assert di.add_tree(str(tmp_path / "docs")) == 2
+    di.join()
+    hits = di.segment.term_search(include_words=["localfile"])
+    assert len(hits) == 2
+    di.close()
+
+
+def test_synonym_enrichment_makes_docs_findable():
+    syn = SynonymLibrary()
+    syn.load_text("car,automobile,vehicle\n# comment\nplane,aircraft\n")
+    assert syn.synonyms_of("car") == {"automobile", "vehicle"}
+    assert syn.synonyms_of("aircraft") == {"plane"}
+    assert syn.synonyms_of("boat") == set()
+    seg = Segment()
+    seg.synonyms = syn
+    seg.store_document(Document(url="http://syn.test/car.html",
+                                title="Car page", text="a red car for sale"))
+    # found under a synonym the text never contains
+    assert len(seg.term_search(include_words=["automobile"])) == 1
+    assert len(seg.term_search(include_words=["aircraft"])) == 0
+    seg.close()
+
+
+def test_arc_cache_promotion_and_bounds():
+    c = ARCCache(max_size=8)     # levels of 4
+    for i in range(10):
+        c.put(i, i * 10)
+    assert len(c) <= 8
+    # recent keys survive in the recency level
+    assert c.get(9) == 90
+    # second access promotes to the frequency level and survives new puts
+    assert c.get(9) == 90
+    for i in range(100, 110):
+        c.put(i, i)
+    assert c.get(9) == 90        # frequent key survived the flood
+    assert c.get(0) is None      # old one-touch key evicted
+    assert c.hits >= 3 and c.misses >= 1
+    c.remove(9)
+    assert 9 not in c

@@ -1,0 +1,92 @@
+"""M15 — message/profile wire RPCs, UPnP stub, release manager."""
+
+import pytest
+
+from yacy_search_server_tpu.peers.node import P2PNode
+from yacy_search_server_tpu.peers.operation import (Release, ReleaseManager,
+                                                    UPnP)
+from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    net = LoopbackNetwork()
+    a = P2PNode("ma", net, data_dir=str(tmp_path / "a"))
+    b = P2PNode("mb", net, data_dir=str(tmp_path / "b"))
+    a.bootstrap([b.seed])
+    a.ping()
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_message_rpc_lands_in_mailbox(pair):
+    a, b = pair
+    assert a.protocol.message(b.seed, "hello", "greetings from ma")
+    inbox = b.sb.messages.inbox("admin")
+    assert inbox and inbox[0]["subject"] == "hello"
+    assert "ma" in inbox[0]["from"]
+    # empty messages are refused
+    assert not a.protocol.message(b.seed, "x", "")
+
+
+def test_profile_rpc(pair):
+    a, b = pair
+    b.sb.config.set("profile.comment", "a tpu peer")
+    prof = a.protocol.profile(b.seed)
+    assert prof["nickname"] == "mb"
+    assert prof["comment"] == "a tpu peer"
+
+
+class _FakeGateway:
+    pass
+
+
+class _FakeDriver:
+    def __init__(self, has_gw=True):
+        self.gw = _FakeGateway() if has_gw else None
+        self.mapped = {}
+
+    def discover(self):
+        return self.gw
+
+    def add_port_mapping(self, gw, port, proto, desc):
+        self.mapped[port] = proto
+        return True
+
+    def delete_port_mapping(self, gw, port, proto):
+        return self.mapped.pop(port, None) is not None
+
+
+def test_upnp_lifecycle():
+    no_driver = UPnP()
+    assert not no_driver.available()
+    assert not no_driver.add_port_mapping(8090)
+
+    u = UPnP(_FakeDriver())
+    assert u.available()
+    assert u.add_port_mapping(8090)
+    assert u.mapped_ports == {8090}
+    u.delete_port_mappings()
+    assert u.mapped_ports == set()
+
+
+def test_release_manager():
+    page = ("<a href='yacy_tpu_v0.1.0-100.tar.gz'>old</a>"
+            "<a href='yacy_tpu_v9.9.9-123.tar.gz'>new</a>"
+            "<a href='unrelated-1.2.tar.gz'>x</a>")
+    rm = ReleaseManager(["http://updates.test/releases"],
+                        fetcher=lambda url: page)
+    rels = rm.scan()
+    assert [r.version for r in rels] == ["0.1.0", "9.9.9"]
+    newest = rm.newer_than_current()
+    assert newest is not None and newest.version == "9.9.9"
+    assert newest.url.endswith("yacy_tpu_v9.9.9-123.tar.gz")
+    # zero-egress default: no fetcher -> no updates, no crash
+    assert ReleaseManager(["http://x"]).newer_than_current() is None
+    # a higher REV of the CURRENT version is also an update
+    from yacy_search_server_tpu import yacy as launcher
+    page2 = f"<a href='yacy_tpu_v{launcher.VERSION}-{launcher.REVISION + 1}.tar.gz'>r</a>"
+    rm2 = ReleaseManager(["http://updates.test/"], fetcher=lambda u: page2)
+    got = rm2.newer_than_current()
+    assert got is not None and got.rev == launcher.REVISION + 1

@@ -1,0 +1,77 @@
+"""M17 — URL proxy servlet: rewrite, blacklist, transparent indexing."""
+
+import urllib.request
+from urllib.parse import quote
+
+import pytest
+
+SITE = {
+    "http://prox.test/": (200, {"content-type": "text/html"},
+        b"<html><body><a href='/next.html'>next</a>"
+        b"<a href=\"http://other.test/x\">abs</a>"
+        b"<a href='#frag'>frag</a>"
+        b"<img src='/i.png'/> proxyword content</body></html>"),
+    "http://prox.test/next.html": (200, {"content-type": "text/html"},
+        b"<html><body>second page proxyword</body></html>"),
+}
+
+
+@pytest.fixture(scope="module")
+def proxy_server(tmp_path_factory):
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    from yacy_search_server_tpu.switchboard import Switchboard
+    tmp = tmp_path_factory.mktemp("proxy")
+    sb = Switchboard(data_dir=str(tmp / "DATA"),
+                     transport=lambda url, headers: SITE.get(
+                         url, (404, {}, b"")))
+    sb.latency.min_delta_s = 0.0
+    srv = YaCyHttpServer(sb, port=0).start()
+    # default-off: enabling is the operator's explicit choice
+    with urllib.request.urlopen(
+            srv.base_url + "/proxy.html?url=http://prox.test/",
+            timeout=10) as r:
+        assert b"disabled" in r.read()
+    sb.config.set("proxyURL", "true")
+    yield sb, srv
+    srv.close()
+    sb.close()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(srv.base_url + path, timeout=10) as r:
+        return r.read().decode("utf-8")
+
+
+def test_proxy_rewrites_links(proxy_server):
+    sb, srv = proxy_server
+    body = _get(srv, "/proxy.html?url=" + quote("http://prox.test/", safe=""))
+    assert "proxyword" in body
+    # relative + absolute links re-routed through the proxy; fragments kept
+    assert "/proxy.html?url=" + quote("http://prox.test/next.html",
+                                      safe="") in body
+    assert "/proxy.html?url=" + quote("http://other.test/x", safe="") in body
+    assert "href='#frag'" in body
+    # navigation through a rewritten link works end-to-end
+    body2 = _get(srv, "/proxy.html?url="
+                 + quote("http://prox.test/next.html", safe=""))
+    assert "second page" in body2
+
+
+def test_proxy_rejects_and_blacklists(proxy_server):
+    sb, srv = proxy_server
+    assert "invalid url" in _get(srv, "/proxy.html?url=ftp://x")
+    sb.blacklist.add("default", "blocked.test/.*", types={"proxy"})
+    assert "blocked by blacklist" in _get(
+        srv, "/proxy.html?url=" + quote("http://blocked.test/a", safe=""))
+    assert "upstream status 404" in _get(
+        srv, "/proxy.html?url=" + quote("http://prox.test/missing", safe=""))
+
+
+def test_proxy_transparent_indexing(proxy_server):
+    sb, srv = proxy_server
+    sb.config.set("proxyindexing", "true")
+    _get(srv, "/proxy.html?url=" + quote("http://prox.test/next.html",
+                                         safe=""))
+    sb.flush_pipeline()
+    ev = sb.search("proxyword")
+    assert any("next.html" in r.url for r in ev.results())

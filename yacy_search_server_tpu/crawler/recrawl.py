@@ -1,0 +1,82 @@
+"""Recrawl — periodic re-fetch of stale indexed documents.
+
+Capability equivalent of the reference's recrawl machinery (reference:
+source/net/yacy/crawler/RecrawlBusyThread.java — a busy thread that
+queries the fulltext for documents whose load date passed a staleness
+horizon and stacks them back onto the frontier — and the autocrawl
+startup path Switchboard.initAutocrawl). Selection here is a columnar
+scan over load_date_days (one vectorized compare instead of a Solr
+query), feeding the normal admission pipeline so robots/blacklist checks
+re-apply.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .request import Request
+
+DEFAULT_STALE_AGE_DAYS = 30
+DEFAULT_CHUNK = 100
+
+
+class RecrawlJob:
+    def __init__(self, segment, stacker, profile_handle: str,
+                 stale_age_days: int = DEFAULT_STALE_AGE_DAYS,
+                 chunk: int = DEFAULT_CHUNK):
+        self.segment = segment
+        self.stacker = stacker
+        self.profile_handle = profile_handle
+        self.stale_age_days = stale_age_days
+        self.chunk = chunk
+        self.stacked_total = 0
+        # rolling cursor so successive rounds cover the whole index
+        self._cursor = 0
+        # a doc stays "stale" in metadata until its re-fetch lands; the
+        # cooldown stops the job from re-stacking it every round meanwhile
+        self.cooldown_s = 3600.0
+        self._recently: dict[int, float] = {}
+
+    def _stale_docids(self, today_days: int) -> list[int]:
+        meta = self.segment.metadata
+        n = meta.capacity()
+        if n == 0:
+            return []
+        load_days = meta.int_column("load_date_days_i")[:n]
+        alive = meta.alive_mask()[:n]
+        stale = alive & (load_days > 0) \
+            & (load_days <= today_days - self.stale_age_days)
+        ids = np.nonzero(stale)[0]
+        if len(ids) == 0:
+            return []
+        # resume after the cursor; wrap around
+        pos = np.searchsorted(ids, self._cursor)
+        ordered = np.concatenate([ids[pos:], ids[:pos]])
+        return ordered[: self.chunk].tolist()
+
+    def job(self) -> bool:
+        """One recrawl round (BusyThread contract: True = did work)."""
+        today = int(time.time() // 86400)
+        docids = self._stale_docids(today)
+        if not docids:
+            return False
+        now = time.time()
+        self._recently = {d: t for d, t in self._recently.items()
+                          if now - t < self.cooldown_s}
+        stacked = 0
+        for docid in docids:
+            if docid in self._recently:
+                continue
+            url = self.segment.metadata.text_value(docid, "sku")
+            if not url:
+                continue
+            self._recently[docid] = now
+            reason = self.stacker.stack(Request(
+                url=url, profile_handle=self.profile_handle, depth=0))
+            if reason is None:
+                stacked += 1
+        self._cursor = docids[-1] + 1
+        self.stacked_total += stacked
+        return stacked > 0

@@ -1,0 +1,130 @@
+"""Snapshots — page revision archive with an inventory/archive state machine.
+
+Capability equivalent of the reference's snapshot subsystem (reference:
+source/net/yacy/crawler/data/Snapshots.java:61 — revisions stored under
+SNAPSHOTS/<state>/<hosthash>/<depth>/<urlhash>.<date>.* — and
+Transactions.java:57-247 — the INVENTORY/ARCHIVE state machine where
+fresh snapshots land in INVENTORY, may be replaced by newer loads, and
+`commit` moves a revision to ARCHIVE permanently). The reference shells
+out to wkhtmltopdf/convert for PDF/image renditions; here the archived
+rendition is the loaded content itself (the framework never shells out),
+which keeps every revision queryable and diffable.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..utils.hashes import hosthash, url2hash
+
+INVENTORY = "INVENTORY"
+ARCHIVE = "ARCHIVE"
+
+
+class Snapshots:
+    def __init__(self, data_dir: str | None = None):
+        self.data_dir = data_dir
+        if data_dir:
+            for state in (INVENTORY, ARCHIVE):
+                os.makedirs(os.path.join(data_dir, state), exist_ok=True)
+
+    def _dir(self, state: str, urlhash: bytes, depth: int) -> str | None:
+        if not self.data_dir:
+            return None
+        hh = hosthash(urlhash).decode("ascii", "replace")
+        return os.path.join(self.data_dir, state, hh, str(depth))
+
+    @staticmethod
+    def _fname(urlhash: bytes, date_s: float, ext: str) -> str:
+        stamp = time.strftime("%Y%m%d%H%M%S", time.gmtime(date_s))
+        return f"{urlhash.decode('ascii', 'replace')}.{stamp}.{ext}"
+
+    # -- store/load -----------------------------------------------------------
+
+    def store(self, url: str, content: bytes, depth: int = 0,
+              date_s: float | None = None, ext: str = "html",
+              state: str = INVENTORY, replace_inventory: bool = True) -> str | None:
+        """Store one revision; INVENTORY keeps only the newest revision per
+        url (replaceable working copy), ARCHIVE accumulates (permanent)."""
+        uh = url2hash(url)
+        d = self._dir(state, uh, depth)
+        if d is None:
+            return None
+        os.makedirs(d, exist_ok=True)
+        if state == INVENTORY and replace_inventory:
+            for old in self._revision_files(INVENTORY, uh):
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+        path = os.path.join(d, self._fname(
+            uh, date_s if date_s is not None else time.time(), ext))
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(content)
+        os.replace(tmp, path)
+        return path
+
+    def _revision_files(self, state: str, urlhash: bytes) -> list[str]:
+        if not self.data_dir:
+            return []
+        hh = hosthash(urlhash).decode("ascii", "replace")
+        base = os.path.join(self.data_dir, state, hh)
+        prefix = urlhash.decode("ascii", "replace") + "."
+        out = []
+        if not os.path.isdir(base):
+            return out
+        for depth in os.listdir(base):
+            dd = os.path.join(base, depth)
+            if not os.path.isdir(dd):
+                continue
+            for fn in os.listdir(dd):
+                if fn.startswith(prefix) and not fn.endswith(".tmp"):
+                    out.append(os.path.join(dd, fn))
+        return sorted(out)
+
+    def revisions(self, url: str, state: str | None = None) -> list[str]:
+        uh = url2hash(url)
+        states = (state,) if state else (INVENTORY, ARCHIVE)
+        return [p for s in states for p in self._revision_files(s, uh)]
+
+    def load(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    # -- state machine (Transactions semantics) -------------------------------
+
+    def commit(self, url: str) -> int:
+        """Move every INVENTORY revision of `url` to ARCHIVE (permanent).
+        Returns revisions moved (Transactions.commit)."""
+        uh = url2hash(url)
+        moved = 0
+        for src in self._revision_files(INVENTORY, uh):
+            rel = os.path.relpath(src, os.path.join(self.data_dir, INVENTORY))
+            dst = os.path.join(self.data_dir, ARCHIVE, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            os.replace(src, dst)
+            moved += 1
+        return moved
+
+    def delete(self, url: str, state: str | None = None) -> int:
+        uh = url2hash(url)
+        states = (state,) if state else (INVENTORY, ARCHIVE)
+        n = 0
+        for s in states:
+            for p in self._revision_files(s, uh):
+                try:
+                    os.remove(p)
+                    n += 1
+                except OSError:
+                    pass
+        return n
+
+    def size(self, state: str) -> int:
+        if not self.data_dir:
+            return 0
+        n = 0
+        for _root, _dirs, files in os.walk(os.path.join(self.data_dir, state)):
+            n += sum(1 for f in files if not f.endswith(".tmp"))
+        return n

@@ -60,7 +60,7 @@ class Condenser:
     """Single-pass condensation of one Document."""
 
     def __init__(self, doc: Document, index_text: bool = True,
-                 index_media: bool = True):
+                 index_media: bool = True, synonyms=None):
         self.doc = doc
         self.words: dict[str, WordStat] = {}
         self.content_flags = Bitfield()
@@ -68,6 +68,26 @@ class Condenser:
         self.phrase_count = 0
         self._zone_extra = 0  # zone-only words, counted apart from the body
         self._condense(index_text, index_media)
+        if synonyms is not None:
+            self._enrich_synonyms(synonyms)
+
+    def _enrich_synonyms(self, synonyms) -> None:
+        """Index the document under synonym terms too (reference:
+        Condenser applies LibraryProvider synonym dictionaries so one
+        group member makes the doc findable under all of them). Synonym
+        entries inherit the source word's stats."""
+        if not synonyms.has_entries():
+            return      # empty library: skip the per-word lock round-trips
+        extra: dict[str, WordStat] = {}
+        for w, st in self.words.items():
+            for syn in synonyms.synonyms_of(w):
+                if syn not in self.words and syn not in extra:
+                    extra[syn] = WordStat(
+                        count=st.count, posintext=st.posintext,
+                        posinphrase=st.posinphrase,
+                        posofphrase=st.posofphrase,
+                        flags=Bitfield(st.flags.value))
+        self.words.update(extra)
 
     # -- core pass -----------------------------------------------------------
 

@@ -1,0 +1,69 @@
+"""Synonym library — indexing-time term expansion.
+
+Capability equivalent of the reference's synonym machinery (reference:
+source/net/yacy/document/LibraryProvider.java loading synonym
+dictionaries from DATA/DICTIONARIES/synonyms/*, and Condenser.java:
+applying them so a document containing one member of a synonym group is
+also findable under the others). Dictionary format: one comma-separated
+group per line ("car,automobile,vehicle"); lookups are symmetric within
+a group.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class SynonymLibrary:
+    def __init__(self, data_dir: str | None = None):
+        self._groups: dict[str, set[str]] = {}
+        self._lock = threading.Lock()
+        self.data_dir = data_dir
+        if data_dir and os.path.isdir(data_dir):
+            for fn in sorted(os.listdir(data_dir)):
+                if fn.endswith((".txt", ".csv")):
+                    try:
+                        with open(os.path.join(data_dir, fn),
+                                  encoding="utf-8") as f:
+                            self.load_text(f.read())
+                    except OSError:
+                        continue
+
+    def load_text(self, text: str) -> int:
+        groups = 0
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            words = {w.strip().lower() for w in line.split(",") if w.strip()}
+            if len(words) < 2:
+                continue
+            self.add_group(words)
+            groups += 1
+        return groups
+
+    def add_group(self, words: set[str]) -> None:
+        with self._lock:
+            # merge with any group a member already belongs to
+            merged = set(words)
+            for w in words:
+                old = self._groups.get(w)
+                if old is not None:
+                    merged |= old
+            for w in merged:
+                self._groups[w] = merged
+
+    def has_entries(self) -> bool:
+        return bool(self._groups)
+
+    def synonyms_of(self, word: str) -> set[str]:
+        """Other members of the word's group ('' set when unknown)."""
+        w = word.lower()
+        with self._lock:
+            group = self._groups.get(w)
+            return (group - {w}) if group else set()
+
+    def size(self) -> int:
+        with self._lock:
+            return len({id(g) for g in self._groups.values()})

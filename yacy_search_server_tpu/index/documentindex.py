@@ -1,0 +1,100 @@
+"""DocumentIndex — the "just index these files" mini API.
+
+Capability equivalent of the reference's embedded indexing helper
+(reference: source/net/yacy/search/index/DocumentIndex.java:57 — a
+Segment wrapper with a small queue + worker threads that parses local
+files/URLs through TextParser and makes them searchable, used by tests
+and desktop-search style tools without a crawler)."""
+
+from __future__ import annotations
+
+import mimetypes
+import os
+import queue
+import threading
+
+from ..document.parser import ParserError, parse_source
+from .segment import Segment
+
+
+class DocumentIndex:
+    def __init__(self, segment: Segment | None = None, workers: int = 2):
+        self.segment = segment or Segment()
+        self._q: queue.Queue = queue.Queue()
+        self._errors: list[tuple[str, str]] = []
+        self._done = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"docindex-{i}")
+            for i in range(workers)]
+        for t in self._threads:
+            t.start()
+
+    # -- feeding --------------------------------------------------------------
+
+    def add_file(self, path: str) -> None:
+        self._q.put(("file", path))
+
+    def add_tree(self, root: str) -> int:
+        """Queue every regular file under `root`; returns files queued."""
+        n = 0
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in files:
+                self.add_file(os.path.join(dirpath, fn))
+                n += 1
+        return n
+
+    def add_content(self, url: str, content: bytes,
+                    mime: str | None = None) -> None:
+        self._q.put(("content", (url, content, mime)))
+
+    # -- workers --------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            try:
+                self._process(item)
+            except Exception as e:      # a broken file must not kill a worker
+                self._errors.append((str(item[1])[:200], str(e)))
+            finally:
+                self._q.task_done()
+
+    def _process(self, item) -> None:
+        kind, payload = item
+        if kind == "file":
+            path = payload
+            url = "file://" + os.path.abspath(path)
+            mime = mimetypes.guess_type(path)[0] or "application/octet-stream"
+            with open(path, "rb") as f:
+                content = f.read()
+        else:
+            url, content, mime = payload
+            mime = mime or "text/html"
+        try:
+            docs = parse_source(url, mime, content, None)
+        except ParserError as e:
+            self._errors.append((url, str(e)))
+            return
+        for doc in docs:
+            self.segment.store_document(doc, collection="documentindex")
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def join(self) -> None:
+        self._q.join()
+
+    def errors(self) -> list[tuple[str, str]]:
+        return list(self._errors)
+
+    def close(self, close_segment: bool = True) -> None:
+        self.join()
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+        if close_segment:
+            self.segment.close()

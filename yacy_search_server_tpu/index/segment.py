@@ -67,6 +67,9 @@ class Segment:
         # store_document writes vocabulary facets into vocabulary_sxt
         # (the reference's vocabulary_* Solr fields from Tokenizer tagging)
         self.vocabularies = None
+        # optional synonym library (document/synonyms.py): indexing-time
+        # term expansion inside the Condenser
+        self.synonyms = None
         self._lock = threading.RLock()
 
     # -- write path ----------------------------------------------------------
@@ -76,7 +79,7 @@ class Segment:
         """Index one parsed document; returns its docid."""
         with StageTimer(EClass.INDEX, "storeDocument", 1):
             urlhash = url2hash(doc.url)
-            condenser = Condenser(doc)
+            condenser = Condenser(doc, synonyms=self.synonyms)
 
             vocab_sxt = ""
             if self.vocabularies is not None:

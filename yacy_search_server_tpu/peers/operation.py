@@ -1,0 +1,107 @@
+"""Node operation helpers: NAT port mapping + release update discovery.
+
+Capability equivalents of the reference's operational plumbing
+(reference: source/net/yacy/utils/upnp/UPnP.java — router port mapping
+via weupnp on startup/port change; peers/operation/yacyRelease.java —
+signed release discovery from configured update locations with an
+auto-update policy, and yacyUpdateLocation.java). Both are gated
+best-effort subsystems here: UPnP uses an injectable SSDP/SOAP driver
+(this image has zero egress, so the default driver reports unavailable
+without network IO), and release discovery parses a release table from
+an update location via an injectable fetcher.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .. import yacy as _launcher
+
+
+class UPnP:
+    """Router port mapping, best-effort (UPnP.java semantics)."""
+
+    def __init__(self, driver=None):
+        # driver: object with discover() -> gateway|None,
+        #         add_port_mapping(gw, port, proto, desc) -> bool,
+        #         delete_port_mapping(gw, port, proto) -> bool
+        self.driver = driver
+        self.mapped_ports: set[int] = set()
+
+    def available(self) -> bool:
+        return self.driver is not None and self.driver.discover() is not None
+
+    def add_port_mapping(self, port: int) -> bool:
+        if self.driver is None:
+            return False
+        gw = self.driver.discover()
+        if gw is None:
+            return False
+        ok = self.driver.add_port_mapping(gw, port, "TCP", "yacy-tpu")
+        if ok:
+            self.mapped_ports.add(port)
+        return ok
+
+    def delete_port_mappings(self) -> None:
+        if self.driver is None:
+            return
+        gw = self.driver.discover()
+        if gw is None:
+            return
+        for port in list(self.mapped_ports):
+            if self.driver.delete_port_mapping(gw, port, "TCP"):
+                self.mapped_ports.discard(port)
+
+
+_RELEASE_RE = re.compile(
+    r"yacy_tpu_v(?P<version>\d+(?:\.\d+)*)[-_](?P<rev>\d+)\.(?:tar\.gz|whl)")
+
+
+class Release:
+    def __init__(self, version: str, rev: int, url: str):
+        self.version = version
+        self.rev = rev
+        self.url = url
+
+    def version_tuple(self) -> tuple[int, ...]:
+        return tuple(int(p) for p in self.version.split("."))
+
+    def __repr__(self):
+        return f"Release({self.version}-{self.rev})"
+
+
+class ReleaseManager:
+    """Update-location scan + newer-release decision (yacyRelease.java).
+
+    `fetcher(url) -> str|None` supplies the release index page; with no
+    fetcher (zero-egress deployments) every check reports 'no update'."""
+
+    def __init__(self, update_locations: list[str] | None = None,
+                 fetcher=None):
+        self.update_locations = update_locations or []
+        self.fetcher = fetcher
+
+    def scan(self) -> list[Release]:
+        releases: list[Release] = []
+        if self.fetcher is None:
+            return releases
+        for loc in self.update_locations:
+            try:
+                page = self.fetcher(loc)
+            except Exception:
+                continue
+            if not page:
+                continue
+            for m in _RELEASE_RE.finditer(page):
+                releases.append(Release(
+                    m.group("version"), int(m.group("rev")),
+                    loc.rstrip("/") + "/" + m.group(0)))
+        releases.sort(key=lambda r: (r.version_tuple(), r.rev))
+        return releases
+
+    def newer_than_current(self) -> Release | None:
+        cur = (tuple(int(p) for p in _launcher.VERSION.split(".")),
+               _launcher.REVISION)
+        candidates = [r for r in self.scan()
+                      if (r.version_tuple(), r.rev) > cur]
+        return candidates[-1] if candidates else None

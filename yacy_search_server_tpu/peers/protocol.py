@@ -199,6 +199,24 @@ class Protocol:
             reply = {**reply, **reply2}
         return True, reply
 
+    # -- messages + profile ---------------------------------------------------
+
+    def message(self, target: Seed, subject: str, content: str) -> bool:
+        """Deliver a peer-to-peer message into the target's mailbox
+        (reference: htroot/yacy/message.java; Protocol message call).
+        The sender identity is my seed hash/name."""
+        my = self.seeddb.my_seed
+        ok, reply = self._call(target, "message", {
+            "from": my.hash.decode("ascii", "replace"),
+            "fromname": my.name, "subject": subject, "content": content})
+        return ok and reply.get("result") == "ok"
+
+    def profile(self, target: Seed) -> dict:
+        """Fetch a peer's operator profile (htroot/yacy/profile.java;
+        Protocol.getProfile:1992)."""
+        ok, reply = self._call(target, "profile", {})
+        return reply.get("profile", {}) if ok else {}
+
     # -- remote crawl delegation ---------------------------------------------
 
     def pull_crawl_urls(self, target: Seed, count: int = 10) -> list[dict]:

@@ -196,6 +196,40 @@ class PeerServer:
         self.received_url_count += stored
         return {"result": "ok", "stored": stored}
 
+    # -- messages + profile ---------------------------------------------------
+
+    MAX_MESSAGE_SIZE = 32_768
+    MAX_MAILBOX_SIZE = 1000
+
+    def do_message(self, payload: dict) -> dict:
+        """Accept a peer message into the local mailbox (message.java).
+        Addressed to the operator ('admin'), sender recorded as
+        'name (hash)' so replies can route. Gated: the operator can turn
+        messaging off, and a full mailbox refuses further inserts
+        (message.java checks acceptance + advertised size first)."""
+        if not self.sb.config.get_bool("messages.accept", True):
+            return {"result": "rejected", "reason": "not accepted"}
+        subject = str(payload.get("subject", ""))[:256]
+        content = str(payload.get("content", ""))[:self.MAX_MESSAGE_SIZE]
+        sender = f"{payload.get('fromname', '?')} ({payload.get('from', '')})"
+        if not content:
+            return {"result": "rejected", "reason": "empty"}
+        if len(self.sb.messages.inbox("admin")) >= self.MAX_MAILBOX_SIZE:
+            return {"result": "rejected", "reason": "mailbox full"}
+        self.sb.messages.send("admin", sender, subject, content)
+        return {"result": "ok"}
+
+    def do_profile(self, payload: dict) -> dict:
+        """Operator profile (profile.java) — config-backed key/value set."""
+        cfg = self.sb.config
+        return {"profile": {
+            "name": cfg.get("promoteSearchPageGreeting", ""),
+            "nickname": self.seeddb.my_seed.name,
+            "homepage": cfg.get("profile.homepage", ""),
+            "email": cfg.get("profile.email", ""),
+            "comment": cfg.get("profile.comment", ""),
+        }}
+
     # -- remote crawl delegation ---------------------------------------------
 
     def do_urls(self, payload: dict) -> dict:

@@ -1,0 +1,95 @@
+"""URL proxy servlet — browse through the node, optionally indexing.
+
+Capability equivalent of the reference's proxy surface (reference:
+source/net/yacy/http/servlets/UrlProxyServlet.java — /proxy.html?url=…
+fetches the page through the node, rewrites links so navigation stays
+inside the proxy, and hands the content to the indexer when
+`proxyindexing` is enabled; the transparent variant lives in
+server/http/HTTPDProxyHandler.java). The fetch goes through the normal
+LoaderDispatcher, so the page cache, politeness and blacklist all apply.
+"""
+
+from __future__ import annotations
+
+import re
+from urllib.parse import quote, urljoin
+
+from ...crawler.loader import CacheStrategy
+from ...crawler.request import Request
+from ..objects import ServerObjects
+from . import servlet
+
+_HREF_RE = re.compile(
+    rb"""(\b(?:href|src|action)\s*=\s*)(["'])(.*?)\2""",
+    re.IGNORECASE | re.DOTALL)
+
+
+def _rewrite_html(content: bytes, base_url: str) -> bytes:
+    """Point every link back through /proxy.html so navigation stays
+    proxied (UrlProxyServlet's directory rewrite)."""
+
+    def repl(m: re.Match) -> bytes:
+        attr, q, target = m.group(1), m.group(2), m.group(3)
+        t = target.decode("utf-8", "replace").strip()
+        if t.startswith(("javascript:", "data:", "mailto:", "#")):
+            return m.group(0)
+        absolute = urljoin(base_url, t)
+        return attr + q + f"/proxy.html?url={quote(absolute, safe='')}" \
+            .encode("ascii") + q
+
+    return _HREF_RE.sub(repl, content)
+
+
+@servlet("proxy")
+def respond_proxy(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    # the proxy is OFF unless the operator enables it (the reference only
+    # mounts UrlProxyServlet when the proxy feature is switched on) — an
+    # always-on unauthenticated fetcher would be an open SSRF surface
+    if not sb.config.get_bool("proxyURL", False):
+        prop.raw_body = "<b>proxy: disabled (set proxyURL=true)</b>"
+        prop.raw_ctype = "text/html; charset=utf-8"
+        return prop
+    url = post.get("url", "")
+    if not url.startswith(("http://", "https://")):
+        prop.raw_body = "<b>proxy: missing or invalid url parameter</b>"
+        prop.raw_ctype = "text/html; charset=utf-8"
+        return prop
+    if sb.blacklist.is_listed("proxy", url):
+        prop.raw_body = "<b>proxy: url blocked by blacklist</b>"
+        prop.raw_ctype = "text/html; charset=utf-8"
+        return prop
+    try:
+        resp = sb.loader.load(Request(url), CacheStrategy.IFFRESH)
+    except Exception as e:
+        prop.raw_body = f"<b>proxy: load failed: {e}</b>"
+        prop.raw_ctype = "text/html; charset=utf-8"
+        return prop
+    if resp.status != 200:
+        prop.raw_body = f"<b>proxy: upstream status {resp.status}</b>"
+        prop.raw_ctype = "text/html; charset=utf-8"
+        return prop
+
+    mime = resp.mime_type()       # parameters stripped; charset() has them
+    body = resp.content
+    if "html" in mime:
+        body = _rewrite_html(body, url)
+    # transparent indexing (HTTPDProxyHandler's proxy-crawl): hand the
+    # loaded page to the indexing pipeline when enabled
+    if sb.config.get_bool("proxyindexing", False):
+        profile = next((p for p in sb.profiles.values()
+                        if p.name == "proxy"), None)
+        if profile is None:
+            from ...crawler.profile import CrawlProfile
+            profile = sb.add_profile(CrawlProfile(
+                "proxy", store_ht_cache=True,
+                recrawl_if_older_s=7 * 24 * 3600))
+        sb.to_indexer(resp, profile)
+    prop.raw_body = body
+    if mime.startswith("text/") or "html" in mime or "xml" in mime:
+        # preserve the upstream charset — re-labeling shift_jis etc. as
+        # utf-8 would render mojibake
+        prop.raw_ctype = f"{mime}; charset={resp.charset() or 'utf-8'}"
+    else:
+        prop.raw_ctype = mime
+    return prop

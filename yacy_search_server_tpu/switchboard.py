@@ -111,6 +111,13 @@ class Switchboard:
         from .document.vocabulary import TripleStore, VocabularyLibrary
         self.vocabularies = VocabularyLibrary(sub("DICTIONARIES"))
         self.index.vocabularies = self.vocabularies
+        from .document.synonyms import SynonymLibrary
+        syn_dir = os.path.join(data_dir, "DICTIONARIES", "synonyms") \
+            if data_dir else None
+        self.synonyms = SynonymLibrary(syn_dir)
+        self.index.synonyms = self.synonyms
+        from .crawler.snapshots import Snapshots
+        self.snapshots = Snapshots(sub("SNAPSHOTS"))
         self.triplestore = TripleStore(
             os.path.join(data_dir, "triplestore.jsonl") if data_dir else None)
         self.content_control = ContentControl(self.bookmarks)
@@ -236,9 +243,19 @@ class Switchboard:
 
     def _stage_store(self, entry: IndexingEntry):
         with StageTimer(EClass.INDEX, "storeDocumentIndex", 1):
+            req = entry.response.request
+            # snapshot the loaded rendition when the profile asks for it
+            # (Transactions.store on the indexing path)
+            if 0 <= req.depth <= entry.profile.snapshot_depth:
+                try:
+                    self.snapshots.store(entry.response.url,
+                                         entry.response.content,
+                                         depth=req.depth)
+                except OSError:
+                    pass
             for doc in entry.documents:
                 self.index.store_document(
-                    doc, crawldepth=entry.response.request.depth,
+                    doc, crawldepth=req.depth,
                     collection=entry.profile.collections[0])
                 self.indexed_count += 1
             return None
@@ -365,6 +382,20 @@ class Switchboard:
         self.threads.deploy(BusyThread(
             "25_contentcontrol", self._content_control_job,
             idle_sleep_s=30.0, busy_sleep_s=5.0))
+
+        if self.config.get_bool("recrawl.enabled", False):
+            from .crawler.recrawl import RecrawlJob
+            stale_days = self.config.get_int("recrawl.staleAgeDays", 30)
+            prof = CrawlProfile(
+                "recrawl", recrawl_if_older_s=stale_days * 86400,
+                store_ht_cache=False)
+            self.add_profile(prof)
+            self._recrawl = RecrawlJob(self.index, self.crawl_stacker,
+                                       prof.handle,
+                                       stale_age_days=stale_days)
+            self.threads.deploy(BusyThread(
+                "60_recrawl", self._recrawl.job,
+                idle_sleep_s=120.0, busy_sleep_s=5.0))
 
     def _content_control_job(self) -> bool:
         changed = self.content_control.update_filter_job()

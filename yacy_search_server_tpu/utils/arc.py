@@ -1,0 +1,71 @@
+"""ARC cache — recency + frequency segmented cache.
+
+Capability equivalent of the reference's ARC family (reference:
+source/net/yacy/cora/storage/SimpleARC.java / HashARC / ComparableARC /
+ConcurrentARC — two-level caches where a hit in the recency level
+promotes to the frequency level, each level LRU-bounded to half the
+cache size; used for DNS, digest, and search-result caches). Backed by
+ordered dicts; thread-safe.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable
+
+
+class ARCCache:
+    def __init__(self, max_size: int = 1024):
+        self.level_size = max(1, max_size // 2)
+        self._a: OrderedDict[Hashable, Any] = OrderedDict()  # recency
+        self._b: OrderedDict[Hashable, Any] = OrderedDict()  # frequency
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def put(self, key: Hashable, value: Any) -> None:
+        with self._lock:
+            if key in self._b:
+                self._b[key] = value
+                self._b.move_to_end(key)
+                return
+            self._a[key] = value
+            self._a.move_to_end(key)
+            while len(self._a) > self.level_size:
+                self._a.popitem(last=False)
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        with self._lock:
+            if key in self._b:
+                self._b.move_to_end(key)
+                self.hits += 1
+                return self._b[key]
+            if key in self._a:
+                # second access: promote recency -> frequency
+                value = self._a.pop(key)
+                self._b[key] = value
+                while len(self._b) > self.level_size:
+                    self._b.popitem(last=False)
+                self.hits += 1
+                return value
+            self.misses += 1
+            return default
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._a or key in self._b
+
+    def remove(self, key: Hashable) -> None:
+        with self._lock:
+            self._a.pop(key, None)
+            self._b.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._a.clear()
+            self._b.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._a) + len(self._b)

@@ -23,6 +23,7 @@ import threading
 import time
 
 VERSION = "0.2.0"
+REVISION = 0        # build counter within a version (release comparison)
 
 DEFAULT_PORT = 8090
 
