@@ -27,7 +27,14 @@ def test_snapshots_inventory_replace_and_commit(tmp_path):
     s.store(url, b"rev three", depth=1, date_s=3000.0)
     assert s.commit(url) == 1
     assert len(s.revisions(url, ARCHIVE)) == 2      # archive accumulates
-    assert s.delete(url) == 2
+    # same-second revisions must never overwrite an archived one
+    s.store(url, b"same second A", depth=1, date_s=3000.0)
+    assert s.commit(url) == 1
+    archived = s.revisions(url, ARCHIVE)
+    assert len(archived) == 3
+    assert {s.load(p) for p in archived} == {b"rev two", b"rev three",
+                                             b"same second A"}
+    assert s.delete(url) == 3
     assert s.revisions(url) == []
 
 

@@ -1,0 +1,70 @@
+"""Gazetteer geolocalization + event-feed RSS channels."""
+
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.document.geolocalization import Gazetteer
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.utils.bitfield import FLAG_CAT_HASLOCATION
+
+
+def test_gazetteer_lookup_and_ranking():
+    g = Gazetteer()
+    g.load_text("Berlin,52.52,13.40,3600000\n"
+                "New York,40.71,-74.00,8400000\n"
+                "Paris,48.85,2.35,2100000\n"
+                "Paris,33.66,-95.55,25000\n"     # the small Texas one loses
+                "# comment line\nbadline\n")
+    assert g.size() == 3
+    assert g.find("berlin") == (52.52, 13.40)
+    assert g.find("paris") == (48.85, 2.35)
+    # bigram match + most-populous-wins across the text
+    hit = g.locate_text("flights from Paris to New York daily")
+    assert hit == (40.71, -74.00)
+    assert g.locate_text("no places here") is None
+
+
+def test_gazetteer_fills_document_location():
+    g = Gazetteer()
+    g.load_text("Heidelberg,49.40,8.69,160000\n")
+    seg = Segment()
+    seg.gazetteer = g
+    docid = seg.store_document(Document(
+        url="http://geo.test/a.html", title="Visit Heidelberg",
+        text="the castle of heidelberg is famous"))
+    m = seg.metadata.get(docid)
+    assert m.get("lat_d") == pytest.approx(49.40)
+    assert m.get("lon_d") == pytest.approx(8.69)
+    # the HASLOCATION content flag lit up (condenser saw the lat/lon)
+    assert (m.get("flags_i") >> FLAG_CAT_HASLOCATION) & 1
+    seg.close()
+
+
+@pytest.fixture(scope="module")
+def feed_server(tmp_path_factory):
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    from yacy_search_server_tpu.switchboard import Switchboard
+    tmp = tmp_path_factory.mktemp("feed")
+    sb = Switchboard(data_dir=str(tmp / "DATA"))
+    sb.index.store_document(Document(url="http://f.test/x.html",
+                                     title="F", text="feedword content"))
+    sb.search("feedword")
+    srv = YaCyHttpServer(sb, port=0).start()
+    yield sb, srv
+    srv.close()
+    sb.close()
+
+
+def test_feed_channels(feed_server):
+    sb, srv = feed_server
+    with urllib.request.urlopen(srv.base_url + "/feed.rss?set=LOCALSEARCH",
+                                timeout=10) as r:
+        assert "rss+xml" in r.headers["Content-Type"]
+        body = r.read().decode("utf-8")
+    assert "<rss" in body and "query: feedword" in body
+    with urllib.request.urlopen(srv.base_url + "/feed.rss?set=INDEX",
+                                timeout=10) as r:
+        body = r.read().decode("utf-8")
+    assert "indexed documents: 1" in body

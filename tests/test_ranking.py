@@ -207,3 +207,37 @@ def test_bm25_invalid_rows_never_win():
                        jnp.asarray(valid), jnp.arange(n, dtype=jnp.int32), 5)
     assert set(np.asarray(d)[:3]) == {0, 1, 2}
     assert np.isinf(np.asarray(s)[3:]).all()
+
+
+def test_compact16_scores_bit_identical():
+    """Compact int16 block + exact fast division == int32 path exactly."""
+    import jax.numpy as jnp
+    plist = _rand_plist(800, seed=9)
+    # include values near the int16 boundary and big flags
+    plist.feats[:5, P.F_POSINTEXT] = 32767
+    plist.feats[5:10, P.F_FLAGS] = (1 << 30) - 1
+    prof = R.RankingProfile()
+    r = R.CardinalRanker(prof, "en")
+    n = len(plist)
+    valid = jnp.ones(n, bool)
+    hi = jnp.zeros(n, jnp.int32)
+    want = np.asarray(R.cardinal_scores(
+        jnp.asarray(plist.feats), valid, hi, r._norm, r._bits, r._shifts,
+        r._dl, r._tf, r._lang_c, r._auth, r._lang))
+    f16, flags = R.compact_feats(plist.feats)
+    got = np.asarray(R.cardinal_scores16(
+        jnp.asarray(f16), jnp.asarray(flags), valid, hi, None,
+        r._norm, r._bits, r._shifts, r._dl, r._tf, r._lang_c, r._auth,
+        r._lang))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_compact_feats_clipping_and_flags():
+    feats = np.zeros((3, P.NF), np.int32)
+    feats[0, P.F_WORDS_IN_TEXT] = 1_000_000     # clips to 32767
+    feats[1, P.F_FLAGS] = (1 << 29) | 5         # preserved exactly
+    f16, flags = R.compact_feats(feats)
+    assert f16.dtype == np.int16
+    assert f16[0, P.F_WORDS_IN_TEXT] == 32767
+    assert (f16[:, P.F_FLAGS] == 0).all()
+    assert flags[1] == (1 << 29) | 5

@@ -60,11 +60,24 @@ class Snapshots:
                     pass
         path = os.path.join(d, self._fname(
             uh, date_s if date_s is not None else time.time(), ext))
+        path = self._uncollide(path)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(content)
         os.replace(tmp, path)
         return path
+
+    @staticmethod
+    def _uncollide(path: str) -> str:
+        """Archived revisions are permanent: a same-second revision must
+        get a fresh name, never overwrite."""
+        if not os.path.exists(path):
+            return path
+        base, ext = path.rsplit(".", 1)
+        i = 1
+        while os.path.exists(f"{base}-{i}.{ext}"):
+            i += 1
+        return f"{base}-{i}.{ext}"
 
     def _revision_files(self, state: str, urlhash: bytes) -> list[str]:
         if not self.data_dir:
@@ -104,7 +117,7 @@ class Snapshots:
             rel = os.path.relpath(src, os.path.join(self.data_dir, INVENTORY))
             dst = os.path.join(self.data_dir, ARCHIVE, rel)
             os.makedirs(os.path.dirname(dst), exist_ok=True)
-            os.replace(src, dst)
+            os.replace(src, self._uncollide(dst))
             moved += 1
         return moved
 

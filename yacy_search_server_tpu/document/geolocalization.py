@@ -1,0 +1,89 @@
+"""Gazetteer — place-name geolocalization for documents and queries.
+
+Capability equivalent of the reference's geo library (reference:
+source/net/yacy/cora/geo/ — GeonamesLocation/OpenGeoDBLocation load
+place-name dumps into in-memory maps; LibraryProvider wires them in, and
+document processing derives the lat/lon written into the Solr schema,
+feeding location search and the HASLOCATION content flag). Dump format
+here: CSV lines "name,lat,lon,population" under DATA/DICTIONARIES/geo/.
+Lookups are token-based; the most populous match wins (the reference
+ranks candidate locations the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+
+_TOKEN_RE = re.compile(r"\w+", re.UNICODE)
+
+
+class Gazetteer:
+    def __init__(self, data_dir: str | None = None):
+        # name (lower) -> (lat, lon, population)
+        self._places: dict[str, tuple[float, float, int]] = {}
+        self._lock = threading.Lock()
+        if data_dir and os.path.isdir(data_dir):
+            for fn in sorted(os.listdir(data_dir)):
+                if fn.endswith((".csv", ".txt")):
+                    try:
+                        with open(os.path.join(data_dir, fn),
+                                  encoding="utf-8") as f:
+                            self.load_text(f.read())
+                    except OSError:
+                        continue
+
+    def load_text(self, text: str) -> int:
+        n = 0
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(",")
+            if len(parts) < 3:
+                continue
+            try:
+                lat, lon = float(parts[1]), float(parts[2])
+                pop = int(parts[3]) if len(parts) > 3 and parts[3] else 0
+            except ValueError:
+                continue
+            self.add(parts[0], lat, lon, pop)
+            n += 1
+        return n
+
+    def add(self, name: str, lat: float, lon: float,
+            population: int = 0) -> None:
+        key = name.strip().lower()
+        if not key:
+            return
+        with self._lock:
+            old = self._places.get(key)
+            # the bigger place wins a name collision
+            if old is None or population >= old[2]:
+                self._places[key] = (lat, lon, population)
+
+    def find(self, name: str) -> tuple[float, float] | None:
+        p = self._places.get(name.strip().lower())
+        return (p[0], p[1]) if p else None
+
+    def locate_text(self, text: str,
+                    max_tokens: int = 1000) -> tuple[float, float] | None:
+        """Best (most populous) place name appearing in the text; bigrams
+        are checked so 'new york' style names match."""
+        if not self._places:
+            return None
+        tokens = [t.lower() for t in _TOKEN_RE.findall(text)[:max_tokens]]
+        best: tuple[float, float, int] | None = None
+        with self._lock:
+            for i, tok in enumerate(tokens):
+                for cand in ((tok,) if i + 1 >= len(tokens)
+                             else (tok + " " + tokens[i + 1], tok)):
+                    p = self._places.get(cand)
+                    if p is not None and (best is None or p[2] > best[2]):
+                        best = p
+        return (best[0], best[1]) if best else None
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._places)

@@ -70,6 +70,10 @@ class Segment:
         # optional synonym library (document/synonyms.py): indexing-time
         # term expansion inside the Condenser
         self.synonyms = None
+        # optional gazetteer (document/geolocalization.py): fills missing
+        # doc lat/lon from place names before condensing, so the
+        # HASLOCATION flag and lat_d/lon_d columns light up
+        self.gazetteer = None
         self._lock = threading.RLock()
 
     # -- write path ----------------------------------------------------------
@@ -79,6 +83,11 @@ class Segment:
         """Index one parsed document; returns its docid."""
         with StageTimer(EClass.INDEX, "storeDocument", 1):
             urlhash = url2hash(doc.url)
+            if self.gazetteer is not None and not doc.lat and not doc.lon:
+                hit = self.gazetteer.locate_text(
+                    f"{doc.title}\n{' '.join(doc.keywords)}\n{doc.text[:2048]}")
+                if hit is not None:
+                    doc.lat, doc.lon = hit
             condenser = Condenser(doc, synonyms=self.synonyms)
 
             vocab_sxt = ""

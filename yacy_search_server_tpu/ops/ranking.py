@@ -191,20 +191,27 @@ def _masked_minmax(feats: jnp.ndarray, valid: jnp.ndarray):
 
 
 def local_stats(feats: jnp.ndarray, valid: jnp.ndarray, hostids: jnp.ndarray,
-                num_hosts: int) -> dict:
+                num_hosts: int, with_host_counts: bool = True) -> dict:
     """Per-block normalization statistics (pure shard-local reduces).
 
     Returned stats combine across shards with (min, max, min, max, sum):
     the sharded path (parallel/mesh.py) runs this per doc-shard, merges via
     lax.pmin/pmax/psum over the mesh axis, and feeds the merged stats to
     `cardinal_from_stats` — bitwise identical to the single-device path.
-    """
+
+    `with_host_counts=False` skips the (expensive) per-host scatter-add —
+    legitimate whenever the profile's authority guard is off (the
+    reference also skips the domain-count accumulation then,
+    ReferenceOrder.java:255)."""
     col_min, col_max = _masked_minmax(feats, valid)
     tfv = _term_frequency(feats)
     tf_min = jnp.min(jnp.where(valid, tfv, jnp.inf))
     tf_max = jnp.max(jnp.where(valid, tfv, -jnp.inf))
-    host_counts = jax.ops.segment_sum(valid.astype(jnp.int32), hostids,
-                                      num_segments=num_hosts)
+    if with_host_counts:
+        host_counts = jax.ops.segment_sum(valid.astype(jnp.int32), hostids,
+                                          num_segments=num_hosts)
+    else:
+        host_counts = jnp.zeros(1, dtype=jnp.int32)
     return {"col_min": col_min, "col_max": col_max,
             "tf_min": tf_min, "tf_max": tf_max, "host_counts": host_counts}
 
@@ -213,8 +220,23 @@ def _term_frequency(feats: jnp.ndarray) -> jnp.ndarray:
     """hitcount / (wordsintext + wordsintitle + 1)
     (WordReferenceVars.termFrequency semantics)."""
     return feats[:, P.F_HITCOUNT].astype(jnp.float32) / (
-        feats[:, P.F_WORDS_IN_TEXT] + feats[:, P.F_WORDS_IN_TITLE] + 1
+        feats[:, P.F_WORDS_IN_TEXT].astype(jnp.int32)
+        + feats[:, P.F_WORDS_IN_TITLE].astype(jnp.int32) + 1
     ).astype(jnp.float32)
+
+
+def _norm_div_exact_fast(prod: jnp.ndarray, safe_span: jnp.ndarray) -> jnp.ndarray:
+    """floor(prod / span) without integer division (TPUs emulate int div
+    expensively): f32-reciprocal estimate + /-1 integer correction.
+
+    EXACT when prod <= 2^23 (f32 represents the product exactly and the
+    estimate is within +-1 of the true quotient) — guaranteed for compact
+    int16 blocks where prod = diff * 256 <= 2^15 * 256 = 2^23."""
+    q0 = (prod.astype(jnp.float32)
+          * (1.0 / safe_span.astype(jnp.float32))[None, :]).astype(jnp.int32)
+    r = prod - q0 * safe_span[None, :]
+    return q0 + (r >= safe_span[None, :]).astype(jnp.int32) \
+        - (r < 0).astype(jnp.int32)
 
 
 def cardinal_from_stats(feats: jnp.ndarray, valid: jnp.ndarray,
@@ -224,13 +246,25 @@ def cardinal_from_stats(feats: jnp.ndarray, valid: jnp.ndarray,
                         domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
                         language_coeff: jnp.ndarray,
                         authority_coeff: jnp.ndarray,
-                        language_pref: jnp.ndarray) -> jnp.ndarray:
-    """Score rows against precomputed (possibly cross-shard) statistics."""
+                        language_pref: jnp.ndarray,
+                        fast_div: bool = False,
+                        flags: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Score rows against precomputed (possibly cross-shard) statistics.
+
+    `feats` may be int16 (compact block) — expressions promote to int32
+    elementwise, so XLA reads the narrow array from HBM and widens in
+    registers; `flags` then carries the int32 bitfields separately (the
+    compact block zeroes that column). No full-width copy is ever
+    materialized."""
     col_min, col_max = stats["col_min"], stats["col_max"]
     span = col_max - col_min
     safe_span = jnp.maximum(span, 1)
 
-    norm = ((feats - col_min[None, :]) * 256) // safe_span[None, :]
+    prod = (feats.astype(jnp.int32) - col_min[None, :]) * 256
+    if fast_div:
+        norm = _norm_div_exact_fast(prod, safe_span)
+    else:
+        norm = prod // safe_span[None, :]
     norm = jnp.where(span[None, :] == 0, 0, norm)
     direct = jnp.asarray(_NORM_DIRECT)
     # inverted attributes score (256 - norm), but stay 0 when span == 0
@@ -246,7 +280,8 @@ def cardinal_from_stats(feats: jnp.ndarray, valid: jnp.ndarray,
     score = jnp.sum(jnp.where(active[None, :], per_col, 0), axis=1)
 
     # domlength: stored pre-normalized 0..255; (256 - v) << coeff
-    score = score + ((256 - feats[:, P.F_DOMLENGTH]) << domlength_coeff)
+    score = score + ((256 - feats[:, P.F_DOMLENGTH].astype(jnp.int32))
+                     << domlength_coeff)
 
     # term frequency: hitcount / (wordsintext + wordsintitle + 1), min/max
     # normalized to 0..255 (WordReferenceVars.termFrequency semantics)
@@ -259,21 +294,27 @@ def cardinal_from_stats(feats: jnp.ndarray, valid: jnp.ndarray,
     score = score + (tf_norm << tf_coeff)
 
     # language preference match: 255 << coeff
-    score = score + jnp.where(feats[:, P.F_LANGUAGE] == language_pref,
-                              jnp.int32(255) << language_coeff, 0)
+    score = score + jnp.where(
+        feats[:, P.F_LANGUAGE].astype(jnp.int32) == language_pref,
+        jnp.int32(255) << language_coeff, 0)
 
     # appearance/category flags: 255 << coeff each
-    flags = feats[:, P.F_FLAGS]
+    if flags is None:
+        flags = feats[:, P.F_FLAGS].astype(jnp.int32)
     flag_hit = (flags[:, None] >> flag_bits[None, :]) & 1
     score = score + jnp.sum(flag_hit * (255 << flag_shifts[None, :]), axis=1)
 
     # authority: domain-frequency score, only when coeff > 12
     # (ReferenceOrder.java:255 guard); counts precomputed in stats so they
-    # can be psum'd across doc shards
+    # can be psum'd across doc shards. A single-entry counts array means
+    # the caller disabled authority at trace time (the guard is false):
+    # skip the gather+divide entirely instead of computing a dead branch.
     counts = stats["host_counts"]
-    maxdom = jnp.max(counts)
-    auth = (counts[hostids] << 8) // (1 + maxdom)
-    score = score + jnp.where(authority_coeff > 12, auth << authority_coeff, 0)
+    if counts.shape[0] > 1:
+        maxdom = jnp.max(counts)
+        auth = (counts[hostids] << 8) // (1 + maxdom)
+        score = score + jnp.where(authority_coeff > 12,
+                                  auth << authority_coeff, 0)
 
     return jnp.where(valid, score, jnp.int32(-(2**31 - 1)))
 
@@ -297,6 +338,76 @@ def cardinal_scores(feats: jnp.ndarray, valid: jnp.ndarray,
                                flag_bits, flag_shifts, domlength_coeff,
                                tf_coeff, language_coeff, authority_coeff,
                                language_pref)
+
+
+# ---------------------------------------------------------------------------
+# Compact device blocks — int16 features + separate int32 flags
+# ---------------------------------------------------------------------------
+# The scorer is HBM-bandwidth-bound: a 10M-row int32 block is 680 MB per
+# scan. Every posting attribute except the flag bitfield is small by
+# construction (hitcount <= 255, positions <= 2^15, day counts < 2^15), so
+# the device-resident form halves the bytes: int16 [n, NF] with the flags
+# column zeroed, plus one int32 [n] flags array. Values are clipped into
+# int16 range at pack time — part of the block format, applied identically
+# on every read path.
+
+INT16_MAX = 32767
+
+
+def compact_feats(feats: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """int32 [n, NF] -> (int16 [n, NF] with flags zeroed, int32 [n] flags)."""
+    flags = np.ascontiguousarray(feats[:, P.F_FLAGS]).astype(np.int32)
+    small = np.clip(feats, -INT16_MAX - 1, INT16_MAX).astype(np.int16)
+    small[:, P.F_FLAGS] = 0
+    return small, flags
+
+
+def cardinal_scores16(feats16: jnp.ndarray, flags: jnp.ndarray,
+                      valid: jnp.ndarray, hostids: jnp.ndarray,
+                      stats: dict | None, norm_coeffs: jnp.ndarray,
+                      flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
+                      domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
+                      language_coeff: jnp.ndarray,
+                      authority_coeff: jnp.ndarray,
+                      language_pref: jnp.ndarray,
+                      with_authority: bool = True) -> jnp.ndarray:
+    """Compact-block scorer: reads half the bytes of the int32 path and
+    normalizes with the exact fast division. Identical scores to
+    cardinal_scores over `compact_feats`-clipped int32 input.
+
+    `with_authority` is the TRACE-TIME authority guard (profile.authority
+    > 12, known host-side): when False the per-host scatter/gather is
+    never built into the program."""
+    if stats is None:
+        # NB: the flags column's min/max come out 0 (the compact block
+        # zeroes that column) — harmless: normalization masks the flags
+        # column out entirely; the bitfield scores via `flags` below
+        stats = local_stats(feats16, valid, hostids,
+                            num_hosts=feats16.shape[0],
+                            with_host_counts=with_authority)
+    return cardinal_from_stats(feats16, valid, hostids, stats, norm_coeffs,
+                               flag_bits, flag_shifts, domlength_coeff,
+                               tf_coeff, language_coeff, authority_coeff,
+                               language_pref, fast_div=True, flags=flags)
+
+
+@partial(jax.jit, static_argnames=("k", "with_authority"))
+def score_topk16(feats16: jnp.ndarray, flags: jnp.ndarray,
+                 docids: jnp.ndarray, valid: jnp.ndarray,
+                 hostids: jnp.ndarray, norm_coeffs: jnp.ndarray,
+                 flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
+                 domlength_coeff: jnp.ndarray, tf_coeff: jnp.ndarray,
+                 language_coeff: jnp.ndarray, authority_coeff: jnp.ndarray,
+                 language_pref: jnp.ndarray, k: int,
+                 with_authority: bool = True):
+    """Fused compact-block cardinal + top-k (bandwidth-halved score_topk)."""
+    scores = cardinal_scores16(feats16, flags, valid, hostids, None,
+                               norm_coeffs, flag_bits, flag_shifts,
+                               domlength_coeff, tf_coeff, language_coeff,
+                               authority_coeff, language_pref,
+                               with_authority=with_authority)
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    return top_scores, docids[top_idx], top_idx
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -357,11 +468,14 @@ class CardinalRanker:
         if hosthashes is not None:
             hostids[:n] = hostid_array(plist.docids, hosthashes)
         kk = min(k, npad)
-        s, d, _ = score_topk(jnp.asarray(feats), jnp.asarray(docids),
-                             jnp.asarray(valid), jnp.asarray(hostids),
-                             self._norm, self._bits, self._shifts,
-                             self._dl, self._tf, self._lang_c, self._auth,
-                             self._lang, kk)
+        feats16, flags = compact_feats(feats)
+        s, d, _ = score_topk16(jnp.asarray(feats16), jnp.asarray(flags),
+                               jnp.asarray(docids), jnp.asarray(valid),
+                               jnp.asarray(hostids),
+                               self._norm, self._bits, self._shifts,
+                               self._dl, self._tf, self._lang_c, self._auth,
+                               self._lang, kk,
+                               with_authority=self.profile.authority > 12)
         s, d = np.asarray(s), np.asarray(d)
         keep = d >= 0
         keep &= s > -(2**31 - 1)

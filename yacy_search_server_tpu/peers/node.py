@@ -55,6 +55,7 @@ class P2PNode:
         self.dist = Distribution(partition_exponent)
         self.redundancy = redundancy
         self.news = NewsPool(data_dir)
+        self.sb.news = self.news     # feed servlet reads the pool from sb
         self.protocol = Protocol(self.seeddb, p2p_transport, news=self.news)
         self.server = PeerServer(self.sb, self.seeddb,
                                  accept_remote_index=accept_remote_index,

@@ -13,6 +13,47 @@ from ..objects import ServerObjects, escape_json
 from . import servlet
 
 
+@servlet("feed")
+def respond_feed(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Event channels as RSS (reference: peers/EventChannel.java +
+    htroot/api/feed.java — recent node events streamed as feeds).
+    Channels: LOCALSEARCH (query log), NEWS (incoming news records),
+    INDEX (indexing counters)."""
+    import time as _time
+    from ..objects import escape_xml
+    prop = ServerObjects()
+    channel = post.get("set", "LOCALSEARCH").upper()
+    count = min(max(post.get_int("count", 20), 1), 100)
+    items: list[tuple[str, str, float]] = []
+    if channel == "LOCALSEARCH":
+        for e in sb.access_tracker.latest(count):
+            items.append((f"query: {e.query}",
+                          f"{e.result_count} results in {e.time_ms:.0f} ms",
+                          e.timestamp))
+    elif channel == "NEWS":
+        pool = getattr(sb, "news", None)   # set by P2PNode; absent solo
+        if pool is not None:
+            for rec in pool.incoming()[:count]:
+                items.append((f"news: {rec.category}",
+                              str(rec.attributes), rec.created))
+    elif channel == "INDEX":
+        items.append((f"indexed documents: {sb.index.doc_count()}",
+                      f"rwi postings: {sb.index.rwi_size()}", _time.time()))
+    rows = []
+    for title, desc, ts in items:
+        pub = _time.strftime("%a, %d %b %Y %H:%M:%S GMT", _time.gmtime(ts))
+        rows.append(f"<item><title>{escape_xml(title)}</title>"
+                    f"<description>{escape_xml(desc)}</description>"
+                    f"<pubDate>{pub}</pubDate></item>")
+    prop.raw_body = (
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<rss version="2.0"><channel>'
+        f"<title>yacy-tpu feed: {escape_xml(channel)}</title>"
+        + "".join(rows) + "</channel></rss>")
+    prop.raw_ctype = "application/rss+xml; charset=utf-8"
+    return prop
+
+
 @servlet("termlist_p")
 def respond_termlist(header: dict, post: ServerObjects, sb) -> ServerObjects:
     """Term census of the local RWI (reference: htroot/api/termlist_p.java)."""

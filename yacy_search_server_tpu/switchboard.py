@@ -116,6 +116,11 @@ class Switchboard:
             if data_dir else None
         self.synonyms = SynonymLibrary(syn_dir)
         self.index.synonyms = self.synonyms
+        from .document.geolocalization import Gazetteer
+        self.gazetteer = Gazetteer(
+            os.path.join(data_dir, "DICTIONARIES", "geo")
+            if data_dir else None)
+        self.index.gazetteer = self.gazetteer if self.gazetteer.size() else None
         from .crawler.snapshots import Snapshots
         self.snapshots = Snapshots(sub("SNAPSHOTS"))
         self.triplestore = TripleStore(
