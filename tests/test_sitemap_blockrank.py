@@ -1,0 +1,95 @@
+"""Sitemap ingestion + BlockRank citation postprocessing."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.crawler.sitemap import parse_sitemap
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.ops.blockrank import host_ranks
+from yacy_search_server_tpu.switchboard import Switchboard
+from yacy_search_server_tpu.webstructure import WebStructureGraph
+
+SITEMAP = b"""<?xml version="1.0" encoding="UTF-8"?>
+<urlset xmlns="http://www.sitemaps.org/schemas/sitemap/0.9">
+  <url><loc>http://sm.test/a.html</loc><lastmod>2024-01-01</lastmod></url>
+  <url><loc>http://sm.test/b.html</loc><priority>0.8</priority></url>
+</urlset>"""
+
+SITEMAP_INDEX = b"""<?xml version="1.0" encoding="UTF-8"?>
+<sitemapindex xmlns="http://www.sitemaps.org/schemas/sitemap/0.9">
+  <sitemap><loc>http://sm.test/sub.xml</loc></sitemap>
+</sitemapindex>"""
+
+
+def test_parse_sitemap_urlset_and_index():
+    urls, nested = parse_sitemap(SITEMAP)
+    assert [u["loc"] for u in urls] == ["http://sm.test/a.html",
+                                       "http://sm.test/b.html"]
+    assert urls[0]["lastmod"] == "2024-01-01"
+    assert nested == []
+    urls2, nested2 = parse_sitemap(SITEMAP_INDEX)
+    assert urls2 == [] and nested2 == ["http://sm.test/sub.xml"]
+    # gzip payloads are the protocol norm
+    urls3, _ = parse_sitemap(gzip.compress(SITEMAP))
+    assert len(urls3) == 2
+    assert parse_sitemap(b"not xml at all") == ([], [])
+
+
+def test_sitemap_crawl_end_to_end(tmp_path):
+    PAGES = {
+        "http://sm.test/index.xml": (200, {"content-type": "application/xml"},
+                                     SITEMAP_INDEX),
+        "http://sm.test/sub.xml": (200, {"content-type": "application/xml"},
+                                   SITEMAP),
+        "http://sm.test/a.html": (200, {"content-type": "text/html"},
+            b"<html><title>A</title><body>sitemapword alpha</body></html>"),
+        "http://sm.test/b.html": (200, {"content-type": "text/html"},
+            b"<html><title>B</title><body>sitemapword beta</body></html>"),
+        "http://sm.test/robots.txt": (200, {}, b"User-agent: *\n"),
+    }
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"),
+                     transport=lambda url, h: PAGES.get(url, (404, {}, b"")))
+    sb.latency.min_delta_s = 0.0
+    try:
+        assert sb.start_sitemap_crawl("http://sm.test/index.xml") == 2
+        sb.crawl_until_idle(timeout_s=20)
+        ev = sb.search("sitemapword")
+        assert {r.url for r in ev.results()} == {"http://sm.test/a.html",
+                                                 "http://sm.test/b.html"}
+    finally:
+        sb.close()
+
+
+def test_host_ranks_power_iteration():
+    ws = WebStructureGraph()
+    # hub.test is cited by everyone and cites nothing (dangling);
+    # a.test is cited only by b; b is cited by nobody
+    ws.add_document("http://a.test/1", ["http://hub.test/x"] * 3)
+    ws.add_document("http://b.test/1", ["http://hub.test/y",
+                                        "http://a.test/2"])
+    ranks = host_ranks(ws)
+    assert set(ranks) >= {"a.test", "b.test", "hub.test"}
+    assert ranks["hub.test"] == 1.0            # max-normalized
+    assert ranks["hub.test"] > ranks["a.test"] > 0
+    assert ranks["b.test"] < ranks["a.test"]   # nothing cites b
+    assert all(0 <= r <= 1 for r in ranks.values())
+
+
+def test_postprocessing_writes_cr(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        d1 = sb.index.store_document(Document(
+            url="http://hub.test/p.html", title="hub",
+            text="crword page"))
+        sb.web_structure.add_document("http://a.test/1",
+                                      ["http://hub.test/p.html"])
+        sb.web_structure.add_document("http://b.test/1",
+                                      ["http://hub.test/p.html"])
+        n = sb.run_postprocessing()
+        assert n == 1
+        m = sb.index.metadata.get(d1)
+        assert m.get("cr_host_norm_d") == 1.0
+    finally:
+        sb.close()

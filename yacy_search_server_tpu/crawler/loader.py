@@ -86,7 +86,10 @@ class LoaderDispatcher:
             if len(content) > self.max_size:
                 raise OSError(f"content exceeds max size {self.max_size}")
             headers = {k.lower(): v for k, v in resp.headers.items()}
-            return resp.status, headers, content
+            # non-HTTP handlers (ftp) return status=None on success —
+            # urllib raises on failure, so a None here means 200
+            status = resp.status if resp.status is not None else 200
+            return status, headers, content
 
     def _fetch_file(self, url: str) -> tuple[int, dict, bytes]:
         path = urlsplit(url).path
@@ -141,11 +144,15 @@ class LoaderDispatcher:
         scheme = urlsplit(url).scheme.lower()
         t0 = time.monotonic()
         try:
-            if scheme in ("http", "https"):
+            if scheme in ("http", "https", "ftp"):
+                # ftp rides urllib's built-in FTPHandler (the reference's
+                # FTPLoader is its own client; capability, not mechanism)
                 status, headers, content = self._fetch_http(url)
             elif scheme == "file":
                 status, headers, content = self._fetch_file(url)
             else:
+                # smb would need an SMB client library (reference bundles
+                # jcifs); not available in this image — explicit 501
                 return Response(request, status=501,
                                 headers={"x-error": f"scheme {scheme}"})
             elapsed = time.monotonic() - t0

@@ -1,0 +1,109 @@
+"""BlockRank — host-level citation rank as a device power iteration.
+
+Capability equivalent of the reference's offline citation ranking
+(reference: source/net/yacy/search/ranking/BlockRank.java:50 — iterative
+rank evaluation over exported webgraph indexes — and
+CollectionConfiguration's postprocessing that writes the normalized
+host citation rank into cr_host_norm_d for query-time boosting). The
+reference iterates Java maps; here the host link graph becomes a dense
+column-stochastic matrix and the rank vector is a jnp power iteration —
+one matmul per step on the MXU, converging in tens of steps for the
+host counts a node ever sees.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DAMPING = 0.85
+MAX_ITERS = 50
+TOL = 1e-9
+
+
+@partial(jax.jit, static_argnames=("n",))
+def _power_iterate_sparse(srcs: jnp.ndarray, dsts: jnp.ndarray,
+                          weights: jnp.ndarray, dangling: jnp.ndarray,
+                          damping: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Damped power iteration over an EDGE LIST (segment-sum per step):
+    the host graph is sparse, so no n x n matrix is ever materialized —
+    memory is O(edges + hosts) instead of O(hosts^2)."""
+    r0 = jnp.full((n,), 1.0 / n, dtype=jnp.float32)
+    teleport = (1.0 - damping) / n
+
+    def body(state):
+        r, _delta, i = state
+        contrib = jax.ops.segment_sum(weights * r[srcs], dsts,
+                                      num_segments=n)
+        dangling_mass = jnp.sum(jnp.where(dangling, r, 0.0)) / n
+        r2 = teleport + damping * (contrib + dangling_mass)
+        return r2, jnp.max(jnp.abs(r2 - r)), i + 1
+
+    def cond(state):
+        _r, delta, i = state
+        return (delta > TOL) & (i < MAX_ITERS)
+
+    r, _, _ = jax.lax.while_loop(cond, body, (r0, jnp.float32(1.0),
+                                              jnp.int32(0)))
+    return r
+
+
+def host_ranks(web_structure, damping: float = DAMPING) -> dict[str, float]:
+    """host -> rank in [0, 1] (max-normalized), from the host link graph."""
+    # node set = every source host plus every link target
+    hosts = set(web_structure.source_hosts())
+    for h in list(hosts):
+        hosts.update(web_structure.outgoing(h).keys())
+    hosts = sorted(hosts)
+    if not hosts:
+        return {}
+    idx = {h: i for i, h in enumerate(hosts)}
+    n = len(hosts)
+    srcs: list[int] = []
+    dsts: list[int] = []
+    weights: list[float] = []
+    dangling = np.zeros(n, dtype=bool)
+    for h in hosts:
+        out = web_structure.outgoing(h)
+        total = sum(out.values())
+        if total <= 0:
+            dangling[idx[h]] = True     # rank mass spreads uniformly
+            continue
+        for target, count in out.items():
+            srcs.append(idx[h])
+            dsts.append(idx[target])
+            weights.append(count / total)
+    if not srcs:        # no edges at all: uniform ranks
+        return {h: 1.0 for h in hosts}
+    r = np.asarray(_power_iterate_sparse(
+        jnp.asarray(np.array(srcs, np.int32)),
+        jnp.asarray(np.array(dsts, np.int32)),
+        jnp.asarray(np.array(weights, np.float32)),
+        jnp.asarray(dangling), jnp.float32(damping), n))
+    peak = float(r.max()) or 1.0
+    return {h: float(r[idx[h]]) / peak for h in hosts}
+
+
+def postprocess_segment(segment, web_structure, damping: float = DAMPING,
+                        ranks: dict[str, float] | None = None) -> int:
+    """Write cr_host_norm_d for every indexed doc from its host's rank
+    (the reference's postprocessing pass over the collection). Returns
+    docs updated. Pass precomputed `ranks` to avoid re-iterating."""
+    if ranks is None:
+        ranks = host_ranks(web_structure, damping)
+    if not ranks:
+        return 0
+    meta = segment.metadata
+    updated = 0
+    for docid in range(meta.capacity()):
+        if meta.is_deleted(docid):
+            continue
+        host = meta.text_value(docid, "host_s")
+        r = ranks.get(host)
+        if r is not None:
+            meta.set_fields(docid, cr_host_norm_d=r)
+            updated += 1
+    return updated

@@ -40,8 +40,9 @@ def respond_feed(header: dict, post: ServerObjects, sb) -> ServerObjects:
         items.append((f"indexed documents: {sb.index.doc_count()}",
                       f"rwi postings: {sb.index.rwi_size()}", _time.time()))
     rows = []
+    from email.utils import formatdate
     for title, desc, ts in items:
-        pub = _time.strftime("%a, %d %b %Y %H:%M:%S GMT", _time.gmtime(ts))
+        pub = formatdate(ts, usegmt=True)   # RFC-822, locale-independent
         rows.append(f"<item><title>{escape_xml(title)}</title>"
                     f"<description>{escape_xml(desc)}</description>"
                     f"<pubDate>{pub}</pubDate></item>")
@@ -51,6 +52,26 @@ def respond_feed(header: dict, post: ServerObjects, sb) -> ServerObjects:
         f"<title>yacy-tpu feed: {escape_xml(channel)}</title>"
         + "".join(rows) + "</channel></rss>")
     prop.raw_ctype = "application/rss+xml; charset=utf-8"
+    return prop
+
+
+@servlet("postprocessing_p")
+def respond_postprocessing(header: dict, post: ServerObjects,
+                           sb) -> ServerObjects:
+    """Trigger citation-rank postprocessing (reference: the postprocessing
+    control on IndexControl; BlockRank evaluation)."""
+    prop = ServerObjects()
+    from ...ops.blockrank import host_ranks, postprocess_segment
+    all_ranks = host_ranks(sb.web_structure)   # computed once per request
+    if post.get("run"):
+        prop.put("updated", postprocess_segment(
+            sb.index, sb.web_structure, ranks=all_ranks))
+    ranks = sorted(all_ranks.items(),
+                   key=lambda kv: -kv[1])[: post.get_int("maxhosts", 25)]
+    prop.put("hosts", len(ranks))
+    for i, (h, r) in enumerate(ranks):
+        prop.put(f"hosts_{i}_host", escape_json(h))
+        prop.put(f"hosts_{i}_rank", round(r, 6))
     return prop
 
 

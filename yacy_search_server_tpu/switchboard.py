@@ -181,6 +181,30 @@ class Switchboard:
             raise ValueError(f"start url rejected: {reason}")
         return profile
 
+    def start_sitemap_crawl(self, sitemap_url: str,
+                            name: str | None = None,
+                            **profile_kwargs) -> int:
+        """Stack every location of a sitemap (recursing through indexes);
+        returns urls stacked (Crawler_p sitemap start semantics)."""
+        from .crawler.sitemap import SitemapImporter
+        profile = CrawlProfile(name or f"sitemap:{sitemap_url}",
+                               start_url=sitemap_url, depth=0,
+                               **profile_kwargs)
+        self.add_profile(profile)
+        importer = SitemapImporter(self.loader, self.crawl_stacker,
+                                   profile.handle)
+        stacked = importer.import_sitemap(sitemap_url)
+        if stacked == 0:
+            self.profiles.pop(profile.handle, None)
+        return stacked
+
+    def run_postprocessing(self) -> int:
+        """Citation-rank postprocessing: host BlockRank power iteration ->
+        cr_host_norm_d columns (reference: CollectionConfiguration
+        postprocessing + BlockRank)."""
+        from .ops.blockrank import postprocess_segment
+        return postprocess_segment(self.index, self.web_structure)
+
     def crawl_until_idle(self, timeout_s: float = 60.0) -> int:
         """Drive the crawl synchronously until frontier + pipeline drain
         (test/CLI surface; the busy-thread mode is deploy_threads).

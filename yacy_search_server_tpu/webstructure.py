@@ -86,6 +86,11 @@ class WebStructureGraph:
         with self._lock:
             return len(self._out)
 
+    def source_hosts(self) -> list[str]:
+        """Every host that has outgoing links recorded."""
+        with self._lock:
+            return list(self._out.keys())
+
     def top_hosts(self, n: int = 20) -> list[tuple[str, int]]:
         """Hosts by inbound reference count."""
         counts: dict[str, int] = defaultdict(int)
