@@ -1,0 +1,119 @@
+"""Monitoring servlets + OpenSearch federated search."""
+
+import json
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu.search.federated import (FederateSearchManager,
+                                                     parse_opensearch_results)
+
+RSS = b"""<?xml version="1.0"?><rss version="2.0"><channel>
+<item><title>Ext One</title><link>http://ext.test/one</link>
+<description>first external hit</description></item>
+<item><title>Ext Two</title><link>http://ext.test/two</link>
+<description>second</description></item></channel></rss>"""
+
+ATOM = b"""<?xml version="1.0"?><feed xmlns="http://www.w3.org/2005/Atom">
+<entry><title>Atom Hit</title><link href="http://atom.test/a"/>
+<summary>atom summary</summary></entry></feed>"""
+
+
+def test_parse_opensearch_rss_and_atom():
+    rows = parse_opensearch_results(RSS)
+    assert [r["link"] for r in rows] == ["http://ext.test/one",
+                                        "http://ext.test/two"]
+    assert rows[0]["description"] == "first external hit"
+    atom = parse_opensearch_results(ATOM)
+    assert atom == [{"title": "Atom Hit", "link": "http://atom.test/a",
+                     "description": "atom summary"}]
+    assert parse_opensearch_results(b"junk") == []
+
+
+@pytest.fixture(scope="module")
+def mon_server(tmp_path_factory):
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    from yacy_search_server_tpu.switchboard import Switchboard
+    tmp = tmp_path_factory.mktemp("mon")
+    PAGES = {
+        "http://mon.test/": (200, {"content-type": "text/html"},
+            b"<html><title>Mon</title><body>monword content</body></html>"),
+        "http://mon.test/robots.txt": (200, {}, b"User-agent: *\n"),
+        "http://osearch.test/q=monword": (
+            200, {"content-type": "application/rss+xml"}, RSS),
+    }
+    sb = Switchboard(data_dir=str(tmp / "DATA"),
+                     transport=lambda u, h: PAGES.get(u, (404, {}, b"")))
+    sb.latency.min_delta_s = 0.0
+    sb.start_crawl("http://mon.test/", depth=0)
+    sb.crawl_until_idle(timeout_s=20)
+    sb.search("monword")
+    srv = YaCyHttpServer(sb, port=0).start()
+    yield sb, srv
+    srv.close()
+    sb.close()
+
+
+def _get_json(srv, path):
+    with urllib.request.urlopen(srv.base_url + path, timeout=10) as r:
+        return json.loads(r.read().decode("utf-8"))
+
+
+def test_performance_memory_servlet(mon_server):
+    sb, srv = mon_server
+    out = _get_json(srv, "/PerformanceMemory_p.json")
+    assert int(out["used_bytes"]) > 0
+    stores = {out[f"stores_{i}_name"]: int(out[f"stores_{i}_value"])
+              for i in range(int(out["stores"]))}
+    assert stores["metadata.docs"] == 1
+    assert stores["rwi.total_postings"] > 0
+
+
+def test_crawl_results_servlet(mon_server):
+    sb, srv = mon_server
+    sb.crawl_queues.error_cache.push(b"X" * 12, "http://fail.test/x",
+                                     "test failure")
+    out = _get_json(srv, "/CrawlResults.json")
+    assert int(out["indexed_count"]) == 1
+    assert out["errors_0_url"] == "http://fail.test/x"
+
+
+def test_viewfile_servlet(mon_server):
+    sb, srv = mon_server
+    out = _get_json(srv, "/ViewFile.json?url=http://mon.test/")
+    assert "monword" in out["text"]
+    meta = _get_json(srv, "/ViewFile.json?url=http://mon.test/"
+                          "&viewMode=metadata")
+    assert meta["field_host_s"] == "mon.test"
+    # raw mode serves the cached bytes
+    with urllib.request.urlopen(
+            srv.base_url + "/ViewFile.html?url=http://mon.test/&viewMode=raw",
+            timeout=10) as r:
+        assert b"monword" in r.read()
+
+
+def test_performance_graph_png(mon_server):
+    sb, srv = mon_server
+    with urllib.request.urlopen(srv.base_url + "/PerformanceGraph.png",
+                                timeout=10) as r:
+        assert r.headers["Content-Type"] == "image/png"
+        assert r.read()[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_federated_opensearch_merges_into_event(mon_server):
+    sb, srv = mon_server
+    sb.config.set("heuristic.opensearch.urls",
+                  "http://osearch.test/q={searchTerms}")
+    mgr = FederateSearchManager.from_config(sb.loader, sb.config)
+    assert mgr.endpoints == ["http://osearch.test/q={searchTerms}"]
+    ev = sb.search("monword")
+    # synchronous merge for determinism (the config-gated path launches
+    # the same merge asynchronously from Switchboard.search)
+    merged = mgr.search_into_event(ev, "monword", asynchronous=False)
+    assert merged == 2
+    urls = {r.url for r in ev.results(count=10)}
+    assert "http://ext.test/one" in urls
+    assert any(r.source.startswith("opensearch:")
+               for r in ev.results(count=10) if r.url.startswith("http://ext"))
+    # repeated merge dedups (seen urlhashes)
+    assert mgr.search_into_event(ev, "monword", asynchronous=False) == 0

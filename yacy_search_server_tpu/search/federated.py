@@ -1,0 +1,86 @@
+"""Federated external search — OpenSearch endpoints merged into a live event.
+
+Capability equivalent of the reference's federated-search heuristics
+(reference: source/net/yacy/cora/federate/FederateSearchManager.java +
+opensearch/OpenSearchConnector — configured OpenSearch RSS/Atom URL
+templates queried at search time, results injected into the running
+SearchEvent as remote entries; wired by Switchboard's heuristic config).
+Endpoints are `...{searchTerms}...` URL templates; fetching goes through
+the node's loader (cache, politeness, blacklist, zero-egress injection).
+"""
+
+from __future__ import annotations
+
+import threading
+from urllib.parse import quote
+
+from ..crawler.loader import CacheStrategy
+from ..crawler.request import Request
+from ..utils.hashes import safe_host, url2hash
+
+
+def parse_opensearch_results(content: bytes) -> list[dict]:
+    """RSS 2.0 / Atom feed -> [{title, link, description}].
+
+    Thin adapter over the parser zoo's feed parser (first-link-wins, HTML
+    stripped from summaries) so federated results and feed indexing share
+    one set of feed semantics."""
+    from ..document.parser.xmlparsers import parse_feed
+    rows = []
+    for doc in parse_feed("opensearch://result", content):
+        if doc.url and doc.url != "opensearch://result":
+            rows.append({"title": doc.title, "link": doc.url,
+                         "description": doc.description})
+    return rows
+
+
+class FederateSearchManager:
+    """Query configured OpenSearch endpoints and feed a live SearchEvent."""
+
+    def __init__(self, loader, endpoints: list[str] | None = None):
+        self.loader = loader
+        self.endpoints = list(endpoints or [])
+
+    @staticmethod
+    def from_config(loader, config) -> "FederateSearchManager":
+        raw = config.get("heuristic.opensearch.urls", "")
+        eps = [u.strip() for u in raw.split("|") if u.strip()]
+        return FederateSearchManager(loader, eps)
+
+    def query_endpoint(self, template: str, querystring: str) -> list[dict]:
+        url = template.replace("{searchTerms}", quote(querystring))
+        resp = self.loader.load(Request(url), CacheStrategy.IFFRESH)
+        if resp.status != 200:
+            return []
+        return parse_opensearch_results(resp.content)
+
+    def search_into_event(self, event, querystring: str,
+                          per_endpoint: int = 10,
+                          asynchronous: bool = True) -> int:
+        """Fan out to every endpoint; merge results as remote entries.
+        Returns endpoints launched (async) or results merged (sync)."""
+        if not self.endpoints:
+            return 0
+
+        def one(template: str) -> int:
+            from .searchevent import ResultEntry
+            rows = self.query_endpoint(template, querystring)[:per_endpoint]
+            entries = []
+            for r in rows:
+                try:
+                    entries.append(ResultEntry(
+                        docid=-1, urlhash=url2hash(r["link"]),
+                        score=0, url=r["link"], title=r["title"],
+                        snippet=r["description"],
+                        host=safe_host(r["link"]),
+                        source=f"opensearch:{safe_host(template)}"))
+                except Exception:
+                    continue
+            return event.add_remote_results(entries)
+
+        if asynchronous:
+            for t in self.endpoints:
+                threading.Thread(target=one, args=(t,), daemon=True,
+                                 name="federated-search").start()
+            return len(self.endpoints)
+        return sum(one(t) for t in self.endpoints)

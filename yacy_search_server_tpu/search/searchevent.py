@@ -113,6 +113,10 @@ class SearchEvent:
         self.local_rwi_evicted = 0
         self.remote_peers_asked = 0
         self.remote_results = 0
+        # one-shot latch for query-time heuristics: they fire when the
+        # event is created, never again on cache hits/paging (the
+        # reference's heuristics are per-search-event)
+        self.heuristics_fired = False
         self._ranker = CardinalRanker(query.profile, query.lang)
         self._run_local()
 

@@ -1,0 +1,124 @@
+"""Monitoring + inspection servlets: memory dashboard, crawl results,
+cached-page viewer, profiling graph.
+
+Capability equivalents of the reference's operations pages (reference:
+htroot/PerformanceMemory_p.java — heap/tables memory dashboard backed by
+MemoryControl; htroot/CrawlResults.java — per-origin crawl outcome lists
+incl. the error cache; htroot/ViewFile.java — render a cached page's
+text/metadata from the HTCache; htroot/PerformanceGraph.java — the
+EventTracker time-series rendered as a PNG via ProfilingGraph)."""
+
+from __future__ import annotations
+
+from ...utils.eventtracker import EClass, events
+from ...utils.memory import MemoryControl
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+
+@servlet("PerformanceMemory_p")
+def respond_memory(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    prop = ServerObjects()
+    prop.put("used_bytes", MemoryControl.used())
+    prop.put("available_bytes", MemoryControl.available())
+    prop.put("short_status", 1 if MemoryControl.short_status() else 0)
+    # per-store accounting (the reference's table/heap trackers)
+    rows = [
+        ("rwi.ram_postings", sb.index.rwi.ram_postings_count),
+        ("rwi.total_postings", sb.index.rwi.total_postings()),
+        ("rwi.runs", sb.index.rwi.run_count()),
+        ("metadata.docs", len(sb.index.metadata)),
+        ("search.cached_events", len(sb.search_cache)),
+        ("frontier.local", _frontier_size(sb)),
+        ("tables", len(sb.tables.tables())),
+    ]
+    prop.put("stores", len(rows))
+    for i, (name, v) in enumerate(rows):
+        prop.put(f"stores_{i}_name", name)
+        prop.put(f"stores_{i}_value", int(v))
+    return prop
+
+
+def _frontier_size(sb) -> int:
+    from ...crawler.frontier import StackType
+    return sb.noticed.size(StackType.LOCAL)
+
+
+@servlet("CrawlResults")
+def respond_crawl_results(header: dict, post: ServerObjects,
+                          sb) -> ServerObjects:
+    prop = ServerObjects()
+    prop.put("indexed_count", sb.indexed_count)
+    errors = sb.crawl_queues.error_cache.recent(post.get_int("count", 50))
+    prop.put("errors", len(errors))
+    for i, (url, reason, ts) in enumerate(errors):
+        prop.put(f"errors_{i}_url", escape_json(url))
+        prop.put(f"errors_{i}_reason", escape_json(reason))
+        prop.put(f"errors_{i}_time", int(ts))
+    return prop
+
+
+@servlet("ViewFile")
+def respond_viewfile(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Inspect a document as the index sees it: cached raw content,
+    extracted text, or metadata row (ViewFile.java viewMode semantics)."""
+    prop = ServerObjects()
+    url = post.get("url", "")
+    mode = post.get("viewMode", "parsed")
+    if not url:
+        prop.put("info", "missing url")
+        return prop
+    from ...utils.hashes import url2hash
+    docid = sb.index.metadata.docid(url2hash(url))
+    if mode == "raw":
+        got = sb.htcache.get(url)
+        if got is None:
+            prop.put("info", "not in cache")
+            return prop
+        content, headers = got
+        prop.raw_body = content
+        prop.raw_ctype = headers.get("content-type",
+                                     "application/octet-stream")
+        return prop
+    if docid is None:
+        prop.put("info", "not indexed")
+        return prop
+    m = sb.index.metadata.get(docid)
+    prop.put("url", escape_json(url))
+    prop.put("title", escape_json(m.get("title", "")))
+    prop.put("docid", docid)
+    if mode == "metadata":
+        for k, v in sorted(m.fields.items()):
+            if k != "text_t":
+                prop.put(f"field_{k}", escape_json(str(v)))
+    else:   # parsed text
+        prop.put("text", escape_json(m.get("text_t", "")[:20000]))
+        prop.put("wordcount", m.get("wordcount_i", 0))
+    return prop
+
+
+@servlet("PerformanceGraph")
+def respond_perfgraph(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """EventTracker time-series as a PNG bar graph (ProfilingGraph)."""
+    from ...visualization.raster import RasterPlotter
+    try:
+        ecl = EClass[post.get("set", "SEARCH").upper()]
+    except KeyError:
+        ecl = EClass.SEARCH
+    evs = events(ecl)[-60:]
+    w, h = 640, 240
+    img = RasterPlotter(w, h, background=(10, 10, 30))
+    img.text(8, 6, f"{ecl.name} EVENTS: {len(evs)}", (200, 200, 220))
+    if evs:
+        maxd = max(max(e.duration_ms for e in evs), 1.0)
+        bw = max(2, (w - 20) // max(len(evs), 1))
+        for i, e in enumerate(evs):
+            bh = int((e.duration_ms / maxd) * (h - 60))
+            x = 10 + i * bw
+            img.rect(x, h - 20 - bh, x + bw - 2, h - 20,
+                     (90, 200, 140), fill=True)
+        img.text(8, h - 12, f"MAX {maxd:.1f} MS", (160, 160, 180))
+    prop = ServerObjects()
+    prop.raw_body = img.png_bytes()
+    prop.raw_ctype = "image/png"
+    return prop

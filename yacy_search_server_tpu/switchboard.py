@@ -321,10 +321,21 @@ class Switchboard:
         # site heuristic (reference: Switchboard.heuristicSite:4209): a
         # site:-restricted query that finds little triggers a shallow crawl
         # of that site so the next query round can answer from the index
-        if q.modifier.sitehost and self.config.get_bool(
-                "heuristic.site", False) \
-                and event.result_heap.size_available() < count:
-            self.heuristic_site(q.modifier.sitehost)
+        if not event.heuristics_fired:
+            # one-shot per event: paging / cache hits never re-fire
+            event.heuristics_fired = True
+            if q.modifier.sitehost and self.config.get_bool(
+                    "heuristic.site", False) \
+                    and event.result_heap.size_available() < count:
+                self.heuristic_site(q.modifier.sitehost)
+            # opensearch heuristic: external endpoints late-merge into the
+            # live event (FederateSearchManager; results appear on paging)
+            if self.config.get_bool("heuristic.opensearch", False) \
+                    and q.goal.include_words:
+                from .search.federated import FederateSearchManager
+                FederateSearchManager.from_config(
+                    self.loader, self.config).search_into_event(
+                        event, " ".join(q.goal.include_words))
         return event
 
     # heuristic re-fire cooldown per host (the reference's heuristics are
