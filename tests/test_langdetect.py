@@ -1,0 +1,81 @@
+"""Language detection + the metadata/statistical/TLD vote + pause backpressure."""
+
+import time
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.document.langdetect import (detect_language,
+                                                        tld_hint,
+                                                        vote_language)
+from yacy_search_server_tpu.index.segment import Segment
+
+EN = ("the quick brown fox jumps over the lazy dog and it was the best of "
+      "times for all of the people that had come from far away")
+DE = ("der schnelle braune fuchs springt über den faulen hund und es war "
+      "die beste von allen zeiten für die menschen die von weit her kamen")
+FR = ("le renard brun rapide saute sur le chien paresseux et c'était le "
+      "meilleur des temps pour les gens qui venaient de loin avec un grand")
+
+
+def test_detect_language_basic():
+    assert detect_language(EN) == "en"
+    assert detect_language(DE) == "de"
+    assert detect_language(FR) == "fr"
+    assert detect_language("too short") == ""
+    assert detect_language("zzz qqq xxx yyy www vvv uuu ttt sss rrr") == ""
+
+
+def test_tld_hint():
+    assert tld_hint("http://example.de/page") == "de"
+    assert tld_hint("http://example.com/page") == ""
+
+
+def test_vote_language():
+    # metadata confirmed by statistics
+    assert vote_language("en", EN) == "en"
+    # silent metadata: statistics decide
+    assert vote_language("", DE) == "de"
+    # conflict + TLD agrees with metadata -> metadata kept
+    assert vote_language("de", EN, "http://site.de/x") == "de"
+    # conflict + TLD disagrees -> statistics win
+    assert vote_language("de", EN, "http://site.fr/x") == "en"
+    # nothing statistical: TLD fallback
+    assert vote_language("", "short", "http://site.de/x") == "de"
+
+
+def test_store_document_votes_language():
+    seg = Segment()
+    docid = seg.store_document(Document(
+        url="http://lang.test/de.html", title="Seite", text=DE))
+    assert seg.metadata.get(docid).get("language_s") == "de"
+    seg.close()
+
+
+def test_dispatcher_honors_pause(tmp_path):
+    from yacy_search_server_tpu.peers.node import P2PNode
+    from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+    net = LoopbackNetwork()
+    a = P2PNode("pa", net, data_dir=str(tmp_path / "a"), redundancy=1)
+    b = P2PNode("pb", net, data_dir=str(tmp_path / "b"), redundancy=1)
+    try:
+        a.bootstrap([b.seed])
+        a.ping()
+        a.sb.index.store_document(Document(
+            url="http://pp.test/x.html", title="x", text="pauseterm body"))
+        # receiver refuses: not granted + pause hint
+        b.server.accept_remote_index = False
+        moved = a.distribute_all()
+        assert moved == 0                        # nothing delivered...
+        assert a.dispatcher.buffer_size() > 0    # ...and nothing lost
+        assert b.seed.hash in a.dispatcher._paused_until
+        # while paused, dequeue defers the cells instead of sending
+        assert a.dispatcher.dequeue_transmissions() == []
+        # pause expiry + receiver recovery -> delivery succeeds
+        a.dispatcher._paused_until[b.seed.hash] = time.time() - 1
+        b.server.accept_remote_index = True
+        txs = a.dispatcher.dequeue_transmissions(max_chunks=64)
+        assert a.dispatcher.transmit_all(txs) > 0
+    finally:
+        a.close()
+        b.close()

@@ -1,0 +1,96 @@
+"""Language identification — stopword profiles + the reference's vote.
+
+Capability equivalent of the reference's language handling (reference:
+source/net/yacy/document/language/ (langdetect profiles) and the vote in
+search/index/Segment.java:492 — the indexed language is decided between
+the parser's metadata language, the statistical detection over the text,
+and the URL's TLD hint). Profiles here are high-frequency stopword sets
+per language: tiny, dependency-free, and accurate enough for the
+whole-document decision the index needs (the reference's n-gram profiles
+solve the same problem with more bytes).
+"""
+
+from __future__ import annotations
+
+import re
+
+_TOKEN_RE = re.compile(r"[a-zà-ÿа-я]+")
+
+# high-frequency function words per language (lowercase)
+_PROFILES: dict[str, frozenset] = {
+    "en": frozenset("the of and to in is was for that it with as his on be "
+                    "at by are this had not have from".split()),
+    "de": frozenset("der die das und ist von den mit für auf des im ein "
+                    "eine nicht werden sich dem als auch".split()),
+    "fr": frozenset("le la les des et de un une est dans pour que qui sur "
+                    "avec pas au aux par plus".split()),
+    "es": frozenset("el la los las de y en que es un una por con para del "
+                    "se su no más como".split()),
+    "it": frozenset("il la le di e che un una per in del con non sono al "
+                    "dei più come anche".split()),
+    "pt": frozenset("o a os as de e que um uma do da em para com não por "
+                    "mais se como foi".split()),
+    "nl": frozenset("de het een en van in is dat op te met voor niet zijn "
+                    "aan er ook als".split()),
+    "ru": frozenset("и в не на что с по как это из у за от так же для "
+                    "его к но".split()),
+    "sv": frozenset("och att det i en som är av på för med den till inte "
+                    "om har de".split()),
+    "pl": frozenset("i w na z do się nie jest że to po o jak ale za od "
+                    "przez przy".split()),
+}
+
+_TLD_LANG = {
+    "de": "de", "at": "de", "fr": "fr", "es": "es", "it": "it", "pt": "pt",
+    "br": "pt", "nl": "nl", "ru": "ru", "se": "sv", "pl": "pl", "uk": "en",
+    "us": "en", "au": "en", "ie": "en", "nz": "en",
+}
+
+MIN_TOKENS = 8          # below this the text carries too little signal
+MIN_MARGIN = 1.25       # best score must beat the runner-up by this factor
+
+
+def detect_language(text: str, max_tokens: int = 2000) -> str:
+    """Best-profile language code, or '' when unsure."""
+    # slice BEFORE lowercasing: .lower() of a multi-MB body would copy it
+    tokens = _TOKEN_RE.findall(text[: max_tokens * 12].lower())[:max_tokens]
+    if len(tokens) < MIN_TOKENS:
+        return ""
+    scores = {lang: 0 for lang in _PROFILES}
+    for t in tokens:
+        for lang, words in _PROFILES.items():
+            if t in words:
+                scores[lang] += 1
+    ranked = sorted(scores.items(), key=lambda kv: -kv[1])
+    best, second = ranked[0], ranked[1]
+    if best[1] == 0:
+        return ""
+    if second[1] and best[1] / second[1] < MIN_MARGIN:
+        return ""
+    return best[0]
+
+
+def tld_hint(url: str) -> str:
+    from ..utils.hashes import safe_host
+    host = safe_host(url)
+    tld = host.rsplit(".", 1)[-1] if "." in host else ""
+    return _TLD_LANG.get(tld, "")
+
+
+def vote_language(meta_lang: str, text: str, url: str = "") -> str:
+    """The Segment.java:492 vote: parser metadata wins when the
+    statistical detection agrees or abstains; a confident statistical
+    result overrides silent/conflicting metadata; the TLD breaks ties."""
+    meta = (meta_lang or "").lower()[:2]
+    stat = detect_language(text)
+    if meta and (stat == meta or not stat):
+        return meta
+    if stat:
+        if not meta:
+            return stat
+        # conflict: TLD is the tiebreaker
+        hint = tld_hint(url)
+        if hint == meta:
+            return meta
+        return stat
+    return tld_hint(url) or meta

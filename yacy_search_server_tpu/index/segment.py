@@ -30,6 +30,7 @@ import numpy as np
 
 from ..document.condenser import Condenser
 from ..document.document import Document
+from ..document.langdetect import vote_language
 from ..utils.eventtracker import EClass, StageTimer
 from ..utils.hashes import url2hash, word2hash
 from . import postings as P
@@ -83,6 +84,9 @@ class Segment:
         """Index one parsed document; returns its docid."""
         with StageTimer(EClass.INDEX, "storeDocument", 1):
             urlhash = url2hash(doc.url)
+            # language vote (Segment.java:492): metadata vs statistical
+            # detection vs TLD hint — every doc gets its best-known lang
+            doc.language = vote_language(doc.language, doc.text, doc.url)
             if self.gazetteer is not None and not doc.lat and not doc.lon:
                 hit = self.gazetteer.locate_text(
                     f"{doc.title}\n{' '.join(doc.keywords)}\n{doc.text[:2048]}")

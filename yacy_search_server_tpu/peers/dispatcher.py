@@ -16,6 +16,7 @@ per-entry loop.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -58,10 +59,16 @@ class Transmission:
     def posting_count(self) -> int:
         return sum(len(p) for p, _ in self.containers.values())
 
-    def transmit(self, protocol: Protocol) -> bool:
-        ok, _reply = protocol.transfer_index(
+    def transmit(self, protocol: Protocol) -> tuple[bool, float]:
+        """-> (ok, pause_s): the receiver's backpressure hint
+        (transferRWI 'pause' reply field)."""
+        ok, reply = protocol.transfer_index(
             self.target, self.containers, self.metadata_rows)
-        return ok
+        try:
+            pause = float(reply.get("pause", 0) or 0)
+        except (TypeError, ValueError):
+            pause = 0.0
+        return ok, pause
 
 
 class Dispatcher:
@@ -77,6 +84,9 @@ class Dispatcher:
         # (termhash, partition) -> (PostingsList, urlhashes)
         self._buffer: dict[tuple[bytes, int],
                            tuple[PostingsList, list[bytes]]] = {}
+        # per-target backpressure: peer hash -> resume timestamp (the
+        # receiver's 'pause' hints, honored like the reference's sender)
+        self._paused_until: dict[bytes, float] = {}
         self._lock = threading.Lock()
         self.transferred_postings = 0
         self.failed_transmissions = 0
@@ -161,11 +171,22 @@ class Dispatcher:
             cells = [(k, self._buffer.pop(k)) for k in keys]
         per_target: dict[bytes, Transmission] = {}
         unsendable = []
+        now = time.time()
+        with self._lock:
+            self._paused_until = {h: t for h, t in
+                                  self._paused_until.items() if t > now}
+            paused = set(self._paused_until)
         for (th, part), (plist, uhs) in cells:
-            targets = select_distribution_targets(
+            owners = select_distribution_targets(
                 self.seeddb, self.dist, th, part, self.redundancy)
-            if not targets:
+            # honor receiver backpressure: paused owners get their replica
+            # later — the cell is RE-BUFFERED whenever any owner is
+            # skipped, so redundancy is never silently degraded (re-sent
+            # postings dedup by docid on the receive side)
+            targets = [t for t in owners if t.hash not in paused]
+            if not targets or len(targets) < len(owners):
                 unsendable.append(((th, part), (plist, uhs)))
+            if not targets:
                 continue
             rows = {uh: self._metadata_row(uh) for uh in set(uhs)}
             for t in targets:
@@ -190,7 +211,12 @@ class Dispatcher:
         (Transmission.java failure path). Returns postings delivered."""
         sent = 0
         for tx in transmissions:
-            if tx.transmit(self.protocol):
+            ok, pause_s = tx.transmit(self.protocol)
+            if pause_s > 0:
+                with self._lock:
+                    self._paused_until[tx.target.hash] = \
+                        time.time() + pause_s
+            if ok:
                 sent += tx.posting_count()
             else:
                 self.failed_transmissions += 1

@@ -188,6 +188,11 @@ class Protocol:
                                    {"entries": entries})
             if not ok:
                 return False, {}
+            if reply.get("result") not in ("ok", None):
+                # receiver refused ("not granted"/"busy"): nothing was
+                # stored — treat as failure so the caller re-enqueues
+                # (delete-on-select postings must never be dropped)
+                return False, reply
             unknown.extend(u.encode("ascii")
                            for u in reply.get("unknownURL", []))
         if unknown:
