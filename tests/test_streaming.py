@@ -1,0 +1,84 @@
+"""Streaming block scorer — parity with the one-shot kernel."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.ops import ranking as R
+from yacy_search_server_tpu.ops.streaming import (scan_score_topk,
+                                                  stream_score_topk)
+
+
+def _block(n, seed=0):
+    rng = np.random.default_rng(seed)
+    feats = rng.integers(0, 900, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2**20, n)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    docids = np.arange(n, dtype=np.int32)
+    hostids = rng.integers(0, 50, n).astype(np.int32)
+    return feats, docids, hostids
+
+
+def _consts(prof):
+    return (jnp.asarray(prof.norm_coeffs()),
+            *map(jnp.asarray, prof.flag_coeffs()),
+            jnp.int32(prof.domlength), jnp.int32(prof.tf),
+            jnp.int32(prof.language), jnp.int32(prof.authority))
+
+
+def _reference_topk(feats, docids, hostids, prof, k):
+    r = R.CardinalRanker(prof, "en")
+    f16, flags = R.compact_feats(feats)
+    n = len(docids)
+    s = np.asarray(R.cardinal_scores16(
+        jnp.asarray(f16), jnp.asarray(flags), jnp.ones(n, bool),
+        jnp.asarray(hostids), None, r._norm, r._bits, r._shifts, r._dl,
+        r._tf, r._lang_c, r._auth, r._lang, with_authority=False))
+    order = np.argsort(-s.astype(np.int64), kind="stable")[:k]
+    return s[order], docids[order]
+
+
+def test_scan_score_topk_matches_oneshot():
+    n, k, tile = 4096, 50, 512
+    feats, docids, hostids = _block(n)
+    prof = R.RankingProfile()
+    f16, flags = R.compact_feats(feats)
+    stats = R.local_stats(jnp.asarray(f16), jnp.ones(n, bool),
+                          jnp.asarray(hostids), num_hosts=1,
+                          with_host_counts=False)
+    got_s, got_d = scan_score_topk(
+        jnp.asarray(f16), jnp.asarray(flags), jnp.asarray(docids),
+        jnp.ones(n, bool), jnp.asarray(hostids), stats, *_consts(prof),
+        jnp.int32(P.pack_language("en")), k, tile)
+    want_s, _want_d = _reference_topk(feats, docids, hostids, prof, k)
+    # scores must match exactly; docid order may differ only inside ties
+    np.testing.assert_array_equal(np.asarray(got_s), want_s)
+
+
+def test_stream_score_topk_matches_oneshot():
+    n, k = 10_000, 64
+    feats, docids, hostids = _block(n, seed=3)
+    prof = R.RankingProfile()
+    f16, flags = R.compact_feats(feats)
+    got_s, got_d = stream_score_topk(
+        f16, flags, docids, hostids, _consts(prof),
+        jnp.int32(P.pack_language("en")), k=k, chunk=2048)
+    want_s, _ = _reference_topk(feats, docids, hostids, prof, k)
+    np.testing.assert_array_equal(got_s, want_s)
+    assert len(got_d) == k
+
+
+def test_stream_handles_small_and_empty():
+    prof = R.RankingProfile()
+    feats, docids, hostids = _block(10, seed=5)
+    f16, flags = R.compact_feats(feats)
+    s, d = stream_score_topk(f16, flags, docids, hostids, _consts(prof),
+                             jnp.int32(P.pack_language("en")), k=100,
+                             chunk=4)
+    assert len(s) == 10            # fewer rows than k: all returned
+    s0, d0 = stream_score_topk(
+        np.empty((0, P.NF), np.int16), np.empty(0, np.int32),
+        np.empty(0, np.int32), np.empty(0, np.int32), _consts(prof),
+        jnp.int32(0), k=10)
+    assert len(s0) == 0 and len(d0) == 0

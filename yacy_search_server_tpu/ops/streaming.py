@@ -1,0 +1,141 @@
+"""Streaming block scorer — unbounded postings through a running top-k.
+
+The long-context story of this framework (SURVEY.md §5): the reference's
+unbounded dimensions (per-term postings lists, result sets) are handled
+there by LSM splits and bounded heaps; on TPU the analogous mechanism is
+*streaming* — postings blocks flow tile-by-tile through the scoring
+kernel while a running top-k rides in the carry of a `lax.scan`, the
+same shape ring attention gives long sequences (block in, running state
+through). Two layers:
+
+- `scan_score_topk`: device-resident [n, NF] block processed in fixed
+  tiles under one jit — peak live memory is one tile + the carry, so a
+  block bigger than any single fused-scoring working set still scores.
+- `stream_score_topk`: host-side driver feeding device tiles from a
+  numpy array (or any chunk iterator) — blocks larger than device HBM
+  score in bounded memory, merging each tile's top-k into the running
+  result exactly like SearchEvent's bounded heap absorbed RWI entries
+  (reference: SearchEvent.java:809 rwiStack heap loop).
+
+Stats (min/max normalization bounds) must be block-global, so both
+drivers take precomputed `stats` — for streamed blocks the caller
+accumulates them per chunk via `merge_stats` (min/min, max/max, sum)
+before the scoring pass, mirroring parallel/mesh.py's cross-shard merge.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..index import postings as P
+from .ranking import cardinal_from_stats, local_stats
+
+NEG_INF32 = -(2**31 - 1)
+
+
+def merge_stats(a: dict | None, b: dict) -> dict:
+    """Combine per-chunk stats (same laws as the mesh pmin/pmax/psum)."""
+    if a is None:
+        return b
+    return {
+        "col_min": jnp.minimum(a["col_min"], b["col_min"]),
+        "col_max": jnp.maximum(a["col_max"], b["col_max"]),
+        "tf_min": jnp.minimum(a["tf_min"], b["tf_min"]),
+        "tf_max": jnp.maximum(a["tf_max"], b["tf_max"]),
+        "host_counts": a["host_counts"] + b["host_counts"],
+    }
+
+
+def _merge_topk(run_s, run_d, new_s, new_d, k: int):
+    s = jnp.concatenate([run_s, new_s])
+    d = jnp.concatenate([run_d, new_d])
+    top_s, idx = jax.lax.top_k(s, k)
+    return top_s, d[idx]
+
+
+@partial(jax.jit, static_argnames=("k", "tile"))
+def scan_score_topk(feats16: jnp.ndarray, flags: jnp.ndarray,
+                    docids: jnp.ndarray, valid: jnp.ndarray,
+                    hostids: jnp.ndarray, stats: dict,
+                    norm_coeffs: jnp.ndarray, flag_bits: jnp.ndarray,
+                    flag_shifts: jnp.ndarray, domlength_coeff: jnp.ndarray,
+                    tf_coeff: jnp.ndarray, language_coeff: jnp.ndarray,
+                    authority_coeff: jnp.ndarray, language_pref: jnp.ndarray,
+                    k: int, tile: int = 1 << 20):
+    """Device streaming: score in `tile`-row slices under lax.scan with a
+    running (scores, docids) top-k carry. n must be a tile multiple
+    (pad_to takes care of it)."""
+    n = feats16.shape[0]
+    steps = n // tile
+    f = feats16.reshape(steps, tile, P.NF)
+    fl = flags.reshape(steps, tile)
+    dd = docids.reshape(steps, tile)
+    vv = valid.reshape(steps, tile)
+    hh = hostids.reshape(steps, tile)
+
+    init = (jnp.full((k,), NEG_INF32, jnp.int32),
+            jnp.full((k,), -1, jnp.int32))
+
+    def step(carry, xs):
+        run_s, run_d = carry
+        tf16, tfl, tdd, tvv, thh = xs
+        s = cardinal_from_stats(tf16, tvv, thh, stats, norm_coeffs,
+                                flag_bits, flag_shifts, domlength_coeff,
+                                tf_coeff, language_coeff, authority_coeff,
+                                language_pref, fast_div=True, flags=tfl)
+        tile_s, tile_i = jax.lax.top_k(s, min(k, tile))
+        return _merge_topk(run_s, run_d, tile_s, tdd[tile_i], k), None
+
+    (top_s, top_d), _ = jax.lax.scan(step, init, (f, fl, dd, vv, hh))
+    return top_s, top_d
+
+
+def stream_score_topk(feats: np.ndarray, flags: np.ndarray,
+                      docids: np.ndarray, hostids: np.ndarray,
+                      ranker_consts: tuple, language_pref,
+                      k: int = 100, chunk: int = 1 << 21,
+                      with_authority: bool = False):
+    """Host streaming: numpy block -> device chunks -> running top-k.
+
+    Peak device memory is one chunk regardless of block size; two passes
+    (stats, then score) keep normalization block-global. Returns
+    (scores, docids) np arrays, best-first."""
+    n = len(docids)
+    if n == 0:
+        return (np.empty(0, np.int32), np.empty(0, np.int32))
+
+    # pass 1: accumulate block-global stats chunk by chunk
+    stats = None
+    for lo in range(0, n, chunk):
+        hi = min(n, lo + chunk)
+        cs = local_stats(jnp.asarray(feats[lo:hi]),
+                         jnp.ones(hi - lo, bool),
+                         jnp.asarray(hostids[lo:hi]),
+                         num_hosts=1, with_host_counts=False)
+        stats = merge_stats(stats, cs)
+    if not with_authority:
+        stats = dict(stats)
+        stats["host_counts"] = jnp.zeros(1, jnp.int32)
+
+    # pass 2: score chunks, merge into the running top-k
+    run_s = jnp.full((k,), NEG_INF32, jnp.int32)
+    run_d = jnp.full((k,), -1, jnp.int32)
+    for lo in range(0, n, chunk):
+        hi = min(n, lo + chunk)
+        s = cardinal_from_stats(
+            jnp.asarray(feats[lo:hi]), jnp.ones(hi - lo, bool),
+            jnp.asarray(hostids[lo:hi]), stats, *ranker_consts,
+            language_pref, fast_div=feats.dtype == np.int16,
+            flags=jnp.asarray(flags[lo:hi]))
+        kk = min(k, hi - lo)
+        tile_s, tile_i = jax.lax.top_k(s, kk)
+        run_s, run_d = _merge_topk(
+            run_s, run_d, tile_s,
+            jnp.asarray(docids[lo:hi])[tile_i], k)
+    s_np, d_np = np.asarray(run_s), np.asarray(run_d)
+    keep = d_np >= 0
+    return s_np[keep], d_np[keep]
