@@ -113,7 +113,13 @@ def _config2_bm25_tpu(k=100, ndocs=1_000_000, iters=20):
     import numpy as np
     from yacy_search_server_tpu.ops import ranking
     tf, doclen, df = _synth_bm25_corpus(ndocs)
-    cpu_qps = _cpu_qps(lambda: ranking.bm25_scores_np(tf, doclen, df, ndocs))
+
+    def cpu_one():     # same work as the device path: score + top-k
+        s = ranking.bm25_scores_np(tf, doclen, df, ndocs)
+        idx = np.argpartition(-s, k)[:k]
+        return idx[np.argsort(-s[idx])]
+
+    cpu_qps = _cpu_qps(cpu_one)
     dev = jax.devices()[0]
     args = [jax.device_put(x, dev) for x in
             (tf, doclen, df)] + [jnp.int32(ndocs),
@@ -177,8 +183,18 @@ def _config5_hybrid(k=100, ndocs=100_000, iters=20):
     qvec = doc_vecs[17] + 0.1 * rng.standard_normal(dim).astype(np.float32)
     sparse = rng.integers(0, 10**6, ndocs).astype(np.float32)
     valid = np.ones(ndocs, bool)
-    cpu_qps = _cpu_qps(lambda: dense.hybrid_rerank_topk_np(
-        qvec, doc_vecs, sparse, valid, 0.5, k))
+
+    def cpu_one():
+        # same work as the device path: cosine + blend + PARTIAL top-k
+        # (the oracle's full argsort would unfairly slow the baseline)
+        sims = doc_vecs @ qvec
+        smin, smax = sparse.min(), sparse.max()
+        final = (1 - 0.5) * ((sparse - smin) / max(smax - smin, 1e-6)) \
+            + 0.5 * sims
+        idx = np.argpartition(-final, k)[:k]
+        return idx[np.argsort(-final[idx])]
+
+    cpu_qps = _cpu_qps(cpu_one)
     dev = jax.devices()[0]
     a = [jax.device_put(x, dev) for x in (qvec, doc_vecs, sparse, valid)]
     out = dense.hybrid_rerank_topk(*a, jnp.float32(0.5), k)

@@ -353,3 +353,25 @@ def test_query_id_distinguishes_hash_level_excludes():
     q2.goal._include_hashes_override = [word2hash("a")]
     q2.goal._exclude_hashes_override = [word2hash("c")]
     assert q1.query_id() != q2.query_id()
+
+
+def test_switch_network_rewires_dht(tmp_path):
+    from yacy_search_server_tpu.peers.node import P2PNode
+    from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+    net = LoopbackNetwork()
+    a = P2PNode("sw", net, data_dir=str(tmp_path / "sw"))
+    try:
+        assert a.dist.vertical_partitions() == 16     # freeworld default
+        a.sb.index.store_document(_doc("http://sw.test/1", "t", "switch term"))
+        # buffer something, then switch: buffered postings must come home
+        a.dispatcher.select_containers_to_buffer(0, (1 << 63) - 1, 10**6, 10**9)
+        assert a.dispatcher.buffer_size() > 0
+        a.switch_network("intranet")
+        assert a.dispatcher.buffer_size() == 0
+        assert a.dist.vertical_partitions() == 1      # intranet: exponent 0
+        assert a.redundancy == 1
+        assert a.sb.config.get("network.unit.definition") == "intranet"
+        # the index kept its postings through the switch
+        assert len(a.sb.index.term_search(include_words=["switch"])) == 1
+    finally:
+        a.close()

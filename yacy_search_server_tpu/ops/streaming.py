@@ -67,10 +67,21 @@ def scan_score_topk(feats16: jnp.ndarray, flags: jnp.ndarray,
                     authority_coeff: jnp.ndarray, language_pref: jnp.ndarray,
                     k: int, tile: int = 1 << 20):
     """Device streaming: score in `tile`-row slices under lax.scan with a
-    running (scores, docids) top-k carry. n must be a tile multiple
-    (pad_to takes care of it)."""
+    running (scores, docids) top-k carry. Inputs of any length are padded
+    to a whole number of tiles here (padding rows are invalid and score
+    the sentinel). NB: the outputs are fixed-shape [k]; when fewer than k
+    valid rows exist the tail carries docid -1 at the sentinel score —
+    host callers filter `docids >= 0` (stream_score_topk does)."""
     n = feats16.shape[0]
-    steps = n // tile
+    npad = max(tile, ((n + tile - 1) // tile) * tile)
+    if npad != n:
+        pad = npad - n
+        feats16 = jnp.pad(feats16, ((0, pad), (0, 0)))
+        flags = jnp.pad(flags, (0, pad))
+        docids = jnp.pad(docids, (0, pad), constant_values=-1)
+        valid = jnp.pad(valid, (0, pad), constant_values=False)
+        hostids = jnp.pad(hostids, (0, pad))
+    steps = npad // tile
     f = feats16.reshape(steps, tile, P.NF)
     fl = flags.reshape(steps, tile)
     dd = docids.reshape(steps, tile)
@@ -97,13 +108,17 @@ def scan_score_topk(feats16: jnp.ndarray, flags: jnp.ndarray,
 def stream_score_topk(feats: np.ndarray, flags: np.ndarray,
                       docids: np.ndarray, hostids: np.ndarray,
                       ranker_consts: tuple, language_pref,
-                      k: int = 100, chunk: int = 1 << 21,
-                      with_authority: bool = False):
+                      k: int = 100, chunk: int = 1 << 21):
     """Host streaming: numpy block -> device chunks -> running top-k.
 
     Peak device memory is one chunk regardless of block size; two passes
     (stats, then score) keep normalization block-global. Returns
-    (scores, docids) np arrays, best-first."""
+    (scores, docids) np arrays, best-first.
+
+    The domain-authority signal needs block-global per-host counts that
+    this driver does not accumulate — streamed scoring always behaves as
+    if the profile's authority guard is off (authority <= 12, the
+    default); use the one-shot kernel for authority-boosted profiles."""
     n = len(docids)
     if n == 0:
         return (np.empty(0, np.int32), np.empty(0, np.int32))
@@ -117,9 +132,6 @@ def stream_score_topk(feats: np.ndarray, flags: np.ndarray,
                          jnp.asarray(hostids[lo:hi]),
                          num_hosts=1, with_host_counts=False)
         stats = merge_stats(stats, cs)
-    if not with_authority:
-        stats = dict(stats)
-        stats["host_counts"] = jnp.zeros(1, jnp.int32)
 
     # pass 2: score chunks, merge into the running top-k
     run_s = jnp.full((k,), NEG_INF32, jnp.int32)

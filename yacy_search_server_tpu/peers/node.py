@@ -66,8 +66,36 @@ class P2PNode:
         self.dispatcher = Dispatcher(self.sb.index, self.seeddb, self.dist,
                                      self.protocol, redundancy)
         self.network = Network(self.seeddb, self.protocol)
+        # active network definition; ctor args override its DHT geometry
+        from ..utils.config import NetworkUnit
+        self.network_unit = NetworkUnit("freeworld", {
+            "network.unit.dht.partitionExponent": str(partition_exponent),
+            "network.unit.dhtredundancy.senior": str(redundancy)})
         self.cluster_peers = list(cluster_peers or [])
         self._rng = random.Random(self.seed.ring_position())
+
+    # -- network definition ---------------------------------------------------
+
+    def switch_network(self, unit_name: str, overrides=None) -> None:
+        """Re-wire DHT + crawl behavior to another network definition at
+        runtime (reference: Switchboard.switchNetwork selected by
+        `network.unit.definition`): partition exponent, redundancy and
+        remote-search budgets come from the unit; buffered outbound
+        postings return to the local index first (their vertical split
+        depends on the partition count)."""
+        from ..utils.config import NETWORK_UNITS, NetworkUnit
+        if unit_name not in NETWORK_UNITS:
+            # a typo must not silently rewire the node onto the PUBLIC net
+            raise ValueError(f"unknown network unit: {unit_name!r} "
+                             f"(have: {sorted(NETWORK_UNITS)})")
+        unit = NetworkUnit(unit_name, overrides)
+        self.dispatcher.restore_buffer_to_index()
+        self.dist = Distribution(unit.partition_exponent)
+        self.redundancy = unit.redundancy_senior
+        self.dispatcher = Dispatcher(self.sb.index, self.seeddb, self.dist,
+                                     self.protocol, self.redundancy)
+        self.network_unit = unit
+        self.sb.config.set("network.unit.definition", unit.name)
 
     # -- membership ----------------------------------------------------------
 
@@ -183,19 +211,24 @@ class P2PNode:
     # -- search --------------------------------------------------------------
 
     def search(self, query_string: str, count: int = 10,
-               remote: bool = True, timeout_s: float = 3.0,
+               remote: bool = True, timeout_s: float | None = None,
                secondary: bool = True) -> SearchEvent:
         """Local batched search + remote scatter-gather into one event
         (the yacysearch entry: local threads + primaryRemoteSearches).
+        The per-peer budget defaults to the active network unit's
+        remotesearch.maxtime/maxcount.
 
         Cluster mode (reference: cluster.peers.yacydomain allowlist ->
         Searchdom.CLUSTER): when `cluster_peers` is set, the scatter goes to
         exactly that fixed peer set instead of DHT-selected targets."""
+        if timeout_s is None:
+            timeout_s = self.network_unit.remotesearch_maxtime_ms / 1000.0
+        per_peer = max(count, self.network_unit.remotesearch_maxcount)
         event = self.sb.search(query_string, count=count)
         if remote and self.seeddb.active:
             rs = RemoteSearch(event, self.seeddb, self.dist, self.protocol,
                               redundancy=self.redundancy,
-                              per_peer_count=count, timeout_s=timeout_s)
+                              per_peer_count=per_peer, timeout_s=timeout_s)
             if self.cluster_peers:
                 allowed = {n.lower() for n in self.cluster_peers}
                 targets = [s for s in self.seeddb.active_seeds()
