@@ -375,3 +375,18 @@ def test_switch_network_rewires_dht(tmp_path):
         assert len(a.sb.index.term_search(include_words=["switch"])) == 1
     finally:
         a.close()
+
+
+def test_idx_and_list_rpcs(trio):
+    _net, (a, b, c) = trio
+    _index_corpus(b)
+    stats = a.protocol.idx(b.seed)
+    assert stats["urls"] == 3 and stats["words"] > 0
+    # blacklist sharing is per-list consent-gated
+    b.sb.blacklist.add("default", "spam.test/.*", types={"crawler"})
+    b.sb.blacklist.add("private", "internal.test/.*", types={"crawler"})
+    assert a.protocol.fetch_blacklist(b.seed) == []
+    b.sb.config.set("blacklist.share.lists", "default")
+    shared = a.protocol.fetch_blacklist(b.seed)
+    assert "spam.test/.*" in shared
+    assert "internal.test/.*" not in shared   # unshared list never leaks

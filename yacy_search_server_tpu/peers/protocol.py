@@ -204,6 +204,19 @@ class Protocol:
             reply = {**reply, **reply2}
         return True, reply
 
+    def idx(self, target: Seed) -> dict:
+        """Peer index statistics (htroot/yacy/idx.java server side).
+        Returns {} for unreachable peers AND for peers answering with an
+        error shape (older versions without the handler)."""
+        ok, reply = self._call(target, "idx", {})
+        return reply if ok and "urls" in reply else {}
+
+    def fetch_blacklist(self, target: Seed) -> list[str]:
+        """Pull a peer's shared url blacklist (htroot/yacy/list.java,
+        col=black) for cooperative filtering."""
+        ok, reply = self._call(target, "list", {"col": "black"})
+        return list(reply.get("list", [])) if ok else []
+
     # -- messages + profile ---------------------------------------------------
 
     def message(self, target: Seed, subject: str, content: str) -> bool:

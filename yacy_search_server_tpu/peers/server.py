@@ -196,6 +196,32 @@ class PeerServer:
         self.received_url_count += stored
         return {"result": "ok", "stored": stored}
 
+    def do_idx(self, payload: dict) -> dict:
+        """Index statistics for peer-to-peer capacity planning
+        (htroot/yacy/idx.java — urls/words counts per peer)."""
+        return {"urls": self.sb.index.doc_count(),
+                "words": self.sb.index.rwi_size(),
+                "rwi_runs": self.sb.index.rwi.run_count()}
+
+    def do_list(self, payload: dict) -> dict:
+        """Share blacklist entries with peers (htroot/yacy/list.java —
+        col=black returns the url blacklist for cooperative filtering).
+        Only the lists NAMED in `blacklist.share.lists` leave the node
+        (per-list consent, the reference's shared-list selection): a
+        private list next to a shared one must never leak."""
+        if payload.get("col") != "black":
+            return {"list": []}
+        bl = getattr(self.sb, "blacklist", None)
+        shared_names = {n.strip() for n in self.sb.config.get(
+            "blacklist.share.lists", "").split(",") if n.strip()}
+        if bl is None or not shared_names:
+            return {"list": []}
+        out: list[str] = []
+        for name in bl.list_names():
+            if name in shared_names:
+                out.extend(bl.entries(name))
+        return {"list": out[:10_000]}
+
     # -- messages + profile ---------------------------------------------------
 
     MAX_MESSAGE_SIZE = 32_768
