@@ -217,10 +217,10 @@ class PeerServer:
         if bl is None or not shared_names:
             return {"list": []}
         out: list[str] = []
-        for name in bl.list_names():
-            if name in shared_names:
-                out.extend(bl.entries(name))
-        return {"list": out[:10_000]}
+        for name in sorted(shared_names):   # entries([]) for unknown names
+            out.extend(bl.entries(name))
+        cap = 10_000
+        return {"list": out[:cap], "truncated": len(out) > cap}
 
     # -- messages + profile ---------------------------------------------------
 
