@@ -88,7 +88,10 @@ class AccessTracker:
         window (callers throttle above a threshold)."""
         now = time.time()
         with self._lock:
-            times = self._host_access.setdefault(client_host, deque())
+            # maxlen bounds a flooding client's memory; the window prune
+            # below keeps the COUNT honest for throttling decisions
+            times = self._host_access.setdefault(
+                client_host, deque(maxlen=20_000))
             times.append(now)
             cutoff = now - window_s
             while times and times[0] < cutoff:

@@ -146,6 +146,22 @@ class YaCyHttpServer:
             params = dict(parse_qsl(parts.query, keep_blank_values=True))
             params.update(post_params)
 
+            # host-level access accounting + abuse throttle
+            # (serverAccessTracker parity): every request counts toward
+            # its client's sliding window; past the per-host limit the
+            # node answers 429 instead of serving (localhost exempt)
+            tracker = getattr(self.sb, "access_tracker", None)
+            client_ip = handler.client_address[0]
+            if tracker is not None:
+                hits = tracker.track_access(client_ip)
+                limit = self.sb.config.get_int(
+                    "httpd.maxAccessPerHost.600s", 6000)
+                if hits > limit and client_ip not in ("127.0.0.1", "::1"):
+                    self._send(handler, 429, "text/plain",
+                               b"too many requests",
+                               extra={"Retry-After": "600"})
+                    return
+
             if path.startswith("/yacy/"):
                 self._handle_wire(handler, path, params)
                 return

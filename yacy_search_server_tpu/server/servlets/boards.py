@@ -186,4 +186,10 @@ def respond_accesstracker(header: dict, post: ServerObjects, sb) -> ServerObject
         prop.put(f"queries_{i}_time", int(e.timestamp))
         prop.put(f"queries_{i}_results", e.result_count)
         prop.put(f"queries_{i}_ms", round(e.time_ms, 1))
+    # host-level access counts (serverAccessTracker surface)
+    hosts = sb.access_tracker.access_hosts()[: post.get_int("maxhosts", 25)]
+    prop.put("accesshosts", len(hosts))
+    for i, (host, n) in enumerate(hosts):
+        prop.put(f"accesshosts_{i}_host", escape_json(host))
+        prop.put(f"accesshosts_{i}_count", n)
     return prop
