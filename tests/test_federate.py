@@ -141,3 +141,25 @@ def test_index_export_servlet(fed_server):
     out = _get_json(srv, "/IndexExport_p.json?action=export&file=t.jsonl")
     assert int(out["exported"]) >= 4
     assert out["dumps_0_file"] == "t.jsonl"
+
+
+def test_select_csv_writer(fed_server):
+    sb, srv = fed_server
+    with urllib.request.urlopen(
+            srv.base_url + "/select.csv?q=fedword&wt=csv&fl=sku,title",
+            timeout=10) as r:
+        assert "text/csv" in r.headers["Content-Type"]
+        lines = r.read().decode("utf-8").strip().splitlines()
+    assert lines[0] == "sku,title"
+    assert len(lines) >= 5 and lines[1].startswith('"http://')
+
+
+def test_opensearch_description(fed_server):
+    sb, srv = fed_server
+    with urllib.request.urlopen(srv.base_url + "/opensearchdescription.xml",
+                                timeout=10) as r:
+        body = r.read().decode("utf-8")
+    assert "OpenSearchDescription" in body
+    # templates are ABSOLUTE urls (offline copies must resolve)
+    assert 'template="http://' in body
+    assert "/yacysearch.rss?query={searchTerms}" in body

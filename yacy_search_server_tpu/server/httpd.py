@@ -185,7 +185,9 @@ class YaCyHttpServer:
             post = ServerObjects(params)
             header = {"ext": ext, "path": path,
                       "client_ip": handler.client_address[0],
-                      "method": handler.command}
+                      "method": handler.command,
+                      "host": handler.headers.get(
+                          "Host", f"{self.host}:{self.port}")}
             prop = fn(header, post, self.sb)
             if isinstance(prop.raw_body, bytes):    # binary (PNG graphics)
                 self._send(handler, 200,

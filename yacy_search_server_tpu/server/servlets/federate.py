@@ -90,6 +90,19 @@ def respond_select(header: dict, post: ServerObjects, sb) -> ServerObjects:
             if row is not None:
                 docs.append(row)
 
+    wt = post.get("wt", "json")
+    if wt == "csv":
+        # flat writer (the reference's flat-text/CSV response writers,
+        # cora/federate/solr/responsewriter): header row + one doc/line
+        cols = fl or ["id", "sku", "title", "host_s", "score"]
+        lines = [",".join(cols)]
+        for d in docs:
+            lines.append(",".join(
+                '"' + str(d.get(c, "")).replace('"', '""') + '"'
+                for c in cols))
+        prop.raw_body = "\n".join(lines) + "\n"
+        prop.raw_ctype = "text/csv; charset=utf-8"
+        return prop
     prop.raw_body = json.dumps({
         "responseHeader": {"status": 0, "QTime": 0,
                            "params": {"q": q, "rows": str(rows),
@@ -128,6 +141,32 @@ def respond_push(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop.put("stored", 1)
     prop.put("docid", docid)
     prop.put("urlhash", url2hash(url).decode("ascii", "replace"))
+    return prop
+
+
+@servlet("opensearchdescription")
+def respond_osd(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """OpenSearch description document (reference:
+    htroot/opensearchdescription.java) — lets browsers/aggregators
+    register this node as a search provider."""
+    from ..objects import escape_xml
+    prop = ServerObjects()
+    name = sb.config.get("promoteSearchPageGreeting", "YaCy-TPU Search")
+    # absolute URLs from the request host: saved/offline copies of this
+    # document must still resolve (the reference builds them the same way)
+    base = "http://" + header.get("host", "127.0.0.1:8090")
+    prop.raw_body = (
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<OpenSearchDescription xmlns="http://a9.com/-/spec/opensearch/1.1/">'
+        f"<ShortName>{escape_xml(name[:16])}</ShortName>"
+        f"<Description>{escape_xml(name)} P2P web search</Description>"
+        f'<Url type="application/rss+xml" template="{base}'
+        '/yacysearch.rss?query={searchTerms}&amp;startRecord={startIndex?}"/>'
+        f'<Url type="text/html" template="{base}'
+        '/yacysearch.html?query={searchTerms}"/>'
+        "<InputEncoding>UTF-8</InputEncoding>"
+        "</OpenSearchDescription>")
+    prop.raw_ctype = "application/opensearchdescription+xml; charset=utf-8"
     return prop
 
 
