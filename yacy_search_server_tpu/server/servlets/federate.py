@@ -153,8 +153,9 @@ def respond_osd(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop = ServerObjects()
     name = sb.config.get("promoteSearchPageGreeting", "YaCy-TPU Search")
     # absolute URLs from the request host: saved/offline copies of this
-    # document must still resolve (the reference builds them the same way)
-    base = "http://" + header.get("host", "127.0.0.1:8090")
+    # document must still resolve (the reference builds them the same way).
+    # The Host header is client-controlled: escape it like any attribute.
+    base = escape_xml("http://" + header.get("host", "127.0.0.1:8090"))
     prop.raw_body = (
         '<?xml version="1.0" encoding="UTF-8"?>\n'
         '<OpenSearchDescription xmlns="http://a9.com/-/spec/opensearch/1.1/">'

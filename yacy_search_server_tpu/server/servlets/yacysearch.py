@@ -12,6 +12,7 @@ formats — the template chosen by extension renders the same property set.
 from __future__ import annotations
 
 import time
+from urllib.parse import quote
 
 from ..objects import (ServerObjects, escape_html, escape_json, escape_xml)
 from . import servlet
@@ -42,7 +43,26 @@ def _sizename(n: int) -> str:
     return f"{n} TB"
 
 
-def _fill_navigation(prop: ServerObjects, event, esc) -> None:
+def _mod_value(prefix: str, v: str) -> str:
+    """modifier:value, parenthesized when the value has whitespace (the
+    parser's `prefix:(multi word)` form, query.py _strip_prefix_op)."""
+    return f"{prefix}:({v})" if " " in v else f"{prefix}:{v}"
+
+
+# facet dimension -> query modifier producing the refinement
+# (yacysearchtrailer semantics: facet clicks append a modifier)
+_FACET_MODIFIER = {
+    "hosts": lambda v: _mod_value("site", v),
+    "filetype": lambda v: _mod_value("filetype", v),
+    "authors": lambda v: _mod_value("author", v),
+    "language": lambda v: f"/language/{v}",
+    "year": lambda v: f"daterange:{v}0101..{v}1231",
+    "collections": lambda v: _mod_value("keyword", v),
+}
+
+
+def _fill_navigation(prop: ServerObjects, event, esc,
+                     base_query: str = "", url_suffix: str = "") -> None:
     navs = [(name, nav.top(10)) for name, nav in event.navigators.items()
             if len(nav) > 0]
     prop.put("navigation", len(navs))
@@ -50,10 +70,15 @@ def _fill_navigation(prop: ServerObjects, event, esc) -> None:
         p = f"navigation_{i}_"
         prop.put(p + "facetname", esc(name))
         prop.put(p + "elements", len(entries))
+        mod = _FACET_MODIFIER.get(name)
         for j, (value, count) in enumerate(entries):
             q = f"{p}elements_{j}_"
             prop.put(q + "name", esc(str(value)))
             prop.put(q + "count", count)
+            refined = (f"{base_query} {mod(value)}".strip()
+                       if mod and base_query else base_query)
+            prop.put(q + "url",
+                     "yacysearch.html?query=" + quote(refined) + url_suffix)
             prop.put(q + "eol", 1 if j < len(entries) - 1 else 0)
         prop.put(p + "eol", 1 if i < len(navs) - 1 else 0)
 
@@ -94,7 +119,21 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop.put("totalcount", event.local_rwi_considered + event.remote_results)
     prop.put("found", 1 if results else 0)
     _fill_items(prop, results, esc)
-    _fill_navigation(prop, event, esc)
+    # page size + ranking mode must survive navigation, or page 2 would
+    # re-rank differently and repeat/skip results
+    suffix = f"&maximumRecords={count}"
+    if post.get_bool("hybrid", False):
+        suffix += "&hybrid=true"
+    _fill_navigation(prop, event, esc, base_query=query, url_suffix=suffix)
+    # pagination (yacysearch paging over the cached event)
+    qq = quote(query)
+    prop.put("hasprev", 1 if offset > 0 else 0)
+    prop.put("prevurl", f"yacysearch.html?query={qq}"
+                        f"&startRecord={max(0, offset - count)}{suffix}")
+    more = event.result_heap.size_available() > offset + len(results)
+    prop.put("hasnext", 1 if (more and results) else 0)
+    prop.put("nexturl", f"yacysearch.html?query={qq}"
+                        f"&startRecord={offset + count}{suffix}")
     return prop
 
 
