@@ -173,3 +173,19 @@ def test_crawl_start_records_api_call_and_scheduler(board_server):
                                         now=time.time() + 61) is True
     row = sb.tables.get("api", pk)
     assert row["exec_count"] == 2 and row["last_exec_ok"] is True
+
+
+def test_crawl_start_with_filters_over_http(board_server):
+    sb, srv = board_server
+    sb.latency.min_delta_s = 0.0
+    from urllib.parse import quote
+    out = _get_json(srv, "/Crawler_p.json?crawlingstart=1"
+                         "&crawlingURL=http://filtered.test/"
+                         "&crawlingDepth=1&mustmatch=" + quote(".*filtered.*"))
+    assert out["started"] == "1", out
+    prof = sb.profiles[out["handle"]]
+    assert prof.crawler_url_must_match == ".*filtered.*"
+    # the recorded replay URL carries the filter
+    call = [c for c in sb.work_tables.calls()
+            if "filtered.test" in c["url"]][0]
+    assert "mustmatch=" in call["url"]

@@ -90,3 +90,28 @@ def test_cache_hit_on_recrawl(sb):
 def test_rejected_start_url(sb):
     with pytest.raises(ValueError):
         sb.start_crawl("gopher://nowhere.test/", depth=0)
+
+
+def test_crawl_profiles_survive_restart(tmp_path):
+    from yacy_search_server_tpu.switchboard import Switchboard
+    data = str(tmp_path / "DATA")
+    sb = Switchboard(data_dir=data,
+                     transport=lambda u, h: (404, {}, b""))
+    sb.latency.min_delta_s = 0.0
+    prof = sb.start_crawl("http://persist.test/", depth=2,
+                          crawler_url_must_match=".*persist.*")
+    handle = prof.handle
+    sb.close()
+    # restart: the queued frontier request's profile handle must resolve
+    sb2 = Switchboard(data_dir=data,
+                      transport=lambda u, h: (404, {}, b""))
+    try:
+        got = sb2.profiles.get(handle)
+        assert got is not None
+        assert got.depth == 2
+        assert got.crawler_url_must_match == ".*persist.*"
+        # default profiles were NOT duplicated into the persistence file
+        names = [p.name for p in sb2.profiles.values()]
+        assert names.count("remote") == 1
+    finally:
+        sb2.close()

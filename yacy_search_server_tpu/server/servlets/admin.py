@@ -25,9 +25,9 @@ def respond_crawler(header: dict, post: ServerObjects, sb) -> ServerObjects:
         depth = post.get_int("crawlingDepth", 0)
         kwargs = {}
         if post.get("mustmatch"):
-            kwargs["mustmatch"] = post.get("mustmatch")
+            kwargs["crawler_url_must_match"] = post.get("mustmatch")
         if post.get("mustnotmatch"):
-            kwargs["mustnotmatch"] = post.get("mustnotmatch")
+            kwargs["crawler_url_must_not_match"] = post.get("mustnotmatch")
         try:
             profile = sb.start_crawl(url, depth=depth, **kwargs)
             prop.put("started", 1)
@@ -40,10 +40,12 @@ def respond_crawler(header: dict, post: ServerObjects, sb) -> ServerObjects:
                       f"{quote(url)}&crawlingDepth={depth}")
             # the replay URL must carry the full crawl spec, or scheduled
             # re-crawls would run unfiltered
-            if kwargs.get("mustmatch"):
-                replay += f"&mustmatch={quote(kwargs['mustmatch'])}"
-            if kwargs.get("mustnotmatch"):
-                replay += f"&mustnotmatch={quote(kwargs['mustnotmatch'])}"
+            if kwargs.get("crawler_url_must_match"):
+                replay += ("&mustmatch="
+                           + quote(kwargs["crawler_url_must_match"]))
+            if kwargs.get("crawler_url_must_not_match"):
+                replay += ("&mustnotmatch="
+                           + quote(kwargs["crawler_url_must_not_match"]))
             sb.work_tables.record_api_call(
                 replay, "Crawler_p", f"crawl start for {url}",
                 repeat_count=post.get_int("repeat_count", 0),

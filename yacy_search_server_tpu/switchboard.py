@@ -79,6 +79,15 @@ class Switchboard:
         self.profiles: dict[str, CrawlProfile] = {}
         for p in default_profiles().values():
             self.profiles[p.handle] = p
+        # user profiles survive restarts (the reference keeps them in a
+        # MapHeap; CrawlSwitchboard reload) — the frontier's queued
+        # requests reference profile handles that must still resolve.
+        # Defaults are excluded from the file BY HANDLE (a user profile
+        # may legitimately reuse a default's name).
+        self._default_handles = set(self.profiles)
+        self._profiles_lock = threading.Lock()
+        self._profiles_path = sub("CRAWL_PROFILES.jsonl") if data_dir else None
+        self._load_profiles()
         self.noticed = NoticedURL(self.latency, sub("CRAWL"))
         self.blacklist = Blacklist(sub("BLACKLISTS"))
         self.crawl_stacker = CrawlStacker(
@@ -162,8 +171,43 @@ class Switchboard:
         resp = self.loader.load(Request(url), CacheStrategy.IFFRESH)
         return resp.content if resp.status == 200 else None
 
+    def _load_profiles(self) -> None:
+        import json
+        if not self._profiles_path or not os.path.exists(self._profiles_path):
+            return
+        try:
+            with open(self._profiles_path, encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        p = CrawlProfile.from_dict(json.loads(line))
+                        self.profiles[p.handle] = p
+                    except (ValueError, TypeError, KeyError):
+                        continue
+        except OSError:
+            pass
+
+    def _save_profiles(self) -> None:
+        import json
+        if not self._profiles_path:
+            return
+        # snapshot under the lock (concurrent crawl starts mutate the
+        # dict); file IO happens outside it
+        with self._profiles_lock:
+            rows = [p.to_dict() for p in self.profiles.values()
+                    if p.handle not in self._default_handles]
+        tmp = self._profiles_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for row in rows:
+                    f.write(json.dumps(row) + "\n")
+            os.replace(tmp, self._profiles_path)
+        except OSError:
+            pass
+
     def add_profile(self, profile: CrawlProfile) -> CrawlProfile:
-        self.profiles[profile.handle] = profile
+        with self._profiles_lock:
+            self.profiles[profile.handle] = profile
+        self._save_profiles()
         return profile
 
     def start_crawl(self, start_url: str, depth: int = 0,
@@ -178,6 +222,7 @@ class Switchboard:
         if reason:
             # rejected start never crawls: do not leak its profile
             self.profiles.pop(profile.handle, None)
+            self._save_profiles()
             raise ValueError(f"start url rejected: {reason}")
         return profile
 
@@ -196,6 +241,7 @@ class Switchboard:
         stacked = importer.import_sitemap(sitemap_url)
         if stacked == 0:
             self.profiles.pop(profile.handle, None)
+            self._save_profiles()    # the pop must reach the file too
         return stacked
 
     def run_postprocessing(self) -> int:
