@@ -1,0 +1,70 @@
+"""UI translation framework (Translator/.lng parity)."""
+
+import os
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu.server.translation import (TranslationTable,
+                                                       load_locale)
+
+LNG = """# a comment
+#File: *
+Search==Suchen
+#File: yacysearch.html
+candidates==Kandidaten
+"""
+
+
+def test_table_parse_and_sections():
+    t = TranslationTable("de")
+    assert t.load_text(LNG) == 2
+    # global pair applies everywhere
+    assert t.translate("Search here", "index.html") == "Suchen here"
+    # template-scoped pair only on its template
+    assert t.translate("10 candidates", "yacysearch.html") == "10 Kandidaten"
+    assert t.translate("10 candidates", "index.html") == "10 candidates"
+    # longest-source-first: overlapping strings replace deterministically
+    t2 = TranslationTable()
+    t2.add("Search engine", "Suchmaschine")
+    t2.add("Search", "Suchen")
+    assert t2.translate("Search engine") == "Suchmaschine"
+
+
+def test_load_locale(tmp_path):
+    d = str(tmp_path / "LOCALES")
+    os.makedirs(d)
+    with open(os.path.join(d, "de.lng"), "w", encoding="utf-8") as f:
+        f.write(LNG)
+    assert load_locale(d, "en").is_empty()       # default: no rewriting
+    assert load_locale(d, "fr").is_empty()       # missing file: empty
+    de = load_locale(d, "de")
+    assert not de.is_empty() and de.lang == "de"
+
+
+def test_translated_ui_over_http(tmp_path):
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    from yacy_search_server_tpu.switchboard import Switchboard
+    data = str(tmp_path / "DATA")
+    os.makedirs(os.path.join(data, "LOCALES"))
+    with open(os.path.join(data, "LOCALES", "de.lng"), "w",
+              encoding="utf-8") as f:
+        f.write("#File: *\nSearch==Suchen\n")
+    sb = Switchboard(data_dir=data)
+    srv = YaCyHttpServer(sb, port=0).start()
+    try:
+        body = urllib.request.urlopen(srv.base_url + "/", timeout=10) \
+            .read().decode()
+        assert "Search" in body                     # default: english
+        sb.config.set("locale.language", "de")
+        body = urllib.request.urlopen(srv.base_url + "/", timeout=10) \
+            .read().decode()
+        assert "Suchen" in body and 'value="Search"' not in body
+        # json output is never rewritten
+        import json as _json
+        out = _json.load(urllib.request.urlopen(
+            srv.base_url + "/Status.json", timeout=10))
+        assert out is not None
+    finally:
+        srv.close()
+        sb.close()

@@ -207,12 +207,37 @@ class YaCyHttpServer:
             except Exception:
                 pass
 
+    def _translation(self):
+        """Lazy-loaded translation table for the configured UI language
+        (config `locale.language`; reloaded when the setting changes)."""
+        from .translation import load_locale
+        lang = self.sb.config.get("locale.language", "default")
+        cached = getattr(self, "_i18n", None)
+        if cached is None or cached.lang != lang:
+            locales = os.path.join(self.sb.data_dir, "LOCALES") \
+                if getattr(self.sb, "data_dir", None) else None
+            cached = load_locale(locales, lang)
+            cached.lang = lang
+            self._i18n = cached
+        return cached
+
     def _render(self, name: str, ext: str, prop: ServerObjects) -> str:
         if prop.raw_body is not None:
             return prop.raw_body
         tmpl = f"{name}.{ext}"
-        if self.templates.resolve(tmpl) is not None:
-            return self.templates.render_file(tmpl, prop)
+        path = self.templates.resolve(tmpl)
+        if path is not None:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            if ext == "html":
+                # translate the TEMPLATE SOURCE, before property
+                # substitution: .lng pairs must rewrite page chrome only,
+                # never crawled titles/snippets/urls (the reference
+                # translates per-language htroot copies for this reason)
+                i18n = self._translation()
+                if not i18n.is_empty():
+                    source = i18n.translate(source, tmpl)
+            return self.templates.render(source, prop)
         # No template: serialize the property map directly. Values follow
         # the template contract — the servlet already escaped them for the
         # output medium — so insert them verbatim (json.dumps would
@@ -243,6 +268,12 @@ class YaCyHttpServer:
         ext = relpath.rpartition(".")[2]
         with open(path, "rb") as f:
             data = f.read()
+        if ext == "html":
+            i18n = self._translation()
+            if not i18n.is_empty():
+                data = i18n.translate(
+                    data.decode("utf-8", "replace"),
+                    os.path.basename(relpath)).encode("utf-8")
         self._send(handler, 200, _CONTENT_TYPES.get(ext, "application/octet-stream"), data)
 
     @staticmethod

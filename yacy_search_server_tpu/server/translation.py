@@ -1,0 +1,98 @@
+"""UI translation — language files applied to rendered pages.
+
+Capability equivalent of the reference's translator (reference:
+source/net/yacy/utils/translation/ + Translator.java — `.lng` files under
+locales/ hold per-template `source==target` string pairs; the build
+translates htroot copies per language, selected by `locale.language`).
+Here translation applies at RENDER time (no template copies): a
+TranslationTable loads `<lang>.lng`, and the HTTP layer rewrites the
+rendered HTML body when a non-default language is configured.
+
+File format (Translator-compatible subset):
+    #File: yacysearch.html          -> section: apply to this template
+    Search==Suchen                  -> source==target
+    #File: *                        -> section: apply everywhere
+Lines starting with `#` otherwise are comments.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class TranslationTable:
+    def __init__(self, lang: str = ""):
+        self.lang = lang
+        # template name ('*' = global) -> [(source, target)]
+        self._sections: dict[str, list[tuple[str, str]]] = {}
+        self._merged: dict[str, list[tuple[str, str]]] = {}  # sorted cache
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def load(path: str) -> "TranslationTable":
+        t = TranslationTable(os.path.basename(path).split(".")[0])
+        try:
+            with open(path, encoding="utf-8") as f:
+                t.load_text(f.read())
+        except OSError:
+            pass
+        return t
+
+    def load_text(self, text: str) -> int:
+        section = "*"
+        n = 0
+        with self._lock:
+            for raw in text.splitlines():
+                line = raw.strip()
+                if not line:
+                    continue
+                if line.lower().startswith("#file:"):
+                    section = line.split(":", 1)[1].strip() or "*"
+                    continue
+                if line.startswith("#"):
+                    continue
+                if "==" not in line:
+                    continue
+                src, _, dst = line.partition("==")
+                if src:
+                    self._sections.setdefault(section, []).append((src, dst))
+                    n += 1
+            self._merged.clear()
+        return n
+
+    def add(self, source: str, target: str, template: str = "*") -> None:
+        with self._lock:
+            self._sections.setdefault(template, []).append((source, target))
+            self._merged.clear()
+
+    def translate(self, body: str, template: str = "*") -> str:
+        """Apply global pairs then template-specific pairs (longest source
+        first, so overlapping strings replace deterministically). The
+        sorted merge is cached per template — .lng files carry thousands
+        of pairs and every page render calls this."""
+        with self._lock:
+            pairs = self._merged.get(template)
+            if pairs is None:
+                pairs = list(self._sections.get("*", []))
+                if template != "*":
+                    pairs += self._sections.get(template, [])
+                pairs.sort(key=lambda p: -len(p[0]))
+                self._merged[template] = pairs
+        for src, dst in pairs:
+            body = body.replace(src, dst)
+        return body
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return not self._sections
+
+
+def load_locale(locales_dir: str | None, lang: str) -> TranslationTable:
+    """`<locales_dir>/<lang>.lng`, empty table when absent/default."""
+    if not locales_dir or not lang or lang in ("en", "default", "browser"):
+        return TranslationTable()
+    path = os.path.join(locales_dir, lang + ".lng")
+    if not os.path.exists(path):
+        return TranslationTable(lang)
+    return TranslationTable.load(path)

@@ -190,19 +190,21 @@ class Switchboard:
         import json
         if not self._profiles_path:
             return
-        # snapshot under the lock (concurrent crawl starts mutate the
-        # dict); file IO happens outside it
+        # the WHOLE save runs under the lock: concurrent saves would
+        # otherwise race on the shared .tmp file and a stale snapshot
+        # could os.replace a newer one (the file is tiny; serializing is
+        # cheap)
         with self._profiles_lock:
             rows = [p.to_dict() for p in self.profiles.values()
                     if p.handle not in self._default_handles]
-        tmp = self._profiles_path + ".tmp"
-        try:
-            with open(tmp, "w", encoding="utf-8") as f:
-                for row in rows:
-                    f.write(json.dumps(row) + "\n")
-            os.replace(tmp, self._profiles_path)
-        except OSError:
-            pass
+            tmp = self._profiles_path + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    for row in rows:
+                        f.write(json.dumps(row) + "\n")
+                os.replace(tmp, self._profiles_path)
+            except OSError:
+                pass
 
     def add_profile(self, profile: CrawlProfile) -> CrawlProfile:
         with self._profiles_lock:
@@ -221,7 +223,8 @@ class Switchboard:
         reason = self.crawl_stacker.stack(req)
         if reason:
             # rejected start never crawls: do not leak its profile
-            self.profiles.pop(profile.handle, None)
+            with self._profiles_lock:
+                self.profiles.pop(profile.handle, None)
             self._save_profiles()
             raise ValueError(f"start url rejected: {reason}")
         return profile
@@ -240,7 +243,8 @@ class Switchboard:
                                    profile.handle)
         stacked = importer.import_sitemap(sitemap_url)
         if stacked == 0:
-            self.profiles.pop(profile.handle, None)
+            with self._profiles_lock:
+                self.profiles.pop(profile.handle, None)
             self._save_profiles()    # the pop must reach the file too
         return stacked
 
