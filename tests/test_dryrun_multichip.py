@@ -1,0 +1,74 @@
+"""The driver's multichip dryrun must be hermetic w.r.t. the default backend.
+
+Round-1 regression: ``MULTICHIP_r01.json`` came back ``ok=false`` because
+``MeshRanker.__init__`` created its ranking constants with bare
+``jnp.asarray`` — which places on the DEFAULT backend (the remote TPU
+plugin) even when the mesh is the 8-device virtual CPU pool, so any TPU-side
+failure (libtpu version skew, tunnel hiccup) killed a nominally-CPU dryrun.
+
+Two layers of defense:
+
+* in-process: every array the dryrun touches must live on the mesh's
+  devices (replicated or sharded), never on whatever the default backend is;
+* subprocess: run ``dryrun_multichip(8)`` WITHOUT ``JAX_PLATFORMS=cpu`` so
+  that any TPU plugin registered in the image stays visible — the dryrun has
+  to succeed without touching it (exactly the driver's environment).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_inprocess():
+    from __graft_entry__ import dryrun_multichip
+    dryrun_multichip(8)
+
+
+def test_mesh_ranker_constants_live_on_mesh_devices():
+    from yacy_search_server_tpu.index.postings import PostingsList
+    from yacy_search_server_tpu.ops.ranking import RankingProfile
+    from yacy_search_server_tpu.parallel.mesh import (MeshRanker, best_devices,
+                                                      make_mesh)
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PS
+    mesh = make_mesh(n_doc=4, n_term=2, devices=best_devices(8)[:8])
+    mesh_devs = set(mesh.devices.flat)
+    rep = NamedSharding(mesh, PS())
+    ranker = MeshRanker(mesh, RankingProfile())
+    for arr in (ranker._norm, ranker._bits, ranker._shifts, ranker._dl,
+                ranker._tf, ranker._lang_c, ranker._auth, ranker._lang):
+        # must be explicitly replicated over the mesh (committed), not
+        # merely "on a device that happens to be in the mesh" — the round-1
+        # bug placed on default-backend device 0, which IS in the CPU mesh
+        assert arr.sharding == rep, (
+            f"constant sharded {arr.sharding}, want {rep}")
+    rng = np.random.default_rng(3)
+    from yacy_search_server_tpu.index import postings as P
+    feats = rng.integers(0, 500, (64, P.NF)).astype(np.int32)
+    pl = PostingsList(np.arange(64, dtype=np.int32), feats)
+    placed = ranker.place(pl, [bytes([i % 5, 1]) for i in range(64)])
+    for arr in placed[:4]:
+        assert set(arr.devices()) <= mesh_devs
+
+
+@pytest.mark.slow
+def test_dryrun_subprocess_with_default_backend_visible():
+    """Driver-environment replica: no JAX_PLATFORMS forcing, virtual CPU
+    pool via XLA_FLAGS only. Must pass even when the default backend is an
+    unusable TPU tunnel."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from __graft_entry__ import dryrun_multichip; "
+         "dryrun_multichip(8); print('OK')"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK" in r.stdout

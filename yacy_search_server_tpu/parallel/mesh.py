@@ -43,19 +43,26 @@ from ..ops import ranking as R
 NEG_INF_I32 = -(2**31 - 1)
 
 
-def best_devices(need: int | None = None):
-    """Default device pool; falls back to the virtual CPU pool when the
-    default backend has fewer devices than requested (single-chip dev box
-    with xla_force_host_platform_device_count set — the documented test
-    pattern for multi-chip shardings)."""
+def best_devices(need: int | None = None, prefer_cpu: bool = False):
+    """Device pool for an n-way mesh.
+
+    Default policy: the default backend, falling back to the virtual CPU
+    pool when the default backend has fewer devices than requested
+    (single-chip dev box with xla_force_host_platform_device_count set —
+    the documented test pattern for multi-chip shardings).
+
+    prefer_cpu=True inverts the preference: take the CPU pool whenever it
+    satisfies `need` (the driver's multichip dryrun contract — CPU
+    validation that must not couple to default-backend health)."""
+    try:
+        cpu = jax.devices("cpu")
+    except RuntimeError:
+        cpu = []
+    if prefer_cpu and need is not None and len(cpu) >= need:
+        return cpu
     devs = jax.devices()
-    if need is not None and len(devs) < need:
-        try:
-            cpu = jax.devices("cpu")
-        except RuntimeError:
-            cpu = []
-        if len(cpu) >= need:
-            devs = cpu
+    if need is not None and len(devs) < need and len(cpu) >= need:
+        devs = cpu
     return devs
 
 
@@ -175,14 +182,21 @@ class MeshRanker:
         self.mesh = mesh
         self.n_doc = mesh.shape["doc"]
         self.profile = profile or R.RankingProfile()
-        self._norm = jnp.asarray(self.profile.norm_coeffs())
+        # Every constant is pinned to the mesh's devices with an explicit
+        # replicated sharding.  A bare jnp.asarray/jnp.int32 would place on
+        # the DEFAULT backend — which may be a (possibly broken/busy) TPU
+        # while the mesh is the virtual CPU pool, hermetically coupling a
+        # CPU dryrun to TPU health.
+        rep = NamedSharding(mesh, PS())
+        put = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
+        self._norm = put(self.profile.norm_coeffs())
         bits, shifts = self.profile.flag_coeffs()
-        self._bits, self._shifts = jnp.asarray(bits), jnp.asarray(shifts)
-        self._dl = jnp.int32(self.profile.domlength)
-        self._tf = jnp.int32(self.profile.tf)
-        self._lang_c = jnp.int32(self.profile.language)
-        self._auth = jnp.int32(self.profile.authority)
-        self._lang = jnp.int32(P.pack_language(language))
+        self._bits, self._shifts = put(bits), put(shifts)
+        self._dl = put(np.int32(self.profile.domlength))
+        self._tf = put(np.int32(self.profile.tf))
+        self._lang_c = put(np.int32(self.profile.language))
+        self._auth = put(np.int32(self.profile.authority))
+        self._lang = put(np.int32(P.pack_language(language)))
         self._fns: dict[tuple[int, int], object] = {}
 
     def _fn(self, k: int, num_hosts: int):
@@ -268,7 +282,7 @@ class MeshBM25:
         return (jax.device_put(tf_p, sh),
                 jax.device_put(dl_p, sh_doc),
                 jax.device_put(df_p, sh_term),
-                jax.device_put(jnp.int32(ndocs), sh_rep),
+                jax.device_put(np.int32(ndocs), sh_rep),
                 jax.device_put(valid, sh_doc),
                 jax.device_put(did_p, sh_doc))
 
