@@ -1,0 +1,216 @@
+"""Disk-paged frozen runs — postings served from mmap, not host RAM.
+
+Capability equivalent of the reference's on-disk container array, which
+streams term containers from BLOB heap files instead of materializing the
+whole index in heap (reference: source/net/yacy/kelondro/blob/HeapReader.java:60
+index-then-seek reads; kelondro/rwi/ReferenceContainerArray.java:45). The
+round-1 store loaded every frozen ``.npz`` run fully into host RAM at
+startup, capping the index at host-memory size; a ``PagedRun`` instead
+keeps only the per-term offset index resident and maps the flat postings
+arrays with ``np.memmap`` — the OS pages postings in on access, and a
+shared byte-budget LRU (`TermCache`) keeps hot terms materialized.
+
+File format (one run = two files, written atomically via os.replace):
+
+    run-XXXXXX.dat   int32 little-endian: docids[total] then feats[total, NF]
+    run-XXXXXX.tix   text: "PR1 <total>" header, then one line per term:
+                     "<termhash> <start> <count>"   (rows into .dat, sorted
+                     by termhash for deterministic files)
+
+Postings of one term are contiguous rows ``[start, start+count)`` in both
+sections, docid-sorted — which is also exactly the span shape the device
+arena packs from (index/devstore.py), so packing a run onto the TPU reads
+each term once, straight off the map.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from .postings import NF, PostingsList
+
+_MAGIC = "PR1"
+
+
+class TermCache:
+    """Shared LRU of materialized PostingsLists under a byte budget.
+
+    One cache serves every PagedRun of an index (keys are (run_path, term))
+    so the budget bounds total resident postings regardless of run count.
+    """
+
+    def __init__(self, budget_bytes: int = 64 << 20):
+        self.budget_bytes = budget_bytes
+        self._bytes = 0
+        self._map: OrderedDict[tuple, PostingsList] = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _cost(p: PostingsList) -> int:
+        return p.docids.nbytes + p.feats.nbytes
+
+    def get(self, key: tuple) -> PostingsList | None:
+        with self._lock:
+            p = self._map.get(key)
+            if p is not None:
+                self._map.move_to_end(key)
+            return p
+
+    def put(self, key: tuple, p: PostingsList) -> None:
+        cost = self._cost(p)
+        if cost > self.budget_bytes:
+            return  # larger than the whole budget: serve uncached
+        with self._lock:
+            old = self._map.pop(key, None)
+            if old is not None:
+                self._bytes -= self._cost(old)
+            self._map[key] = p
+            self._bytes += cost
+            while self._bytes > self.budget_bytes and self._map:
+                _, ev = self._map.popitem(last=False)
+                self._bytes -= self._cost(ev)
+
+    def invalidate(self, key: tuple) -> None:
+        with self._lock:
+            p = self._map.pop(key, None)
+            if p is not None:
+                self._bytes -= self._cost(p)
+
+    def invalidate_run(self, run_path: str) -> None:
+        with self._lock:
+            dead = [k for k in self._map if k[0] == run_path]
+            for k in dead:
+                self._bytes -= self._cost(self._map.pop(k))
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+
+class PagedRun:
+    """Immutable disk run: per-term offset index + mmap'd flat arrays."""
+
+    def __init__(self, path: str, index: dict[bytes, tuple[int, int]],
+                 total: int, cache: TermCache | None = None):
+        self.path = path
+        self._index = index                  # termhash -> (start, count)
+        self._total = total
+        self._cache = cache
+        self._mm_docids: np.ndarray | None = None
+        self._mm_feats: np.ndarray | None = None
+        self.n_postings = sum(c for _, c in index.values())
+
+    # -- construction --------------------------------------------------------
+
+    @staticmethod
+    def write(path: str, terms: dict[bytes, PostingsList],
+              cache: TermCache | None = None) -> "PagedRun":
+        """Persist a term->postings dict as one paged run (atomic)."""
+        order = sorted(terms.keys())
+        total = sum(len(terms[th]) for th in order)
+        index: dict[bytes, tuple[int, int]] = {}
+        tmp_dat, tmp_tix = path + ".tmp", _tix_path(path) + ".tmp"
+        with open(tmp_dat, "wb") as f:
+            start = 0
+            for th in order:
+                index[th] = (start, len(terms[th]))
+                f.write(np.ascontiguousarray(
+                    terms[th].docids, dtype="<i4").tobytes())
+                start += len(terms[th])
+            for th in order:
+                f.write(np.ascontiguousarray(
+                    terms[th].feats, dtype="<i4").tobytes())
+        with open(tmp_tix, "w", encoding="ascii") as f:
+            f.write(f"{_MAGIC} {total}\n")
+            for th in order:
+                s, c = index[th]
+                f.write(f"{th.decode('ascii')} {s} {c}\n")
+        os.replace(tmp_dat, path)
+        os.replace(tmp_tix, _tix_path(path))
+        return PagedRun(path, index, total, cache)
+
+    @staticmethod
+    def open(path: str, cache: TermCache | None = None) -> "PagedRun":
+        index: dict[bytes, tuple[int, int]] = {}
+        with open(_tix_path(path), "r", encoding="ascii") as f:
+            header = f.readline().split()
+            assert header[0] == _MAGIC, f"bad run header in {path}: {header}"
+            total = int(header[1])
+            for line in f:
+                th, s, c = line.split()
+                index[th.encode("ascii")] = (int(s), int(c))
+        return PagedRun(path, index, total, cache)
+
+    def _maps(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._mm_docids is None:
+            self._mm_docids = np.memmap(self.path, dtype="<i4", mode="r",
+                                        shape=(self._total,))
+            self._mm_feats = np.memmap(self.path, dtype="<i4", mode="r",
+                                       offset=self._total * 4,
+                                       shape=(self._total, NF))
+        return self._mm_docids, self._mm_feats
+
+    # -- run interface (shared with rwi.FrozenRun) ---------------------------
+
+    def get(self, termhash: bytes) -> PostingsList | None:
+        span = self._index.get(termhash)
+        if span is None:
+            return None
+        key = (self.path, termhash)
+        if self._cache is not None:
+            hit = self._cache.get(key)
+            if hit is not None:
+                return hit
+        start, count = span
+        docids, feats = self._maps()
+        p = PostingsList(np.array(docids[start:start + count]),
+                         np.array(feats[start:start + count]))
+        if self._cache is not None:
+            self._cache.put(key, p)
+        return p
+
+    def span(self, termhash: bytes) -> tuple[int, int] | None:
+        """(start, count) rows of a term in the flat arrays (arena packing)."""
+        return self._index.get(termhash)
+
+    def docids_of(self, termhash: bytes) -> np.ndarray | None:
+        """A term's sorted docids straight off the map (join path — avoids
+        materializing the feature rows)."""
+        span = self._index.get(termhash)
+        if span is None:
+            return None
+        start, count = span
+        return self._maps()[0][start:start + count]
+
+    def has(self, termhash: bytes) -> bool:
+        return termhash in self._index
+
+    def term_hashes(self):
+        return self._index.keys()
+
+    def drop_term(self, termhash: bytes) -> int:
+        """Remove a term from the run's view (delete-on-select handoff);
+        returns the dropped posting count. The .dat rows stay on disk until
+        the next merge rewrites the run — same semantics as the round-1
+        in-RAM pop, which also only reclaimed space at merge."""
+        span = self._index.pop(termhash, None)
+        if span is None:
+            return 0
+        if self._cache is not None:
+            self._cache.invalidate((self.path, termhash))
+        self.n_postings -= span[1]
+        return span[1]
+
+    def close(self) -> None:
+        self._mm_docids = None
+        self._mm_feats = None
+        if self._cache is not None:
+            self._cache.invalidate_run(self.path)
+
+
+def _tix_path(dat_path: str) -> str:
+    return dat_path[:-4] + ".tix" if dat_path.endswith(".dat") else dat_path + ".tix"

@@ -10,8 +10,10 @@ and runs are merged in the background.
 
 Differences from the reference, by design:
 - postings are dense numpy SoA blocks (index/postings.py), not byte rows;
-- a frozen run persists as one .npz file (numpy's container format) instead
-  of a BLOB heap; a run is immutable once written;
+- a frozen run persists as a disk-paged flat file pair (.dat/.tix,
+  index/pagedrun.py) served through mmap with a byte-budget term LRU, so
+  resident memory is bounded regardless of index size (round-1 .npz runs
+  are still readable and are rewritten paged at the next merge);
 - deletes are docid tombstones applied at read and folded in at merge,
   replacing the reference's in-place row removal — immutable runs cannot be
   mutated, and the device arrays built from them must not be either.
@@ -28,6 +30,7 @@ import threading
 
 import numpy as np
 
+from .pagedrun import PagedRun, TermCache
 from .postings import NF, PostingsList, merge, remove_docids, sort_dedupe
 from ..utils.eventtracker import EClass, update as track
 
@@ -35,13 +38,23 @@ from ..utils.eventtracker import EClass, update as track
 # (defaults/yacy.init:793)
 DEFAULT_MAX_RAM_POSTINGS = 50_000
 
+# resident-postings budget for the shared paged-run term cache
+DEFAULT_TERM_CACHE_BYTES = 256 << 20
+
 
 def _b64key(termhash: bytes) -> str:
     return termhash.decode("ascii")
 
 
 class FrozenRun:
-    """Immutable sorted run: term -> PostingsList, optionally disk-backed."""
+    """Immutable sorted run held in RAM: term -> PostingsList.
+
+    Two roles: (a) the only run form for RAM-only indexes (no data_dir);
+    (b) the transient form a fresh flush/merge serves from while its
+    PagedRun file is being written outside the lock (then swapped out).
+    Shares the run interface with pagedrun.PagedRun: get/has/term_hashes/
+    drop_term/span/close.
+    """
 
     def __init__(self, terms: dict[bytes, PostingsList], path: str | None = None):
         self.terms = terms
@@ -51,7 +64,31 @@ class FrozenRun:
     def get(self, termhash: bytes) -> PostingsList | None:
         return self.terms.get(termhash)
 
+    def has(self, termhash: bytes) -> bool:
+        return termhash in self.terms
+
+    def term_hashes(self):
+        return self.terms.keys()
+
+    def drop_term(self, termhash: bytes) -> int:
+        p = self.terms.pop(termhash, None)
+        if p is None:
+            return 0
+        self.n_postings -= len(p)
+        return len(p)
+
+    def span(self, termhash: bytes):
+        return None  # not flat-file backed
+
+    def docids_of(self, termhash: bytes) -> np.ndarray | None:
+        p = self.terms.get(termhash)
+        return None if p is None else p.docids
+
+    def close(self) -> None:
+        pass
+
     def save(self, path: str) -> None:
+        """Legacy .npz writer (round-1 format; kept for migration tests)."""
         arrays: dict[str, np.ndarray] = {}
         for th, p in self.terms.items():
             k = _b64key(th)
@@ -78,12 +115,14 @@ class RWIIndex:
     """RAM buffer + frozen runs, with tombstones and background-mergeable runs."""
 
     def __init__(self, data_dir: str | None = None,
-                 max_ram_postings: int = DEFAULT_MAX_RAM_POSTINGS):
+                 max_ram_postings: int = DEFAULT_MAX_RAM_POSTINGS,
+                 term_cache_bytes: int = DEFAULT_TERM_CACHE_BYTES):
         self.data_dir = data_dir
         self.max_ram_postings = max_ram_postings
+        self.term_cache = TermCache(term_cache_bytes)
         self._ram: dict[bytes, list[tuple[int, np.ndarray]]] = {}
         self._ram_count = 0
-        self._runs: list[FrozenRun] = []
+        self._runs: list = []  # FrozenRun | PagedRun, oldest first
         self._tombstones: set[int] = set()
         self._dead_arr: np.ndarray | None = None  # cached sorted tombstones
         self._lock = threading.RLock()
@@ -99,11 +138,15 @@ class RWIIndex:
                     names = [ln.strip() for ln in f if ln.strip()]
             else:
                 names = sorted(fn for fn in os.listdir(data_dir)
-                               if fn.startswith("run-") and fn.endswith(".npz"))
+                               if fn.startswith("run-")
+                               and fn[-4:] in (".npz", ".dat"))
             for fn in names:
                 p = os.path.join(data_dir, fn)
                 if os.path.exists(p):
-                    self._runs.append(FrozenRun.load(p))
+                    if fn.endswith(".npz"):   # round-1 format: full load
+                        self._runs.append(FrozenRun.load(p))
+                    else:                     # paged: index only, mmap data
+                        self._runs.append(PagedRun.open(p, self.term_cache))
                     self._run_seq = max(self._run_seq, int(fn[4:-4]) + 1)
             dp = os.path.join(data_dir, "deletions.log")
             if os.path.exists(dp):
@@ -122,7 +165,7 @@ class RWIIndex:
         os.replace(tmp, mp)
 
     def _replay_deletions(self, path: str) -> None:
-        def run_seq_of(run: FrozenRun) -> int:
+        def run_seq_of(run) -> int:
             return int(os.path.basename(run.path)[4:-4]) if run.path else -1
 
         with open(path, "r", encoding="ascii") as f:
@@ -140,9 +183,7 @@ class RWIIndex:
                     for run in self._runs:
                         if run_seq_of(run) >= horizon:
                             continue
-                        p = run.terms.pop(th, None)
-                        if p is not None:
-                            run.n_postings -= len(p)
+                        run.drop_term(th)
 
     def _journal_deletion(self, line: str) -> None:
         if self._dels:
@@ -169,13 +210,15 @@ class RWIIndex:
     def needs_flush(self) -> bool:
         return self._ram_count >= self.max_ram_postings
 
-    def flush(self) -> FrozenRun | None:
+    def flush(self):
         """Freeze the RAM buffer into an immutable run (and persist it).
 
-        The compressed disk write happens OUTSIDE the lock: queries and
-        writers proceed against the already-appended in-memory run while
-        the .npz is being written (the reference's FlushThread dumps in the
-        background for the same reason, IndexCell.java:115-160)."""
+        The disk write happens OUTSIDE the lock: queries and writers
+        proceed against the already-appended in-RAM run while the paged
+        file is being written (the reference's FlushThread dumps in the
+        background for the same reason, IndexCell.java:115-160); the RAM
+        form is then swapped for the mmap-backed PagedRun, releasing the
+        postings from host memory."""
         with self._lock:
             terms: dict[bytes, PostingsList] = {}
             for th, rows in self._ram.items():
@@ -190,17 +233,44 @@ class RWIIndex:
             if not terms:  # only emptied buckets: nothing to persist
                 return None
             run = FrozenRun(terms)
+            # snapshot for the outside-lock write: a concurrent remove_term
+            # may pop from the live run.terms dict mid-write
+            snapshot = dict(terms)
             path = None
             if self.data_dir:
-                path = os.path.join(self.data_dir, f"run-{self._run_seq:06d}.npz")
+                path = os.path.join(self.data_dir, f"run-{self._run_seq:06d}.dat")
             self._run_seq += 1
             self._runs.append(run)
+        out = run
         if path:
-            run.save(path)
-            with self._lock:
-                self._write_manifest()
+            paged = PagedRun.write(path, snapshot, self.term_cache)
+            out = self._swap_run(run, paged)
         track(EClass.WORDCACHE, "flush", n)
-        return run
+        return out
+
+    def _swap_run(self, ram_run: FrozenRun, paged: PagedRun):
+        """Replace a just-persisted in-RAM run with its PagedRun, carrying
+        over any term drops that landed while the file was being written."""
+        with self._lock:
+            live = set(ram_run.terms.keys())
+            for th in [t for t in paged.term_hashes() if t not in live]:
+                paged.drop_term(th)
+            try:
+                i = self._runs.index(ram_run)
+            except ValueError:
+                # merged away while writing: the file pair is orphaned (it
+                # never reached the manifest) — remove it, or a future
+                # listdir-fallback open would resurrect folded-in deletions
+                paged.close()
+                for p in (paged.path, paged.path[:-4] + ".tix"):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+                return ram_run
+            self._runs[i] = paged
+            self._write_manifest()
+            return paged
 
     def merge_runs(self, max_runs: int = 8) -> bool:
         """Merge the smallest runs into one when there are more than max_runs.
@@ -217,36 +287,46 @@ class RWIIndex:
             victims = self._runs[: len(self._runs) - max_runs + 1]
             all_terms: set[bytes] = set()
             for r in victims:
-                all_terms.update(r.terms.keys())
+                all_terms.update(r.term_hashes())
             dead = self._dead_sorted()
+            # transient RAM spike proportional to the victims' size — a
+            # merge is a rewrite; steady-state residency stays paged
             merged: dict[bytes, PostingsList] = {}
             for th in all_terms:
-                parts = [r.terms[th] for r in victims if th in r.terms]
+                parts = [p for p in (r.get(th) for r in victims)
+                         if p is not None]
                 m = remove_docids(merge(parts), dead)
                 if len(m):
                     merged[th] = m
             new_run = FrozenRun(merged)
+            snapshot = dict(merged)  # outside-lock write vs remove_term race
             save_path = None
             if self.data_dir:
                 # fresh sequence number: keeps it past every journaled T-line
                 # horizon (its term removals are physically folded in);
                 # chronological position is preserved by the manifest instead
                 save_path = os.path.join(self.data_dir,
-                                         f"run-{self._run_seq:06d}.npz")
+                                         f"run-{self._run_seq:06d}.dat")
             self._run_seq += 1
             victim_paths = [r.path for r in victims if r.path]
             # merged run replaces the victims at the FRONT (oldest position)
             self._runs = [new_run] + [r for r in self._runs if r not in victims]
-        # compressed write outside the lock; manifest after the file exists
+        # paged write outside the lock, then swap the RAM form out
         if save_path:
-            new_run.save(save_path)
-        with self._lock:
-            self._write_manifest()
+            paged = PagedRun.write(save_path, snapshot, self.term_cache)
+            self._swap_run(new_run, paged)
+        else:
+            with self._lock:
+                self._write_manifest()
+        for r in victims:
+            r.close()
         for p in victim_paths:
-            try:
-                os.remove(p)
-            except OSError:
-                pass
+            for path in (p, p[:-4] + ".tix" if p.endswith(".dat") else None):
+                if path:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
         track(EClass.INDEX, "merge", len(victims))
         return True
 
@@ -263,7 +343,13 @@ class RWIIndex:
 
     def remove_term(self, termhash: bytes) -> PostingsList:
         """Remove and return a term's postings (DHT delete-on-select handoff,
-        reference: peers/Dispatcher.java:296 selectContainersEnqueueToBuffer)."""
+        reference: peers/Dispatcher.java:296 selectContainersEnqueueToBuffer).
+
+        Materializes paged postings under the lock: the read-then-drop must
+        be atomic versus other removers, and a concurrent merge may unlink
+        the backing file the moment the term leaves the run's index. This
+        path is a rare batch operation (DHT shard handoff), not the query
+        hot path — see get() for the lock-free read."""
         with self._lock:
             parts: list[PostingsList] = []
             rows = self._ram.pop(termhash, None)
@@ -273,9 +359,9 @@ class RWIIndex:
                 f = np.stack([r[1] for r in rows]).astype(np.int32)
                 parts.append(sort_dedupe(d, f))
             for run in self._runs:
-                p = run.terms.pop(termhash, None)
+                p = run.get(termhash)
                 if p is not None:
-                    run.n_postings -= len(p)
+                    run.drop_term(termhash)
                     parts.append(p)
             self._journal_deletion(f"T {termhash.decode('ascii')} {self._run_seq}")
             return self._apply_tombstones(merge(parts))
@@ -306,17 +392,27 @@ class RWIIndex:
     def get(self, termhash: bytes) -> PostingsList:
         """A term's full postings: RAM + all runs merged, tombstones applied.
 
-        Later-written postings win on docid collision (RAM beats runs)."""
+        Later-written postings win on docid collision (RAM beats runs).
+        Paged-run materialization (mmap page-ins) happens OUTSIDE the lock:
+        runs are immutable, so only the run-list snapshot and the RAM
+        buffer need the lock — a cold-term disk read must not stall
+        writers (the round-1 store held the lock across reads because they
+        were pure dict lookups)."""
         with self._lock:
-            parts: list[PostingsList] = []
-            for run in self._runs:
-                p = run.get(termhash)
-                if p is not None:
-                    parts.append(p)
+            runs = list(self._runs)
             ram = self._ram_postings(termhash)
-            if ram is not None:
-                parts.append(ram)  # last -> wins collisions
-            return self._apply_tombstones(merge(parts))
+            dead = self._dead_sorted() if self._tombstones else None
+        parts: list[PostingsList] = []
+        for run in runs:
+            p = run.get(termhash)
+            if p is not None:
+                parts.append(p)
+        if ram is not None:
+            parts.append(ram)  # last -> wins collisions
+        out = merge(parts)
+        if dead is not None and len(out):
+            out = remove_docids(out, dead)
+        return out
 
     def count(self, termhash: bytes) -> int:
         """Posting count (the queryRWICount RPC answer); tombstones applied."""
@@ -326,13 +422,13 @@ class RWIIndex:
         with self._lock:
             if termhash in self._ram:
                 return True
-            return any(termhash in r.terms for r in self._runs)
+            return any(r.has(termhash) for r in self._runs)
 
     def term_hashes(self) -> set[bytes]:
         with self._lock:
             out = set(self._ram.keys())
             for r in self._runs:
-                out.update(r.terms.keys())
+                out.update(r.term_hashes())
             return out
 
     def terms_in_ring_segment(self, start_pos: int, limit_pos: int) -> list[bytes]:
@@ -369,3 +465,6 @@ class RWIIndex:
         if self._dels:
             self._dels.close()
             self._dels = None
+        with self._lock:
+            for r in self._runs:
+                r.close()
