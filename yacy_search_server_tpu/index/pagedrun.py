@@ -177,6 +177,20 @@ class PagedRun:
         """(start, count) rows of a term in the flat arrays (arena packing)."""
         return self._index.get(termhash)
 
+    def all_spans(self) -> dict[bytes, tuple[int, int]]:
+        """Live term -> (start, count) in file-row coordinates. Rows of
+        dropped terms remain in the file (and in flat_chunks) but are
+        unreferenced — same dead-space-until-merge contract as the file."""
+        return dict(self._index)
+
+    def flat_chunks(self, chunk_rows: int):
+        """Stream the whole run as (docids, feats) numpy chunks in file
+        order (device-arena packing reads the map once, sequentially)."""
+        docids, feats = self._maps()
+        for lo in range(0, self._total, chunk_rows):
+            hi = min(self._total, lo + chunk_rows)
+            yield np.array(docids[lo:hi]), np.array(feats[lo:hi])
+
     def docids_of(self, termhash: bytes) -> np.ndarray | None:
         """A term's sorted docids straight off the map (join path — avoids
         materializing the feature rows)."""

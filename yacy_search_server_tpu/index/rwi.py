@@ -80,6 +80,23 @@ class FrozenRun:
     def span(self, termhash: bytes):
         return None  # not flat-file backed
 
+    def all_spans(self) -> dict[bytes, tuple[int, int]]:
+        """Flat-layout spans in the same (sorted-by-termhash) order that
+        flat_chunks streams — the RAM twin of PagedRun.all_spans."""
+        spans: dict[bytes, tuple[int, int]] = {}
+        start = 0
+        for th in sorted(self.terms):
+            n = len(self.terms[th])
+            spans[th] = (start, n)
+            start += n
+        return spans
+
+    def flat_chunks(self, chunk_rows: int):
+        for th in sorted(self.terms):
+            p = self.terms[th]
+            for lo in range(0, len(p), chunk_rows):
+                yield p.docids[lo:lo + chunk_rows], p.feats[lo:lo + chunk_rows]
+
     def docids_of(self, termhash: bytes) -> np.ndarray | None:
         p = self.terms.get(termhash)
         return None if p is None else p.docids
@@ -120,6 +137,10 @@ class RWIIndex:
         self.data_dir = data_dir
         self.max_ram_postings = max_ram_postings
         self.term_cache = TermCache(term_cache_bytes)
+        # optional run-lifecycle listener (index/devstore.py packs runs onto
+        # the device through these hooks): on_run_added / on_run_swapped /
+        # on_run_removed / on_doc_deleted / on_term_dropped
+        self.listener = None
         self._ram: dict[bytes, list[tuple[int, np.ndarray]]] = {}
         self._ram_count = 0
         self._runs: list = []  # FrozenRun | PagedRun, oldest first
@@ -242,6 +263,8 @@ class RWIIndex:
             self._run_seq += 1
             self._runs.append(run)
         out = run
+        if self.listener is not None:
+            self.listener.on_run_added(run)
         if path:
             paged = PagedRun.write(path, snapshot, self.term_cache)
             out = self._swap_run(run, paged)
@@ -270,6 +293,8 @@ class RWIIndex:
                 return ram_run
             self._runs[i] = paged
             self._write_manifest()
+            if self.listener is not None:
+                self.listener.on_run_swapped(ram_run, paged)
             return paged
 
     def merge_runs(self, max_runs: int = 8) -> bool:
@@ -311,6 +336,14 @@ class RWIIndex:
             victim_paths = [r.path for r in victims if r.path]
             # merged run replaces the victims at the FRONT (oldest position)
             self._runs = [new_run] + [r for r in self._runs if r not in victims]
+        # listener first (pack the merged run, retire the victims' extents)
+        # and only then the paged swap: on_run_swapped re-keys the packed
+        # extents from the FrozenRun to its PagedRun, so the registration
+        # must exist before the swap or the merged run is never packed
+        if self.listener is not None:
+            self.listener.on_run_added(new_run)
+            for r in victims:
+                self.listener.on_run_removed(r)
         # paged write outside the lock, then swap the RAM form out
         if save_path:
             paged = PagedRun.write(save_path, snapshot, self.term_cache)
@@ -340,6 +373,8 @@ class RWIIndex:
                 self._ram_count -= len(rows) - len(kept)
                 rows[:] = kept
             self._journal_deletion(f"D {docid}")
+        if self.listener is not None:
+            self.listener.on_doc_deleted(docid)
 
     def remove_term(self, termhash: bytes) -> PostingsList:
         """Remove and return a term's postings (DHT delete-on-select handoff,
@@ -362,6 +397,8 @@ class RWIIndex:
                 p = run.get(termhash)
                 if p is not None:
                     run.drop_term(termhash)
+                    if self.listener is not None:
+                        self.listener.on_term_dropped(run, termhash)
                     parts.append(p)
             self._journal_deletion(f"T {termhash.decode('ascii')} {self._run_seq}")
             return self._apply_tombstones(merge(parts))

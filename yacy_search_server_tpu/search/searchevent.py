@@ -124,6 +124,22 @@ class SearchEvent:
 
     def _run_local(self) -> None:
         q = self.query
+        k_need = max(q.item_count + q.offset, 10) * TOPK_OVERSAMPLE
+
+        # steady-state path: rank placed device blocks (uploads only the
+        # RAM delta); None -> host path (term not resident / query shape
+        # needs host-side data)
+        placed = self._device_local(k_need)
+        if placed is not None:
+            scores, docids, self.local_rwi_considered = placed
+            if len(docids) == 0:
+                return
+            if q.hybrid:
+                with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
+                    scores, docids = self._dense_rerank(scores, docids)
+            self._fill_results(scores, docids)
+            return
+
         with StageTimer(EClass.SEARCH, "JOIN"):
             joined = self.segment.term_search(
                 include_hashes=q.goal.include_hashes or None,
@@ -138,10 +154,14 @@ class SearchEvent:
         if len(cand) == 0:
             return
 
-        hosthashes = [hosthash(self.segment.metadata.urlhash_of(d))
-                      for d in cand.docids.tolist()]
-        k = min(len(cand),
-                max(q.item_count + q.offset, 10) * TOPK_OVERSAMPLE)
+        # the authority signal is the only hosthash consumer; the per-row
+        # python loop must not run for profiles that never read it
+        # (ReferenceOrder.java:255 guard — authority only when coeff > 12)
+        hosthashes = None
+        if q.profile.authority > 12:
+            hosthashes = [hosthash(self.segment.metadata.urlhash_of(d))
+                          for d in cand.docids.tolist()]
+        k = min(len(cand), k_need)
         if q.modifier.date_sort:
             # /date modifier: recency replaces the cardinal as the sort key
             # (reference: QueryModifier /date -> Solr sort last_modified desc)
@@ -156,6 +176,9 @@ class SearchEvent:
             with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
                 scores, docids = self._dense_rerank(scores, docids)
 
+        self._fill_results(scores, docids)
+
+    def _fill_results(self, scores, docids) -> None:
         with StageTimer(EClass.SEARCH, "RESULTLIST", len(docids)):
             for score, docid in zip(scores.tolist(), docids.tolist()):
                 made = self._make_entry(int(docid), int(score))
@@ -164,6 +187,34 @@ class SearchEvent:
                     continue
                 entry, meta = made
                 self._insert(entry, meta)
+
+    def _device_local(self, k: int):
+        """Eligibility gate + dispatch for the device-resident serving path
+        (index/devstore.py). Query shapes needing host-side data fall back:
+        multi-term joins and exclusions (host sorted-intersect), metadata
+        modifiers (site:/tld:/filetype:/protocol), date-sort, and
+        authority-boosted profiles (host-count stats)."""
+        q = self.query
+        ds = self.segment.devstore
+        if ds is None:
+            return None
+        inc, exc = q.goal.include_hashes, q.goal.exclude_hashes
+        if len(inc) != 1 or exc:
+            return None
+        m = q.modifier
+        if m.sitehost or m.tld or m.filetype or m.protocol or m.date_sort:
+            return None
+        if q.profile.authority > 12:
+            return None
+        from ..index.devstore import NO_FLAG, NO_LANG
+        flag = _CD_FLAG.get(q.contentdom)
+        with StageTimer(EClass.SEARCH, "DEVRANK"):
+            return ds.rank_term(
+                inc[0], q.profile, q.lang, k=k,
+                lang_filter=(P.pack_language(m.language) if m.language
+                             else NO_LANG),
+                flag_bit=NO_FLAG if flag is None else flag,
+                from_days=m.from_days, to_days=m.to_days)
 
     def _dense_rerank(self, scores, docids):
         """M7 second stage: blend dense cosine similarity into the sparse
