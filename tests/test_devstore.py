@@ -198,6 +198,69 @@ def test_budget_skip_falls_back():
     assert ds.rank_term(TH, RankingProfile(), k=10) is None
 
 
+def test_pruning_exact_and_skips_tiles():
+    """Default-profile query over a proxy-sorted multi-tile span must
+    return the exact oracle top-k while reading only the first tile."""
+    rng = np.random.default_rng(20)
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(rng, 4 * TILE + 123))
+    idx.flush()
+    ds = _store(idx)
+    got = ds.rank_term(TH, RankingProfile(), k=100)
+    _assert_same_ranking(got, _oracle(idx, TH, 100))
+    assert ds.prune_rounds >= 1
+    assert ds.pruned_tiles >= 3, "tail tiles were not pruned"
+
+
+def test_pruning_exact_under_nondefault_profile():
+    """A profile with boosted coefficients shifts the bound (possible
+    escalations) but the returned top-k must still be oracle-exact."""
+    rng = np.random.default_rng(21)
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(rng, 2 * TILE + 77))
+    idx.flush()
+    ds = _store(idx)
+    prof = RankingProfile(worddistance=2, appemph=15, urllength=12, tf=3)
+    got = ds.rank_term(TH, prof, k=60)
+    _assert_same_ranking(got, _oracle(idx, TH, 60, profile=prof))
+
+
+def test_pruning_exact_under_language_preference():
+    rng = np.random.default_rng(22)
+    idx = RWIIndex()
+    p = _plist(rng, TILE + 500)
+    p.feats[::3, P.F_LANGUAGE] = P.pack_language("de")
+    idx.add_many(TH, p)
+    idx.flush()
+    ds = _store(idx)
+    got = ds.rank_term(TH, RankingProfile(), language="de", k=50)
+    _assert_same_ranking(got, _oracle(idx, TH, 50, lang="de"))
+
+
+def test_tombstone_disables_pruning_until_merge():
+    """Deletes after packing must force the exact live-stats kernel (frozen
+    pack stats would drift); the next merge folds them and re-arms."""
+    rng = np.random.default_rng(23)
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(rng, 2 * TILE))
+    idx.flush()
+    ds = _store(idx)
+    ds.rank_term(TH, RankingProfile(), k=10)
+    rounds0 = ds.prune_rounds
+    assert rounds0 >= 1
+    idx.delete_doc(3)
+    got = ds.rank_term(TH, RankingProfile(), k=10)
+    assert ds.prune_rounds == rounds0, "pruned while tombstones postdate span"
+    _assert_same_ranking(got, _oracle(idx, TH, 10))
+    # second run, then fold everything: pruning re-arms
+    idx.add_many(TH, _plist(rng, 100, base=10 ** 6))
+    idx.flush()
+    assert idx.merge_runs(max_runs=1)
+    got = ds.rank_term(TH, RankingProfile(), k=10)
+    assert ds.prune_rounds > rounds0
+    _assert_same_ranking(got, _oracle(idx, TH, 10))
+
+
 def test_searchevent_device_vs_host_identical():
     """End-to-end: SearchEvent with devstore enabled returns the same page
     as with it disabled."""

@@ -35,6 +35,23 @@ daterange) evaluate inside the kernel from scalar parameters; queries
 needing host-side data (site:/tld:/filetype: metadata checks, exclusion
 terms, date-sort, authority-boosted profiles) fall back to the host path
 in SearchEvent — eligibility is decided by ``DeviceSegmentStore.eligible``.
+
+Block-max pruning (VERDICT r1 #4 — the only way past the HBM roofline):
+at pack time each term's rows are reordered by a PROXY score (the default
+ranking profile evaluated against the span's frozen normalization stats,
+descending), and the proxy score of each tile's best row is stored in a
+device side-table (``pmax``). A query then scores only a prefix of B tiles
+and verifies ON DEVICE that no unscored tile can beat the running k-th
+score: for any query profile, score_q(row) <= pmax(tile) * 2^max_s(cq_s -
+cp_s) because every signal contributes non-negatively with profile-only
+shift differences (the WAND upper-bound argument, specialized to shift
+coefficients). If verification fails the host escalates B — exactness is
+guaranteed by construction, and with the proxy ordering the first tile
+almost always suffices, so a 10M-posting term reads ~32k rows instead of
+10M. The pruned path uses the span's PACK-TIME normalization stats (the
+LSM contract: bounds are block metadata, refreshed at merge); queries with
+constraint filters or a RAM delta take the exact live-stats streaming
+kernel instead.
 """
 
 from __future__ import annotations
@@ -47,7 +64,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.ranking import (cardinal_from_stats, compact_feats, local_stats)
+from ..ops.ranking import (_NORM_DIRECT, RankingProfile, cardinal_from_stats,
+                           compact_feats, local_stats)
 from ..ops.streaming import merge_stats
 from ..utils.eventtracker import EClass, update as track
 from . import postings as P
@@ -57,8 +75,6 @@ from . import postings as P
 # in-span predicate), so the arena always keeps >= one spare tile of
 # capacity past the used region to keep dynamic_slice in bounds
 TILE = 32_768
-# rows per packing upload (one compiled shape for bulk run packing)
-PACK_CHUNK = 1 << 18
 # delta/remainder blocks pad to buckets (bounds compile count)
 _DELTA_BUCKETS = (256, 1024, 4096, 16_384, 65_536, 262_144)
 
@@ -67,6 +83,107 @@ NO_FLAG = -1         # contentdom flag sentinel
 DAYS_NONE_LO = -(2 ** 30)
 DAYS_NONE_HI = 2 ** 30
 NEG_INF32 = -(2 ** 31 - 1)
+INT32_MAX = 2 ** 31 - 1
+
+# prune-prefix escalation buckets (tiles scored before tail verification)
+_PRUNE_B = (1, 8, 64, 512, 4096)
+# safety margin added to stored proxy maxima: the device tf-normalization
+# runs in float32 and may differ from the numpy pack-time computation by
+# one unit, worth up to 1 << tf_coeff score points
+_PMAX_MARGIN_EXTRA = 64
+
+
+class Span:
+    """One packed extent of a (run, term): arena rows + prune side-table."""
+
+    __slots__ = ("start", "count", "tstart", "tcount", "stats", "dead_seq")
+
+    def __init__(self, start, count, tstart=-1, tcount=0, stats=None,
+                 dead_seq=-1):
+        self.start = start
+        self.count = count
+        self.tstart = tstart      # first row in the pmax side-table
+        self.tcount = tcount      # tiles in the side-table
+        self.stats = stats        # frozen pack-time normalization stats
+        # tombstone count at the span's run creation: pruning (frozen
+        # stats) is exact only while no tombstone postdates the span —
+        # sp.dead_seq == len(rwi tombstones) means none does; -1 = unknown
+        # provenance (legacy run), never prunable until the next merge
+        self.dead_seq = dead_seq
+
+
+_ACTIVE_COLS = ~np.isin(
+    np.arange(P.NF), [P.F_FLAGS, P.F_DOCTYPE, P.F_LANGUAGE, P.F_DOMLENGTH])
+
+
+def _pack_stats_np(feats16: np.ndarray, flags: np.ndarray) -> dict:
+    """Frozen span normalization stats (numpy twin of ops.ranking
+    local_stats over a compact block, all rows valid)."""
+    f = feats16.astype(np.int32)
+    tf = f[:, P.F_HITCOUNT].astype(np.float32) / (
+        f[:, P.F_WORDS_IN_TEXT] + f[:, P.F_WORDS_IN_TITLE] + 1
+    ).astype(np.float32)
+    return {
+        "col_min": f.min(axis=0).astype(np.int32),
+        "col_max": f.max(axis=0).astype(np.int32),
+        "tf_min": np.float32(tf.min()),
+        "tf_max": np.float32(tf.max()),
+    }
+
+
+def _cardinal_np(feats16: np.ndarray, flags: np.ndarray, stats: dict,
+                 prof: RankingProfile, language_pref: int) -> np.ndarray:
+    """Numpy twin of cardinal_from_stats (authority off) — used for the
+    pack-time proxy ordering. Integer parts are bit-exact vs the device
+    kernel; the float32 tf normalization may drift by one unit (covered by
+    the stored-bound margin)."""
+    f = feats16.astype(np.int32)
+    col_min, col_max = stats["col_min"], stats["col_max"]
+    span = col_max - col_min
+    safe = np.maximum(span, 1)
+    norm = ((f - col_min[None, :]) * 256) // safe[None, :]
+    norm = np.where(span[None, :] == 0, 0, norm)
+    inv = np.where(span[None, :] == 0, 0, 256 - norm)
+    contrib = np.where(_NORM_DIRECT[None, :], norm, inv)
+    per_col = contrib << np.abs(prof.norm_coeffs())[None, :]
+    score = np.where(_ACTIVE_COLS[None, :], per_col, 0).sum(
+        axis=1, dtype=np.int64)
+    score += (256 - f[:, P.F_DOMLENGTH]) << prof.domlength
+    tf = f[:, P.F_HITCOUNT].astype(np.float32) / (
+        f[:, P.F_WORDS_IN_TEXT] + f[:, P.F_WORDS_IN_TITLE] + 1
+    ).astype(np.float32)
+    tf_span = stats["tf_max"] - stats["tf_min"]
+    tf_norm = np.where(
+        tf_span > 0,
+        (tf - stats["tf_min"]) * np.float32(256.0) / max(tf_span, 1e-9),
+        0.0).astype(np.int32)
+    score += tf_norm.astype(np.int64) << prof.tf
+    score += np.where(f[:, P.F_LANGUAGE] == language_pref,
+                      255 << prof.language, 0)
+    bits, shifts = prof.flag_coeffs()
+    hit = (flags[:, None] >> bits[None, :]) & 1
+    score += (hit * (255 << shifts[None, :])).sum(axis=1, dtype=np.int64)
+    return score.astype(np.int64)
+
+
+def _signal_shift_vector(prof: RankingProfile) -> np.ndarray:
+    """Every signal's shift coefficient in one fixed order (for the
+    cross-profile bound max_s(cq_s - cp_s))."""
+    bits_shifts = prof.flag_coeffs()[1]
+    return np.concatenate([
+        np.abs(prof.norm_coeffs())[_ACTIVE_COLS],
+        np.array([prof.domlength, prof.tf, prof.language], np.int32),
+        bits_shifts,
+    ]).astype(np.int32)
+
+
+_PROXY_PROFILE = RankingProfile()          # the pack-time ordering profile
+_PROXY_SHIFTS = _signal_shift_vector(_PROXY_PROFILE)
+
+
+def _bound_shift(prof: RankingProfile) -> int:
+    """log2 of the bound factor M: score_q(row) <= proxy(row) << shift."""
+    return int(np.max(_signal_shift_vector(prof) - _PROXY_SHIFTS))
 
 
 def _bucket_delta(n: int) -> int:
@@ -199,31 +316,119 @@ def _rank_spans_kernel(feats16, flags, docids, dead,
     return run
 
 
+def _pruned_span_topk(feats16, flags, docids, dead, pmax,
+                      start, count, tstart, tcount,
+                      col_min, col_max, tf_min, tf_max,
+                      bound_shift, lang_term,
+                      norm_coeffs, flag_bits, flag_shifts,
+                      domlength_coeff, tf_coeff, language_coeff,
+                      authority_coeff, language_pref,
+                      k: int, b: int):
+    """Traced body: prefix-scored, tail-verified top-k over ONE
+    proxy-sorted span (shared by the solo and batched kernels).
+
+    Scores the first min(b, n_tiles) tiles against the span's frozen
+    pack-time stats, then walks the unscored tail's pmax side-table: every
+    tail tile must satisfy (pmax << bound_shift) + lang_term <= theta (the
+    running k-th score) for the result to be exact. Returns
+    (scores, docids, ok); ok=False means the caller escalates b.
+    """
+    stats = {"col_min": col_min, "col_max": col_max,
+             "tf_min": tf_min, "tf_max": tf_max,
+             "host_counts": jnp.zeros((1,), jnp.int32)}
+    n_tiles = tcount
+    scored = jnp.minimum(jnp.int32(b), n_tiles)
+
+    def body(i, run):
+        off = start + i * TILE
+        f = lax.dynamic_slice(feats16, (off, 0), (TILE, P.NF))
+        fl = lax.dynamic_slice(flags, (off,), (TILE,))
+        dd = lax.dynamic_slice(docids, (off,), (TILE,))
+        v = _tile_valid(dd, dead, jnp.arange(TILE) < (count - i * TILE))
+        sc = cardinal_from_stats(f, v, jnp.zeros(TILE, jnp.int32), stats,
+                                 norm_coeffs, flag_bits, flag_shifts,
+                                 domlength_coeff, tf_coeff, language_coeff,
+                                 authority_coeff, language_pref,
+                                 fast_div=True, flags=fl)
+        run_s, run_d = run
+        tile_s, tile_i = lax.top_k(sc, min(k, TILE))
+        s = jnp.concatenate([run_s, tile_s])
+        d = jnp.concatenate([run_d, dd[tile_i]])
+        top_s, idx = lax.top_k(s, k)
+        return top_s, d[idx]
+
+    init = (jnp.full((k,), NEG_INF32, jnp.int32),
+            jnp.full((k,), -1, jnp.int32))
+    run_s, run_d = lax.fori_loop(0, scored, body, init)
+    theta = run_s[k - 1]
+
+    def ub_body(j, ok):
+        pm = pmax[tstart + j]
+        pos = jnp.maximum(bound_shift, 0)     # negative shift = query's
+        neg = jnp.maximum(-bound_shift, 0)    # coefficients all <= proxy's
+        # saturation cap leaves headroom for the additive language term so
+        # `shifted + lang_term` can never wrap int32 (a wrapped bound
+        # would compare <= theta and prune tiles it must not)
+        cap = jnp.int32(INT32_MAX - 2048) - lang_term
+        shifted = jnp.where(pm > (cap >> pos), cap, pm << pos) >> neg
+        return ok & (shifted + lang_term <= theta)
+
+    ok = lax.fori_loop(scored, n_tiles, ub_body, jnp.bool_(True))
+    return run_s, run_d, ok
+
+
+@partial(jax.jit, static_argnames=("k", "b"))
+def _rank_pruned_kernel(feats16, flags, docids, dead, pmax,
+                        start, count, tstart, tcount,
+                        col_min, col_max, tf_min, tf_max,
+                        bound_shift, lang_term,
+                        norm_coeffs, flag_bits, flag_shifts,
+                        domlength_coeff, tf_coeff, language_coeff,
+                        authority_coeff, language_pref,
+                        k: int, b: int):
+    return _pruned_span_topk(
+        feats16, flags, docids, dead, pmax, start, count, tstart, tcount,
+        col_min, col_max, tf_min, tf_max, bound_shift, lang_term,
+        norm_coeffs, flag_bits, flag_shifts, domlength_coeff, tf_coeff,
+        language_coeff, authority_coeff, language_pref, k=k, b=b)
+
+
+@partial(jax.jit, static_argnames=("k", "b"))
+def _rank_pruned_batch_kernel(feats16, flags, docids, dead, pmax,
+                              starts, counts, tstarts, tcounts,
+                              col_mins, col_maxs, tf_mins, tf_maxs,
+                              bound_shift, lang_term,
+                              norm_coeffs, flag_bits, flag_shifts,
+                              domlength_coeff, tf_coeff, language_coeff,
+                              authority_coeff, language_pref,
+                              k: int, b: int):
+    """Batched pruned ranking: lax.map over per-query span descriptors —
+    the dynamic-batching dispatch (one device round trip serves a whole
+    group of concurrent searches; the round trip is the latency floor on
+    remote-attached devices, and dispatch overhead even on local ones)."""
+    def one(x):
+        start, count, tstart, tcount, cmin, cmax, tmin, tmax = x
+        return _pruned_span_topk(
+            feats16, flags, docids, dead, pmax, start, count, tstart,
+            tcount, cmin, cmax, tmin, tmax, bound_shift, lang_term,
+            norm_coeffs, flag_bits, flag_shifts, domlength_coeff, tf_coeff,
+            language_coeff, authority_coeff, language_pref, k=k, b=b)
+
+    return lax.map(one, (starts, counts, tstarts, tcounts,
+                         col_mins, col_maxs, tf_mins, tf_maxs))
+
+
 # ---------------------------------------------------------------------------
 # The arena
 # ---------------------------------------------------------------------------
 
-def _reslab(chunks, slab: int):
-    """Re-chunk a (docids, feats) stream into exact `slab`-row slabs plus
-    one final remainder — thousands of tiny per-term chunks must not each
-    become a device upload."""
-    buf_d, buf_f, acc = [], [], 0
-    for d, f in chunks:
-        if not len(d):
-            continue
-        buf_d.append(np.asarray(d))
-        buf_f.append(np.asarray(f))
-        acc += len(d)
-        while acc >= slab:
-            D = np.concatenate(buf_d) if len(buf_d) > 1 else buf_d[0]
-            F = np.concatenate(buf_f) if len(buf_f) > 1 else buf_f[0]
-            yield D[:slab], F[:slab]
-            buf_d, buf_f, acc = [D[slab:]], [F[slab:]], acc - slab
-            if not acc:
-                buf_d, buf_f = [], []
-    if acc:
-        yield (np.concatenate(buf_d) if len(buf_d) > 1 else buf_d[0],
-               np.concatenate(buf_f) if len(buf_f) > 1 else buf_f[0])
+def _bucket_rows(n: int) -> int:
+    """Size buckets for arena writes (pow2 and 1.5*pow2: <=33% pad, a
+    bounded set of compiled write shapes)."""
+    p = 1 << max(8, (n - 1).bit_length())
+    if n <= p // 2 + p // 4:
+        return p // 2 + p // 4
+    return p
 
 
 # module-level jitted updaters (per-call lambdas would defeat the jit cache
@@ -256,6 +461,10 @@ class DeviceArena:
         self._doc_cap = 1 << 16
         self._dead = self._dev(np.zeros(self._doc_cap, bool))
         self._pending_dead: list[int] = []
+        # prune side-table: per-tile proxy-score maxima (margin folded in)
+        self._tcap = 1 << 12
+        self._tused = 0
+        self._pmax = self._dev(np.full(self._tcap, INT32_MAX, np.int32))
 
     def _dev(self, arr):
         return jax.device_put(arr, self.device)
@@ -294,33 +503,59 @@ class DeviceArena:
         self._docids = jnp.pad(self._docids, (0, pad), constant_values=-1)
         self._cap = new_cap
 
-    def _write_chunk(self, docids: np.ndarray, feats: np.ndarray,
-                     off: int, pad_to: int) -> None:
-        n = len(docids)
-        f16 = np.zeros((pad_to, P.NF), np.int16)
-        fl = np.zeros(pad_to, np.int32)
-        dd = np.full(pad_to, -1, np.int32)
-        cf, cfl = compact_feats(np.ascontiguousarray(feats, dtype=np.int32))
-        f16[:n], fl[:n], dd[:n] = cf, cfl, docids
-        off = np.int32(off)
-        self._feats16 = _write_rows2(self._feats16, self._dev(f16), off)
-        self._flags = _write_rows1(self._flags, self._dev(fl), off)
-        self._docids = _write_rows1(self._docids, self._dev(dd), off)
-
     def append_block(self, chunks) -> int:
         """Pack a flat block streamed as (docids, feats) numpy chunks;
-        returns the block's base row. Incoming chunks of any shape are
-        re-slabbed to PACK_CHUNK uploads (one compiled write shape) plus a
-        bucket-padded remainder; pad rows carry docid -1 and are either
+        returns the block's base row.
+
+        The whole block is assembled in HOST buffers first (a transient
+        spike of the block's size) and written with ONE device update per
+        array: every `dynamic_update_slice` without donation copies the
+        entire arena, so per-chunk writes would cost O(arena) each — the
+        round-1 10M pack spent minutes there. Buffers pad to size buckets
+        (bounded compile count); pad rows carry docid -1 and are either
         overwritten by the next append or left inert past the used mark."""
+        parts_d, parts_f = [], []
+        for docids, feats in chunks:
+            if len(docids):
+                parts_d.append(np.asarray(docids))
+                parts_f.append(np.asarray(feats))
         base = self._used
-        for docids, feats in _reslab(chunks, PACK_CHUNK):
-            n = len(docids)
-            pad = n if n == PACK_CHUNK else _bucket_delta(n)
-            self._grow_to(self._used + pad + TILE)
-            self._write_chunk(docids, feats, self._used, pad)
-            self._used += n
+        if not parts_d:
+            return base
+        dd = np.concatenate(parts_d) if len(parts_d) > 1 else parts_d[0]
+        ff = np.concatenate(parts_f) if len(parts_f) > 1 else parts_f[0]
+        n = len(dd)
+        pad = _bucket_rows(n)
+        f16 = np.zeros((pad, P.NF), np.int16)
+        fl = np.zeros(pad, np.int32)
+        dpad = np.full(pad, -1, np.int32)
+        cf, cfl = compact_feats(np.ascontiguousarray(ff, dtype=np.int32))
+        f16[:n], fl[:n], dpad[:n] = cf, cfl, dd
+        self._grow_to(self._used + pad + TILE)
+        off = np.int32(self._used)
+        self._feats16 = _write_rows2(self._feats16, self._dev(f16), off)
+        self._flags = _write_rows1(self._flags, self._dev(fl), off)
+        self._docids = _write_rows1(self._docids, self._dev(dpad), off)
+        self._used += n
         return base
+
+    def append_pmax(self, pmax: np.ndarray) -> int:
+        """Add a span's per-tile bound row to the side-table; returns its
+        start. Pad slots hold INT32_MAX (an always-failing bound — never
+        consulted because tcount caps the tail walk)."""
+        n = len(pmax)
+        b = 1 << max(8, (n - 1).bit_length())  # min bucket 256 rows
+        while self._tcap < self._tused + b:
+            self._pmax = jnp.pad(self._pmax, (0, self._tcap),
+                                 constant_values=INT32_MAX)
+            self._tcap *= 2
+        buf = np.full(b, INT32_MAX, np.int32)
+        buf[:n] = pmax
+        self._pmax = _write_rows1(self._pmax, self._dev(buf),
+                                  np.int32(self._tused))
+        start = self._tused
+        self._tused += n
+        return start
 
     def mark_dead(self, docid: int) -> None:
         self._pending_dead.append(docid)
@@ -344,6 +579,142 @@ class DeviceArena:
         return self._feats16, self._flags, self._docids
 
 
+class _QueryBatcher:
+    """Dynamic batching of concurrent pruned queries into one dispatch.
+
+    Natural batching with zero added latency: the dispatcher thread takes
+    the first pending query, drains whatever else is already queued (up to
+    max_batch), and issues ONE _rank_pruned_batch_kernel call for each
+    (profile, language, k) group. While that dispatch is in flight new
+    queries accumulate, so batches form exactly when concurrency exists —
+    the inference-server technique, applied to search. Throughput then
+    scales past the one-dispatch-per-query ceiling (the device round trip,
+    ~110 ms through a remote tunnel, a few hundred µs locally)."""
+
+    def __init__(self, store: "DeviceSegmentStore", max_batch: int = 16,
+                 dispatchers: int = 8):
+        import queue as _queue
+        self.store = store
+        self.max_batch = max_batch
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._stop = False
+        # a POOL of dispatcher threads: each one's kernel-call+fetch blocks
+        # for a full device round trip (the dispatch itself is synchronous
+        # through a remote tunnel), so overlap comes from concurrent
+        # dispatchers — throughput ~ dispatchers * batch / round-trip
+        self._threads = [
+            threading.Thread(target=self._loop,
+                             name=f"devstore-batcher-{i}", daemon=True)
+            for i in range(dispatchers)]
+        for t in self._threads:
+            t.start()
+
+    def submit(self, termhash: bytes, profile, language: str, kk: int):
+        """Blocking; returns ("ok", scores, docids, considered) |
+        ("prune_fail",) | ("ineligible",)."""
+        ev = threading.Event()
+        item = {"th": termhash, "profile": profile, "lang": language,
+                "kk": kk, "ev": ev, "res": ("ineligible",)}
+        self._q.put(item)
+        if not ev.wait(timeout=120.0):
+            return ("ineligible",)  # dispatcher wedged: serve solo
+        return item["res"]
+
+    def close(self) -> None:
+        self._stop = True
+        for _ in self._threads:
+            self._q.put(None)
+
+    # -- dispatcher pool -----------------------------------------------------
+
+    def _loop(self) -> None:
+        import queue as _queue
+        while True:
+            item = self._q.get()
+            if item is None:
+                return  # one shutdown sentinel per dispatcher thread
+            batch = [item]
+            while len(batch) < self.max_batch:
+                try:
+                    nxt = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    # another thread's shutdown sentinel: hand it back
+                    self._q.put(None)
+                    break
+                batch.append(nxt)
+            try:
+                self._dispatch(batch)
+            except Exception:  # pragma: no cover - defensive
+                for it in batch:
+                    it["res"] = ("ineligible",)
+                    it["ev"].set()
+
+    def _dispatch(self, batch: list[dict]) -> None:
+        store = self.store
+        # one consistent snapshot serves the whole batch (see rank_term)
+        with store._lock:
+            feats16, flags, docids = store.arena.arrays()
+            dead = store.arena.dead_array()
+            pmax = store.arena._pmax
+            spans = {it["th"]: store.spans_for(it["th"]) for it in batch}
+        with store.rwi._lock:
+            tomb = len(store.rwi._tombstones)
+            has_delta = {th: bool(store.rwi._ram.get(th))
+                         for th in spans}
+        groups: dict[tuple, list[dict]] = {}
+        for it in batch:
+            sp = spans[it["th"]]
+            if (sp is None or len(sp) != 1 or sp[0].tcount <= 0
+                    or sp[0].dead_seq != tomb or has_delta[it["th"]]):
+                it["ev"].set()  # stays ("ineligible",): caller goes solo
+                continue
+            it["span"] = sp[0]
+            key = (it["profile"].to_external_string(), it["lang"], it["kk"])
+            groups.setdefault(key, []).append(it)
+        b = _PRUNE_B[0]
+        for (_, lang, kk), items in groups.items():
+            prof = items[0]["profile"]
+            consts = store._profile_consts(prof, lang)
+            # fixed batch shape: padded slots (count 0) cost nothing, while
+            # per-size shapes would each recompile (seconds) on first use
+            bs = self.max_batch
+            starts = np.zeros(bs, np.int32)
+            counts = np.zeros(bs, np.int32)     # pad queries: count 0
+            tstarts = np.zeros(bs, np.int32)
+            tcounts = np.zeros(bs, np.int32)    # -> no tiles, ok=True
+            cmins = np.zeros((bs, P.NF), np.int32)
+            cmaxs = np.zeros((bs, P.NF), np.int32)
+            tmins = np.zeros(bs, np.float32)
+            tmaxs = np.zeros(bs, np.float32)
+            for i, it in enumerate(items):
+                sp = it["span"]
+                starts[i], counts[i] = sp.start, sp.count
+                tstarts[i], tcounts[i] = sp.tstart, sp.tcount
+                cmins[i] = sp.stats["col_min"]
+                cmaxs[i] = sp.stats["col_max"]
+                tmins[i] = sp.stats["tf_min"]
+                tmaxs[i] = sp.stats["tf_max"]
+            out = _rank_pruned_batch_kernel(
+                feats16, flags, docids, dead, pmax,
+                starts, counts, tstarts, tcounts,
+                cmins, cmaxs, tmins, tmaxs,
+                np.int32(_bound_shift(prof)),
+                np.int32(255 << min(max(prof.language, 0), 15)),
+                *consts, k=kk, b=b)
+            s, d, ok = jax.device_get(out)
+            store.prune_rounds += 1
+            for i, it in enumerate(items):
+                if bool(ok[i]):
+                    store.pruned_tiles += max(0, it["span"].tcount - b)
+                    it["res"] = ("ok", s[i], d[i], it["span"].count)
+                else:
+                    it["res"] = ("prune_fail",)
+            for it in items:
+                it["ev"].set()
+
+
 class DeviceSegmentStore:
     """Span registry + query dispatch over a DeviceArena.
 
@@ -364,6 +735,9 @@ class DeviceSegmentStore:
         self._garbage_rows = 0
         self.queries_served = 0
         self.fallbacks = 0
+        self.prune_rounds = 0    # pruned-kernel dispatches (incl. escalations)
+        self.pruned_tiles = 0    # tiles skipped by bound verification
+        self._batcher: _QueryBatcher | None = None
         # seed tombstones recorded before this store existed (restart path)
         for docid in rwi._tombstones:
             self.arena.mark_dead(docid)
@@ -377,9 +751,12 @@ class DeviceSegmentStore:
     # -- packing (listener protocol) ----------------------------------------
 
     def on_run_added(self, run) -> None:
-        """Pack a frozen run into the arena as ONE flat block, reusing the
-        run's own contiguous per-term layout (PagedRun .dat order); the
-        term registry then addresses extents at block_base + term_start."""
+        """Pack a frozen run into one contiguous arena block, each term's
+        rows reordered by the pack-time proxy score (descending) with its
+        per-tile bound row in the pmax side-table — the prune layout.
+
+        Host memory: the run materializes once in host buffers for a
+        single arena write (transient spike of the run's size)."""
         with self._lock:
             rid = id(run)
             if rid in self._packed:
@@ -393,16 +770,47 @@ class DeviceSegmentStore:
                 # its terms); merges may later shrink the index back in
                 track(EClass.INDEX, "devstore_skip", rows)
                 return
-            base = self.arena.append_block(run.flat_chunks(PACK_CHUNK))
+            base = self.arena.used_rows
+            margin = (1 << _PROXY_PROFILE.tf) + _PMAX_MARGIN_EXTRA
+            lang_en = P.pack_language("en")
+            meta: list[tuple] = []   # (th, rel_off, n, rel_toff, n_tiles, stats)
+            pmax_parts: list[np.ndarray] = []
+            pending: list[tuple[np.ndarray, np.ndarray]] = []
+            off = toff = 0
+            for th in list(run.term_hashes()):
+                p = run.get(th)
+                if p is None or len(p) == 0:
+                    continue
+                f16, fl = compact_feats(p.feats)
+                stats = _pack_stats_np(f16, fl)
+                proxy = _cardinal_np(f16, fl, stats, _PROXY_PROFILE, lang_en)
+                order = np.argsort(-proxy, kind="stable")
+                n = len(p)
+                n_tiles = (n + TILE - 1) // TILE
+                pmax_parts.append(np.minimum(
+                    proxy[order][::TILE] + margin, INT32_MAX).astype(np.int32))
+                meta.append((th, off, n, toff, n_tiles, stats))
+                off += n
+                toff += n_tiles
+                pending.append((p.docids[order], p.feats[order]))
+            if pending:
+                # one arena write for the whole run (transient host buffer
+                # of the run's size; see append_block)
+                self.arena.append_block(pending)
+            tbase = self.arena.append_pmax(
+                np.concatenate(pmax_parts) if pmax_parts
+                else np.empty(0, np.int32))
+            dseq = getattr(run, "dead_seq", -1)
             self._packed[rid] = {
-                th: (base + s, c) for th, (s, c) in run.all_spans().items()}
+                th: Span(base + o, n, tbase + to, nt, st, dseq)
+                for th, o, n, to, nt, st in meta}
             track(EClass.INDEX, "devstore_pack", rows)
 
     def on_run_removed(self, run) -> None:
         with self._lock:
             spans = self._packed.pop(id(run), None)
             if spans:
-                self._garbage_rows += sum(c for _, c in spans.values())
+                self._garbage_rows += sum(sp.count for sp in spans.values())
             # dead extents are reclaimed wholesale: once more than half the
             # arena is garbage (merges retire whole runs), rebuild it from
             # the live runs
@@ -433,8 +841,8 @@ class DeviceSegmentStore:
 
     def live_rows(self) -> int:
         with self._lock:
-            return sum(c for spans in self._packed.values()
-                       for _, c in spans.values())
+            return sum(sp.count for spans in self._packed.values()
+                       for sp in spans.values())
 
     def repack(self) -> None:
         """Rebuild the arena from live runs (reclaims dead extents). The
@@ -452,13 +860,27 @@ class DeviceSegmentStore:
             for run in list(self.rwi._runs):
                 self.on_run_added(run)
 
+    def enable_batching(self, max_batch: int = 16,
+                        dispatchers: int = 8) -> None:
+        """Coalesce concurrent pruned queries into pooled batch dispatches."""
+        if self._batcher is None:
+            self._batcher = _QueryBatcher(self, max_batch=max_batch,
+                                          dispatchers=dispatchers)
+
+    def close(self) -> None:
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None
+        if self.rwi.listener is self:
+            self.rwi.listener = None
+
     # -- query dispatch ------------------------------------------------------
 
-    def spans_for(self, termhash: bytes) -> list[tuple[int, int]] | None:
+    def spans_for(self, termhash: bytes) -> list[Span] | None:
         """Arena extents covering ALL frozen postings of a term, oldest
         first — or None when any run holding the term is not packed."""
         with self._lock:
-            out: list[tuple[int, int]] = []
+            out: list[Span] = []
             for run in list(self.rwi._runs):
                 if not run.has(termhash):
                     continue
@@ -508,45 +930,93 @@ class DeviceSegmentStore:
                 return None
             feats16, flags, docids = self.arena.arrays()
             dead = self.arena.dead_array()
+            pmax = self.arena._pmax
         # RAM delta: the term's unflushed postings (ram/array split)
         with self.rwi._lock:
             delta = self.rwi._ram_postings(termhash)
         if not spans and delta is None:
             return np.empty(0, np.int32), np.empty(0, np.int32), 0
-        considered = sum(c for _, c in spans) + (len(delta) if delta else 0)
-
-        # per-query host args ride along with the ONE kernel dispatch (no
+        considered = sum(sp.count for sp in spans) + (len(delta) if delta
+                                                      else 0)
+        with_delta = delta is not None and len(delta) > 0
+        consts = self._profile_consts(profile, language)
+        kk = max(16, 1 << (max(k, 1) - 1).bit_length())  # bucket k: pow2
+        # per-query host args ride along with the kernel dispatch (no
         # explicit device_puts: through a remote tunnel every separate
         # transfer is a full round trip, and the round trip IS the latency
         # floor — see BASELINE.md served-path notes)
-        starts = np.zeros(self.MAX_SPANS, np.int32)
-        counts = np.zeros(self.MAX_SPANS, np.int32)
-        for i, (s, c) in enumerate(spans):
-            starts[i], counts[i] = s, c
-        with_delta = delta is not None and len(delta) > 0
-        if with_delta:
-            n = len(delta)
-            b = _bucket_delta(n)
-            df = np.zeros((b, P.NF), np.int16)
-            dfl = np.zeros(b, np.int32)
-            ddd = np.full(b, -1, np.int32)
-            cf, cfl = compact_feats(delta.feats)
-            df[:n], dfl[:n], ddd[:n] = cf, cfl, delta.docids
-            d_args = (df, dfl, ddd)
-        else:
-            d_args = (np.zeros((1, P.NF), np.int16),
-                      np.zeros(1, np.int32), np.full(1, -1, np.int32))
 
-        consts = self._profile_consts(profile, language)
-        kk = max(16, 1 << (max(k, 1) - 1).bit_length())  # bucket k: pow2
-        out = _rank_spans_kernel(
-            feats16, flags, docids, dead,
-            starts, counts, *d_args,
-            np.int32(lang_filter), np.int32(flag_bit),
-            np.int32(DAYS_NONE_LO if from_days is None else from_days),
-            np.int32(DAYS_NONE_HI if to_days is None else to_days),
-            *consts, k=kk, n_spans=self.MAX_SPANS, with_delta=with_delta)
-        s, d = jax.device_get(out)  # one combined fetch
+        no_filters = (lang_filter == NO_LANG and flag_bit == NO_FLAG
+                      and from_days is None and to_days is None)
+        s = d = None
+        prune_from = 0  # index into _PRUNE_B for the solo escalation
+        # batched dispatch: concurrent pruned queries share one round trip
+        if (self._batcher is not None and no_filters
+                and threading.current_thread()
+                not in self._batcher._threads):
+            res = self._batcher.submit(termhash, profile, language, kk)
+            if res[0] == "ok":
+                s, d = res[1], res[2]
+            elif res[0] == "prune_fail":
+                # the batch already proved _PRUNE_B[0] insufficient: the
+                # solo escalation must not repeat that round trip
+                prune_from = 1
+            # "ineligible": fall through to the solo paths
+
+        # pruned fast path: one merged span, no delta, no constraint
+        # filters — stats are the span's frozen pack stats, so only a
+        # prefix of proxy-sorted tiles is read (the tail is bound-verified)
+        if (s is None and no_filters
+                and len(spans) == 1 and spans[0].tcount > 0
+                and not with_delta
+                and spans[0].dead_seq == len(self.rwi._tombstones)):
+            sp = spans[0]
+            st = sp.stats
+            shift = np.int32(_bound_shift(profile))
+            lang_term = np.int32(255 << min(max(profile.language, 0), 15))
+            for b in _PRUNE_B[prune_from:]:
+                out = _rank_pruned_kernel(
+                    feats16, flags, docids, dead, pmax,
+                    np.int32(sp.start), np.int32(sp.count),
+                    np.int32(sp.tstart), np.int32(sp.tcount),
+                    st["col_min"], st["col_max"], st["tf_min"],
+                    st["tf_max"], shift, lang_term, *consts, k=kk, b=b)
+                s, d, ok = jax.device_get(out)  # one combined fetch
+                self.prune_rounds += 1
+                if bool(ok):
+                    self.pruned_tiles += max(0, sp.tcount - b)
+                    break
+                s = d = None  # bound failed: escalate the prefix
+            # every bucket exhausted without ok (pathological profile):
+            # fall through to the exact streaming scan below
+
+        if s is None:
+            starts = np.zeros(self.MAX_SPANS, np.int32)
+            counts = np.zeros(self.MAX_SPANS, np.int32)
+            for i, sp in enumerate(spans):
+                starts[i], counts[i] = sp.start, sp.count
+            if with_delta:
+                n = len(delta)
+                b = _bucket_delta(n)
+                df = np.zeros((b, P.NF), np.int16)
+                dfl = np.zeros(b, np.int32)
+                ddd = np.full(b, -1, np.int32)
+                cf, cfl = compact_feats(delta.feats)
+                df[:n], dfl[:n], ddd[:n] = cf, cfl, delta.docids
+                d_args = (df, dfl, ddd)
+            else:
+                d_args = (np.zeros((1, P.NF), np.int16),
+                          np.zeros(1, np.int32), np.full(1, -1, np.int32))
+
+            out = _rank_spans_kernel(
+                feats16, flags, docids, dead,
+                starts, counts, *d_args,
+                np.int32(lang_filter), np.int32(flag_bit),
+                np.int32(DAYS_NONE_LO if from_days is None else from_days),
+                np.int32(DAYS_NONE_HI if to_days is None else to_days),
+                *consts, k=kk, n_spans=self.MAX_SPANS,
+                with_delta=with_delta)
+            s, d = jax.device_get(out)  # one combined fetch
         keep = (d >= 0) & (s > NEG_INF32)
         s, d = s[keep], d[keep]
         # cross-run duplicate docids are possible after raw transfer

@@ -85,6 +85,28 @@ class DocumentMetadata:
         return self.fields.get(k, default)
 
 
+class LazyRow:
+    """Read-on-demand view of one doc's metadata (DocumentMetadata.get
+    interface over the live columns; no row materialization)."""
+
+    __slots__ = ("_store", "_docid", "urlhash")
+
+    def __init__(self, store: "MetadataStore", docid: int):
+        self._store = store
+        self._docid = docid
+        self.urlhash = store._urlhashes[docid]
+
+    def get(self, k, default=None):
+        s, d = self._store, self._docid
+        if k in s._text:
+            return s._text[k][d]
+        if k in s._ints:
+            return s._ints[k][d]
+        if k in s._doubles:
+            return s._doubles[k][d]
+        return default
+
+
 class MetadataStore:
     """docid-addressed columnar store with urlhash identity index."""
 
@@ -138,6 +160,33 @@ class MetadataStore:
                 self._doubles[f].append(float(doc.get(f, 0.0)))
             self._journal_write(doc)
             return docid
+
+    def bulk_load(self, urlhashes: list[bytes], **columns) -> int:
+        """Bulk-append rows column-wise (surrogate/import fast path: one
+        list extend per column instead of per-document put()). Unlisted
+        columns fill with defaults; urlhashes must be new. Returns the
+        first allocated docid. NOT journaled — callers importing into a
+        persistent store should snapshot/export afterwards (import jobs
+        are re-runnable, unlike organic crawl writes)."""
+        n = len(urlhashes)
+        for name, col in columns.items():
+            if name not in TEXT_FIELDS and name not in INT_FIELDS \
+                    and name not in DOUBLE_FIELDS:
+                raise KeyError(f"unknown metadata field: {name}")
+            if len(col) != n:
+                raise ValueError(f"column {name}: {len(col)} rows != {n}")
+        with self._lock:
+            base = len(self._urlhashes)
+            self._urlhash_to_docid.update(
+                (uh, base + i) for i, uh in enumerate(urlhashes))
+            self._urlhashes.extend(urlhashes)
+            for f in TEXT_FIELDS:
+                self._text[f].extend(columns.get(f) or [""] * n)
+            for f in INT_FIELDS:
+                self._ints[f].extend(columns.get(f) or [0] * n)
+            for f in DOUBLE_FIELDS:
+                self._doubles[f].extend(columns.get(f) or [0.0] * n)
+            return base
 
     def set_field(self, docid: int, field: str, value) -> None:
         """Postprocessing update (e.g. references_i from the citation index)."""
@@ -199,6 +248,15 @@ class MetadataStore:
 
     def is_deleted(self, docid: int) -> bool:
         return docid in self._deleted
+
+    def row(self, docid: int) -> "LazyRow | None":
+        """Column-backed row view: reads fields on demand without
+        materializing the 32-field dict (the result-drain hot path calls
+        this per candidate; get() is the full-row API surface)."""
+        if docid is None or docid >= len(self._urlhashes) \
+                or docid in self._deleted:
+            return None
+        return LazyRow(self, docid)
 
     def get(self, docid: int) -> DocumentMetadata | None:
         with self._lock:

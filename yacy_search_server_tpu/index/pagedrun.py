@@ -95,7 +95,8 @@ class PagedRun:
     """Immutable disk run: per-term offset index + mmap'd flat arrays."""
 
     def __init__(self, path: str, index: dict[bytes, tuple[int, int]],
-                 total: int, cache: TermCache | None = None):
+                 total: int, cache: TermCache | None = None,
+                 dead_seq: int = -1):
         self.path = path
         self._index = index                  # termhash -> (start, count)
         self._total = total
@@ -103,12 +104,18 @@ class PagedRun:
         self._mm_docids: np.ndarray | None = None
         self._mm_feats: np.ndarray | None = None
         self.n_postings = sum(c for _, c in index.values())
+        # tombstone count at creation: this run's rows exclude every
+        # tombstone journaled before it was written (flush purges the RAM
+        # buffer; merge folds). Consumed by the device store's pruning
+        # eligibility; -1 = unknown (legacy file without the header field).
+        self.dead_seq = dead_seq
 
     # -- construction --------------------------------------------------------
 
     @staticmethod
     def write(path: str, terms: dict[bytes, PostingsList],
-              cache: TermCache | None = None) -> "PagedRun":
+              cache: TermCache | None = None,
+              dead_seq: int = -1) -> "PagedRun":
         """Persist a term->postings dict as one paged run (atomic)."""
         order = sorted(terms.keys())
         total = sum(len(terms[th]) for th in order)
@@ -125,13 +132,13 @@ class PagedRun:
                 f.write(np.ascontiguousarray(
                     terms[th].feats, dtype="<i4").tobytes())
         with open(tmp_tix, "w", encoding="ascii") as f:
-            f.write(f"{_MAGIC} {total}\n")
+            f.write(f"{_MAGIC} {total} {dead_seq}\n")
             for th in order:
                 s, c = index[th]
                 f.write(f"{th.decode('ascii')} {s} {c}\n")
         os.replace(tmp_dat, path)
         os.replace(tmp_tix, _tix_path(path))
-        return PagedRun(path, index, total, cache)
+        return PagedRun(path, index, total, cache, dead_seq)
 
     @staticmethod
     def open(path: str, cache: TermCache | None = None) -> "PagedRun":
@@ -140,10 +147,11 @@ class PagedRun:
             header = f.readline().split()
             assert header[0] == _MAGIC, f"bad run header in {path}: {header}"
             total = int(header[1])
+            dead_seq = int(header[2]) if len(header) > 2 else -1
             for line in f:
                 th, s, c = line.split()
                 index[th.encode("ascii")] = (int(s), int(c))
-        return PagedRun(path, index, total, cache)
+        return PagedRun(path, index, total, cache, dead_seq)
 
     def _maps(self) -> tuple[np.ndarray, np.ndarray]:
         if self._mm_docids is None:

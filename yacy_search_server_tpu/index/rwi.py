@@ -56,10 +56,13 @@ class FrozenRun:
     drop_term/span/close.
     """
 
-    def __init__(self, terms: dict[bytes, PostingsList], path: str | None = None):
+    def __init__(self, terms: dict[bytes, PostingsList], path: str | None = None,
+                 dead_seq: int = -1):
         self.terms = terms
         self.path = path
         self.n_postings = sum(len(p) for p in terms.values())
+        # tombstone count at creation (see PagedRun.dead_seq)
+        self.dead_seq = dead_seq
 
     def get(self, termhash: bytes) -> PostingsList | None:
         return self.terms.get(termhash)
@@ -228,6 +231,35 @@ class RWIIndex:
                 bucket.append((int(postings.docids[i]), postings.feats[i]))
             self._ram_count += len(postings)
 
+    def ingest_run(self, terms: dict[bytes, PostingsList]):
+        """Bulk-ingest a prebuilt term->postings mapping as one frozen run,
+        bypassing the per-posting RAM buffer — the fast path for surrogate
+        imports (WARC/dump ingestion) and index-transfer batches, where the
+        postings already arrive in columnar form (reference analog: the
+        surrogate importers feeding storeDocument in bulk)."""
+        with self._lock:
+            clean = {th: sort_dedupe(p.docids, p.feats)
+                     for th, p in terms.items() if len(p)}
+            if not clean:
+                return None
+            run = FrozenRun(clean, dead_seq=len(self._tombstones))
+            path = None
+            if self.data_dir:
+                path = os.path.join(self.data_dir,
+                                    f"run-{self._run_seq:06d}.dat")
+            self._run_seq += 1
+            self._runs.append(run)
+            snapshot = dict(clean)
+        out = run
+        if self.listener is not None:
+            self.listener.on_run_added(run)
+        if path:
+            paged = PagedRun.write(path, snapshot, self.term_cache,
+                                   dead_seq=run.dead_seq)
+            out = self._swap_run(run, paged)
+        track(EClass.WORDCACHE, "ingest", run.n_postings)
+        return out
+
     def needs_flush(self) -> bool:
         return self._ram_count >= self.max_ram_postings
 
@@ -253,7 +285,7 @@ class RWIIndex:
             self._ram_count = 0
             if not terms:  # only emptied buckets: nothing to persist
                 return None
-            run = FrozenRun(terms)
+            run = FrozenRun(terms, dead_seq=len(self._tombstones))
             # snapshot for the outside-lock write: a concurrent remove_term
             # may pop from the live run.terms dict mid-write
             snapshot = dict(terms)
@@ -266,7 +298,8 @@ class RWIIndex:
         if self.listener is not None:
             self.listener.on_run_added(run)
         if path:
-            paged = PagedRun.write(path, snapshot, self.term_cache)
+            paged = PagedRun.write(path, snapshot, self.term_cache,
+                                   dead_seq=run.dead_seq)
             out = self._swap_run(run, paged)
         track(EClass.WORDCACHE, "flush", n)
         return out
@@ -323,7 +356,7 @@ class RWIIndex:
                 m = remove_docids(merge(parts), dead)
                 if len(m):
                     merged[th] = m
-            new_run = FrozenRun(merged)
+            new_run = FrozenRun(merged, dead_seq=len(self._tombstones))
             snapshot = dict(merged)  # outside-lock write vs remove_term race
             save_path = None
             if self.data_dir:
@@ -346,7 +379,8 @@ class RWIIndex:
                 self.listener.on_run_removed(r)
         # paged write outside the lock, then swap the RAM form out
         if save_path:
-            paged = PagedRun.write(save_path, snapshot, self.term_cache)
+            paged = PagedRun.write(save_path, snapshot, self.term_cache,
+                                   dead_seq=new_run.dead_seq)
             self._swap_run(new_run, paged)
         else:
             with self._lock:

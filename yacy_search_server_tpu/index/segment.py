@@ -240,6 +240,9 @@ class Segment:
         return self.rwi.total_postings()
 
     def close(self) -> None:
+        if self.devstore is not None:
+            self.devstore.close()
+            self.devstore = None
         self.rwi.close()
         self.metadata.close()
         self.dense.close()

@@ -65,6 +65,7 @@ class ResultEntry:
     url: str = ""
     title: str = ""
     snippet: str = ""
+    snippet_done: bool = False  # lazily extracted at page render
     host: str = ""
     filetype: str = ""
     language: str = ""
@@ -117,6 +118,8 @@ class SearchEvent:
         # event is created, never again on cache hits/paging (the
         # reference's heuristics are per-search-event)
         self.heuristics_fired = False
+        self._pending: list[tuple[int, int]] = []  # lazily-drained ranked
+        self._drained = 0                          # local entries drained
         self._ranker = CardinalRanker(query.profile, query.lang)
         self._run_local()
 
@@ -179,14 +182,46 @@ class SearchEvent:
         self._fill_results(scores, docids)
 
     def _fill_results(self, scores, docids) -> None:
-        with StageTimer(EClass.SEARCH, "RESULTLIST", len(docids)):
-            for score, docid in zip(scores.tolist(), docids.tolist()):
-                made = self._make_entry(int(docid), int(score))
-                if made is None:
-                    self.local_rwi_evicted += 1
-                    continue
-                entry, meta = made
-                self._insert(entry, meta)
+        """Queue the ranked candidates and materialize lazily: the page
+        drain (results()) joins metadata only for as many entries as the
+        page needs plus a post-ranking cushion — materializing the whole
+        oversampled top-k per query was the serving path's python
+        bottleneck. A cushion beyond the page keeps post-ranking boosts
+        competing across the page boundary.
+
+        Facets accumulate over the FULL ranked candidate set here (cheap
+        columnar reads), not over materialized entries — the reference's
+        facet counts also cover the whole query result, not the page
+        (Solr facet counting)."""
+        self._pending = list(zip(scores.tolist(), docids.tolist()))
+        self._pending.reverse()          # pop() from the end = best-first
+        if self.navigators:
+            meta = self.segment.metadata
+            for docid in docids.tolist():
+                row = meta.row(int(docid))
+                if row is not None:
+                    accumulate(self.navigators, row)
+        self._drain(self.query.offset + self.query.item_count)
+
+    def _drain(self, need: int) -> None:
+        """Materialize pending local candidates until `cushion` of them
+        have been drained (counted independently of the heap, which remote
+        feeders also fill — remote inserts must not starve better local
+        candidates out of materialization)."""
+        cushion = need * 2 + 6
+        with self._lock:
+            if not self._pending:
+                return
+            with StageTimer(EClass.SEARCH, "RESULTLIST"):
+                while self._pending and self._drained < cushion:
+                    score, docid = self._pending.pop()
+                    made = self._make_entry(int(docid), int(score))
+                    if made is None:
+                        self.local_rwi_evicted += 1
+                        continue
+                    self._drained += 1
+                    entry, _meta = made
+                    self._insert(entry)
 
     def _device_local(self, k: int):
         """Eligibility gate + dispatch for the device-resident serving path
@@ -291,10 +326,11 @@ class SearchEvent:
         return mask
 
     def _make_entry(self, docid: int, score: int):
-        """Metadata join + modifier recheck + snippet; returns
-        (ResultEntry, DocumentMetadata) or None when evicted."""
+        """Metadata join + modifier recheck; returns (ResultEntry, row)
+        or None when evicted. Uses the lazy column-backed row — this runs
+        once per oversampled candidate, the serving drain's hot loop."""
         q = self.query
-        m = self.segment.metadata.get(docid)
+        m = self.segment.metadata.row(docid)
         if m is None:
             return None
         url = m.get("sku", "")
@@ -311,19 +347,19 @@ class SearchEvent:
         if q.modifier.keyword:
             if q.modifier.keyword.lower() not in (m.get("keywords") or "").lower():
                 return None
-        text = m.get("text_t", "")
-        snippet = ""
-        if q.snippet_fetch:
-            snippet, _all = extract_snippet(text, q.goal.include_words)
         # quoted phrases must literally appear (QueryGoal phrase recheck)
         if q.goal.phrases:
+            text = m.get("text_t", "")
             tl = text.lower()
             for ph in q.goal.phrases:
                 if ph not in tl and ph not in title.lower():
                     return None
+        # snippet extraction is deferred to page render (results()):
+        # only the ~10 returned entries need one, not the whole
+        # oversampled top-k — the drain loop is the serving hot path
         return ResultEntry(
             docid=docid, urlhash=self.segment.metadata.urlhash_of(docid),
-            score=score, url=url, title=title, snippet=snippet,
+            score=score, url=url, title=title, snippet="",
             host=m.get("host_s", ""), filetype=m.get("url_file_ext_s", ""),
             language=m.get("language_s", ""), size=m.get("size_i", 0),
             wordcount=m.get("wordcount_i", 0),
@@ -332,10 +368,10 @@ class SearchEvent:
 
     # -- fusion (local batch now, remote feeders in M5) ----------------------
 
-    def _insert(self, entry: ResultEntry, meta=None) -> bool:
-        """Dedup + host-diversity + post-ranking + heap insert. `meta` is
-        the already-joined metadata row for local results (None for remote
-        entries, which carry no local row)."""
+    def _insert(self, entry: ResultEntry) -> bool:
+        """Dedup + host-diversity + post-ranking + heap insert. Facet
+        accumulation happens upstream over the whole candidate set
+        (_fill_results), not per inserted entry."""
         q = self.query
         if q.url_filter is not None and entry.url and q.url_filter(entry.url):
             return False
@@ -366,8 +402,6 @@ class SearchEvent:
             score = self._post_ranking(entry)
             entry.score = score
             self.result_heap.put(entry, score)
-            if meta is not None:
-                accumulate(self.navigators, meta)
             return True
 
     def _post_ranking(self, entry: ResultEntry) -> int:
@@ -410,9 +444,10 @@ class SearchEvent:
         offset = q.offset if offset is None else offset
         count = q.item_count if count is None else count
         need = offset + count
+        self._drain(need)
         with self._lock:
             avail = self.result_heap.size_available()
-            if avail < need and self._diverted:
+            if avail < need and self._diverted and not self._pending:
                 # page underfills: merge back diverted same-host entries
                 # (the reference re-admits doubledom-parked results when the
                 # drained stacks run dry, SearchEvent.java:1376-1412)
@@ -427,6 +462,13 @@ class SearchEvent:
             if el is None:
                 break
             got.append(el.payload)
+        if q.snippet_fetch:
+            for e in got:
+                if not e.snippet_done and e.source == "local":
+                    text = self.segment.metadata.text_value(e.docid, "text_t")
+                    e.snippet, _ = extract_snippet(
+                        text, self.query.goal.include_words)
+                    e.snippet_done = True
         return got
 
     def one_result(self, item: int) -> ResultEntry | None:

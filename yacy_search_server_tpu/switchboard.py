@@ -78,6 +78,10 @@ class Switchboard:
                 self.index.enable_device_serving(
                     budget_bytes=self.config.get_int(
                         "index.device.budgetBytes", 2 << 30))
+                if self.config.get_bool("index.device.batching", True):
+                    self.index.devstore.enable_batching(
+                        max_batch=self.config.get_int(
+                            "index.device.batchSize", 16))
             except Exception:  # no usable jax backend: host path serves
                 self.index.devstore = None
                 self.index.rwi.listener = None
