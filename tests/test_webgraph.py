@@ -1,0 +1,225 @@
+"""Webgraph edge store — per-hyperlink index (VERDICT r1 missing #2).
+
+Covers: edge write-through from Segment.store_document, re-index/delete
+retirement, journal persistence, anchor-text extraction, BlockRank over
+real edges (parity vs the host-matrix path), and the linkstructure API
+servlet (reference: search/schema/WebgraphSchema.java:34,
+WebgraphConfiguration.java:141-291, htroot/api/linkstructure.java).
+"""
+
+import types
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Anchor, Document
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.index.webgraph import (
+    REL_NOFOLLOW, WebgraphStore, rel_flags)
+from yacy_search_server_tpu.ops.blockrank import (host_ranks,
+                                                  host_ranks_from_edges)
+from yacy_search_server_tpu.utils.hashes import url2hash
+from yacy_search_server_tpu.webstructure import WebStructureGraph
+
+
+def _doc(url, anchors, title="t"):
+    return Document(url=url, title=title,
+                    text="searchable body text with words", anchors=anchors)
+
+
+def test_rel_flags_coding():
+    # reference WebgraphConfiguration.relEval:291: me=1, nofollow=2
+    assert rel_flags("me") == 1
+    assert rel_flags("nofollow") == 2
+    assert rel_flags("NOFOLLOW sponsored") == REL_NOFOLLOW | 16
+
+
+def test_store_document_writes_edges(tmp_path):
+    seg = Segment(data_dir=str(tmp_path / "seg"))
+    try:
+        seg.store_document(_doc("http://a.test/page.html", [
+            Anchor(url="http://a.test/other.html", text="same host link"),
+            Anchor(url="http://b.test/ext.pdf", text="external link",
+                   rel="nofollow"),
+        ]), crawldepth=2, collection="crawl1")
+        wg = seg.webgraph
+        assert len(wg) == 2
+        edges = wg.edges_from_host("a.test")
+        assert len(edges) == 2
+        by_target = {e["target_host_s"]: e for e in edges}
+        inhost = by_target["a.test"]
+        ext = by_target["b.test"]
+        assert inhost["target_inbound_b"] == 1
+        assert ext["target_inbound_b"] == 0
+        assert ext["target_relflags_i"] == REL_NOFOLLOW
+        assert ext["target_file_ext_s"] == "pdf"
+        assert ext["target_linktext_wordcount_i"] == 2
+        assert ext["source_crawldepth_i"] == 2
+        assert ext["collection_sxt"] == "crawl1"
+        assert inhost["target_order_i"] == 0 and ext["target_order_i"] == 1
+        assert ext["source_id_s"] == url2hash(
+            "http://a.test/page.html").decode()
+    finally:
+        seg.close()
+
+
+def test_reindex_retires_previous_edges(tmp_path):
+    seg = Segment(data_dir=str(tmp_path / "seg"))
+    try:
+        seg.store_document(_doc("http://a.test/", [
+            Anchor(url="http://old.test/x", text="old")]))
+        seg.store_document(_doc("http://a.test/", [
+            Anchor(url="http://new.test/y", text="new")]))
+        wg = seg.webgraph
+        assert len(wg) == 1
+        assert wg.inbound_count(url2hash("http://old.test/x")) == 0
+        assert wg.inbound_count(url2hash("http://new.test/y")) == 1
+    finally:
+        seg.close()
+
+
+def test_remove_document_retires_edges(tmp_path):
+    seg = Segment(data_dir=str(tmp_path / "seg"))
+    try:
+        seg.store_document(_doc("http://a.test/", [
+            Anchor(url="http://b.test/", text="x")]))
+        assert len(seg.webgraph) == 1
+        assert seg.remove_document(url2hash("http://a.test/"))
+        assert len(seg.webgraph) == 0
+    finally:
+        seg.close()
+
+
+def test_journal_replay_across_restart(tmp_path):
+    d = str(tmp_path / "seg")
+    seg = Segment(data_dir=d)
+    seg.store_document(_doc("http://a.test/", [
+        Anchor(url="http://b.test/kept", text="kept link")]))
+    seg.store_document(_doc("http://gone.test/", [
+        Anchor(url="http://b.test/lost", text="lost link")]))
+    seg.remove_document(url2hash("http://gone.test/"))
+    seg.close()
+
+    seg2 = Segment(data_dir=d)
+    try:
+        wg = seg2.webgraph
+        assert len(wg) == 1
+        assert wg.inbound_count(url2hash("http://b.test/kept")) == 1
+        assert wg.inbound_count(url2hash("http://b.test/lost")) == 0
+        assert wg.anchor_texts(url2hash("http://b.test/kept")) == ["kept link"]
+    finally:
+        seg2.close()
+
+
+def test_anchor_texts_skip_nofollow():
+    wg = WebgraphStore()
+    wg.add_document_edges(0, "http://a.test/", [
+        Anchor(url="http://t.test/", text="followed anchor"),
+    ])
+    wg.add_document_edges(1, "http://b.test/", [
+        Anchor(url="http://t.test/", text="paid anchor", rel="nofollow"),
+    ])
+    th = url2hash("http://t.test/")
+    assert wg.anchor_texts(th) == ["followed anchor"]
+    assert set(wg.anchor_texts(th, skip_nofollow=False)) == {
+        "followed anchor", "paid anchor"}
+
+
+def test_compact_preserves_alive_edges(tmp_path):
+    wg = WebgraphStore(str(tmp_path / "wg"))
+    wg.add_document_edges(0, "http://a.test/", [
+        Anchor(url="http://b.test/", text="b")])
+    wg.add_document_edges(1, "http://c.test/", [
+        Anchor(url="http://d.test/", text="d")])
+    wg.remove_source(0)
+    wg.compact()
+    assert len(wg) == 1 and wg.edge_count_total() == 1
+    assert wg.inbound_count(url2hash("http://d.test/")) == 1
+    wg.close()
+    # the rewritten journal replays to the compacted state
+    wg2 = WebgraphStore(str(tmp_path / "wg"))
+    assert len(wg2) == 1
+    assert wg2.inbound_count(url2hash("http://b.test/")) == 0
+    wg2.close()
+
+
+GRAPH = {
+    "http://hub.test/": ["http://a.test/", "http://b.test/",
+                         "http://c.test/"],
+    "http://a.test/": ["http://b.test/"],
+    "http://b.test/": ["http://a.test/", "http://hub.test/"],
+    "http://c.test/": ["http://hub.test/", "http://hub.test/page2"],
+}
+
+
+def test_blockrank_over_real_edges_matches_host_matrix():
+    """host_ranks_from_edges (per-edge store) must agree with host_ranks
+    (host-matrix path) on the same link graph."""
+    wg = WebgraphStore()
+    ws = WebStructureGraph()
+    for i, (src, targets) in enumerate(GRAPH.items()):
+        wg.add_document_edges(i, src, [Anchor(url=t, text="x")
+                                       for t in targets])
+        ws.add_document(src, targets)
+    r_edges = host_ranks_from_edges(wg)
+    r_matrix = host_ranks(ws)
+    assert set(r_edges) == set(r_matrix)
+    for h in r_edges:
+        assert r_edges[h] == pytest.approx(r_matrix[h], abs=1e-5)
+    # normalized ranks: peak exactly 1, everything in (0, 1]
+    assert max(r_edges.values()) == pytest.approx(1.0)
+    assert all(0.0 < v <= 1.0 for v in r_edges.values())
+
+
+def test_linkstructure_servlet():
+    from yacy_search_server_tpu.server.servlets import lookup
+    wg = WebgraphStore()
+    wg.add_document_edges(0, "http://site.test/", [
+        Anchor(url="http://site.test/a.html", text="a"),
+        Anchor(url="http://ext.test/x", text="out")])
+    wg.add_document_edges(1, "http://site.test/a.html", [
+        Anchor(url="http://site.test/deep.html", text="deep")])
+    sb = types.SimpleNamespace(index=types.SimpleNamespace(webgraph=wg))
+    fn = lookup("linkstructure")
+    assert fn is not None
+    from yacy_search_server_tpu.server.objects import ServerObjects
+    prop = fn({}, ServerObjects({"about": "site.test"}), sb)
+    assert int(prop.get("edges")) == 3
+    assert int(prop.get("maxdepth")) == 2
+    rows = {(prop.get(f"edges_{i}_source"), prop.get(f"edges_{i}_target")):
+            prop.get(f"edges_{i}_type") for i in range(3)}
+    assert rows[("/", "/a.html")] == "Inbound"
+    assert rows[("/a.html", "/deep.html")] == "Inbound"
+    assert rows[("/", "http://ext.test/x")] == "Outbound"
+    # depth: / = 0, /a.html = 1, /deep.html = 2
+    for i in range(3):
+        if prop.get(f"edges_{i}_target") == "/deep.html":
+            assert int(prop.get(f"edges_{i}_depthTarget")) == 2
+
+
+def test_linkstructure_root_fallback_without_slash():
+    """When no '/' node exists the BFS root is the shortest SOURCE path,
+    so prefix-hosted sites still get real depths."""
+    from yacy_search_server_tpu.server.objects import ServerObjects
+    from yacy_search_server_tpu.server.servlets import lookup
+    wg = WebgraphStore()
+    wg.add_document_edges(0, "http://p.test/blog/", [
+        Anchor(url="http://p.test/blog/a.html", text="a")])
+    sb = types.SimpleNamespace(index=types.SimpleNamespace(webgraph=wg))
+    prop = lookup("linkstructure")({}, ServerObjects({"about": "p.test"}), sb)
+    assert int(prop.get("edges")) == 1
+    assert int(prop.get("maxdepth")) == 1
+    assert int(prop.get("edges_0_depthSource")) == 0
+    assert int(prop.get("edges_0_depthTarget")) == 1
+
+
+def test_auto_compaction_on_dead_majority(tmp_path):
+    wg = WebgraphStore(str(tmp_path / "wg"))
+    wg.COMPACT_MIN_DEAD = 2    # shrink the production floor for the test
+    for i in range(4):
+        wg.add_document_edges(i, f"http://s{i}.test/", [
+            Anchor(url="http://t.test/", text="x")])
+    wg.remove_source(0)
+    assert wg.edge_count_total() == 4          # below floor: no compaction
+    wg.remove_source(1)                        # 2 dead of 4 -> compacts
+    assert wg.edge_count_total() == 2 and len(wg) == 2
+    wg.close()

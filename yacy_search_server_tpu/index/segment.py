@@ -56,6 +56,12 @@ class Segment:
         self.rwi = RWIIndex(rwi_dir, **kwargs)
         self.citations = CitationIndex()
         self.metadata = MetadataStore(meta_dir)
+        # per-edge hyperlink store (reference: the webgraph Solr core,
+        # search/schema/WebgraphSchema.java:34 — edges written as
+        # subdocuments in Segment.storeDocument:642-659)
+        from .webgraph import WebgraphStore
+        self.webgraph = WebgraphStore(
+            f"{data_dir}/webgraph" if data_dir else None)
         # M7 hybrid rerank: doc embeddings aligned to docids (new
         # capability beyond the reference; ops/dense.py)
         from ..ops.dense import HashingEncoder
@@ -152,6 +158,7 @@ class Segment:
                     # refreshed below)
                     for target in self.citations.remove_citing_doc(old_docid):
                         self._refresh_references(target)
+                    self.webgraph.remove_source(old_docid)
 
                 # citations: this doc cites its anchors
                 for a in doc.anchors:
@@ -161,6 +168,12 @@ class Segment:
                         continue
                     self.citations.add(target, docid, urlhash)
                     self._refresh_references(target)
+                # webgraph: one edge row per anchor with link text/rel
+                # (Segment.java:642-659 webgraph putEdges)
+                self.webgraph.add_document_edges(
+                    docid, doc.url, doc.anchors, crawldepth=crawldepth,
+                    collection=collection,
+                    load_date_days=meta.get("load_date_days_i", 0))
 
                 # RWI block append; the catchall term gets the neutral
                 # doc-level row (not any word's flags/positions)
@@ -199,6 +212,7 @@ class Segment:
             self.rwi.delete_doc(docid)
             for target in self.citations.remove_citing_doc(docid):
                 self._refresh_references(target)
+            self.webgraph.remove_source(docid)
             return True
 
     # -- read path -----------------------------------------------------------
@@ -245,6 +259,7 @@ class Segment:
             self.devstore = None
         self.rwi.close()
         self.metadata.close()
+        self.webgraph.close()
         self.dense.close()
 
 
@@ -308,8 +323,5 @@ def _host_of(url: str) -> str:
 
 
 def _ext_of(url: str) -> str:
-    from ..utils.hashes import _split
-    path = _split(url)[3]
-    if "." in path.rsplit("/", 1)[-1]:
-        return path.rsplit(".", 1)[-1].lower()[:8]
-    return ""
+    from ..utils.hashes import url_file_ext
+    return url_file_ext(url)

@@ -87,6 +87,31 @@ def host_ranks(web_structure, damping: float = DAMPING) -> dict[str, float]:
     return {h: float(r[idx[h]]) / peak for h in hosts}
 
 
+def host_ranks_from_edges(webgraph, damping: float = DAMPING) -> dict[str, float]:
+    """host -> rank from the per-edge webgraph store (index/webgraph.py) —
+    the real-edge path the reference feeds from exported webgraph indexes
+    (BlockRank.java:50 loads webgraph dumps; here the edge store IS the
+    graph, no export round-trip). Cross-host edges aggregate into the same
+    column-stochastic form as host_ranks(); in-host edges are excluded,
+    matching the host-matrix semantics."""
+    hosts, srcs, dsts, counts = webgraph.host_edge_arrays()
+    n = len(hosts)
+    if n == 0:
+        return {}
+    if len(srcs) == 0:
+        return {h: 1.0 for h in hosts}
+    # per-source out-degree normalization (column-stochastic transition)
+    out_total = np.zeros(n, dtype=np.float32)
+    np.add.at(out_total, srcs, counts)
+    weights = counts / out_total[srcs]
+    dangling = out_total == 0.0
+    r = np.asarray(_power_iterate_sparse(
+        jnp.asarray(srcs), jnp.asarray(dsts), jnp.asarray(weights),
+        jnp.asarray(dangling), jnp.float32(damping), n))
+    peak = float(r.max()) or 1.0
+    return {h: float(r[i]) / peak for i, h in enumerate(hosts)}
+
+
 def postprocess_segment(segment, web_structure, damping: float = DAMPING,
                         ranks: dict[str, float] | None = None) -> int:
     """Write cr_host_norm_d for every indexed doc from its host's rank

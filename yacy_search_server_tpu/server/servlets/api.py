@@ -198,3 +198,67 @@ def respond_pageinfo(header: dict, post: ServerObjects, sb) -> ServerObjects:
     except Exception as e:
         prop.put("error", escape_json(str(e)))
     return prop
+
+
+@servlet("linkstructure")
+def respond_linkstructure(header: dict, post: ServerObjects,
+                          sb) -> ServerObjects:
+    """Hyperlink structure of one host from the per-edge webgraph store
+    (reference: htroot/api/linkstructure.java — edges with source/target
+    paths, Inbound/Outbound type, and per-node link depth from the host
+    root, computed there by HyperlinkGraph.findLinkDepth)."""
+    prop = ServerObjects()
+    about = post.get("about", "").strip()
+    prop.put("edges", 0)
+    prop.put("maxdepth", 0)
+    if not about:
+        return prop
+    host = about
+    if "://" in about:
+        from ...utils.hashes import safe_host
+        host = safe_host(about)
+    maxnodes = min(post.get_int("maxnodes", 10000), 10000)
+    wg = sb.index.webgraph
+    inhost, outbound = wg.host_link_graph(host)
+    edges = (inhost + outbound)[:maxnodes]
+
+    # link depth per in-host path: BFS from the host root ("/" when linked,
+    # else the shortest source path — HyperlinkGraph's root choice)
+    adj: dict[str, list[str]] = {}
+    nodes = set()
+    for e in inhost:
+        adj.setdefault(e["source_path_s"], []).append(e["target_path_s"])
+        nodes.add(e["source_path_s"])
+        nodes.add(e["target_path_s"])
+    depth: dict[str, int] = {}
+    if nodes:
+        # root = "/" when linked; else the shortest SOURCE path (a node
+        # with out-edges — a leaf target can never seed the BFS), with a
+        # lexicographic tie-break for deterministic depths
+        root = "/" if "/" in nodes else min(sorted(adj), key=len)
+        frontier = [root]
+        depth[root] = 0
+        while frontier:
+            nxt = []
+            for p in frontier:
+                for q in adj.get(p, ()):
+                    if q not in depth:
+                        depth[q] = depth[p] + 1
+                        nxt.append(q)
+            frontier = nxt
+    maxdepth = max(depth.values(), default=0)
+
+    prop.put("edges", len(edges))
+    prop.put("maxdepth", maxdepth)
+    for i, e in enumerate(edges):
+        pre = f"edges_{i}_"
+        outb = not e["target_inbound_b"]
+        prop.put(pre + "source", escape_json(e["source_path_s"]))
+        prop.put(pre + "target", escape_json(
+            e["target_sku_s"] if outb else e["target_path_s"]))
+        prop.put(pre + "type", "Outbound" if outb else "Inbound")
+        prop.put(pre + "depthSource", depth.get(e["source_path_s"], -1))
+        prop.put(pre + "depthTarget", depth.get(e["target_path_s"], -1)
+                 if not outb else -1)
+        prop.put(pre + "eol", 1 if i < len(edges) - 1 else 0)
+    return prop

@@ -106,6 +106,15 @@ def safe_host(url: str) -> str:
     return _split(url)[1]
 
 
+def url_file_ext(url: str) -> str:
+    """File extension of the url path, lowercased, capped at 8 chars;
+    '' when the file name has none (CollectionSchema.url_file_ext_s /
+    WebgraphSchema.target_file_ext_s normalization)."""
+    path = _split(url)[3]
+    name = path.rsplit("/", 1)[-1]
+    return name.rsplit(".", 1)[-1].lower()[:8] if "." in name else ""
+
+
 def normalform(url: str) -> str:
     scheme, host, port, path, query = _split(url)
     netloc = host if port == default_port(scheme) else f"{host}:{port}"
