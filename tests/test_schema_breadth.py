@@ -1,0 +1,335 @@
+"""Schema breadth — the ~90-field metadata store (VERDICT r1 missing #3).
+
+Field-group round-trip tests: index a document through the real parser +
+Segment, then read every new field group back through the metadata store
+and the select servlet (reference checklist:
+search/schema/CollectionSchema.java:34+ — link arrays, heading zones,
+robots/canonical flags, dates_in_content, signatures, url/host
+decomposition, uniqueness postprocessing).
+"""
+
+import types
+
+import pytest
+
+from yacy_search_server_tpu.document.datedetection import (dates_as_iso,
+                                                           dates_in_content)
+from yacy_search_server_tpu.document.document import (ROBOTS_NOARCHIVE,
+                                                      ROBOTS_NOFOLLOW,
+                                                      ROBOTS_NOINDEX,
+                                                      Anchor, Document, Image)
+from yacy_search_server_tpu.document.parser.htmlparser import parse_html
+from yacy_search_server_tpu.document.signature import (exact_signature,
+                                                       fuzzy_signature)
+from yacy_search_server_tpu.index.metadata import (DOUBLE_FIELDS, INT_FIELDS,
+                                                   TEXT_FIELDS, split_multi)
+from yacy_search_server_tpu.index.postprocess import postprocess_uniqueness
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.utils.hashes import url2hash
+
+
+def test_schema_field_count_reaches_80():
+    total = len(TEXT_FIELDS) + len(INT_FIELDS) + len(DOUBLE_FIELDS)
+    assert total >= 80, f"schema has {total} fields"
+
+
+# -- date detection ------------------------------------------------------
+
+
+def test_dates_in_content_formats():
+    text = ("Released 2023-05-17, updated 17.06.2023, reviewed 7/4/2023, "
+            "announced March 5, 2024 and 5 March 2024, plus junk 99.99.2099")
+    dates = dates_as_iso(dates_in_content(text))
+    assert "2023-05-17" in dates
+    assert "2023-06-17" in dates
+    assert "2023-07-04" in dates       # US mm/dd
+    assert "2024-03-05" in dates
+    assert len([d for d in dates if d == "2024-03-05"]) == 1  # dedup
+
+
+def test_dates_reject_invalid():
+    assert dates_in_content("on 2023-13-45 and 31.02.2020 nothing") == []
+
+
+# -- signatures ----------------------------------------------------------
+
+
+def test_exact_signature_normalizes_whitespace_and_case():
+    assert exact_signature("Hello  World\n") == exact_signature("hello world")
+    assert exact_signature("hello world") != exact_signature("hello mars")
+
+
+def test_fuzzy_signature_tolerates_reordering():
+    a = "alpha beta gamma delta epsilon zeta " * 10
+    b = "beta alpha gamma delta zeta epsilon " * 10
+    assert fuzzy_signature(a) == fuzzy_signature(b)
+    assert fuzzy_signature(a) != fuzzy_signature("totally different words here")
+
+
+# -- html parser additions ----------------------------------------------
+
+HTML = b"""<html lang="en"><head><title>Zones</title>
+<meta name="robots" content="noarchive">
+<meta name="generator" content="acme-cms 1.0">
+<meta property="og:site_name" content="Acme Site">
+<link rel="canonical" href="http://z.test/page">
+<link rel="icon" href="/favicon.ico">
+</head><body>
+<h1>Top Heading</h1><h2>Sub One</h2><h2>Sub Two</h2><h4>Deep</h4>
+<p>Published 2024-01-15. some body text</p>
+<a href="/in.html">internal anchor</a>
+<a href="http://other.test/x" rel="nofollow">paid anchor</a>
+<img src="/pic.png" alt="a picture">
+<img src="/nopic.png">
+</body></html>"""
+
+
+@pytest.fixture(scope="module")
+def parsed():
+    return parse_html("http://z.test/page", HTML)[0]
+
+
+def test_parser_headings_per_level(parsed):
+    assert parsed.headings[1] == ["Top Heading"]
+    assert parsed.headings[2] == ["Sub One", "Sub Two"]
+    assert parsed.headings[4] == ["Deep"]
+    assert 3 not in parsed.headings
+
+
+def test_parser_meta_additions(parsed):
+    assert parsed.canonical == "http://z.test/page"
+    assert parsed.robots_flags == ROBOTS_NOARCHIVE
+    assert parsed.favicon == "http://z.test/favicon.ico"
+    assert parsed.generator == "acme-cms 1.0"
+    assert parsed.publisher == "Acme Site"
+
+
+def test_parser_robots_bitfield():
+    html = (b"<html><head><meta name='robots' "
+            b"content='noindex, nofollow'></head><body>x</body></html>")
+    doc = parse_html("http://r.test/", html)[0]
+    assert doc.robots_flags == ROBOTS_NOINDEX | ROBOTS_NOFOLLOW
+
+
+# -- segment round-trip per field group ---------------------------------
+
+
+@pytest.fixture(scope="module")
+def indexed(tmp_path_factory):
+    seg = Segment(data_dir=str(tmp_path_factory.mktemp("seg") / "d"))
+    doc = parse_html("http://z.test/page", HTML)[0]
+    docid = seg.store_document(doc, crawldepth=1, collection="grp",
+                               referrer_urlhash=url2hash("http://ref.test/"),
+                               responsetime_ms=123, httpstatus=200)
+    yield seg, seg.metadata.row(docid)
+    seg.close()
+
+
+def test_roundtrip_link_arrays(indexed):
+    _seg, row = indexed
+    assert split_multi(row.get("inboundlinks_urlstub_sxt")) == [
+        "z.test/in.html"]
+    assert split_multi(row.get("outboundlinks_urlstub_sxt")) == [
+        "other.test/x"]
+    assert row.get("inboundlinks_anchortext_txt") == "internal anchor"
+    assert row.get("outboundlinks_anchortext_txt") == "paid anchor"
+    assert row.get("inboundlinkscount_i") == 1
+    assert row.get("outboundlinkscount_i") == 1
+    assert row.get("outboundlinksnofollowcount_i") == 1
+    assert row.get("linksnofollowcount_i") == 1
+
+
+def test_roundtrip_heading_zones(indexed):
+    _seg, row = indexed
+    assert row.get("h1_txt") == "Top Heading"
+    assert split_multi(row.get("h2_txt")) == ["Sub One", "Sub Two"]
+    assert row.get("h2_i") == 2
+    assert row.get("h3_i") == 0
+    # htags bitmask: h1 (bit0) + h2 (bit1) + h4 (bit3)
+    assert row.get("htags_i") == 0b1011
+
+
+def test_roundtrip_robots_canonical(indexed):
+    _seg, row = indexed
+    assert row.get("robots_i") == ROBOTS_NOARCHIVE
+    assert row.get("canonical_s") == "http://z.test/page"
+    assert row.get("canonical_equal_sku_b") == 1
+
+
+def test_roundtrip_dates(indexed):
+    _seg, row = indexed
+    assert split_multi(row.get("dates_in_content_dts")) == ["2024-01-15"]
+    assert row.get("dates_in_content_count_i") == 1
+
+
+def test_roundtrip_images_media(indexed):
+    _seg, row = indexed
+    assert split_multi(row.get("images_urlstub_sxt")) == [
+        "z.test/pic.png", "z.test/nopic.png"]
+    assert split_multi(row.get("images_alt_sxt")) == ["a picture"]
+    assert row.get("images_withalt_i") == 1
+    assert split_multi(row.get("icons_urlstub_sxt")) == ["z.test/favicon.ico"]
+
+
+def test_roundtrip_url_host_decomposition(indexed):
+    _seg, row = indexed
+    assert row.get("url_protocol_s") == "http"
+    assert row.get("url_file_name_s") == "page"
+    assert row.get("url_paths_count_i") == 0
+    assert row.get("url_chars_i") == len("http://z.test/page")
+    assert row.get("host_organization_s") == "z"
+    assert row.get("host_subdomain_s") == ""
+
+
+def test_roundtrip_transport_and_shape(indexed):
+    _seg, row = indexed
+    assert row.get("referrer_id_s") == url2hash("http://ref.test/").decode()
+    assert row.get("responsetime_i") == 123
+    assert row.get("content_type") == "text/html"
+    assert row.get("charset_s")
+    assert row.get("metagenerator_t") == "acme-cms 1.0"
+    assert row.get("publisher_t") == "Acme Site"
+    assert row.get("title_count_i") == 1
+    assert row.get("title_words_val") == 1      # "Zones"
+
+
+def test_roundtrip_signatures_defaults(indexed):
+    _seg, row = indexed
+    assert row.get("exact_signature_l") > 0
+    assert row.get("fuzzy_signature_l") > 0
+    assert row.get("exact_signature_unique_b") == 1
+
+
+# -- uniqueness postprocessing ------------------------------------------
+
+
+def _plain(url, title, text, host_suffix=""):
+    return Document(url=url, title=title, text=text)
+
+
+def test_postprocess_uniqueness(tmp_path):
+    seg = Segment(data_dir=str(tmp_path / "u"))
+    try:
+        seg.store_document(_plain("http://h.test/a", "Same Title",
+                                  "identical body of text"))
+        seg.store_document(_plain("http://h.test/b", "Same Title",
+                                  "identical body of text"))
+        seg.store_document(_plain("http://other.test/c", "Same Title",
+                                  "a completely different text body"))
+        changed = postprocess_uniqueness(seg)
+        assert changed >= 2
+        m = seg.metadata
+        a = m.row(m.docid(url2hash("http://h.test/a")))
+        b = m.row(m.docid(url2hash("http://h.test/b")))
+        c = m.row(m.docid(url2hash("http://other.test/c")))
+        # same host + same title -> title not unique
+        assert a.get("title_unique_b") == 0 and b.get("title_unique_b") == 0
+        # same title on ANOTHER host stays unique
+        assert c.get("title_unique_b") == 1
+        # identical text -> exact signature duplicated globally
+        assert a.get("exact_signature_unique_b") == 0
+        assert a.get("exact_signature_copycount_i") == 1
+        assert c.get("exact_signature_unique_b") == 1
+    finally:
+        seg.close()
+
+
+# -- citations split + navigators ---------------------------------------
+
+
+def test_references_internal_external(tmp_path):
+    seg = Segment(data_dir=str(tmp_path / "r"))
+    try:
+        target = "http://t.test/page"
+        seg.store_document(_plain(target, "Target", "the target body"))
+        seg.store_document(Document(
+            url="http://t.test/linker", title="Internal", text="links",
+            anchors=[Anchor(url=target)]))
+        seg.store_document(Document(
+            url="http://elsewhere.test/", title="External", text="links",
+            anchors=[Anchor(url=target)]))
+        row = seg.metadata.row(seg.metadata.docid(url2hash(target)))
+        assert row.get("references_i") == 2
+        assert row.get("references_internal_i") == 1
+        assert row.get("references_external_i") == 1
+    finally:
+        seg.close()
+
+
+def test_dates_navigator():
+    from yacy_search_server_tpu.search.navigator import (accumulate,
+                                                         make_navigators)
+    navs = make_navigators(("dates",))
+    meta = types.SimpleNamespace(
+        get=lambda k, d=None: "2024-01-15|2024-02-20"
+        if k == "dates_in_content_dts" else d)
+    accumulate(navs, meta)
+    assert dict(navs["dates"].top(5)) == {"2024-01-15": 1, "2024-02-20": 1}
+
+
+# -- review-fix regressions ---------------------------------------------
+
+
+def test_canonical_pointing_elsewhere_is_not_equal(tmp_path):
+    html = (b"<html><head><title>Dup</title>"
+            b"<link rel='canonical' href='http://c.test/main'></head>"
+            b"<body>duplicate view of main</body></html>")
+    doc = parse_html("http://c.test/dup?view=1", html)[0]
+    assert doc.fetched_url == "http://c.test/dup?view=1"
+    seg = Segment(data_dir=str(tmp_path / "c"))
+    try:
+        docid = seg.store_document(doc)
+        row = seg.metadata.row(docid)
+        assert row.get("canonical_s") == "http://c.test/main"
+        assert row.get("canonical_equal_sku_b") == 0
+    finally:
+        seg.close()
+
+
+def test_uniqueness_skips_sentinel_signatures(tmp_path):
+    seg = Segment(data_dir=str(tmp_path / "s"))
+    try:
+        # two empty-text docs (e.g. noindex) share the empty signature but
+        # must NOT cluster as duplicates
+        seg.store_document(_plain("http://e.test/a", "A", ""))
+        seg.store_document(_plain("http://e.test/b", "B", ""))
+        postprocess_uniqueness(seg)
+        m = seg.metadata
+        row = m.row(m.docid(url2hash("http://e.test/a")))
+        assert row.get("exact_signature_unique_b") == 1
+        assert row.get("exact_signature_copycount_i") == 0
+    finally:
+        seg.close()
+
+
+def test_merge_folds_headings():
+    a = Document(url="http://m.test/", headings={1: ["Parent"]})
+    b = Document(url="http://m.test/sub", headings={1: ["Child"], 2: ["S"]})
+    a.merge(b)
+    assert a.headings == {1: ["Parent", "Child"], 2: ["S"]}
+
+
+def test_malformed_source_url_does_not_crash_edges():
+    from yacy_search_server_tpu.index.webgraph import WebgraphStore
+    wg = WebgraphStore()
+    # unbalanced IPv6 bracket: raw urlsplit raises ValueError on this
+    wg.add_document_edges(0, "http://[::1/page", [
+        Anchor(url="http://ok.test/x", text="t")])
+    wg.add_document_edges(1, "http://fine.test/", [
+        Anchor(url="http://[::1/broken", text="t")])
+
+
+def test_url_parameter_count_keeps_blank_values(tmp_path):
+    seg = Segment(data_dir=str(tmp_path / "q"))
+    try:
+        docid = seg.store_document(
+            _plain("http://q.test/p?download&v=", "T", "body"))
+        assert seg.metadata.row(docid).get("url_parameter_i") == 2
+    finally:
+        seg.close()
+
+
+def test_dates_cap_bounds_all_scanners():
+    text = " ".join(f"2020-{m:02d}-{d:02d}" for m in range(1, 13)
+                    for d in range(1, 29))
+    assert len(dates_in_content(text, max_dates=10)) == 10

@@ -1,0 +1,105 @@
+"""Date detection — find calendar dates mentioned in document text.
+
+Capability equivalent of the reference's date extraction (reference:
+source/net/yacy/document/DateDetection.java — scans content for absolute
+dates which fill the dates_in_content_dts / dates_in_content_count_i
+schema fields and drive the /date sort and date facets). The reference
+builds per-language linear scanners; here a small set of anchored regexes
+covers the load-bearing formats (ISO, dotted european, slashed US, and
+written month names in english/german/french), normalized to days since
+epoch so the ranking kernel can consume them as an int column.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+
+_MONTHS = {
+    # english
+    "january": 1, "february": 2, "march": 3, "april": 4, "may": 5,
+    "june": 6, "july": 7, "august": 8, "september": 9, "october": 10,
+    "november": 11, "december": 12,
+    "jan": 1, "feb": 2, "mar": 3, "apr": 4, "jun": 6, "jul": 7, "aug": 8,
+    "sep": 9, "sept": 9, "oct": 10, "nov": 11, "dec": 12,
+    # german
+    "januar": 1, "februar": 2, "märz": 3, "maerz": 3, "mai": 5, "juni": 6,
+    "juli": 7, "oktober": 10, "dezember": 12,
+    # french
+    "janvier": 1, "février": 2, "fevrier": 2, "mars": 3, "avril": 4,
+    "juin": 6, "juillet": 7, "août": 8, "aout": 8, "septembre": 9,
+    "octobre": 10, "novembre": 11, "décembre": 12, "decembre": 12,
+}
+_MONTH_RE = "|".join(sorted(_MONTHS, key=len, reverse=True))
+
+# yyyy-mm-dd (ISO)
+_ISO = re.compile(r"\b(\d{4})-(\d{2})-(\d{2})\b")
+# dd.mm.yyyy (european dotted)
+_DOTTED = re.compile(r"\b(\d{1,2})\.(\d{1,2})\.(\d{4})\b")
+# mm/dd/yyyy (US slashed)
+_SLASHED = re.compile(r"\b(\d{1,2})/(\d{1,2})/(\d{4})\b")
+# "March 5, 2024" / "March 5 2024"
+_MDY = re.compile(rf"\b({_MONTH_RE})\.?\s+(\d{{1,2}})(?:st|nd|rd|th)?,?\s+(\d{{4}})\b",
+                  re.IGNORECASE)
+# "5 March 2024" / "5. März 2024"
+_DMY = re.compile(rf"\b(\d{{1,2}})(?:st|nd|rd|th)?\.?\s+({_MONTH_RE})\.?\s+(\d{{4}})\b",
+                  re.IGNORECASE)
+
+_EPOCH = _dt.date(1970, 1, 1)
+# plausibility window (DateDetection restricts to recent years too:
+# its kernel covers the current year +/- a few)
+_MIN_YEAR, _MAX_YEAR = 1970, 2100
+
+
+def _mk(year: int, month: int, day: int) -> _dt.date | None:
+    if not (_MIN_YEAR <= year <= _MAX_YEAR):
+        return None
+    try:
+        return _dt.date(year, month, day)
+    except ValueError:
+        return None
+
+
+def _numeric(m) -> tuple[int, int, int]:
+    return int(m.group(1)), int(m.group(2)), int(m.group(3))
+
+
+# (pattern, match -> (year, month, day) or None)
+_SCANNERS = (
+    (_ISO, lambda m: _numeric(m)),
+    (_DOTTED, lambda m: (int(m.group(3)), int(m.group(2)), int(m.group(1)))),
+    (_SLASHED, lambda m: (int(m.group(3)), int(m.group(1)), int(m.group(2)))),
+    (_MDY, lambda m: (int(m.group(3)), _MONTHS.get(m.group(1).lower(), 0),
+                      int(m.group(2)))),
+    (_DMY, lambda m: (int(m.group(3)), _MONTHS.get(m.group(2).lower(), 0),
+                      int(m.group(1)))),
+)
+
+
+def dates_in_content(text: str, max_dates: int = 100) -> list[_dt.date]:
+    """Distinct dates found in `text`, in per-format first-appearance
+    order, capped at `max_dates` (every scanner stops at the cap — a
+    date-dump page cannot make the indexing path accumulate unbounded
+    matches)."""
+    found: dict[_dt.date, None] = {}
+    scan = text[:200_000]    # bound the regex work on pathological docs
+    for pattern, extract in _SCANNERS:
+        if len(found) >= max_dates:
+            break
+        for m in pattern.finditer(scan):
+            ymd = extract(m)
+            if ymd and ymd[1]:
+                d = _mk(*ymd)
+                if d:
+                    found.setdefault(d)
+                    if len(found) >= max_dates:
+                        break
+    return list(found)[:max_dates]
+
+
+def dates_as_days(dates: list[_dt.date]) -> list[int]:
+    return [(d - _EPOCH).days for d in dates]
+
+
+def dates_as_iso(dates: list[_dt.date]) -> list[str]:
+    return [d.isoformat() for d in dates]

@@ -57,6 +57,14 @@ class Document:
     lon: float = 0.0
     publish_date_days: int = 0  # days since epoch; 0 = unknown
     doctype: int = 0            # document/parsers/__init__.py doctype codes
+    # zone texts per heading level 1..6 (CollectionSchema h1_txt..h6_txt;
+    # `sections` above stays the flat all-levels list)
+    headings: dict = field(default_factory=dict)
+    canonical: str = ""         # <link rel=canonical> target
+    robots_flags: int = 0       # meta-robots bitfield (ROBOTS_* below)
+    favicon: str = ""
+    generator: str = ""         # <meta name=generator> (metagenerator_t)
+    publisher: str = ""         # dc:publisher / og:site_name
 
     def hyperlinks(self) -> list[Anchor]:
         return self.anchors
@@ -70,5 +78,16 @@ class Document:
         self.anchors.extend(other.anchors)
         self.images.extend(other.images)
         self.sections.extend(other.sections)
+        for level, texts in (other.headings or {}).items():
+            self.headings.setdefault(level, []).extend(texts)
         if not self.title:
             self.title = other.title
+
+
+# meta-robots bitfield carried in Document.robots_flags and the robots_i
+# schema column (reference: ContentScraper's noindex/nofollow evaluation
+# feeding CollectionSchema.robots_i)
+ROBOTS_NOINDEX = 1
+ROBOTS_NOFOLLOW = 2
+ROBOTS_NOARCHIVE = 4
+ROBOTS_NOSNIPPET = 8

@@ -36,6 +36,7 @@ class ContentScraper(HTMLParser):
         self.base_url = base_url
         self.title_parts: list[str] = []
         self.sections: list[str] = []
+        self.headings: dict[int, list[str]] = {}   # level 1..6 -> texts
         self.text_parts: list[str] = []
         self.anchors: list[Anchor] = []
         self.images: list[Image] = []
@@ -67,7 +68,7 @@ class ContentScraper(HTMLParser):
         elif tag == "title":
             self._in_title = True
         elif tag in _SECTION_TAGS:
-            self._section_stack.append([])
+            self._section_stack.append((int(tag[1]), []))
         elif tag == "meta":
             name = (a.get("name") or a.get("property") or "").lower()
             if name and a.get("content") is not None:
@@ -122,9 +123,11 @@ class ContentScraper(HTMLParser):
         if tag == "title":
             self._in_title = False
         elif tag in _SECTION_TAGS and self._section_stack:
-            text = _WS_RE.sub(" ", " ".join(self._section_stack.pop())).strip()
+            level, parts = self._section_stack.pop()
+            text = _WS_RE.sub(" ", " ".join(parts)).strip()
             if text:
                 self.sections.append(text)
+                self.headings.setdefault(level, []).append(text)
         elif tag == "a" and self._cur_anchor is not None:
             self._cur_anchor.text = _WS_RE.sub(
                 " ", " ".join(self._cur_anchor_text)).strip()[:500]
@@ -139,7 +142,7 @@ class ContentScraper(HTMLParser):
             self.title_parts.append(data)
             return
         if self._section_stack:
-            self._section_stack[-1].append(data)
+            self._section_stack[-1][1].append(data)
         if self._cur_anchor is not None:
             self._cur_anchor_text.append(data)
         self.text_parts.append(data)
@@ -178,6 +181,12 @@ def parse_html(url: str, content: bytes,
     robots = scraper.meta.get("robots", "").lower()
     noindex = "noindex" in robots
     nofollow = "nofollow" in robots
+    from ..document import (ROBOTS_NOARCHIVE, ROBOTS_NOFOLLOW,
+                            ROBOTS_NOINDEX, ROBOTS_NOSNIPPET)
+    robots_flags = ((ROBOTS_NOINDEX if noindex else 0)
+                    | (ROBOTS_NOFOLLOW if nofollow else 0)
+                    | (ROBOTS_NOARCHIVE if "noarchive" in robots else 0)
+                    | (ROBOTS_NOSNIPPET if "nosnippet" in robots else 0))
 
     audio, video, apps = [], [], []
     for link in scraper.embeds:
@@ -221,4 +230,14 @@ def parse_html(url: str, content: bytes,
     doc.video_links = video
     doc.app_links = apps
     doc.noindex = noindex
+    doc.headings = scraper.headings
+    doc.canonical = scraper.canonical
+    # doc.url above was rewritten to the canonical; keep the URL the page
+    # was actually fetched under so canonical_equal_sku_b can compare them
+    doc.fetched_url = url
+    doc.robots_flags = robots_flags
+    doc.favicon = scraper.favicon
+    doc.generator = scraper.meta.get("generator", "")
+    doc.publisher = scraper.meta.get("dc.publisher",
+                                     scraper.meta.get("og:site_name", ""))
     return [doc]

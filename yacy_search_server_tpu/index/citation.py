@@ -36,6 +36,27 @@ class CitationIndex:
         with self._lock:
             return len(self._cites.get(target_urlhash, ()))
 
+    def reference_counts(self, target_urlhash: bytes
+                         ) -> tuple[int, int, int, int]:
+        """(total, internal, external, exthosts) in ONE scan under one lock
+        — the write path refreshes all four columns per anchor, so the
+        split accessors below delegate here."""
+        own = hosthash(target_urlhash)
+        with self._lock:
+            hosts = list(self._cites.get(target_urlhash, {}).values())
+        internal = sum(1 for h in hosts if h == own)
+        ext_hosts = set(hosts)
+        ext_hosts.discard(own)
+        return (len(hosts), internal, len(hosts) - internal, len(ext_hosts))
+
+    def references_internal(self, target_urlhash: bytes) -> int:
+        """Citations from the target's own host (references_internal_i)."""
+        return self.reference_counts(target_urlhash)[1]
+
+    def references_external(self, target_urlhash: bytes) -> int:
+        """Citations from other hosts (references_external_i)."""
+        return self.reference_counts(target_urlhash)[2]
+
     def references_exthosts(self, target_urlhash: bytes) -> int:
         """Distinct citing hosts other than the target's own host."""
         own = hosthash(target_urlhash)

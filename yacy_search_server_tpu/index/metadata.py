@@ -29,6 +29,11 @@ from ..utils.hashes import dom_length_normalized, hosthash, url_comps
 # Load-bearing schema fields (name -> default), subset of CollectionSchema.
 # Text-like fields live in python lists; numeric ranking signals get numpy
 # column views for device upload.
+# Multi-valued (_sxt/_txt list) fields are stored "|"-joined ("|" cannot
+# appear unescaped in a URL and the reference's text fields never carry
+# it); split with split_multi() below.
+MULTI_SEP = "|"
+
 TEXT_FIELDS = (
     "sku",            # url (CollectionSchema.sku)
     "title",
@@ -41,6 +46,32 @@ TEXT_FIELDS = (
     "url_file_ext_s",
     "collection_sxt",  # crawl collections (comma-joined)
     "vocabulary_sxt",  # autotagging facets "voc:tag,..." (vocabulary_* fields)
+    # -- content/transport identity (CollectionSchema content_type etc.)
+    "content_type",
+    "charset_s",
+    "canonical_s",
+    "referrer_id_s",   # urlhash of the page that linked here
+    "publisher_t",
+    "metagenerator_t",
+    # -- link arrays (CollectionSchema *_sxt / anchortext fields)
+    "inboundlinks_urlstub_sxt",
+    "outboundlinks_urlstub_sxt",
+    "inboundlinks_anchortext_txt",
+    "outboundlinks_anchortext_txt",
+    "images_urlstub_sxt",
+    "images_alt_sxt",
+    "icons_urlstub_sxt",
+    # -- heading zone texts (h1_txt..h6_txt)
+    "h1_txt", "h2_txt", "h3_txt", "h4_txt", "h5_txt", "h6_txt",
+    # -- dates found in the content (ISO strings; dates_in_content_dts)
+    "dates_in_content_dts",
+    # -- url decomposition (url_* fields)
+    "url_protocol_s",
+    "url_file_name_s",
+    "url_paths_sxt",
+    # -- host decomposition (host_* fields)
+    "host_organization_s",
+    "host_subdomain_s",
 )
 INT_FIELDS = (
     "size_i",          # byte size
@@ -61,12 +92,60 @@ INT_FIELDS = (
     "domlength_i",         # derived from url-hash flag byte
     "urllength_i",
     "urlcomps_i",
+    # -- media link counts
+    "audiolinkscount_i",
+    "videolinkscount_i",
+    "applinkscount_i",
+    # -- nofollow-split link counts
+    "linksnofollowcount_i",
+    "inboundlinksnofollowcount_i",
+    "outboundlinksnofollowcount_i",
+    # -- robots/meta flags and heading census
+    "robots_i",            # document.ROBOTS_* bitfield
+    "htags_i",             # bitmask: bit(l-1) set when an h<l> exists
+    "h1_i", "h2_i", "h3_i", "h4_i", "h5_i", "h6_i",   # per-level counts
+    "images_withalt_i",
+    # -- dates in content
+    "dates_in_content_count_i",
+    # -- title/description shape (counts the reference keeps as *_val)
+    "title_count_i",
+    "title_words_val",
+    "description_count_i",
+    "description_words_val",
+    # -- url decomposition counts
+    "url_paths_count_i",
+    "url_parameter_i",
+    "url_chars_i",
+    # -- citation split (references_i above is the total)
+    "references_internal_i",
+    "references_external_i",
+    # -- canonical/duplicate signals
+    "canonical_equal_sku_b",
+    "exact_signature_l",
+    "fuzzy_signature_l",
+    "exact_signature_copycount_i",
+    "fuzzy_signature_copycount_i",
+    "title_unique_b",
+    "description_unique_b",
+    "exact_signature_unique_b",
+    "fuzzy_signature_unique_b",
+    # -- transport
+    "responsetime_i",
 )
 DOUBLE_FIELDS = (
     "lat_d",
     "lon_d",
     "cr_host_norm_d",      # citation rank (postprocessing)
 )
+
+
+def join_multi(values) -> str:
+    """Join a multi-valued field for storage (see MULTI_SEP)."""
+    return MULTI_SEP.join(v.replace(MULTI_SEP, " ") for v in values if v)
+
+
+def split_multi(value: str) -> list[str]:
+    return [v for v in value.split(MULTI_SEP) if v] if value else []
 
 
 class DocumentMetadata:

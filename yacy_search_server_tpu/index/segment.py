@@ -101,7 +101,10 @@ class Segment:
     # -- write path ----------------------------------------------------------
 
     def store_document(self, doc: Document, crawldepth: int = 0,
-                       collection: str = "user") -> int:
+                       collection: str = "user",
+                       referrer_urlhash: bytes | None = None,
+                       responsetime_ms: int = 0,
+                       httpstatus: int = 200) -> int:
         """Index one parsed document; returns its docid."""
         with StageTimer(EClass.INDEX, "storeDocument", 1):
             urlhash = url2hash(doc.url)
@@ -122,12 +125,13 @@ class Segment:
                 vocab_sxt = ",".join(
                     f"{voc}:{tag}" for voc in sorted(tagmap)
                     for tag in sorted(tagmap[voc]))
+            host = _host_of(doc.url)
             meta = metadata_from_parsed(
                 urlhash, doc.url, doc.title, doc.text,
                 author=doc.author,
                 description_txt=doc.description,
                 keywords=",".join(doc.keywords),
-                host_s=_host_of(doc.url),
+                host_s=host,
                 language_s=doc.language,
                 url_file_ext_s=_ext_of(doc.url),
                 collection_sxt=collection,
@@ -140,10 +144,17 @@ class Segment:
                 doctype_i=doc.doctype,
                 flags_i=condenser.content_flags.value,
                 last_modified_days_i=doc.publish_date_days,
-                references_i=self.citations.references(urlhash),
-                references_exthosts_i=self.citations.references_exthosts(urlhash),
+                **dict(zip(
+                    ("references_i", "references_internal_i",
+                     "references_external_i", "references_exthosts_i"),
+                    self.citations.reference_counts(urlhash))),
                 lat_d=doc.lat, lon_d=doc.lon,
                 vocabulary_sxt=vocab_sxt,
+                referrer_id_s=(referrer_urlhash or b"").decode("ascii",
+                                                               "replace"),
+                responsetime_i=responsetime_ms,
+                httpstatus_i=httpstatus,
+                **_schema_breadth_fields(doc, host),
             )
             with self._lock:
                 # re-index: retire the previous version's identity so its
@@ -193,15 +204,18 @@ class Segment:
             return docid
 
     def _refresh_references(self, target_urlhash: bytes) -> None:
-        """Sync a target's references_i/_exthosts_i metadata columns with
-        the citation index (no-op when the target is not indexed here)."""
+        """Sync a target's references_* metadata columns with the citation
+        index (no-op when the target is not indexed here)."""
         cited_docid = self.metadata.docid(target_urlhash)
         if cited_docid is not None:
+            total, internal, external, exthosts = \
+                self.citations.reference_counts(target_urlhash)
             self.metadata.set_fields(
                 cited_docid,
-                references_i=self.citations.references(target_urlhash),
-                references_exthosts_i=(
-                    self.citations.references_exthosts(target_urlhash)))
+                references_i=total,
+                references_internal_i=internal,
+                references_external_i=external,
+                references_exthosts_i=exthosts)
 
     def remove_document(self, urlhash: bytes) -> bool:
         """Blacklist/url-delete path: tombstone everywhere."""
@@ -315,6 +329,127 @@ def exclude_destructive(joined: PostingsList, excluded: PostingsList) -> Posting
     (ReferenceContainer.excludeDestructive:491 semantics)."""
     mask = ~np.isin(joined.docids, excluded.docids, assume_unique=True)
     return joined.select(mask)
+
+
+def _urlstub(url: str) -> str:
+    """URL without its protocol (the reference's *_urlstub_sxt shape)."""
+    return url.split("://", 1)[-1]
+
+
+def _schema_breadth_fields(doc: Document, host: str) -> dict:
+    """The document→schema conversion beyond the core fields — the
+    capability analog of CollectionConfiguration.yacy2solr (reference:
+    search/schema/CollectionConfiguration.java: link array partitioning,
+    heading zone texts, robots/canonical flags, dates-in-content,
+    signatures, url/host decomposition)."""
+    from urllib.parse import parse_qsl
+
+    from ..document.datedetection import (dates_as_iso, dates_in_content)
+    from ..document.signature import exact_signature, fuzzy_signature
+    from ..utils.hashes import _split, _split_host, normalform
+    from .metadata import join_multi
+
+    # link arrays, partitioned by host (inbound = same host)
+    inb_stubs, outb_stubs, inb_texts, outb_texts = [], [], [], []
+    inb_nofollow = outb_nofollow = 0
+    for a in doc.anchors:
+        target_host = _host_of(a.url)
+        nofollow = "nofollow" in (getattr(a, "rel", "") or "").lower()
+        text = (getattr(a, "text", "") or "").strip()
+        if target_host == host:
+            inb_stubs.append(_urlstub(a.url))
+            if text:
+                inb_texts.append(text)
+            inb_nofollow += nofollow
+        else:
+            outb_stubs.append(_urlstub(a.url))
+            if text:
+                outb_texts.append(text)
+            outb_nofollow += nofollow
+
+    # heading zones
+    headings = doc.headings or {}
+    h_fields = {}
+    htags = 0
+    for level in range(1, 7):
+        texts = headings.get(level, [])
+        h_fields[f"h{level}_txt"] = join_multi(texts)
+        h_fields[f"h{level}_i"] = len(texts)
+        if texts:
+            htags |= 1 << (level - 1)
+
+    # dates mentioned in the content
+    dates = dates_in_content(doc.text)
+
+    # url decomposition
+    scheme, _h, _port, path, query = _split(doc.url)
+    path_parts = [p for p in path.split("/") if p]
+    if path.endswith("/") or not path_parts:
+        file_name, path_dirs = "", path_parts
+    else:
+        file_name, path_dirs = path_parts[-1], path_parts[:-1]
+    subdom, organization = _split_host(host)
+
+    canonical_equal = 0
+    if doc.canonical:
+        # compare against the URL the page was FETCHED under (the parser
+        # rewrites doc.url to the canonical, so doc.url would always match)
+        fetched = getattr(doc, "fetched_url", doc.url)
+        try:
+            canonical_equal = int(
+                normalform(doc.canonical) == normalform(fetched))
+        except Exception:
+            canonical_equal = 0
+
+    return dict(
+        content_type=doc.mime_type,
+        charset_s=doc.charset,
+        canonical_s=doc.canonical,
+        publisher_t=doc.publisher,
+        metagenerator_t=doc.generator,
+        inboundlinks_urlstub_sxt=join_multi(inb_stubs),
+        outboundlinks_urlstub_sxt=join_multi(outb_stubs),
+        inboundlinks_anchortext_txt=join_multi(inb_texts),
+        outboundlinks_anchortext_txt=join_multi(outb_texts),
+        inboundlinkscount_i=len(inb_stubs),
+        outboundlinkscount_i=len(outb_stubs),
+        inboundlinksnofollowcount_i=inb_nofollow,
+        outboundlinksnofollowcount_i=outb_nofollow,
+        linksnofollowcount_i=inb_nofollow + outb_nofollow,
+        images_urlstub_sxt=join_multi(_urlstub(im.url)
+                                      for im in doc.images),
+        images_alt_sxt=join_multi(im.alt for im in doc.images),
+        images_withalt_i=sum(1 for im in doc.images if im.alt),
+        icons_urlstub_sxt=join_multi(
+            [_urlstub(doc.favicon)] if doc.favicon else []),
+        audiolinkscount_i=len(doc.audio_links),
+        videolinkscount_i=len(doc.video_links),
+        applinkscount_i=len(doc.app_links),
+        robots_i=doc.robots_flags,
+        htags_i=htags,
+        dates_in_content_dts=join_multi(dates_as_iso(dates)),
+        dates_in_content_count_i=len(dates),
+        title_count_i=1 if doc.title else 0,
+        title_words_val=len(doc.title.split()),
+        description_count_i=1 if doc.description else 0,
+        description_words_val=len(doc.description.split()),
+        url_protocol_s=scheme,
+        url_file_name_s=file_name,
+        url_paths_sxt=join_multi(path_dirs),
+        url_paths_count_i=len(path_dirs),
+        url_parameter_i=len(parse_qsl(query, keep_blank_values=True)),
+        url_chars_i=len(doc.url),
+        host_organization_s=organization,
+        host_subdomain_s=subdom,
+        canonical_equal_sku_b=canonical_equal,
+        exact_signature_l=exact_signature(doc.text),
+        fuzzy_signature_l=fuzzy_signature(doc.text),
+        # optimistic until postprocess_uniqueness() recomputes them
+        # (index/postprocess.py) — a fresh doc is unique until proven not
+        title_unique_b=1, description_unique_b=1,
+        exact_signature_unique_b=1, fuzzy_signature_unique_b=1,
+        **h_fields,
+    )
 
 
 def _host_of(url: str) -> str:

@@ -27,11 +27,10 @@ import json
 import os
 import threading
 from collections import defaultdict
-from urllib.parse import urlsplit
 
 import numpy as np
 
-from ..utils.hashes import safe_host, url2hash, url_file_ext
+from ..utils.hashes import _split, safe_host, url2hash, url_file_ext
 
 # rel attribute coding (reference: WebgraphConfiguration.relEval:291 —
 # "me"=1, "nofollow"=2; we extend with the other machine-meaningful rels)
@@ -117,8 +116,10 @@ class WebgraphStore:
         """Record one indexed document's outbound hyperlinks; returns the
         number of edges written (WebgraphConfiguration.getEdges parity:
         one edge per anchor, with link text/alt/rel and the inbound flag)."""
+        # _split tolerates malformed URLs (the identity layer's contract:
+        # scraped hrefs must never crash indexing) where raw urlsplit raises
         src_host = safe_host(source_url)
-        src_path = urlsplit(source_url).path or "/"
+        src_path = _split(source_url)[3]
         try:
             src_id = url2hash(source_url).decode("ascii")
         except Exception:
@@ -129,7 +130,7 @@ class WebgraphStore:
             tgt_host = safe_host(target_url)
             if not tgt_host:
                 continue
-            path = urlsplit(target_url).path or "/"
+            path = _split(target_url)[3]
             ext = url_file_ext(target_url)
             try:
                 tgt_id = url2hash(target_url).decode("ascii")

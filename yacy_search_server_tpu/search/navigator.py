@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from ..utils.scoremap import ScoreMap
 
-DEFAULT_NAVIGATORS = ("hosts", "language", "filetype", "authors", "year")
+DEFAULT_NAVIGATORS = ("hosts", "language", "filetype", "authors", "year",
+                      "dates")
 
 
 class Navigator:
@@ -45,6 +46,9 @@ def make_navigators(names=DEFAULT_NAVIGATORS) -> dict[str, Navigator]:
         "authors": "author",
         "year": "last_modified_days_i",
         "collections": "collection_sxt",
+        # dates mentioned IN the content (reference: DateNavigator over
+        # dates_in_content_dts), distinct from the `year` modified-date facet
+        "dates": "dates_in_content_dts",
     }
     return {n: Navigator(n, fields[n]) for n in names if n in fields}
 
@@ -57,4 +61,9 @@ def accumulate(navigators: dict[str, Navigator], meta) -> None:
             import datetime
             v = datetime.date.fromordinal(
                 datetime.date(1970, 1, 1).toordinal() + int(v)).year
+        if nav.name == "dates" and v:
+            from ..index.metadata import split_multi
+            for date in split_multi(str(v)):
+                nav.add(date)
+            continue
         nav.add(v)

@@ -256,7 +256,8 @@ class QueryParams:
     lang: str = "en"
     profile: RankingProfile | None = None
     snippet_fetch: bool = True
-    facets: tuple = ("hosts", "language", "filetype", "authors", "year")
+    facets: tuple = ("hosts", "language", "filetype", "authors", "year",
+                     "dates")
     # domain diversity: max results per host before diversion
     # (doubledom handling, SearchEvent.java:1297-1412)
     max_per_host: int = 6

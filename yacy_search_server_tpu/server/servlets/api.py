@@ -66,6 +66,8 @@ def respond_postprocessing(header: dict, post: ServerObjects,
     if post.get("run"):
         prop.put("updated", postprocess_segment(
             sb.index, sb.web_structure, ranks=all_ranks))
+        from ...index.postprocess import postprocess_uniqueness
+        prop.put("uniqueness_updated", postprocess_uniqueness(sb.index))
     ranks = sorted(all_ranks.items(),
                    key=lambda kv: -kv[1])[: post.get_int("maxhosts", 25)]
     prop.put("hosts", len(ranks))

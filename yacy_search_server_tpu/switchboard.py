@@ -350,7 +350,11 @@ class Switchboard:
             for doc in entry.documents:
                 self.index.store_document(
                     doc, crawldepth=req.depth,
-                    collection=entry.profile.collections[0])
+                    collection=entry.profile.collections[0],
+                    referrer_urlhash=req.referrer_hash or None,
+                    responsetime_ms=int(
+                        entry.response.fetch_time_s * 1000),
+                    httpstatus=entry.response.status)
                 self.indexed_count += 1
             return None
 
