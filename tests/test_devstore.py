@@ -261,13 +261,19 @@ def test_tombstone_disables_pruning_until_merge():
     _assert_same_ranking(got, _oracle(idx, TH, 10))
 
 
-def test_searchevent_device_vs_host_identical():
+def test_searchevent_device_vs_host_identical(monkeypatch):
     """End-to-end: SearchEvent with devstore enabled returns the same page
     as with it disabled."""
     from yacy_search_server_tpu.document.document import Document
     from yacy_search_server_tpu.index.segment import Segment
+    from yacy_search_server_tpu.ops import ranking
     from yacy_search_server_tpu.search.query import QueryParams
     from yacy_search_server_tpu.search.searchevent import SearchEvent
+
+    # the small-candidate gate would route this tiny corpus to the host
+    # path; the device-vs-host identity is exactly what this test checks,
+    # so force the device path
+    monkeypatch.setattr(ranking, "SMALL_RANK_N", 0)
 
     seg = Segment(max_ram_postings=50)
     rng = np.random.default_rng(8)

@@ -241,3 +241,81 @@ def test_compact_feats_clipping_and_flags():
     assert f16[0, P.F_WORDS_IN_TEXT] == 32767
     assert (f16[:, P.F_FLAGS] == 0).all()
     assert flags[1] == (1 << 29) | 5
+
+
+def test_cardinal_host_twin_matches_oracle():
+    """The small-candidate numpy path (cardinal_scores_host) must score
+    exactly like the per-row oracle (and hence like the device kernel)."""
+    plist = _rand_plist(700, seed=9)
+    prof = R.RankingProfile()
+    got = R.cardinal_scores_host(plist.feats, prof)
+    want = oracle_cardinal(plist.feats, prof)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_cardinal_host_twin_authority():
+    plist = _rand_plist(300, seed=10)
+    rng = np.random.default_rng(11)
+    hostids = rng.integers(0, 9, len(plist)).astype(np.int32)
+    prof = R.RankingProfile()
+    prof.authority = 13
+    got = R.cardinal_scores_host(plist.feats, prof, hostids=hostids)
+    want = oracle_cardinal(plist.feats, prof, hostids=hostids)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_rank_small_path_matches_device_path():
+    """CardinalRanker.rank must return the same page whether the small-n
+    host path or the padded device kernel runs."""
+    plist = _rand_plist(900, seed=12)
+    prof = R.RankingProfile()
+    r = R.CardinalRanker(prof)
+    s_host, d_host = r.rank(plist, k=20)          # n < SMALL_RANK_N: host
+    import yacy_search_server_tpu.ops.ranking as mod
+    saved = mod.SMALL_RANK_N
+    try:
+        mod.SMALL_RANK_N = 0                       # force device path
+        s_dev, d_dev = R.CardinalRanker(prof).rank(plist, k=20)
+    finally:
+        mod.SMALL_RANK_N = saved
+    np.testing.assert_array_equal(np.asarray(d_host), np.asarray(d_dev))
+    np.testing.assert_array_equal(np.asarray(s_host, dtype=np.int64),
+                                  np.asarray(s_dev, dtype=np.int64))
+
+
+def test_host_twin_matches_device_on_overflow_feats():
+    """Features beyond int16 must clip identically on both paths (the
+    compact block format is THE scoring representation)."""
+    plist = _rand_plist(900, seed=13)
+    plist.feats[5, P.F_WORDS_IN_TEXT] = 40000    # > int16 max
+    prof = R.RankingProfile()
+    s_host, d_host = R.CardinalRanker(prof).rank(plist, k=30)
+    import yacy_search_server_tpu.ops.ranking as mod
+    saved = mod.SMALL_RANK_N
+    try:
+        mod.SMALL_RANK_N = 0
+        s_dev, d_dev = R.CardinalRanker(prof).rank(plist, k=30)
+    finally:
+        mod.SMALL_RANK_N = saved
+    np.testing.assert_array_equal(np.asarray(d_host), np.asarray(d_dev))
+    np.testing.assert_array_equal(np.asarray(s_host, dtype=np.int64),
+                                  np.asarray(s_dev, dtype=np.int64))
+
+
+def test_host_twin_f32_tf_matches_device_across_seeds():
+    """float32 tf normalization: host and device must agree on every
+    input (the f64 variant drifted by 1<<tf on ~4% of random blocks)."""
+    prof = R.RankingProfile()
+    import yacy_search_server_tpu.ops.ranking as mod
+    for seed in range(25):
+        plist = _rand_plist(400, seed=100 + seed)
+        s_host, d_host = R.CardinalRanker(prof).rank(plist, k=400)
+        saved = mod.SMALL_RANK_N
+        try:
+            mod.SMALL_RANK_N = 0
+            s_dev, d_dev = R.CardinalRanker(prof).rank(plist, k=400)
+        finally:
+            mod.SMALL_RANK_N = saved
+        np.testing.assert_array_equal(
+            np.asarray(s_host, dtype=np.int64),
+            np.asarray(s_dev, dtype=np.int64), err_msg=f"seed {seed}")

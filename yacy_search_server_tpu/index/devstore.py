@@ -64,8 +64,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.ranking import (_NORM_DIRECT, RankingProfile, cardinal_from_stats,
-                           compact_feats, local_stats)
+from ..ops.ranking import (_ACTIVE_COLS, RankingProfile,
+                           cardinal_from_stats, cardinal_from_stats_host,
+                           compact_feats, local_stats, pack_stats_host)
 from ..ops.streaming import merge_stats
 from ..utils.eventtracker import EClass, update as track
 from . import postings as P
@@ -112,58 +113,11 @@ class Span:
         self.dead_seq = dead_seq
 
 
-_ACTIVE_COLS = ~np.isin(
-    np.arange(P.NF), [P.F_FLAGS, P.F_DOCTYPE, P.F_LANGUAGE, P.F_DOMLENGTH])
-
-
-def _pack_stats_np(feats16: np.ndarray, flags: np.ndarray) -> dict:
-    """Frozen span normalization stats (numpy twin of ops.ranking
-    local_stats over a compact block, all rows valid)."""
-    f = feats16.astype(np.int32)
-    tf = f[:, P.F_HITCOUNT].astype(np.float32) / (
-        f[:, P.F_WORDS_IN_TEXT] + f[:, P.F_WORDS_IN_TITLE] + 1
-    ).astype(np.float32)
-    return {
-        "col_min": f.min(axis=0).astype(np.int32),
-        "col_max": f.max(axis=0).astype(np.int32),
-        "tf_min": np.float32(tf.min()),
-        "tf_max": np.float32(tf.max()),
-    }
-
-
-def _cardinal_np(feats16: np.ndarray, flags: np.ndarray, stats: dict,
-                 prof: RankingProfile, language_pref: int) -> np.ndarray:
-    """Numpy twin of cardinal_from_stats (authority off) — used for the
-    pack-time proxy ordering. Integer parts are bit-exact vs the device
-    kernel; the float32 tf normalization may drift by one unit (covered by
-    the stored-bound margin)."""
-    f = feats16.astype(np.int32)
-    col_min, col_max = stats["col_min"], stats["col_max"]
-    span = col_max - col_min
-    safe = np.maximum(span, 1)
-    norm = ((f - col_min[None, :]) * 256) // safe[None, :]
-    norm = np.where(span[None, :] == 0, 0, norm)
-    inv = np.where(span[None, :] == 0, 0, 256 - norm)
-    contrib = np.where(_NORM_DIRECT[None, :], norm, inv)
-    per_col = contrib << np.abs(prof.norm_coeffs())[None, :]
-    score = np.where(_ACTIVE_COLS[None, :], per_col, 0).sum(
-        axis=1, dtype=np.int64)
-    score += (256 - f[:, P.F_DOMLENGTH]) << prof.domlength
-    tf = f[:, P.F_HITCOUNT].astype(np.float32) / (
-        f[:, P.F_WORDS_IN_TEXT] + f[:, P.F_WORDS_IN_TITLE] + 1
-    ).astype(np.float32)
-    tf_span = stats["tf_max"] - stats["tf_min"]
-    tf_norm = np.where(
-        tf_span > 0,
-        (tf - stats["tf_min"]) * np.float32(256.0) / max(tf_span, 1e-9),
-        0.0).astype(np.int32)
-    score += tf_norm.astype(np.int64) << prof.tf
-    score += np.where(f[:, P.F_LANGUAGE] == language_pref,
-                      255 << prof.language, 0)
-    bits, shifts = prof.flag_coeffs()
-    hit = (flags[:, None] >> bits[None, :]) & 1
-    score += (hit * (255 << shifts[None, :])).sum(axis=1, dtype=np.int64)
-    return score.astype(np.int64)
+# the canonical numpy twin of the cardinal kernel lives in ops.ranking
+# (pack_stats_host / cardinal_from_stats_host): pack-time proxy ordering
+# here and the small-candidate serving fast path must score identically
+_pack_stats_np = pack_stats_host
+_cardinal_np = cardinal_from_stats_host
 
 
 def _signal_shift_vector(prof: RankingProfile) -> np.ndarray:

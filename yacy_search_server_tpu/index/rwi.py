@@ -489,6 +489,25 @@ class RWIIndex:
         """Posting count (the queryRWICount RPC answer); tombstones applied."""
         return len(self.get(termhash))
 
+    def count_upper(self, termhash: bytes) -> int:
+        """Cheap upper bound on a term's posting count: per-run span
+        extents + RAM buffer length, NO postings materialization and no
+        tombstone filtering. Gate decisions (device vs host path) only
+        need the magnitude."""
+        with self._lock:
+            total = 0
+            ram = self._ram.get(termhash)
+            if ram is not None:
+                total += len(ram)
+            for run in self._runs:
+                sp = run.span(termhash)
+                if sp is not None:
+                    total += sp[1]
+                elif run.has(termhash):
+                    p = run.get(termhash)
+                    total += len(p) if p is not None else 0
+            return total
+
     def has_term(self, termhash: bytes) -> bool:
         with self._lock:
             if termhash in self._ram:

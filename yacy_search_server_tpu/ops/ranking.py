@@ -437,26 +437,163 @@ def hostid_array(docids: np.ndarray, hosthashes: list[bytes] | np.ndarray) -> np
     return ids.astype(np.int32)
 
 
+# below this candidate count the kernel dispatch overhead (and, through a
+# remote tunnel, the device round trip) dwarfs the scoring work: score on
+# the host instead. 4096×NF int64 numpy ops run in ~0.1ms; a CPU-backend
+# jit dispatch costs ~10ms and a tunnel round trip ~110ms (BASELINE.md).
+SMALL_RANK_N = 4096
+
+
+# columns carrying normalized contributions (flags/doctype/language/
+# domlength are handled by their own terms)
+_ACTIVE_COLS = ~np.isin(
+    np.arange(P.NF), [P.F_FLAGS, P.F_DOCTYPE, P.F_LANGUAGE, P.F_DOMLENGTH])
+
+
+def pack_stats_host(feats16: np.ndarray, flags: np.ndarray) -> dict:
+    """Normalization stats over a compact block (numpy twin of
+    local_stats, all rows valid) — float32 tf to match the kernel."""
+    f = feats16.astype(np.int32)
+    tf = f[:, P.F_HITCOUNT].astype(np.float32) / (
+        f[:, P.F_WORDS_IN_TEXT] + f[:, P.F_WORDS_IN_TITLE] + 1
+    ).astype(np.float32)
+    return {
+        "col_min": f.min(axis=0).astype(np.int32),
+        "col_max": f.max(axis=0).astype(np.int32),
+        "tf_min": np.float32(tf.min()),
+        "tf_max": np.float32(tf.max()),
+    }
+
+
+def cardinal_from_stats_host(feats16: np.ndarray, flags: np.ndarray,
+                             stats: dict, prof: "RankingProfile",
+                             language_pref: int,
+                             hostids: np.ndarray | None = None) -> np.ndarray:
+    """Numpy twin of cardinal_from_stats over a compact block. Integer
+    parts are bit-exact vs the device kernel; tf normalization runs in
+    float32 like the kernel (so host and device agree on the same input).
+    The single canonical host twin: CardinalRanker's small-candidate fast
+    path and devstore's pack-time proxy ordering both call this."""
+    f = feats16.astype(np.int32)
+    col_min, col_max = stats["col_min"], stats["col_max"]
+    span = col_max - col_min
+    safe = np.maximum(span, 1)
+    norm = ((f - col_min[None, :]) * 256) // safe[None, :]
+    norm = np.where(span[None, :] == 0, 0, norm)
+    inv = np.where(span[None, :] == 0, 0, 256 - norm)
+    contrib = np.where(_NORM_DIRECT[None, :], norm, inv)
+    per_col = contrib << np.abs(prof.norm_coeffs())[None, :]
+    score = np.where(_ACTIVE_COLS[None, :], per_col, 0).sum(
+        axis=1, dtype=np.int64)
+    score += (256 - f[:, P.F_DOMLENGTH]) << prof.domlength
+    tf = f[:, P.F_HITCOUNT].astype(np.float32) / (
+        f[:, P.F_WORDS_IN_TEXT] + f[:, P.F_WORDS_IN_TITLE] + 1
+    ).astype(np.float32)
+    tf_span = stats["tf_max"] - stats["tf_min"]
+    tf_norm = np.where(
+        tf_span > 0,
+        (tf - stats["tf_min"]) * np.float32(256.0) / max(tf_span, 1e-9),
+        0.0).astype(np.int32)
+    score += tf_norm.astype(np.int64) << prof.tf
+    score += np.where(f[:, P.F_LANGUAGE] == language_pref,
+                      255 << prof.language, 0)
+    bits, shifts = prof.flag_coeffs()
+    hit = (flags[:, None] >> bits[None, :]) & 1
+    score += (hit * (255 << shifts[None, :])).sum(axis=1, dtype=np.int64)
+    if prof.authority > 12 and hostids is not None and len(f):
+        counts = np.bincount(hostids, minlength=int(hostids.max()) + 1)
+        auth = (counts[hostids].astype(np.int64) << 8) // (1 + counts.max())
+        score += auth << prof.authority
+    return score.astype(np.int64)
+
+
+def cardinal_scores_host(feats: np.ndarray, profile: "RankingProfile",
+                         language: str = "en",
+                         hostids: np.ndarray | None = None) -> np.ndarray:
+    """Pure-numpy scorer for small candidate sets (the P2P fan-out's
+    per-peer searches and tiny-term queries, where a device dispatch per
+    query would dominate end-to-end latency). Scores the SAME compact
+    int16 representation the device path scores (compact_feats clip +
+    float32 tf), so host and device agree on every input."""
+    feats16, flags = compact_feats(np.asarray(feats, dtype=np.int32))
+    stats = pack_stats_host(feats16, flags)
+    return cardinal_from_stats_host(feats16, flags, stats, profile,
+                                    P.pack_language(language), hostids)
+
+
 class CardinalRanker:
     """Host-side wrapper: pad → upload → score_topk, profile baked in."""
 
     def __init__(self, profile: RankingProfile | None = None,
                  language: str = "en"):
         self.profile = profile or RankingProfile()
-        self._norm = jnp.asarray(self.profile.norm_coeffs())
-        bits, shifts = self.profile.flag_coeffs()
-        self._bits, self._shifts = jnp.asarray(bits), jnp.asarray(shifts)
-        self._dl = jnp.int32(self.profile.domlength)
-        self._tf = jnp.int32(self.profile.tf)
-        self._lang_c = jnp.int32(self.profile.language)
-        self._auth = jnp.int32(self.profile.authority)
-        self._lang = jnp.int32(P.pack_language(language))
+        self._lang_str = language
+        self._consts = None   # device constants, built on first device rank
+
+    def _device_consts(self):
+        """Lazy device upload of the profile constants: a ranker whose
+        every query takes the small-n host path (tiny peers, sparse terms)
+        must never pay the 11 per-constant transfers at construction —
+        SearchEvent builds one ranker per query."""
+        if self._consts is None:
+            bits, shifts = self.profile.flag_coeffs()
+            self._consts = (
+                jnp.asarray(self.profile.norm_coeffs()),
+                jnp.asarray(bits), jnp.asarray(shifts),
+                jnp.int32(self.profile.domlength),
+                jnp.int32(self.profile.tf),
+                jnp.int32(self.profile.language),
+                jnp.int32(self.profile.authority),
+                jnp.int32(P.pack_language(self._lang_str)))
+        return self._consts
+
+    # constant accessors (kernel call sites and the multichip dryrun read
+    # these; they trigger the lazy device upload)
+    @property
+    def _norm(self):
+        return self._device_consts()[0]
+
+    @property
+    def _bits(self):
+        return self._device_consts()[1]
+
+    @property
+    def _shifts(self):
+        return self._device_consts()[2]
+
+    @property
+    def _dl(self):
+        return self._device_consts()[3]
+
+    @property
+    def _tf(self):
+        return self._device_consts()[4]
+
+    @property
+    def _lang_c(self):
+        return self._device_consts()[5]
+
+    @property
+    def _auth(self):
+        return self._device_consts()[6]
+
+    @property
+    def _lang(self):
+        return self._device_consts()[7]
 
     def rank(self, plist, hosthashes=None, k: int = 10):
         """(scores, docids) best-first over a PostingsList."""
         n = len(plist)
         if n == 0:
             return np.empty(0, np.int32), np.empty(0, np.int32)
+        if n <= SMALL_RANK_N:
+            # host fast path: no kernel dispatch for tiny candidate sets
+            hostids = (hostid_array(plist.docids, hosthashes)
+                       if hosthashes is not None else None)
+            s = cardinal_scores_host(plist.feats, self.profile,
+                                     self._lang_str, hostids)
+            order = np.argsort(-s, kind="stable")[:k]
+            return s[order], plist.docids[order]
         npad = pad_to(n)
         feats = np.zeros((npad, P.NF), np.int32)
         feats[:n] = plist.feats
@@ -469,12 +606,13 @@ class CardinalRanker:
             hostids[:n] = hostid_array(plist.docids, hosthashes)
         kk = min(k, npad)
         feats16, flags = compact_feats(feats)
+        norm, bits, shifts, dl, tf, lang_c, auth, lang = self._device_consts()
         s, d, _ = score_topk16(jnp.asarray(feats16), jnp.asarray(flags),
                                jnp.asarray(docids), jnp.asarray(valid),
                                jnp.asarray(hostids),
-                               self._norm, self._bits, self._shifts,
-                               self._dl, self._tf, self._lang_c, self._auth,
-                               self._lang, kk,
+                               norm, bits, shifts,
+                               dl, tf, lang_c, auth,
+                               lang, kk,
                                with_authority=self.profile.authority > 12)
         s, d = np.asarray(s), np.asarray(d)
         keep = d >= 0

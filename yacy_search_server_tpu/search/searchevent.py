@@ -236,6 +236,12 @@ class SearchEvent:
         inc, exc = q.goal.include_hashes, q.goal.exclude_hashes
         if len(inc) != 1 or exc:
             return None
+        # tiny terms: the host path scores them in microseconds
+        # (ops/ranking.SMALL_RANK_N numpy twin); a device dispatch — and
+        # through a remote tunnel, a full round trip — would dominate
+        from ..ops.ranking import SMALL_RANK_N
+        if self.segment.rwi.count_upper(inc[0]) <= SMALL_RANK_N:
+            return None
         m = q.modifier
         if m.sitehost or m.tld or m.filetype or m.protocol or m.date_sort:
             return None
