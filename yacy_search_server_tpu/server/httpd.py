@@ -50,12 +50,16 @@ class YaCyHttpServer:
     """One node's HTTP face: UI/API servlets + P2P wire endpoints."""
 
     def __init__(self, sb, port: int = 8090, host: str = "127.0.0.1",
-                 peer_server=None, htroot_dirs: list[str] | None = None):
+                 peer_server=None, htroot_dirs: list[str] | None = None,
+                 https_port: int | None = None,
+                 certfile: str | None = None, keyfile: str | None = None):
         self.sb = sb
         self.peer_server = peer_server
         roots = list(htroot_dirs or [])
         roots.append(DEFAULT_HTROOT)
         self.templates = TemplateEngine(roots)
+        from .security import SecurityHandler
+        self.security = SecurityHandler(sb.config)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -86,12 +90,50 @@ class YaCyHttpServer:
         self.host = host
         self._thread: threading.Thread | None = None
 
+        # HTTPS listener (reference: Jetty9HttpServerImpl.java:112-233
+        # mounts an SSL connector beside the plain one when server.https
+        # is on). Cert/key paths come from arguments or config; both
+        # listeners share the one Handler/dispatch.
+        self.httpsd = None
+        self.https_port = None
+        self._https_thread: threading.Thread | None = None
+        cfg = sb.config
+        from_config = https_port is None
+        if https_port is None and cfg.get_bool("server.https", False):
+            https_port = cfg.get_int("port.ssl", 8443)
+        if https_port is not None:
+            import ssl
+            certfile = certfile or cfg.get("ssl.certPath", "")
+            keyfile = keyfile or cfg.get("ssl.keyPath", "") or None
+            try:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(certfile, keyfile)
+                self.httpsd = ThreadingHTTPServer((host, https_port),
+                                                  Handler)
+                self.httpsd.socket = ctx.wrap_socket(self.httpsd.socket,
+                                                     server_side=True)
+                self.https_port = self.httpsd.server_address[1]
+            except Exception as e:
+                # a misconfigured cert must not kill the plain-HTTP node
+                # (the reference's Jetty setup degrades to HTTP-only too);
+                # an explicit https_port argument is a programming contract
+                # and still raises
+                if not from_config:
+                    self.httpd.server_close()
+                    raise
+                self.https_error = f"https disabled: {e}"
+                self.httpsd = None
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "YaCyHttpServer":
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, name="httpd", daemon=True)
         self._thread.start()
+        if self.httpsd is not None:
+            self._https_thread = threading.Thread(
+                target=self.httpsd.serve_forever, name="httpsd", daemon=True)
+            self._https_thread.start()
         # recorded-API replay goes through our own HTTP surface (the
         # reference's WorkTables.execAPICall self-call), so the recorded
         # URL stays the replayable action across restarts
@@ -113,29 +155,39 @@ class YaCyHttpServer:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self.httpsd is not None:
+            self.httpsd.shutdown()
+            self.httpsd.server_close()
+            if self._https_thread:
+                self._https_thread.join(timeout=5)
 
     @property
     def base_url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    @property
+    def https_url(self) -> str | None:
+        return (f"https://{self.host}:{self.https_port}"
+                if self.https_port else None)
+
     # -- auth ----------------------------------------------------------------
 
     def _is_admin(self, handler) -> bool:
-        client_ip = handler.client_address[0]
-        cfg = self.sb.config
-        if client_ip in ("127.0.0.1", "::1") and cfg.get_bool(
-                "adminAccountForLocalhost", True):
-            return True
-        auth = handler.headers.get("authorization", "")
-        if auth.lower().startswith("basic "):
-            try:
-                user, _, pw = base64.b64decode(
-                    auth[6:]).decode("utf-8").partition(":")
-            except Exception:
-                return False
-            return (user == cfg.get("adminAccountName", "admin")
-                    and pw != "" and pw == cfg.get("adminAccountPassword", ""))
-        return False
+        """Basic/digest/localhost admin check (server/security.py)."""
+        return self.security.is_admin(
+            handler.client_address[0], handler.headers,
+            method=handler.command, uri=urlsplit(handler.path).path)
+
+    def _send_401(self, handler) -> None:
+        handler.send_response(401)
+        body = b"admin authorization required"
+        handler.send_header("Content-Type", "text/plain")
+        handler.send_header("Content-Length", str(len(body)))
+        # both schemes offered: one WWW-Authenticate header per scheme
+        for challenge in self.security.challenges():
+            handler.send_header("WWW-Authenticate", challenge)
+        handler.end_headers()
+        handler.wfile.write(body)
 
     # -- dispatch ------------------------------------------------------------
 
@@ -152,6 +204,11 @@ class YaCyHttpServer:
             # node answers 429 instead of serving (localhost exempt)
             tracker = getattr(self.sb, "access_tracker", None)
             client_ip = handler.client_address[0]
+            # client allowlist (serverClient config) gates everything
+            if not self.security.client_allowed(client_ip):
+                self._send(handler, 403, "text/plain",
+                           b"client not allowed")
+                return
             if tracker is not None:
                 hits = tracker.track_access(client_ip)
                 limit = self.sb.config.get_int(
@@ -172,14 +229,15 @@ class YaCyHttpServer:
             if not name:
                 name, ext = ext, "html"
 
+            # per-path protection applies to servlets AND static files
+            # (an admin template source must not leak via static serving)
+            if self.security.admin_required(name, path) \
+                    and not self._is_admin(handler):
+                self._send_401(handler)
+                return
             fn = servlets.lookup(name)
             if fn is None:
                 self._serve_static(handler, path.lstrip("/"))
-                return
-            if name.endswith("_p") and not self._is_admin(handler):
-                self._send(handler, 401, "text/plain",
-                           b"admin authorization required",
-                           extra={"WWW-Authenticate": 'Basic realm="YaCy"'})
                 return
 
             post = ServerObjects(params)

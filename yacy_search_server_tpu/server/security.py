@@ -1,0 +1,199 @@
+"""HTTP security — digest/basic admin auth and per-path access rules.
+
+Capability equivalent of the reference's security handler stack
+(reference: source/net/yacy/http/Jetty9YaCySecurityHandler.java:60 —
+computes per-path admin requirements from config; YaCyLoginService /
+YaCyLegacyCredential — BASIC and DIGEST admin credentials, with the
+stored secret being the MD5 of "user:realm:password"; serverClient
+config key — client-IP allowlist, defaults/yacy.init:440-445).
+
+Rules implemented here:
+- client allowlist: config ``serverClient`` ("*" or comma-separated IP
+  prefixes) gates every request (403 otherwise);
+- admin paths: servlet names ending ``_p`` plus any globs in config
+  ``security.adminPaths``; when ``publicSearchpage`` is false the search
+  surface needs admin too (defaults/yacy.init:1143);
+- localhost auto-admin when ``adminAccountForLocalhost`` is true;
+- HTTP Basic against ``adminAccountName``/``adminAccountPassword`` or
+  the stored HA1 digest ``adminDigestHA1``;
+- HTTP Digest (RFC 7616, MD5 + qop=auth) against the same credentials.
+  Nonces are HMAC-signed timestamps: stateless verification, 10-minute
+  validity window (no server-side nonce table; the nc replay counter is
+  not tracked — a design degradation vs RFC 7616 noted here).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import hmac
+import os
+import time
+
+
+def _md5(s: str) -> str:
+    return hashlib.md5(s.encode("utf-8")).hexdigest()
+
+
+def ha1(user: str, realm: str, password: str) -> str:
+    """The stored digest credential (YaCyLegacyCredential shape:
+    MD5 of "user:realm:password")."""
+    return _md5(f"{user}:{realm}:{password}")
+
+
+_AUTH_PARAM_RE = None
+
+
+def _parse_auth_params(header: str) -> dict[str, str]:
+    """Parse the comma-separated k=v digest fields. Quoted values may
+    contain commas (RFC 7616 quoted-string — e.g. a uri with a comma in
+    its query), so this must not naively split on ','."""
+    import re
+    global _AUTH_PARAM_RE
+    if _AUTH_PARAM_RE is None:
+        _AUTH_PARAM_RE = re.compile(
+            r'([a-zA-Z0-9_-]+)\s*=\s*("(?:[^"\\]|\\.)*"|[^,]*)')
+    out: dict[str, str] = {}
+    for k, v in _AUTH_PARAM_RE.findall(header):
+        v = v.strip()
+        if v.startswith('"') and v.endswith('"') and len(v) >= 2:
+            v = v[1:-1].replace('\\"', '"')
+        out[k.lower()] = v
+    return out
+
+
+class SecurityHandler:
+    NONCE_MAX_AGE_S = 600
+
+    def __init__(self, config):
+        self.config = config
+        self._nonce_key = os.urandom(16)
+
+    # -- per-path rules ------------------------------------------------------
+
+    @property
+    def realm(self) -> str:
+        return self.config.get("adminRealm", "YaCy-AdminUI")
+
+    def client_allowed(self, client_ip: str) -> bool:
+        """serverClient allowlist (defaults/yacy.init:440: comma-separated
+        client IPs that may connect; '*' = everyone). Localhost is always
+        allowed — an operator must never lock themself out of their node."""
+        if client_ip in ("127.0.0.1", "::1"):
+            return True
+        allow = self.config.get("serverClient", "*").strip()
+        if allow in ("*", ""):
+            return True
+        # entries match exactly unless they end with '*' (explicit prefix
+        # glob) — '10.0.0.1' must NOT admit 10.0.0.10x by string prefix
+        for p in (x.strip() for x in allow.split(",")):
+            if not p:
+                continue
+            if p.endswith("*"):
+                if client_ip.startswith(p[:-1]):
+                    return True
+            elif client_ip == p:
+                return True
+        return False
+
+    def admin_required(self, name: str, path: str) -> bool:
+        """Does this servlet need admin rights?
+        (Jetty9YaCySecurityHandler.checkUrlProtection equivalent)."""
+        if name.endswith("_p"):
+            return True
+        for pattern in self.config.get("security.adminPaths", "").split(","):
+            pattern = pattern.strip()
+            if pattern and (fnmatch.fnmatch(name, pattern)
+                            or fnmatch.fnmatch(path, pattern)):
+                return True
+        if not self.config.get_bool("publicSearchpage", True) and \
+                name.startswith(("yacysearch", "suggest", "select",
+                                 "solr/select", "gsa/search", "opensearch")):
+            return True
+        return False
+
+    # -- authentication ------------------------------------------------------
+
+    def is_admin(self, client_ip: str, headers, method: str = "GET",
+                 uri: str = "/") -> bool:
+        if client_ip in ("127.0.0.1", "::1") and self.config.get_bool(
+                "adminAccountForLocalhost", True):
+            return True
+        auth = headers.get("authorization", "") or ""
+        if auth.lower().startswith("basic "):
+            return self._check_basic(auth[6:].strip())
+        if auth.lower().startswith("digest "):
+            return self._check_digest(auth[7:], method, uri)
+        return False
+
+    def _credential_ha1(self, user: str) -> str | None:
+        """The HA1 the node compares against: the stored digest if set,
+        else derived from the plaintext password config."""
+        if user != self.config.get("adminAccountName", "admin"):
+            return None
+        stored = self.config.get("adminDigestHA1", "")
+        if stored:
+            return stored.lower()
+        pw = self.config.get("adminAccountPassword", "")
+        if not pw:
+            return None
+        return ha1(user, self.realm, pw)
+
+    def _check_basic(self, b64: str) -> bool:
+        import base64
+        try:
+            user, _, pw = base64.b64decode(b64).decode("utf-8").partition(":")
+        except Exception:
+            return False
+        want = self._credential_ha1(user)
+        return (want is not None and pw != ""
+                and hmac.compare_digest(ha1(user, self.realm, pw), want))
+
+    def _check_digest(self, header: str, method: str, uri: str) -> bool:
+        p = _parse_auth_params(header)
+        user = p.get("username", "")
+        want_ha1 = self._credential_ha1(user)
+        if want_ha1 is None:
+            return False
+        if p.get("realm") != self.realm:
+            return False
+        nonce = p.get("nonce", "")
+        if not self._nonce_valid(nonce):
+            return False
+        # the client computes the response against the URI it sent; verify
+        # against the client's own uri field but require path agreement
+        req_uri = p.get("uri", uri)
+        if req_uri.split("?", 1)[0] != uri.split("?", 1)[0]:
+            return False
+        ha2 = _md5(f"{method}:{req_uri}")
+        if p.get("qop") == "auth":
+            expect = _md5(":".join((want_ha1, nonce, p.get("nc", ""),
+                                    p.get("cnonce", ""), "auth", ha2)))
+        else:   # RFC 2069 compatibility
+            expect = _md5(f"{want_ha1}:{nonce}:{ha2}")
+        return hmac.compare_digest(expect, p.get("response", ""))
+
+    # -- nonces --------------------------------------------------------------
+
+    def mint_nonce(self) -> str:
+        ts = str(int(time.time()))
+        sig = hmac.new(self._nonce_key, ts.encode(), "sha256").hexdigest()[:24]
+        return f"{ts}.{sig}"
+
+    def _nonce_valid(self, nonce: str) -> bool:
+        ts, _, sig = nonce.partition(".")
+        if not ts.isdigit():
+            return False
+        want = hmac.new(self._nonce_key, ts.encode(), "sha256").hexdigest()[:24]
+        if not hmac.compare_digest(want, sig):
+            return False
+        return (time.time() - int(ts)) <= self.NONCE_MAX_AGE_S
+
+    def challenges(self) -> list[str]:
+        """The WWW-Authenticate header values for a 401 (both schemes
+        offered, like the reference's DIGEST+legacy-BASIC login service)."""
+        return [
+            (f'Digest realm="{self.realm}", qop="auth", algorithm=MD5, '
+             f'nonce="{self.mint_nonce()}"'),
+            f'Basic realm="{self.realm}"',
+        ]
