@@ -1,0 +1,346 @@
+"""Parser zoo — fixture parity against the reference corpus (VERDICT r1
+missing #4).
+
+One test per format family against /root/reference/test/parsertest/*
+(the same corpus the reference's parser tests use,
+test/java/net/yacy/document/parser/*Test.java), asserting the canonical
+umlaut sentence ("In München steht ein Hofbräuhaus…") survives
+extraction — encoding fidelity is the whole point of that corpus.
+Skipped when the corpus is not mounted. Formats with no corpus file
+(7z) build their fixture in-test.
+"""
+
+import glob
+import io
+import lzma
+import os
+import struct
+import zlib
+
+import pytest
+
+from yacy_search_server_tpu.document.parser.registry import parse_source
+
+CORPUS = "/root/reference/test/parsertest"
+pytestmark = pytest.mark.skipif(not os.path.isdir(CORPUS),
+                                reason="reference corpus not mounted")
+
+SENTENCE_WORDS = ("München", "Hofbräuhaus", "Maßkrügen")
+
+
+def _text_of(name: str) -> str:
+    data = open(os.path.join(CORPUS, name), "rb").read()
+    docs = parse_source(f"http://t/{name}", None, data)
+    return "\n".join(d.title + "\n" + d.text for d in docs)
+
+
+def _assert_umlauts(name: str):
+    text = _text_of(name)
+    for w in SENTENCE_WORDS:
+        assert w in text, f"{name}: missing {w!r} in {text[:200]!r}"
+    return text
+
+
+# -- binary office (CFB/OLE2) -------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["umlaute_linux.doc", "umlaute_mac.doc",
+                                  "umlaute_windows.doc"])
+def test_doc(name):
+    _assert_umlauts(name)
+
+
+@pytest.mark.parametrize("name", ["umlaute_linux.xls", "umlaute_mac.xls",
+                                  "umlaute_windows.xls"])
+def test_xls(name):
+    _assert_umlauts(name)
+
+
+def test_xls_author_from_summary_information():
+    data = open(os.path.join(CORPUS, "umlaute_windows.xls"), "rb").read()
+    doc = parse_source("http://t/u.xls", None, data)[0]
+    assert doc.author == "afieg"      # xlsParserTest.java:30 expectation
+
+
+@pytest.mark.parametrize("name", ["umlaute_linux.ppt"])
+def test_ppt(name):
+    _assert_umlauts(name)
+
+
+def test_ppt_windows_has_slide_text():
+    # the windows ppt carries the sentence in slide bodies
+    text = _text_of("umlaute_windows.ppt")
+    assert "München" in text
+
+
+# -- modern office ------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", [
+    "umlaute_linux.odt", "umlaute_linux.ods", "umlaute_linux.odp",
+    "umlaute_linux.sxw", "umlaute_linux.sxc",
+    "umlaute_windows.docx", "umlaute_windows.xlsx",
+    "umlaute_windows.pptx", "umlaute_linux.ppsx",
+])
+def test_odf_ooxml(name):
+    _assert_umlauts(name)
+
+
+@pytest.mark.parametrize("name", ["umlaute_linux.rtf", "umlaute_mac.rtf",
+                                  "umlaute_windows_wordpad.rtf"])
+def test_rtf(name):
+    _assert_umlauts(name)
+
+
+# -- pdf ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["umlaute_linux.pdf",
+                                  "umlaute_windows.pdf",
+                                  "umlaute_mac_fromWord.pdf"])
+def test_pdf_cid_fonts(name):
+    """These PDFs use subset TrueType/CID fonts readable only through
+    their /ToUnicode CMaps (pdfParserTest.java parity)."""
+    _assert_umlauts(name)
+
+
+def test_pdf_title():
+    text = _text_of("umlaute_linux.pdf")
+    assert "Münchner Hofbräuhaus" in text     # /Info /Title
+
+
+def test_pdf_miktex_degraded_but_textful():
+    """TeX accent composition is a declared degradation: base letters
+    survive, combining accents don't."""
+    text = _text_of("umlaute_windows_miktex.pdf")
+    assert "unchen steht ein Hofbr" in text
+
+
+# -- postscript ---------------------------------------------------------
+
+
+def test_postscript():
+    text = _text_of("umlaute_linux.ps")
+    for w in SENTENCE_WORDS:
+        assert w in text
+    assert "test" in text             # %%Title
+
+
+# -- plain text encodings ------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["umlaute_linux.txt",
+                                  "umlaute_windows.txt",
+                                  "umlaute_mac.txt",      # MacRoman
+                                  "umlaute_mac.csv"])
+def test_text_encodings(name):
+    text = _text_of(name)
+    assert "München" in text, f"{name}: {text[:120]!r}"
+
+
+# -- html + xml ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["umlaute_html_iso.html",
+                                  "umlaute_html_utf8.html",
+                                  "umlaute_html_namedentities.html",
+                                  "umlaute_mac_fromWord.htm"])
+def test_html_encodings(name):
+    assert "München" in _text_of(name)
+
+
+@pytest.mark.parametrize("name", ["umlaute_dc_xml_iso.xml",
+                                  "umlaute_dc_xml_utf8.xml"])
+def test_dc_xml(name):
+    text = _text_of(name)
+    assert "üöä" in text or "XML test file" in text
+
+
+@pytest.mark.parametrize("name", ["umlaute_windows.vdx",
+                                  "umlaute_windows.vtx"])
+def test_visio_xml(name):
+    # XML visio containers parse as generic XML without erroring
+    assert _text_of(name)
+
+
+def test_visio_binary_degrades_gracefully():
+    # binary .vsd text lives LZW-ish compressed; declared degradation:
+    # must parse without error and without emitting binary garbage
+    data = open(os.path.join(CORPUS, "umlaute_windows.vsd"), "rb").read()
+    docs = parse_source("http://t/u.vsd", None, data)
+    text = docs[0].text
+    junk = sum(1 for c in text if ord(c) > 0x2500)
+    assert junk < len(text) * 0.05 + 5
+
+
+# -- archives -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", [
+    "umlaute_html_utf8.html.gz", "umlaute_html_utf8.html.bz2",
+    "umlaute_html_utf8.html.xz",
+    "umlaute_linux.txt.gz", "umlaute_linux.txt.bz2", "umlaute_linux.txt.xz",
+    "umlaute_html_xml_txt_gnu.tar", "umlaute_html_xml_txt_pax.tar",
+    "umlaute_html_xml_txt_ustar.tar", "umlaute_html_xml_txt_v7.tar",
+    "umlaute_html_xml_txt_gnu.tgz", "umlaute_html_xml_txt_gnu.tbz2",
+    "umlaute_html_xml_txt_gnu.txz",
+])
+def test_archives(name):
+    assert "München" in _text_of(name)
+
+
+# -- 7z (fixture built in-test: no corpus file, no 7z binary) -----------
+
+
+def _w7num(n: int) -> bytes:
+    assert n < 0x80
+    return bytes([n])
+
+
+def _make_7z(files: list[tuple[str, bytes]], lzma2: bool) -> bytes:
+    """Tiny single-folder 7z writer (Copy or LZMA2 coder) for testing the
+    reader; layout per 7zFormat.txt."""
+    blob = b"".join(d for _n, d in files)
+    if lzma2:
+        filt = [{"id": lzma.FILTER_LZMA2, "preset": 1}]
+        packed = lzma.compress(blob, format=lzma.FORMAT_RAW, filters=filt)
+        coder = bytes([1 | 0x20]) + b"\x21" + _w7num(1) + bytes([24])
+    else:
+        packed = blob
+        coder = bytes([1]) + b"\x00"
+
+    hdr = bytearray()
+    hdr += b"\x01"                                   # kHeader
+    hdr += b"\x04"                                   # kMainStreamsInfo
+    hdr += b"\x06" + _w7num(0) + _w7num(1)           # kPackInfo pos=0 n=1
+    hdr += b"\x09" + _w7num(len(packed)) + b"\x00"   # kSize, kEnd
+    hdr += b"\x07"                                   # kUnpackInfo
+    hdr += b"\x0b" + _w7num(1) + b"\x00"             # kFolder n=1 internal
+    hdr += _w7num(1) + coder                         # 1 coder
+    hdr += b"\x0c" + _w7num(len(blob)) + b"\x00"     # kCodersUnpackSize
+    hdr += b"\x08"                                   # kSubStreamsInfo
+    hdr += b"\x0d" + _w7num(len(files))              # kNumUnpackStream
+    if len(files) > 1:
+        hdr += b"\x09"                               # kSize (n-1 sizes)
+        for _n, d in files[:-1]:
+            hdr += _w7num(len(d))
+    hdr += b"\x00\x00"                               # end substreams+main
+    hdr += b"\x05" + _w7num(len(files))              # kFilesInfo
+    names = b"\x00" + b"".join(
+        n.encode("utf-16-le") + b"\x00\x00" for n, _d in files)
+    hdr += b"\x11" + _w7num(len(names)) + names      # kName
+    hdr += b"\x00\x00"                               # end files, end header
+
+    out = bytearray(b"7z\xbc\xaf\x27\x1c\x00\x04")
+    start = struct.pack("<QQI", len(packed), len(hdr),
+                        zlib.crc32(bytes(hdr)))
+    out += struct.pack("<I", zlib.crc32(start))
+    out += start
+    out += packed
+    out += hdr
+    return bytes(out)
+
+
+@pytest.mark.parametrize("lzma2", [False, True],
+                         ids=["copy-coder", "lzma2-coder"])
+def test_7z_archive(lzma2):
+    payload = "In München steht ein Hofbräuhaus".encode("utf-8")
+    html = b"<html><head><title>Seven</title></head>" \
+           b"<body>zip member body</body></html>"
+    data = _make_7z([("a.txt", payload), ("b.html", html)], lzma2)
+    docs = parse_source("http://t/test.7z", "application/x-7z-compressed",
+                        data)
+    text = "\n".join(d.title + "\n" + d.text for d in docs)
+    assert "München" in text
+    assert "zip member body" in text
+
+
+# -- images + exif ------------------------------------------------------
+
+
+def test_jpeg_exif_description():
+    text = _text_of("YaCyLogo_120ppi.jpg")
+    assert "YaCy Logo" in text        # EXIF ImageDescription
+
+
+def test_tiff_exif_description():
+    text = _text_of("YaCyLogo_120ppi.tif")
+    assert "YaCy Logo" in text
+
+
+def test_png_text_chunk_macroman():
+    text = _text_of("image_green_sd.png")
+    assert "München" in text          # GraphicConverter MacRoman comment
+
+
+# -- audio tags ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["umlaute_windows.mp3",
+                                  "umlaute_windows.ogg",
+                                  "umlaute_windows.flac",
+                                  "umlaute_windows.m4a"])
+def test_audio_tags_umlauts(name):
+    """audioTagParserTest.java parity: tag text carries the umlaut
+    sentence (album) and the title."""
+    text = _text_of(name)
+    assert "440Hz test tone" in text
+    assert "München" in text
+
+
+@pytest.mark.parametrize("name", ["umlaute_windows.wav",
+                                  "umlaute_windows.aiff"])
+def test_audio_tags_containers(name):
+    # RIFF INFO / AIFF chunks: ASCII-transliterated by the encoder, so
+    # assert tags rather than umlauts
+    text = _text_of(name)
+    assert "440Hz test tone" in text
+
+
+# -- review-fix regressions ---------------------------------------------
+
+
+def test_ps_no_text_raises_parsererror():
+    from yacy_search_server_tpu.document.parser.errors import ParserError
+    from yacy_search_server_tpu.document.parser.textparsers import parse_ps
+    with pytest.raises(ParserError):
+        parse_ps("http://t/x.ps", b"%!PS nothing here")
+
+
+def test_truncated_7z_raises_parsererror():
+    from yacy_search_server_tpu.document.parser.errors import ParserError
+    good = _make_7z([("a.txt", b"payload bytes here")], False)
+    with pytest.raises(ParserError):
+        parse_source("http://t/x.7z", "application/x-7z-compressed",
+                     good[:40])
+
+
+def test_pdf_text_survives_stray_delimiter():
+    from yacy_search_server_tpu.document.parser.pdfparser import parse_pdf
+    pdf = (b"%PDF-1.4\n1 0 obj\n<< /Length 60 >>\nstream\n"
+           b"BT (before) Tj ET ] BT (after) Tj ET\nendstream\nendobj\n%%EOF")
+    doc = parse_pdf("http://t/x.pdf", pdf)[0]
+    assert "before" in doc.text and "after" in doc.text
+
+
+def test_pdf_trailer_encryption_detected():
+    from yacy_search_server_tpu.document.parser.pdfparser import parse_pdf
+    pdf = (b"%PDF-1.4\n1 0 obj\n<< /Length 30 >>\nstream\n"
+           b"BT (ciphertext) Tj ET\nendstream\nendobj\n"
+           b"trailer\n<< /Size 5 /Encrypt 5 0 R /Root 1 0 R >>\n"
+           b"startxref\n0\n%%EOF")
+    doc = parse_pdf("http://t/x.pdf", pdf)[0]
+    assert doc.text == ""             # declared degradation, no garbage
+
+
+def test_https_error_none_on_healthy_server(tmp_path):
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    from yacy_search_server_tpu.switchboard import Switchboard
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"),
+                     transport=lambda u, h: (404, {}, b""))
+    srv = YaCyHttpServer(sb, port=0)
+    try:
+        assert srv.https_error is None
+    finally:
+        srv.close()
+        sb.close()

@@ -46,13 +46,8 @@ class Request:
                        appdate_s=float(d.get("appdate_s", 0.0)))
 
 
-# mime prefixes that the parser registry can turn into indexable documents
-_INDEXABLE_MIME_PREFIXES = (
-    "text/", "application/xhtml", "application/xml", "application/rss",
-    "application/atom", "application/json", "application/pdf",
-    "application/zip", "application/gzip", "application/x-tar",
-    "application/warc",
-)
+# WARC surrogates bypass the parser registry (importer-handled)
+_EXTRA_INDEXABLE_PREFIXES = ("application/warc",)
 
 
 @dataclass
@@ -90,7 +85,12 @@ class Response:
         if not self.content:
             return "empty content"
         mime = self.mime_type()
-        if mime and not any(mime.startswith(p)
-                            for p in _INDEXABLE_MIME_PREFIXES):
-            return f"unindexable mime {mime}"
+        if mime:
+            # the parser registry is the single authority on what can be
+            # turned into an indexable document (TextParser.supports)
+            from ..document.parser.registry import supports
+            if not supports(self.url, mime) and not any(
+                    mime.startswith(p)
+                    for p in _EXTRA_INDEXABLE_PREFIXES):
+                return f"unindexable mime {mime}"
         return None

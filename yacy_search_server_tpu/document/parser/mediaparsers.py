@@ -20,6 +20,13 @@ from .errors import ParserError
 
 # -- images ------------------------------------------------------------------
 
+def _text8(raw: bytes) -> str:
+    """8-bit text decode (shared utf-8 → MacRoman-heuristic → latin-1
+    cascade; see textparsers.decode8)."""
+    from .textparsers import decode8
+    return decode8(raw)
+
+
 def _png_info(content: bytes) -> tuple[int, int, dict]:
     w, h = struct.unpack(">II", content[16:24])
     texts: dict[str, str] = {}
@@ -30,8 +37,7 @@ def _png_info(content: bytes) -> tuple[int, int, dict]:
         if ctype == b"tEXt":
             data = content[off + 8:off + 8 + length]
             key, _, val = data.partition(b"\x00")
-            texts[key.decode("latin-1", "replace")] = \
-                val.decode("latin-1", "replace")
+            texts[key.decode("latin-1", "replace")] = _text8(val)
         off += 12 + length
         if ctype == b"IEND":
             break
@@ -58,7 +64,7 @@ def _jpeg_info(content: bytes) -> tuple[int, int, dict]:
             h, w = struct.unpack(">HH", seg[1:5])
             break
         if marker == 0xFE:                             # comment
-            texts["comment"] = seg.decode("latin-1", "replace").strip("\x00")
+            texts["comment"] = _text8(seg).strip("\x00")
         off += 2 + seglen
     return w, h, texts
 
@@ -68,14 +74,60 @@ def _gif_info(content: bytes) -> tuple[int, int, dict]:
     return w, h, {}
 
 
+# EXIF tag ids worth indexing (genericImageParser.java pulls the same
+# set through metadata-extractor)
+_EXIF_TAGS = {270: "description", 315: "artist", 306: "datetime",
+              271: "make", 272: "model", 305: "software"}
+
+
+def _exif_info(content: bytes) -> tuple[int, int, dict, float, float]:
+    """Dimensions + EXIF text fields + GPS position via PIL (jpeg/tiff)."""
+    import io
+
+    from PIL import Image
+    texts: dict[str, str] = {}
+    lat = lon = 0.0
+    with Image.open(io.BytesIO(content)) as im:
+        w, h = im.size
+        exif = im.getexif()
+        for tag, field in _EXIF_TAGS.items():
+            v = exif.get(tag)
+            if v:
+                texts[field] = str(v).strip()
+        try:
+            gps = exif.get_ifd(0x8825)      # GPS IFD
+            if gps and 2 in gps and 4 in gps:
+                def dms(v, ref, neg):
+                    deg = float(v[0]) + float(v[1]) / 60 + float(v[2]) / 3600
+                    return -deg if ref in neg else deg
+                lat = dms(gps[2], gps.get(1, "N"), ("S",))
+                lon = dms(gps[4], gps.get(3, "E"), ("W",))
+        except Exception:
+            pass
+    return w, h, texts, lat, lon
+
+
 def parse_image(url: str, content: bytes,
                 charset: str | None = None) -> list[Document]:
+    lat = lon = 0.0
     if content.startswith(b"\x89PNG\r\n\x1a\n"):
         w, h, texts = _png_info(content)
         mime = "image/png"
     elif content.startswith(b"\xff\xd8"):
         w, h, texts = _jpeg_info(content)
         mime = "image/jpeg"
+        try:
+            w2, h2, exif, lat, lon = _exif_info(content)
+            w, h = w or w2, h or h2
+            texts.update(exif)
+        except Exception:
+            pass
+    elif content[:4] in (b"II*\x00", b"MM\x00*"):      # TIFF
+        try:
+            w, h, texts, lat, lon = _exif_info(content)
+        except Exception as e:
+            raise ParserError(f"bad tiff: {e}") from e
+        mime = "image/tiff"
     elif content[:6] in (b"GIF87a", b"GIF89a"):
         w, h, texts = _gif_info(content)
         mime = "image/gif"
@@ -83,8 +135,12 @@ def parse_image(url: str, content: bytes,
         raise ParserError("unrecognized image container")
     name = url.rsplit("/", 1)[-1]
     parts = [name, f"{w}x{h}"] + [f"{k}: {v}" for k, v in texts.items()]
-    return [Document(url=url, mime_type=mime, title=name,
-                     text="\n".join(parts), doctype=DT_IMAGE)]
+    doc = Document(url=url, mime_type=mime,
+                   title=texts.get("description", name) or name,
+                   author=texts.get("artist", ""),
+                   text="\n".join(parts), doctype=DT_IMAGE,
+                   lat=lat, lon=lon)
+    return [doc]
 
 
 # -- audio (ID3) -------------------------------------------------------------
@@ -137,17 +193,170 @@ def _id3v1(content: bytes) -> dict:
         ("album", fld(63, 93)), ("year", fld(93, 97))) if v}
 
 
+_VORBIS_FIELDS = {"title": "title", "artist": "artist", "album": "album",
+                  "date": "year", "genre": "genre", "comment": "comment",
+                  "description": "comment"}
+
+
+def _vorbis_comments(block: bytes) -> dict:
+    """Vorbis comment structure (shared by Ogg Vorbis and FLAC)."""
+    out: dict[str, str] = {}
+    try:
+        (vlen,) = struct.unpack_from("<I", block, 0)
+        pos = 4 + vlen
+        (n,) = struct.unpack_from("<I", block, pos)
+        pos += 4
+        for _ in range(min(n, 64)):
+            (clen,) = struct.unpack_from("<I", block, pos)
+            pos += 4
+            entry = block[pos:pos + clen].decode("utf-8", "replace")
+            pos += clen
+            k, _, v = entry.partition("=")
+            field = _VORBIS_FIELDS.get(k.lower())
+            if field and v:
+                out.setdefault(field, v.strip())
+    except (struct.error, IndexError):
+        pass
+    return out
+
+
+def _ogg_tags(content: bytes) -> dict:
+    # the comment header packet starts with \x03vorbis (or OpusTags)
+    for marker, skip in ((b"\x03vorbis", 7), (b"OpusTags", 8)):
+        i = content.find(marker)
+        if i >= 0:
+            return _vorbis_comments(content[i + skip:])
+    return {}
+
+
+def _flac_tags(content: bytes) -> dict:
+    if not content.startswith(b"fLaC"):
+        return {}
+    pos = 4
+    while pos + 4 <= len(content):
+        header = content[pos]
+        btype, last = header & 0x7F, header & 0x80
+        blen = int.from_bytes(content[pos + 1:pos + 4], "big")
+        if btype == 4:          # VORBIS_COMMENT
+            return _vorbis_comments(content[pos + 4:pos + 4 + blen])
+        pos += 4 + blen
+        if last:
+            break
+    return {}
+
+
+_RIFF_INFO = {b"INAM": "title", b"IART": "artist", b"IPRD": "album",
+              b"ICMT": "comment", b"ICRD": "year", b"IGNR": "genre"}
+
+
+def _riff_tags(content: bytes) -> dict:
+    """WAV LIST/INFO chunks (+ an embedded id3 chunk when present)."""
+    out: dict[str, str] = {}
+    pos = 12
+    while pos + 8 <= len(content):
+        cid = content[pos:pos + 4]
+        (clen,) = struct.unpack_from("<I", content, pos + 4)
+        data = content[pos + 8:pos + 8 + clen]
+        if cid == b"LIST" and data[:4] == b"INFO":
+            ipos = 4
+            while ipos + 8 <= len(data):
+                fid = data[ipos:ipos + 4]
+                (flen,) = struct.unpack_from("<I", data, ipos + 4)
+                field = _RIFF_INFO.get(fid)
+                if field:
+                    out[field] = data[ipos + 8:ipos + 8 + flen].split(
+                        b"\0")[0].decode("utf-8", "replace").strip()
+                ipos += 8 + flen + (flen & 1)
+        elif cid in (b"id3 ", b"ID3 "):
+            for k, v in _id3v2(data).items():
+                out.setdefault(k, v)
+        pos += 8 + clen + (clen & 1)
+    return out
+
+
+_AIFF_TEXT = {b"NAME": "title", b"AUTH": "artist", b"ANNO": "comment"}
+
+
+def _aiff_tags(content: bytes) -> dict:
+    out: dict[str, str] = {}
+    pos = 12
+    while pos + 8 <= len(content):
+        cid = content[pos:pos + 4]
+        (clen,) = struct.unpack_from(">I", content, pos + 4)
+        data = content[pos + 8:pos + 8 + clen]
+        field = _AIFF_TEXT.get(cid)
+        if field:
+            out[field] = data.decode("utf-8", "replace").strip("\0 ")
+        elif cid in (b"ID3 ", b"id3 "):
+            for k, v in _id3v2(data).items():
+                out.setdefault(k, v)
+        pos += 8 + clen + (clen & 1)
+    return out
+
+
+_MP4_ITEMS = {b"\xa9nam": "title", b"\xa9ART": "artist",
+              b"\xa9alb": "album", b"\xa9day": "year",
+              b"\xa9cmt": "comment", b"\xa9gen": "genre"}
+
+
+def _mp4_tags(content: bytes) -> dict:
+    """MP4/M4A ilst metadata (moov > udta > meta > ilst walk)."""
+    out: dict[str, str] = {}
+
+    def walk(data: bytes, path: tuple, depth: int = 0) -> None:
+        if depth > 8:
+            return
+        pos = 0
+        while pos + 8 <= len(data):
+            (size,) = struct.unpack_from(">I", data, pos)
+            btype = data[pos + 4:pos + 8]
+            if size < 8:
+                break
+            body = data[pos + 8:pos + size]
+            if btype in (b"moov", b"udta", b"ilst", b"trak"):
+                walk(body, path + (btype,), depth + 1)
+            elif btype == b"meta":
+                walk(body[4:], path + (btype,), depth + 1)  # 4-byte version
+            elif btype in _MP4_ITEMS and path and path[-1] == b"ilst":
+                # contains a 'data' box: 8B header + 8B type/locale + value
+                if body[4:8] == b"data" and len(body) > 16:
+                    out[_MP4_ITEMS[btype]] = body[16:].decode(
+                        "utf-8", "replace").strip("\0 ")
+            pos += size
+    walk(content, ())
+    return out
+
+
 def parse_audio(url: str, content: bytes,
                 charset: str | None = None) -> list[Document]:
-    tags = _id3v2(content)
-    for k, v in _id3v1(content).items():
-        tags.setdefault(k, v)
+    """Tag extraction across the audio container zoo (reference:
+    audioTagParser.java via jaudiotagger — mp3/ogg/flac/wav/aiff/m4a)."""
+    mime = "audio/mpeg"
+    if content.startswith(b"OggS"):
+        tags = _ogg_tags(content)
+        mime = "audio/ogg"
+    elif content.startswith(b"fLaC"):
+        tags = _flac_tags(content)
+        mime = "audio/flac"
+    elif content.startswith(b"RIFF") and content[8:12] == b"WAVE":
+        tags = _riff_tags(content)
+        mime = "audio/x-wav"
+    elif content.startswith(b"FORM") and content[8:12] in (b"AIFF", b"AIFC"):
+        tags = _aiff_tags(content)
+        mime = "audio/x-aiff"
+    elif content[4:8] == b"ftyp":
+        tags = _mp4_tags(content)
+        mime = "audio/mp4"
+    else:
+        tags = _id3v2(content)
+        for k, v in _id3v1(content).items():
+            tags.setdefault(k, v)
     if not tags:
         raise ParserError("no audio tags found")
     name = url.rsplit("/", 1)[-1]
     title = tags.get("title") or name
     text = "\n".join(f"{k}: {v}" for k, v in tags.items())
-    return [Document(url=url, mime_type="audio/mpeg", title=title,
+    return [Document(url=url, mime_type=mime, title=title,
                      author=tags.get("artist", ""), text=text,
                      doctype=DT_AUDIO)]
 

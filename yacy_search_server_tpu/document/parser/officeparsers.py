@@ -123,27 +123,97 @@ _RTF_CONTROL = re.compile(rb"\\([a-z]{1,32})(-?\d{1,10})?[ ]?|\\'[0-9a-f]{2}"
                           rb"|\\[^a-z]|[{}]|\r|\n")
 
 
+# destination groups whose content is data, not document text
+_RTF_SKIP_DESTS = (b"fonttbl", b"colortbl", b"stylesheet", b"info",
+                   b"pict", b"themedata", b"colorschememapping",
+                   b"latentstyles", b"datastore", b"generator",
+                   b"listtable", b"listoverridetable", b"rsidtbl",
+                   b"xmlnstbl", b"operator", b"header", b"footer")
+_RTF_DEST_RE = re.compile(
+    rb"{\\\*?\\?(" + b"|".join(_RTF_SKIP_DESTS) + rb")\b")
+
+
+def _rtf_strip_destinations(content: bytes) -> bytes:
+    """Remove skippable destination groups with real brace matching
+    (nested groups defeat any single regex)."""
+    out = bytearray()
+    pos = 0
+    while True:
+        m = _RTF_DEST_RE.search(content, pos)
+        if m is None:
+            out += content[pos:]
+            return bytes(out)
+        out += content[pos:m.start()]
+        depth = 0
+        i = m.start()
+        while i < len(content):
+            c = content[i]
+            if c == 0x7B and (i == 0 or content[i - 1] != 0x5C):
+                depth += 1
+            elif c == 0x7D and content[i - 1] != 0x5C:
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        pos = i + 1
+
+
 def parse_rtf(url: str, content: bytes,
               charset: str | None = None) -> list[Document]:
     if not content.startswith(b"{\\rtf"):
         raise ParserError("not an rtf file")
-    # drop binary/skippable groups (fonttbl, pict, stylesheet...)
-    body = re.sub(rb"{\\(?:fonttbl|colortbl|stylesheet|info|pict)[^{}]*(?:{[^{}]*})*[^{}]*}",
-                  b" ", content)
+    # the declared codepage governs \'xx byte escapes: \ansicpgNNNN
+    # (10000 = MacRoman), bare \mac, else cp1252
+    codec = "cp1252"
+    m = re.search(rb"\\ansicpg(\d+)", content[:256])
+    if m:
+        cpg = int(m.group(1))
+        codec = "mac_roman" if cpg == 10000 else f"cp{cpg}"
+    elif re.search(rb"\\mac\b", content[:64]):
+        codec = "mac_roman"
+    body = _rtf_strip_destinations(content)
 
-    def repl(m: re.Match) -> bytes:
+    # single decoding pass over tokens: \'xx bytes decode via the
+    # document codec, \uN emits the code point and SKIPS the following
+    # \ucN fallback items (chars or \'xx escapes) per the RTF spec
+    parts: list[str] = []
+    uc_skip = 1     # current \ucN value (default 1)
+    pending_skip = 0
+    pos = 0
+    for m in _RTF_CONTROL.finditer(body):
+        gap = body[pos:m.start()]
+        if gap:
+            if pending_skip:
+                skip = min(pending_skip, len(gap))
+                gap = gap[skip:]
+                pending_skip -= skip
+            if gap:
+                parts.append(gap.decode("ascii", "replace"))
+        pos = m.end()
         tok = m.group(0)
         if tok.startswith(b"\\'"):
+            if pending_skip:
+                pending_skip -= 1
+                continue
             try:
-                return bytes([int(tok[2:], 16)])
-            except ValueError:
-                return b""
-        if m.group(1) in (b"par", b"line", b"tab", b"sect", b"page"):
-            return b"\n"
-        return b""
-
-    raw = _RTF_CONTROL.sub(repl, body)
-    text = re.sub(r"[ \t]+", " ", raw.decode(charset or "latin-1", "replace")).strip()
+                parts.append(bytes([int(tok[2:], 16)]).decode(
+                    codec, "replace"))
+            except (ValueError, LookupError):
+                pass
+            continue
+        word, num = m.group(1), m.group(2)
+        if word == b"u" and num:
+            cp = int(num)
+            parts.append(chr(cp + 65536 if cp < 0 else cp))
+            pending_skip = uc_skip
+        elif word == b"uc" and num:
+            uc_skip = int(num)
+        elif word in (b"par", b"line", b"tab", b"sect", b"page"):
+            parts.append("\n")
+    tail = body[pos:]
+    if tail:
+        parts.append(tail.decode("ascii", "replace"))
+    text = re.sub(r"[ \t]+", " ", "".join(parts)).strip()
     if not text:
         raise ParserError("empty rtf document")
     return [Document(url=url, mime_type="application/rtf",

@@ -25,7 +25,9 @@ from .htmlparser import parse_html
 from .pdfparser import parse_pdf
 from .mediaparsers import parse_audio, parse_image, parse_torrent
 from .officeparsers import parse_epub, parse_odf, parse_ooxml, parse_rtf
-from .textparsers import parse_csv, parse_json, parse_text, parse_vcf
+from .oleparsers import parse_doc, parse_ole, parse_ppt, parse_xls
+from .textparsers import parse_csv, parse_json, parse_ps, parse_text, \
+    parse_vcf
 from .xmlparsers import is_feed, parse_feed, parse_generic_xml
 
 MAX_ARCHIVE_MEMBERS = 200
@@ -68,10 +70,24 @@ _MIME_PARSERS = {
     "application/vnd.oasis.opendocument.presentation": parse_odf,
     "application/rtf": parse_rtf, "text/rtf": parse_rtf,
     "application/epub+zip": parse_epub,
+    # legacy binary office (OLE2/CFB containers)
+    "application/msword": parse_doc,
+    "application/vnd.ms-excel": parse_xls, "application/msexcel": parse_xls,
+    "application/vnd.ms-powerpoint": parse_ppt,
+    "application/mspowerpoint": parse_ppt,
+    "application/vnd.visio": parse_ole,
+    # OpenOffice 1.x (same zip/content.xml shape as ODF)
+    "application/vnd.sun.xml.writer": parse_odf,
+    # postscript
+    "application/postscript": parse_ps,
     # media
     "image/png": parse_image, "image/jpeg": parse_image,
-    "image/gif": parse_image,
+    "image/gif": parse_image, "image/tiff": parse_image,
     "audio/mpeg": parse_audio, "audio/mp3": parse_audio,
+    "audio/ogg": parse_audio, "application/ogg": parse_audio,
+    "audio/flac": parse_audio, "audio/x-flac": parse_audio,
+    "audio/x-wav": parse_audio, "audio/wav": parse_audio,
+    "audio/x-aiff": parse_audio, "audio/mp4": parse_audio,
     "application/x-bittorrent": parse_torrent,
 }
 
@@ -82,19 +98,28 @@ _EXT_PARSERS = {
     "pdf": parse_pdf, "xml": parse_generic_xml,
     "rss": parse_feed, "atom": parse_feed,
     "docx": parse_ooxml, "xlsx": parse_ooxml, "pptx": parse_ooxml,
+    "ppsx": parse_ooxml,
     "odt": parse_odf, "ods": parse_odf, "odp": parse_odf,
+    "sxw": parse_odf, "sxc": parse_odf, "sxi": parse_odf,
     "rtf": parse_rtf, "epub": parse_epub,
+    "doc": parse_doc, "xls": parse_xls, "ppt": parse_ppt, "pps": parse_ppt,
+    "vsd": parse_ole, "vst": parse_ole,
+    "vdx": parse_generic_xml, "vtx": parse_generic_xml,
+    "ps": parse_ps,
     "png": parse_image, "jpg": parse_image, "jpeg": parse_image,
-    "gif": parse_image,
-    "mp3": parse_audio,
+    "gif": parse_image, "tif": parse_image, "tiff": parse_image,
+    "mp3": parse_audio, "ogg": parse_audio, "oga": parse_audio,
+    "flac": parse_audio, "wav": parse_audio, "aiff": parse_audio,
+    "aif": parse_audio, "m4a": parse_audio,
     "torrent": parse_torrent,
 }
 
 _ARCHIVE_MIMES = {"application/zip", "application/x-zip-compressed",
                   "application/gzip", "application/x-gzip",
                   "application/x-tar", "application/x-bzip2",
-                  "application/x-xz"}
-_ARCHIVE_EXTS = {"zip", "gz", "tgz", "tar", "bz2", "xz", "7z"}
+                  "application/x-xz", "application/x-7z-compressed"}
+_ARCHIVE_EXTS = {"zip", "gz", "tgz", "tbz2", "txz", "tar", "bz2", "xz",
+                 "7z"}
 
 
 def supported_mime(mime: str) -> bool:
@@ -130,10 +155,12 @@ def _parse_archive(url: str, mime: str, content: bytes, charset,
                     recurse(info.filename, zf.read(info))
         except zipfile.BadZipFile as e:
             raise ParserError(f"bad zip: {e}") from e
-    elif mime in ("application/x-tar",) or ext in ("tar", "tgz") or \
-            (ext == "gz" and url.endswith(".tar.gz")):
+    elif mime in ("application/x-tar",) or \
+            ext in ("tar", "tgz", "tbz2", "txz") or \
+            url.endswith((".tar.gz", ".tar.bz2", ".tar.xz")):
         try:
-            with tarfile.open(fileobj=io.BytesIO(content)) as tf:
+            # mode r:* lets tarfile undo the gz/bz2/xz layer itself
+            with tarfile.open(fileobj=io.BytesIO(content), mode="r:*") as tf:
                 for member in tf.getmembers()[:MAX_ARCHIVE_MEMBERS]:
                     if not member.isfile():
                         continue
@@ -160,6 +187,18 @@ def _parse_archive(url: str, mime: str, content: bytes, charset,
         except lzma.LZMAError as e:
             raise ParserError(f"bad xz: {e}") from e
         recurse(os.path.basename(urlsplit(url).path)[:-3] or "member", inner)
+    elif mime == "application/x-7z-compressed" or ext == "7z":
+        import lzma as _lzma
+        import struct as _struct
+
+        from .sevenzip import SevenZip
+        try:
+            members = SevenZip(content).files[:MAX_ARCHIVE_MEMBERS]
+        except (IndexError, ValueError, _struct.error,
+                _lzma.LZMAError) as e:
+            raise ParserError(f"bad 7z: {e}") from e
+        for name, data in members:
+            recurse(name, data)
     else:
         raise ParserError(f"unsupported archive {mime or ext}")
     return docs
@@ -187,6 +226,8 @@ def _parse(url: str, mime: str | None, content: bytes,
             parser = parse_html
         elif head.startswith(b"%pdf"):
             parser = parse_pdf
+        elif content.startswith(b"\xd0\xcf\x11\xe0"):   # OLE2/CFB
+            parser = parse_ole
         elif head.startswith(b"<?xml"):
             parser = parse_feed if is_feed(content) else parse_generic_xml
         else:

@@ -1,0 +1,310 @@
+"""7z archive reader — pure Python over the lzma module's raw decoders.
+
+Capability equivalent of the reference's sevenzipParser (reference:
+source/net/yacy/document/parser/sevenzipParser.java via the bundled
+J7Zip java port). The container format ([7zFormat.txt]) is parsed
+directly: signature + start header, (possibly LZMA-compressed) metadata
+header, pack/unpack stream info, folders with a single coder each, and
+file names from the FilesInfo block. Supported coders: Copy, LZMA1,
+LZMA2 — which covers archives produced by default 7z/p7zip settings.
+Multi-coder chains (BCJ2, delta, AES) raise ParserError (declared
+degradation; the reference's java port had similar limits)."""
+
+from __future__ import annotations
+
+import io
+import lzma
+import struct
+
+from .errors import ParserError
+
+_MAGIC = b"7z\xbc\xaf\x27\x1c"
+
+# property ids
+K_END = 0x00
+K_HEADER = 0x01
+K_MAIN_STREAMS = 0x04
+K_FILES_INFO = 0x05
+K_PACK_INFO = 0x06
+K_UNPACK_INFO = 0x07
+K_SUBSTREAMS = 0x08
+K_SIZE = 0x09
+K_CRC = 0x0A
+K_FOLDER = 0x0B
+K_UNPACK_SIZE = 0x0C
+K_NUM_UNPACK_STREAM = 0x0D
+K_EMPTY_STREAM = 0x0E
+K_EMPTY_FILE = 0x0F
+K_NAME = 0x11
+K_ENCODED_HEADER = 0x17
+K_DUMMY = 0x19
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.pos = 0
+
+    def byte(self) -> int:
+        b = self.d[self.pos]
+        self.pos += 1
+        return b
+
+    def bytes(self, n: int) -> bytes:
+        out = self.d[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def number(self) -> int:
+        """7z variable-length number."""
+        first = self.byte()
+        mask = 0x80
+        value = 0
+        for i in range(8):
+            if not (first & mask):
+                value |= (first & (mask - 1)) << (8 * i)
+                return value
+            value |= self.byte() << (8 * i)
+            mask >>= 1
+        return value
+
+    def bits(self, n: int) -> list[bool]:
+        out: list[bool] = []
+        b = 0
+        mask = 0
+        for _ in range(n):
+            if mask == 0:
+                b = self.byte()
+                mask = 0x80
+            out.append(bool(b & mask))
+            mask >>= 1
+        return out
+
+    def bool_vector(self, n: int) -> list[bool]:
+        all_defined = self.byte()
+        return [True] * n if all_defined else self.bits(n)
+
+
+class _Folder:
+    def __init__(self):
+        self.coder_id = b""
+        self.props = b""
+        self.unpack_sizes: list[int] = []
+        self.num_unpack_streams = 1
+
+    @property
+    def unpack_size(self) -> int:
+        return self.unpack_sizes[-1] if self.unpack_sizes else 0
+
+    def decode(self, packed: bytes) -> bytes:
+        cid = self.coder_id
+        if cid == b"\x00":                 # Copy
+            return packed[:self.unpack_size]
+        if cid == b"\x21":                 # LZMA2
+            dec = lzma.LZMADecompressor(
+                format=lzma.FORMAT_RAW,
+                filters=[{"id": lzma.FILTER_LZMA2,
+                          "dict_size": _lzma2_dict(self.props)}])
+            return dec.decompress(packed, self.unpack_size)
+        if cid == b"\x03\x01\x01":         # LZMA1
+            if len(self.props) < 5:
+                raise ParserError("7z: bad lzma props")
+            prop = self.props[0]
+            lc, rem = prop % 9, prop // 9
+            lp, pb = rem % 5, rem // 5
+            dict_size = struct.unpack("<I", self.props[1:5])[0]
+            dec = lzma.LZMADecompressor(
+                format=lzma.FORMAT_RAW,
+                filters=[{"id": lzma.FILTER_LZMA1, "lc": lc, "lp": lp,
+                          "pb": pb, "dict_size": max(dict_size, 4096)}])
+            return dec.decompress(packed, self.unpack_size)
+        raise ParserError(f"7z: unsupported coder {cid.hex()}")
+
+
+def _lzma2_dict(props: bytes) -> int:
+    if not props:
+        return 1 << 24
+    v = props[0]
+    if v > 40:
+        return 1 << 26
+    if v == 40:
+        return 0xFFFFFFFF
+    return (2 | (v & 1)) << (v // 2 + 11)
+
+
+class SevenZip:
+    """Parsed archive: .files is a list of (name, data)."""
+
+    def __init__(self, data: bytes):
+        if not data.startswith(_MAGIC):
+            raise ParserError("not a 7z archive")
+        next_off, next_size = struct.unpack_from("<QQ", data, 12)
+        header = data[32 + next_off:32 + next_off + next_size]
+        if not header:
+            raise ParserError("7z: empty header")
+        self.data = data
+        r = _Reader(header)
+        tid = r.byte()
+        if tid == K_ENCODED_HEADER:
+            header = self._decode_encoded_header(r)
+            r = _Reader(header)
+            tid = r.byte()
+        if tid != K_HEADER:
+            raise ParserError("7z: no header")
+        self.files: list[tuple[str, bytes]] = []
+        self._parse_header(r)
+
+    # -- metadata parsing ----------------------------------------------------
+
+    def _read_streams_info(self, r: _Reader):
+        pack_pos = 0
+        pack_sizes: list[int] = []
+        folders: list[_Folder] = []
+        substream_counts: list[int] = []
+        substream_sizes: list[int] = []
+        while True:
+            tid = r.byte()
+            if tid == K_END:
+                break
+            if tid == K_PACK_INFO:
+                pack_pos = r.number()
+                num_pack = r.number()
+                while True:
+                    sub = r.byte()
+                    if sub == K_END:
+                        break
+                    if sub == K_SIZE:
+                        pack_sizes = [r.number() for _ in range(num_pack)]
+                    elif sub == K_CRC:
+                        defined = r.bool_vector(num_pack)
+                        r.bytes(4 * sum(defined))
+                    else:
+                        raise ParserError("7z: bad packinfo")
+            elif tid == K_UNPACK_INFO:
+                if r.byte() != K_FOLDER:
+                    raise ParserError("7z: expected folder")
+                num_folders = r.number()
+                external = r.byte()
+                if external:
+                    raise ParserError("7z: external folders unsupported")
+                for _ in range(num_folders):
+                    folders.append(self._read_folder(r))
+                if r.byte() != K_UNPACK_SIZE:
+                    raise ParserError("7z: expected unpack sizes")
+                for f in folders:
+                    f.unpack_sizes = [r.number()
+                                      for _ in range(f._num_out_streams)]
+                while True:
+                    sub = r.byte()
+                    if sub == K_END:
+                        break
+                    if sub == K_CRC:
+                        defined = r.bool_vector(num_folders)
+                        r.bytes(4 * sum(defined))
+            elif tid == K_SUBSTREAMS:
+                while True:
+                    sub = r.byte()
+                    if sub == K_END:
+                        break
+                    if sub == K_NUM_UNPACK_STREAM:
+                        substream_counts = [r.number() for _ in folders]
+                    elif sub == K_SIZE:
+                        for i, f in enumerate(folders):
+                            n = (substream_counts[i]
+                                 if substream_counts else 1)
+                            sizes = [r.number() for _ in range(n - 1)]
+                            sizes.append(f.unpack_size - sum(sizes))
+                            substream_sizes.extend(sizes)
+                    elif sub == K_CRC:
+                        total = (sum(substream_counts)
+                                 if substream_counts else len(folders))
+                        defined = r.bool_vector(total)
+                        r.bytes(4 * sum(defined))
+            else:
+                raise ParserError(f"7z: unexpected id {tid}")
+        return pack_pos, pack_sizes, folders, substream_counts, \
+            substream_sizes
+
+    def _read_folder(self, r: _Reader) -> _Folder:
+        f = _Folder()
+        num_coders = r.number()
+        if num_coders != 1:
+            raise ParserError("7z: multi-coder folders unsupported")
+        flags = r.byte()
+        id_size = flags & 0x0F
+        f.coder_id = r.bytes(id_size)
+        f._num_out_streams = 1
+        if flags & 0x10:     # complex coder
+            raise ParserError("7z: complex coders unsupported")
+        if flags & 0x20:     # attributes
+            psize = r.number()
+            f.props = r.bytes(psize)
+        return f
+
+    def _decode_encoded_header(self, r: _Reader) -> bytes:
+        (pack_pos, pack_sizes, folders,
+         _counts, _sizes) = self._read_streams_info(r)
+        if not folders or not pack_sizes:
+            raise ParserError("7z: bad encoded header")
+        off = 32 + pack_pos
+        packed = self.data[off:off + pack_sizes[0]]
+        return folders[0].decode(packed)
+
+    def _parse_header(self, r: _Reader) -> None:
+        pack_pos = 0
+        pack_sizes: list[int] = []
+        folders: list[_Folder] = []
+        counts: list[int] = []
+        sizes: list[int] = []
+        names: list[str] = []
+        empty_streams: list[bool] = []
+        while r.pos < len(r.d):
+            tid = r.byte()
+            if tid == K_END:
+                break
+            if tid == K_MAIN_STREAMS:
+                (pack_pos, pack_sizes, folders,
+                 counts, sizes) = self._read_streams_info(r)
+            elif tid == K_FILES_INFO:
+                num_files = r.number()
+                while True:
+                    ptype = r.byte()
+                    if ptype == K_END:
+                        break
+                    psize = r.number()
+                    payload = _Reader(r.bytes(psize))
+                    if ptype == K_NAME:
+                        ext = payload.byte()
+                        if not ext:
+                            raw = payload.d[payload.pos:]
+                            names = [n for n in
+                                     raw.decode("utf-16-le", "replace")
+                                     .split("\0") if n]
+                    elif ptype == K_EMPTY_STREAM:
+                        empty_streams = payload.bits(num_files)
+            else:
+                # skip unknown top-level block
+                psize = r.number()
+                r.bytes(psize)
+
+        # decode folders into one contiguous unpacked pool
+        pool = io.BytesIO()
+        off = 32 + pack_pos
+        for i, f in enumerate(folders):
+            size = pack_sizes[i] if i < len(pack_sizes) else 0
+            pool.write(f.decode(self.data[off:off + size]))
+            off += size
+        blob = pool.getvalue()
+
+        # split into substreams and pair with non-empty-stream names
+        if not sizes:
+            sizes = [f.unpack_size for f in folders]
+        content_names = [n for j, n in enumerate(names)
+                         if not (empty_streams and j < len(empty_streams)
+                                 and empty_streams[j])]
+        pos = 0
+        for i, size in enumerate(sizes):
+            name = content_names[i] if i < len(content_names) \
+                else f"member{i}"
+            self.files.append((name, blob[pos:pos + size]))
+            pos += size

@@ -12,17 +12,34 @@ import io
 import json
 
 from ..document import Document
+from .errors import ParserError
+
+
+def decode8(content: bytes) -> str:
+    """Charset-less 8-bit text decode: utf-8, then the MacRoman
+    heuristic (bytes in 0x80-0x9F are C1 controls in latin-1 but letters
+    in MacRoman — classic Mac text like the reference corpus's
+    umlaute_mac.* files decodes wrong without this), then latin-1.
+    Shared by the text parsers and the media parsers' comment fields."""
+    try:
+        return content.decode("utf-8")
+    except UnicodeDecodeError:
+        pass
+    if any(0x80 <= b <= 0x9F for b in content[:4096]):
+        try:
+            return content.decode("mac_roman")
+        except UnicodeDecodeError:
+            pass
+    return content.decode("latin-1", "replace")
 
 
 def _decode(content: bytes, charset: str | None) -> str:
-    for cs in (charset, "utf-8", "latin-1"):
-        if not cs:
-            continue
+    if charset:
         try:
-            return content.decode(cs)
+            return content.decode(charset)
         except (UnicodeDecodeError, LookupError):
-            continue
-    return content.decode("utf-8", "replace")
+            pass
+    return decode8(content)
 
 
 def parse_text(url: str, content: bytes,
@@ -90,3 +107,42 @@ def parse_vcf(url: str, content: bytes,
             lines.append(value.replace(";", " ").strip())
     return [Document(url=url, mime_type="text/vcard",
                      title=names[0] if names else "", text=" ".join(lines))]
+
+
+_PS_HEX_SHOW_RE = None
+_PS_LIT_SHOW_RE = None
+
+
+def parse_ps(url: str, content: bytes,
+             charset: str | None = None) -> list[Document]:
+    """PostScript text extraction (reference: psParser.java — a token
+    scanner for show-family operators). Collects literal and hex string
+    operands of show/xshow/ashow/widthshow/bshow/bxshow plus the DSC
+    %%Title comment; glyphs are latin-1 in the common generator output."""
+    global _PS_HEX_SHOW_RE, _PS_LIT_SHOW_RE
+    import re as _re
+    if _PS_HEX_SHOW_RE is None:
+        # hex string, optionally followed by a widths array, then a
+        # show-family operator
+        _PS_HEX_SHOW_RE = _re.compile(
+            rb"<([0-9A-Fa-f\s]+)>\s*(?:\[[-\d\s.]*\]\s*)?"
+            rb"(?:x|a|width|b|bx)?show\b", _re.DOTALL)
+        _PS_LIT_SHOW_RE = _re.compile(
+            rb"\(((?:\\.|[^()\\])*)\)\s*(?:\[[-\d\s.]*\]\s*)?"
+            rb"(?:x|a|width|b|bx)?show\b", _re.DOTALL)
+    parts: list[str] = []
+    for m in _PS_HEX_SHOW_RE.finditer(content):
+        hexs = _re.sub(rb"\s", b"", m.group(1))
+        if len(hexs) % 2:
+            hexs += b"0"
+        parts.append(bytes.fromhex(hexs.decode("ascii"))
+                     .decode("latin-1", "replace"))
+    for m in _PS_LIT_SHOW_RE.finditer(content):
+        parts.append(m.group(1).decode("latin-1", "replace"))
+    tm = _re.search(rb"%%Title:\s*\(?([^)\r\n]*)", content)
+    title = tm.group(1).decode("latin-1", "replace").strip() if tm else ""
+    text = "\n".join(p.strip() for p in parts if p.strip())
+    if not text and not title:
+        raise ParserError("ps: no text recovered")
+    return [Document(url=url, mime_type="application/postscript",
+                     title=title, text=text)]

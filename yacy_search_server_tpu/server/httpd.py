@@ -96,6 +96,7 @@ class YaCyHttpServer:
         # listeners share the one Handler/dispatch.
         self.httpsd = None
         self.https_port = None
+        self.https_error: str | None = None
         self._https_thread: threading.Thread | None = None
         cfg = sb.config
         from_config = https_port is None
@@ -151,12 +152,16 @@ class YaCyHttpServer:
         return self
 
     def close(self) -> None:
-        self.httpd.shutdown()
+        # shutdown() blocks on the serve_forever loop acknowledging — it
+        # must only run when that loop was actually started
+        if self._thread:
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
         if self.httpsd is not None:
-            self.httpsd.shutdown()
+            if self._https_thread:
+                self.httpsd.shutdown()
             self.httpsd.server_close()
             if self._https_thread:
                 self._https_thread.join(timeout=5)
