@@ -30,7 +30,9 @@ from ..document import DT_PDF, Document
 from .errors import ParserError
 
 _OBJ_RE = re.compile(rb"(\d+)\s+(\d+)\s+obj\b(.*?)endobj", re.DOTALL)
-_STREAM_RE = re.compile(rb"stream\r?\n?", re.DOTALL)
+# the stream keyword always follows the stream dict's closing ">>" — a
+# bare "stream" substring may occur inside string values ("Upstream")
+_STREAM_RE = re.compile(rb"(>>)\s*stream\r?\n?", re.DOTALL)
 
 _ESCAPES = {b"n": b"\n", b"r": b"\r", b"t": b"\t", b"b": b"\b",
             b"f": b"\f", b"(": b"(", b")": b")", b"\\": b"\\"}
@@ -235,7 +237,7 @@ class _Pdf:
             end = stream.rfind(b"endstream")
             if end >= 0:
                 stream = stream[:end].rstrip(b"\r\n")
-            body = body[:sm.start()]
+            body = body[:sm.end(1)]      # keep the dict's ">>"
         lex = _Lexer(body)
         val = lex.parse()
         return (body, val if isinstance(val, (dict, list)) else val, stream)
@@ -569,8 +571,11 @@ def parse_pdf(url: str, content: bytes, charset=None) -> list[Document]:
     title = author = subject = keywords = ""
     for entry in pdf.objects.values():
         d = entry[1]
+        # outline (bookmark) items also carry /Title but have tree links
+        # (/Parent /Next /First) — they must not clobber the /Info dict
         if isinstance(d, dict) and ("Title" in d or "Author" in d) \
-                and "Type" not in d and "Subtype" not in d:
+                and "Type" not in d and "Subtype" not in d \
+                and not ({"Parent", "Next", "First", "Prev", "Dest"} & d.keys()):
             title = _info_str(pdf.resolve(d.get("Title"))) or title
             author = _info_str(pdf.resolve(d.get("Author"))) or author
             subject = _info_str(pdf.resolve(d.get("Subject"))) or subject

@@ -109,27 +109,25 @@ def parse_vcf(url: str, content: bytes,
                      title=names[0] if names else "", text=" ".join(lines))]
 
 
-_PS_HEX_SHOW_RE = None
-_PS_LIT_SHOW_RE = None
+import re as _re
+
+# hex or literal string operand, optionally followed by a widths array,
+# then a show-family operator (show/xshow/ashow/widthshow/bshow/bxshow)
+_PS_HEX_SHOW_RE = _re.compile(
+    rb"<([0-9A-Fa-f\s]+)>\s*(?:\[[-\d\s.]*\]\s*)?"
+    rb"(?:x|a|width|b|bx)?show\b", _re.DOTALL)
+_PS_LIT_SHOW_RE = _re.compile(
+    rb"\(((?:\\.|[^()\\])*)\)\s*(?:\[[-\d\s.]*\]\s*)?"
+    rb"(?:x|a|width|b|bx)?show\b", _re.DOTALL)
+_PS_TITLE_RE = _re.compile(rb"%%Title:\s*\(?([^)\r\n]*)")
 
 
 def parse_ps(url: str, content: bytes,
              charset: str | None = None) -> list[Document]:
     """PostScript text extraction (reference: psParser.java — a token
     scanner for show-family operators). Collects literal and hex string
-    operands of show/xshow/ashow/widthshow/bshow/bxshow plus the DSC
-    %%Title comment; glyphs are latin-1 in the common generator output."""
-    global _PS_HEX_SHOW_RE, _PS_LIT_SHOW_RE
-    import re as _re
-    if _PS_HEX_SHOW_RE is None:
-        # hex string, optionally followed by a widths array, then a
-        # show-family operator
-        _PS_HEX_SHOW_RE = _re.compile(
-            rb"<([0-9A-Fa-f\s]+)>\s*(?:\[[-\d\s.]*\]\s*)?"
-            rb"(?:x|a|width|b|bx)?show\b", _re.DOTALL)
-        _PS_LIT_SHOW_RE = _re.compile(
-            rb"\(((?:\\.|[^()\\])*)\)\s*(?:\[[-\d\s.]*\]\s*)?"
-            rb"(?:x|a|width|b|bx)?show\b", _re.DOTALL)
+    operands of the show family plus the DSC %%Title comment; glyphs are
+    latin-1 in the common generator output."""
     parts: list[str] = []
     for m in _PS_HEX_SHOW_RE.finditer(content):
         hexs = _re.sub(rb"\s", b"", m.group(1))
@@ -139,7 +137,7 @@ def parse_ps(url: str, content: bytes,
                      .decode("latin-1", "replace"))
     for m in _PS_LIT_SHOW_RE.finditer(content):
         parts.append(m.group(1).decode("latin-1", "replace"))
-    tm = _re.search(rb"%%Title:\s*\(?([^)\r\n]*)", content)
+    tm = _PS_TITLE_RE.search(content)
     title = tm.group(1).decode("latin-1", "replace").strip() if tm else ""
     text = "\n".join(p.strip() for p in parts if p.strip())
     if not text and not title:

@@ -13,7 +13,7 @@ reference's security handler does by path.
 from __future__ import annotations
 
 from ...utils.hashes import url2hash, word2hash
-from ..objects import ServerObjects, escape_json
+from ..objects import ServerObjects, escape_html, escape_json
 from . import servlet
 
 
@@ -211,3 +211,376 @@ def respond_hostbrowser(header: dict, post: ServerObjects, sb) -> ServerObjects:
             prop.put(f"files_{i}_url", escape_json(u))
             prop.put(f"files_{i}_eol", 1 if i < len(urls) - 1 else 0)
     return prop
+
+
+# -- round-2 surface sweep (VERDICT r1 #10) ------------------------------
+
+
+@servlet("Ranking_p")
+def respond_ranking(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Ranking coefficient editor wired to RankingProfile (reference:
+    htroot/Ranking_p.java — the 32 shift coefficients, persisted into
+    config and applied to every subsequent search)."""
+    from dataclasses import fields
+
+    from ...ops.ranking import RankingProfile
+    prop = ServerObjects()
+    current = RankingProfile()
+    ext = sb.config.get("rankingProfile.default", "")
+    if ext:
+        try:
+            current = RankingProfile.from_external_string(ext)
+        except (ValueError, KeyError):
+            pass
+    if post.get("reset"):
+        sb.config.set("rankingProfile.default", "")
+        current = RankingProfile()
+        prop.put("saved", 1)
+    elif post.get("save"):
+        for f in fields(current):
+            v = post.get(f"coeff_{f.name}", "")
+            if v != "":
+                try:
+                    setattr(current, f.name,
+                            max(0, min(15, int(v))))
+                except ValueError:
+                    pass
+        sb.config.set("rankingProfile.default",
+                      current.to_external_string())
+        prop.put("saved", 1)
+    coeffs = [(f.name, getattr(current, f.name))
+              for f in fields(current)]
+    prop.put("coeffs", len(coeffs))
+    for i, (name, val) in enumerate(coeffs):
+        prop.put(f"coeffs_{i}_name", name)
+        prop.put(f"coeffs_{i}_value", val)
+        prop.put(f"coeffs_{i}_eol", 1 if i < len(coeffs) - 1 else 0)
+    return prop
+
+
+@servlet("ConfigNetwork_p")
+def respond_confignetwork(header: dict, post: ServerObjects,
+                          sb) -> ServerObjects:
+    """Network-unit selection (reference: htroot/ConfigNetwork_p.java —
+    switching the network definition re-wires DHT + crawl behavior)."""
+    from ...utils.config import NETWORK_UNITS
+    prop = ServerObjects()
+    want = post.get("unit", "").strip()
+    if want:
+        node = getattr(sb, "node", None)
+        try:
+            if node is not None:
+                node.switch_network(want)
+            elif want not in NETWORK_UNITS:
+                raise ValueError(want)
+            sb.config.set("network.unit.name", want)
+            prop.put("switched", 1)
+        except ValueError as e:
+            prop.put("error", escape_html(str(e)))
+    current = sb.config.get("network.unit.name", "freeworld")
+    prop.put("current", escape_html(current))
+    units = sorted(NETWORK_UNITS)
+    prop.put("units", len(units))
+    for i, u in enumerate(units):
+        prop.put(f"units_{i}_name", u)
+        prop.put(f"units_{i}_selected", 1 if u == current else 0)
+        prop.put(f"units_{i}_eol", 1 if i < len(units) - 1 else 0)
+    return prop
+
+
+@servlet("Settings_p")
+def respond_settings(header: dict, post: ServerObjects,
+                     sb) -> ServerObjects:
+    """General server settings (reference: htroot/Settings_p.java —
+    admin account, ports, TLS, proxy and access settings in one form)."""
+    prop = ServerObjects()
+    editable = ("adminAccountName", "adminAccountPassword",
+                "adminAccountForLocalhost", "serverClient", "port",
+                "port.ssl", "server.https", "ssl.certPath", "ssl.keyPath",
+                "publicSearchpage", "locale.language",
+                "httpd.maxAccessPerHost.600s")
+    if post.get("save"):
+        for key in editable:
+            v = post.get(f"set_{key}", None)
+            if v is None:
+                continue
+            # the form round-trips the display mask for secrets; writing
+            # it back would replace the real password with the mask
+            if "Password" in key and v == "********":
+                continue
+            sb.config.set(key, v)
+        prop.put("saved", 1)
+    prop.put("keys", len(editable))
+    for i, key in enumerate(editable):
+        prop.put(f"keys_{i}_key", key)
+        val = sb.config.get(key, "")
+        if "Password" in key and val:
+            val = "********"
+        prop.put(f"keys_{i}_value", escape_html(str(val)))
+        prop.put(f"keys_{i}_eol", 1 if i < len(editable) - 1 else 0)
+    return prop
+
+
+@servlet("User_p")
+def respond_users(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """User administration (reference: htroot/User_p.java backed by
+    UserDB — create/delete accounts, grant/revoke rights)."""
+    from ...data.userdb import ALL_RIGHTS
+    prop = ServerObjects()
+    action = post.get("action", "")
+    user = post.get("user", "").strip()
+    if action == "create" and user:
+        prop.put("created", int(sb.userdb.create(
+            user, post.get("password", ""),
+            [r for r in post.get("rights", "").split(",") if r])))
+    elif action == "delete" and user:
+        prop.put("deleted", int(sb.userdb.delete(user)))
+    elif action == "grant" and user:
+        prop.put("granted", int(sb.userdb.grant(user, post.get("right", ""))))
+    elif action == "revoke" and user:
+        prop.put("revoked", int(sb.userdb.revoke(user, post.get("right", ""))))
+    rows = sb.userdb.users()
+    prop.put("rights_available", ",".join(sorted(ALL_RIGHTS)))
+    prop.put("users", len(rows))
+    for i, row in enumerate(rows):
+        prop.put(f"users_{i}_name", escape_html(row.get("name", "")))
+        prop.put(f"users_{i}_rights",
+                 escape_html(",".join(row.get("rights", []))))
+        prop.put(f"users_{i}_eol", 1 if i < len(rows) - 1 else 0)
+    return prop
+
+
+@servlet("ConfigPortal_p")
+def respond_configportal(header: dict, post: ServerObjects,
+                         sb) -> ServerObjects:
+    """Search portal appearance (reference: htroot/ConfigPortal_p.java —
+    greeting, prompt, footer, result target options)."""
+    prop = ServerObjects()
+    keys = ("portal.greeting", "portal.prompt", "portal.footer",
+            "portal.target", "portal.smallheader")
+    if post.get("save"):
+        for k in keys:
+            v = post.get(f"set_{k}", None)
+            if v is not None:
+                sb.config.set(k, v)
+        prop.put("saved", 1)
+    for k in keys:
+        prop.put(k.replace(".", "_"),
+                 escape_json(sb.config.get(k, "")))
+    return prop
+
+
+@servlet("ConfigBasic")
+def respond_configbasic(header: dict, post: ServerObjects,
+                        sb) -> ServerObjects:
+    """First-run basics (reference: htroot/ConfigBasic.java — peer name,
+    port, use-case selection)."""
+    prop = ServerObjects()
+    if post.get("save"):
+        # network-unit switching lives in ConfigNetwork_p, which
+        # validates the unit name and re-wires the running node
+        for k in ("peerName", "port"):
+            v = post.get(f"set_{k}", None)
+            if v is not None:
+                sb.config.set(k, v)
+        prop.put("saved", 1)
+    prop.put("peerName", escape_json(sb.config.get("peerName", "anon")))
+    prop.put("port", sb.config.get("port", "8090"))
+    prop.put("doccount", sb.index.doc_count())
+    return prop
+
+
+@servlet("ConfigHeuristics_p")
+def respond_configheuristics(header: dict, post: ServerObjects,
+                             sb) -> ServerObjects:
+    """Search heuristic toggles (reference: htroot/ConfigHeuristics_p.java
+    — site-operator crawl and opensearch federation on/off)."""
+    prop = ServerObjects()
+    keys = ("heuristic.site", "heuristic.opensearch")
+    if post.get("save"):
+        for k in keys:
+            sb.config.set(k, "true" if post.get(f"set_{k}") else "false")
+        prop.put("saved", 1)
+    for k in keys:
+        prop.put(k.replace(".", "_"),
+                 1 if sb.config.get_bool(k, False) else 0)
+    return prop
+
+
+@servlet("ConfigUpdate_p")
+def respond_configupdate(header: dict, post: ServerObjects,
+                         sb) -> ServerObjects:
+    """Release/update policy (reference: htroot/ConfigUpdate_p.java —
+    update location table + auto-update policy keys)."""
+    prop = ServerObjects()
+    if post.get("save"):
+        for k in ("update.process", "update.cycle", "update.blacklist"):
+            v = post.get(f"set_{k}", None)
+            if v is not None:
+                sb.config.set(k, v)
+        prop.put("saved", 1)
+    prop.put("update_process",
+             escape_json(sb.config.get("update.process", "manual")))
+    prop.put("update_cycle", sb.config.get("update.cycle", "168"))
+    releases = []
+    op = getattr(sb, "operation", None)
+    if op is not None and hasattr(op, "releases"):
+        releases = list(op.releases())
+    prop.put("releases", len(releases))
+    for i, rel in enumerate(releases):
+        prop.put(f"releases_{i}_name", escape_json(str(rel)))
+        prop.put(f"releases_{i}_eol", 1 if i < len(releases) - 1 else 0)
+    return prop
+
+
+@servlet("ConfigLanguage_p")
+def respond_configlanguage(header: dict, post: ServerObjects,
+                           sb) -> ServerObjects:
+    """UI locale selection (reference: htroot/ConfigLanguage_p.java over
+    the .lng locale files)."""
+    import os as _os
+    prop = ServerObjects()
+    want = post.get("language", "").strip()
+    if want:
+        sb.config.set("locale.language", want)
+        prop.put("saved", 1)
+    current = sb.config.get("locale.language", "default")
+    langs = ["default"]
+    locdir = _os.path.join(sb.data_dir, "LOCALES") \
+        if getattr(sb, "data_dir", None) else None
+    if locdir and _os.path.isdir(locdir):
+        langs += sorted(f[:-4] for f in _os.listdir(locdir)
+                        if f.endswith(".lng"))
+    prop.put("current", escape_json(current))
+    prop.put("langs", len(langs))
+    for i, lg in enumerate(langs):
+        prop.put(f"langs_{i}_code", escape_json(lg))
+        prop.put(f"langs_{i}_selected", 1 if lg == current else 0)
+        prop.put(f"langs_{i}_eol", 1 if i < len(langs) - 1 else 0)
+    return prop
+
+
+@servlet("CrawlStartExpert")
+def respond_crawlstartexpert(header: dict, post: ServerObjects,
+                             sb) -> ServerObjects:
+    """Advanced crawl start (reference: htroot/CrawlStartExpert.java —
+    full profile parameter surface: filters, depth, recrawl age,
+    index/store toggles)."""
+    prop = ServerObjects()
+    url = post.get("crawlingURL", post.get("url", "")).strip()
+    prop.put("started", 0)
+    if url and post.get("crawlingstart"):
+        kwargs = {}
+        if post.get("mustmatch"):
+            kwargs["crawler_url_must_match"] = post.get("mustmatch")
+        if post.get("mustnotmatch"):
+            kwargs["crawler_url_must_not_match"] = post.get("mustnotmatch")
+        if post.get("recrawl_age_days"):
+            kwargs["recrawl_if_older_s"] = \
+                post.get_int("recrawl_age_days", 0) * 86400
+        kwargs["index_text"] = bool(post.get_int("indexText", 1))
+        kwargs["index_media"] = bool(post.get_int("indexMedia", 1))
+        try:
+            profile = sb.start_crawl(
+                url, depth=post.get_int("crawlingDepth", 0),
+                name=post.get("crawlingName") or None, **kwargs)
+        except ValueError as e:
+            prop.put("error", escape_json(str(e)))
+            profile = None
+        if profile is not None:
+            prop.put("started", 1)
+            prop.put("handle", escape_json(profile.handle))
+    return prop
+
+
+@servlet("CrawlProfileEditor_p")
+def respond_crawlprofiles(header: dict, post: ServerObjects,
+                          sb) -> ServerObjects:
+    """Crawl profile registry (reference:
+    htroot/CrawlProfileEditor_p.java — list + delete profiles)."""
+    prop = ServerObjects()
+    handle = post.get("delete", "")
+    if handle:
+        prop.put("deleted", int(sb.remove_profile(handle)
+                                if hasattr(sb, "remove_profile")
+                                else bool(sb.profiles.pop(handle, None))))
+    rows = list(sb.profiles.values())
+    prop.put("profiles", len(rows))
+    for i, p in enumerate(rows):
+        prop.put(f"profiles_{i}_handle", escape_json(p.handle))
+        prop.put(f"profiles_{i}_name", escape_json(p.name))
+        prop.put(f"profiles_{i}_depth", p.depth)
+        prop.put(f"profiles_{i}_eol", 1 if i < len(rows) - 1 else 0)
+    return prop
+
+
+@servlet("IndexCleaner_p")
+def respond_indexcleaner(header: dict, post: ServerObjects,
+                         sb) -> ServerObjects:
+    """Bulk index deletion (reference: htroot/IndexCleaner_p.java — drop
+    documents by host)."""
+    prop = ServerObjects()
+    host = post.get("host", "").strip().lower()
+    deleted = 0
+    if host and post.get("run"):
+        meta = sb.index.metadata
+        for docid in range(meta.capacity()):
+            if meta.is_deleted(docid):
+                continue
+            if meta.text_value(docid, "host_s") == host:
+                if sb.index.remove_document(meta.urlhash_of(docid)):
+                    deleted += 1
+    prop.put("deleted", deleted)
+    prop.put("doccount", sb.index.doc_count())
+    return prop
+
+
+@servlet("News")
+def respond_news(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """News pool browser (reference: htroot/News.java — incoming/outgoing
+    gossip records)."""
+    prop = ServerObjects()
+    node = getattr(sb, "node", None)
+    pool = getattr(node, "news", None) if node else getattr(sb, "news", None)
+    records = []
+    if pool is not None:
+        records = list(pool.incoming())[:post.get_int("count", 50)]
+    prop.put("records", len(records))
+    for i, rec in enumerate(records):
+        prop.put(f"records_{i}_category", escape_json(rec.category))
+        prop.put(f"records_{i}_attributes",
+                 escape_json(str(rec.attributes)))
+        prop.put(f"records_{i}_eol", 1 if i < len(records) - 1 else 0)
+    return prop
+
+
+@servlet("Surrogates_p")
+def respond_surrogates(header: dict, post: ServerObjects,
+                       sb) -> ServerObjects:
+    """Surrogate import control (reference: htroot/IndexImportMediawiki_p
+    family — list the surrogate inbox and trigger a scan)."""
+    import os as _os
+    prop = ServerObjects()
+    indir = getattr(sb, "surrogates_in", None)
+    files = sorted(_os.listdir(indir)) if indir and _os.path.isdir(indir) \
+        else []
+    if post.get("process"):
+        n = 0
+        while sb.surrogate_process_job():
+            n += 1
+        prop.put("processed", n)
+    prop.put("files", len(files))
+    for i, fn in enumerate(files):
+        prop.put(f"files_{i}_name", escape_json(fn))
+        prop.put(f"files_{i}_eol", 1 if i < len(files) - 1 else 0)
+    return prop
+
+
+@servlet("Blacklist_p")
+def respond_blacklist_ui(header: dict, post: ServerObjects,
+                         sb) -> ServerObjects:
+    """Blacklist admin UI page (reference: htroot/Blacklist_p.java); the
+    machine CRUD lives at blacklists_p, this page serves the same data
+    for the UI template."""
+    from .api import respond_blacklists
+    return respond_blacklists(header, post, sb)

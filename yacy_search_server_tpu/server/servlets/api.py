@@ -264,3 +264,90 @@ def respond_linkstructure(header: dict, post: ServerObjects,
                  if not outb else -1)
         prop.put(pre + "eol", 1 if i < len(edges) - 1 else 0)
     return prop
+
+
+@servlet("schema")
+def respond_schema(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Metadata schema listing (reference: htroot/api/schema.java — the
+    active field set, here the columnar store's full field census)."""
+    from ...index.metadata import DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS
+    prop = ServerObjects()
+    rows = ([(f, "text") for f in TEXT_FIELDS]
+            + [(f, "int") for f in INT_FIELDS]
+            + [(f, "double") for f in DOUBLE_FIELDS])
+    prop.put("fields", len(rows))
+    for i, (name, ftype) in enumerate(rows):
+        prop.put(f"fields_{i}_name", name)
+        prop.put(f"fields_{i}_type", ftype)
+        prop.put(f"fields_{i}_eol", 1 if i < len(rows) - 1 else 0)
+    return prop
+
+
+@servlet("snapshot")
+def respond_snapshot(header: dict, post: ServerObjects,
+                     sb) -> ServerObjects:
+    """Stored page snapshot retrieval (reference: htroot/api/snapshot.java
+    — serve the archived rendition of a url)."""
+    prop = ServerObjects()
+    url = post.get("url", "").strip()
+    revisions = sb.snapshots.revisions(url) if url else []
+    if revisions:
+        data = sb.snapshots.load(revisions[-1])
+        prop.raw_body = data
+        prop.raw_ctype = "text/html; charset=utf-8"
+        return prop
+    prop.put("url", escape_json(url))
+    prop.put("revisions", 0)
+    return prop
+
+
+@servlet("status_p")
+def respond_status_api(header: dict, post: ServerObjects,
+                       sb) -> ServerObjects:
+    """Machine status endpoint (reference: htroot/api/status_p.java —
+    index sizes, queue fill, memory in one JSON)."""
+    from ...crawler.frontier import StackType
+    from ...utils.memory import MemoryControl
+    prop = ServerObjects()
+    prop.put("urlpublictextSize", sb.index.doc_count())
+    prop.put("rwipublictextSize", sb.index.rwi_size())
+    prop.put("webgraphSize", len(sb.index.webgraph))
+    prop.put("localcrawljobs", sb.noticed.size(StackType.LOCAL))
+    prop.put("memoryUsed_kb", MemoryControl.used() // 1024)
+    prop.put("memoryFree_kb", MemoryControl.available() // 1024)
+    return prop
+
+
+@servlet("latency_p")
+def respond_latency(header: dict, post: ServerObjects,
+                    sb) -> ServerObjects:
+    """Per-host crawl latency table (reference:
+    htroot/api/latency_p.java over the Latency politeness model)."""
+    prop = ServerObjects()
+    snap = sb.latency.snapshot()
+    hosts = sorted(snap)[:post.get_int("maxhosts", 100)]
+    prop.put("hosts", len(hosts))
+    for i, h in enumerate(hosts):
+        st = snap[h]
+        prop.put(f"hosts_{i}_host", escape_json(h))
+        prop.put(f"hosts_{i}_average_ms", int(st.average_s * 1000))
+        prop.put(f"hosts_{i}_count", st.count)
+        prop.put(f"hosts_{i}_eol", 1 if i < len(hosts) - 1 else 0)
+    return prop
+
+
+@servlet("timeline_p")
+def respond_timeline(header: dict, post: ServerObjects,
+                     sb) -> ServerObjects:
+    """Query timeline (reference: htroot/api/timeline_p.java — recent
+    searches as a time series from the AccessTracker)."""
+    prop = ServerObjects()
+    entries = sb.access_tracker.latest(post.get_int("count", 100))
+    prop.put("events", len(entries))
+    for i, e in enumerate(entries):
+        prop.put(f"events_{i}_time", int(e.timestamp))
+        prop.put(f"events_{i}_query", escape_json(e.query))
+        prop.put(f"events_{i}_resultcount", e.result_count)
+        prop.put(f"events_{i}_ms", int(e.time_ms))
+        prop.put(f"events_{i}_eol", 1 if i < len(entries) - 1 else 0)
+    return prop

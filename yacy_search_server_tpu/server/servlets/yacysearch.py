@@ -113,7 +113,8 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
 
     t0 = time.time()
     event = sb.search(query, count=count, offset=offset,
-                      hybrid=post.get_bool("hybrid", False))
+                      hybrid=post.get_bool("hybrid", False),
+                      contentdom=post.get("contentdom", ""))
     results = event.results(offset=offset, count=count)
     prop.put("searchtime", int((time.time() - t0) * 1000))
     prop.put("totalcount", event.local_rwi_considered + event.remote_results)

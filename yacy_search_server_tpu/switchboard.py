@@ -371,11 +371,33 @@ class Switchboard:
 
     def search(self, query_string: str, count: int = 10,
                offset: int = 0, hybrid: bool = False,
-               client: str = "") -> SearchEvent:
+               client: str = "", contentdom: str = "") -> SearchEvent:
         q = QueryParams.parse(query_string)
         q.item_count = count
         q.offset = offset
         q.hybrid = hybrid
+        if contentdom:
+            # contentdom selects the media type AND its ranking preset
+            # (reference: yacysearch.java contentdom parameter)
+            from .search.query import CONTENTDOM_NAMES
+            cd = CONTENTDOM_NAMES.get(contentdom.lower())
+            if cd is not None and cd != q.contentdom:
+                q.contentdom = cd
+                from .ops.ranking import RankingProfile
+                q.profile = RankingProfile.for_contentdom(cd)
+        # operator-tuned coefficients (Ranking_p editor) override the
+        # default TEXT profile only — image/audio/video content domains
+        # keep their cat*-boosted presets (reference: RankingProfile
+        # serialized into config keys, RankingProfile.java:155+, with
+        # per-contentdom presets at :92-124)
+        ext = self.config.get("rankingProfile.default", "")
+        if ext:
+            from .ops.ranking import CD_ALL, CD_TEXT, RankingProfile
+            if q.contentdom in (CD_ALL, CD_TEXT):
+                try:
+                    q.profile = RankingProfile.from_external_string(ext)
+                except (ValueError, KeyError):
+                    pass
         if self.content_control.enabled:
             q.url_filter = self.content_control.excluded
         t0 = time.time()
