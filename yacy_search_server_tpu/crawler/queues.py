@@ -21,23 +21,81 @@ from .request import Request, Response
 
 class ErrorCache:
     """Failed-url store for the crawl monitor (reference:
-    source/net/yacy/search/index/ErrorCache.java — Solr-backed there,
-    bounded in-RAM map with the same (url, reason, ts) surface here)."""
+    source/net/yacy/search/index/ErrorCache.java — Solr-backed there, so
+    fail reasons survive restarts; here a bounded map with a jsonl
+    journal carrying the same (url, reason, ts) surface). The journal
+    compacts on load AND once it exceeds 10x the retained entries, so
+    its size stays proportional to max_entries even under a flood of
+    failures."""
 
-    def __init__(self, max_entries: int = 1000):
+    def __init__(self, max_entries: int = 1000,
+                 data_dir: str | None = None):
+        import json
+        import os
         self.max_entries = max_entries
         self._entries: dict[bytes, tuple[str, str, float]] = {}
         self._lock = threading.Lock()
+        self._journal = None
+        self._journal_lines = 0
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            path = os.path.join(data_dir, "errors.jsonl")
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        try:
+                            rec = json.loads(line)
+                            self._entries[rec["h"].encode()] = (
+                                rec["u"], rec["r"], float(rec["t"]))
+                        except (ValueError, KeyError):
+                            continue
+                while len(self._entries) > max_entries:
+                    self._entries.pop(next(iter(self._entries)))
+            self._path = path
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal to the retained entries (caller holds the
+        lock or is the constructor)."""
+        import json
+        import os
+        if self._journal:
+            self._journal.close()
+        tmp = self._path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for h, (u, r, t) in self._entries.items():
+                f.write(json.dumps({"h": h.decode("ascii", "replace"),
+                                    "u": u, "r": r, "t": t}) + "\n")
+        os.replace(tmp, self._path)
+        self._journal = open(self._path, "a", encoding="utf-8")
+        self._journal_lines = len(self._entries)
 
     def push(self, urlhash: bytes, url: str, reason: str) -> None:
+        import json
+        now = time.time()
         with self._lock:
-            self._entries[urlhash] = (url, reason, time.time())
+            self._entries[urlhash] = (url, reason, now)
             while len(self._entries) > self.max_entries:
                 self._entries.pop(next(iter(self._entries)))
+            if self._journal:
+                self._journal.write(json.dumps(
+                    {"h": urlhash.decode("ascii", "replace"),
+                     "u": url, "r": reason, "t": now}) + "\n")
+                self._journal.flush()
+                self._journal_lines += 1
+                # in-run compaction: a flood of failures must not grow
+                # the journal past a small multiple of the retained set
+                if self._journal_lines > 10 * self.max_entries:
+                    self._compact_locked()
 
     def has(self, urlhash: bytes) -> bool:
         with self._lock:
             return urlhash in self._entries
+
+    def reason(self, urlhash: bytes) -> str | None:
+        with self._lock:
+            e = self._entries.get(urlhash)
+            return e[1] if e else None
 
     def recent(self, n: int = 100) -> list[tuple[str, str, float]]:
         with self._lock:
@@ -47,17 +105,24 @@ class ErrorCache:
         with self._lock:
             return len(self._entries)
 
+    def close(self) -> None:
+        with self._lock:
+            if self._journal:
+                self._journal.close()
+                self._journal = None
+
 
 class CrawlQueues:
     def __init__(self, noticed: NoticedURL, loader: LoaderDispatcher,
                  profiles: dict[str, CrawlProfile], robots=None,
-                 indexer=None, workers: int = 4):
+                 indexer=None, workers: int = 4,
+                 data_dir: str | None = None):
         self.noticed = noticed
         self.loader = loader
         self.profiles = profiles
         self.robots = robots
         self.indexer = indexer          # callable(Response, CrawlProfile)
-        self.error_cache = ErrorCache()
+        self.error_cache = ErrorCache(data_dir=data_dir)
         self.pool = ThreadPoolExecutor(max_workers=workers,
                                        thread_name_prefix="crawl-worker")
         self.loaded = 0
@@ -125,3 +190,4 @@ class CrawlQueues:
                 return
             self._open = False
         self.pool.shutdown(wait=True)
+        self.error_cache.close()

@@ -46,3 +46,63 @@ def migrate(config, current_version: str) -> int:
     if stored != current_version:
         config.set("version", current_version)
     return ran
+
+
+# -- data-store migrations ---------------------------------------------------
+# Stores churn independently of config (profiles jsonl, runs manifest,
+# metadata journal); each step upgrades rows written by an older release
+# in place. Applied once per store version bump at Switchboard startup.
+
+
+def _d_backfill_signatures(segment) -> int:
+    """0.3.0: exact/fuzzy content signatures were added to the schema —
+    rows journaled by older releases replay with the 0 sentinel and
+    never participate in duplicate detection. Backfill them from the
+    stored text."""
+    from .document.signature import exact_signature, fuzzy_signature
+    meta = segment.metadata
+    fixed = 0
+    for docid in range(meta.capacity()):
+        if meta.is_deleted(docid):
+            continue
+        row = meta.row(docid)
+        if row.get("exact_signature_l", 0):
+            continue
+        text = row.get("text_t", "")
+        if not text:
+            continue
+        meta.set_fields(docid,
+                        exact_signature_l=exact_signature(text),
+                        fuzzy_signature_l=fuzzy_signature(text))
+        fixed += 1
+    return fixed
+
+
+DATA_MIGRATIONS: list[tuple[str, object]] = [
+    ("0.3.0", _d_backfill_signatures),
+]
+
+
+def migrate_data(segment, data_dir: str, current_version: str) -> int:
+    """Apply data-store migration steps newer than the stored data
+    version; returns rows touched. The version marker lives IN the data
+    dir (STORE_VERSION file), not in config: the data's age travels with
+    the data when an operator copies a DATA dir between releases, and it
+    cannot be masked by the config migration bumping its own version
+    first (nor forgotten when a caller holds a throwaway config)."""
+    import os
+    marker = os.path.join(data_dir, "STORE_VERSION")
+    stored = "0.0.0"
+    if os.path.exists(marker):
+        with open(marker, encoding="ascii") as f:
+            stored = f.read().strip() or "0.0.0"
+    touched = 0
+    for step_version, fn in DATA_MIGRATIONS:
+        if _v(stored) < _v(step_version) <= _v(current_version):
+            touched += fn(segment)
+    if stored != current_version:
+        tmp = marker + ".tmp"
+        with open(tmp, "w", encoding="ascii") as f:
+            f.write(current_version)
+        os.replace(tmp, marker)
+    return touched

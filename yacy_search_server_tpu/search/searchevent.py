@@ -258,30 +258,25 @@ class SearchEvent:
                 from_days=m.from_days, to_days=m.to_days)
 
     def _dense_rerank(self, scores, docids):
-        """M7 second stage: blend dense cosine similarity into the sparse
-        candidate scores on device (ops/dense.hybrid_rerank_topk).  The
-        returned scores are rescaled into the cardinal range so remote
-        fusion and post-ranking keep working on one scale."""
+        """M7 second stage: add dense cosine similarity into the sparse
+        cardinal scores on device (ops/dense.dense_boost_topk). One score
+        domain throughout — the boost has a FIXED scale, so fusion with
+        remote results never depends on the local batch's score range."""
         import jax.numpy as jnp
 
-        from ..ops.dense import hybrid_rerank_topk
+        from ..ops.dense import dense_boost_topk
 
         q = self.query
         qtext = " ".join(self.query.include_words())
         qvec = self.segment.encoder.encode(qtext)
         doc_vecs = self.segment.dense.get_block(np.asarray(docids))
         k = int(len(docids))
-        final, order = hybrid_rerank_topk(
+        final, order = dense_boost_topk(
             jnp.asarray(qvec), jnp.asarray(doc_vecs),
-            jnp.asarray(np.asarray(scores, dtype=np.float32)),
+            jnp.asarray(np.asarray(scores, dtype=np.int32)),
             jnp.ones(k, dtype=bool), jnp.float32(q.hybrid_alpha), k)
-        order = np.asarray(order)
-        # blended scores are in [0,~2); rescale onto the cardinal scale of
-        # the incoming sparse scores for heap compatibility
-        smax = float(np.max(scores)) if len(scores) else 1.0
-        rescaled = (np.asarray(final, dtype=np.float64)
-                    * max(smax, 1.0) / 2.0).astype(np.int64)
-        return rescaled, np.asarray(docids)[order]
+        return (np.asarray(final, dtype=np.int64),
+                np.asarray(docids)[np.asarray(order)])
 
     def _constraint_mask(self, plist) -> np.ndarray:
         """Vector filters replacing the reference's per-row checks in

@@ -478,8 +478,12 @@ def respond_crawlstartexpert(header: dict, post: ServerObjects,
         if post.get("recrawl_age_days"):
             kwargs["recrawl_if_older_s"] = \
                 post.get_int("recrawl_age_days", 0) * 86400
-        kwargs["index_text"] = bool(post.get_int("indexText", 1))
-        kwargs["index_media"] = bool(post.get_int("indexMedia", 1))
+        # tolerant toggle parsing: machine clients send 0/1, HTML forms
+        # send "on"; only an explicit falsy value disables
+        def _toggle(name):
+            return post.get(name, "1").lower() not in ("0", "false", "off")
+        kwargs["index_text"] = _toggle("indexText")
+        kwargs["index_media"] = _toggle("indexMedia")
         try:
             profile = sb.start_crawl(
                 url, depth=post.get_int("crawlingDepth", 0),

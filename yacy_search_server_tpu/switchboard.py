@@ -110,7 +110,7 @@ class Switchboard:
             robots=self.robots, blacklist=self.blacklist.crawler_reason)
         self.crawl_queues = CrawlQueues(
             self.noticed, self.loader, self.profiles, robots=self.robots,
-            indexer=self.to_indexer)
+            indexer=self.to_indexer, data_dir=sub("CRAWL"))
         self.web_structure = WebStructureGraph(sub("WEBSTRUCTURE"))
         self.search_cache = SearchEventCache()
         from .search.accesstracker import AccessTracker
@@ -179,6 +179,15 @@ class Switchboard:
         self._parse_proc = WorkflowProcessor(
             "parseDocument", self._stage_parse, workers=pipeline_workers,
             queue_size=200, next_stage=self._condense_proc)
+
+        # data-store migrations: rows written by an older release are
+        # upgraded in place once, tracked by the STORE_VERSION marker in
+        # the data dir (reference: migration.java version-gated rewrites,
+        # yacy.java:285)
+        if data_dir:
+            from .migration import migrate_data
+            from .yacy import VERSION
+            migrate_data(self.index, data_dir, VERSION)
 
     # -- crawl control -------------------------------------------------------
 

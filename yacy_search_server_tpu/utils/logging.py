@@ -1,0 +1,109 @@
+"""Async bounded logging — the ConcurrentLog analog.
+
+Capability equivalent of the reference's logging subsystem (reference:
+source/net/yacy/cora/util/ConcurrentLog.java:48-60,356 — a bounded
+500-entry queue drained by ONE writer thread, so hot paths never block
+on disk IO; configured at startup from DATA/LOG, yacy.java:176-188).
+
+Built on the stdlib pieces that implement exactly that shape: every
+logger publishes through a QueueHandler into a bounded queue; a single
+QueueListener thread writes to a rotating file under DATA/LOG plus the
+console. When the queue is full the record is DROPPED (the reference
+blocks; dropping is the deliberate choice here — a stalled disk must
+not back-pressure the crawl/search hot paths through the logger).
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.handlers
+import os
+import queue
+import threading
+
+QUEUE_SIZE = 500
+
+_lock = threading.Lock()
+_listener: logging.handlers.QueueListener | None = None
+_dropped = 0
+
+
+class _DroppingQueueHandler(logging.handlers.QueueHandler):
+    """Dropping variant: enqueue_nowait, count what was lost."""
+
+    def enqueue(self, record) -> None:
+        global _dropped
+        try:
+            self.queue.put_nowait(record)
+        except queue.Full:
+            _dropped += 1
+
+
+def setup(data_dir: str | None = None, level: int = logging.INFO,
+          console: bool = True) -> logging.Logger:
+    """Install the async pipeline on the root logger (idempotent;
+    reconfigures on repeat calls). Returns the root logger."""
+    global _listener
+    root = logging.getLogger()
+    with _lock:
+        _teardown_locked(root)
+
+        q: queue.Queue = queue.Queue(maxsize=QUEUE_SIZE)
+        sinks: list[logging.Handler] = []
+        if data_dir:
+            logdir = os.path.join(data_dir, "LOG")
+            os.makedirs(logdir, exist_ok=True)
+            fh = logging.handlers.RotatingFileHandler(
+                os.path.join(logdir, "yacy.log"),
+                maxBytes=4 << 20, backupCount=5, encoding="utf-8")
+            fh.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname).1s %(name)s %(message)s"))
+            sinks.append(fh)
+        if console:
+            ch = logging.StreamHandler()
+            ch.setFormatter(logging.Formatter(
+                "%(levelname).1s %(name)s %(message)s"))
+            sinks.append(ch)
+
+        root.addHandler(_DroppingQueueHandler(q))
+        root.setLevel(level)
+        _listener = logging.handlers.QueueListener(
+            q, *sinks, respect_handler_level=True)
+        _listener.start()
+    return root
+
+
+def _teardown_locked(root: logging.Logger) -> None:
+    """Stop the listener, close its sinks, detach the queue handler —
+    no leaked file descriptors on reconfigure, and no records silently
+    vanishing into an undrained queue after shutdown (late log calls
+    fall back to logging's lastResort stderr handler)."""
+    global _listener
+    if _listener is not None:
+        _listener.stop()
+        for sink in _listener.handlers:
+            try:
+                sink.close()
+            except Exception:
+                pass
+        _listener = None
+    for h in list(root.handlers):
+        root.removeHandler(h)
+        if isinstance(h, _DroppingQueueHandler):
+            h.close()
+
+
+def shutdown() -> None:
+    """Drain the queue, stop the writer thread, close sinks, detach."""
+    with _lock:
+        _teardown_locked(logging.getLogger())
+
+
+def dropped_count() -> int:
+    """Records lost to the bounded queue (observability surface)."""
+    return _dropped
+
+
+def get(name: str) -> logging.Logger:
+    """Named logger (the ConcurrentLog.logger(name) surface)."""
+    return logging.getLogger(name)

@@ -22,7 +22,7 @@ import sys
 import threading
 import time
 
-VERSION = "0.2.0"
+VERSION = "0.3.0"
 REVISION = 0        # build counter within a version (release comparison)
 
 DEFAULT_PORT = 8090
@@ -78,6 +78,10 @@ def startup(data_dir: str, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
     from .utils.config import Config
 
     lock = acquire_lock(data_dir)
+    # async bounded logging first: everything after this logs through
+    # the single-writer queue (ConcurrentLog shape, yacy.java:176-188)
+    from .utils.logging import setup as setup_logging
+    setup_logging(data_dir)
     settings = os.path.join(data_dir, "SETTINGS", "yacy.conf")
     config = Config(settings_path=settings)
     migrate(config, VERSION)
@@ -170,6 +174,8 @@ def main(argv: list[str] | None = None) -> int:
         node.close()
         http.close()
         release_lock(lock)
+        from .utils.logging import shutdown as logging_shutdown
+        logging_shutdown()
     return 0
 
 
