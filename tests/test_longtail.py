@@ -1,0 +1,341 @@
+"""Missing-list long tail (VERDICT r1 missing #7-#10 + §2 partials).
+
+- transparent forward proxy with indexing + *.yacy peer resolution
+- SMB loader behind an injectable driver
+- snapshot PDF renditions (gated shell-out, injectable renderer)
+- shipped locale files (de/fr) through the render pipeline
+- SplitTable analog (date-partitioned tables)
+- ConcurrentUpdate connector (async queue + id cache)
+- qf boost algebra on the select surface
+"""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.server import YaCyHttpServer
+from yacy_search_server_tpu.switchboard import Switchboard
+
+EXT = {
+    "http://ext.test/page.html": (b"<html><head><title>Proxied</title>"
+                                  b"</head><body>proxied page body words"
+                                  b"</body></html>"),
+    "http://ext.test/robots.txt": b"User-agent: *\n",
+}
+
+
+def _transport(url, headers):
+    if url in EXT:
+        return 200, {"content-type": "text/html"}, EXT[url]
+    return 404, {}, b""
+
+
+@pytest.fixture()
+def node(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), transport=_transport)
+    sb.latency.min_delta_s = 0.0
+    srv = YaCyHttpServer(sb, port=0).start()
+    yield sb, srv
+    srv.close()
+    sb.close()
+
+
+# -- transparent forward proxy ------------------------------------------
+
+
+def _via_proxy(srv, url):
+    opener = urllib.request.build_opener(
+        urllib.request.ProxyHandler({"http": srv.base_url}))
+    try:
+        with opener.open(url, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_forward_proxy_disabled_by_default(node):
+    _sb, srv = node
+    status, body = _via_proxy(srv, "http://ext.test/page.html")
+    assert status == 403 and b"disabled" in body
+
+
+def test_forward_proxy_fetches_and_indexes(node):
+    sb, srv = node
+    sb.config.set("proxyURL", "true")
+    sb.config.set("proxyIndexing", "true")
+    status, body = _via_proxy(srv, "http://ext.test/page.html")
+    assert status == 200 and b"proxied page body" in body
+    sb.flush_pipeline(timeout_s=30)
+    hits = [r.url for r in sb.search("proxied").results()]
+    assert "http://ext.test/page.html" in hits
+
+
+def test_yacy_domain_resolution(tmp_path):
+    # peer B serves its UI; peer A resolves bob.yacy through its seed db
+    import types
+
+    from yacy_search_server_tpu.peers.seed import (Seed, SeedDB,
+                                                    make_seed_hash)
+
+    sb_b = Switchboard(data_dir=str(tmp_path / "B"), transport=_transport)
+    srv_b = YaCyHttpServer(sb_b, port=0).start()
+
+    def transport_a(url, headers):
+        # peer A's loader reaches B over "real" HTTP (urllib)
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, dict(r.headers), r.read()
+
+    sb_a = Switchboard(data_dir=str(tmp_path / "A"),
+                       transport=transport_a)
+    me = Seed(make_seed_hash("a", "127.0.0.1", 1), name="a")
+    seeddb = SeedDB(me)
+    seed = Seed(make_seed_hash("bob", "127.0.0.1", srv_b.port),
+                name="bob", ip="127.0.0.1", port=srv_b.port)
+    seeddb.connected(seed)
+    sb_a.node = types.SimpleNamespace(seeddb=seeddb)
+    srv_a = YaCyHttpServer(sb_a, port=0).start()
+    try:
+        req = urllib.request.Request(
+            srv_a.base_url + "/index.html",
+            headers={"Host": "bob.yacy"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = r.read()
+        assert b"YaCy-TPU" in body      # peer B's portal page
+        # unknown peer -> 502
+        req = urllib.request.Request(
+            srv_a.base_url + "/index.html",
+            headers={"Host": "nobody.yacy"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            status = 200
+        except urllib.error.HTTPError as e:
+            status = e.code
+        assert status == 502
+    finally:
+        srv_a.close()
+        sb_a.close()
+        srv_b.close()
+        sb_b.close()
+
+
+# -- SMB loader ---------------------------------------------------------
+
+
+def test_smb_loader_driver(node):
+    from yacy_search_server_tpu.crawler.request import Request
+    sb, _srv = node
+    resp = sb.loader.load(Request(url="smb://fileserver/share/doc.txt"))
+    assert resp.status == 501           # no driver: declared degradation
+
+    def fake_smb(url):
+        return 200, {"content-type": "text/plain"}, b"smb file content"
+    sb.loader.smb_driver = fake_smb
+    resp = sb.loader.load(Request(url="smb://fileserver/share/doc.txt"))
+    assert resp.status == 200 and resp.content == b"smb file content"
+
+
+# -- snapshot renditions ------------------------------------------------
+
+
+def test_pdf_rendition_injectable(tmp_path):
+    from yacy_search_server_tpu.crawler.snapshots import render_pdf
+    out = str(tmp_path / "page.pdf")
+
+    def fake_renderer(url, path):
+        with open(path, "wb") as f:
+            f.write(b"%PDF-1.4 fake rendition of " + url.encode())
+        return True
+    assert render_pdf("http://r.test/", out, renderer=fake_renderer)
+    assert open(out, "rb").read().startswith(b"%PDF")
+
+
+def test_pdf_rendition_gated_without_binary(monkeypatch, tmp_path):
+    from yacy_search_server_tpu.crawler import snapshots
+    monkeypatch.setattr(snapshots, "_which", lambda b: None)
+    assert snapshots.wkhtmltopdf_available() is False
+    assert snapshots.render_pdf("http://r.test/",
+                                str(tmp_path / "x.pdf")) is False
+
+
+# -- shipped locales ----------------------------------------------------
+
+
+def test_shipped_locale_german_renders(node):
+    sb, srv = node
+    sb.config.set("locale.language", "de")
+    try:
+        with urllib.request.urlopen(srv.base_url + "/index.html",
+                                    timeout=10) as r:
+            body = r.read().decode()
+        assert "Websuche" in body           # translated h1
+        assert 'value="Suchen"' in body     # translated button
+    finally:
+        sb.config.set("locale.language", "default")
+
+
+def test_shipped_locale_listing():
+    from yacy_search_server_tpu.server.translation import shipped_languages
+    assert {"de", "fr"} <= set(shipped_languages())
+
+
+# -- SplitTable analog --------------------------------------------------
+
+
+def test_partitioned_table(tmp_path):
+    from yacy_search_server_tpu.data.tables import PartitionedTable, Tables
+    tables = Tables(str(tmp_path / "tables"))
+    pt = PartitionedTable(tables, "events")
+    old = time.time() - 400 * 86400     # >13 months ago
+    pk_old = pt.insert({"what": "ancient"}, when_s=old)
+    pk_new = pt.insert({"what": "fresh"})
+    assert len(pt.partitions()) == 2
+    assert pt.get(pk_old)["what"] == "ancient"
+    assert pt.get(pk_new)["what"] == "fresh"
+    assert {r["what"] for r in pt.rows()} == {"ancient", "fresh"}
+    # update/delete route through the embedded partition
+    row = pt.get(pk_new)
+    row["what"] = "fresher"
+    assert pt.update(pk_new, row)
+    assert pt.get(pk_new)["what"] == "fresher"
+    # whole-partition retirement
+    assert pt.drop_partitions_older_than(12) == 1
+    assert [r["what"] for r in pt.rows()] == ["fresher"]
+
+
+# -- ConcurrentUpdate connector -----------------------------------------
+
+
+def test_concurrent_update_connector():
+    from yacy_search_server_tpu.index.federate import (
+        ConcurrentUpdateConnector, LocalConnector)
+    from yacy_search_server_tpu.index.segment import Segment
+    from yacy_search_server_tpu.utils.hashes import url2hash
+    seg = Segment()
+    conn = ConcurrentUpdateConnector(LocalConnector(seg))
+    doc = Document(url="http://cu.test/a", title="Async",
+                   text="queued document body")
+    conn.add(doc)
+    # in-flight visibility through the id cache, before the drain
+    assert conn.exists(url2hash("http://cu.test/a"))
+    conn.flush()
+    assert seg.doc_count() == 1
+    conn.delete_by_id(url2hash("http://cu.test/a"))
+    assert not conn.exists(url2hash("http://cu.test/a"))
+    conn.flush()
+    assert seg.doc_count() == 0
+    conn.close()
+    seg.close()
+
+
+# -- qf boost algebra ---------------------------------------------------
+
+
+def test_select_qf_boosts(node):
+    sb, srv = node
+    sb.index.store_document(Document(
+        url="http://b.test/title-hit", title="quantum mechanics",
+        text="unrelated body"))
+    sb.index.store_document(Document(
+        url="http://b.test/body-hit", title="irrelevant",
+        text="quantum quantum quantum mentioned in passing body"))
+    with urllib.request.urlopen(
+            srv.base_url + "/select.json?q=quantum&qf="
+            + urllib.parse.quote("title^20 text_t^1"), timeout=10) as r:
+        docs = json.loads(r.read())["response"]["docs"]
+    assert docs[0]["sku"] == "http://b.test/title-hit"
+
+    from yacy_search_server_tpu.index.federate import (boosted_score,
+                                                       parse_boosts)
+    boosts = parse_boosts("title^20 text_t^1")
+    a = boosted_score({"title": "quantum mechanics", "text_t": "x"},
+                      ["quantum"], boosts)
+    b = boosted_score({"title": "other", "text_t": "quantum here"},
+                      ["quantum"], boosts)
+    assert a > b
+
+
+# -- review-fix regressions ---------------------------------------------
+
+
+def test_concurrent_update_backend_failure_visible():
+    from yacy_search_server_tpu.index.federate import \
+        ConcurrentUpdateConnector
+    from yacy_search_server_tpu.utils.hashes import url2hash
+
+    class Broken:
+        def add(self, doc):
+            raise OSError("backend down")
+
+        def exists(self, urlhash):
+            return False
+    conn = ConcurrentUpdateConnector(Broken())
+    doc = Document(url="http://f.test/x", title="t", text="b")
+    conn.add(doc)
+    conn.flush(timeout_s=5)
+    assert conn.failed == 1
+    # the id cache no longer claims the lost document exists
+    assert not conn.exists(url2hash("http://f.test/x"))
+    conn.close()
+
+
+def test_concurrent_update_flush_times_out():
+    import time as _time
+
+    class Hung:
+        def add(self, doc):
+            _time.sleep(60)
+    from yacy_search_server_tpu.index.federate import \
+        ConcurrentUpdateConnector
+    conn = ConcurrentUpdateConnector(Hung())
+    conn.add(Document(url="http://h.test/x", title="t", text="b"))
+    t0 = _time.monotonic()
+    conn.flush(timeout_s=0.5)
+    assert _time.monotonic() - t0 < 5       # returned at the deadline
+    # leave the hung daemon thread behind (daemon=True)
+
+
+def test_forward_proxy_relays_redirect(node):
+    sb, srv = node
+    sb.config.set("proxyURL", "true")
+
+    def redirecting(url, headers):
+        if url == "http://r.test/old":
+            return 301, {"content-type": "text/html",
+                         "location": "http://r.test/new"}, b"moved"
+        return 404, {}, b""
+    sb.loader.transport = redirecting
+    opener = urllib.request.build_opener(
+        urllib.request.ProxyHandler({"http": srv.base_url}))
+    # urllib follows redirects; the 404 target proves Location was relayed
+    try:
+        opener.open("http://r.test/old", timeout=10)
+        followed = 200
+    except urllib.error.HTTPError as e:
+        followed = e.code
+    assert followed == 404
+
+
+def test_crawlstart_checkbox_marker(node):
+    sb, srv = node
+    import json as _json
+    import urllib.parse as _up
+
+    def post(data):
+        body = _up.urlencode(data).encode()
+        with urllib.request.urlopen(urllib.request.Request(
+                srv.base_url + "/CrawlStartExpert.json", data=body),
+                timeout=10) as r:
+            return _json.loads(r.read().decode())
+    body = post({"crawlingstart": "1",
+                 "crawlingURL": "http://ext.test/page.html",
+                 "recrawl_age_days": "0",
+                 "indexText_present": "1",       # form marker, box unchecked
+                 "indexMedia": "on", "indexMedia_present": "1"})
+    assert int(body["started"]) == 1
+    profile = sb.profiles[body["handle"]]
+    assert profile.index_text is False
+    assert profile.index_media is True

@@ -37,7 +37,10 @@ def test_load_locale(tmp_path):
     with open(os.path.join(d, "de.lng"), "w", encoding="utf-8") as f:
         f.write(LNG)
     assert load_locale(d, "en").is_empty()       # default: no rewriting
-    assert load_locale(d, "fr").is_empty()       # missing file: empty
+    # missing file AND not shipped: empty; a shipped language ("fr")
+    # now falls back to the packaged locale instead
+    assert load_locale(d, "xx").is_empty()
+    assert not load_locale(d, "fr").is_empty()
     de = load_locale(d, "de")
     assert not de.is_empty() and de.lang == "de"
 

@@ -52,6 +52,8 @@ class LoaderDispatcher:
         self.cache = cache or HTCache()
         self.latency = latency or Latency()
         self.transport = transport   # (url, headers) -> (status, headers, bytes)
+        # injectable SMB client: (url) -> (status, headers, bytes)
+        self.smb_driver = None
         self.agent = agent
         self.max_size = max_size
         self.timeout_s = timeout_s
@@ -150,9 +152,16 @@ class LoaderDispatcher:
                 status, headers, content = self._fetch_http(url)
             elif scheme == "file":
                 status, headers, content = self._fetch_file(url)
+            elif scheme == "smb":
+                # SMB loading through an injectable driver (reference:
+                # crawler/retrieval/SMBLoader.java via jcifs; no SMB
+                # client library ships in this image, so operators plug
+                # one in — same pattern as the UPnP driver)
+                if self.smb_driver is None:
+                    return Response(request, status=501, headers={
+                        "x-error": "smb driver not configured"})
+                status, headers, content = self.smb_driver(url)
             else:
-                # smb would need an SMB client library (reference bundles
-                # jcifs); not available in this image — explicit 501
                 return Response(request, status=501,
                                 headers={"x-error": f"scheme {scheme}"})
             elapsed = time.monotonic() - t0

@@ -141,3 +141,38 @@ class Snapshots:
         for _root, _dirs, files in os.walk(os.path.join(self.data_dir, state)):
             n += sum(1 for f in files if not f.endswith(".tmp"))
         return n
+
+
+# -- renditions (Html2Image shell-outs, gated) ---------------------------
+
+def _which(binary: str) -> str | None:
+    import shutil
+    return shutil.which(binary)
+
+
+def wkhtmltopdf_available() -> bool:
+    """The reference's PDF rendition path shells out to wkhtmltopdf
+    (Transactions.java:69,239 via Html2Image); availability-gated here
+    the same way."""
+    return _which("wkhtmltopdf") is not None
+
+
+def render_pdf(url: str, out_path: str, renderer=None,
+               timeout_s: float = 60.0) -> bool:
+    """Render a live url to PDF via wkhtmltopdf (or an injected
+    `renderer(url, out_path) -> bool` for tests/alternatives). Returns
+    False when no renderer is available — a declared degradation, never
+    an error (the reference logs and continues too)."""
+    if renderer is not None:
+        return bool(renderer(url, out_path))
+    binary = _which("wkhtmltopdf")
+    if binary is None:
+        return False
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [binary, "--quiet", url, out_path],
+            timeout=timeout_s, capture_output=True)
+        return proc.returncode == 0 and os.path.exists(out_path)
+    except (subprocess.TimeoutExpired, OSError):
+        return False

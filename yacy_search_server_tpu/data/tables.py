@@ -127,6 +127,21 @@ class Tables:
         with self._lock:
             return [dict(r) for r in self._tables.get(table, {}).values()]
 
+    def drop_table(self, table: str) -> bool:
+        """Remove a whole table and its journal file (partition
+        retirement — SplitTable's by-age table discard)."""
+        with self._lock:
+            if self._tables.pop(table, None) is None:
+                return False
+            self._seq.pop(table, None)
+            if self.data_dir:
+                path = os.path.join(self.data_dir, table + ".jsonl")
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            return True
+
     def select(self, table: str, **match) -> list[dict]:
         """Rows whose columns equal every given value."""
         with self._lock:
@@ -145,3 +160,75 @@ class Tables:
         with self._lock:
             self._tables[table] = {}
             self._compact(table)
+
+
+class PartitionedTable:
+    """Date-partitioned table set behind one table-like API.
+
+    Capability equivalent of the reference's SplitTable (reference:
+    source/net/yacy/kelondro/table/SplitTable.java:61 — a set of
+    per-date-suffix Tables presented as one Index so writes land in the
+    current partition while reads fan over all of them, and whole
+    partitions can be dropped by age instead of row-by-row deletes).
+    Here partitions are months ("%Y%m"); rows are stamped with their
+    partition so updates/deletes route directly."""
+
+    def __init__(self, tables: Tables, base_name: str):
+        self.tables = tables
+        self.base = base_name
+
+    def _partition(self, when_s: float | None = None) -> str:
+        import time as _time
+        return _time.strftime("%Y%m", _time.gmtime(when_s))
+
+    def _table(self, partition: str) -> str:
+        return f"{self.base}.{partition}"
+
+    def partitions(self) -> list[str]:
+        with self.tables._lock:
+            prefix = self.base + "."
+            return sorted(t[len(prefix):] for t in self.tables._tables
+                          if t.startswith(prefix))
+
+    def insert(self, row: dict, pk: str | None = None,
+               when_s: float | None = None) -> str:
+        part = self._partition(when_s)
+        pk = self.tables.insert(self._table(part), row, pk=pk)
+        return f"{part}/{pk}"
+
+    @staticmethod
+    def _split_pk(full_pk: str) -> tuple[str, str]:
+        part, _, pk = full_pk.partition("/")
+        return part, pk
+
+    def get(self, full_pk: str) -> dict | None:
+        part, pk = self._split_pk(full_pk)
+        return self.tables.get(self._table(part), pk)
+
+    def update(self, full_pk: str, row: dict) -> bool:
+        part, pk = self._split_pk(full_pk)
+        return self.tables.update(self._table(part), pk, row)
+
+    def delete(self, full_pk: str) -> bool:
+        part, pk = self._split_pk(full_pk)
+        return self.tables.delete(self._table(part), pk)
+
+    def rows(self) -> list[dict]:
+        """All rows across partitions, oldest partition first."""
+        out: list[dict] = []
+        for part in self.partitions():
+            out.extend(self.tables.rows(self._table(part)))
+        return out
+
+    def drop_partitions_older_than(self, keep_months: int) -> int:
+        """Whole-partition retirement — the point of date splitting
+        (SplitTable discards table files by age)."""
+        import time as _time
+        cutoff = _time.strftime(
+            "%Y%m", _time.gmtime(_time.time() - keep_months * 30 * 86400))
+        dropped = 0
+        for part in self.partitions():
+            if part < cutoff:
+                if self.tables.drop_table(self._table(part)):
+                    dropped += 1
+        return dropped

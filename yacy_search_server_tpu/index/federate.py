@@ -248,3 +248,159 @@ class ShardConnector:
             seen.add(rid)
             out.append(r)
         return out[start:start + rows]
+
+
+class ConcurrentUpdateConnector:
+    """Async update queue + id-exists cache over any connector.
+
+    Capability equivalent of the reference's ConcurrentUpdateSolrConnector
+    (reference: cora/federate/solr/connector/AbstractSolrConnector.java /
+    ConcurrentUpdateSolrConnector — writers enqueue documents and return
+    immediately; ONE background thread drains the queue into the wrapped
+    connector, and a bounded id cache answers exists() for documents
+    still in flight without hitting the backend)."""
+
+    def __init__(self, inner, queue_size: int = 1000,
+                 id_cache_size: int = 10_000):
+        import queue as _q
+        import threading as _t
+        self.inner = inner
+        self._queue: "_q.Queue" = _q.Queue(maxsize=queue_size)
+        self._id_cache: dict[bytes, bool] = {}
+        self._id_cache_size = id_cache_size
+        self._lock = _t.Lock()
+        self._closed = False
+        self.failed = 0          # updates lost to backend errors
+        self._thread = _t.Thread(target=self._drain,
+                                 name="concurrent-update", daemon=True)
+        self._thread.start()
+
+    def _remember(self, urlhash: bytes, present: bool) -> None:
+        with self._lock:
+            self._id_cache[urlhash] = present
+            while len(self._id_cache) > self._id_cache_size:
+                self._id_cache.pop(next(iter(self._id_cache)))
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            op, payload = item
+            try:
+                if op == "add":
+                    self.inner.add(payload)
+                else:
+                    self.inner.delete_by_id(payload)
+            except Exception as e:
+                # a failing backend must not kill the drainer, but a lost
+                # update must be visible: counter + log line, and the id
+                # cache must stop claiming the document is present
+                self.failed += 1
+                if op == "add":
+                    from ..utils.hashes import url2hash
+                    try:
+                        self._remember(url2hash(payload.url), False)
+                    except Exception:
+                        pass
+                import logging as _logging
+                _logging.getLogger("federate.update").warning(
+                    "dropped %s update: %s", op, e)
+            finally:
+                self._queue.task_done()
+
+    # -- connector surface ---------------------------------------------------
+
+    def add(self, doc: Document) -> None:
+        """Enqueue; blocks only when the bounded queue is full (the
+        reference's backpressure point)."""
+        from ..utils.hashes import url2hash
+        self._remember(url2hash(doc.url), True)
+        self._queue.put(("add", doc))
+
+    def delete_by_id(self, urlhash: bytes) -> bool:
+        self._remember(urlhash, False)
+        self._queue.put(("delete", urlhash))
+        return True
+
+    def exists(self, urlhash: bytes) -> bool:
+        with self._lock:
+            cached = self._id_cache.get(urlhash)
+        if cached is not None:
+            return cached
+        present = self.inner.exists(urlhash)
+        self._remember(urlhash, present)
+        return present
+
+    def count(self) -> int:
+        return self.inner.count()
+
+    def query(self, querystring: str, rows: int = 10,
+              start: int = 0) -> list[dict]:
+        return self.inner.query(querystring, rows=rows, start=start)
+
+    def flush(self, timeout_s: float = 30.0) -> None:
+        """Block until every enqueued update reached the backend, or the
+        deadline passes (queue.join has no timeout; poll the task
+        counter so a hung backend cannot wedge shutdown)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            with self._queue.all_tasks_done:
+                if self._queue.unfinished_tasks == 0:
+                    return
+            _time.sleep(0.01)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join(timeout=10)
+
+
+# -- boost algebra -------------------------------------------------------
+
+DEFAULT_BOOSTS = {
+    # the reference's default field boosts (defaults/yacy.init
+    # search.ranking.solrboost: sku^20 url_paths_sxt^20 title^15 ...)
+    "sku": 20.0, "title": 15.0, "h1_txt": 11.0, "h2_txt": 10.0,
+    "author": 8.0, "description_txt": 5.0, "keywords": 2.0, "text_t": 1.0,
+}
+
+
+def parse_boosts(spec: str) -> dict[str, float]:
+    """Parse a Solr-style qf boost spec ("title^15 text_t^1") —
+    cora/federate/solr/Boost.java's field^boost syntax."""
+    out: dict[str, float] = {}
+    for token in spec.replace(",", " ").split():
+        field, _, boost = token.partition("^")
+        if not field:
+            continue
+        try:
+            out[field] = float(boost) if boost else 1.0
+        except ValueError:
+            out[field] = 1.0
+    return out
+
+
+def boosted_score(row: dict, terms: list[str],
+                  boosts: dict[str, float] | None = None) -> float:
+    """Field-weighted match score of one metadata row: sum over fields of
+    boost * matched-term fraction. The query-builder algebra the select
+    path uses when a qf= spec is given (Boost.java + the dismax-ish
+    query construction in CollectionConfiguration)."""
+    boosts = boosts or DEFAULT_BOOSTS
+    if not terms:
+        return 0.0
+    score = 0.0
+    lowered = [t.lower() for t in terms]
+    for field, boost in boosts.items():
+        value = str(row.get(field, "") or "").lower()
+        if not value:
+            continue
+        hits = sum(1 for t in lowered if t in value)
+        if hits:
+            score += boost * hits / len(lowered)
+    return score

@@ -198,6 +198,20 @@ class YaCyHttpServer:
 
     def _handle(self, handler, post_params: dict) -> None:
         try:
+            # forward-proxy request line (GET http://host/path) — the
+            # transparent indexing proxy (reference:
+            # server/http/HTTPDProxyHandler.java, config proxyURL /
+            # proxyIndexing)
+            if handler.path.startswith(("http://", "https://")):
+                self._handle_forward_proxy(handler, handler.path)
+                return
+            # *.yacy virtual domains resolve to peers by name (reference:
+            # the Jetty domain-rewrite handler + HTTPDProxyHandler)
+            host_header = handler.headers.get("Host", "").split(":")[0]
+            if host_header.endswith(".yacy"):
+                self._handle_yacy_domain(handler, host_header, handler.path)
+                return
+
             parts = urlsplit(handler.path)
             path = unquote(parts.path)
             params = dict(parse_qsl(parts.query, keep_blank_values=True))
@@ -308,6 +322,88 @@ class YaCyHttpServer:
         rows = ",\n".join(f' {json.dumps(k)}: "{v}"'
                           for k, v in sorted(prop.items()))
         return "{\n" + rows + "\n}"
+
+    # -- transparent proxy ---------------------------------------------------
+
+    def _proxy_profile(self):
+        """The crawl profile proxied pages are indexed under (reference:
+        the defaultProxyProfile in CrawlSwitchboard)."""
+        for p in self.sb.profiles.values():
+            if p.name == "proxy":
+                return p
+        from ..crawler.profile import CrawlProfile
+        profile = CrawlProfile("proxy", depth=0, remote_indexing=False)
+        self.sb.add_profile(profile)
+        return profile
+
+    def _handle_forward_proxy(self, handler, url: str) -> None:
+        cfg = self.sb.config
+        if not cfg.get_bool("proxyURL", False):
+            self._send(handler, 403, "text/plain",
+                       b"forward proxy disabled (config proxyURL)")
+            return
+        from ..crawler.loader import CacheStrategy
+        from ..crawler.request import Request
+        try:
+            resp = self.sb.loader.load(Request(url=url),
+                                       CacheStrategy.IFFRESH)
+        except Exception as e:
+            self._send(handler, 502, "text/plain",
+                       f"proxy fetch failed: {e}".encode())
+            return
+        if resp.status != 200:
+            # relay the upstream response (redirects need their Location
+            # header to keep browsing working through the proxy)
+            extra = {k: v for k, v in resp.headers.items()
+                     if k.lower() in ("location", "content-type",
+                                      "cache-control", "expires",
+                                      "set-cookie", "last-modified")
+                     and k.lower() != "content-type"}
+            ctype = resp.headers.get("content-type", "text/plain")
+            self._send(handler, resp.status or 502, ctype,
+                       resp.content or b"", extra=extra)
+            return
+        # indexing side effect (HTTPDProxyHandler hands fetched pages to
+        # the indexer when proxyIndexing is on)
+        if cfg.get_bool("proxyIndexing", False) \
+                and resp.indexable() is None:
+            try:
+                self.sb.to_indexer(resp, self._proxy_profile())
+            except Exception:
+                pass
+        ctype = resp.headers.get("content-type",
+                                 "application/octet-stream")
+        self._send(handler, 200, ctype, resp.content)
+
+    def _handle_yacy_domain(self, handler, host: str, path: str) -> None:
+        """<peername>.yacy resolves through the seed directory."""
+        peer_name = host[:-len(".yacy")]
+        # P2PNode publishes the seed directory on the switchboard
+        # (peers/node.py: self.sb.seeddb = ...)
+        seeddb = getattr(self.sb, "seeddb", None) \
+            or getattr(getattr(self.sb, "node", None), "seeddb", None)
+        seed = None
+        if seeddb is not None:
+            for s in seeddb.all_seeds():
+                if s.name == peer_name:
+                    seed = s
+                    break
+        if seed is None:
+            self._send(handler, 502, "text/plain",
+                       f"unknown peer: {peer_name}".encode())
+            return
+        from ..crawler.loader import CacheStrategy
+        from ..crawler.request import Request
+        target = f"http://{seed.ip}:{seed.port}{path}"
+        try:
+            resp = self.sb.loader.load(Request(url=target),
+                                       CacheStrategy.NOCACHE)
+        except Exception as e:
+            self._send(handler, 502, "text/plain",
+                       f"peer fetch failed: {e}".encode())
+            return
+        ctype = resp.headers.get("content-type", "text/html")
+        self._send(handler, resp.status or 200, ctype, resp.content)
 
     def _handle_wire(self, handler, path: str, params: dict) -> None:
         if self.peer_server is None:

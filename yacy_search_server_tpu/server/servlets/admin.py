@@ -444,13 +444,14 @@ def respond_configlanguage(header: dict, post: ServerObjects,
     if want:
         sb.config.set("locale.language", want)
         prop.put("saved", 1)
+    from ..translation import shipped_languages
     current = sb.config.get("locale.language", "default")
-    langs = ["default"]
+    langs = ["default"] + shipped_languages()
     locdir = _os.path.join(sb.data_dir, "LOCALES") \
         if getattr(sb, "data_dir", None) else None
     if locdir and _os.path.isdir(locdir):
         langs += sorted(f[:-4] for f in _os.listdir(locdir)
-                        if f.endswith(".lng"))
+                        if f.endswith(".lng") and f[:-4] not in langs)
     prop.put("current", escape_json(current))
     prop.put("langs", len(langs))
     for i, lg in enumerate(langs):
@@ -478,10 +479,15 @@ def respond_crawlstartexpert(header: dict, post: ServerObjects,
         if post.get("recrawl_age_days"):
             kwargs["recrawl_if_older_s"] = \
                 post.get_int("recrawl_age_days", 0) * 86400
-        # tolerant toggle parsing: machine clients send 0/1, HTML forms
-        # send "on"; only an explicit falsy value disables
+        # toggle parsing across both client styles: machine clients send
+        # explicit 0/1; HTML checkbox forms OMIT unchecked boxes, so the
+        # form carries a hidden `<name>_present=1` marker — with the
+        # marker, absence means unchecked
         def _toggle(name):
-            return post.get(name, "1").lower() not in ("0", "false", "off")
+            v = post.get(name, None)
+            if v is None:
+                return not post.get(f"{name}_present")
+            return v.lower() not in ("0", "false", "off")
         kwargs["index_text"] = _toggle("indexText")
         kwargs["index_media"] = _toggle("indexMedia")
         try:

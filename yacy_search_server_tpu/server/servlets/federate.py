@@ -90,6 +90,15 @@ def respond_select(header: dict, post: ServerObjects, sb) -> ServerObjects:
             if row is not None:
                 docs.append(row)
 
+    # qf= field boosts re-rank the page (Boost.java query algebra): each
+    # row scores as sum(boost * matched-term fraction) over the spec
+    qf = post.get("qf", "").strip()
+    if qf and docs:
+        from ...index.federate import boosted_score, parse_boosts
+        boosts = parse_boosts(qf)
+        terms = [t for t in q.split() if ":" not in t]
+        docs.sort(key=lambda d: -boosted_score(d, terms, boosts))
+
     wt = post.get("wt", "json")
     if wt == "csv":
         # flat writer (the reference's flat-text/CSV response writers,

@@ -88,11 +88,28 @@ class TranslationTable:
             return not self._sections
 
 
+# languages shipped with the package (reference: locales/*.lng in the
+# distribution); a DATA/LOCALES file with the same name overrides it
+SHIPPED_LOCALES_DIR = os.path.join(os.path.dirname(__file__), "locales")
+
+
+def shipped_languages() -> list[str]:
+    if not os.path.isdir(SHIPPED_LOCALES_DIR):
+        return []
+    return sorted(f[:-4] for f in os.listdir(SHIPPED_LOCALES_DIR)
+                  if f.endswith(".lng"))
+
+
 def load_locale(locales_dir: str | None, lang: str) -> TranslationTable:
-    """`<locales_dir>/<lang>.lng`, empty table when absent/default."""
-    if not locales_dir or not lang or lang in ("en", "default", "browser"):
+    """`<locales_dir>/<lang>.lng`, falling back to the shipped locale of
+    the same name; empty table for default/english."""
+    if not lang or lang in ("en", "default", "browser"):
         return TranslationTable()
-    path = os.path.join(locales_dir, lang + ".lng")
-    if not os.path.exists(path):
-        return TranslationTable(lang)
-    return TranslationTable.load(path)
+    if locales_dir:
+        path = os.path.join(locales_dir, lang + ".lng")
+        if os.path.exists(path):
+            return TranslationTable.load(path)
+    shipped = os.path.join(SHIPPED_LOCALES_DIR, lang + ".lng")
+    if os.path.exists(shipped):
+        return TranslationTable.load(shipped)
+    return TranslationTable(lang)
