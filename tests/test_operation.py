@@ -90,3 +90,52 @@ def test_release_manager():
     rm2 = ReleaseManager(["http://updates.test/"], fetcher=lambda u: page2)
     got = rm2.newer_than_current()
     assert got is not None and got.rev == launcher.REVISION + 1
+
+
+# -- signed releases (yacyRelease signature verification) ----------------
+
+
+def test_signed_release_verify_and_stage(tmp_path):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+        Ed25519PrivateKey
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat)
+
+    from yacy_search_server_tpu.peers.operation import (
+        Release, SignedReleaseDownloader, verify_release)
+
+    priv = Ed25519PrivateKey.generate()
+    pub_hex = priv.public_key().public_bytes(
+        Encoding.Raw, PublicFormat.Raw).hex()
+    artifact = b"release tarball bytes"
+    good_sig = priv.sign(artifact)
+
+    assert verify_release(artifact, good_sig, pub_hex)
+    assert not verify_release(artifact + b"x", good_sig, pub_hex)
+    assert not verify_release(artifact, b"\x00" * 64, pub_hex)
+    assert not verify_release(artifact, good_sig, "zz-not-hex")
+
+    store = {"http://up.test/yacy_tpu_v9.9.9-99.tar.gz": artifact,
+             "http://up.test/yacy_tpu_v9.9.9-99.tar.gz.sig": good_sig}
+    dl = SignedReleaseDownloader(pub_hex, store.__getitem__,
+                                 stage_dir=str(tmp_path / "stage"))
+    rel = Release("9.9.9", 99, "http://up.test/yacy_tpu_v9.9.9-99.tar.gz")
+    path = dl.download(rel)
+    assert path and open(path, "rb").read() == artifact
+
+    # tampered artifact refuses to stage
+    store["http://up.test/yacy_tpu_v9.9.9-99.tar.gz"] = b"evil bytes"
+    assert dl.download(rel) is None
+    # no pinned key: fail closed
+    assert SignedReleaseDownloader("", store.__getitem__).download(rel) is None
+
+
+def test_signed_release_fails_closed_on_text_fetcher(tmp_path):
+    from yacy_search_server_tpu.peers.operation import (
+        Release, SignedReleaseDownloader, verify_release)
+    assert not verify_release("text not bytes", b"\x00" * 64, "00" * 32)
+    assert not verify_release(b"data", "text sig", "00" * 32)
+    dl = SignedReleaseDownloader("00" * 32, lambda url: "page text",
+                                 stage_dir=str(tmp_path))
+    rel = Release("9.9.9", 99, "http://up.test/yacy_tpu_v9.9.9-99.tar.gz")
+    assert dl.download(rel) is None       # refuses, never raises

@@ -223,3 +223,27 @@ def test_auto_compaction_on_dead_majority(tmp_path):
     wg.remove_source(1)                        # 2 dead of 4 -> compacts
     assert wg.edge_count_total() == 2 and len(wg) == 2
     wg.close()
+
+
+def test_inbound_anchor_text_indexes_target(tmp_path):
+    """A page becomes findable by what OTHERS call it: anchor texts of
+    inbound links index under the target with the description flag."""
+    seg = Segment(data_dir=str(tmp_path / "anchor"))
+    try:
+        # the linking page exists first, pointing at the target with a
+        # distinctive anchor word the target's own body never contains
+        seg.store_document(_doc("http://linker.test/", [
+            Anchor(url="http://target.test/page",
+                   text="zebrasaurus reviews")]))
+        seg.store_document(Document(
+            url="http://target.test/page", title="Plain Title",
+            text="ordinary body content with no unusual words"))
+        hits = seg.term_search(include_words=["zebrasaurus"])
+        target_docid = seg.metadata.docid(
+            url2hash("http://target.test/page"))
+        assert target_docid in hits.docids.tolist()
+        # body terms are not duplicated by the anchor pass
+        hits2 = seg.term_search(include_words=["ordinary"])
+        assert list(hits2.docids).count(target_docid) == 1
+    finally:
+        seg.close()

@@ -191,9 +191,17 @@ class Segment:
                 doc_row = condenser.doc_row(
                     {P.F_DOMLENGTH: meta.get("domlength_i")})
                 term_hashes, rows = condenser.postings_rows(base_row=doc_row)
+                seen_terms = set(term_hashes)
                 for th, row in zip(term_hashes, rows):
                     self.rwi.add(th, docid, row)
                 self.rwi.add(word2hash(CATCHALL_WORD), docid, doc_row)
+                # inbound anchor texts make the page findable by what
+                # OTHERS call it (reference: webgraph anchor text feeding
+                # the target's index via CollectionConfiguration): terms
+                # from links already pointing here index under this doc
+                # with the description flag set
+                self._index_anchor_terms(docid, urlhash, doc_row,
+                                         seen_terms)
                 self.dense.put(docid, self.encoder.encode(
                     f"{doc.title}\n{doc.text[:4096]}"))
 
@@ -202,6 +210,31 @@ class Segment:
             if self.rwi.needs_flush():
                 self.rwi.flush()
             return docid
+
+    MAX_ANCHOR_TEXTS = 50
+
+    def _index_anchor_terms(self, docid: int, urlhash: bytes,
+                            doc_row, seen_terms: set) -> None:
+        """Index the target document under the words of its inbound
+        anchor texts (skipping nofollow links and terms the body already
+        carries). One posting per new term with FLAG_APP_DC_DESCRIPTION,
+        like an in-description appearance."""
+        from ..document.condenser import words_of
+        from ..utils.bitfield import FLAG_APP_DC_DESCRIPTION
+        texts = self.webgraph.anchor_texts(urlhash)[:self.MAX_ANCHOR_TEXTS]
+        if not texts:
+            return
+        extra: set[str] = set()
+        for text in texts:
+            extra.update(words_of(text.lower()))
+        row = doc_row.copy()
+        row[P.F_FLAGS] |= 1 << FLAG_APP_DC_DESCRIPTION
+        row[P.F_HITCOUNT] = 1
+        for word in extra:
+            th = word2hash(word)
+            if th in seen_terms:
+                continue
+            self.rwi.add(th, docid, row)
 
     def _refresh_references(self, target_urlhash: bytes) -> None:
         """Sync a target's references_* metadata columns with the citation

@@ -105,3 +105,76 @@ class ReleaseManager:
         candidates = [r for r in self.scan()
                       if (r.version_tuple(), r.rev) > cur]
         return candidates[-1] if candidates else None
+
+
+# -- signed releases ----------------------------------------------------
+# The reference verifies releases against the project's public key
+# before auto-deploying (yacyRelease.checkFingerprint — SHA1withRSA over
+# the tarball, .sig files beside the release). Here the signature scheme
+# is Ed25519 (smaller keys, no parameter pitfalls): <release>.sig holds
+# the raw 64-byte signature over the release bytes, and the operator
+# pins the 32-byte public key (hex) in config `update.publicKey`.
+
+
+def verify_release(data: bytes, signature: bytes,
+                   public_key_hex: str) -> bool:
+    """True iff `signature` is a valid Ed25519 signature of `data` under
+    the pinned public key. Any malformed input verifies False — an
+    update path must fail closed."""
+    try:
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import \
+            Ed25519PublicKey
+    except ImportError:
+        return False
+    try:
+        key = Ed25519PublicKey.from_public_bytes(
+            bytes.fromhex(public_key_hex))
+        key.verify(signature, data)
+        return True
+    except (ValueError, TypeError, InvalidSignature):
+        # TypeError: non-bytes input (e.g. a text-mode fetcher) — still
+        # fail closed, never propagate out of the update check
+        return False
+
+
+class SignedReleaseDownloader:
+    """Fetch + verify + stage a release (yacyRelease download/deploy).
+
+    `fetch_bytes(url) -> bytes` supplies the artifact and its .sig; a
+    verified release lands in `stage_dir` for the operator (or a deploy
+    hook) to install — the node never self-restarts here, matching the
+    'deploy script' half of the reference being an external step."""
+
+    def __init__(self, public_key_hex: str, fetch_bytes,
+                 stage_dir: str | None = None):
+        self.public_key_hex = public_key_hex
+        self.fetch_bytes = fetch_bytes
+        self.stage_dir = stage_dir
+
+    def download(self, release: Release) -> str | None:
+        """Returns the staged file path, or None when the signature (or
+        the fetch) fails. Nothing unverified ever touches the disk
+        outside a temp file."""
+        import os
+        import tempfile
+        if not self.public_key_hex:
+            return None     # no pinned key: refuse, never trust-on-fetch
+        try:
+            data = self.fetch_bytes(release.url)
+            sig = self.fetch_bytes(release.url + ".sig")
+        except Exception:
+            return None
+        if not isinstance(data, bytes) or not isinstance(sig, bytes):
+            return None     # a text-mode fetcher cannot carry a signature
+        if not data or not sig or not verify_release(
+                data, sig, self.public_key_hex):
+            return None
+        stage = self.stage_dir or tempfile.mkdtemp(prefix="yacy-release-")
+        os.makedirs(stage, exist_ok=True)
+        path = os.path.join(stage, release.url.rsplit("/", 1)[-1])
+        tmp = path + ".part"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return path
