@@ -1,0 +1,158 @@
+"""Device-side conjunctive join over placed spans (SURVEY §7.1:
+'conjunctive join becomes sorted-id intersection on device').
+
+Oracle parity against the host join path (segment.join_constructive +
+CardinalRanker) on randomized corpora: multi-term conjunction, exclusion,
+tombstones, constraint filters, and the SearchEvent end-to-end wiring.
+"""
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.ops.ranking import CardinalRanker, RankingProfile
+from yacy_search_server_tpu.utils.hashes import word2hash
+
+
+def _plist(rng, n, id_pool):
+    docids = np.sort(rng.choice(id_pool, n, replace=False)).astype(np.int32)
+    feats = np.zeros((n, P.NF), np.int32)
+    feats[:, P.F_HITCOUNT] = rng.integers(1, 60, n)
+    feats[:, P.F_WORDS_IN_TEXT] = rng.integers(50, 3000, n)
+    feats[:, P.F_LASTMOD] = rng.integers(18000, 21000, n)
+    feats[:, P.F_POSINTEXT] = rng.integers(1, 4000, n)
+    feats[:, P.F_WORDS_IN_TITLE] = rng.integers(0, 10, n)
+    feats[:, P.F_LANGUAGE] = np.where(
+        rng.random(n) < 0.7, P.pack_language("en"), P.pack_language("de"))
+    feats[:, P.F_FLAGS] = rng.integers(0, 2**26, n)
+    return PostingsList(docids, feats)
+
+
+@pytest.fixture()
+def seg3():
+    """Three overlapping terms in one frozen, device-placed run."""
+    seg = Segment(max_ram_postings=10)
+    rng = np.random.default_rng(3)
+    pool = np.arange(60_000)
+    seg.rwi.ingest_run({
+        word2hash("aa"): _plist(rng, 20_000, pool),
+        word2hash("bb"): _plist(rng, 9_000, pool),
+        word2hash("cc"): _plist(rng, 5_000, pool),
+    })
+    seg.enable_device_serving()
+    yield seg
+    seg.close()
+
+
+def _host_oracle(seg, inc, exc, k=50, profile=None):
+    joined = seg.term_search(include_hashes=inc, exclude_hashes=exc)
+    if len(joined) == 0:
+        return np.empty(0, np.int64), np.empty(0, np.int32)
+    hs, hd = CardinalRanker(profile or RankingProfile()).rank(joined, k=k)
+    return np.asarray(hs, np.int64), np.asarray(hd)
+
+
+def _assert_join_matches(seg, inc, exc, k=50, **kw):
+    out = seg.devstore.rank_join(inc, exc, RankingProfile(), "en", k=k,
+                                 **kw)
+    assert out is not None, f"unexpected fallback ({seg.devstore.fallbacks})"
+    s, d, _considered = out
+    hs, hd = _host_oracle(seg, inc, exc, k=k)
+    np.testing.assert_array_equal(np.asarray(d)[:len(hd)], hd)
+    np.testing.assert_array_equal(np.asarray(s, np.int64)[:len(hs)], hs)
+    return out
+
+
+def test_two_term_parity(seg3):
+    _assert_join_matches(seg3, [word2hash("aa"), word2hash("bb")], [])
+
+
+def test_three_term_parity(seg3):
+    _assert_join_matches(
+        seg3, [word2hash("aa"), word2hash("bb"), word2hash("cc")], [])
+
+
+def test_exclusion_parity(seg3):
+    _assert_join_matches(seg3, [word2hash("aa"), word2hash("bb")],
+                         [word2hash("cc")])
+
+
+def test_join_with_tombstones(seg3):
+    # tombstone a slice of docids that appear in the join
+    joined = seg3.term_search(include_hashes=[word2hash("aa"),
+                                              word2hash("bb")])
+    victims = joined.docids[:40]
+    for docid in victims.tolist():
+        seg3.rwi.delete_doc(int(docid))
+    out = _assert_join_matches(seg3, [word2hash("aa"), word2hash("bb")], [])
+    s, d, _c = out
+    assert not set(victims.tolist()) & set(np.asarray(d).tolist())
+
+
+def test_join_language_filter(seg3):
+    inc = [word2hash("aa"), word2hash("bb")]
+    out = seg3.devstore.rank_join(
+        inc, [], RankingProfile(), "en", k=50,
+        lang_filter=P.pack_language("de"))
+    s, d, _c = out
+    # every hit's rare-term row is German (host recheck)
+    joined = seg3.term_search(include_hashes=inc)
+    langmap = dict(zip(joined.docids.tolist(),
+                       joined.feats[:, P.F_LANGUAGE].tolist()))
+    for docid in np.asarray(d).tolist():
+        assert langmap[docid] == P.pack_language("de")
+
+
+def test_empty_intersection(seg3):
+    seg = seg3
+    rng = np.random.default_rng(9)
+    # a term over a disjoint docid range: conjunction is empty
+    seg.rwi.ingest_run({word2hash("zz"): _plist(rng, 6_000,
+                                                np.arange(10**6, 10**6 + 50_000))})
+    out = seg.devstore.rank_join([word2hash("aa"), word2hash("zz")], [],
+                                 RankingProfile(), "en", k=20)
+    s, d, _c = out
+    assert len(d) == 0
+
+
+def test_fallback_on_unpacked_term(seg3):
+    # a term living only in the RAM buffer is not joinable on device
+    seg3.rwi.add(word2hash("fresh"), 7,
+                 np.zeros(P.NF, np.int32))
+    out = seg3.devstore.rank_join([word2hash("aa"), word2hash("fresh")],
+                                  [], RankingProfile(), "en", k=10)
+    assert out is None
+
+
+def test_searchevent_uses_device_join(monkeypatch, seg3):
+    from yacy_search_server_tpu.ops import ranking as mod
+    from yacy_search_server_tpu.search.query import QueryParams
+    from yacy_search_server_tpu.search.searchevent import SearchEvent
+    monkeypatch.setattr(mod, "SMALL_RANK_N", 0)
+    served0 = seg3.devstore.queries_served
+    q = QueryParams.parse("x")          # build then override the goal
+    q.goal._include_hashes_override = [word2hash("aa"), word2hash("bb")]
+    q.goal._exclude_hashes_override = [word2hash("cc")]
+    ev = SearchEvent(q, seg3)
+    assert seg3.devstore.queries_served == served0 + 1
+    # page scores match the host oracle's top scores
+    hs, hd = _host_oracle(seg3, q.goal.include_hashes,
+                          q.goal.exclude_hashes, k=30)
+    pending = dict((docid, score)
+                   for score, docid in ev._pending)
+    for docid, score in zip(hd[:10].tolist(), hs[:10].tolist()):
+        # entries either drained already or still pending with the score
+        assert pending.get(docid, score) == score
+
+
+def test_single_include_with_exclusion(seg3):
+    """1-include + exclusion is a served device shape (review fix)."""
+    out = _assert_join_matches(seg3, [word2hash("aa")], [word2hash("cc")])
+    assert out is not None
+
+
+def test_plain_single_term_not_joined(seg3):
+    assert seg3.devstore.rank_join([word2hash("aa")], [],
+                                   RankingProfile(), "en", k=10) is None

@@ -97,15 +97,18 @@ _PMAX_MARGIN_EXTRA = 64
 class Span:
     """One packed extent of a (run, term): arena rows + prune side-table."""
 
-    __slots__ = ("start", "count", "tstart", "tcount", "stats", "dead_seq")
+    __slots__ = ("start", "count", "tstart", "tcount", "stats", "dead_seq",
+                 "jstart")
 
     def __init__(self, start, count, tstart=-1, tcount=0, stats=None,
-                 dead_seq=-1):
+                 dead_seq=-1, jstart=-1):
         self.start = start
         self.count = count
         self.tstart = tstart      # first row in the pmax side-table
         self.tcount = tcount      # tiles in the side-table
         self.stats = stats        # frozen pack-time normalization stats
+        self.jstart = jstart      # first row in the join side-table
+        #                           (-1: no docid-sorted view packed)
         # tombstone count at the span's run creation: pruning (frozen
         # stats) is exact only while no tombstone postdates the span —
         # sp.dead_seq == len(rwi tombstones) means none does; -1 = unknown
@@ -270,6 +273,130 @@ def _rank_spans_kernel(feats16, flags, docids, dead,
     return run
 
 
+# docids are bounded below 2^29 so key = docid*2+tag fits int32 (the
+# sort-merge membership packs an A/B tag into the key's low bit)
+_JOIN_DOCID_CAP = 1 << 29
+
+
+def _membership_sorted(jdocids, jpos, lo, m, targets, a_valid,
+                       b_count_traced=None):
+    """Membership + partner-row lookup of `targets` (unsorted) inside the
+    docid-sorted segment jdocids[lo:lo+m] (m static), via ONE device sort
+    instead of per-lane binary search — random gathers are the slow path
+    on TPU (~8 µs/k rows), sorts are fast.
+
+    Tag trick: sort keys docid*2 for targets (A) and docid*2+1 for the
+    segment (B); ties order A immediately before its matching B, so a
+    shifted equality compare yields membership and the co-sorted payload
+    carries the partner's arena row. Results scatter back to A order.
+    Returns (found[r] bool, partner_row[r] int32)."""
+    r = targets.shape[0]
+    bd = lax.dynamic_slice(jdocids, (lo,), (m,))
+    bp = lax.dynamic_slice(jpos, (lo,), (m,))
+    # mask rows past the segment's true length: the static window may
+    # overrun into the NEXT term's sorted segment (append padding is per
+    # run, not per term), and those rows hold real docids
+    b_count = m if b_count_traced is None else b_count_traced
+    b_valid = jnp.arange(m) < b_count
+    # clamp pads out of the docid space: B pads become an odd key with
+    # no even partner; invalid A rows get key -2
+    a_key = jnp.where(a_valid, jnp.clip(targets, 0, _JOIN_DOCID_CAP), -1) \
+        * 2
+    b_key = jnp.where(b_valid,
+                      jnp.minimum(bd, _JOIN_DOCID_CAP + 1),
+                      _JOIN_DOCID_CAP + 1) * 2 + 1
+    keys = jnp.concatenate([a_key, b_key])
+    # payload: A rows carry their original index; B rows carry arena row
+    payload = jnp.concatenate([jnp.arange(r, dtype=jnp.int32), bp])
+    sk, sp = lax.sort((keys, payload), num_keys=1)
+    next_key = jnp.concatenate([sk[1:], jnp.full((1,), -5, jnp.int32)])
+    next_pay = jnp.concatenate([sp[1:], jnp.zeros(1, jnp.int32)])
+    is_a = (sk & 1) == 0        # A keys are even, B keys odd
+    hit = is_a & (next_key == sk + 1)
+    # scatter back to A order; non-A lanes target index r -> dropped
+    a_idx = jnp.where(is_a, sp, r)
+    found = jnp.zeros(r, bool).at[a_idx].set(hit, mode="drop")
+    prow = jnp.zeros(r, jnp.int32).at[a_idx].set(
+        jnp.where(hit, next_pay, 0), mode="drop")
+    return found, prow
+
+
+@partial(jax.jit, static_argnames=("k", "n_inc", "n_exc", "r",
+                                   "inc_ms", "exc_ms"))
+def _rank_join_kernel(feats16, flags, docids, dead, jdocids, jpos,
+                      qargs,
+                      norm_coeffs, flag_bits, flag_shifts,
+                      domlength_coeff, tf_coeff, language_coeff,
+                      authority_coeff, language_pref,
+                      k: int, n_inc: int, n_exc: int, r: int,
+                      inc_ms: tuple = (), exc_ms: tuple = ()):
+    """Device conjunction: slice the RAREST include term's whole span
+    (`r` = its statically bucketed row count), membership-test every
+    docid against the other include terms' docid-sorted side-tables via
+    ONE sort-merge membership per partner (and negated for excludes —
+    see _membership_sorted), gather partner rows, and merge features with the host join's
+    semantics (worddistance = position span across terms, hitcount =
+    min, flags = OR — segment.join_constructive). Then stats + score +
+    top-k over the merged rows.
+
+    Everything is single-pass big-tensor work, and every per-query
+    scalar rides in ONE packed int32 vector (`qargs`) — through a remote
+    tunnel each separate host scalar argument costs a transfer round
+    trip, which dwarfed the kernel itself. Layout:
+    [start, count, lang_filter, flag_bit, from_days, to_days,
+     inc_jstart*n_inc, inc_jcount*n_inc, exc_jstart*n_exc,
+     exc_jcount*n_exc]. This is the design stance's 'conjunctive join
+    becomes sorted-id intersection on device' (SURVEY §7.1) — postings
+    never leave HBM.
+    """
+    start, count = qargs[0], qargs[1]
+    lang_filter, flag_bit = qargs[2], qargs[3]
+    from_days, to_days = qargs[4], qargs[5]
+    base = 6
+    f = lax.dynamic_slice(feats16, (start, 0), (r, P.NF)).astype(jnp.int32)
+    fl = lax.dynamic_slice(flags, (start,), (r,))
+    dd = lax.dynamic_slice(docids, (start,), (r,))
+    v = _tile_valid(dd, dead, jnp.arange(r) < count)
+
+    pos_min = f[:, P.F_POSINTEXT]
+    pos_max = f[:, P.F_POSINTEXT]
+    hit_min = f[:, P.F_HITCOUNT]
+    flags_or = fl
+    for t in range(n_inc):
+        lo = qargs[base + t]
+        cnt = qargs[base + n_inc + t]
+        found, prow = _membership_sorted(jdocids, jpos, lo, inc_ms[t],
+                                         dd, v, cnt)
+        v &= found
+        pf = feats16[prow].astype(jnp.int32)
+        pos_min = jnp.minimum(pos_min, pf[:, P.F_POSINTEXT])
+        pos_max = jnp.maximum(pos_max, pf[:, P.F_POSINTEXT])
+        hit_min = jnp.minimum(hit_min, pf[:, P.F_HITCOUNT])
+        # partner rows for misses gather row 0's flags — mask them out
+        flags_or = flags_or | jnp.where(found, flags[prow], 0)
+    for e in range(n_exc):
+        lo = qargs[base + 2 * n_inc + e]
+        cnt = qargs[base + 2 * n_inc + n_exc + e]
+        found, _prow = _membership_sorted(jdocids, jpos, lo, exc_ms[e],
+                                          dd, v, cnt)
+        v &= ~found
+
+    merged = f.at[:, P.F_WORDDISTANCE].set(pos_max - pos_min)
+    merged = merged.at[:, P.F_HITCOUNT].set(hit_min)
+    v &= _constraint_valid(merged, flags_or, lang_filter, flag_bit,
+                           from_days, to_days)
+
+    stats = local_stats(merged, v, jnp.zeros(r, jnp.int32),
+                        num_hosts=1, with_host_counts=False)
+    sc = cardinal_from_stats(
+        merged, v, jnp.zeros(r, jnp.int32), stats,
+        norm_coeffs, flag_bits, flag_shifts, domlength_coeff,
+        tf_coeff, language_coeff, authority_coeff, language_pref,
+        flags=flags_or)
+    top_s, idx = lax.top_k(sc, min(k, r))
+    return top_s, dd[idx]
+
+
 def _pruned_span_topk(feats16, flags, docids, dead, pmax,
                       start, count, tstart, tcount,
                       col_min, col_max, tf_min, tf_max,
@@ -419,6 +546,13 @@ class DeviceArena:
         self._tcap = 1 << 12
         self._tused = 0
         self._pmax = self._dev(np.full(self._tcap, INT32_MAX, np.int32))
+        # join side-table: per-span docid-SORTED views (docid + the arena
+        # row it lives at) — the device conjunction's lookup structure.
+        # Pad slots hold INT32_MAX so binary search stays monotone.
+        self._jcap = 1 << 12
+        self._jused = 0
+        self._jdocids = self._dev(np.full(self._jcap, INT32_MAX, np.int32))
+        self._jpos = self._dev(np.zeros(self._jcap, np.int32))
 
     def _dev(self, arr):
         return jax.device_put(arr, self.device)
@@ -493,23 +627,62 @@ class DeviceArena:
         self._used += n
         return base
 
+    @staticmethod
+    def _sidetable_bucket(n: int) -> int:
+        return 1 << max(8, (n - 1).bit_length())  # min bucket 256 rows
+
+    def _sidetable_write(self, arrays, bufs, used, cap_attr):
+        """Shared side-table growth + write (pmax and join tables use the
+        same pad-doubling allocation); returns (new_arrays, start)."""
+        b = len(bufs[0])
+        cap = getattr(self, cap_attr)
+        while cap < used + b:
+            arrays = [jnp.pad(a, (0, cap), constant_values=f)
+                      for a, f in zip(arrays, self._sidetable_fills)]
+            cap *= 2
+        setattr(self, cap_attr, cap)
+        off = np.int32(used)
+        arrays = [_write_rows1(a, self._dev(buf), off)
+                  for a, buf in zip(arrays, bufs)]
+        return arrays, used
+
     def append_pmax(self, pmax: np.ndarray) -> int:
         """Add a span's per-tile bound row to the side-table; returns its
         start. Pad slots hold INT32_MAX (an always-failing bound — never
         consulted because tcount caps the tail walk)."""
         n = len(pmax)
-        b = 1 << max(8, (n - 1).bit_length())  # min bucket 256 rows
-        while self._tcap < self._tused + b:
-            self._pmax = jnp.pad(self._pmax, (0, self._tcap),
-                                 constant_values=INT32_MAX)
-            self._tcap *= 2
+        b = self._sidetable_bucket(n)
         buf = np.full(b, INT32_MAX, np.int32)
         buf[:n] = pmax
-        self._pmax = _write_rows1(self._pmax, self._dev(buf),
-                                  np.int32(self._tused))
-        start = self._tused
+        self._sidetable_fills = (INT32_MAX,)
+        (self._pmax,), start = self._sidetable_write(
+            [self._pmax], [buf], self._tused, "_tcap")
         self._tused += n
         return start
+
+    def append_join_index(self, sorted_docids: np.ndarray,
+                          sorted_pos: np.ndarray) -> int:
+        """Add spans' docid-sorted (docid, arena-row) views; returns the
+        start offset. Caller concatenates per-term segments — each term's
+        segment is internally sorted; offsets address the segments. Pad
+        slots hold INT32_MAX docids (monotone; masked by segment counts
+        on the read side)."""
+        n = len(sorted_docids)
+        if n == 0:
+            return self._jused
+        b = self._sidetable_bucket(n)
+        dbuf = np.full(b, INT32_MAX, np.int32)
+        pbuf = np.zeros(b, np.int32)
+        dbuf[:n], pbuf[:n] = sorted_docids, sorted_pos
+        self._sidetable_fills = (INT32_MAX, 0)
+        (self._jdocids, self._jpos), start = self._sidetable_write(
+            [self._jdocids, self._jpos], [dbuf, pbuf], self._jused,
+            "_jcap")
+        self._jused += n
+        return start
+
+    def join_arrays(self):
+        return self._jdocids, self._jpos
 
     def mark_dead(self, docid: int) -> None:
         self._pending_dead.append(docid)
@@ -727,10 +900,13 @@ class DeviceSegmentStore:
             base = self.arena.used_rows
             margin = (1 << _PROXY_PROFILE.tf) + _PMAX_MARGIN_EXTRA
             lang_en = P.pack_language("en")
-            meta: list[tuple] = []   # (th, rel_off, n, rel_toff, n_tiles, stats)
+            meta: list[tuple] = []   # (th, rel_off, n, rel_toff, n_tiles,
+            #                           stats, rel_joff)
             pmax_parts: list[np.ndarray] = []
+            join_dd_parts: list[np.ndarray] = []
+            join_pos_parts: list[np.ndarray] = []
             pending: list[tuple[np.ndarray, np.ndarray]] = []
-            off = toff = 0
+            off = toff = joff = 0
             for th in list(run.term_hashes()):
                 p = run.get(th)
                 if p is None or len(p) == 0:
@@ -743,10 +919,18 @@ class DeviceSegmentStore:
                 n_tiles = (n + TILE - 1) // TILE
                 pmax_parts.append(np.minimum(
                     proxy[order][::TILE] + margin, INT32_MAX).astype(np.int32))
-                meta.append((th, off, n, toff, n_tiles, stats))
+                packed_dd = p.docids[order]
+                # docid-sorted view of the packed rows: the device
+                # conjunction's binary-search table (absolute arena rows)
+                jorder = np.argsort(packed_dd, kind="stable")
+                join_dd_parts.append(packed_dd[jorder].astype(np.int32))
+                join_pos_parts.append(
+                    (base + off + jorder).astype(np.int32))
+                meta.append((th, off, n, toff, n_tiles, stats, joff))
                 off += n
                 toff += n_tiles
-                pending.append((p.docids[order], p.feats[order]))
+                joff += n
+                pending.append((packed_dd, p.feats[order]))
             if pending:
                 # one arena write for the whole run (transient host buffer
                 # of the run's size; see append_block)
@@ -754,10 +938,15 @@ class DeviceSegmentStore:
             tbase = self.arena.append_pmax(
                 np.concatenate(pmax_parts) if pmax_parts
                 else np.empty(0, np.int32))
+            jbase = self.arena.append_join_index(
+                np.concatenate(join_dd_parts) if join_dd_parts
+                else np.empty(0, np.int32),
+                np.concatenate(join_pos_parts) if join_pos_parts
+                else np.empty(0, np.int32))
             dseq = getattr(run, "dead_seq", -1)
             self._packed[rid] = {
-                th: Span(base + o, n, tbase + to, nt, st, dseq)
-                for th, o, n, to, nt, st in meta}
+                th: Span(base + o, n, tbase + to, nt, st, dseq, jbase + jo)
+                for th, o, n, to, nt, st, jo in meta}
             track(EClass.INDEX, "devstore_pack", rows)
 
     def on_run_removed(self, run) -> None:
@@ -863,6 +1052,123 @@ class DeviceSegmentStore:
                                 put(np.int32(P.pack_language(language))))
                 self._profile_key = key
             return self._consts
+
+    # the join kernel compiles per (k, n_inc, n_exc, bucketed rare size);
+    # cap term counts so hostile many-term queries cannot mint unbounded
+    # compile shapes, and cap the rare-span window's transient memory
+    # (int32 merged features ~68 B/row: 4M rows ≈ 280 MB)
+    MAX_JOIN_TERMS = 6
+    MAX_JOIN_ROWS = 4_194_304
+
+    def rank_join(self, include_hashes, exclude_hashes, profile,
+                  language: str = "en", k: int = 100,
+                  lang_filter: int = NO_LANG, flag_bit: int = NO_FLAG,
+                  from_days: int | None = None, to_days: int | None = None):
+        """Multi-term conjunctive ranked top-k entirely on device.
+
+        Streams the rarest include term's placed span and joins the other
+        terms (and negates the exclude terms) by binary search in their
+        docid-sorted side-tables — postings never leave HBM
+        (segment.join_constructive + TermSearch semantics, the SURVEY
+        §7.1 'sorted-id intersection on device'). Returns
+        (scores, docids, considered) or None when any term is not a
+        single fully-packed span or carries an unflushed RAM delta
+        (caller falls back to the host join)."""
+        include_hashes = list(include_hashes)
+        exclude_hashes = list(exclude_hashes or [])
+        # shapes served: >=2 includes, or 1 include with exclusions
+        # (plain single-term queries belong to the pruned rank_term path)
+        if not include_hashes \
+                or (len(include_hashes) == 1 and not exclude_hashes) \
+                or len(include_hashes) > self.MAX_JOIN_TERMS \
+                or len(exclude_hashes) > self.MAX_JOIN_TERMS:
+            return None
+        with self._lock:
+            inc_spans = []
+            for th in include_hashes:
+                spans = self.spans_for(th)
+                if spans is None or len(spans) != 1 \
+                        or spans[0].jstart < 0:
+                    self.fallbacks += 1
+                    return None
+                inc_spans.append(spans[0])
+            exc_spans = []
+            for th in exclude_hashes:
+                spans = self.spans_for(th)
+                if spans is None:
+                    # term not packed at all: if it has no postings
+                    # anywhere it excludes nothing; otherwise fall back
+                    if self.rwi.has_term(th):
+                        self.fallbacks += 1
+                        return None
+                    continue
+                if len(spans) > 1 or (spans and spans[0].jstart < 0):
+                    self.fallbacks += 1
+                    return None
+                if spans:
+                    exc_spans.append(spans[0])
+            feats16, flags, docids = self.arena.arrays()
+            jdocids, jpos = self.arena.join_arrays()
+            dead = self.arena.dead_array()
+        # RAM deltas are not joinable on device (unsorted, host-side)
+        with self.rwi._lock:
+            for th in include_hashes + exclude_hashes:
+                if self.rwi._ram.get(th):
+                    self.fallbacks += 1
+                    return None
+
+        rare_i = min(range(len(inc_spans)),
+                     key=lambda i: inc_spans[i].count)
+        rare = inc_spans[rare_i]
+        partners = [sp for i, sp in enumerate(inc_spans) if i != rare_i]
+        considered = rare.count
+
+        # static span window: bucketed row count (bounded compile shapes),
+        # clamped so the slice never shifts (XLA clamps out-of-bounds
+        # dynamic_slice starts, which would misalign the validity mask).
+        # Caps come from the SNAPSHOT arrays — the live arena may grow or
+        # be swapped by a concurrent flush/repack after the lock released
+        r = min(_bucket_rows(rare.count),
+                int(feats16.shape[0]) - rare.start)
+        if r < rare.count or rare.count > self.MAX_JOIN_ROWS:
+            self.fallbacks += 1
+            return None
+
+        # static sorted-segment windows per partner (bucketed for a
+        # bounded compile-shape set); a window that cannot cover the
+        # segment inside the SNAPSHOT falls back to the host join
+        jcap = int(jdocids.shape[0])
+
+        def window(sp):
+            m = min(_bucket_rows(sp.count), jcap - sp.jstart)
+            return m if m >= sp.count else None
+
+        inc_ms = tuple(window(sp) for sp in partners)
+        exc_ms = tuple(window(sp) for sp in exc_spans)
+        if any(m is None for m in inc_ms + exc_ms):
+            self.fallbacks += 1
+            return None
+
+        consts = self._profile_consts(profile, language)
+        kk = max(16, 1 << (max(k, 1) - 1).bit_length())
+        # one packed per-query vector = one host->device transfer (the
+        # tunnel charges a round trip per separate argument)
+        qargs = np.asarray(
+            [rare.start, rare.count, lang_filter, flag_bit,
+             DAYS_NONE_LO if from_days is None else from_days,
+             DAYS_NONE_HI if to_days is None else to_days]
+            + [sp.jstart for sp in partners]
+            + [sp.count for sp in partners]
+            + [sp.jstart for sp in exc_spans]
+            + [sp.count for sp in exc_spans], np.int32)
+        s, d = _rank_join_kernel(
+            feats16, flags, docids, dead, jdocids, jpos, qargs,
+            *consts, k=kk, n_inc=len(partners), n_exc=len(exc_spans),
+            r=r, inc_ms=inc_ms, exc_ms=exc_ms)
+        s, d = np.asarray(s), np.asarray(d)
+        keep = (d >= 0) & (s > NEG_INF32)
+        self.queries_served += 1
+        return s[keep][:k], d[keep][:k], considered
 
     def rank_term(self, termhash: bytes, profile, language: str = "en",
                   k: int = 100,

@@ -225,22 +225,26 @@ class SearchEvent:
 
     def _device_local(self, k: int):
         """Eligibility gate + dispatch for the device-resident serving path
-        (index/devstore.py). Query shapes needing host-side data fall back:
-        multi-term joins and exclusions (host sorted-intersect), metadata
-        modifiers (site:/tld:/filetype:/protocol), date-sort, and
-        authority-boosted profiles (host-count stats)."""
+        (index/devstore.py). Plain single terms rank via the pruned span
+        scan; conjunctions — and single terms with exclusions — via the
+        device join (sort-merge over docid-sorted side-tables). Query
+        shapes needing host-side data still fall back: metadata modifiers
+        (site:/tld:/filetype:/protocol), date-sort, and authority-boosted
+        profiles."""
         q = self.query
         ds = self.segment.devstore
         if ds is None:
             return None
         inc, exc = q.goal.include_hashes, q.goal.exclude_hashes
-        if len(inc) != 1 or exc:
+        if not inc:
             return None
-        # tiny terms: the host path scores them in microseconds
+        # tiny candidate sets: the host path scores them in microseconds
         # (ops/ranking.SMALL_RANK_N numpy twin); a device dispatch — and
-        # through a remote tunnel, a full round trip — would dominate
+        # through a remote tunnel, a full round trip — would dominate.
+        # A conjunction's join size is bounded by its RAREST term.
         from ..ops.ranking import SMALL_RANK_N
-        if self.segment.rwi.count_upper(inc[0]) <= SMALL_RANK_N:
+        if min(self.segment.rwi.count_upper(th)
+               for th in inc) <= SMALL_RANK_N:
             return None
         m = q.modifier
         if m.sitehost or m.tld or m.filetype or m.protocol or m.date_sort:
@@ -249,12 +253,19 @@ class SearchEvent:
             return None
         from ..index.devstore import NO_FLAG, NO_LANG
         flag = _CD_FLAG.get(q.contentdom)
-        with StageTimer(EClass.SEARCH, "DEVRANK"):
-            return ds.rank_term(
-                inc[0], q.profile, q.lang, k=k,
-                lang_filter=(P.pack_language(m.language) if m.language
-                             else NO_LANG),
-                flag_bit=NO_FLAG if flag is None else flag,
+        lang_filter = (P.pack_language(m.language) if m.language
+                       else NO_LANG)
+        flag_bit = NO_FLAG if flag is None else flag
+        if len(inc) == 1 and not exc:
+            with StageTimer(EClass.SEARCH, "DEVRANK"):
+                return ds.rank_term(
+                    inc[0], q.profile, q.lang, k=k,
+                    lang_filter=lang_filter, flag_bit=flag_bit,
+                    from_days=m.from_days, to_days=m.to_days)
+        with StageTimer(EClass.SEARCH, "DEVJOIN"):
+            return ds.rank_join(
+                inc, exc, q.profile, q.lang, k=k,
+                lang_filter=lang_filter, flag_bit=flag_bit,
                 from_days=m.from_days, to_days=m.to_days)
 
     def _dense_rerank(self, scores, docids):
