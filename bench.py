@@ -321,6 +321,56 @@ def _config3_sharded(k=100, iters=10):
     _emit(f"bm25_sharded_{ndev}way_qps_1M_docs", qps, "queries/sec", 0.0)
 
 
+def _config8_device_join(iters=10):
+    """Config #8: multi-term conjunction served from placed device spans
+    (M44) vs the host join+rank path, 1M x 300k postings with an 80k
+    exclusion term."""
+    import numpy as np
+    from yacy_search_server_tpu.index.segment import Segment
+    from yacy_search_server_tpu.index.postings import PostingsList
+    from yacy_search_server_tpu.index import postings as P
+    from yacy_search_server_tpu.ops.ranking import (CardinalRanker,
+                                                    RankingProfile)
+    from yacy_search_server_tpu.utils.hashes import word2hash
+    seg = Segment(max_ram_postings=10**9)
+    rng = np.random.default_rng(0)
+
+    def plist(n, pool):
+        docids = np.sort(rng.choice(pool, n, replace=False)).astype(np.int32)
+        feats = np.zeros((n, P.NF), np.int32)
+        feats[:, P.F_HITCOUNT] = rng.integers(1, 50, n)
+        feats[:, P.F_WORDS_IN_TEXT] = rng.integers(50, 3000, n)
+        feats[:, P.F_LASTMOD] = rng.integers(18000, 21000, n)
+        feats[:, P.F_POSINTEXT] = rng.integers(1, 4000, n)
+        return PostingsList(docids, feats)
+
+    pool = np.arange(3_000_000)
+    inc = [word2hash("alpha"), word2hash("beta")]
+    exc = [word2hash("gamma")]
+    seg.rwi.ingest_run({inc[0]: plist(1_000_000, pool),
+                        inc[1]: plist(300_000, pool),
+                        exc[0]: plist(80_000, pool)})
+    prof = RankingProfile()
+
+    # host twin: join + rank (the pre-M44 serving path)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        joined = seg.term_search(include_hashes=inc, exclude_hashes=exc)
+        CardinalRanker(prof).rank(joined, k=100)
+    host_s = (time.perf_counter() - t0) / 3
+
+    ds = seg.enable_device_serving()
+    out = ds.rank_join(inc, exc, prof, "en", k=100)
+    assert out is not None
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ds.rank_join(inc, exc, prof, "en", k=100)
+    dev_s = (time.perf_counter() - t0) / iters
+    seg.close()
+    _emit("device_join_qps_1Mx300k", 1.0 / dev_s, "queries/sec",
+          host_s / dev_s)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000_000,
@@ -328,7 +378,7 @@ def main():
     ap.add_argument("--k", type=int, default=100)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--cpu-iters", type=int, default=3)
-    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5, 6, 7],
+    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5, 6, 7, 8],
                     help="run a BASELINE.md benchmark config instead of "
                          "the headline metric")
     args = ap.parse_args()
@@ -340,7 +390,8 @@ def main():
     if args.config:
         {1: _config1_bm25_cpu_baseline, 2: _config2_bm25_tpu,
          3: _config3_sharded, 4: _config4_p2p_fusion,
-         5: _config5_hybrid, 7: _config7_kernel}[args.config]()
+         5: _config5_hybrid, 7: _config7_kernel,
+         8: _config8_device_join}[args.config]()
         return
 
     # ------------------------------------------------------------------
