@@ -216,3 +216,19 @@ def test_kernel_compiles_on_real_backend():
         pytest.skip("no non-CPU jax backend visible")
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "DEVICE_OK" in out
+
+
+def test_migrate_data_backfills_url_protocol(tmp_path):
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.index.segment import Segment
+    from yacy_search_server_tpu.migration import migrate_data
+    seg = Segment(data_dir=str(tmp_path / "p"))
+    docid = seg.store_document(Document(
+        url="https://p.test/x", title="T", text="protocol row"))
+    seg.metadata.set_fields(docid, url_protocol_s="")   # pre-0.3.1 row
+    migrate_data(seg, str(tmp_path / "p"), "0.3.1")
+    assert seg.metadata.row(docid).get("url_protocol_s") == "https"
+    # the facet index follows the backfill (protocol: filter works)
+    assert docid in seg.metadata.facet_docids(
+        "url_protocol_s", "https").tolist()
+    seg.close()

@@ -186,6 +186,12 @@ class LazyRow:
         return default
 
 
+# low-cardinality columns carrying query modifiers (site:/filetype:/
+# protocol:): an inverted value->docids index turns the per-row filter
+# loop into a per-distinct-value loop + one isin
+FACET_FIELDS = ("host_s", "url_file_ext_s", "url_protocol_s")
+
+
 class MetadataStore:
     """docid-addressed columnar store with urlhash identity index."""
 
@@ -198,6 +204,10 @@ class MetadataStore:
         self._ints: dict[str, list] = {f: [] for f in INT_FIELDS}
         self._doubles: dict[str, list] = {f: [] for f in DOUBLE_FIELDS}
         self._deleted: set[int] = set()
+        # facet indexes: field -> value -> docid list (append-only; the
+        # alive mask filters deletions at read time)
+        self._facets: dict[str, dict[str, list[int]]] = {
+            f: {} for f in FACET_FIELDS}
         self._journal = None
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
@@ -237,6 +247,10 @@ class MetadataStore:
                 self._ints[f].append(int(doc.get(f, 0)))
             for f in DOUBLE_FIELDS:
                 self._doubles[f].append(float(doc.get(f, 0.0)))
+            for f in FACET_FIELDS:
+                v = str(doc.get(f, "") or "").lower()
+                if v:
+                    self._facets[f].setdefault(v, []).append(docid)
             self._journal_write(doc)
             return docid
 
@@ -265,6 +279,14 @@ class MetadataStore:
                 self._ints[f].extend(columns.get(f) or [0] * n)
             for f in DOUBLE_FIELDS:
                 self._doubles[f].extend(columns.get(f) or [0.0] * n)
+            for f in FACET_FIELDS:
+                col = columns.get(f)
+                if col:
+                    idx = self._facets[f]
+                    for i, v in enumerate(col):
+                        v = str(v or "").lower()
+                        if v:
+                            idx.setdefault(v, []).append(base + i)
             return base
 
     def set_field(self, docid: int, field: str, value) -> None:
@@ -289,6 +311,16 @@ class MetadataStore:
                 else:
                     raise KeyError(field)
                 if col[docid] != value:
+                    if field in FACET_FIELDS:
+                        # facet maintenance (rare: these fields normally
+                        # never change after put — migrations backfill)
+                        old = str(col[docid] or "").lower()
+                        if old and docid in self._facets[field].get(old, ()):
+                            self._facets[field][old].remove(docid)
+                        new = str(value or "").lower()
+                        if new:
+                            self._facets[field].setdefault(
+                                new, []).append(docid)
                     col[docid] = value
                     changed[field] = value
             if changed and self._journal:
@@ -379,6 +411,41 @@ class MetadataStore:
             if self._deleted:
                 m[list(self._deleted)] = False
             return m
+
+    def facet_docids(self, field: str, match) -> np.ndarray:
+        """Sorted docids whose `field` value satisfies `match` (a value
+        string for equality, or a predicate over the lowercased value).
+        Iterates DISTINCT VALUES, not rows — the vectorized replacement of
+        the per-row modifier filters (site:/tld:/filetype:/protocol).
+        Deleted docids are excluded."""
+        idx = self._facets[field]
+        with self._lock:
+            if callable(match):
+                lists = [docs for v, docs in idx.items() if match(v)]
+            else:
+                lists = [idx.get(str(match).lower(), [])]
+            out = (np.sort(np.concatenate(
+                [np.asarray(ls, dtype=np.int32) for ls in lists]))
+                if any(len(ls) for ls in lists)
+                else np.empty(0, np.int32))
+            if self._deleted and len(out):
+                out = out[self._alive_array()[out]]
+            return out
+
+    def _alive_array(self) -> np.ndarray:
+        """Cached per-docid liveness (caller holds the lock): rebuilt only
+        when deletions changed, so facet filters cost O(result), not
+        O(total deletions ever)."""
+        cached = getattr(self, "_alive_cache", None)
+        if cached is not None and cached[0] == len(self._deleted) \
+                and len(cached[1]) >= len(self._urlhashes):
+            return cached[1]
+        m = np.ones(len(self._urlhashes), dtype=bool)
+        if self._deleted:
+            m[np.fromiter(self._deleted, dtype=np.int64,
+                          count=len(self._deleted))] = False
+        self._alive_cache = (len(self._deleted), m)
+        return m
 
     def hosthash_groups(self) -> dict[bytes, list[int]]:
         """hosthash -> docids (authority/doubledom signals)."""

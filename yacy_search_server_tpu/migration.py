@@ -78,8 +78,30 @@ def _d_backfill_signatures(segment) -> int:
     return fixed
 
 
+def _d_backfill_url_protocol(segment) -> int:
+    """0.3.1: url_protocol_s feeds the protocol: modifier's facet index —
+    derive it from the stored url for rows written by older releases."""
+    meta = segment.metadata
+    fixed = 0
+    for docid in range(meta.capacity()):
+        if meta.is_deleted(docid):
+            continue
+        row = meta.row(docid)
+        if row.get("url_protocol_s", ""):
+            continue
+        sku = row.get("sku", "")
+        scheme = sku.split("://", 1)[0].lower() if "://" in sku else ""
+        if scheme:
+            meta.set_fields(docid, url_protocol_s=scheme)
+            fixed += 1
+    return fixed
+
+
 DATA_MIGRATIONS: list[tuple[str, object]] = [
     ("0.3.0", _d_backfill_signatures),
+    # 0.3.1, not 0.3.0: stores started by a 0.3.0 build already carry
+    # STORE_VERSION=0.3.0 and would skip a step registered there
+    ("0.3.1", _d_backfill_url_protocol),
 ]
 
 
