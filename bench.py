@@ -371,6 +371,39 @@ def _config8_device_join(iters=10):
           host_s / dev_s)
 
 
+def _config9_indexing(ndocs=2000):
+    """Config #9: indexing write-path throughput — parse + condense +
+    store_document (RWI append, metadata, citations, webgraph, dense
+    vector) for realistic small HTML pages, docs/sec."""
+    import tempfile
+
+    from yacy_search_server_tpu.document.parser.registry import parse_source
+    from yacy_search_server_tpu.index.segment import Segment
+
+    pages = []
+    for i in range(ndocs):
+        body = " ".join(f"word{(i * 37 + j) % 5000}" for j in range(150))
+        pages.append((
+            f"http://h{i % 97}.bench/p{i}.html",
+            (f"<html><head><title>Page {i}</title></head><body>"
+             f"<h1>Heading {i}</h1><p>{body}</p>"
+             f"<a href='/p{(i + 1) % ndocs}.html'>next</a>"
+             f"<a href='http://ext{i % 13}.bench/'>out</a>"
+             f"</body></html>").encode()))
+    with tempfile.TemporaryDirectory() as tmp:
+        seg = Segment(data_dir=f"{tmp}/seg")
+        t0 = time.perf_counter()
+        for url, html in pages:
+            doc = parse_source(url, "text/html", html)[0]
+            seg.store_document(doc, crawldepth=1)
+        dt = time.perf_counter() - t0
+        seg.close()
+    dps = ndocs / dt
+    # reference anchor: default remote-crawl budget is 60 pages/minute
+    # (Switchboard.java:1271) = 1 doc/sec
+    _emit("indexing_docs_per_sec", dps, "docs/sec", dps / 1.0)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000_000,
@@ -378,7 +411,8 @@ def main():
     ap.add_argument("--k", type=int, default=100)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--cpu-iters", type=int, default=3)
-    ap.add_argument("--config", type=int, choices=[1, 2, 3, 4, 5, 6, 7, 8],
+    ap.add_argument("--config", type=int,
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9],
                     help="run a BASELINE.md benchmark config instead of "
                          "the headline metric")
     args = ap.parse_args()
@@ -391,7 +425,8 @@ def main():
         {1: _config1_bm25_cpu_baseline, 2: _config2_bm25_tpu,
          3: _config3_sharded, 4: _config4_p2p_fusion,
          5: _config5_hybrid, 7: _config7_kernel,
-         8: _config8_device_join}[args.config]()
+         8: _config8_device_join,
+         9: _config9_indexing}[args.config]()
         return
 
     # ------------------------------------------------------------------

@@ -113,3 +113,57 @@ def test_hybrid_search_end_to_end(tmp_path):
     # the dense stage actually changed the decision
     assert sparse_first == "http://a.test/off"
     seg.close()
+
+
+def test_encoder_version_migration(tmp_path):
+    """Vectors hashed by an older encoder re-encode on upgrade (the
+    feature hash changed in ENCODER_VERSION 2)."""
+    import os
+
+    import numpy as np
+
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.index.segment import Segment
+    from yacy_search_server_tpu.migration import migrate_data
+    d = str(tmp_path / "seg")
+    seg = Segment(data_dir=d)
+    docid = seg.store_document(Document(
+        url="http://v.test/", title="Versioned", text="encoder text body"))
+    seg.close()
+    # simulate a store written by the v1 encoder: corrupt the vector and
+    # stamp the old version
+    os.remove(os.path.join(d, "dense", "ENCODER_VERSION"))
+    seg2 = Segment(data_dir=d)
+    seg2.dense._vecs[docid] = 0.0
+    assert seg2.dense.stale_encoder
+    touched = migrate_data(seg2, d, "0.3.2")
+    assert touched >= 1
+    assert not seg2.dense.stale_encoder
+    want = seg2.encoder.encode("Versioned\nencoder text body")
+    np.testing.assert_allclose(
+        np.asarray(seg2.dense.get_block(np.asarray([docid]))[0],
+                   np.float32), want, atol=2e-3)
+    seg2.close()
+
+
+def test_stale_store_never_stamps_mid_migration(tmp_path):
+    """Auto-flushes during re-encode must not advance the encoder
+    version; a crash mid-migration stays re-runnable (review fix)."""
+    import os
+
+    from yacy_search_server_tpu.index.dense import DenseVectorStore
+    d = str(tmp_path / "dense")
+    st = DenseVectorStore(d)
+    st.put(0, np.ones(st.dim, np.float32))
+    st.close()
+    os.remove(os.path.join(d, "ENCODER_VERSION"))    # v1-era store
+    st2 = DenseVectorStore(d)
+    assert st2.stale_encoder
+    st2.put(1, np.ones(st2.dim, np.float32))
+    st2.flush()                                       # mid-migration flush
+    assert not os.path.exists(os.path.join(d, "ENCODER_VERSION"))
+    st2.close()
+    assert DenseVectorStore(d).stale_encoder          # still re-runnable
+    st3 = DenseVectorStore(d)
+    st3.mark_encoder_current()
+    assert not DenseVectorStore(d).stale_encoder

@@ -15,7 +15,7 @@ import threading
 
 import numpy as np
 
-from ..ops.dense import DIM
+from ..ops.dense import DIM, ENCODER_VERSION
 
 
 class DenseVectorStore:
@@ -26,6 +26,7 @@ class DenseVectorStore:
         self._n = 0
         self._lock = threading.Lock()
         self._dirty = 0
+        self.stale_encoder = False
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             p = self._path()
@@ -34,9 +35,24 @@ class DenseVectorStore:
                 if loaded.shape[1] == dim:
                     self._vecs = loaded.copy()
                     self._n = loaded.shape[0]
+                # vectors hashed by an older encoder cannot be compared
+                # with current query vectors; migration re-encodes
+                self.stale_encoder = (self._n > 0 and
+                                      self._load_version()
+                                      != ENCODER_VERSION)
 
     def _path(self) -> str:
         return os.path.join(self.data_dir, "vectors.npy")
+
+    def _version_path(self) -> str:
+        return os.path.join(self.data_dir, "ENCODER_VERSION")
+
+    def _load_version(self) -> int:
+        try:
+            with open(self._version_path(), encoding="ascii") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return 1    # pre-versioning stores used the v1 FNV hash
 
     def put(self, docid: int, vec: np.ndarray) -> None:
         with self._lock:
@@ -62,9 +78,25 @@ class DenseVectorStore:
         with open(tmp, "wb") as f:
             np.save(f, self._vecs[:max(self._n, 1)])
         os.replace(tmp, self._path())
+        # while the store is stale (migration in flight) the version
+        # marker must NOT advance: a crash mid-re-encode would otherwise
+        # mask the remaining v1 vectors as migrated forever
+        if not self.stale_encoder:
+            with open(self._version_path(), "w", encoding="ascii") as f:
+                f.write(str(ENCODER_VERSION))
         self._dirty = 0
 
-    def close(self) -> None:
+    def mark_encoder_current(self) -> None:
+        """Called by the migration AFTER every vector was re-encoded:
+        clears staleness and stamps the encoder version."""
+        with self._lock:
+            self.stale_encoder = False
+            self._save_locked()
+
+    def flush(self) -> None:
         if self.data_dir:
             with self._lock:
                 self._save_locked()
+
+    def close(self) -> None:
+        self.flush()

@@ -97,11 +97,34 @@ def _d_backfill_url_protocol(segment) -> int:
     return fixed
 
 
+def _d_reencode_dense(segment) -> int:
+    """0.3.2: the dense feature hash changed (ENCODER_VERSION 2) —
+    vectors stored under the old hash are incomparable with current
+    query vectors, so re-encode every live document from its stored
+    text. Embeddings are derivable data; the store marks itself stale
+    when its persisted encoder version is older."""
+    dense = segment.dense
+    if not getattr(dense, "stale_encoder", False):
+        return 0
+    meta = segment.metadata
+    fixed = 0
+    for docid in range(min(meta.capacity(), len(dense))):
+        if meta.is_deleted(docid):
+            continue
+        row = meta.row(docid)
+        text = f"{row.get('title', '')}\n{row.get('text_t', '')[:4096]}"
+        dense.put(docid, segment.encoder.encode(text))
+        fixed += 1
+    dense.mark_encoder_current()   # persist + stamp: migration complete
+    return fixed
+
+
 DATA_MIGRATIONS: list[tuple[str, object]] = [
     ("0.3.0", _d_backfill_signatures),
     # 0.3.1, not 0.3.0: stores started by a 0.3.0 build already carry
     # STORE_VERSION=0.3.0 and would skip a step registered there
     ("0.3.1", _d_backfill_url_protocol),
+    ("0.3.2", _d_reencode_dense),
 ]
 
 
