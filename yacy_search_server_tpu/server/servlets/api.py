@@ -61,8 +61,16 @@ def respond_postprocessing(header: dict, post: ServerObjects,
     """Trigger citation-rank postprocessing (reference: the postprocessing
     control on IndexControl; BlockRank evaluation)."""
     prop = ServerObjects()
-    from ...ops.blockrank import host_ranks, postprocess_segment
-    all_ranks = host_ranks(sb.web_structure)   # computed once per request
+    from ...ops.blockrank import (host_ranks, host_ranks_from_edges,
+                                  postprocess_segment)
+    # prefer the per-edge webgraph when it has data (richer than the
+    # host matrix: per-edge retirement on re-index, nofollow carried)
+    if len(sb.index.webgraph):
+        all_ranks = host_ranks_from_edges(sb.index.webgraph)
+        prop.put("source", "webgraph")
+    else:
+        all_ranks = host_ranks(sb.web_structure)
+        prop.put("source", "hostmatrix")
     if post.get("run"):
         prop.put("updated", postprocess_segment(
             sb.index, sb.web_structure, ranks=all_ranks))
