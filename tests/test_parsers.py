@@ -339,3 +339,16 @@ def test_torrent():
 def test_image_bad_container_rejected():
     with pytest.raises(ParserError):
         parse_source("http://ex.test/x.png", "image/png", b"not an image!!")
+
+
+def test_sevenzip_unpack_size_cap():
+    """A tiny archive declaring a huge unpack size must raise ParserError
+    before allocating (decompression bomb, ADVICE r2 medium)."""
+    from yacy_search_server_tpu.document.parser import sevenzip
+    f = sevenzip._Folder()
+    f.coder_id = b"\x00"
+    f.unpack_sizes = [sevenzip.MAX_UNPACK_SIZE + 1]
+    import pytest
+    from yacy_search_server_tpu.document.parser.errors import ParserError
+    with pytest.raises(ParserError):
+        f.decode(b"x")

@@ -262,3 +262,79 @@ def test_explicit_bad_cert_still_raises(tmp_path):
                            certfile="/nonexistent/cert.pem")
     finally:
         sb.close()
+
+
+# -- ADVICE r2 regression tests ------------------------------------------
+
+
+def test_digest_replay_rejected(node):
+    """A captured Authorization header must not replay: the nc counter is
+    tracked per nonce (ADVICE r2; RFC 7616 §5.12)."""
+    _sb, srv = node
+    status, headers, _b = _get(srv.base_url + "/PerformanceMemory_p.json")
+    assert status == 401
+    challenge = next(v.strip()[7:] for v in
+                     headers.get("WWW-Authenticate", "").split("\n")
+                     if v.strip().startswith("Digest"))
+    p = _parse_auth_params(challenge)
+    realm, nonce = p["realm"], p["nonce"]
+    uri = "/PerformanceMemory_p.json"
+    h1 = ha1("admin", realm, "sesame")
+    h2 = hashlib.md5(f"GET:{uri}".encode()).hexdigest()
+
+    def hdr(nc):
+        resp = hashlib.md5(
+            f"{h1}:{nonce}:{nc}:zz:auth:{h2}".encode()).hexdigest()
+        return (f'Digest username="admin", realm="{realm}", '
+                f'nonce="{nonce}", uri="{uri}", qop=auth, nc={nc}, '
+                f'cnonce="zz", response="{resp}"')
+    first = hdr("00000001")
+    status, _h, _b = _get(srv.base_url + uri, {"Authorization": first})
+    assert status == 200
+    # exact replay → rejected
+    status, _h, _b = _get(srv.base_url + uri, {"Authorization": first})
+    assert status == 401
+    # a fresh, larger nc on the same nonce keeps working
+    status, _h, _b = _get(srv.base_url + uri,
+                          {"Authorization": hdr("00000002")})
+    assert status == 200
+
+
+def test_localhost_autoadmin_referer_guard():
+    """Localhost auto-admin is denied when the request carries a
+    non-localhost Referer (DNS-rebinding/CSRF hardening, ADVICE r2)."""
+    class Cfg(dict):
+        def get(self, k, d=""):
+            return dict.get(self, k, d)
+
+        def get_bool(self, k, d=False):
+            v = dict.get(self, k, None)
+            return d if v is None else str(v).lower() == "true"
+    sec = SecurityHandler(Cfg())
+    assert sec.is_admin("127.0.0.1", {})
+    assert sec.is_admin("127.0.0.1", {"referer": "http://localhost:8090/x"})
+    assert not sec.is_admin("127.0.0.1", {"referer": "http://evil.test/a"})
+
+
+def test_proxy_loopback_target_guard(tmp_path):
+    """The forward proxy refuses to fetch this node / loopback for
+    non-admin clients (SSRF-to-admin, ADVICE r2 high)."""
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"),
+                     transport=lambda u, h: (404, {}, b""))
+    srv = YaCyHttpServer(sb, port=0)
+    try:
+        assert srv._loopback_target("http://127.0.0.1:9999/x")
+        assert srv._loopback_target("http://localhost/x")
+        assert srv._loopback_target("http://[::1]:80/x")
+        assert srv._loopback_target("http://0.0.0.0/")
+        # a public literal IP is proxyable without DNS
+        assert not srv._loopback_target("http://93.184.216.34/")
+        # injected transport (this fixture): non-literal names pass —
+        # no real socket is opened, DNS proves nothing
+        assert not srv._loopback_target("http://mock.test/")
+        # real-socket loader: unresolvable names are refused blind
+        sb.loader.transport = None
+        assert srv._loopback_target("http://no.such.host.invalid/")
+    finally:
+        srv.httpd.server_close()
+        sb.close()

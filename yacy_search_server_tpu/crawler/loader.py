@@ -38,6 +38,20 @@ class _CappedRedirectHandler(HTTPRedirectHandler):
     max_redirections = MAX_REDIRECTS
 
 
+class _FilteredRedirectHandler(_CappedRedirectHandler):
+    """Redirect handler that re-applies a caller's URL filter on every
+    hop — a fetch whose initial target passed an SSRF guard must not be
+    redirected into a refused address (httpd forward proxy)."""
+
+    def __init__(self, url_filter):
+        self._url_filter = url_filter
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        if not self._url_filter(newurl):
+            raise OSError(f"redirect target refused: {newurl}")
+        return super().redirect_request(req, fp, code, msg, headers, newurl)
+
+
 _OPENER = build_opener(_CappedRedirectHandler)
 
 
@@ -79,11 +93,14 @@ class LoaderDispatcher:
 
     # -- transports ----------------------------------------------------------
 
-    def _fetch_http(self, url: str) -> tuple[int, dict, bytes]:
+    def _fetch_http(self, url: str,
+                    url_filter=None) -> tuple[int, dict, bytes]:
         if self.transport is not None:
             return self.transport(url, {"User-Agent": self.agent})
         req = UrlRequest(url, headers={"User-Agent": self.agent})
-        with _OPENER.open(req, timeout=self.timeout_s) as resp:  # nosec - crawler
+        opener = _OPENER if url_filter is None \
+            else build_opener(_FilteredRedirectHandler(url_filter))
+        with opener.open(req, timeout=self.timeout_s) as resp:  # nosec - crawler
             content = resp.read(self.max_size + 1)
             if len(content) > self.max_size:
                 raise OSError(f"content exceeds max size {self.max_size}")
@@ -112,7 +129,11 @@ class LoaderDispatcher:
     # -- public API ----------------------------------------------------------
 
     def load(self, request: Request,
-             strategy: str = CacheStrategy.IFEXIST) -> Response:
+             strategy: str = CacheStrategy.IFEXIST,
+             url_filter=None) -> Response:
+        """`url_filter` (url -> bool), when given, is applied to every
+        HTTP redirect hop; hops it refuses abort the fetch (the initial
+        URL is the caller's own responsibility to check)."""
         url = request.url
         cached = self._try_cache(url, strategy)
         if cached is not None:
@@ -149,7 +170,7 @@ class LoaderDispatcher:
             if scheme in ("http", "https", "ftp"):
                 # ftp rides urllib's built-in FTPHandler (the reference's
                 # FTPLoader is its own client; capability, not mechanism)
-                status, headers, content = self._fetch_http(url)
+                status, headers, content = self._fetch_http(url, url_filter)
             elif scheme == "file":
                 status, headers, content = self._fetch_file(url)
             elif scheme == "smb":

@@ -20,6 +20,10 @@ from .errors import ParserError
 
 _MAGIC = b"7z\xbc\xaf\x27\x1c"
 
+# hard ceiling on any single folder's declared unpack size; crawled
+# archives are untrusted and the declared size is what we allocate
+MAX_UNPACK_SIZE = 1 << 28          # 256 MB
+
 # property ids
 K_END = 0x00
 K_HEADER = 0x01
@@ -98,6 +102,13 @@ class _Folder:
 
     def decode(self, packed: bytes) -> bytes:
         cid = self.coder_id
+        # the unpack size is attacker-declared archive metadata: a tiny
+        # crawled .7z may claim a multi-GB output (decompression bomb) —
+        # cap it before allocating anything
+        if self.unpack_size > MAX_UNPACK_SIZE:
+            raise ParserError(
+                f"7z: declared unpack size {self.unpack_size} exceeds "
+                f"limit {MAX_UNPACK_SIZE}")
         if cid == b"\x00":                 # Copy
             return packed[:self.unpack_size]
         if cid == b"\x21":                 # LZMA2

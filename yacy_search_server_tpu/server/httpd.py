@@ -198,6 +198,28 @@ class YaCyHttpServer:
 
     def _handle(self, handler, post_params: dict) -> None:
         try:
+            # client allowlist + abuse throttle run before EVERY branch —
+            # including the proxy and *.yacy rewrites below, which fetch
+            # attacker-supplied URLs and must never be reachable by a
+            # client the allowlist rejects (serverAccessTracker +
+            # serverClient parity; the reference's Jetty chain puts the
+            # monitor/security handlers ahead of the proxy handler)
+            tracker = getattr(self.sb, "access_tracker", None)
+            client_ip = handler.client_address[0]
+            if not self.security.client_allowed(client_ip):
+                self._send(handler, 403, "text/plain",
+                           b"client not allowed")
+                return
+            if tracker is not None:
+                hits = tracker.track_access(client_ip)
+                limit = self.sb.config.get_int(
+                    "httpd.maxAccessPerHost.600s", 6000)
+                if hits > limit and client_ip not in ("127.0.0.1", "::1"):
+                    self._send(handler, 429, "text/plain",
+                               b"too many requests",
+                               extra={"Retry-After": "600"})
+                    return
+
             # forward-proxy request line (GET http://host/path) — the
             # transparent indexing proxy (reference:
             # server/http/HTTPDProxyHandler.java, config proxyURL /
@@ -216,27 +238,6 @@ class YaCyHttpServer:
             path = unquote(parts.path)
             params = dict(parse_qsl(parts.query, keep_blank_values=True))
             params.update(post_params)
-
-            # host-level access accounting + abuse throttle
-            # (serverAccessTracker parity): every request counts toward
-            # its client's sliding window; past the per-host limit the
-            # node answers 429 instead of serving (localhost exempt)
-            tracker = getattr(self.sb, "access_tracker", None)
-            client_ip = handler.client_address[0]
-            # client allowlist (serverClient config) gates everything
-            if not self.security.client_allowed(client_ip):
-                self._send(handler, 403, "text/plain",
-                           b"client not allowed")
-                return
-            if tracker is not None:
-                hits = tracker.track_access(client_ip)
-                limit = self.sb.config.get_int(
-                    "httpd.maxAccessPerHost.600s", 6000)
-                if hits > limit and client_ip not in ("127.0.0.1", "::1"):
-                    self._send(handler, 429, "text/plain",
-                               b"too many requests",
-                               extra={"Retry-After": "600"})
-                    return
 
             if path.startswith("/yacy/"):
                 self._handle_wire(handler, path, params)
@@ -336,17 +337,55 @@ class YaCyHttpServer:
         self.sb.add_profile(profile)
         return profile
 
+    def _loopback_target(self, url: str) -> bool:
+        """True when the proxy target resolves to loopback/unspecified or
+        to this node itself — a proxied fetch FROM localhost would be
+        granted localhost auto-admin by the target, so a remote client
+        must never be able to aim the proxy back at the node (SSRF →
+        admin bypass; the reference's proxy handler similarly refuses to
+        proxy to its own address)."""
+        import ipaddress
+        import socket
+        host = urlsplit(url).hostname or ""
+        if host.lower() in ("localhost", ""):
+            return True
+        addrs = []
+        try:
+            addrs.append(ipaddress.ip_address(host))
+        except ValueError:
+            if getattr(self.sb.loader, "transport", None) is not None:
+                # injected transport: the fetch never opens a real
+                # socket, so DNS says nothing about what it reaches —
+                # only literal loopback addresses are refusable
+                return False
+            try:
+                for info in socket.getaddrinfo(host, None):
+                    addrs.append(ipaddress.ip_address(info[4][0]))
+            except (socket.gaierror, ValueError, OSError):
+                return True     # unresolvable: refuse rather than fetch
+        return any(a.is_loopback or a.is_unspecified for a in addrs)
+
     def _handle_forward_proxy(self, handler, url: str) -> None:
         cfg = self.sb.config
         if not cfg.get_bool("proxyURL", False):
             self._send(handler, 403, "text/plain",
                        b"forward proxy disabled (config proxyURL)")
             return
+        is_admin = self._is_admin(handler)
+        if self._loopback_target(url) and not is_admin:
+            self._send(handler, 403, "text/plain",
+                       b"proxy to this node refused")
+            return
         from ..crawler.loader import CacheStrategy
         from ..crawler.request import Request
+        # the same guard rides every redirect hop: an allowed public
+        # target must not 302 the node into fetching itself
+        url_filter = None if is_admin \
+            else (lambda u: not self._loopback_target(u))
         try:
             resp = self.sb.loader.load(Request(url=url),
-                                       CacheStrategy.IFFRESH)
+                                       CacheStrategy.IFFRESH,
+                                       url_filter=url_filter)
         except Exception as e:
             self._send(handler, 502, "text/plain",
                        f"proxy fetch failed: {e}".encode())
@@ -395,6 +434,14 @@ class YaCyHttpServer:
         from ..crawler.loader import CacheStrategy
         from ..crawler.request import Request
         target = f"http://{seed.ip}:{seed.port}{path}"
+        # same rule as the forward proxy: a seed claiming a loopback
+        # address would make the node fetch localhost services (itself —
+        # where auto-admin applies — or anything co-located); non-admin
+        # clients are refused
+        if self._loopback_target(target) and not self._is_admin(handler):
+            self._send(handler, 403, "text/plain",
+                       b"peer resolves to this node")
+            return
         try:
             resp = self.sb.loader.load(Request(url=target),
                                        CacheStrategy.NOCACHE)

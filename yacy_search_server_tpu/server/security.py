@@ -28,6 +28,7 @@ import fnmatch
 import hashlib
 import hmac
 import os
+import threading
 import time
 
 
@@ -68,6 +69,11 @@ class SecurityHandler:
     def __init__(self, config):
         self.config = config
         self._nonce_key = os.urandom(16)
+        # highest nc seen per nonce (bounded LRU): a captured
+        # Authorization header must not replay within the nonce validity
+        # window
+        self._nonce_nc: dict[str, int] = {}
+        self._nonce_nc_lock = threading.Lock()
 
     # -- per-path rules ------------------------------------------------------
 
@@ -117,9 +123,27 @@ class SecurityHandler:
     def is_admin(self, client_ip: str, headers, method: str = "GET",
                  uri: str = "/") -> bool:
         if client_ip in ("127.0.0.1", "::1") and self.config.get_bool(
-                "adminAccountForLocalhost", True):
+                "adminAccountForLocalhost", True) \
+                and self._referer_local(headers):
             return True
         auth = headers.get("authorization", "") or ""
+        return self._check_auth_header(auth, method, uri)
+
+    @staticmethod
+    def _referer_local(headers) -> bool:
+        """The localhost auto-admin grant additionally requires the
+        Referer (when present) to name localhost — a browser on the node
+        navigated to an attacker page could otherwise drive admin
+        requests via DNS rebinding / CSRF (reference:
+        Jetty9YaCySecurityHandler referer check)."""
+        ref = (headers.get("referer", "") or "").strip()
+        if not ref:
+            return True
+        from urllib.parse import urlsplit
+        host = (urlsplit(ref).hostname or "").lower()
+        return host in ("localhost", "127.0.0.1", "::1", "")
+
+    def _check_auth_header(self, auth: str, method: str, uri: str) -> bool:
         if auth.lower().startswith("basic "):
             return self._check_basic(auth[6:].strip())
         if auth.lower().startswith("digest "):
@@ -171,20 +195,52 @@ class SecurityHandler:
                                     p.get("cnonce", ""), "auth", ha2)))
         else:   # RFC 2069 compatibility
             expect = _md5(f"{want_ha1}:{nonce}:{ha2}")
-        return hmac.compare_digest(expect, p.get("response", ""))
+        if not hmac.compare_digest(expect, p.get("response", "")):
+            return False
+        # replay guard: the nc counter must strictly increase per nonce
+        # (RFC 7616 §5.12); only enforced after the response verified so
+        # a forged header can't burn a legitimate client's counter.
+        # The qop-less RFC 2069 form carries no nc — each success
+        # consumes its nonce outright (the client re-auths against the
+        # fresh challenge on the next 401).
+        if p.get("qop") == "auth":
+            try:
+                nc = int(p.get("nc", ""), 16)
+            except ValueError:
+                return False
+        else:
+            nc = 1 << 62
+        with self._nonce_nc_lock:
+            if nc <= self._nonce_nc.get(nonce, 0):
+                return False
+            # move-to-end on update: the cap must evict the LEAST
+            # recently used nonce, never an active one still inside its
+            # validity window (that would re-open replay under load)
+            self._nonce_nc.pop(nonce, None)
+            self._nonce_nc[nonce] = nc
+            while len(self._nonce_nc) > 1024:
+                self._nonce_nc.pop(next(iter(self._nonce_nc)))
+        return True
 
     # -- nonces --------------------------------------------------------------
 
     def mint_nonce(self) -> str:
+        # per-mint randomness: concurrent clients challenged in the same
+        # second must get DISTINCT nonces, or the strictly-increasing nc
+        # replay counter would 401 whichever client's nc lags
         ts = str(int(time.time()))
-        sig = hmac.new(self._nonce_key, ts.encode(), "sha256").hexdigest()[:24]
-        return f"{ts}.{sig}"
+        rand = os.urandom(6).hex()
+        sig = hmac.new(self._nonce_key, f"{ts}.{rand}".encode(),
+                       "sha256").hexdigest()[:24]
+        return f"{ts}.{rand}.{sig}"
 
     def _nonce_valid(self, nonce: str) -> bool:
-        ts, _, sig = nonce.partition(".")
+        ts, _, rest = nonce.partition(".")
+        rand, _, sig = rest.partition(".")
         if not ts.isdigit():
             return False
-        want = hmac.new(self._nonce_key, ts.encode(), "sha256").hexdigest()[:24]
+        want = hmac.new(self._nonce_key, f"{ts}.{rand}".encode(),
+                        "sha256").hexdigest()[:24]
         if not hmac.compare_digest(want, sig):
             return False
         return (time.time() - int(ts)) <= self.NONCE_MAX_AGE_S
