@@ -208,17 +208,25 @@ def _config5_hybrid(k=100, ndocs=100_000, iters=20):
           "queries/sec", qps / cpu_qps)
 
 
-def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096):
+def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
+                              mesh: str = "auto"):
     """A Switchboard whose index holds `n_terms` hot terms with `n`
     postings each, plus real metadata rows for every doc — the served-path
-    workload (distinct query strings so the event cache never aliases)."""
+    workload (distinct query strings so the event cache never aliases).
+    `mesh`: the index.device.mesh mode — "off" pins the single-device
+    store, "on" forces the mesh-sharded store, "auto" is the product
+    default (mesh when >1 device)."""
     import numpy as np
     from yacy_search_server_tpu.index import postings as P
     from yacy_search_server_tpu.index.postings import PostingsList
     from yacy_search_server_tpu.switchboard import Switchboard
+    from yacy_search_server_tpu.utils.config import Config
+
     from yacy_search_server_tpu.utils.hashes import word2hash
 
-    sb = Switchboard(data_dir=None)
+    cfg = Config()
+    cfg.set("index.device.mesh", mesh)
+    sb = Switchboard(data_dir=None, config=cfg)
     rng = np.random.default_rng(0)
     # synthetic 12-char urlhashes: positional layout (6:12 = host part)
     # with `hosts` distinct hosts so host-diversity drain has real work
@@ -279,11 +287,34 @@ def _config6_served_path(k=10, ndocs=1_000_000, threads=16):
     HTTP server actually runs; through a remote-tunnel device the
     single-stream latency is pinned to the tunnel round trip (~110 ms
     here) while concurrent dispatches batch and pipeline — BASELINE.md."""
-    sb = _build_served_switchboard(ndocs, n_terms=8)
+    sb = _build_served_switchboard(ndocs, n_terms=8, mesh="off")
     assert sb.index.devstore is not None, "device serving must be on"
     qps = _served_qps(sb, k=k, threads=threads, per_thread=5, n_terms=8)
     _emit(f"served_search_top{k}_qps_{ndocs // 1_000_000}M_postings"
           f"_x{threads}", qps, "queries/sec", 0.0)
+
+
+def _config10_mesh_served(k=10, ndocs=1_000_000, threads=16):
+    """Config #10: the SERVED path over the MESH-SHARDED arena (VERDICT
+    r2 #1) — Switchboard.search() end-to-end with every query one SPMD
+    program over all available devices (8-way on the virtual CPU mesh /
+    a v5e-8; degenerates to 1 cell on a single chip). Same protocol as
+    config 6, so the two numbers are directly comparable."""
+    import os
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    ndev = len(jax.devices())
+    sb = _build_served_switchboard(ndocs, n_terms=8, mesh="on")
+    from yacy_search_server_tpu.index.meshstore import MeshSegmentStore
+    assert isinstance(sb.index.devstore, MeshSegmentStore)
+    qps = _served_qps(sb, k=k, threads=threads, per_thread=5, n_terms=8)
+    _emit(f"mesh_served_search_top{k}_qps_{ndocs // 1_000_000}M"
+          f"_x{ndev}dev", qps, "queries/sec", 0.0)
 
 
 def _config3_sharded(k=100, iters=10):
@@ -412,14 +443,15 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--cpu-iters", type=int, default=3)
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
                     help="run a BASELINE.md benchmark config instead of "
                          "the headline metric")
     args = ap.parse_args()
 
-    if args.config == 6:
-        _config6_served_path(ndocs=args.n if args.n != 10_000_000
-                             else 1_000_000)
+    if args.config in (6, 10):
+        fn = _config6_served_path if args.config == 6 \
+            else _config10_mesh_served
+        fn(ndocs=args.n if args.n != 10_000_000 else 1_000_000)
         return
     if args.config:
         {1: _config1_bm25_cpu_baseline, 2: _config2_bm25_tpu,
@@ -459,7 +491,10 @@ def main():
     cpu_qps = 1.0 / (time.perf_counter() - t0)
     del feats, valid, hostids
 
-    sb = _build_served_switchboard(n, n_terms=2)
+    # pinned to the single-device store: the headline metric's protocol
+    # (pruned+batched placed-block serving) must stay comparable across
+    # rounds; the mesh-sharded serving number is config 10
+    sb = _build_served_switchboard(n, n_terms=2, mesh="off")
     assert sb.index.devstore is not None, "device serving must be on"
     qps = _served_qps(sb, k=10, threads=64, per_thread=3, n_terms=2)
     print(json.dumps({

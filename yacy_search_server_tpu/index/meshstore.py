@@ -1,0 +1,808 @@
+"""Mesh-sharded postings serving — the DHT axes as arena partitions.
+
+Multi-chip product serving (VERDICT r2 missing #1): the single-device
+``DeviceSegmentStore`` pins one chip; this store partitions the SAME
+packed-extent arena across a ``('term', 'doc')`` ``jax.sharding.Mesh`` and
+executes every eligible query as ONE SPMD program over all devices:
+
+    per-device streaming scan of its extent slice
+    → lax.pmin/pmax merge of normalization stats (ReferenceOrder's
+      global min/max, computed once per query across the whole mesh)
+    → per-device score + local top-k
+    → lax.all_gather over both mesh axes + global top-k (replicated)
+
+Placement IS the DHT math (reference:
+source/net/yacy/cora/federate/yacy/Distribution.java:35-93 mapped over
+kelondro/rwi/IndexCell.java:65-283):
+
+- **term axis** (horizontal ring): a term's postings live only on the
+  term row ``(horizontal_dht_position(termhash) * n_term) >> 63`` — the
+  base64-cardinal ring position of ``parallel/distribution.py`` scaled to
+  the axis size. Other term rows hold a zero-count extent and contribute
+  neutral stats/candidates.
+- **doc axis** (vertical partitions): each posting lands on doc column
+  ``docid % n_doc``. Docids are the metadata store's bijective alias of
+  url hashes, so this is the same equivalence the reference's
+  url-hash vertical split provides (one url → one column for EVERY
+  term), which is what makes conjunctions column-local.
+
+Queries whose terms all live on one term row join device-side per doc
+column (docid-sorted side tables are column-local by the invariant
+above); terms on different rows fall back to the host join — the same
+boundary the reference has, where a cross-ring join ships candidate doc
+lists between peers (SecondarySearchSuperviser).
+
+The RAM-buffer delta (postings newer than the last flush) replicates to
+every device for the query: min/max stats are idempotent under
+duplication, and duplicate candidates in the gathered top-k dedup
+host-side (the existing cross-run duplicate rule of the single-chip
+store).
+
+Unlike the single-chip store there is no block-max pruning here: each
+device scans only ``count / n_devices`` rows, which is the mesh's own
+roofline win; per-cell pruning composes later without changing the
+layout. Host mirrors of each cell's buffers are kept so growth and
+repacking never read back from device.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as PS
+
+from ..ops.ranking import (RankingProfile, cardinal_from_stats,
+                           compact_feats, local_stats)
+from ..ops.streaming import merge_stats
+from ..parallel.distribution import horizontal_dht_position
+from ..utils.eventtracker import EClass, update as track
+from . import postings as P
+from .devstore import (DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32, NO_FLAG,
+                       NO_LANG, TILE, _bucket_delta, _bucket_rows,
+                       _constraint_valid, _tile_valid)
+
+INT32_MAX = 2 ** 31 - 1
+
+
+def term_shard(termhash: bytes, n_term: int) -> int:
+    """Horizontal DHT ring position scaled to the term axis size."""
+    return int((horizontal_dht_position(termhash) * n_term) >> 63)
+
+
+class MeshSpan:
+    """One run's extents for a term across every mesh cell."""
+
+    __slots__ = ("starts", "counts", "total", "jstarts")
+
+    def __init__(self, starts: np.ndarray, counts: np.ndarray,
+                 jstarts: np.ndarray | None = None):
+        self.starts = starts          # int32 [n_cells] per-cell offsets
+        self.counts = counts          # int32 [n_cells]
+        self.jstarts = jstarts        # int32 [n_cells] join-table offsets
+        self.total = int(counts.sum())
+
+
+class _CellBuf:
+    """Host mirror of one mesh cell's packed rows (+ join side-table).
+
+    Appends accumulate CHUNKS and only concatenate at materialize time
+    (once per device sync) — per-append concatenation would copy the
+    whole cell per (term, column) and make run packing quadratic in term
+    count (the pathology devstore's one-write-per-run pack avoids)."""
+
+    __slots__ = ("_parts", "used", "_jparts", "jused",
+                 "feats16", "flags", "docids", "jdocids", "jpos")
+
+    def __init__(self):
+        self.used = 0
+        self.jused = 0
+        self._parts: list[tuple] = []       # (f16, fl, dd) chunks
+        self._jparts: list[tuple] = []      # (jdocids, jpos) chunks
+        self.feats16 = np.zeros((0, P.NF), np.int16)
+        self.flags = np.zeros(0, np.int32)
+        self.docids = np.zeros(0, np.int32)
+        self.jdocids = np.zeros(0, np.int32)
+        self.jpos = np.zeros(0, np.int32)
+
+    def append(self, f16, fl, dd) -> int:
+        start = self.used
+        self._parts.append((f16, fl, dd))
+        self.used += len(dd)
+        return start
+
+    def append_join(self, jd, jp) -> int:
+        start = self.jused
+        self._jparts.append((jd, jp))
+        self.jused += len(jd)
+        return start
+
+    def materialize(self) -> None:
+        if self._parts:
+            self.feats16 = np.concatenate(
+                [self.feats16] + [p[0] for p in self._parts])
+            self.flags = np.concatenate(
+                [self.flags] + [p[1] for p in self._parts])
+            self.docids = np.concatenate(
+                [self.docids] + [p[2] for p in self._parts])
+            self._parts = []
+        if self._jparts:
+            self.jdocids = np.concatenate(
+                [self.jdocids] + [p[0] for p in self._jparts])
+            self.jpos = np.concatenate(
+                [self.jpos] + [p[1] for p in self._jparts])
+            self._jparts = []
+
+
+class MeshSegmentStore:
+    """Span registry + SPMD query dispatch over a sharded arena.
+
+    Drop-in for ``DeviceSegmentStore`` behind ``Segment.devstore``: same
+    RWI listener protocol, same ``rank_term``/``rank_join`` signatures,
+    chosen by the Switchboard whenever the host has more than one device.
+    """
+
+    MAX_SPANS = 8   # matches the RWI merge policy's max_runs
+    # SearchEvent's small-candidate gate threshold; None = the default
+    # (ops/ranking.SMALL_RANK_N). Locally-attached meshes can lower it —
+    # their dispatch floor is microseconds, not a tunnel round trip.
+    small_rank_n: int | None = None
+
+    def __init__(self, rwi, devices=None, n_term: int = 1,
+                 budget_bytes: int = 2 << 30):
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if n_term < 1 or len(devs) % n_term:
+            raise ValueError(f"{len(devs)} devices not divisible by "
+                             f"n_term={n_term}")
+        self.n_term = n_term
+        self.n_doc = len(devs) // n_term
+        self.n_cells = len(devs)
+        self.mesh = Mesh(np.asarray(devs).reshape(self.n_term, self.n_doc),
+                         axis_names=("term", "doc"))
+        self.rwi = rwi
+        self.budget_bytes = budget_bytes
+        self._cells = [_CellBuf() for _ in range(self.n_cells)]
+        self._packed: dict[int, dict[bytes, MeshSpan]] = {}
+        self._lock = threading.RLock()
+        self._garbage_rows = 0
+        self.queries_served = 0
+        self.fallbacks = 0
+        # device state (rebuilt lazily from the host mirrors)
+        self._dev_arrays = None       # (feats16, flags, docids) sharded
+        self._dev_join = None         # (jdocids, jpos) sharded
+        self._dirty = True
+        self._dead_host = np.zeros(1 << 16, bool)
+        self._dev_dead = None
+        self._dirty_dead = True
+        self._consts = None
+        self._profile_key = None
+        self._fns: dict[tuple, object] = {}
+        self._jfns: dict[tuple, object] = {}
+        for docid in rwi._tombstones:
+            self.mark_dead(docid)
+        for run in list(rwi._runs):
+            self.on_run_added(run)
+        rwi.listener = self
+
+    # -- placement math ------------------------------------------------------
+
+    def _cell_of(self, t: int, d: int) -> int:
+        return t * self.n_doc + d
+
+    def row_bytes(self) -> int:
+        return P.NF * 2 + 4 + 4
+
+    def _would_fit(self, extra_rows: int) -> bool:
+        # worst case the whole run lands on one cell; budget the padded
+        # global buffer that cell size would force
+        worst = max(c.used for c in self._cells) + extra_rows
+        cap = _bucket_rows(worst + TILE) + TILE
+        return cap * self.n_cells * self.row_bytes() <= self.budget_bytes
+
+    # -- packing (listener protocol) ----------------------------------------
+
+    def on_run_added(self, run) -> None:
+        with self._lock:
+            rid = id(run)
+            if rid in self._packed:
+                return
+            rows = run.n_postings
+            if rows == 0:
+                self._packed[rid] = {}
+                return
+            if not self._would_fit(rows):
+                track(EClass.INDEX, "meshstore_skip", rows)
+                return
+            spans: dict[bytes, MeshSpan] = {}
+            for th in list(run.term_hashes()):
+                p = run.get(th)
+                if p is None or len(p) == 0:
+                    continue
+                f16, fl = compact_feats(p.feats)
+                dd = p.docids.astype(np.int32)
+                t = term_shard(th, self.n_term)
+                d_shard = dd % self.n_doc
+                starts = np.zeros(self.n_cells, np.int32)
+                counts = np.zeros(self.n_cells, np.int32)
+                jstarts = np.zeros(self.n_cells, np.int32)
+                for d in range(self.n_doc):
+                    sel = d_shard == d
+                    n = int(sel.sum())
+                    if n == 0:
+                        continue
+                    cell = self._cell_of(t, d)
+                    buf = self._cells[cell]
+                    start = buf.append(f16[sel], fl[sel], dd[sel])
+                    # column-local docid-sorted view (device join table):
+                    # the j-th selected posting sits at cell row start+j
+                    order = np.argsort(dd[sel], kind="stable")
+                    jstarts[cell] = buf.append_join(
+                        dd[sel][order].astype(np.int32),
+                        (start + order).astype(np.int32))
+                    starts[cell], counts[cell] = start, n
+                spans[th] = MeshSpan(starts, counts, jstarts)
+            self._packed[rid] = spans
+            self._dirty = True
+            track(EClass.INDEX, "meshstore_pack", rows)
+
+    def on_run_removed(self, run) -> None:
+        with self._lock:
+            spans = self._packed.pop(id(run), None)
+            if spans:
+                self._garbage_rows += sum(sp.total for sp in spans.values())
+            used = sum(c.used for c in self._cells)
+            if (self._garbage_rows * 2 > max(used, 1)
+                    and self._garbage_rows > 4 * TILE):
+                self.repack()
+
+    def on_run_swapped(self, old_run, new_run) -> None:
+        with self._lock:
+            spans = self._packed.pop(id(old_run), None)
+            if spans is not None:
+                live = set(new_run.term_hashes())
+                self._packed[id(new_run)] = {
+                    th: sp for th, sp in spans.items() if th in live}
+
+    def on_doc_deleted(self, docid: int) -> None:
+        self.mark_dead(docid)
+
+    def on_term_dropped(self, run, termhash: bytes) -> None:
+        with self._lock:
+            spans = self._packed.get(id(run))
+            if spans is not None:
+                sp = spans.pop(termhash, None)
+                if sp is not None:
+                    self._garbage_rows += sp.total
+
+    def mark_dead(self, docid: int) -> None:
+        with self._lock:
+            if docid >= len(self._dead_host):
+                cap = len(self._dead_host)
+                while cap <= docid:
+                    cap *= 2
+                grown = np.zeros(cap, bool)
+                grown[:len(self._dead_host)] = self._dead_host
+                self._dead_host = grown
+            self._dead_host[docid] = True
+            self._dirty_dead = True
+
+    def live_rows(self) -> int:
+        with self._lock:
+            return sum(sp.total for spans in self._packed.values()
+                       for sp in spans.values())
+
+    def repack(self) -> None:
+        with self._lock:
+            self._cells = [_CellBuf() for _ in range(self.n_cells)]
+            self._packed.clear()
+            self._garbage_rows = 0
+            self._dirty = True
+            for run in list(self.rwi._runs):
+                self.on_run_added(run)
+
+    def enable_batching(self, **_kw) -> None:
+        """Accepted for devstore interface parity; the SPMD dispatch is
+        already one program for the whole mesh (cross-query batching
+        composes later)."""
+
+    def close(self) -> None:
+        if self.rwi.listener is self:
+            self.rwi.listener = None
+
+    # -- device sync ---------------------------------------------------------
+
+    def _sync_device(self):
+        """Rebuild the sharded global arrays from the host mirrors.
+
+        Runs once per flush/merge (packs are rare); queries between packs
+        reuse the placed buffers — steady-state per-query traffic is the
+        span descriptor vector only."""
+        for c in self._cells:
+            c.materialize()
+        C = _bucket_rows(max(max(c.used for c in self._cells), 1)
+                         + TILE) + TILE
+        feats = np.zeros((self.n_cells, C, P.NF), np.int16)
+        flags = np.zeros((self.n_cells, C), np.int32)
+        docids = np.full((self.n_cells, C), -1, np.int32)
+        for i, c in enumerate(self._cells):
+            feats[i, :c.used] = c.feats16
+            flags[i, :c.used] = c.flags
+            docids[i, :c.used] = c.docids
+        # join-table width pads to twice the bucket of the largest cell:
+        # a query's static membership window (bucket of the segment
+        # size) must fit after ANY segment start — lo + bucket(seg) <=
+        # jused + bucket(jused) <= 2*bucket(jused) — so windows never
+        # overrun (dynamic_slice would clamp the start and misalign)
+        JC = 2 * _bucket_rows(
+            max(max((c.jused for c in self._cells), default=1), 1))
+        jdocids = np.full((self.n_cells, JC), INT32_MAX, np.int32)
+        jpos = np.zeros((self.n_cells, JC), np.int32)
+        for i, c in enumerate(self._cells):
+            jdocids[i, :c.jused] = c.jdocids
+            jpos[i, :c.jused] = c.jpos
+        sh3 = NamedSharding(self.mesh, PS(("term", "doc"), None, None))
+        sh2 = NamedSharding(self.mesh, PS(("term", "doc"), None))
+        self._dev_arrays = (jax.device_put(feats, sh3),
+                            jax.device_put(flags, sh2),
+                            jax.device_put(docids, sh2))
+        self._dev_join = (jax.device_put(jdocids, sh2),
+                          jax.device_put(jpos, sh2))
+        self._dirty = False
+
+    def _device_arrays(self):
+        if self._dirty or self._dev_arrays is None:
+            self._sync_device()
+        return self._dev_arrays
+
+    def _dead_array(self):
+        if self._dirty_dead or self._dev_dead is None:
+            self._dev_dead = jax.device_put(
+                self._dead_host, NamedSharding(self.mesh, PS()))
+            self._dirty_dead = False
+        return self._dev_dead
+
+    def _profile_consts(self, profile, language: str):
+        key = (profile.to_external_string(), language)
+        with self._lock:
+            if self._profile_key != key:
+                rep = NamedSharding(self.mesh, PS())
+                put = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
+                bits, shifts = profile.flag_coeffs()
+                self._consts = (put(profile.norm_coeffs()), put(bits),
+                                put(shifts),
+                                put(np.int32(profile.domlength)),
+                                put(np.int32(profile.tf)),
+                                put(np.int32(profile.language)),
+                                put(np.int32(profile.authority)),
+                                put(np.int32(P.pack_language(language))))
+                self._profile_key = key
+            return self._consts
+
+    # -- query dispatch ------------------------------------------------------
+
+    def spans_for(self, termhash: bytes) -> list[MeshSpan] | None:
+        with self._lock:
+            out: list[MeshSpan] = []
+            for run in list(self.rwi._runs):
+                if not run.has(termhash):
+                    continue
+                spans = self._packed.get(id(run))
+                if spans is None:
+                    return None
+                sp = spans.get(termhash)
+                if sp is None:
+                    return None
+                out.append(sp)
+            return out
+
+    def _fn(self, kk: int, with_delta: bool):
+        key = (kk, with_delta)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(jax.shard_map(
+                partial(_mesh_rank_shard, k=kk, with_delta=with_delta),
+                mesh=self.mesh,
+                in_specs=(PS(("term", "doc"), None, None),   # feats16
+                          PS(("term", "doc"), None),         # flags
+                          PS(("term", "doc"), None),         # docids
+                          PS(("term", "doc"), None),         # starts
+                          PS(("term", "doc"), None),         # counts
+                          PS(),                              # dead
+                          PS(), PS(), PS(),                  # delta
+                          PS(),                              # qfilters
+                          PS(), PS(), PS(), PS(), PS(), PS(), PS(), PS()),
+                out_specs=(PS(), PS()),
+                check_vma=False,   # replicated by the all_gather+top_k
+            ))
+        return self._fns[key]
+
+    def rank_term(self, termhash: bytes, profile, language: str = "en",
+                  k: int = 100,
+                  lang_filter: int = NO_LANG, flag_bit: int = NO_FLAG,
+                  from_days: int | None = None, to_days: int | None = None):
+        """Single-term ranked top-k as one SPMD program over the mesh.
+
+        Same contract as ``DeviceSegmentStore.rank_term``: returns
+        (scores, docids, considered) or None for host fallback."""
+        with self._lock:
+            spans = self.spans_for(termhash)
+            if spans is None or len(spans) > self.MAX_SPANS:
+                self.fallbacks += 1
+                return None
+            arrays = self._device_arrays()
+            dead = self._dead_array()
+        with self.rwi._lock:
+            delta = self.rwi._ram_postings(termhash)
+        if not spans and delta is None:
+            return np.empty(0, np.int32), np.empty(0, np.int32), 0
+        with_delta = delta is not None and len(delta) > 0
+        considered = sum(sp.total for sp in spans) + (
+            len(delta) if with_delta else 0)
+
+        starts = np.zeros((self.n_cells, self.MAX_SPANS), np.int32)
+        counts = np.zeros((self.n_cells, self.MAX_SPANS), np.int32)
+        for i, sp in enumerate(spans):
+            starts[:, i] = sp.starts
+            counts[:, i] = sp.counts
+        if with_delta:
+            n = len(delta)
+            b = _bucket_delta(n)
+            df = np.zeros((b, P.NF), np.int16)
+            dfl = np.zeros(b, np.int32)
+            ddd = np.full(b, -1, np.int32)
+            cf, cfl = compact_feats(delta.feats)
+            df[:n], dfl[:n], ddd[:n] = cf, cfl, delta.docids
+            d_args = (df, dfl, ddd)
+        else:
+            d_args = (np.zeros((1, P.NF), np.int16),
+                      np.zeros(1, np.int32), np.full(1, -1, np.int32))
+        qfilters = np.asarray(
+            [lang_filter, flag_bit,
+             DAYS_NONE_LO if from_days is None else from_days,
+             DAYS_NONE_HI if to_days is None else to_days], np.int32)
+        kk = max(16, 1 << (max(k, 1) - 1).bit_length())
+        consts = self._profile_consts(profile, language)
+        out = self._fn(kk, with_delta)(
+            *arrays, starts, counts, dead, *d_args, qfilters, *consts)
+        s, d = jax.device_get(out)
+        keep = (d >= 0) & (s > NEG_INF32)
+        s, d = s[keep], d[keep]
+        # gathered candidates may repeat a docid (replicated delta rows;
+        # cross-run re-pushes): keep the best-scored instance
+        _, first = np.unique(d, return_index=True)
+        if len(first) != len(d):
+            sel = np.sort(first)
+            s, d = s[sel], d[sel]
+        self.queries_served += 1
+        return s[:k], d[:k], considered
+
+    MAX_JOIN_TERMS = 6
+
+    def _jfn(self, kk: int, n_inc: int, n_exc: int, r: int,
+             inc_ms: tuple, exc_ms: tuple):
+        key = (kk, n_inc, n_exc, r, inc_ms, exc_ms)
+        if key not in self._jfns:
+            self._jfns[key] = jax.jit(jax.shard_map(
+                partial(_mesh_join_shard, k=kk, n_inc=n_inc, n_exc=n_exc,
+                        r=r, inc_ms=inc_ms, exc_ms=exc_ms),
+                mesh=self.mesh,
+                in_specs=(PS(("term", "doc"), None, None),   # feats16
+                          PS(("term", "doc"), None),         # flags
+                          PS(("term", "doc"), None),         # docids
+                          PS(("term", "doc"), None),         # jdocids
+                          PS(("term", "doc"), None),         # jpos
+                          PS(),                              # dead
+                          PS(("term", "doc"), None),         # qargs
+                          PS(), PS(), PS(), PS(), PS(), PS(), PS(), PS()),
+                out_specs=(PS(), PS()),
+                check_vma=False,
+            ))
+        return self._jfns[key]
+
+    def rank_join(self, include_hashes, exclude_hashes, profile,
+                  language: str = "en", k: int = 100,
+                  lang_filter: int = NO_LANG, flag_bit: int = NO_FLAG,
+                  from_days: int | None = None, to_days: int | None = None):
+        """Multi-term conjunctive ranked top-k as one SPMD program.
+
+        The vertical-partition invariant (one docid → one doc column for
+        EVERY term) makes the conjunction COLUMN-LOCAL: each device
+        membership-tests its slice of the rarest term's span against the
+        partner terms' column-local docid-sorted side tables, merges
+        features with the host join's semantics, and the per-column
+        survivors fuse by all_gather + global top-k. Terms on different
+        TERM rows cannot join device-side (their postings live on
+        different cells) — that is the reference's own cross-ring
+        boundary, where joins ship candidate lists between peers; such
+        queries fall back to the host join, as do terms with multiple
+        spans or an unflushed RAM delta."""
+        include_hashes = list(include_hashes)
+        exclude_hashes = list(exclude_hashes or [])
+        if not include_hashes \
+                or (len(include_hashes) == 1 and not exclude_hashes) \
+                or len(include_hashes) > self.MAX_JOIN_TERMS \
+                or len(exclude_hashes) > self.MAX_JOIN_TERMS:
+            return None
+        with self._lock:
+            rows = set()
+            inc_spans = []
+            for th in include_hashes:
+                spans = self.spans_for(th)
+                if spans is None or len(spans) != 1:
+                    self.fallbacks += 1
+                    return None
+                rows.add(term_shard(th, self.n_term))
+                inc_spans.append(spans[0])
+            exc_spans = []
+            for th in exclude_hashes:
+                spans = self.spans_for(th)
+                if spans is None:
+                    if self.rwi.has_term(th):
+                        self.fallbacks += 1
+                        return None
+                    continue
+                if len(spans) > 1:
+                    self.fallbacks += 1
+                    return None
+                if spans:
+                    rows.add(term_shard(th, self.n_term))
+                    exc_spans.append(spans[0])
+            if len(rows) > 1:      # cross-row join: host fallback
+                self.fallbacks += 1
+                return None
+            arrays = self._device_arrays()
+            jdocids, jpos = self._dev_join
+            dead = self._dead_array()
+            JC = int(jdocids.shape[1])
+            C = int(arrays[0].shape[1])
+        with self.rwi._lock:
+            for th in include_hashes + exclude_hashes:
+                if self.rwi._ram.get(th):
+                    self.fallbacks += 1
+                    return None
+
+        rare_i = min(range(len(inc_spans)),
+                     key=lambda i: inc_spans[i].total)
+        rare = inc_spans[rare_i]
+        partners = [sp for i, sp in enumerate(inc_spans) if i != rare_i]
+        considered = rare.total
+
+        r = _bucket_rows(max(int(rare.counts.max()), 1))
+        if int((rare.starts + r).max()) > C:
+            self.fallbacks += 1
+            return None
+
+        def window(sp):
+            m = _bucket_rows(max(int(sp.counts.max()), 1))
+            return m if int((sp.jstarts + m).max()) <= JC else None
+
+        inc_ms = tuple(window(sp) for sp in partners)
+        exc_ms = tuple(window(sp) for sp in exc_spans)
+        if any(m is None for m in inc_ms + exc_ms):
+            self.fallbacks += 1
+            return None
+
+        n_inc, n_exc = len(partners), len(exc_spans)
+        qargs = np.zeros((self.n_cells, 6 + 2 * (n_inc + n_exc)), np.int32)
+        qargs[:, 0] = rare.starts
+        qargs[:, 1] = rare.counts
+        qargs[:, 2] = lang_filter
+        qargs[:, 3] = flag_bit
+        qargs[:, 4] = DAYS_NONE_LO if from_days is None else from_days
+        qargs[:, 5] = DAYS_NONE_HI if to_days is None else to_days
+        base = 6
+        for t, sp in enumerate(partners):
+            qargs[:, base + t] = sp.jstarts
+            qargs[:, base + n_inc + t] = sp.counts
+        for e, sp in enumerate(exc_spans):
+            qargs[:, base + 2 * n_inc + e] = sp.jstarts
+            qargs[:, base + 2 * n_inc + n_exc + e] = sp.counts
+
+        consts = self._profile_consts(profile, language)
+        kk = max(16, 1 << (max(k, 1) - 1).bit_length())
+        out = self._jfn(kk, n_inc, n_exc, r, inc_ms, exc_ms)(
+            *arrays, jdocids, jpos, dead, qargs, *consts)
+        s, d = jax.device_get(out)
+        keep = (d >= 0) & (s > NEG_INF32)
+        self.queries_served += 1
+        return s[keep][:k], d[keep][:k], considered
+
+
+def _mesh_join_shard(feats16, flags, docids, jdocids, jpos, dead, qargs,
+                     norm_coeffs, flag_bits, flag_shifts,
+                     domlength_coeff, tf_coeff, language_coeff,
+                     authority_coeff, language_pref,
+                     *, k: int, n_inc: int, n_exc: int, r: int,
+                     inc_ms: tuple, exc_ms: tuple):
+    """Per-device body of the sharded conjunction: column-local
+    sort-merge membership (devstore._membership_sorted), host-join
+    feature merge semantics (worddistance = position span, hitcount =
+    min, flags = OR — segment.join_constructive), mesh-wide stats merge,
+    all_gather + global top-k."""
+    from .devstore import _membership_sorted
+    feats16 = feats16[0]
+    flags = flags[0]
+    docids = docids[0]
+    jdocids = jdocids[0]
+    jpos = jpos[0]
+    q = qargs[0]
+    axes = ("term", "doc")
+    start, count = q[0], q[1]
+    lang_filter, flag_bit = q[2], q[3]
+    from_days, to_days = q[4], q[5]
+    base = 6
+    f = lax.dynamic_slice(feats16, (start, 0), (r, P.NF)).astype(jnp.int32)
+    fl = lax.dynamic_slice(flags, (start,), (r,))
+    dd = lax.dynamic_slice(docids, (start,), (r,))
+    v = _tile_valid(dd, dead, jnp.arange(r) < count)
+
+    pos_min = f[:, P.F_POSINTEXT]
+    pos_max = f[:, P.F_POSINTEXT]
+    hit_min = f[:, P.F_HITCOUNT]
+    flags_or = fl
+    for t in range(n_inc):
+        lo = q[base + t]
+        cnt = q[base + n_inc + t]
+        found, prow = _membership_sorted(jdocids, jpos, lo, inc_ms[t],
+                                         dd, v, cnt)
+        v &= found
+        pf = feats16[prow].astype(jnp.int32)
+        pos_min = jnp.minimum(pos_min, pf[:, P.F_POSINTEXT])
+        pos_max = jnp.maximum(pos_max, pf[:, P.F_POSINTEXT])
+        hit_min = jnp.minimum(hit_min, pf[:, P.F_HITCOUNT])
+        flags_or = flags_or | jnp.where(found, flags[prow], 0)
+    for e in range(n_exc):
+        lo = q[base + 2 * n_inc + e]
+        cnt = q[base + 2 * n_inc + n_exc + e]
+        found, _prow = _membership_sorted(jdocids, jpos, lo, exc_ms[e],
+                                          dd, v, cnt)
+        v &= ~found
+
+    merged = f.at[:, P.F_WORDDISTANCE].set(pos_max - pos_min)
+    merged = merged.at[:, P.F_HITCOUNT].set(hit_min)
+    v &= _constraint_valid(merged, flags_or, lang_filter, flag_bit,
+                           from_days, to_days)
+
+    stats = local_stats(merged, v, jnp.zeros(r, jnp.int32),
+                        num_hosts=1, with_host_counts=False)
+    # normalization bounds over ALL survivors, mesh-wide — one global
+    # min/max exactly like the single-device join's local_stats over the
+    # whole rare span (ReferenceOrder.normalizeWith)
+    stats = {"col_min": lax.pmin(stats["col_min"], axes),
+             "col_max": lax.pmax(stats["col_max"], axes),
+             "tf_min": lax.pmin(stats["tf_min"], axes),
+             "tf_max": lax.pmax(stats["tf_max"], axes),
+             "host_counts": stats["host_counts"]}
+    sc = cardinal_from_stats(
+        merged, v, jnp.zeros(r, jnp.int32), stats,
+        norm_coeffs, flag_bits, flag_shifts, domlength_coeff,
+        tf_coeff, language_coeff, authority_coeff, language_pref,
+        flags=flags_or)
+    top_s, idx = lax.top_k(sc, min(k, r))
+    gs = lax.all_gather(top_s, axes, tiled=True)
+    gd = lax.all_gather(dd[idx], axes, tiled=True)
+    out_s, out_i = lax.top_k(gs, min(k, gs.shape[0]))
+    return out_s, gd[out_i]
+
+
+def _mesh_rank_shard(feats16, flags, docids, starts, counts, dead,
+                     d_feats16, d_flags, d_docids, qfilters,
+                     norm_coeffs, flag_bits, flag_shifts,
+                     domlength_coeff, tf_coeff, language_coeff,
+                     authority_coeff, language_pref,
+                     *, k: int, with_delta: bool):
+    """Per-device body of the sharded rank: streaming two-pass scan of the
+    local extent slices, cross-mesh stats merge, all_gather + global
+    top-k. Mirrors devstore._rank_spans_kernel semantics exactly — the
+    parity tests compare against it and the host oracle."""
+    feats16 = feats16[0]          # [C, NF]  this device's cell
+    flags = flags[0]
+    docids = docids[0]
+    starts = starts[0]            # [n_spans]
+    counts = counts[0]
+    n_spans = starts.shape[0]
+    C = feats16.shape[0]
+    tile = min(TILE, C)
+    lang_filter, flag_bit = qfilters[0], qfilters[1]
+    from_days, to_days = qfilters[2], qfilters[3]
+    axes = ("term", "doc")
+
+    def tile_of(span_start, span_count, i):
+        off = span_start + i * tile
+        f = lax.dynamic_slice(feats16, (off, 0), (tile, P.NF))
+        fl = lax.dynamic_slice(flags, (off,), (tile,))
+        dd = lax.dynamic_slice(docids, (off,), (tile,))
+        in_span = jnp.arange(tile) < (span_count - i * tile)
+        v = _tile_valid(dd, dead, in_span)
+        v &= _constraint_valid(f, fl, lang_filter, flag_bit,
+                               from_days, to_days)
+        return f, fl, dd, v
+
+    def stats_of(f, v):
+        return local_stats(f, v, jnp.zeros(f.shape[0], jnp.int32),
+                           num_hosts=1, with_host_counts=False)
+
+    def span_stats(carry, s):
+        start, count = starts[s], counts[s]
+        n_tiles = (count + tile - 1) // tile
+
+        def body(i, st):
+            f, fl, dd, v = tile_of(start, count, i)
+            return merge_stats(st, stats_of(f, v))
+        return lax.fori_loop(0, n_tiles, body, carry)
+
+    big, small = jnp.int32(INT32_MAX), jnp.int32(-INT32_MAX)
+    stats = {"col_min": jnp.full((P.NF,), big),
+             "col_max": jnp.full((P.NF,), small),
+             "tf_min": jnp.float32(jnp.inf),
+             "tf_max": jnp.float32(-jnp.inf),
+             "host_counts": jnp.zeros((1,), jnp.int32)}
+    for s in range(n_spans):
+        stats = span_stats(stats, s)
+    if with_delta:
+        d_v = _tile_valid(d_docids, dead, jnp.ones(d_docids.shape[0], bool))
+        d_v &= _constraint_valid(d_feats16, d_flags, lang_filter, flag_bit,
+                                 from_days, to_days)
+        stats = merge_stats(stats, stats_of(d_feats16, d_v))
+
+    # the reference computes ONE global min/max before scoring
+    # (ReferenceOrder.normalizeWith); on the mesh that is a pmin/pmax
+    # over both DHT axes — idempotent, so replicated delta rows and
+    # empty term rows merge neutrally
+    stats = {"col_min": lax.pmin(stats["col_min"], axes),
+             "col_max": lax.pmax(stats["col_max"], axes),
+             "tf_min": lax.pmin(stats["tf_min"], axes),
+             "tf_max": lax.pmax(stats["tf_max"], axes),
+             "host_counts": stats["host_counts"]}
+
+    def score_rows(f, fl, v):
+        return cardinal_from_stats(f, v, jnp.zeros(f.shape[0], jnp.int32),
+                                   stats, norm_coeffs, flag_bits,
+                                   flag_shifts, domlength_coeff, tf_coeff,
+                                   language_coeff, authority_coeff,
+                                   language_pref, fast_div=True, flags=fl)
+
+    def merge_topk(run, tile_s, tile_d):
+        run_s, run_d = run
+        s = jnp.concatenate([run_s, tile_s])
+        d = jnp.concatenate([run_d, tile_d])
+        top_s, idx = lax.top_k(s, k)
+        return top_s, d[idx]
+
+    init = (jnp.full((k,), NEG_INF32, jnp.int32),
+            jnp.full((k,), -1, jnp.int32))
+
+    def span_score(carry, s):
+        start, count = starts[s], counts[s]
+        n_tiles = (count + tile - 1) // tile
+
+        def body(i, run):
+            f, fl, dd, v = tile_of(start, count, i)
+            sc = score_rows(f, fl, v)
+            tile_s, tile_i = lax.top_k(sc, min(k, tile))
+            return merge_topk(run, tile_s, dd[tile_i])
+        return lax.fori_loop(0, n_tiles, body, carry)
+
+    run = init
+    for s in range(n_spans):
+        run = span_score(run, s)
+    if with_delta:
+        sc = score_rows(d_feats16, d_flags, d_v)
+        tile_s, tile_i = lax.top_k(sc, min(k, sc.shape[0]))
+        run = merge_topk(run, tile_s, d_docids[tile_i])
+
+    # candidate fusion across the whole mesh — the TPU replacement of the
+    # reference's per-peer heap-insert merge (SearchEvent.java:444-497).
+    # With a delta the gathered set holds up to n_devices copies of each
+    # delta row (replicated upload); return the WHOLE sorted gather so
+    # the host-side dedup still has k unique docids left (the gather is
+    # only n_devices*k rows).
+    gs = lax.all_gather(run[0], axes, tiled=True)
+    gd = lax.all_gather(run[1], axes, tiled=True)
+    k_out = gs.shape[0] if with_delta else min(k, gs.shape[0])
+    top_s, idx = lax.top_k(gs, k_out)
+    return top_s, gd[idx]

@@ -243,8 +243,13 @@ class SearchEvent:
         # through a remote tunnel, a full round trip — would dominate.
         # A conjunction's join size is bounded by its RAREST term.
         from ..ops.ranking import SMALL_RANK_N
+        # store-overridable threshold: a mesh dryrun (or a locally
+        # attached device with a ~0 dispatch floor) may lower it
+        thresh = getattr(ds, "small_rank_n", None)
+        if thresh is None:
+            thresh = SMALL_RANK_N
         if min(self.segment.rwi.count_upper(th)
-               for th in inc) <= SMALL_RANK_N:
+               for th in inc) <= thresh:
             return None
         m = q.modifier
         if m.sitehost or m.tld or m.filetype or m.protocol or m.date_sort:

