@@ -402,6 +402,61 @@ def _config8_device_join(iters=10):
           host_s / dev_s)
 
 
+def _config11_metadata_startup(ndocs=1_000_000):
+    """Config #11: metadata-store restart time at 1M docs (VERDICT r2 #2
+    'Done' criterion). Builds a snapshotted segmented store, then times a
+    cold open — which loads the manifest + segment headers and replays
+    only the journal tail, NOT the 1M-row history. vs_baseline compares
+    against the round-2 behavior (full jsonl replay), measured on a 20k
+    sample and scaled linearly (the replay was strictly O(rows))."""
+    import tempfile
+    import time as _t
+
+    from yacy_search_server_tpu.index.metadata import (MetadataStore,
+                                                       metadata_from_parsed)
+    with tempfile.TemporaryDirectory() as tmp:
+        d = f"{tmp}/meta"
+        st = MetadataStore(d)
+        hashes = [f"{i:07d}hash0".encode()[:12].ljust(12, b"0")
+                  for i in range(ndocs)]
+        st.bulk_load(
+            hashes,
+            sku=[f"http://h{i % 4096}.example/d{i}.html" for i in range(ndocs)],
+            title=[f"doc {i}" for i in range(ndocs)],
+            text_t=[f"body text of document {i}" for i in range(ndocs)],
+            host_s=[f"h{i % 4096}.example" for i in range(ndocs)],
+            size_i=[1000] * ndocs, wordcount_i=[100] * ndocs)
+        st.snapshot()
+        st.close()
+        t0 = _t.perf_counter()
+        st2 = MetadataStore(d)
+        assert st2.capacity() == ndocs
+        assert st2.text_value(ndocs // 2, "title") == f"doc {ndocs // 2}"
+        dt = _t.perf_counter() - t0
+
+        # round-2 twin: time a 20k-row journal replay, scale to ndocs
+        sample = 20_000
+        d2 = f"{tmp}/legacy"
+        import json as _json
+        import os as _os
+        _os.makedirs(d2)
+        with open(f"{d2}/metadata.jsonl", "w") as f:
+            for i in range(sample):
+                doc = metadata_from_parsed(
+                    hashes[i], f"http://h{i % 97}.example/d{i}.html",
+                    f"doc {i}", f"body text of document {i}")
+                rec = {"_id": doc.urlhash.decode()}
+                rec.update(doc.fields)
+                f.write(_json.dumps(rec) + "\n")
+        t0 = _t.perf_counter()
+        legacy = MetadataStore(d2)
+        replay_s = (_t.perf_counter() - t0) * (ndocs / sample)
+        legacy.close()
+        st2.close()
+    _emit(f"metadata_startup_s_{ndocs // 1_000_000}M_docs", dt, "seconds",
+          replay_s / max(dt, 1e-9))
+
+
 def _config9_indexing(ndocs=2000):
     """Config #9: indexing write-path throughput — parse + condense +
     store_document (RWI append, metadata, citations, webgraph, dense
@@ -443,7 +498,7 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--cpu-iters", type=int, default=3)
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11],
                     help="run a BASELINE.md benchmark config instead of "
                          "the headline metric")
     args = ap.parse_args()
@@ -458,7 +513,8 @@ def main():
          3: _config3_sharded, 4: _config4_p2p_fusion,
          5: _config5_hybrid, 7: _config7_kernel,
          8: _config8_device_join,
-         9: _config9_indexing}[args.config]()
+         9: _config9_indexing,
+         11: _config11_metadata_startup}[args.config]()
         return
 
     # ------------------------------------------------------------------

@@ -1,0 +1,289 @@
+"""Disk-backed metadata store (VERDICT r2 missing #2).
+
+The store of record is immutable mmap'd segment files; the JSONL journal
+only carries the post-snapshot tail, so restart is O(tail) not
+O(history), and reads touch disk pages instead of host RAM (reference:
+the metadata store is Solr/Lucene, on disk by construction —
+source/net/yacy/search/index/Fulltext.java:90-230).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.index.metadata import (DocumentMetadata,
+                                                   MetadataStore,
+                                                   metadata_from_parsed)
+
+
+def _mkdoc(i, host=None):
+    return metadata_from_parsed(
+        f"{i:07d}hash{i % 97:01d}".encode("ascii")[:12].ljust(12, b"0"),
+        f"http://{host or f'h{i % 5}.example'}/d{i}.html",
+        f"title {i}", f"text body of document {i} " * 3,
+        host_s=host or f"h{i % 5}.example",
+        url_file_ext_s="html", url_protocol_s="http",
+        size_i=100 + i, wordcount_i=10 + i)
+
+
+def test_snapshot_freezes_tail_and_truncates_journal(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d)
+    for i in range(20):
+        st.put(_mkdoc(i))
+    assert st.capacity() == 20
+    st.snapshot()
+    # journal is now empty: restart cost is O(tail)=0
+    assert os.path.getsize(os.path.join(d, "metadata.jsonl")) == 0
+    assert os.path.exists(os.path.join(d, "metadata.manifest.json"))
+    # frozen reads serve from the mmap'd segment
+    assert st._frozen_n == 20 and not st._tail_hashes
+    assert st.text_value(3, "title") == "title 3"
+    assert st.get(7).get("size_i") == 107
+    st.close()
+
+
+def test_restart_replays_only_the_tail(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d)
+    for i in range(30):
+        st.put(_mkdoc(i))
+    st.snapshot()
+    for i in range(30, 34):            # post-snapshot tail
+        st.put(_mkdoc(i))
+    # journal holds exactly the 4 tail records
+    with open(os.path.join(d, "metadata.jsonl")) as f:
+        assert sum(1 for _ in f) == 4
+    st._journal.close()                # simulate crash (no close/snapshot)
+    st._journal = None
+
+    st2 = MetadataStore(d)
+    assert st2.capacity() == 34
+    assert len(st2) == 34
+    assert st2.text_value(31, "title") == "title 31"
+    assert st2.text_value(12, "title") == "title 12"
+    assert st2.docid(_mkdoc(17).urlhash) == 17
+    st2.close()
+
+
+def test_reput_versioning_across_freeze_boundary(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d)
+    doc = _mkdoc(1)
+    first = st.put(doc)
+    st.snapshot()
+    second = st.put(_mkdoc(1))         # same urlhash, frozen old version
+    assert second != first
+    assert st.is_deleted(first)
+    assert st.docid(doc.urlhash) == second
+    st.close()
+    st2 = MetadataStore(d)
+    assert st2.docid(doc.urlhash) == second
+    assert st2.is_deleted(first)
+    st2.close()
+
+
+def test_overrides_on_frozen_rows_survive_restart(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d)
+    for i in range(10):
+        st.put(_mkdoc(i))
+    st.snapshot()
+    st.set_fields(4, references_i=42, title_unique_b=1)
+    assert st.get(4).get("references_i") == 42
+    assert st.int_column("references_i")[4] == 42
+    st.close()
+    st2 = MetadataStore(d)
+    assert st2.get(4).get("references_i") == 42
+    assert st2.int_column("references_i")[4] == 42
+    st2.close()
+
+
+def test_facets_span_segments_tail_and_overrides(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d)
+    for i in range(12):
+        st.put(_mkdoc(i, host="frozen.example"))
+    st.snapshot()
+    for i in range(12, 15):
+        st.put(_mkdoc(i, host="tail.example"))
+    f = st.facet_docids("host_s", "frozen.example")
+    t = st.facet_docids("host_s", "tail.example")
+    assert f.tolist() == list(range(12))
+    assert t.tolist() == [12, 13, 14]
+    # override a frozen row's facet value: moves between value lists
+    st.set_fields(3, host_s="moved.example")
+    assert 3 not in st.facet_docids("host_s", "frozen.example").tolist()
+    assert st.facet_docids("host_s", "moved.example").tolist() == [3]
+    # deletions filtered
+    st.delete(st.urlhash_of(5))
+    assert 5 not in st.facet_docids("host_s", "frozen.example").tolist()
+    st.close()
+    st2 = MetadataStore(d)
+    assert 3 not in st2.facet_docids("host_s", "frozen.example").tolist()
+    assert st2.facet_docids("host_s", "moved.example").tolist() == [3]
+    assert 5 not in st2.facet_docids("host_s", "frozen.example").tolist()
+    st2.close()
+
+
+def test_segment_merge_bounds_segment_count(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d, snapshot_rows=5)
+    docid_of = {}
+    n = 0
+    # 19 snapshots of 5 rows -> merges keep the count under the cap
+    for batch in range(19):
+        for _ in range(5):
+            doc = _mkdoc(n)
+            docid_of[n] = st.put(doc)
+            n += 1
+        st.snapshot()
+    from yacy_search_server_tpu.index.metadata import MAX_SEGMENTS
+    assert len(st._segs) <= MAX_SEGMENTS
+    # every row still readable with its original docid
+    for i in (0, 4, 5, 37, 94):
+        assert st.text_value(docid_of[i], "title") == f"title {i}"
+    st.close()
+    st2 = MetadataStore(d)
+    for i in (0, 4, 5, 37, 94):
+        assert st2.text_value(docid_of[i], "title") == f"title {i}"
+    st2.close()
+
+
+def test_merge_blanks_deleted_payload(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d, snapshot_rows=1000)
+    a = st.put(_mkdoc(0))
+    st.snapshot()
+    b = st.put(_mkdoc(1))
+    st.snapshot()
+    st.delete(st.urlhash_of(a))
+    # force a merge of the two 1-row segments
+    st._merge_smallest()
+    st._persist_state()
+    seg = st._segs[0]
+    assert seg.n == 2
+    assert seg.text("text_t", 0) == ""          # deleted payload blanked
+    assert "document 1" in seg.text("text_t", 1)
+    assert st.row(a) is None and st.row(b) is not None
+    st.close()
+
+
+def test_legacy_jsonl_migrates_to_segments(tmp_path):
+    """A round-2 store (full-history metadata.jsonl, no manifest) opens,
+    replays once, and converts itself to the segmented format."""
+    d = str(tmp_path / "meta")
+    os.makedirs(d)
+    with open(os.path.join(d, "metadata.jsonl"), "w") as f:
+        for i in range(8):
+            doc = _mkdoc(i)
+            rec = {"_id": doc.urlhash.decode()}
+            rec.update(doc.fields)
+            f.write(json.dumps(rec) + "\n")
+        f.write(json.dumps({"_del": _mkdoc(2).urlhash.decode()}) + "\n")
+    st = MetadataStore(d)
+    assert st.capacity() == 8 and len(st) == 7
+    assert st.text_value(5, "title") == "title 5"
+    assert st.is_deleted(2)
+    # converted: manifest exists, journal truncated
+    assert os.path.exists(os.path.join(d, "metadata.manifest.json"))
+    assert os.path.getsize(os.path.join(d, "metadata.jsonl")) == 0
+    st.close()
+
+
+def test_int_column_and_alive_mask_span_all_parts(tmp_path):
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d)
+    for i in range(6):
+        st.put(_mkdoc(i))
+    st.snapshot()
+    for i in range(6, 9):
+        st.put(_mkdoc(i))
+    st.set_fields(2, size_i=7777)          # frozen override
+    st.delete(st.urlhash_of(7))
+    col = st.int_column("size_i")
+    assert col[0] == 100 and col[2] == 7777 and col[8] == 108
+    assert col[7] == 0                     # deleted zeroed
+    mask = st.alive_mask()
+    assert mask[7] == False and mask.sum() == 8  # noqa: E712
+    st.close()
+
+
+# -- webgraph: same paging treatment --------------------------------------
+
+
+class _Anchor:
+    def __init__(self, url, text="", rel="", alt="", name=""):
+        self.url, self.text, self.rel = url, text, rel
+        self.alt, self.name = alt, name
+
+
+def test_webgraph_snapshot_and_tail_restart(tmp_path):
+    from yacy_search_server_tpu.index.webgraph import WebgraphStore
+    d = str(tmp_path / "wg")
+    wg = WebgraphStore(d)
+    for i in range(6):
+        wg.add_document_edges(i, f"http://s{i % 2}.test/p{i}", [
+            _Anchor(url="http://t.test/x", text=f"anchor {i}"),
+            _Anchor(url=f"http://o{i}.test/", text="out")])
+    wg.snapshot()
+    assert os.path.getsize(os.path.join(d, "webgraph.jsonl")) == 0
+    # post-snapshot tail
+    wg.add_document_edges(6, "http://s0.test/p6", [
+        _Anchor(url="http://t.test/x", text="anchor 6")])
+    with open(os.path.join(d, "webgraph.jsonl")) as f:
+        assert sum(1 for _ in f) == 1          # O(tail) journal
+    # lookups span frozen segment + tail
+    texts = wg.anchor_texts("http://t.test/x" and
+                            __import__("yacy_search_server_tpu.utils.hashes",
+                                       fromlist=["url2hash"]).url2hash(
+                                           "http://t.test/x"))
+    assert sorted(texts) == [f"anchor {i}" for i in range(7)]
+    assert len(wg.edges_from_host("s0.test")) == 7
+    wg._journal.close()                        # simulate crash
+    wg._journal = None
+    wg2 = WebgraphStore(d)
+    assert len(wg2) == 13
+    texts2 = wg2.anchor_texts(
+        __import__("yacy_search_server_tpu.utils.hashes",
+                   fromlist=["url2hash"]).url2hash("http://t.test/x"))
+    assert sorted(texts2) == [f"anchor {i}" for i in range(7)]
+    # retirement reaches frozen rows; merge drops them physically
+    wg2.remove_source(0)
+    assert len(wg2.anchor_texts(
+        __import__("yacy_search_server_tpu.utils.hashes",
+                   fromlist=["url2hash"]).url2hash("http://t.test/x"))) == 6
+    wg2.compact()
+    assert wg2.edge_count_total() == len(wg2) == 11
+    wg2.close()
+    wg3 = WebgraphStore(d)
+    assert len(wg3) == 11
+    wg3.close()
+
+
+def test_override_survives_merge_and_reopen_in_facets(tmp_path):
+    """An overridden frozen facet value must stay queryable after the
+    override is folded into a merged segment and the store reopens
+    (regression: the merged facet table skipped _facet_removed docids
+    while the fold emptied the override map — the row vanished from
+    site:/filetype: queries forever)."""
+    d = str(tmp_path / "meta")
+    st = MetadataStore(d, snapshot_rows=1000)
+    a = st.put(_mkdoc(0, host="a.example"))
+    st.snapshot()
+    st.put(_mkdoc(1, host="c.example"))
+    st.snapshot()
+    st.set_fields(a, host_s="b.example")
+    st._merge_smallest()                       # folds the override
+    st._persist_state()
+    assert st.facet_docids("host_s", "b.example").tolist() == [a]
+    assert st.facet_docids("host_s", "a.example").tolist() == []
+    st.snapshot()                              # rebuilds live maps
+    assert st.facet_docids("host_s", "b.example").tolist() == [a]
+    st.close()
+    st2 = MetadataStore(d)
+    assert st2.facet_docids("host_s", "b.example").tolist() == [a]
+    assert st2.facet_docids("host_s", "a.example").tolist() == []
+    st2.close()
