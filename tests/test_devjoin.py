@@ -156,3 +156,60 @@ def test_single_include_with_exclusion(seg3):
 def test_plain_single_term_not_joined(seg3):
     assert seg3.devstore.rank_join([word2hash("aa")], [],
                                    RankingProfile(), "en", k=10) is None
+
+
+def test_batched_joins_parity_under_concurrency(seg3):
+    """Concurrent conjunctions coalesce into lax.map batches (VERDICT r2
+    weak #2) and return exactly the solo kernel's results."""
+    import threading
+
+    ds = seg3.devstore
+    inc = [word2hash("aa"), word2hash("bb")]
+    exc = [word2hash("cc")]
+    prof = RankingProfile()
+    solo = ds.rank_join(inc, exc, prof, "en", k=25)
+    assert solo is not None
+    ds.enable_batching(max_batch=8)
+    served0 = ds.join_served
+    results = [None] * 12
+
+    def worker(i):
+        results[i] = ds.rank_join(inc, exc, prof, "en", k=25)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(12)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for out in results:
+        assert out is not None
+        np.testing.assert_array_equal(np.asarray(out[1]),
+                                      np.asarray(solo[1]))
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.asarray(solo[0]))
+    assert ds.join_served - served0 == 12
+
+
+def test_multispan_fallback_requests_merge():
+    """A conjunction over a term split across runs falls back AND flags
+    merge_wanted; after the merge the device join serves it."""
+    seg = Segment(max_ram_postings=10)
+    rng = np.random.default_rng(9)
+    pool = np.arange(40_000)
+    # same term frozen twice -> two spans
+    seg.rwi.ingest_run({word2hash("aa"): _plist(rng, 4_000, pool[:20_000]),
+                        word2hash("bb"): _plist(rng, 3_000, pool)})
+    seg.rwi.ingest_run({word2hash("aa"): _plist(rng, 4_000, pool[20_000:])})
+    seg.enable_device_serving()
+    ds = seg.devstore
+    try:
+        assert ds.rank_join([word2hash("aa"), word2hash("bb")], [],
+                            RankingProfile(), "en", k=10) is None
+        assert ds.merge_wanted and ds.join_fallbacks >= 1
+        assert seg.rwi.merge_runs(max_runs=1)
+        ds.merge_wanted = False
+        out = ds.rank_join([word2hash("aa"), word2hash("bb")], [],
+                           RankingProfile(), "en", k=10)
+        assert out is not None and ds.join_served >= 1
+    finally:
+        seg.close()
