@@ -1,0 +1,138 @@
+"""Image contentdom serving mode (VERDICT r2 missing #3).
+
+contentdom=image returns per-image entries built from the indexed
+images_urlstub_sxt/images_alt_sxt arrays with source-page attribution,
+deduplicated by image URL, paged — reference:
+source/net/yacy/search/query/SearchEvent.java:2178-2280 and the
+htroot/yacysearchitem.java image branch.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Document, Image
+from yacy_search_server_tpu.switchboard import Switchboard
+
+
+@pytest.fixture(scope="module")
+def imgnode(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("imgsearch")
+    sb = Switchboard(data_dir=str(tmp / "DATA"),
+                     transport=lambda u, h: (404, {}, b""))
+    # page 0..5 carry images; the shared logo dedups to ONE entry
+    for i in range(6):
+        sb.index.store_document(Document(
+            url=f"http://img{i}.test/page.html",
+            title=f"Gallery {i}",
+            text=f"imageword gallery page {i} with pictures " * 3,
+            images=[Image(url=f"http://img{i}.test/pic{i}.jpg",
+                          alt=f"picture {i}"),
+                    Image(url="http://shared.test/logo.png",
+                          alt="shared logo")]))
+    # a text-only page matching the query: contributes NO image entries
+    sb.index.store_document(Document(
+        url="http://textonly.test/a.html", title="Text only",
+        text="imageword but not a single picture here " * 3))
+    yield sb
+    sb.close()
+
+
+def test_image_results_shape_and_dedup(imgnode):
+    ev = imgnode.search("imageword", contentdom="image", count=20)
+    images = ev.image_results(offset=0, count=20)
+    assert images, "no image results"
+    urls = [im.image_url for im in images]
+    assert len(urls) == len(set(urls)), "image URLs must dedup"
+    # the shared logo appears exactly once despite 6 carrier pages
+    assert sum("shared.test/logo.png" in u for u in urls) == 1
+    # source-page attribution travels with every entry
+    for im in images:
+        assert im.source_url.startswith("http://img")
+        assert im.source_title.startswith("Gallery")
+        assert im.host
+    # one per-page pic + one shared logo
+    assert len(images) == 7
+
+
+def test_image_results_paging_is_stable(imgnode):
+    ev = imgnode.search("imageword", contentdom="image", count=3)
+    all_at_once = [im.image_url
+                   for im in ev.image_results(offset=0, count=7)]
+    paged = []
+    for off in (0, 3, 6):
+        paged += [im.image_url
+                  for im in ev.image_results(offset=off, count=3)]
+    assert paged == all_at_once
+
+
+def test_image_mode_http_json(imgnode):
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    srv = YaCyHttpServer(imgnode, port=0).start()
+    try:
+        with urllib.request.urlopen(
+                srv.base_url + "/yacysearch.json?query=imageword"
+                               "&contentdom=image", timeout=10) as r:
+            data = json.loads(r.read())
+        items = data["channels"][0]["items"]
+        assert items
+        for it in items:
+            assert it["image"].startswith("http")
+            assert it["sourcelink"].startswith("http://img")
+            assert "sourcetitle" in it
+        # text mode keeps the classic shape
+        with urllib.request.urlopen(
+                srv.base_url + "/yacysearch.json?query=imageword",
+                timeout=10) as r:
+            tdata = json.loads(r.read())
+        titem = tdata["channels"][0]["items"][0]
+        assert "image" not in titem and "description" in titem
+        # html renders the image grid + active tab
+        with urllib.request.urlopen(
+                srv.base_url + "/yacysearch.html?query=imageword"
+                               "&contentdom=image", timeout=10) as r:
+            html = r.read().decode()
+        assert "imageresult" in html and "<img src=" in html
+        # rss carries media:content for images
+        with urllib.request.urlopen(
+                srv.base_url + "/yacysearch.rss?query=imageword"
+                               "&contentdom=image", timeout=10) as r:
+            rss = r.read().decode()
+        assert "media:content" in rss and 'medium="image"' in rss
+    finally:
+        srv.close()
+
+
+def test_text_mode_unaffected(imgnode):
+    ev = imgnode.search("imageword", count=10)
+    results = ev.results()
+    assert results
+    # text mode still returns page documents (incl. the text-only page)
+    assert any("textonly.test" in r.url for r in results)
+
+
+def test_alt_alignment_with_empty_alts(tmp_path):
+    """Empty alt entries must not shift later alts onto the wrong images
+    (positional multi-value arrays; review fix)."""
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"),
+                     transport=lambda u, h: (404, {}, b""))
+    try:
+        sb.index.store_document(Document(
+            url="http://align.test/p.html", title="Align",
+            text="alignword page " * 5,
+            images=[Image(url="http://align.test/first.jpg", alt=""),
+                    Image(url="https://cdn.align.test/second.png",
+                          alt="the second")]))
+        ev = sb.search("alignword", contentdom="image")
+        images = ev.image_results(offset=0, count=10)
+        by_url = {im.image_url: im for im in images}
+        # alt pairs with its own image, not the first alt-less slot
+        assert by_url["http://align.test/first.jpg"].alt == ""
+        assert by_url["https://cdn.align.test/second.png"].alt \
+            == "the second"
+        # image keeps ITS OWN protocol (https CDN on an http page)
+        assert "https://cdn.align.test/second.png" in by_url
+        assert by_url["https://cdn.align.test/second.png"].filetype == "png"
+    finally:
+        sb.close()

@@ -82,6 +82,7 @@ TEXT_FIELDS = (
     "outboundlinks_anchortext_txt",
     "images_urlstub_sxt",
     "images_alt_sxt",
+    "images_protocol_sxt",
     "icons_urlstub_sxt",
     # -- heading zone texts (h1_txt..h6_txt)
     "h1_txt", "h2_txt", "h3_txt", "h4_txt", "h5_txt", "h6_txt",
@@ -168,6 +169,17 @@ def join_multi(values) -> str:
 
 def split_multi(value: str) -> list[str]:
     return [v for v in value.split(MULTI_SEP) if v] if value else []
+
+
+def join_multi_positional(values) -> str:
+    """Positional variant: EMPTY entries survive, so two parallel arrays
+    (e.g. images_urlstub_sxt + images_alt_sxt) stay index-aligned."""
+    return MULTI_SEP.join((v or "").replace(MULTI_SEP, " ")
+                          for v in values)
+
+
+def split_multi_positional(value: str) -> list[str]:
+    return value.split(MULTI_SEP) if value else []
 
 
 class DocumentMetadata:

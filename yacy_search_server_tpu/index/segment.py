@@ -398,7 +398,7 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
     from ..document.datedetection import (dates_as_iso, dates_in_content)
     from ..document.signature import exact_signature, fuzzy_signature
     from ..utils.hashes import _split, _split_host, normalform
-    from .metadata import join_multi
+    from .metadata import join_multi, join_multi_positional
 
     # link arrays, partitioned by host (inbound = same host)
     inb_stubs, outb_stubs, inb_texts, outb_texts = [], [], [], []
@@ -467,9 +467,17 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
         inboundlinksnofollowcount_i=inb_nofollow,
         outboundlinksnofollowcount_i=outb_nofollow,
         linksnofollowcount_i=inb_nofollow + outb_nofollow,
-        images_urlstub_sxt=join_multi(_urlstub(im.url)
-                                      for im in doc.images),
-        images_alt_sxt=join_multi(im.alt for im in doc.images),
+        # urlstubs may dedup-filter, but alt + protocol arrays must stay
+        # POSITIONALLY aligned with the stub array (image serving pairs
+        # them by index; the reference keeps images_protocol_sxt parallel
+        # for the same reason)
+        images_urlstub_sxt=join_multi_positional(
+            _urlstub(im.url) for im in doc.images),
+        images_alt_sxt=join_multi_positional(
+            im.alt for im in doc.images),
+        images_protocol_sxt=join_multi_positional(
+            im.url.split("://", 1)[0] if "://" in im.url else "http"
+            for im in doc.images),
         images_withalt_i=sum(1 for im in doc.images if im.alt),
         icons_urlstub_sxt=join_multi(
             [_urlstub(doc.favicon)] if doc.favicon else []),

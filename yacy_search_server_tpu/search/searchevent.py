@@ -92,6 +92,32 @@ def _sizename(n: int) -> str:
     return f"{n} TB"
 
 
+@dataclass
+class ImageResult:
+    """One image search result (contentdom=image serving mode — the
+    reference builds these from images_urlstub_sxt with source-page
+    attribution, SearchEvent.java:2178-2280 / yacysearchitem image
+    branch)."""
+
+    image_url: str
+    alt: str
+    source_url: str          # the page the image appears on
+    source_title: str
+    source_urlhash: bytes
+    host: str
+    score: int
+    filetype: str = ""
+    source: str = "local"
+
+    def to_json(self) -> dict:
+        return {"image": self.image_url, "alt": self.alt,
+                "sourcelink": self.source_url,
+                "sourcetitle": self.source_title,
+                "urlhash": self.source_urlhash.decode("ascii", "replace"),
+                "host": self.host, "ranking": int(self.score),
+                "filetype": self.filetype, "source": self.source}
+
+
 class SearchEvent:
     """One live search: executes locally at construction, accepts remote
     feeder inserts afterwards, serves pages via `one_result`/`results`."""
@@ -493,6 +519,74 @@ class SearchEvent:
     def one_result(self, item: int) -> ResultEntry | None:
         page = self.results(offset=item, count=1)
         return page[0] if page else None
+
+    def image_results(self, offset: int | None = None,
+                      count: int | None = None) -> list["ImageResult"]:
+        """One page of IMAGE results (contentdom=image serving mode).
+
+        Ranked page documents — already constrained to HASIMAGE carriers
+        by the contentdom flag filter — expand into one entry per image
+        from their indexed ``images_urlstub_sxt``/``images_alt_sxt``
+        arrays, deduplicated by image URL across source pages (the first,
+        best-ranked, page wins attribution), paged over the expansion.
+        Remote entries carry no local metadata row and contribute no
+        images (the reference fetches their image fields from the peer's
+        metadata lines; our remote ResultEntry surface has no image
+        arrays yet). Match: reference SearchEvent.java:2178-2280."""
+        from ..index.metadata import split_multi_positional
+        from ..utils.hashes import url_file_ext
+        q = self.query
+        offset = q.offset if offset is None else offset
+        count = q.item_count if count is None else count
+        need = offset + count
+        meta = self.segment.metadata
+        out: list[ImageResult] = []
+        seen: set[str] = set()
+        doc_off = 0
+        chunk = max(count, 10)
+        # snippets are never shown in image mode: the carrier-page scan
+        # below must not pay a full text_t read per document
+        snippet_fetch, q.snippet_fetch = q.snippet_fetch, False
+        try:
+            # deterministic expansion from rank 0 every call: dedup must
+            # see the same prefix regardless of the requested page
+            while len(out) < need:
+                docs = self.results(offset=doc_off, count=chunk)
+                if not docs:
+                    break
+                for r in docs:
+                    if r.source != "local":
+                        continue
+                    stubs = split_multi_positional(
+                        meta.text_value(r.docid, "images_urlstub_sxt"))
+                    if not any(stubs):
+                        continue
+                    alts = split_multi_positional(
+                        meta.text_value(r.docid, "images_alt_sxt"))
+                    protos = split_multi_positional(
+                        meta.text_value(r.docid, "images_protocol_sxt"))
+                    for j, stub in enumerate(stubs):
+                        key = stub.lower()
+                        if not stub or key in seen:
+                            continue
+                        seen.add(key)
+                        proto = (protos[j] if j < len(protos)
+                                 and protos[j] else "http")
+                        image_url = f"{proto}://{stub}"
+                        out.append(ImageResult(
+                            image_url=image_url,
+                            alt=alts[j] if j < len(alts) else "",
+                            source_url=r.url, source_title=r.title,
+                            source_urlhash=r.urlhash, host=r.host,
+                            score=r.score,
+                            filetype=url_file_ext(image_url),
+                            source=r.source))
+                doc_off += len(docs)
+                if len(docs) < chunk:
+                    break
+        finally:
+            q.snippet_fetch = snippet_fetch
+        return out[offset:need]
 
     def facet(self, name: str, n: int = 10) -> list[tuple[str, int]]:
         nav = self.navigators.get(name)

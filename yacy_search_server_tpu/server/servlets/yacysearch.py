@@ -35,6 +35,31 @@ def _fill_items(prop: ServerObjects, results, esc) -> None:
         prop.put(p + "eol", 1 if i < len(results) - 1 else 0)
 
 
+def _fill_image_items(prop: ServerObjects, images, esc) -> None:
+    """Image-mode item properties (own result shape: the image URL plus
+    source-page attribution — reference yacysearchitem.java image
+    branch)."""
+    prop.put("items", len(images))
+    for i, im in enumerate(images):
+        p = f"items_{i}_"
+        prop.put(p + "image", esc(im.image_url))
+        prop.put(p + "alt", esc(im.alt))
+        prop.put(p + "title", esc(im.alt or im.source_title))
+        prop.put(p + "link", esc(im.image_url))
+        prop.put(p + "description", esc(im.alt))
+        prop.put(p + "sourcelink", esc(im.source_url))
+        prop.put(p + "sourcetitle", esc(im.source_title))
+        prop.put(p + "urlhash",
+                 im.source_urlhash.decode("ascii", "replace"))
+        prop.put(p + "host", esc(im.host))
+        prop.put(p + "size", 0)
+        prop.put(p + "sizename", "")
+        prop.put(p + "ranking", int(im.score))
+        prop.put(p + "source", esc(str(im.source)))
+        prop.put(p + "filetype", esc(im.filetype))
+        prop.put(p + "eol", 1 if i < len(images) - 1 else 0)
+
+
 def _sizename(n: int) -> str:
     for unit in ("bytes", "kB", "MB", "GB"):
         if n < 1024:
@@ -112,27 +137,62 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
         return prop
 
     t0 = time.time()
+    contentdom = post.get("contentdom", "").lower()
+    image_mode = contentdom == "image"
     event = sb.search(query, count=count, offset=offset,
                       hybrid=post.get_bool("hybrid", False),
-                      contentdom=post.get("contentdom", ""))
-    results = event.results(offset=offset, count=count)
-    prop.put("searchtime", int((time.time() - t0) * 1000))
-    prop.put("totalcount", event.local_rwi_considered + event.remote_results)
-    prop.put("found", 1 if results else 0)
-    _fill_items(prop, results, esc)
+                      contentdom=contentdom)
+    if image_mode:
+        # image serving mode: ranked pages expand into per-image entries
+        # (reference SearchEvent.java:2178-2280 + the yacysearchitem
+        # image branch); own item shape with source-page attribution.
+        # One extra entry makes the hasnext check exact.
+        images = event.image_results(offset=offset, count=count + 1)
+        image_more = len(images) > count
+        images = images[:count]
+        results = []
+        prop.put("searchtime", int((time.time() - t0) * 1000))
+        prop.put("totalcount",
+                 event.local_rwi_considered + event.remote_results)
+        prop.put("found", 1 if images else 0)
+        _fill_image_items(prop, images, esc)
+    else:
+        results = event.results(offset=offset, count=count)
+        prop.put("searchtime", int((time.time() - t0) * 1000))
+        prop.put("totalcount",
+                 event.local_rwi_considered + event.remote_results)
+        prop.put("found", 1 if results else 0)
+        _fill_items(prop, results, esc)
+    prop.put("contentdom_image", 1 if image_mode else 0)
     # page size + ranking mode must survive navigation, or page 2 would
     # re-rank differently and repeat/skip results
     suffix = f"&maximumRecords={count}"
     if post.get_bool("hybrid", False):
         suffix += "&hybrid=true"
+    if contentdom:
+        suffix += f"&contentdom={quote(contentdom)}"
     _fill_navigation(prop, event, esc, base_query=query, url_suffix=suffix)
     # pagination (yacysearch paging over the cached event)
     qq = quote(query)
+    # content-domain tabs (the reference's Text/Images/... search tabs);
+    # the hybrid flag must survive a tab switch like it survives paging
+    hybrid_part = "&hybrid=true" if post.get_bool("hybrid", False) else ""
+    for name in ("text", "image", "audio", "video", "app"):
+        prop.put(f"tab_{name}_url",
+                 f"yacysearch.html?query={qq}&maximumRecords={count}"
+                 f"{hybrid_part}"
+                 + (f"&contentdom={name}" if name != "text" else ""))
+        prop.put(f"tab_{name}_active",
+                 1 if (contentdom or "text") == name else 0)
     prop.put("hasprev", 1 if offset > 0 else 0)
     prop.put("prevurl", f"yacysearch.html?query={qq}"
                         f"&startRecord={max(0, offset - count)}{suffix}")
-    more = event.result_heap.size_available() > offset + len(results)
-    prop.put("hasnext", 1 if (more and results) else 0)
+    got_n = len(images) if image_mode else len(results)
+    if image_mode:
+        more = image_more
+    else:
+        more = event.result_heap.size_available() > offset + got_n
+    prop.put("hasnext", 1 if (more and got_n) else 0)
     prop.put("nexturl", f"yacysearch.html?query={qq}"
                         f"&startRecord={offset + count}{suffix}")
     return prop
