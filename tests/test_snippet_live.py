@@ -1,0 +1,131 @@
+"""Live snippet fetch + snippet-fail eviction (VERDICT r2 missing #4).
+
+Reference: source/net/yacy/search/snippet/TextSnippet.java (cacheStrategy
+fetch) and SearchEvent.java:1862-1948 (concurrent snippet workers +
+deleteIfSnippetFail result-quality eviction).
+"""
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.switchboard import Switchboard
+from yacy_search_server_tpu.utils.config import Config
+
+
+def _node(tmp_path, site, verify="ifexist"):
+    cfg = Config()
+    cfg.set("search.verify", verify)
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), config=cfg,
+                     transport=lambda u, h: site.get(u, (404, {}, b"")))
+    return sb
+
+
+def _blank_text(sb, url):
+    """Simulate an aged store: the row's stored text_t is gone."""
+    from yacy_search_server_tpu.utils.hashes import url2hash
+    docid = sb.index.metadata.docid(url2hash(url))
+    sb.index.metadata.set_fields(docid, text_t="")
+    return docid
+
+
+def test_live_fetch_fills_missing_snippet(tmp_path):
+    site = {"http://live.test/a.html": (
+        200, {"content-type": "text/html"},
+        b"<html><body>The wombat grazes at night. Other text.</body></html>")}
+    sb = _node(tmp_path, site)
+    try:
+        sb.index.store_document(Document(
+            url="http://live.test/a.html", title="Wombat page",
+            text="wombat grazing habits " * 5))
+        _blank_text(sb, "http://live.test/a.html")
+        ev = sb.search("wombat")
+        results = ev.results()
+        assert len(results) == 1
+        # the snippet came from the LIVE fetch, not the blanked store
+        assert "grazes at night" in results[0].snippet
+        assert ev.snippet_evictions == 0
+    finally:
+        sb.close()
+
+
+def test_dead_url_evicted_and_backfilled(tmp_path):
+    site = {"http://alive.test/b.html": (
+        200, {"content-type": "text/html"},
+        b"<html><body>A second numbat page, quite alive.</body></html>")}
+    sb = _node(tmp_path, site)
+    try:
+        # dead doc ranks first (more hits); alive doc backfills the page
+        sb.index.store_document(Document(
+            url="http://dead.test/a.html", title="Dead numbat",
+            text="numbat " * 30))
+        sb.index.store_document(Document(
+            url="http://alive.test/b.html", title="Alive numbat",
+            text="numbat page " * 10))
+        _blank_text(sb, "http://dead.test/a.html")
+        _blank_text(sb, "http://alive.test/b.html")
+        ev = sb.search("numbat", count=1)
+        results = ev.results(offset=0, count=1)
+        # dead.test 404s -> evicted; the page backfills with alive.test
+        assert len(results) == 1
+        assert results[0].url == "http://alive.test/b.html"
+        assert ev.snippet_evictions == 1
+        # deleteIfSnippetFail index hygiene: the dead doc is purged
+        from yacy_search_server_tpu.utils.hashes import url2hash
+        assert not sb.index.metadata.exists(
+            url2hash("http://dead.test/a.html"))
+    finally:
+        sb.close()
+
+
+def test_cacheonly_never_fetches_or_evicts(tmp_path):
+    calls = []
+
+    def transport(u, h):
+        calls.append(u)
+        return (404, {}, b"")
+
+    cfg = Config()
+    cfg.set("search.verify", "cacheonly")
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), config=cfg,
+                     transport=transport)
+    try:
+        sb.index.store_document(Document(
+            url="http://quoll.test/a.html", title="Quoll",
+            text="quoll habitat " * 10))
+        _blank_text(sb, "http://quoll.test/a.html")
+        calls.clear()
+        ev = sb.search("quoll")
+        results = ev.results()
+        # cacheonly: no network, no eviction — the result stays, with an
+        # empty snippet (the reference's p2p default)
+        assert len(results) == 1
+        assert results[0].snippet == ""
+        assert ev.snippet_evictions == 0
+        assert not calls, "cacheonly must never hit the transport"
+    finally:
+        sb.close()
+
+
+def test_transport_error_evicts_page_but_not_index(tmp_path):
+    """A 599 transport error proves nothing: the result is dropped from
+    the page (unverifiable) but the document stays indexed."""
+    def transport(u, h):
+        raise OSError("connection refused")
+
+    cfg = Config()
+    cfg.set("search.verify", "ifexist")
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), config=cfg,
+                     transport=transport)
+    try:
+        sb.index.store_document(Document(
+            url="http://flaky.test/a.html", title="Flaky",
+            text="bilby burrow " * 10))
+        _blank_text(sb, "http://flaky.test/a.html")
+        ev = sb.search("bilby")
+        results = ev.results()
+        assert results == []
+        assert ev.snippet_evictions == 1
+        from yacy_search_server_tpu.utils.hashes import url2hash
+        assert sb.index.metadata.exists(url2hash("http://flaky.test/a.html"))
+    finally:
+        sb.close()

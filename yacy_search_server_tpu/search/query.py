@@ -256,6 +256,11 @@ class QueryParams:
     lang: str = "en"
     profile: RankingProfile | None = None
     snippet_fetch: bool = True
+    # live snippet cacheStrategy (reference: search.verify config —
+    # CACHEONLY never hits the network at query time, the p2p default;
+    # IFEXIST is the intranet default) + deleteIfSnippetFail eviction
+    snippet_strategy: str = "cacheonly"
+    snippet_delete_on_fail: bool = True
     facets: tuple = ("hosts", "language", "filetype", "authors", "year",
                      "dates")
     # domain diversity: max results per host before diversion

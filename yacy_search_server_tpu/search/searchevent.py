@@ -109,22 +109,19 @@ class ImageResult:
     filetype: str = ""
     source: str = "local"
 
-    def to_json(self) -> dict:
-        return {"image": self.image_url, "alt": self.alt,
-                "sourcelink": self.source_url,
-                "sourcetitle": self.source_title,
-                "urlhash": self.source_urlhash.decode("ascii", "replace"),
-                "host": self.host, "ranking": int(self.score),
-                "filetype": self.filetype, "source": self.source}
-
 
 class SearchEvent:
     """One live search: executes locally at construction, accepts remote
     feeder inserts afterwards, serves pages via `one_result`/`results`."""
 
-    def __init__(self, query: QueryParams, segment: Segment):
+    def __init__(self, query: QueryParams, segment: Segment, loader=None):
         self.query = query
         self.segment = segment
+        # crawler loader for LIVE snippet production (None: cache-local
+        # extraction only — embedded/federated events have no crawler)
+        self.loader = loader
+        self.snippet_evictions = 0
+        self._snippet_evicted: set[bytes] = set()
         self.created = time.time()
         self.touched = time.time()
         self._lock = threading.RLock()
@@ -481,17 +478,29 @@ class SearchEvent:
 
     # -- consumption ---------------------------------------------------------
 
+    def results_available(self) -> int:
+        """Heap entries that are actually SERVABLE (snippet-evicted slots
+        stay in the heap but never render — paging links must not point
+        at pages made only of them)."""
+        return max(0, self.result_heap.size_available()
+                   - len(self._snippet_evicted))
+
     def results(self, offset: int | None = None,
-                count: int | None = None) -> list[ResultEntry]:
-        """One page of results, best-first (oneResult loop equivalent)."""
+                count: int | None = None,
+                with_snippets: bool | None = None) -> list[ResultEntry]:
+        """One page of results, best-first (oneResult loop equivalent).
+        `with_snippets` overrides the query's snippet_fetch for THIS call
+        (shared QueryParams on a cached event must never be mutated)."""
         self.touched = time.time()
         q = self.query
         offset = q.offset if offset is None else offset
         count = q.item_count if count is None else count
+        if with_snippets is None:
+            with_snippets = q.snippet_fetch
         need = offset + count
         self._drain(need)
         with self._lock:
-            avail = self.result_heap.size_available()
+            avail = self.results_available()
             if avail < need and self._diverted and not self._pending:
                 # page underfills: merge back diverted same-host entries
                 # (the reference re-admits doubledom-parked results when the
@@ -501,20 +510,90 @@ class SearchEvent:
                 for score, entry in self._diverted[:refill]:
                     self.result_heap.put(entry, score)
                 del self._diverted[:refill]
+        got = self._page_entries(offset, count)
+        if with_snippets:
+            # snippet production may EVICT entries (deleteIfSnippetFail);
+            # backfill from the heap until the page fills or runs dry
+            while True:
+                evicted = self._produce_snippets(got)
+                if not evicted:
+                    break
+                refill = self._page_entries(offset, count)
+                if [e.urlhash for e in refill] == [e.urlhash for e in got]:
+                    break
+                got = refill
+        return got
+
+    def _page_entries(self, offset: int, count: int) -> list[ResultEntry]:
+        """One page from the heap, skipping snippet-evicted entries (their
+        heap slots stay; offsets count LIVE entries only)."""
         got: list[ResultEntry] = []
-        for i in range(offset, need):
+        live = 0
+        i = 0
+        while len(got) < count:
             el = self.result_heap.element(i, timeout_s=0)
+            i += 1
             if el is None:
                 break
-            got.append(el.payload)
-        if q.snippet_fetch:
-            for e in got:
-                if not e.snippet_done and e.source == "local":
-                    text = self.segment.metadata.text_value(e.docid, "text_t")
-                    e.snippet, _ = extract_snippet(
-                        text, self.query.goal.include_words)
-                    e.snippet_done = True
+            e = el.payload
+            if e.urlhash in self._snippet_evicted:
+                continue
+            live += 1
+            if live > offset:
+                got.append(e)
         return got
+
+    def _produce_snippets(self, entries: list[ResultEntry]) -> int:
+        """Fill missing snippets; returns how many entries were evicted
+        (reference: concurrent snippet workers + deleteIfSnippetFail,
+        SearchEvent.java:1862-1948)."""
+        from .snippet import (SNIPPET_DEAD, SNIPPET_OK, SnippetProducer)
+        q = self.query
+        words = q.goal.include_words
+        live_jobs: list[ResultEntry] = []
+        for e in entries:
+            if e.snippet_done or e.snippet:
+                continue
+            if e.source == "local":
+                text = self.segment.metadata.text_value(e.docid, "text_t")
+                if text:
+                    e.snippet, _ = extract_snippet(text, words)
+                    e.snippet_done = True
+                    continue
+            # stored text gone (blanked row / imported metadata) or a
+            # remote result without a peer snippet: live path
+            live_jobs.append(e)
+        if not live_jobs or self.loader is None:
+            for e in live_jobs:
+                e.snippet_done = True
+            return 0
+        producer = SnippetProducer(self.loader, q.snippet_strategy)
+        outcomes = producer.produce_many([e.url for e in live_jobs], words)
+        evicted = 0
+        # eviction applies only when verification was REQUESTED: under
+        # cacheonly a missing cache entry proves nothing (the reference
+        # keeps unverified results in its cacheonly default too)
+        verifying = q.snippet_strategy != "cacheonly"
+        for e, (snippet, outcome) in zip(live_jobs, outcomes):
+            e.snippet_done = True
+            if outcome == SNIPPET_OK:
+                e.snippet = snippet
+                continue
+            if not (verifying and q.snippet_delete_on_fail):
+                continue
+            with self._lock:
+                self._snippet_evicted.add(e.urlhash)
+            self.snippet_evictions += 1
+            evicted += 1
+            if outcome == SNIPPET_DEAD and e.source == "local":
+                # the fetch proved the document gone: purge it from the
+                # local index (the reference's deleteIfSnippetFail index
+                # hygiene; transport errors never purge)
+                try:
+                    self.segment.remove_document(e.urlhash)
+                except Exception:
+                    pass
+        return evicted
 
     def one_result(self, item: int) -> ResultEntry | None:
         page = self.results(offset=item, count=1)
@@ -544,48 +623,50 @@ class SearchEvent:
         seen: set[str] = set()
         doc_off = 0
         chunk = max(count, 10)
-        # snippets are never shown in image mode: the carrier-page scan
-        # below must not pay a full text_t read per document
-        snippet_fetch, q.snippet_fetch = q.snippet_fetch, False
-        try:
-            # deterministic expansion from rank 0 every call: dedup must
-            # see the same prefix regardless of the requested page
-            while len(out) < need:
-                docs = self.results(offset=doc_off, count=chunk)
-                if not docs:
-                    break
-                for r in docs:
-                    if r.source != "local":
+        # deterministic expansion from rank 0 every call: dedup must
+        # see the same prefix regardless of the requested page.
+        # with_snippets=False: image mode never shows page snippets, so
+        # the carrier scan must not pay a text_t read per document.
+        while len(out) < need:
+            docs = self.results(offset=doc_off, count=chunk,
+                                with_snippets=False)
+            if not docs:
+                break
+            for r in docs:
+                if r.source != "local":
+                    continue
+                stubs = split_multi_positional(
+                    meta.text_value(r.docid, "images_urlstub_sxt"))
+                if not any(stubs):
+                    continue
+                protos = split_multi_positional(
+                    meta.text_value(r.docid, "images_protocol_sxt"))
+                # legacy rows (indexed before the positional arrays)
+                # have no protocol column and their alt array dropped
+                # empty slots — alignment is unrecoverable, so alts are
+                # omitted rather than misattributed (re-crawl restores)
+                alts = (split_multi_positional(
+                    meta.text_value(r.docid, "images_alt_sxt"))
+                    if any(protos) else [])
+                for j, stub in enumerate(stubs):
+                    key = stub.lower()
+                    if not stub or key in seen:
                         continue
-                    stubs = split_multi_positional(
-                        meta.text_value(r.docid, "images_urlstub_sxt"))
-                    if not any(stubs):
-                        continue
-                    alts = split_multi_positional(
-                        meta.text_value(r.docid, "images_alt_sxt"))
-                    protos = split_multi_positional(
-                        meta.text_value(r.docid, "images_protocol_sxt"))
-                    for j, stub in enumerate(stubs):
-                        key = stub.lower()
-                        if not stub or key in seen:
-                            continue
-                        seen.add(key)
-                        proto = (protos[j] if j < len(protos)
-                                 and protos[j] else "http")
-                        image_url = f"{proto}://{stub}"
-                        out.append(ImageResult(
-                            image_url=image_url,
-                            alt=alts[j] if j < len(alts) else "",
-                            source_url=r.url, source_title=r.title,
-                            source_urlhash=r.urlhash, host=r.host,
-                            score=r.score,
-                            filetype=url_file_ext(image_url),
-                            source=r.source))
-                doc_off += len(docs)
-                if len(docs) < chunk:
-                    break
-        finally:
-            q.snippet_fetch = snippet_fetch
+                    seen.add(key)
+                    proto = (protos[j] if j < len(protos)
+                             and protos[j] else "http")
+                    image_url = f"{proto}://{stub}"
+                    out.append(ImageResult(
+                        image_url=image_url,
+                        alt=alts[j] if j < len(alts) else "",
+                        source_url=r.url, source_title=r.title,
+                        source_urlhash=r.urlhash, host=r.host,
+                        score=r.score,
+                        filetype=url_file_ext(image_url),
+                        source=r.source))
+            doc_off += len(docs)
+            if len(docs) < chunk:
+                break
         return out[offset:need]
 
     def facet(self, name: str, n: int = 10) -> list[tuple[str, int]]:
@@ -604,14 +685,15 @@ class SearchEventCache:
         self._events: dict[str, SearchEvent] = {}
         self._lock = threading.Lock()
 
-    def get_event(self, query: QueryParams, segment: Segment) -> SearchEvent:
+    def get_event(self, query: QueryParams, segment: Segment,
+                  loader=None) -> SearchEvent:
         qid = query.query_id()
         with self._lock:
             ev = self._events.get(qid)
             if ev is not None:
                 ev.touched = time.time()
                 return ev
-        ev = SearchEvent(query, segment)
+        ev = SearchEvent(query, segment, loader=loader)
         with self._lock:
             self.cleanup_locked()
             self._events[qid] = ev

@@ -191,7 +191,8 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
     if image_mode:
         more = image_more
     else:
-        more = event.result_heap.size_available() > offset + got_n
+        # snippet-evicted heap slots never render: count live ones only
+        more = event.results_available() > offset + got_n
     prop.put("hasnext", 1 if (more and got_n) else 0)
     prop.put("nexturl", f"yacysearch.html?query={qq}"
                         f"&startRecord={offset + count}{suffix}")

@@ -432,8 +432,17 @@ class Switchboard:
                     pass
         if self.content_control.enabled:
             q.url_filter = self.content_control.excluded
+        # live snippet verification policy (reference: search.verify
+        # config; cacheonly is the p2p default, ifexist the intranet one)
+        q.snippet_strategy = self.config.get(
+            "search.verify",
+            "ifexist" if self.config.get(
+                "network.unit.name", "") == "intranet" else "cacheonly")
+        q.snippet_delete_on_fail = self.config.get_bool(
+            "search.verify.delete", True)
         t0 = time.time()
-        event = self.search_cache.get_event(q, self.index)
+        event = self.search_cache.get_event(q, self.index,
+                                            loader=self.loader)
         from .search.accesstracker import QueryLogEntry
         self.access_tracker.add(QueryLogEntry(
             query=query_string, timestamp=t0,
