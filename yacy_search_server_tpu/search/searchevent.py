@@ -513,11 +513,16 @@ class SearchEvent:
         got = self._page_entries(offset, count)
         if with_snippets:
             # snippet production may EVICT entries (deleteIfSnippetFail);
-            # backfill from the heap until the page fills or runs dry
+            # backfill from the heap until the page fills or runs dry —
+            # and RE-DRAIN: evictions consumed materialization cushion,
+            # so _pending may still hold live candidates
             while True:
                 evicted = self._produce_snippets(got)
                 if not evicted:
                     break
+                with self._lock:
+                    self._drained = max(0, self._drained - evicted)
+                self._drain(need)
                 refill = self._page_entries(offset, count)
                 if [e.urlhash for e in refill] == [e.urlhash for e in got]:
                     break

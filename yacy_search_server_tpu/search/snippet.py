@@ -92,11 +92,12 @@ class SnippetProducer:
             # failure): the document was never actually answered for —
             # proves nothing about the URL
             return "", SNIPPET_UNVERIFIED
-        if status in (401, 403, 404, 410):
-            # the server answered that the document is gone/denied — the
-            # deleteIfSnippetFail signal. Transient statuses (429, 5xx)
-            # and transport errors prove NOTHING and must never purge a
-            # live document from the index.
+        if status in (404, 410):
+            # the server answered that the document is GONE — the
+            # deleteIfSnippetFail signal. Access-denied (401/403 — WAFs
+            # routinely 403 crawler-shaped fetches of live pages),
+            # transient statuses (429, 5xx), and transport errors prove
+            # nothing and must never purge a live document.
             return "", SNIPPET_DEAD
         if status != 200 or not resp.content:
             return "", SNIPPET_UNVERIFIED

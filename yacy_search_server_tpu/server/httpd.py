@@ -52,7 +52,8 @@ class YaCyHttpServer:
     def __init__(self, sb, port: int = 8090, host: str = "127.0.0.1",
                  peer_server=None, htroot_dirs: list[str] | None = None,
                  https_port: int | None = None,
-                 certfile: str | None = None, keyfile: str | None = None):
+                 certfile: str | None = None, keyfile: str | None = None,
+                 reuse_port: bool = False):
         self.sb = sb
         self.peer_server = peer_server
         roots = list(htroot_dirs or [])
@@ -64,6 +65,13 @@ class YaCyHttpServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # one buffered write per response + TCP_NODELAY: the default
+            # unbuffered handler emits each header line as its own tiny
+            # segment, and Nagle x delayed-ACK stalls every keep-alive
+            # response ~40 ms — which silently capped the whole served
+            # path (a request costs ~6 ms of actual work)
+            wbufsize = 64 * 1024
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # quiet
                 pass
@@ -85,7 +93,21 @@ class YaCyHttpServer:
                                           keep_blank_values=True))
                 outer._handle(self, post)
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        if reuse_port:
+            # multi-process serving: N worker processes bind the same
+            # port and the kernel load-balances accepts across them
+            # (server/rankservice.py)
+            import socket as _socket
+
+            class _ReusePortServer(ThreadingHTTPServer):
+                def server_bind(self):
+                    self.socket.setsockopt(_socket.SOL_SOCKET,
+                                           _socket.SO_REUSEPORT, 1)
+                    ThreadingHTTPServer.server_bind(self)
+            server_cls = _ReusePortServer
+        else:
+            server_cls = ThreadingHTTPServer
+        self.httpd = server_cls((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: threading.Thread | None = None

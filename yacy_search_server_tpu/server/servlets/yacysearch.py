@@ -141,7 +141,8 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
     image_mode = contentdom == "image"
     event = sb.search(query, count=count, offset=offset,
                       hybrid=post.get_bool("hybrid", False),
-                      contentdom=contentdom)
+                      contentdom=contentdom,
+                      use_cache=not post.get_bool("nocache", False))
     if image_mode:
         # image serving mode: ranked pages expand into per-image entries
         # (reference SearchEvent.java:2178-2280 + the yacysearchitem

@@ -403,7 +403,8 @@ class Switchboard:
 
     def search(self, query_string: str, count: int = 10,
                offset: int = 0, hybrid: bool = False,
-               client: str = "", contentdom: str = "") -> SearchEvent:
+               client: str = "", contentdom: str = "",
+               use_cache: bool = True) -> SearchEvent:
         q = QueryParams.parse(query_string)
         q.item_count = count
         q.offset = offset
@@ -441,8 +442,13 @@ class Switchboard:
         q.snippet_delete_on_fail = self.config.get_bool(
             "search.verify.delete", True)
         t0 = time.time()
-        event = self.search_cache.get_event(q, self.index,
-                                            loader=self.loader)
+        if use_cache:
+            event = self.search_cache.get_event(q, self.index,
+                                                loader=self.loader)
+        else:
+            # cache bypass (benchmarks / debugging): a fresh event per
+            # call — paging over it is the caller's problem
+            event = SearchEvent(q, self.index, loader=self.loader)
         from .search.accesstracker import QueryLogEntry
         self.access_tracker.add(QueryLogEntry(
             query=query_string, timestamp=t0,
