@@ -1,0 +1,246 @@
+"""Schema long tail (VERDICT r2 missing #6): ~55 new collection fields +
+~25 new webgraph edge columns, filled from real parses and round-tripped
+(reference: search/schema/CollectionSchema.java:34+,
+WebgraphSchema.java:34-100)."""
+
+import pytest
+
+from yacy_search_server_tpu.document.parser.registry import parse_source
+from yacy_search_server_tpu.index.metadata import (DOUBLE_FIELDS,
+                                                   INT_FIELDS, TEXT_FIELDS,
+                                                   split_multi,
+                                                   split_multi_positional)
+from yacy_search_server_tpu.index.segment import Segment
+from yacy_search_server_tpu.utils.hashes import url2hash
+
+PAGE = b"""<html lang="en"><head>
+<title>Longtail page</title>
+<meta property="og:title" content="OG Title">
+<meta property="og:type" content="article">
+<meta property="og:url" content="http://lt.test/canonical">
+<meta property="og:image" content="http://lt.test/og.png">
+<meta http-equiv="refresh" content="30;url=http://lt.test/next">
+<link rel="stylesheet" href="/style.css">
+<link rel="stylesheet" href="/print.css">
+<link rel="alternate" hreflang="de" href="http://lt.test/de/">
+<link rel="alternate" hreflang="fr-ca" href="http://lt.test/fr/">
+<link rel="next" href="http://lt.test/page2">
+<script src="/app.js"></script>
+<script>inline();</script>
+</head><body>
+<article>Article body text here</article>
+<ul><li>first item</li><li>second item</li></ul>
+<dl><dt>term one</dt><dd>definition one</dd></dl>
+<p><b>bold words</b> and <em>italic words</em> and <u>underlined</u></p>
+<iframe src="http://frames.test/inner"></iframe>
+<embed src="http://lt.test/movie.swf" type="application/x-shockwave-flash">
+<a href="http://lt.test/in?a=1&b=two">in link</a>
+<a href="https://other.test/out">out link</a>
+</body></html>"""
+
+
+@pytest.fixture(scope="module")
+def seg():
+    s = Segment()
+    docs = parse_source("http://www.lt.test/dir/page.html?x=1&y=2",
+                        "text/html", PAGE)
+    s.store_document(docs[0])
+    yield s
+    s.close()
+
+
+def _row(seg):
+    return seg.metadata.row(
+        seg.metadata.docid(url2hash("http://www.lt.test/dir/page.html?x=1&y=2"))
+        or 0)
+
+
+def test_field_count_target():
+    total = len(TEXT_FIELDS) + len(INT_FIELDS) + len(DOUBLE_FIELDS)
+    assert total >= 130, f"schema shrank to {total} fields"
+
+
+def test_structure_text_groups(seg):
+    row = _row(seg)
+    assert split_multi(row.get("li_txt")) == ["first item", "second item"]
+    assert row.get("licount_i") == 2
+    assert split_multi(row.get("dt_txt")) == ["term one"]
+    assert split_multi(row.get("dd_txt")) == ["definition one"]
+    assert row.get("articlecount_i") == 1
+    assert "Article body" in row.get("article_txt")
+    assert split_multi(row.get("bold_txt")) == ["bold words"]
+    assert split_multi(row.get("italic_txt")) == ["italic words"]
+    assert split_multi(row.get("underline_txt")) == ["underlined"]
+    assert row.get("boldcount_i") == row.get("italiccount_i") \
+        == row.get("underlinecount_i") == 1
+
+
+def test_page_machinery_groups(seg):
+    row = _row(seg)
+    assert row.get("csscount_i") == 2
+    assert "style.css" in row.get("css_url_sxt")
+    assert row.get("scriptscount_i") == 2          # src + inline
+    assert "app.js" in row.get("scripts_sxt")
+    assert row.get("iframesscount_i") == 1
+    assert "frames.test/inner" in row.get("iframes_sxt")
+    assert row.get("flash_b") == 1
+    assert row.get("refresh_s").startswith("30")
+
+
+def test_hreflang_navigation_opengraph(seg):
+    row = _row(seg)
+    assert split_multi_positional(row.get("hreflang_cc_sxt")) \
+        == ["de", "fr-ca"]
+    assert "lt.test/de/" in row.get("hreflang_url_sxt")
+    assert "next" in row.get("navigation_type_sxt")
+    assert "page2" in row.get("navigation_url_sxt")
+    assert row.get("opengraph_title_t") == "OG Title"
+    assert row.get("opengraph_type_s") == "article"
+    assert row.get("opengraph_image_s") == "http://lt.test/og.png"
+
+
+def test_url_host_decomposition(seg):
+    row = _row(seg)
+    assert row.get("url_parameter_key_sxt") == "x|y"
+    assert row.get("url_parameter_value_sxt") == "1|2"
+    assert "page" in row.get("url_file_name_tokens_t")
+    assert row.get("host_dnc_s") == "test.lt"
+    assert row.get("host_id_s")
+    assert len(row.get("md5_s")) == 32
+    assert row.get("title_chars_val") == len("Longtail page")
+    assert row.get("title_exact_signature_l") != 0
+
+
+def test_link_protocol_arrays_positional(seg):
+    row = _row(seg)
+    protos = split_multi_positional(row.get("outboundlinks_protocol_sxt"))
+    stubs = split_multi(row.get("outboundlinks_urlstub_sxt"))
+    assert len(protos) == len(stubs)
+    assert "https" in protos
+
+
+def test_http_www_uniqueness_postprocessing():
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.index.postprocess import (
+        postprocess_uniqueness)
+    s = Segment()
+    try:
+        s.store_document(Document(url="http://dup.test/a", title="A",
+                                  text="alpha text " * 5))
+        s.store_document(Document(url="https://dup.test/a", title="A2",
+                                  text="beta text " * 5))
+        s.store_document(Document(url="http://www.solo.test/b", title="B",
+                                  text="gamma text " * 5))
+        postprocess_uniqueness(s)
+        m = s.metadata
+        d1 = m.docid(url2hash("http://dup.test/a"))
+        d2 = m.docid(url2hash("https://dup.test/a"))
+        d3 = m.docid(url2hash("http://www.solo.test/b"))
+        assert m.row(d1).get("http_unique_b") == 0     # protocol twin
+        assert m.row(d2).get("http_unique_b") == 0
+        assert m.row(d3).get("http_unique_b") == 1
+        assert m.row(d3).get("www_unique_b") == 1
+        assert m.row(d1).get("host_extent_i") == 2
+        assert m.row(d1).get("cr_host_chance_d") == 0.5
+        # process bookkeeping consumed
+        assert m.row(d1).get("process_sxt") == ""
+    finally:
+        s.close()
+
+
+def test_synonyms_sxt_records_expansion():
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.document.synonyms import SynonymLibrary
+    s = Segment()
+    try:
+        lib = SynonymLibrary()
+        lib.add_group(["auto", "car", "vehicle"])
+        s.synonyms = lib
+        s.store_document(Document(url="http://syn.test/a", title="Cars",
+                                  text="the auto drives " * 5))
+        row = s.metadata.row(s.metadata.docid(url2hash("http://syn.test/a")))
+        recorded = row.get("synonyms_sxt").split(",")
+        assert "car" in recorded and "vehicle" in recorded
+    finally:
+        s.close()
+
+
+def test_webgraph_edge_decomposition(seg):
+    edges = seg.webgraph.edges_from_host("www.lt.test")
+    assert edges
+    by_target = {e["target_host_s"]: e for e in edges}
+    e = by_target["other.test"]
+    assert e["target_protocol_s"] == "https"
+    assert e["source_protocol_s"] == "http"
+    assert e["source_host_subdomain_s"] == "www"
+    assert e["source_host_organization_s"] == "lt"
+    assert e["source_host_dnc_s"] == "test.lt"
+    assert e["source_file_name_s"] == "page.html"
+    inlink = by_target["lt.test"]
+    assert inlink["target_parameter_count_i"] == 2
+    assert inlink["target_parameter_key_sxt"] == "a|b"
+    assert inlink["target_parameter_value_sxt"] == "1|two"
+    from yacy_search_server_tpu.index.webgraph import INT_COLS, TEXT_COLS
+    assert len(TEXT_COLS) + len(INT_COLS) >= 48
+
+
+def test_select_surfaces_new_fields(seg):
+    from yacy_search_server_tpu.server.servlets.federate import (
+        respond_select)
+    from yacy_search_server_tpu.server.objects import ServerObjects
+
+    class _SB:
+        index = None
+    sb = _SB()
+    sb.index = seg
+    post = ServerObjects({"q": "id:" + url2hash(
+        "http://www.lt.test/dir/page.html?x=1&y=2").decode(),
+        "fl": "sku,opengraph_title_t,li_txt,csscount_i"})
+    prop = respond_select({"ext": "json"}, post, sb)
+    body = prop.raw_body
+    assert "OG Title" in body and "first item" in body
+
+
+def test_implied_end_tags_and_nested_text():
+    """Unclosed <li> items (implied end tags) and text nested inside
+    bold/italic children must still land in the parent's tag text
+    (review fixes)."""
+    from yacy_search_server_tpu.document.parser.htmlparser import parse_html
+    html = (b"<html><body>"
+            b"<ul><li>one<li>two <b>bold bit</b> tail<li>three</ul>"
+            b"<article><p><b>all bold</b></p></article>"
+            b"<p>after</p></body></html>")
+    doc = parse_html("http://implied.test/", html)[0]
+    assert doc.tag_texts["li"] == ["one", "two bold bit tail", "three"]
+    assert doc.tag_texts["bold"] == ["bold bit", "all bold"]
+    assert doc.tag_texts["article"] == ["all bold"]
+    # trailing page text did NOT leak into a dangling entry
+    assert all("after" not in t for t in doc.tag_texts["li"])
+
+
+def test_www_unique_needs_actual_www_twin():
+    """Protocol twins alone must not clear www_unique_b (review fix)."""
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.index.postprocess import (
+        postprocess_uniqueness)
+    s = Segment()
+    try:
+        s.store_document(Document(url="http://p.test/x", title="1",
+                                  text="one " * 5))
+        s.store_document(Document(url="https://p.test/x", title="2",
+                                  text="two " * 5))
+        s.store_document(Document(url="http://www.w.test/y", title="3",
+                                  text="three " * 5))
+        s.store_document(Document(url="http://w.test/y", title="4",
+                                  text="four " * 5))
+        postprocess_uniqueness(s)
+        m = s.metadata
+        # protocol twins: http-non-unique but www-UNIQUE
+        d = m.docid(url2hash("http://p.test/x"))
+        assert m.row(d).get("http_unique_b") == 0
+        assert m.row(d).get("www_unique_b") == 1
+        # real www twins: www-non-unique
+        d = m.docid(url2hash("http://www.w.test/y"))
+        assert m.row(d).get("www_unique_b") == 0
+    finally:
+        s.close()

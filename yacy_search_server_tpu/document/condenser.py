@@ -79,6 +79,7 @@ class Condenser:
         if not synonyms.has_entries():
             return      # empty library: skip the per-word lock round-trips
         extra: dict[str, WordStat] = {}
+        self.synonym_terms: list[str] = []
         for w, st in self.words.items():
             for syn in synonyms.synonyms_of(w):
                 if syn not in self.words and syn not in extra:
@@ -88,6 +89,8 @@ class Condenser:
                         posofphrase=st.posofphrase,
                         flags=Bitfield(st.flags.value))
         self.words.update(extra)
+        # the record of indexing-time expansion (schema synonyms_sxt)
+        self.synonym_terms = sorted(extra)
 
     # -- core pass -----------------------------------------------------------
 

@@ -65,6 +65,20 @@ class Document:
     favicon: str = ""
     generator: str = ""         # <meta name=generator> (metagenerator_t)
     publisher: str = ""         # dc:publisher / og:site_name
+    # schema long-tail structure groups (html parser; defaults keep
+    # non-HTML parsers untouched)
+    tag_texts: dict = field(default_factory=dict)  # li/dt/dd/article/...
+    css: list = field(default_factory=list)
+    scripts: list = field(default_factory=list)
+    script_count: int = 0
+    iframes: list = field(default_factory=list)
+    frames: list = field(default_factory=list)
+    hreflangs: list = field(default_factory=list)   # (lang-cc, url)
+    navigation: list = field(default_factory=list)  # (rel-type, url)
+    refresh: str = ""
+    flash: bool = False
+    opengraph: dict = field(default_factory=dict)   # og:* sans prefix
+    publisher_url: str = ""
 
     def hyperlinks(self) -> list[Anchor]:
         return self.anchors

@@ -24,6 +24,11 @@ _WS_RE = re.compile(r"\s+")
 
 _IGNORE_CONTENT = {"script", "style", "noscript", "template"}
 _SECTION_TAGS = {"h1", "h2", "h3", "h4", "h5", "h6"}
+# structure-text tags captured for the schema long tail (the reference's
+# li_txt/dt_txt/dd_txt/article_txt/bold_txt/italic_txt/underline_txt)
+_TAGTEXT_TAGS = {"li": "li", "dt": "dt", "dd": "dd", "article": "article",
+                 "b": "bold", "strong": "bold", "i": "italic",
+                 "em": "italic", "u": "underline"}
 _MEDIA_EXT_AUDIO = {"mp3", "ogg", "oga", "flac", "wav", "m4a", "aac"}
 _MEDIA_EXT_VIDEO = {"mp4", "webm", "mkv", "avi", "mov", "mpg", "mpeg", "m4v"}
 _MEDIA_EXT_APP = {"apk", "exe", "msi", "dmg", "jar", "deb", "rpm", "zip",
@@ -51,12 +56,33 @@ class ContentScraper(HTMLParser):
         self._cur_anchor: Anchor | None = None
         self._cur_anchor_text: list[str] = []
         self.embeds: list[str] = []       # audio/video/app media links
+        # schema long-tail structure (CollectionSchema li_txt/bold_txt/
+        # css_url_sxt/scripts_sxt/iframes_sxt/hreflang/navigation/
+        # opengraph/refresh/flash groups)
+        self.tag_texts: dict[str, list[str]] = {}
+        self._tagtext_stack: list[tuple[str, list[str]]] = []
+        self.css: list[str] = []
+        self.scripts: list[str] = []
+        self.script_count = 0
+        self.iframes: list[str] = []
+        self.frames: list[str] = []
+        self.hreflangs: list[tuple[str, str]] = []   # (lang-cc, url)
+        self.navigation: list[tuple[str, str]] = []  # (rel-type, url)
+        self.refresh = ""
+        self.flash = False
 
     # -- tag handling --------------------------------------------------------
 
     def handle_starttag(self, tag, attrs):
         # valueless attributes (<a href>) parse as value None
         a = {k: (v if v is not None else "") for k, v in attrs}
+        if tag == "script":
+            # counted/collected BEFORE the ignore branch eats the tag
+            # (script CONTENT is ignored text; the element itself is a
+            # schema signal: scriptscount_i / scripts_sxt)
+            self.script_count += 1
+            if a.get("src"):
+                self.scripts.append(urljoin(self._base, a["src"]))
         if tag in _IGNORE_CONTENT:
             self._ignore_depth += 1
             self.text_parts.append(" ")
@@ -73,8 +99,11 @@ class ContentScraper(HTMLParser):
             name = (a.get("name") or a.get("property") or "").lower()
             if name and a.get("content") is not None:
                 self.meta[name] = a["content"]
-            if a.get("http-equiv", "").lower() == "content-type":
+            equiv = a.get("http-equiv", "").lower()
+            if equiv == "content-type":
                 self.meta.setdefault("content-type", a.get("content", ""))
+            elif equiv == "refresh":
+                self.refresh = a.get("content", "")
         elif tag == "link":
             rel = a.get("rel", "").lower()
             href = a.get("href", "")
@@ -83,6 +112,30 @@ class ContentScraper(HTMLParser):
                     self.canonical = urljoin(self._base, href)
                 elif "icon" in rel:
                     self.favicon = urljoin(self._base, href)
+                elif "stylesheet" in rel:
+                    self.css.append(urljoin(self._base, href))
+                elif "alternate" in rel and a.get("hreflang"):
+                    self.hreflangs.append((a["hreflang"].lower(),
+                                           urljoin(self._base, href)))
+                elif rel in ("next", "prev", "previous", "contents",
+                             "index", "top", "up", "first", "last",
+                             "glossary", "chapter"):
+                    self.navigation.append((rel, urljoin(self._base, href)))
+        elif tag in _TAGTEXT_TAGS:
+            # implied end tags (html.parser emits none): a new <li>
+            # closes an open li; dt/dd close each other (HTML5 rules) —
+            # real-world lists rarely close their items explicitly
+            if tag == "li":
+                self._pop_tagtext("li")
+            elif tag in ("dt", "dd"):
+                self._pop_tagtext("dt")
+                self._pop_tagtext("dd")
+            self._tagtext_stack.append((_TAGTEXT_TAGS[tag], []))
+        elif tag in ("ul", "ol"):
+            self._pop_tagtext("li")
+        elif tag == "dl":
+            self._pop_tagtext("dt")
+            self._pop_tagtext("dd")
         elif tag == "a":
             href = a.get("href", "")
             if href and not href.startswith(("javascript:", "#", "mailto:",
@@ -106,11 +159,17 @@ class ContentScraper(HTMLParser):
             src = a.get("src") or a.get("data") or ""
             if src:
                 self.embeds.append(urljoin(self._base, src))
+                base_src = src.split("?", 1)[0].split("#", 1)[0].lower()
+                if base_src.rsplit(".", 1)[-1] == "swf" \
+                        or "flash" in a.get("type", "").lower():
+                    self.flash = True
         elif tag in ("frame", "iframe"):
             src = a.get("src", "")
             if src:
-                self.anchors.append(Anchor(urljoin(self._base, src),
-                                           text="", rel="frame"))
+                target = urljoin(self._base, src)
+                (self.iframes if tag == "iframe"
+                 else self.frames).append(target)
+                self.anchors.append(Anchor(target, text="", rel="frame"))
         # every tag boundary is a word separator in the extracted text —
         # adjacent text nodes ("indexing<a>deeper</a>") must not concatenate
         self.text_parts.append(" ")
@@ -134,6 +193,23 @@ class ContentScraper(HTMLParser):
             self.anchors.append(self._cur_anchor)
             self._cur_anchor = None
             self._cur_anchor_text = []
+        elif tag in _TAGTEXT_TAGS:
+            self._pop_tagtext(_TAGTEXT_TAGS[tag])
+        elif tag in ("ul", "ol"):        # closes a dangling implied <li>
+            self._pop_tagtext("li")
+        elif tag == "dl":
+            self._pop_tagtext("dt")
+            self._pop_tagtext("dd")
+
+    def _pop_tagtext(self, key: str) -> None:
+        """Commit the TOP stack entry if it carries `key` (unbalanced end
+        tags for other keys are ignored rather than popping the wrong
+        entry)."""
+        if self._tagtext_stack and self._tagtext_stack[-1][0] == key:
+            _k, parts = self._tagtext_stack.pop()
+            text = _WS_RE.sub(" ", " ".join(parts)).strip()
+            if text:
+                self.tag_texts.setdefault(key, []).append(text[:256])
 
     def handle_data(self, data):
         if self._ignore_depth:
@@ -145,6 +221,10 @@ class ContentScraper(HTMLParser):
             self._section_stack[-1][1].append(data)
         if self._cur_anchor is not None:
             self._cur_anchor_text.append(data)
+        for _key, parts in self._tagtext_stack:
+            # EVERY open structure element gets the text: an <article>'s
+            # words must not vanish into a nested <b>
+            parts.append(data)
         self.text_parts.append(data)
 
 
@@ -240,4 +320,20 @@ def parse_html(url: str, content: bytes,
     doc.generator = scraper.meta.get("generator", "")
     doc.publisher = scraper.meta.get("dc.publisher",
                                      scraper.meta.get("og:site_name", ""))
+    # schema long-tail structure groups (CollectionSchema li_txt,
+    # bold_txt, css_url_sxt, scripts_sxt, iframes_sxt, hreflang_*,
+    # navigation_*, opengraph_*, refresh_s, flash_b)
+    doc.tag_texts = scraper.tag_texts
+    doc.css = scraper.css
+    doc.scripts = scraper.scripts
+    doc.script_count = scraper.script_count
+    doc.iframes = scraper.iframes
+    doc.frames = scraper.frames
+    doc.hreflangs = scraper.hreflangs
+    doc.navigation = scraper.navigation
+    doc.refresh = scraper.refresh
+    doc.flash = scraper.flash
+    doc.opengraph = {k[3:]: v for k, v in scraper.meta.items()
+                     if k.startswith("og:")}
+    doc.publisher_url = scraper.meta.get("og:url", "")
     return [doc]

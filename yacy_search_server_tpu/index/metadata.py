@@ -95,6 +95,57 @@ TEXT_FIELDS = (
     # -- host decomposition (host_* fields)
     "host_organization_s",
     "host_subdomain_s",
+    "host_dnc_s",              # domain-name-core reversed ("com.example")
+    "host_organizationdnc_s",
+    # -- identity / transport (host_id_s, ip_s, md5_s)
+    "host_id_s",               # 6-char host hash (DigestURL host part)
+    "ip_s",
+    "md5_s",                   # content digest
+    # -- postprocessing bookkeeping (process_sxt/harvestkey_s: tags a
+    # doc as awaiting a postprocessing pass; cleared when it runs)
+    "process_sxt",
+    "harvestkey_s",
+    # -- failure docs (ErrorCache rows share the collection schema)
+    "failreason_s",
+    "failtype_s",
+    # -- indexing-time term expansion record
+    "synonyms_sxt",
+    "author_sxt",
+    # -- link protocol arrays (positional, like images_protocol_sxt)
+    "inboundlinks_protocol_sxt",
+    "outboundlinks_protocol_sxt",
+    "icons_protocol_sxt",
+    "icons_rel_sxt",
+    "icons_sizes_sxt",
+    # -- image long tail (alt-joined text + positional dimension arrays)
+    "images_text_t",
+    "images_height_val",
+    "images_width_val",
+    "images_pixel_val",
+    # -- structure text groups (li/dt/dd/article/bold/italic/underline)
+    "li_txt", "dt_txt", "dd_txt", "article_txt",
+    "bold_txt", "italic_txt", "underline_txt",
+    # -- page machinery (css/scripts/frames/iframes/refresh/flash)
+    "css_url_sxt",
+    "scripts_sxt",
+    "frames_sxt",
+    "iframes_sxt",
+    "refresh_s",
+    # -- alternate-language + navigation link relations
+    "hreflang_url_sxt",
+    "hreflang_cc_sxt",
+    "navigation_url_sxt",
+    "navigation_type_sxt",
+    # -- opengraph group
+    "opengraph_title_t",
+    "opengraph_type_s",
+    "opengraph_url_s",
+    "opengraph_image_s",
+    "publisher_url_s",
+    # -- url decomposition long tail
+    "url_file_name_tokens_t",
+    "url_parameter_key_sxt",
+    "url_parameter_value_sxt",
 )
 INT_FIELDS = (
     "size_i",          # byte size
@@ -154,11 +205,33 @@ INT_FIELDS = (
     "fuzzy_signature_unique_b",
     # -- transport
     "responsetime_i",
+    # -- structure counts (schema long tail)
+    "csscount_i",
+    "scriptscount_i",
+    "licount_i", "dtcount_i", "ddcount_i", "articlecount_i",
+    "boldcount_i", "italiccount_i", "underlinecount_i",
+    "framesscount_i",
+    "iframesscount_i",
+    "flash_b",
+    # -- per-field signatures + protocol/www duplicate detection
+    "title_exact_signature_l",
+    "description_exact_signature_l",
+    "http_unique_b",           # this doc is the unique http(s) variant
+    "www_unique_b",            # this doc is the unique www/non-www variant
+    # -- shape counts
+    "title_chars_val",
+    "description_chars_val",
+    "host_extent_i",           # docs this host contributes to the index
+    # -- citation-rank bookkeeping + misc
+    "cr_host_count_i",
+    "rating_i",
+    "schema_org_breadcrumb_i",
 )
 DOUBLE_FIELDS = (
     "lat_d",
     "lon_d",
     "cr_host_norm_d",      # citation rank (postprocessing)
+    "cr_host_chance_d",    # citation-rank transition probability
 )
 
 

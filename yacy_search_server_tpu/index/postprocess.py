@@ -39,12 +39,21 @@ def postprocess_uniqueness(segment) -> int:
     fuzzy: Counter = Counter()
     titles: Counter = Counter()
     descriptions: Counter = Counter()
+    stubs: Counter = Counter()        # protocol-less url (http/https twins)
+    # www-less key -> set of stubs: a doc is www-NON-unique only when an
+    # ACTUAL www twin exists (a stub different from its own) — protocol
+    # twins share one stub and belong to http_unique_b, not here
+    wwwgroups: dict = defaultdict(set)
+    hosts: Counter = Counter()        # docs per host (host_extent_i)
     rows = []
     for d in alive:
         row = meta.row(d)
         e = row.get("exact_signature_l", 0)
         f = row.get("fuzzy_signature_l", 0)
         host = row.get("host_s", "")
+        sku = row.get("sku", "")
+        stub = sku.split("://", 1)[-1]
+        wkey = stub[4:] if stub.startswith("www.") else stub
         t = (host, row.get("title", "").strip().lower())
         de = (host, row.get("description_txt", "").strip().lower())
         if e not in _SENTINEL_EXACT:
@@ -55,12 +64,17 @@ def postprocess_uniqueness(segment) -> int:
             titles[t] += 1
         if de[1]:
             descriptions[de] += 1
-        rows.append((d, e, f, t, de))
+        if stub:
+            stubs[stub] += 1
+            wwwgroups[wkey].add(stub)
+        hosts[host] += 1
+        rows.append((d, e, f, t, de, stub, wkey, host))
 
     changed = 0
-    for d, e, f, t, de in rows:
+    for d, e, f, t, de, stub, wkey, host in rows:
         e_copies = exact.get(e, 1)      # sentinel -> counts as unique
         f_copies = fuzzy.get(f, 1)
+        n_host = hosts.get(host, 1)
         fields = dict(
             exact_signature_copycount_i=e_copies - 1,
             fuzzy_signature_copycount_i=f_copies - 1,
@@ -68,6 +82,17 @@ def postprocess_uniqueness(segment) -> int:
             fuzzy_signature_unique_b=int(f_copies == 1),
             title_unique_b=int(titles.get(t, 0) <= 1),
             description_unique_b=int(descriptions.get(de, 0) <= 1),
+            # http/www duplicate detection (reference postprocessing
+            # http_unique_b / www_unique_b: is this doc the only
+            # protocol / www variant of its url?)
+            http_unique_b=int(stubs.get(stub, 1) <= 1),
+            www_unique_b=int(
+                len(wwwgroups.get(wkey, set()) - {stub}) == 0),
+            host_extent_i=n_host,
+            cr_host_count_i=n_host,
+            cr_host_chance_d=1.0 / max(n_host, 1),
+            # the bookkeeping tag set at store time is consumed here
+            process_sxt="",
         )
         row = meta.row(d)
         if any(row.get(k) != v for k, v in fields.items()):

@@ -83,6 +83,28 @@ TEXT_COLS = (
     "target_name_t",
     "target_file_ext_s",
     "collection_sxt",
+    # -- long tail (WebgraphSchema.java:34-100): url/host decompositions
+    "source_protocol_s",
+    "source_urlstub_s",
+    "source_file_name_s",
+    "source_file_ext_s",
+    "source_path_folders_sxt",
+    "source_host_subdomain_s",
+    "source_host_organization_s",
+    "source_host_dnc_s",
+    "source_host_organizationdnc_s",
+    "target_protocol_s",
+    "target_urlstub_s",
+    "target_file_name_s",
+    "target_path_folders_sxt",
+    "target_host_subdomain_s",
+    "target_host_organization_s",
+    "target_host_dnc_s",
+    "target_host_organizationdnc_s",
+    "target_parameter_key_sxt",
+    "target_parameter_value_sxt",
+    "process_sxt",
+    "harvestkey_s",
 )
 INT_COLS = (
     "source_docid_i",   # internal: retirement key on re-index
@@ -95,6 +117,12 @@ INT_COLS = (
     "target_relflags_i",
     "target_inbound_b",  # 1 when target host == source host
     "load_date_days_i",
+    # -- long tail
+    "source_path_folders_count_i",
+    "target_path_folders_count_i",
+    "target_parameter_count_i",
+    "target_alt_charcount_i",
+    "target_alt_wordcount_i",
 )
 
 MAX_SEGMENTS = 16
@@ -167,19 +195,50 @@ class WebgraphStore:
         one edge per anchor, with link text/alt/rel and the inbound flag)."""
         # _split tolerates malformed URLs (the identity layer's contract:
         # scraped hrefs must never crash indexing) where raw urlsplit raises
+        from urllib.parse import parse_qsl
+
+        from ..utils.hashes import _split_host, host_dnc, url_file_ext
+        from .metadata import join_multi_positional
         src_host = safe_host(source_url)
         src_path = _split(source_url)[3]
         try:
             src_id = url2hash(source_url).decode("ascii")
         except Exception:
             return 0
+
+        def _decomp(url, host, path):
+            """Shared url/host decomposition columns (prefix applied by
+            the caller) — WebgraphSchema's *_protocol/urlstub/file/
+            folders/host_* groups."""
+            proto = url.split("://", 1)[0] if "://" in url else "http"
+            parts = [p for p in path.split("/") if p]
+            fname = "" if (path.endswith("/") or not parts) else parts[-1]
+            folders = parts if not fname else parts[:-1]
+            subdom, org = _split_host(host)
+            dnc, orgdnc = host_dnc(host)
+            return {
+                "protocol_s": proto,
+                "urlstub_s": url.split("://", 1)[-1],
+                "file_name_s": fname,
+                "file_ext_s": url_file_ext(url),
+                "path_folders_sxt": join_multi_positional(folders),
+                "path_folders_count_i": len(folders),
+                "host_subdomain_s": subdom,
+                "host_organization_s": org,
+                "host_dnc_s": dnc,
+                "host_organizationdnc_s": orgdnc,
+            }
+
+        src_decomp = {f"source_{k}": v
+                      for k, v in _decomp(source_url, src_host,
+                                          src_path).items()}
         rows = []
         for order, a in enumerate(anchors):
             target_url = getattr(a, "url", None) or str(a)
             tgt_host = safe_host(target_url)
             if not tgt_host:
                 continue
-            path = _split(target_url)[3]
+            _sch, _h, _po, path, query = _split(target_url)
             ext = url_file_ext(target_url)
             try:
                 tgt_id = url2hash(target_url).decode("ascii")
@@ -189,7 +248,21 @@ class WebgraphStore:
             rel = getattr(a, "rel", "") or ""
             alt = getattr(a, "alt", "") or ""
             name = getattr(a, "name", "") or ""
+            tgt_decomp = {f"target_{k}": v
+                          for k, v in _decomp(target_url, tgt_host,
+                                              path).items()
+                          if k != "file_ext_s"}   # kept as its own column
+            qs = parse_qsl(query, keep_blank_values=True)
             rows.append({
+                **src_decomp,
+                **tgt_decomp,
+                "target_parameter_count_i": len(qs),
+                "target_parameter_key_sxt": join_multi_positional(
+                    k for k, _v in qs),
+                "target_parameter_value_sxt": join_multi_positional(
+                    v for _k, v in qs),
+                "target_alt_charcount_i": len(alt),
+                "target_alt_wordcount_i": len(alt.split()) if alt else 0,
                 "source_id_s": src_id,
                 "source_host_s": src_host,
                 "source_path_s": src_path,

@@ -180,3 +180,16 @@ def dom_length_normalized(urlhash: bytes) -> int:
 def is_local_urlhash(urlhash: bytes) -> bool:
     flagbyte = enhanced_coder.decode_byte(urlhash[11])
     return ((flagbyte >> 2) & 7) == 7
+
+
+def host_dnc(host: str) -> tuple[str, str]:
+    """(dnc, organizationdnc): the reversed "domain name core" pair
+    (reference Domains.getDNC — "www.example.com" -> dnc "com.example",
+    organizationdnc "com.example.www"). Dotless hosts ("localhost") have
+    no core: both come back empty."""
+    if not host or "." not in host:
+        return "", ""
+    _sub, org = _split_host(host)
+    tld = host.rsplit(".", 1)[-1]
+    dnc = ".".join(reversed([p for p in (org, tld) if p]))
+    return dnc, ".".join(reversed(host.split(".")))
