@@ -45,4 +45,4 @@ def _ensure_loaded() -> None:
         return
     _loaded = True
     from . import (yacysearch, status, admin, api, boards,  # noqa: F401
-                   federate, graphics, proxy, monitoring)
+                   federate, graphics, operator, proxy, monitoring)
