@@ -321,6 +321,16 @@ class YaCyHttpServer:
             self._i18n = cached
         return cached
 
+    def _translate_source(self, source: str, section: str) -> str:
+        """Shared expand-includes + translate pipeline: includes expand
+        FIRST so the shared header chrome translates too; properties
+        substitute later, so crawled content is never rewritten."""
+        source = self.templates._expand_includes(source, 0)
+        i18n = self._translation()
+        if not i18n.is_empty():
+            source = i18n.translate(source, section)
+        return source
+
     def _render(self, name: str, ext: str, prop: ServerObjects) -> str:
         if prop.raw_body is not None:
             return prop.raw_body
@@ -330,13 +340,7 @@ class YaCyHttpServer:
             with open(path, encoding="utf-8") as f:
                 source = f.read()
             if ext == "html":
-                # translate the TEMPLATE SOURCE, before property
-                # substitution: .lng pairs must rewrite page chrome only,
-                # never crawled titles/snippets/urls (the reference
-                # translates per-language htroot copies for this reason)
-                i18n = self._translation()
-                if not i18n.is_empty():
-                    source = i18n.translate(source, tmpl)
+                source = self._translate_source(source, tmpl)
             return self.templates.render(source, prop)
         if ext == "html":
             # no bespoke template: render the GENERIC admin page — real
@@ -358,7 +362,9 @@ class YaCyHttpServer:
                     page.put(f"rows_{i}_key", escape_html(str(k)))
                     page.put(f"rows_{i}_value", escape_html(str(v)))
                 with open(gen, encoding="utf-8") as f:
-                    return self.templates.render(f.read(), page)
+                    source = f.read()
+                source = self._translate_source(source, f"{name}.html")
+                return self.templates.render(source, page)
         # No template: serialize the property map directly. Values follow
         # the template contract — the servlet already escaped them for the
         # output medium — so insert them verbatim (json.dumps would
@@ -517,12 +523,23 @@ class YaCyHttpServer:
         ext = relpath.rpartition(".")[2]
         with open(path, "rb") as f:
             data = f.read()
-        if ext == "html":
-            i18n = self._translation()
-            if not i18n.is_empty():
-                data = i18n.translate(
-                    data.decode("utf-8", "replace"),
-                    os.path.basename(relpath)).encode("utf-8")
+        if ext == "html" and (b"#%" in data
+                              or not self._translation().is_empty()):
+            # static html that uses template includes (the shared
+            # chrome), or any page under a non-default locale, runs the
+            # expand -> translate -> render pipeline. Plain static pages
+            # under the default locale are served BYTE-FOR-BYTE — an
+            # operator-dropped file must not be re-encoded or have
+            # literal template-syntax text stripped.
+            try:
+                source = data.decode("utf-8")
+            except UnicodeDecodeError:
+                source = None       # not UTF-8: serve verbatim
+            if source is not None:
+                source = self._translate_source(
+                    source, os.path.basename(relpath))
+                data = self.templates.render(
+                    source, ServerObjects()).encode("utf-8")
         self._send(handler, 200, _CONTENT_TYPES.get(ext, "application/octet-stream"), data)
 
     @staticmethod

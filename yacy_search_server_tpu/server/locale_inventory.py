@@ -1,0 +1,108 @@
+"""UI string inventory — what a complete .lng locale must translate.
+
+The reference ships ~15 full locales built with its Translator tool over
+the htroot templates (reference: locales/*.lng, TranslatorTest). This
+module is the completeness oracle for ours: it extracts every
+operator-visible string from the shipped templates — text nodes between
+tags and button/placeholder attribute values — normalized to the exact
+``>text<`` / ``value="text"`` replacement forms the translation engine
+applies, so a locale file is complete when it carries a pair for every
+inventory entry (brand names and untranslatable tokens excluded).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+HTROOT = os.path.join(os.path.dirname(__file__), "htroot")
+
+_PLACEHOLDER_RE = re.compile(r"#\[[^\]]*\]#|#\(/?[^)]*\)#|#\{/?[^}]*\}#"
+                             r"|#%[^%]*%#")
+_TEXT_RE = re.compile(r">([^<>]+)<")
+# attribute strings are extracted per-TAG so protocol values (hidden
+# form fields) can be excluded — translating value="create" would break
+# the form handler comparing action == "create"
+_TAG_RE = re.compile(r"<(?:input|button|textarea)[^>]*>")
+_ATTR_RE = re.compile(r'(value|placeholder)="([^"#]+)"')
+
+# strings a locale need not translate: brand identity, numbers/units,
+# pure punctuation, protocol tokens
+_SKIP = re.compile(
+    r"^[\s\d\W]*$|^YaCy|^TPU$|^APIs?$|^/|^http|^#|^::|"
+    r"^(ms|kB|MB|GB|q/s|json|rss|xml|csv|html|true|false)$",
+    re.IGNORECASE)
+
+
+def template_names() -> list[str]:
+    out = []
+    for root, _dirs, files in os.walk(HTROOT):
+        for f in files:
+            if f.endswith((".html", ".template")):
+                out.append(os.path.relpath(os.path.join(root, f), HTROOT))
+    return sorted(out)
+
+
+def strings_of(template: str) -> list[str]:
+    """Translatable replacement-form strings of one template."""
+    with open(os.path.join(HTROOT, template), encoding="utf-8") as f:
+        source = f.read()
+    # drop script/style bodies (not operator-visible prose) and template
+    # placeholders (dynamic content is never translated)
+    source = re.sub(r"<script.*?</script>", "", source, flags=re.S)
+    source = re.sub(r"<style.*?</style>", "", source, flags=re.S)
+    cleaned = _PLACEHOLDER_RE.sub("\x00", source)
+    out: list[str] = []
+    seen: set[str] = set()
+    for m in _TEXT_RE.finditer(cleaned):
+        text = m.group(1)
+        if "\x00" in text or "\n" in text:
+            continue
+        if _SKIP.match(text.strip()) or not text.strip():
+            continue
+        form = f">{text}<"
+        if form not in seen:
+            seen.add(form)
+            out.append(form)
+    for tag_m in _TAG_RE.finditer(cleaned):
+        tag = tag_m.group(0)
+        if 'type="hidden"' in tag:
+            continue          # protocol value, never operator-visible
+        for m in _ATTR_RE.finditer(tag):
+            val = m.group(2)
+            if _SKIP.match(val.strip()):
+                continue
+            form = f'{m.group(1)}="{val}"'
+            if form not in seen:
+                seen.add(form)
+                out.append(form)
+    return out
+
+
+def inventory() -> dict[str, list[str]]:
+    """template -> replacement-form strings (empty lists dropped)."""
+    out: dict[str, list[str]] = {}
+    for t in template_names():
+        strs = strings_of(t)
+        if strs:
+            out[t] = strs
+    return out
+
+
+def missing_in(table, inv: dict[str, list[str]] | None = None) -> list[str]:
+    """Inventory entries a loaded TranslationTable does not cover.
+
+    Coverage is PER TEMPLATE, matching translate()'s runtime rule: the
+    global section applies everywhere, a named section only to its own
+    template — a pair filed under Settings_p.html must not count as
+    covering Ranking_p.html."""
+    inv = inv or inventory()
+    global_cov = {src for src, _dst in table._sections.get("*", [])}
+    out = []
+    for t, strs in inv.items():
+        local = {src for src, _dst in
+                 table._sections.get(os.path.basename(t), [])}
+        for s in strs:
+            if s not in global_cov and s not in local:
+                out.append(f"{t}: {s}")
+    return out
