@@ -1,0 +1,157 @@
+"""Java-wire compatibility codec (SURVEY §7 optional stretch; VERDICT r2
+missing #8): simpleEncode seed DNA, MapTools map strings, key=value
+response tables, multipart part maps, salted-magic auth — and a full
+hello round trip between two live nodes speaking the JAVA formats over
+real HTTP (reference: utils/crypt.java:74, kelondro/util/MapTools.java,
+peers/Protocol.java:190,2109,2149, htroot/yacy/hello.java)."""
+
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu.peers import javawire as jw
+from yacy_search_server_tpu.peers.seed import Seed
+
+
+def test_simple_encode_roundtrip():
+    s = "Hello=World,Ünïcode αβγ"
+    for method in ("b", "z", "p", "auto"):
+        enc = jw.simple_encode(s, method)
+        assert enc[1] == "|"
+        assert jw.simple_decode(enc) == s
+    # unencoded strings pass through (crypt.simpleDecode:88)
+    assert jw.simple_decode("plain-no-marker") == "plain-no-marker"
+
+
+def test_simple_encode_matches_java_shape():
+    """Byte-parity with the reference's own example: crypt.java's main()
+    prints enc-b of the 62-char test string; the 'b' coding is just the
+    enhanced base64 of the UTF-8 bytes, which our bit-compatible coder
+    reproduces."""
+    teststring = ("1234567890abcdefghijklmnopqrstuvwxyz"
+                  "ABCDEFGHIJKLMNOPQRSTUVWXYZ")
+    enc = jw.simple_encode(teststring, "b")
+    from yacy_search_server_tpu.utils.base64order import enhanced_coder
+    assert enc == "b|" + enhanced_coder.encode(
+        teststring.encode()).decode("ascii")
+    assert jw.simple_decode(enc) == teststring
+
+
+def test_map_string_roundtrip_and_java_tolerance():
+    m = {"Hash": "abcdefghijkl", "Name": "peer1", "Port": "8090"}
+    s = jw.map2string(m)
+    assert s.startswith("{") and s.endswith(",}")
+    assert jw.string2map(s) == m
+    # tolerant of missing braces and whitespace like MapTools.string2map
+    assert jw.string2map("a=1, b=2,") == {"a": "1", "b": "2"}
+
+
+def test_seed_dna_roundtrip():
+    seed = Seed(b"AAAAbbbbCCCC", name="tpu-node", ip="192.0.2.7",
+                port=8091, peer_type="senior")
+    seed.link_count, seed.word_count = 1234, 567
+    seed.flags_accept_remote_crawl = True
+    enc = jw.encode_seed(seed)
+    back = jw.decode_seed(enc)
+    assert back.hash == seed.hash
+    assert back.name == "tpu-node"
+    assert back.ip == "192.0.2.7" and back.port == 8091
+    assert back.link_count == 1234 and back.word_count == 567
+    assert back.flags_accept_remote_crawl is True
+
+
+def test_decode_handwritten_java_style_seed():
+    """A seed string assembled the way the JAVA side does it — plain
+    'p' coding of a MapTools map — must decode (not just our own
+    encoder's output)."""
+    raw = ("p|{IP=203.0.113.9,Port=8090,Hash=0123456789ab,"
+           "Name=realyacy,PeerType=senior,LCount=42,ICount=7,"
+           "Version=1.922,Flags=s-}")
+    s = jw.decode_seed(raw)
+    assert s.hash == b"0123456789ab" and s.name == "realyacy"
+    assert s.port == 8090 and s.link_count == 42
+    assert s.flags_accept_remote_crawl is True
+    assert s.flags_accept_remote_index is False
+
+
+def test_table_codec():
+    raw = b"message=ok\nyourip=10.0.0.5\n# comment\nseed0=b|QUJD\n"
+    t = jw.table_decode(raw)
+    assert t == {"message": "ok", "yourip": "10.0.0.5",
+                 "seed0": "b|QUJD"}
+    assert jw.table_decode(jw.table_encode(t)) == t
+
+
+def test_multipart_roundtrip_and_auth():
+    parts = jw.basic_request_parts("AAAAbbbbCCCC", "DDDDeeeeFFFF",
+                                   "saltsalt", network_magic="magicword")
+    parts["seed"] = "b|payload"
+    body, ctype = jw.multipart_encode(parts)
+    back = jw.multipart_decode(body, ctype)
+    assert back["iam"] == "AAAAbbbbCCCC"
+    assert back["youare"] == "DDDDeeeeFFFF"
+    assert back["seed"] == "b|payload"
+    # salted-magic-sim digest (Protocol.authentifyRequest:2131)
+    assert back["magicmd5"] == jw.magic_md5("saltsalt", "AAAAbbbbCCCC",
+                                            "magicword")
+
+
+@pytest.fixture()
+def two_nodes(tmp_path):
+    from yacy_search_server_tpu.peers.node import P2PNode
+    from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    net = LoopbackNetwork()
+    a = P2PNode("alice", net, data_dir=str(tmp_path / "a"))
+    b = P2PNode("bob", net, data_dir=str(tmp_path / "b"))
+    srv_b = YaCyHttpServer(b.sb, port=0, peer_server=b.server).start()
+    yield a, b, srv_b
+    srv_b.close()
+    a.close()
+    b.close()
+
+
+def test_java_wire_hello_end_to_end(two_nodes):
+    """A node using the JAVA wire (multipart request, key=value response,
+    simpleEncoded seeds) greets another node over real HTTP: both ends
+    learn each other."""
+    a, b, srv_b = two_nodes
+
+    def http_post(url, body, ctype):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.read()
+
+    client = jw.JavaWireClient(a.seed, http_post)
+    out = client.hello("127.0.0.1", srv_b.port,
+                       target_hash=b.seed.hash.decode("ascii"))
+    assert out is not None
+    other, extra, table = out
+    assert other is not None and other.hash == b.seed.hash
+    assert other.name == "bob"
+    assert table["yourip"] == "127.0.0.1"
+    # bob ingested alice's seed from the Java-format hello
+    assert b.seeddb.get(a.seed.hash) is not None
+    # consistency check rejects a wrong target hash (Protocol.java:248)
+    assert client.hello("127.0.0.1", srv_b.port,
+                        target_hash="WRONGhash999") is None
+
+
+def test_java_hello_rejects_foreign_network(two_nodes):
+    """netid admission (review fix): a peer from another network unit
+    must not enter the seed directory."""
+    a, b, srv_b = two_nodes
+
+    def http_post(url, body, ctype):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.read()
+
+    client = jw.JavaWireClient(a.seed, http_post,
+                               network_name="intranet")
+    out = client.hello("127.0.0.1", srv_b.port)
+    # response is a bare rejection table with no seeds
+    assert out is None or out[0] is None
+    assert b.seeddb.get(a.seed.hash) is None

@@ -1,0 +1,332 @@
+"""Java-wire compatibility codec — speak the reference's P2P formats.
+
+The declared-optional stretch of SURVEY §7: a node should be able to
+federate with a LIVE YaCy peer, whose wire is NOT our JSON transport but
+(reference file:line):
+
+- **request**: HTTP POST multipart/form-data whose parts are key=value
+  strings, with `basicRequestParts` identification fields and the
+  salted-magic-sim authentication digest
+  (source/net/yacy/peers/Protocol.java:2149+, authentifyRequest:2109);
+- **response**: a `key=value` line table (FileUtils.table,
+  Protocol.java:971 result parsing);
+- **seed DNA**: the peer record serialized as `{k=v,k=v,}` (MapTools
+  .map2string, kelondro/util/MapTools.java:71) wrapped in
+  `crypt.simpleEncode` — `"b|" + base64(content)` or `"z|" +
+  base64(gzip(content))`, shorter wins (utils/crypt.java:74,
+  Seed.genSeedStr:1389, genRemoteSeed:1247).
+
+Our Base64Order is already bit-compatible with the reference's enhanced
+coder (utils/base64order.py — DHT math depends on it), so the encodings
+here round-trip against real YaCy output byte-for-byte.
+
+``JavaWireClient`` implements the hello RPC (Protocol.java:190) over an
+injectable HTTP POST callable; ``java_hello_response`` renders the
+server side of hello in the Java table format so a real peer can greet
+this node (htroot/yacy/hello.java). Index-transfer RPCs reuse the same
+codec primitives (transferRWI posts the same part format with
+line-serialized posting rows).
+"""
+
+from __future__ import annotations
+
+import gzip as _gzip
+import hashlib
+import secrets
+import time
+
+from ..utils.base64order import enhanced_coder
+from .seed import Seed
+
+# ---------------------------------------------------------------------------
+# crypt.simpleEncode / simpleDecode
+# ---------------------------------------------------------------------------
+
+
+def simple_encode(content: str, method: str = "auto") -> str:
+    """reference utils/crypt.java:74 — 'b' base64, 'z' gzip+base64,
+    'p' plain; 'auto' = shorter of b/z (Seed.genSeedStr:1389)."""
+    if method == "p":
+        return "p|" + content
+    b = "b|" + enhanced_coder.encode(
+        content.encode("utf-8")).decode("ascii")
+    if method == "b":
+        return b
+    z = "z|" + enhanced_coder.encode(
+        _gzip.compress(content.encode("utf-8"))).decode("ascii")
+    if method == "z":
+        return z
+    return b if len(b) < len(z) else z
+
+
+def simple_decode(encoded: str) -> str | None:
+    if not encoded or len(encoded) < 3:
+        return None
+    if encoded[1] != "|":
+        return encoded          # not encoded (crypt.simpleDecode:88)
+    kind, payload = encoded[0], encoded[2:]
+    try:
+        if kind == "b":
+            return enhanced_coder.decode(payload).decode("utf-8")
+        if kind == "z":
+            return _gzip.decompress(
+                enhanced_coder.decode(payload)).decode("utf-8")
+        if kind == "p":
+            return payload
+    except Exception:
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# MapTools map2string / string2map
+# ---------------------------------------------------------------------------
+
+
+def map2string(m: dict[str, str], braces: bool = True) -> str:
+    """kelondro/util/MapTools.java:71 — ``{k=v,k=v,}`` (note the
+    trailing separator the reference emits)."""
+    body = "".join(f"{k}={v}," for k, v in m.items() if v is not None)
+    return "{" + body + "}" if braces else body
+
+
+def string2map(s: str) -> dict[str, str]:
+    """MapTools.java:54 — tolerant parse of map2string output."""
+    if s is None:
+        return {}
+    if (p := s.find("{")) >= 0:
+        s = s[p + 1:].strip()
+    if (p := s.rfind("}")) >= 0:
+        s = s[:p].strip()
+    out: dict[str, str] = {}
+    for token in s.split(","):
+        token = token.strip()
+        p = token.find("=")
+        if p > 0:
+            out[token[:p].strip()] = token[p + 1:].strip()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Seed DNA (Seed.toString / genSeedStr / genRemoteSeed)
+# ---------------------------------------------------------------------------
+
+# our Seed field <-> reference DNA key (Seed.java constants)
+_FLAG_TRUE, _FLAG_FALSE = "true", "false"
+
+
+def seed_to_dna(seed: Seed) -> dict[str, str]:
+    return {
+        "Hash": seed.hash.decode("ascii", "replace"),
+        "Name": seed.name or "anonymous",
+        "IP": seed.ip,
+        "Port": str(seed.port),
+        "PeerType": seed.peer_type,
+        "Version": str(seed.version),
+        "UTC": "+0000",
+        "LCount": str(seed.link_count),
+        "ICount": str(seed.word_count),
+        "RCount": "0",
+        "Uptime": str(int(seed.uptime_s // 60)),
+        "CRWCnt": "0",
+        "CRTCnt": "0",
+        "dct": str(int(time.time() * 1000)),
+        "Flags": ("".join((
+            "s" if seed.flags_accept_remote_crawl else "-",
+            "s" if seed.flags_accept_remote_index else "-"))),
+    }
+
+
+def encode_seed(seed: Seed) -> str:
+    """Seed.genSeedStr:1389 — DNA map as `{k=v,...}` in simpleEncode."""
+    return simple_encode(map2string(seed_to_dna(seed)))
+
+
+def decode_seed(seed_str: str) -> Seed:
+    """Seed.genRemoteSeed:1247 — decode + DNA map parse; raises
+    ValueError on malformed input (the reference throws IOException)."""
+    decoded = simple_decode(seed_str)
+    if not decoded:
+        raise ValueError("seed string does not decode")
+    dna = string2map(decoded)
+    h = dna.pop("Hash", None)
+    if not h or len(h) != 12:
+        raise ValueError(f"bad seed hash: {h!r}")
+    s = Seed(h.encode("ascii"), name=dna.get("Name", ""),
+             ip=dna.get("IP", ""),
+             port=int(dna.get("Port", "8090") or 8090),
+             peer_type=dna.get("PeerType", "senior"))
+    try:
+        s.link_count = int(dna.get("LCount", "0") or 0)
+        s.word_count = int(dna.get("ICount", "0") or 0)
+    except ValueError:
+        pass
+    flags = dna.get("Flags", "")
+    s.flags_accept_remote_crawl = flags[:1] == "s"
+    s.flags_accept_remote_index = flags[1:2] == "s"
+    return s
+
+
+# ---------------------------------------------------------------------------
+# key=value response tables (FileUtils.table)
+# ---------------------------------------------------------------------------
+
+
+def table_decode(content: bytes | str) -> dict[str, str]:
+    if isinstance(content, bytes):
+        content = content.decode("utf-8", "replace")
+    out: dict[str, str] = {}
+    for line in content.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        p = line.find("=")
+        if p > 0:
+            out[line[:p]] = line[p + 1:]
+    return out
+
+
+def table_encode(m: dict[str, object]) -> bytes:
+    return "".join(f"{k}={v}\n" for k, v in m.items()).encode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# multipart/form-data requests + salted-magic authentication
+# ---------------------------------------------------------------------------
+
+
+def random_salt() -> str:
+    """crypt.randomSalt shape: 8 base64-alphabet chars."""
+    alphabet = bytes(enhanced_coder.alpha).decode("ascii")
+    return "".join(secrets.choice(alphabet) for _ in range(8))
+
+
+def magic_md5(salt: str, iam: str, magic: str) -> str:
+    """salted-magic-sim digest (Protocol.authentifyRequest:2131)."""
+    return hashlib.md5(f"{salt}{iam}{magic}".encode("utf-8")).hexdigest()
+
+
+def basic_request_parts(my_hash: str, target_hash: str | None, salt: str,
+                        network_name: str = "freeworld",
+                        network_magic: str = "") -> dict[str, str]:
+    """Protocol.basicRequestParts:2149 — identification + auth fields."""
+    parts = {"iam": my_hash}
+    if target_hash:
+        parts["youare"] = target_hash
+    parts["mytime"] = time.strftime("%Y%m%d%H%M%S", time.gmtime())
+    parts["myUTC"] = str(int(time.time() * 1000))
+    parts["netid"] = network_name
+    parts["key"] = salt
+    if network_magic:
+        parts["magicmd5"] = magic_md5(salt, my_hash, network_magic)
+    return parts
+
+
+def multipart_encode(parts: dict[str, str]) -> tuple[bytes, str]:
+    """multipart/form-data body + content-type for the part map (the
+    reference posts UTF8.StringBody parts via Apache HttpClient)."""
+    boundary = "----YaCyTPU" + secrets.token_hex(12)
+    chunks: list[bytes] = []
+    for name, value in parts.items():
+        chunks.append(
+            (f"--{boundary}\r\n"
+             f'Content-Disposition: form-data; name="{name}"\r\n\r\n'
+             f"{value}\r\n").encode("utf-8"))
+    chunks.append(f"--{boundary}--\r\n".encode("ascii"))
+    return b"".join(chunks), f"multipart/form-data; boundary={boundary}"
+
+
+def multipart_decode(body: bytes, content_type: str) -> dict[str, str]:
+    """Parse a multipart/form-data body into a part map (the server side
+    of the Java wire; tolerant of both \\r\\n and \\n)."""
+    marker = "boundary="
+    p = content_type.find(marker)
+    if p < 0:
+        return {}
+    boundary = content_type[p + len(marker):].split(";")[0].strip()
+    out: dict[str, str] = {}
+    for segment in body.split(b"--" + boundary.encode("ascii")):
+        seg = segment.strip(b"\r\n")
+        if not seg or seg == b"--":
+            continue
+        head, _, payload = seg.partition(b"\r\n\r\n")
+        if not payload:
+            head, _, payload = seg.partition(b"\n\n")
+        name = None
+        for line in head.decode("utf-8", "replace").splitlines():
+            if "form-data" in line and "name=" in line:
+                name = line.split("name=", 1)[1].strip().strip('";')
+                name = name.split('"')[0]
+        if name:
+            out[name] = payload.decode("utf-8", "replace").rstrip("\r\n")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hello RPC, both directions (Protocol.hello:190 / htroot/yacy/hello.java)
+# ---------------------------------------------------------------------------
+
+
+class JavaWireClient:
+    """Client half of the Java wire. `http_post(url, body, content_type)
+    -> bytes` is injectable — tests run a simulated Java peer, a real
+    deployment passes a urllib-based poster."""
+
+    def __init__(self, my_seed: Seed, http_post,
+                 network_name: str = "freeworld",
+                 network_magic: str = ""):
+        self.my_seed = my_seed
+        self.http_post = http_post
+        self.network_name = network_name
+        self.network_magic = network_magic
+
+    def hello(self, target_host: str, target_port: int,
+              target_hash: str | None = None):
+        """POST /yacy/hello.html in the Java part format; returns
+        (other_peer_seed, extra_seeds, response_table) or None."""
+        salt = random_salt()
+        parts = basic_request_parts(
+            self.my_seed.hash.decode("ascii"), target_hash, salt,
+            self.network_name, self.network_magic)
+        parts["count"] = "20"
+        parts["magic"] = "0"
+        parts["seed"] = encode_seed(self.my_seed)
+        body, ctype = multipart_encode(parts)
+        url = f"http://{target_host}:{target_port}/yacy/hello.html"
+        try:
+            raw = self.http_post(url, body, ctype)
+        except Exception:
+            return None
+        if not raw:
+            return None
+        table = table_decode(raw)
+        seeds: list[Seed] = []
+        i = 0
+        while (s := table.get(f"seed{i}")) is not None:
+            try:
+                seeds.append(decode_seed(s))
+            except ValueError:
+                pass
+            i += 1
+        other = seeds[0] if seeds else None
+        if other is not None and target_hash \
+                and other.hash.decode("ascii") != target_hash:
+            return None         # consistency check (Protocol.java:248)
+        return other, seeds[1:], table
+
+
+def java_hello_response(my_seed: Seed, extra_seeds: list[Seed],
+                        client_ip: str, client_seed: Seed | None) -> bytes:
+    """Server half of hello in the Java table format
+    (htroot/yacy/hello.java): seed0 = this node, seedN = a gossip batch,
+    yourip/yourtype tell the caller how it looks from here."""
+    table: dict[str, object] = {
+        "message": "ok",
+        "mytime": time.strftime("%Y%m%d%H%M%S", time.gmtime()),
+        "seed0": encode_seed(my_seed),
+        "yourip": client_ip,
+        "yourtype": (client_seed.peer_type if client_seed else "junior"),
+    }
+    for i, s in enumerate(extra_seeds[:20], start=1):
+        table[f"seed{i}"] = encode_seed(s)
+    return table_encode(table)

@@ -77,9 +77,13 @@ class YaCyHttpServer:
                 pass
 
             def do_GET(self):
+                self._javawire = False
                 outer._handle(self, {})
 
             def do_POST(self):
+                # reset per REQUEST: one handler serves a whole
+                # keep-alive connection
+                self._javawire = False
                 length = int(self.headers.get("content-length", 0) or 0)
                 body = self.rfile.read(length) if length else b""
                 ctype = self.headers.get("content-type", "")
@@ -88,6 +92,14 @@ class YaCyHttpServer:
                         post = json.loads(body.decode("utf-8"))
                     except ValueError:
                         post = {}
+                elif "multipart/form-data" in ctype:
+                    # the Java wire posts multipart key=value parts
+                    # (reference Protocol.java basicRequestParts). The
+                    # marker is OUT-OF-BAND (handler attribute): an
+                    # in-band param could be forged via query string
+                    from ..peers.javawire import multipart_decode
+                    post = multipart_decode(body, ctype)
+                    self._javawire = True
                 else:
                     post = dict(parse_qsl(body.decode("utf-8", "replace"),
                                           keep_blank_values=True))
@@ -508,6 +520,48 @@ class YaCyHttpServer:
         endpoint = path[len("/yacy/"):]
         if endpoint.endswith(".html"):
             endpoint = endpoint[:-5]
+        if getattr(handler, "_javawire", False) and endpoint == "hello":
+            # a REAL YaCy peer greeting us: answer in the Java key=value
+            # table format (htroot/yacy/hello.java), with the caller's
+            # seed ingested into our directory like our native hello
+            from ..peers import javawire
+            seeddb = self.peer_server.seeddb
+            # network-unit admission (reference hello.java via
+            # Protocol.authentifyRequest:2109): a peer from a foreign
+            # network must not pollute this seed directory
+            cfg = self.sb.config
+            unit = cfg.get("network.unit.name", "freeworld")
+            if params.get("netid", unit) != unit:
+                self._send(handler, 200, "text/plain; charset=utf-8",
+                           b"message=wrong network\n")
+                return
+            magic = cfg.get(
+                "network.unit.protocol.request.authentication.essentials",
+                "")
+            if magic and params.get("magicmd5", "") != javawire.magic_md5(
+                    params.get("key", ""), params.get("iam", ""), magic):
+                self._send(handler, 200, "text/plain; charset=utf-8",
+                           b"message=authentication failed\n")
+                return
+            client_seed = None
+            try:
+                client_seed = javawire.decode_seed(params.get("seed", ""))
+                # patch the address to what we actually saw (the
+                # reference anti-spoofing rule, Protocol.java:246)
+                client_seed.ip = handler.client_address[0]
+                seeddb.connected(client_seed)
+            except ValueError:
+                pass
+            # live index counts, like the native do_hello reply
+            me = seeddb.my_seed
+            me.link_count = self.sb.index.doc_count()
+            me.word_count = self.sb.index.rwi_size()
+            extra = [s for s in seeddb.active_seeds()
+                     if s.hash != me.hash][:20]
+            body = javawire.java_hello_response(
+                me, extra, handler.client_address[0], client_seed)
+            self._send(handler, 200, "text/plain; charset=utf-8", body)
+            return
         result = self.peer_server.handle(endpoint, params)
         body = json.dumps(result, default=_wire_default).encode("utf-8")
         self._send(handler, 200, "application/json", body)
