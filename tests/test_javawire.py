@@ -155,3 +155,27 @@ def test_java_hello_rejects_foreign_network(two_nodes):
     # response is a bare rejection table with no seeds
     assert out is None or out[0] is None
     assert b.seeddb.get(a.seed.hash) is None
+
+
+def test_quoted_boundary_and_seed0_separation():
+    """RFC 2046 quoted boundaries parse; a broken seed0 must not let a
+    gossip seed impersonate the responder (review fixes)."""
+    body, ctype = jw.multipart_encode({"a": "1", "b": "two"})
+    boundary = ctype.split("boundary=")[1]
+    quoted = ctype.replace(boundary, f'"{boundary}"')
+    assert jw.multipart_decode(body, quoted) == {"a": "1", "b": "two"}
+
+    # hello with an undecodable seed0 but a valid gossip seed1
+    gossip = Seed(b"GGGGhhhhIIII", name="gossip")
+    table = {"message": "ok", "seed0": "b|garbage~~",
+             "seed1": jw.encode_seed(gossip)}
+
+    def post(url, body, ctype):
+        return jw.table_encode(table)
+
+    client = jw.JavaWireClient(Seed(b"AAAAbbbbCCCC", name="me"), post)
+    out = client.hello("127.0.0.1", 1)
+    assert out is not None
+    other, extra, _t = out
+    assert other is None                      # responder unknown
+    assert [s.name for s in extra] == ["gossip"]

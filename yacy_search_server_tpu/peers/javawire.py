@@ -244,6 +244,8 @@ def multipart_decode(body: bytes, content_type: str) -> dict[str, str]:
     if p < 0:
         return {}
     boundary = content_type[p + len(marker):].split(";")[0].strip()
+    # RFC 2046 allows a QUOTED boundary; several HTTP stacks emit it
+    boundary = boundary.strip('"')
     out: dict[str, str] = {}
     for segment in body.split(b"--" + boundary.encode("ascii")):
         seg = segment.strip(b"\r\n")
@@ -300,19 +302,26 @@ class JavaWireClient:
         if not raw:
             return None
         table = table_decode(raw)
-        seeds: list[Seed] = []
-        i = 0
+        # seed0 IS the responder; seed1..N are gossip — they must not
+        # stand in for each other when one fails to decode
+        other: Seed | None = None
+        if (s0 := table.get("seed0")) is not None:
+            try:
+                other = decode_seed(s0)
+            except ValueError:
+                other = None
+        extra: list[Seed] = []
+        i = 1
         while (s := table.get(f"seed{i}")) is not None:
             try:
-                seeds.append(decode_seed(s))
+                extra.append(decode_seed(s))
             except ValueError:
                 pass
             i += 1
-        other = seeds[0] if seeds else None
         if other is not None and target_hash \
                 and other.hash.decode("ascii") != target_hash:
             return None         # consistency check (Protocol.java:248)
-        return other, seeds[1:], table
+        return other, extra, table
 
 
 def java_hello_response(my_seed: Seed, extra_seeds: list[Seed],

@@ -525,13 +525,15 @@ class YaCyHttpServer:
             # table format (htroot/yacy/hello.java), with the caller's
             # seed ingested into our directory like our native hello
             from ..peers import javawire
-            seeddb = self.peer_server.seeddb
+            from ..peers.seed import Seed as _Seed
             # network-unit admission (reference hello.java via
             # Protocol.authentifyRequest:2109): a peer from a foreign
-            # network must not pollute this seed directory
+            # network must not pollute this seed directory. An absent
+            # netid defaults to "freeworld" EXACTLY like the reference
+            # (post.get(NETWORK_NAME, Seed.DFLT_NETWORK_UNIT)).
             cfg = self.sb.config
             unit = cfg.get("network.unit.name", "freeworld")
-            if params.get("netid", unit) != unit:
+            if params.get("netid", "freeworld") != unit:
                 self._send(handler, 200, "text/plain; charset=utf-8",
                            b"message=wrong network\n")
                 return
@@ -543,21 +545,29 @@ class YaCyHttpServer:
                 self._send(handler, 200, "text/plain; charset=utf-8",
                            b"message=authentication failed\n")
                 return
+            # translate the Java formats at the edge, then delegate to
+            # THE hello implementation (PeerServer.do_hello owns seed
+            # ingest, live counts, and the gossip batch)
+            payload: dict = {}
             client_seed = None
             try:
                 client_seed = javawire.decode_seed(params.get("seed", ""))
                 # patch the address to what we actually saw (the
                 # reference anti-spoofing rule, Protocol.java:246)
                 client_seed.ip = handler.client_address[0]
-                seeddb.connected(client_seed)
+                payload["seed"] = client_seed.dna()
             except ValueError:
                 pass
-            # live index counts, like the native do_hello reply
-            me = seeddb.my_seed
-            me.link_count = self.sb.index.doc_count()
-            me.word_count = self.sb.index.rwi_size()
-            extra = [s for s in seeddb.active_seeds()
-                     if s.hash != me.hash][:20]
+            reply = self.peer_server.do_hello(payload)
+            me = _Seed.from_dna(reply["seed"])
+            extra = []
+            for dna in reply.get("seeds", []):
+                try:
+                    s = _Seed.from_dna(dna)
+                except (KeyError, ValueError):
+                    continue
+                if s.hash != me.hash:
+                    extra.append(s)
             body = javawire.java_hello_response(
                 me, extra, handler.client_address[0], client_seed)
             self._send(handler, 200, "text/plain; charset=utf-8", body)
