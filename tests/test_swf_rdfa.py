@@ -1,0 +1,174 @@
+"""SWF + RDFa parsers — the last parser-zoo gaps (VERDICT r2 §2.4:
+'Missing: rdfa, swf'). SWF is parsed from the file-format spec
+(DefineEditText + ActionScript constant pools/GetURL); RDFa-Lite triples
+feed the lod triple store (reference: document/parser/swfParser.java,
+document/parser/rdfa/)."""
+
+import struct
+import zlib
+
+import pytest
+
+from yacy_search_server_tpu.document.parser.rdfa import extract_triples
+from yacy_search_server_tpu.document.parser.swfparser import parse_swf
+
+
+# -- swf fixture builders (spec-shaped, not copied from anywhere) ----------
+
+def _tag(code: int, payload: bytes) -> bytes:
+    if len(payload) < 0x3F:
+        return struct.pack("<H", (code << 6) | len(payload)) + payload
+    return struct.pack("<HI", (code << 6) | 0x3F, len(payload)) + payload
+
+
+def _edit_text_tag(var: bytes, text: bytes) -> bytes:
+    # CharacterID + minimal RECT (nbits=0) + flag BYTES (byte0 HasText
+    # = 0x80 per the spec's MSB-first bit stream) + var + text
+    payload = (struct.pack("<H", 7) + bytes([0])
+               + bytes([0x80, 0x00])
+               + var + b"\0" + text + b"\0")
+    return _tag(37, payload)
+
+
+def _do_action_tag(strings: list[bytes], url: bytes | None = None) -> bytes:
+    pool = struct.pack("<H", len(strings)) + b"".join(
+        s + b"\0" for s in strings)
+    actions = bytes([0x88]) + struct.pack("<H", len(pool)) + pool
+    if url is not None:
+        geturl = url + b"\0" + b"_self\0"
+        actions += bytes([0x83]) + struct.pack("<H", len(geturl)) + geturl
+    actions += b"\0"
+    return _tag(12, actions)
+
+
+def _swf(body_tags: bytes, compress: str | None = None) -> bytes:
+    body = bytes([0]) + b"\x12\x00\x01\x00" + body_tags + _tag(0, b"")
+    # RECT nbits=0 (1 byte) + frame rate + frame count
+    raw = b"FWS" if compress is None else b"CWS"
+    full_len = 8 + len(body)
+    out = raw + bytes([9]) + struct.pack("<I", full_len)
+    if compress == "zlib":
+        return out[:3] + out[3:8] + zlib.compress(body)
+    return out + body
+
+
+def test_swf_edit_text_and_actions():
+    tags = (_edit_text_tag(b"greeting", b"Hello flash world")
+            + _do_action_tag([b"flashword one", b"http://swf.test/out"],
+                             url=b"http://swf.test/click"))
+    data = _swf(tags)
+    docs = parse_swf("http://site.test/movie.swf", data)
+    doc = docs[0]
+    assert "Hello flash world" in doc.text
+    assert "flashword one" in doc.text
+    urls = [a.url for a in doc.anchors]
+    assert "http://swf.test/out" in urls
+    assert "http://swf.test/click" in urls
+
+
+def test_swf_zlib_compressed():
+    tags = _edit_text_tag(b"v", b"compressed flash text")
+    docs = parse_swf("http://site.test/c.swf",
+                     _swf(tags, compress="zlib"))
+    assert "compressed flash text" in docs[0].text
+
+
+def test_swf_garbage_rejected():
+    from yacy_search_server_tpu.document.parser.errors import ParserError
+    with pytest.raises(ParserError):
+        parse_swf("http://x.test/a.swf", b"GIF89a not a flash file")
+
+
+def test_swf_registered_in_parser_zoo():
+    from yacy_search_server_tpu.document.parser.registry import parse_source
+    tags = _edit_text_tag(b"v", b"registry flash text")
+    docs = parse_source("http://site.test/m.swf",
+                        "application/x-shockwave-flash", _swf(tags))
+    assert "registry flash text" in docs[0].text
+
+
+# -- rdfa -------------------------------------------------------------------
+
+RDFA_PAGE = b"""<html><body vocab="http://schema.org/" prefix="dc: http://purl.org/dc/terms/">
+<div about="/book/1" typeof="Book">
+  <span property="name">The TPU Book</span>
+  <a property="dc:creator" href="/authors/ada">Ada</a>
+  <meta property="datePublished" content="2026-01-01">
+</div>
+<div about="/book/2">
+  <span property="name">Second Title</span>
+</div>
+</body></html>"""
+
+
+def test_rdfa_triples():
+    triples = extract_triples(RDFA_PAGE, "http://lib.test/")
+    t = set(triples)
+    assert ("http://lib.test/book/1",
+            "http://www.w3.org/1999/02/22-rdf-syntax-ns#type",
+            "http://schema.org/Book") in t
+    assert ("http://lib.test/book/1", "http://schema.org/name",
+            "The TPU Book") in t
+    assert ("http://lib.test/book/1", "http://purl.org/dc/terms/creator",
+            "http://lib.test/authors/ada") in t
+    assert ("http://lib.test/book/1", "http://schema.org/datePublished",
+            "2026-01-01") in t
+    assert ("http://lib.test/book/2", "http://schema.org/name",
+            "Second Title") in t
+
+
+def test_rdfa_flows_into_triplestore(tmp_path):
+    """Crawled RDFa lands in the node's lod triple store (reference:
+    parser/rdfa -> cora/lod)."""
+    from yacy_search_server_tpu.switchboard import Switchboard
+    site = {"http://rdfa.test/": (200, {"content-type": "text/html"},
+                                  RDFA_PAGE)}
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"),
+                     transport=lambda u, h: site.get(u, (404, {}, b"")))
+    sb.latency.min_delta_s = 0.0
+    try:
+        sb.start_crawl("http://rdfa.test/", depth=0)
+        sb.crawl_until_idle(timeout_s=30)
+        hits = sb.triplestore.query(None, "http://schema.org/name", None)
+        objs = {o for _s, _p, o in hits}
+        assert "The TPU Book" in objs and "Second Title" in objs
+    finally:
+        sb.close()
+
+
+def test_plain_html_skips_rdfa_scan():
+    from yacy_search_server_tpu.document.parser.htmlparser import parse_html
+    doc = parse_html("http://plain.test/",
+                     b"<html><body><p>no annotations</p></body></html>")[0]
+    assert doc.rdf_triples == []
+
+
+def test_rdfa_implied_closes_and_unclosed_tags():
+    """Unclosed <p>/<li> (implied end tags) still emit their pending
+    triples, and a dangling about= subject does not leak past its
+    element (review fixes)."""
+    page = (b'<html><body vocab="http://schema.org/">'
+            b'<p property="description">first para'
+            b'<p property="alternativeHeadline">second para'
+            b'<ul><li about="urn:item1" property="name">item one'
+            b'<li property="name">item two</ul>'
+            b'</body></html>')
+    triples = set(extract_triples(page, "http://p.test/"))
+    assert ("http://p.test/", "http://schema.org/description",
+            "first para") in triples
+    assert ("http://p.test/", "http://schema.org/alternativeHeadline",
+            "second para") in triples
+    assert ("urn:item1", "http://schema.org/name", "item one") in triples
+    # the second li's implied close popped urn:item1: page is subject
+    assert ("http://p.test/", "http://schema.org/name",
+            "item two") in triples
+
+
+def test_og_meta_alone_skips_triple_scan():
+    from yacy_search_server_tpu.document.parser.htmlparser import parse_html
+    doc = parse_html(
+        "http://og.test/",
+        b'<html><head><meta property="og:title" content="T"></head>'
+        b"<body>plain</body></html>")[0]
+    assert doc.rdf_triples == []
+    assert doc.opengraph.get("title") == "T"

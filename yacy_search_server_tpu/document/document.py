@@ -79,6 +79,7 @@ class Document:
     flash: bool = False
     opengraph: dict = field(default_factory=dict)   # og:* sans prefix
     publisher_url: str = ""
+    rdf_triples: list = field(default_factory=list)  # (s, p, o)
 
     def hyperlinks(self) -> list[Anchor]:
         return self.anchors

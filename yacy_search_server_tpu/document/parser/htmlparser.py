@@ -70,12 +70,20 @@ class ContentScraper(HTMLParser):
         self.navigation: list[tuple[str, str]] = []  # (rel-type, url)
         self.refresh = ""
         self.flash = False
+        self.saw_rdfa = False
 
     # -- tag handling --------------------------------------------------------
 
     def handle_starttag(self, tag, attrs):
         # valueless attributes (<a href>) parse as value None
         a = {k: (v if v is not None else "") for k, v in attrs}
+        # real RDFa signal, recorded by the FIRST pass so the dedicated
+        # triple scan only runs when there is something beyond the og:
+        # metas already captured in self.meta
+        if not self.saw_rdfa and (
+                "vocab" in a or "typeof" in a or "about" in a
+                or (tag != "meta" and "property" in a)):
+            self.saw_rdfa = True
         if tag == "script":
             # counted/collected BEFORE the ignore branch eats the tag
             # (script CONTENT is ignored text; the element itself is a
@@ -336,4 +344,10 @@ def parse_html(url: str, content: bytes,
     doc.opengraph = {k[3:]: v for k, v in scraper.meta.items()
                      if k.startswith("og:")}
     doc.publisher_url = scraper.meta.get("og:url", "")
+    # RDFa triples (reference parser/rdfa feeding the lod triple store);
+    # the second scan only runs when the first pass saw REAL RDFa (og:
+    # meta tags alone are already captured in doc.opengraph)
+    if scraper.saw_rdfa:
+        from .rdfa import extract_triples
+        doc.rdf_triples = extract_triples(html, url)
     return [doc]

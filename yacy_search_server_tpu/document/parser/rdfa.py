@@ -1,0 +1,176 @@
+"""RDFa extraction — structured triples out of annotated HTML.
+
+Capability equivalent of the reference's rdfaParser family (reference:
+source/net/yacy/document/parser/rdfa/ — an RDFa-1.0 transformer feeding
+the cora/lod triple store). Implements the RDFa-Lite subset that real
+pages carry: ``vocab``/``prefix`` term resolution, ``about``/``resource``
+subject chaining, ``typeof`` rdf:type triples, and ``property`` values
+from ``content``/``href``/``src`` attributes or the element's text.
+
+``extract_triples(html, base_url)`` returns (subject, predicate, object)
+string triples ready for the TripleStore (document/vocabulary.py).
+"""
+
+from __future__ import annotations
+
+import re
+from html.parser import HTMLParser
+from urllib.parse import urljoin
+
+RDF_TYPE = "http://www.w3.org/1999/02/22-rdf-syntax-ns#type"
+
+# common prefixes pages rely on without declaring (RDFa initial context)
+DEFAULT_PREFIXES = {
+    "dc": "http://purl.org/dc/terms/",
+    "foaf": "http://xmlns.com/foaf/0.1/",
+    "og": "http://ogp.me/ns#",
+    "schema": "http://schema.org/",
+    "sioc": "http://rdfs.org/sioc/ns#",
+    "skos": "http://www.w3.org/2004/02/skos/core#",
+}
+
+_WS_RE = re.compile(r"\s+")
+
+# void elements never get an end tag: their triples come from attributes
+# only, and they must not occupy the frame stack
+_VOID = {"meta", "link", "br", "img", "input", "hr", "area", "base",
+         "col", "embed", "source", "track", "wbr", "param"}
+# elements whose close is routinely implied by a sibling (HTML5 rules)
+_IMPLIED_SIBLING = {"p": ("p",), "li": ("li",),
+                    "dt": ("dt", "dd"), "dd": ("dt", "dd"),
+                    "tr": ("tr",), "td": ("td", "th"), "th": ("td", "th"),
+                    "option": ("option",)}
+# block-level start tags close an open <p> (HTML5 §8.1.2.4)
+_P_CLOSERS = {"p", "ul", "ol", "dl", "div", "table", "section", "article",
+              "aside", "header", "footer", "blockquote", "pre", "form",
+              "nav", "figure", "h1", "h2", "h3", "h4", "h5", "h6"}
+
+
+class _RdfaScraper(HTMLParser):
+    def __init__(self, base_url: str):
+        super().__init__(convert_charrefs=True)
+        self.base = base_url
+        self.triples: list[tuple[str, str, str]] = []
+        self.prefixes = dict(DEFAULT_PREFIXES)
+        self.vocab = ""
+        # (tag, subject, pending-property-or-None, text-parts)
+        self._stack: list[list] = []
+
+    # -- term resolution -----------------------------------------------------
+
+    def _resolve(self, term: str) -> str:
+        term = term.strip()
+        if not term:
+            return ""
+        if term.startswith(("http://", "https://")):
+            return term
+        if ":" in term:
+            prefix, _, local = term.partition(":")
+            ns = self.prefixes.get(prefix.lower())
+            return ns + local if ns else term
+        return (self.vocab + term) if self.vocab else term
+
+    def _subject(self) -> str:
+        for frame in reversed(self._stack):
+            if frame[1]:
+                return frame[1]
+        return self.base
+
+    # -- tag handling --------------------------------------------------------
+
+    def handle_starttag(self, tag, attrs):
+        a = {k: (v if v is not None else "") for k, v in attrs}
+        # implied sibling closes (html.parser emits no implied end tags:
+        # an unpopped frame would swallow pending triples and leak its
+        # subject over the rest of the page)
+        closes = _IMPLIED_SIBLING.get(tag)
+        if closes and self._stack and self._stack[-1][0] in closes:
+            self._commit(self._stack.pop())
+        if tag in _P_CLOSERS:
+            while self._stack and self._stack[-1][0] == "p":
+                self._commit(self._stack.pop())
+        if a.get("prefix"):
+            tokens = a["prefix"].split()
+            for i in range(0, len(tokens) - 1, 2):
+                self.prefixes[tokens[i].rstrip(":").lower()] = tokens[i + 1]
+        if "vocab" in a:
+            self.vocab = a["vocab"].strip()
+
+        subject = ""
+        if a.get("about"):
+            subject = urljoin(self.base, a["about"])
+        elif a.get("resource") and not a.get("property"):
+            subject = urljoin(self.base, a["resource"])
+        elif a.get("typeof") and not a.get("property"):
+            # typeof without about mints a subject from the element
+            subject = self.base + f"#_auto{len(self.triples)}"
+
+        if a.get("typeof"):
+            for t in a["typeof"].split():
+                resolved = self._resolve(t)
+                if resolved:
+                    self.triples.append(
+                        (subject or self._subject(), RDF_TYPE, resolved))
+
+        pending = None
+        if a.get("property"):
+            props = [self._resolve(p) for p in a["property"].split()]
+            props = [p for p in props if p]
+            subj = subject or self._subject()
+            # object from content/href/src wins; else the element text
+            obj = a.get("content")
+            if obj is None and a.get("href"):
+                obj = urljoin(self.base, a["href"])
+            if obj is None and a.get("resource"):
+                obj = urljoin(self.base, a["resource"])
+            if obj is None and a.get("src"):
+                obj = urljoin(self.base, a["src"])
+            if obj is not None:
+                for p in props:
+                    self.triples.append((subj, p, obj))
+            else:
+                pending = (subj, props)
+        if tag not in _VOID:
+            self._stack.append([tag, subject, pending, []])
+
+    def _commit(self, frame) -> None:
+        _tag, _subj, pending, parts = frame
+        if pending:
+            text = _WS_RE.sub(" ", "".join(parts)).strip()
+            if text:
+                subj, props = pending
+                for p in props:
+                    self.triples.append((subj, p, text[:2048]))
+
+    def handle_endtag(self, tag):
+        for i in range(len(self._stack) - 1, -1, -1):
+            if self._stack[i][0] == tag:
+                # frames above the match were implicitly closed
+                while len(self._stack) > i:
+                    self._commit(self._stack.pop())
+                break
+
+    def flush(self) -> None:
+        """End of document: commit whatever never saw an end tag."""
+        while self._stack:
+            self._commit(self._stack.pop())
+
+    def handle_data(self, data):
+        for frame in self._stack:
+            if frame[2]:
+                frame[3].append(data)
+
+
+def extract_triples(html: str | bytes,
+                    base_url: str) -> list[tuple[str, str, str]]:
+    if isinstance(html, bytes):
+        html = html.decode("utf-8", "replace")
+    scraper = _RdfaScraper(base_url)
+    try:
+        scraper.feed(html)
+        scraper.close()
+    except Exception:
+        pass                    # salvage what was collected
+    scraper.flush()
+    # dedup, preserving order
+    return list(dict.fromkeys(scraper.triples))

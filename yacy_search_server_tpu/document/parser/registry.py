@@ -22,6 +22,7 @@ from urllib.parse import urlsplit
 
 from ..document import Document
 from .htmlparser import parse_html
+from .swfparser import parse_swf
 from .pdfparser import parse_pdf
 from .mediaparsers import parse_audio, parse_image, parse_torrent
 from .officeparsers import parse_epub, parse_odf, parse_ooxml, parse_rtf
@@ -47,6 +48,7 @@ def _ext(url: str) -> str:
 # mime -> parser
 _MIME_PARSERS = {
     "text/html": parse_html,
+    "application/x-shockwave-flash": parse_swf,
     "application/xhtml+xml": parse_html,
     "text/plain": parse_text,
     "text/csv": parse_csv,
@@ -93,6 +95,7 @@ _MIME_PARSERS = {
 
 _EXT_PARSERS = {
     "html": parse_html, "htm": parse_html, "xhtml": parse_html,
+    "swf": parse_swf,
     "txt": parse_text, "md": parse_text, "rst": parse_text,
     "csv": parse_csv, "json": parse_json, "vcf": parse_vcf,
     "pdf": parse_pdf, "xml": parse_generic_xml,

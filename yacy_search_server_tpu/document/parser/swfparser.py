@@ -1,0 +1,220 @@
+"""SWF (Flash) parser — text and link extraction from the tag stream.
+
+Capability equivalent of the reference's swfParser (reference:
+source/net/yacy/document/parser/swfParser.java, which delegates to
+javaswf's SWF2HTML). Built from the SWF file format spec instead:
+
+- header: ``FWS`` (uncompressed), ``CWS`` (zlib, SWF>=6) or ``ZWS``
+  (LZMA, SWF>=13) + version byte + uncompressed length
+- a RECT (variable-width bit field) + frame rate/count, then TAGS:
+  16-bit code<<6|length headers (length 0x3F = extended 32-bit)
+- text sources: DefineEditText (tag 37) carries its initial text
+  inline; the ActionScript ConstantPool (action 0x88) and GetURL
+  (action 0x83) inside DoAction/DoInitAction/PlaceObject2 clips carry
+  string constants and target URLs.
+
+Glyph-indexed DefineText spans are intentionally out of scope (they
+need font cmap reconstruction); DefineEditText + constant pools cover
+the text Flash sites actually carried.
+"""
+
+from __future__ import annotations
+
+import lzma
+import struct
+import zlib
+
+from ..document import Document
+from .errors import ParserError
+
+MAX_DECOMPRESSED = 1 << 26      # 64 MB — crawled archives are untrusted
+
+TAG_DO_ACTION = 12
+TAG_DEFINE_EDIT_TEXT = 37
+TAG_DO_INIT_ACTION = 59
+
+ACTION_GETURL = 0x83
+ACTION_CONSTANT_POOL = 0x88
+
+
+def _decompress(data: bytes) -> bytes:
+    sig = data[:3]
+    if sig == b"FWS":
+        return data[8:]
+    if sig == b"CWS":
+        try:
+            out = zlib.decompressobj().decompress(data[8:],
+                                                  MAX_DECOMPRESSED + 1)
+        except zlib.error as e:
+            raise ParserError(f"swf: bad zlib body: {e}")
+    elif sig == b"ZWS":
+        # ZWS carries a 4-byte compressed-size field, then a raw LZMA
+        # stream with a 5-byte props header
+        if len(data) < 18:
+            raise ParserError("swf: truncated ZWS header")
+        body = data[17:]
+        props = data[12:17]
+        lc = props[0] % 9
+        rem = props[0] // 9
+        lp, pb = rem % 5, rem // 5
+        dict_size = struct.unpack("<I", props[1:5])[0]
+        try:
+            dec = lzma.LZMADecompressor(
+                format=lzma.FORMAT_RAW,
+                filters=[{"id": lzma.FILTER_LZMA1, "lc": lc, "lp": lp,
+                          "pb": pb, "dict_size": max(dict_size, 4096)}])
+            out = dec.decompress(body, MAX_DECOMPRESSED + 1)
+        except lzma.LZMAError as e:
+            raise ParserError(f"swf: bad lzma body: {e}")
+    else:
+        raise ParserError("not a swf file")
+    if len(out) > MAX_DECOMPRESSED:
+        raise ParserError("swf: decompressed body exceeds limit")
+    return out
+
+
+def _skip_rect(body: bytes, off: int) -> int:
+    if off >= len(body):
+        return off
+    nbits = body[off] >> 3
+    total_bits = 5 + 4 * nbits
+    return off + (total_bits + 7) // 8
+
+
+def _iter_tags(body: bytes, off: int):
+    n = len(body)
+    while off + 2 <= n:
+        code_len = struct.unpack_from("<H", body, off)[0]
+        off += 2
+        code = code_len >> 6
+        length = code_len & 0x3F
+        if length == 0x3F:
+            if off + 4 > n:
+                return
+            length = struct.unpack_from("<I", body, off)[0]
+            off += 4
+        if length > n - off:
+            length = n - off
+        yield code, body[off:off + length]
+        off += length
+        if code == 0:           # End tag
+            return
+
+
+def _cstring(buf: bytes, off: int) -> tuple[str, int]:
+    end = buf.find(b"\0", off)
+    if end < 0:
+        end = len(buf)
+    return buf[off:end].decode("utf-8", "replace"), end + 1
+
+
+def _edit_text(payload: bytes) -> str:
+    """DefineEditText: flags select which optional fields precede the
+    variable name and the optional InitialText."""
+    off = 2                     # CharacterID
+    off = _skip_rect(payload, off)
+    if off + 2 > len(payload):
+        return ""
+    # the two flag bytes are a BIT STREAM, MSB-first per byte (not a
+    # little-endian word): byte0 = HasText|WordWrap|Multiline|Password|
+    # ReadOnly|HasTextColor|HasMaxLength|HasFont, byte1 = HasFontClass|
+    # AutoSize|HasLayout|NoSelect|Border|WasStatic|HTML|UseOutlines
+    b0, b1 = payload[off], payload[off + 1]
+    off += 2
+    has_text = b0 & 0x80
+    has_font = b0 & 0x01
+    has_max_length = b0 & 0x02
+    has_text_color = b0 & 0x04
+    has_font_class = b1 & 0x80
+    has_layout = b1 & 0x20
+    if has_font:
+        off += 2                # FontID
+    if has_font_class:
+        _, off = _cstring(payload, off)
+    if has_font:
+        off += 2                # FontHeight
+    if has_text_color:
+        off += 4                # RGBA
+    if has_max_length:
+        off += 2
+    if has_layout:
+        off += 9                # align + margins + indent + leading
+    _, off = _cstring(payload, off)     # VariableName
+    if has_text and off <= len(payload):
+        text, _ = _cstring(payload, off)
+        return text
+    return ""
+
+
+def _actions(payload: bytes) -> tuple[list[str], list[str]]:
+    """(strings, urls) from an action block (ConstantPool + GetURL)."""
+    strings: list[str] = []
+    urls: list[str] = []
+    off = 0
+    n = len(payload)
+    while off < n:
+        code = payload[off]
+        off += 1
+        if code == 0:
+            break
+        length = 0
+        if code >= 0x80:
+            if off + 2 > n:
+                break
+            length = struct.unpack_from("<H", payload, off)[0]
+            off += 2
+        data = payload[off:off + length]
+        off += length
+        if code == ACTION_CONSTANT_POOL and len(data) >= 2:
+            count = struct.unpack_from("<H", data, 0)[0]
+            p = 2
+            for _ in range(count):
+                if p >= len(data):
+                    break
+                s, p = _cstring(data, p)
+                if s:
+                    strings.append(s)
+        elif code == ACTION_GETURL:
+            url, p = _cstring(data, 0)
+            if url and not url.lower().startswith("fscommand:"):
+                urls.append(url)
+    return strings, urls
+
+
+def parse_swf(url: str, content: bytes,
+              charset: str | None = None) -> list[Document]:
+    body = _decompress(content)
+    off = _skip_rect(body, 0)
+    off += 4                    # frame rate (fixed8.8) + frame count
+    texts: list[str] = []
+    links: list[str] = []
+    for code, payload in _iter_tags(body, off):
+        try:
+            if code == TAG_DEFINE_EDIT_TEXT:
+                t = _edit_text(payload)
+                if t:
+                    texts.append(t)
+            elif code == TAG_DO_ACTION:
+                strings, urls = _actions(payload)
+                texts.extend(s for s in strings
+                             if not s.startswith(("http://", "https://")))
+                links.extend(s for s in strings
+                             if s.startswith(("http://", "https://")))
+                links.extend(urls)
+            elif code == TAG_DO_INIT_ACTION and len(payload) > 2:
+                strings, urls = _actions(payload[2:])
+                texts.extend(s for s in strings
+                             if not s.startswith(("http://", "https://")))
+                links.extend(s for s in strings
+                             if s.startswith(("http://", "https://")))
+                links.extend(urls)
+        except (struct.error, IndexError):
+            continue            # salvage the rest of the tag stream
+    from ..document import Anchor
+    doc = Document(
+        url=url, mime_type="application/x-shockwave-flash",
+        title=url.rsplit("/", 1)[-1],
+        text="\n".join(texts),
+        anchors=[Anchor(u) for u in dict.fromkeys(links)
+                 if u.startswith(("http://", "https://"))])
+    return [doc]

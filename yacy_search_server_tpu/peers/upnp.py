@@ -1,0 +1,210 @@
+"""UPnP IGD port mapping — real SSDP discovery + SOAP control.
+
+The reference maps its ports on the router via the weupnp library
+(reference: source/net/yacy/utils/upnp/UPnP.java — discovery of an
+InternetGatewayDevice and AddPortMapping/DeletePortMapping on startup
+and port change). This is the same protocol implemented directly:
+
+1. **SSDP discovery**: UDP M-SEARCH to 239.255.255.250:1900 for
+   ``urn:schemas-upnp-org:device:InternetGatewayDevice:1``; responses
+   carry a LOCATION header pointing at the device description.
+2. **Device description**: fetch the XML, walk its service list for a
+   WANIPConnection/WANPPPConnection service and take its controlURL.
+3. **SOAP control**: POST AddPortMapping / DeletePortMapping /
+   GetExternalIPAddress envelopes to the controlURL.
+
+Both IO edges are injectable (`socket_factory`, `http`) so the protocol
+logic is testable against a simulated gateway in this zero-egress image;
+the defaults do real network IO when deployed.
+"""
+
+from __future__ import annotations
+
+import re
+import socket as _socketlib
+from urllib.parse import urljoin, urlsplit
+
+SSDP_ADDR = "239.255.255.250"
+SSDP_PORT = 1900
+IGD_SEARCH_TARGETS = (
+    "urn:schemas-upnp-org:device:InternetGatewayDevice:1",
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+)
+WAN_SERVICE_TYPES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+_LOCATION_RE = re.compile(r"^location:\s*(\S+)\s*$",
+                          re.IGNORECASE | re.MULTILINE)
+
+
+class Gateway:
+    """One discovered IGD: where to send SOAP control requests."""
+
+    __slots__ = ("location", "control_url", "service_type")
+
+    def __init__(self, location: str, control_url: str, service_type: str):
+        self.location = location
+        self.control_url = control_url
+        self.service_type = service_type
+
+
+def _default_http(url: str, data: bytes | None = None,
+                  headers: dict | None = None, timeout: float = 5.0) -> bytes:
+    import urllib.request
+    req = urllib.request.Request(url, data=data, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout) as r:  # nosec - LAN
+        return r.read()
+
+
+class SSDPDriver:
+    """The UPnP.java/weupnp flow as a driver for peers.operation.UPnP.
+
+    `socket_factory()` must return a UDP socket object supporting
+    sendto/recvfrom/settimeout/close; `http(url, data, headers)` returns
+    response bytes. Tests inject both; production uses the defaults."""
+
+    def __init__(self, socket_factory=None, http=None,
+                 timeout_s: float = 3.0):
+        self._socket_factory = socket_factory or self._real_socket
+        self.http = http or _default_http
+        self.timeout_s = timeout_s
+        self._gateway: Gateway | None = None
+
+    @staticmethod
+    def _real_socket():
+        s = _socketlib.socket(_socketlib.AF_INET, _socketlib.SOCK_DGRAM)
+        s.setsockopt(_socketlib.IPPROTO_IP, _socketlib.IP_MULTICAST_TTL, 2)
+        return s
+
+    # -- step 1: SSDP M-SEARCH ----------------------------------------------
+
+    def _msearch(self) -> list[str]:
+        """Collect LOCATION urls from M-SEARCH responses."""
+        locations: list[str] = []
+        sock = self._socket_factory()
+        try:
+            sock.settimeout(self.timeout_s)
+            for st in IGD_SEARCH_TARGETS:
+                msg = ("M-SEARCH * HTTP/1.1\r\n"
+                       f"HOST: {SSDP_ADDR}:{SSDP_PORT}\r\n"
+                       'MAN: "ssdp:discover"\r\n'
+                       "MX: 2\r\n"
+                       f"ST: {st}\r\n\r\n").encode("ascii")
+                sock.sendto(msg, (SSDP_ADDR, SSDP_PORT))
+            while True:
+                try:
+                    data, _addr = sock.recvfrom(2048)
+                except (TimeoutError, OSError):
+                    break
+                m = _LOCATION_RE.search(data.decode("utf-8", "replace"))
+                if m and m.group(1) not in locations:
+                    locations.append(m.group(1))
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return locations
+
+    # -- step 2: device description -----------------------------------------
+
+    def _parse_description(self, location: str) -> Gateway | None:
+        try:
+            xml = self.http(location).decode("utf-8", "replace")
+        except Exception:
+            return None
+        # walk <service> blocks for a WAN*Connection control URL
+        for svc in re.finditer(r"<service>(.*?)</service>", xml, re.S):
+            block = svc.group(1)
+            st = _tag(block, "serviceType")
+            if st not in WAN_SERVICE_TYPES:
+                continue
+            control = _tag(block, "controlURL")
+            if not control:
+                continue
+            base = _tag(xml, "URLBase") or location
+            return Gateway(location, urljoin(base, control), st)
+        return None
+
+    # -- driver protocol (peers.operation.UPnP) ------------------------------
+
+    def discover(self) -> Gateway | None:
+        if self._gateway is not None:
+            return self._gateway
+        for location in self._msearch():
+            gw = self._parse_description(location)
+            if gw is not None:
+                self._gateway = gw
+                return gw
+        return None
+
+    def _soap(self, gw: Gateway, action: str, args: dict[str, str]) -> str:
+        arg_xml = "".join(f"<{k}>{v}</{k}>" for k, v in args.items())
+        envelope = (
+            '<?xml version="1.0"?>'
+            '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/"'
+            ' s:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+            f'<s:Body><u:{action} xmlns:u="{gw.service_type}">{arg_xml}'
+            f"</u:{action}></s:Body></s:Envelope>").encode("utf-8")
+        headers = {
+            "Content-Type": 'text/xml; charset="utf-8"',
+            "SOAPAction": f'"{gw.service_type}#{action}"',
+        }
+        return self.http(gw.control_url, envelope,
+                         headers).decode("utf-8", "replace")
+
+    def _local_ip(self, gw: Gateway) -> str:
+        """The LAN address the router should forward to: the local end
+        of a UDP 'connection' toward the gateway."""
+        host = urlsplit(gw.location).hostname or "192.168.0.1"
+        s = _socketlib.socket(_socketlib.AF_INET, _socketlib.SOCK_DGRAM)
+        try:
+            s.connect((host, 1900))
+            return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+        finally:
+            s.close()
+
+    def add_port_mapping(self, gw: Gateway, port: int, proto: str,
+                         desc: str) -> bool:
+        try:
+            resp = self._soap(gw, "AddPortMapping", {
+                "NewRemoteHost": "",
+                "NewExternalPort": str(port),
+                "NewProtocol": proto,
+                "NewInternalPort": str(port),
+                "NewInternalClient": self._local_ip(gw),
+                "NewEnabled": "1",
+                "NewPortMappingDescription": desc,
+                "NewLeaseDuration": "0",
+            })
+        except Exception:
+            return False
+        return "AddPortMappingResponse" in resp and "Fault" not in resp
+
+    def delete_port_mapping(self, gw: Gateway, port: int,
+                            proto: str) -> bool:
+        try:
+            resp = self._soap(gw, "DeletePortMapping", {
+                "NewRemoteHost": "",
+                "NewExternalPort": str(port),
+                "NewProtocol": proto,
+            })
+        except Exception:
+            return False
+        return "DeletePortMappingResponse" in resp and "Fault" not in resp
+
+    def external_ip(self, gw: Gateway) -> str | None:
+        try:
+            resp = self._soap(gw, "GetExternalIPAddress", {})
+        except Exception:
+            return None
+        return _tag(resp, "NewExternalIPAddress") or None
+
+
+def _tag(xml: str, name: str) -> str:
+    m = re.search(rf"<{name}>\s*(.*?)\s*</{name}>", xml, re.S)
+    return m.group(1) if m else ""

@@ -387,6 +387,10 @@ class Switchboard:
                     responsetime_ms=int(
                         entry.response.fetch_time_s * 1000),
                     httpstatus=entry.response.status)
+                # RDFa annotations land in the lod triple store
+                # (reference: parser/rdfa -> cora/lod)
+                for s_, p_, o_ in getattr(doc, "rdf_triples", []):
+                    self.triplestore.add(s_, p_, o_)
                 self.indexed_count += 1
             return None
 

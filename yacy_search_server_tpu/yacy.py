@@ -89,6 +89,21 @@ def startup(data_dir: str, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
     port = config.get_int("port", port)
     peer_name = peer_name or config.get("peerName", f"peer-{os.getpid()}")
 
+    def _upnp_map(sb_like) -> None:
+        # best-effort router port mapping on startup (reference:
+        # UPnP.addPortMappings on startup/port change, utils/upnp/
+        # UPnP.java) — real SSDP/SOAP, config-gated, never fatal
+        if not config.get_bool("upnp.enabled", False):
+            return
+        try:
+            from .peers.operation import UPnP
+            from .peers.upnp import SSDPDriver
+            upnp = UPnP(driver=SSDPDriver())
+            if upnp.add_port_mapping(port):
+                sb_like.upnp = upnp
+        except Exception:
+            pass
+
     if p2p:
         from .peers.node import P2PNode
         from .peers.transport import HttpTransport
@@ -97,12 +112,14 @@ def startup(data_dir: str, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
         node.sb.config = config
         http = node.serve_http(host=host, port=port)
         node.deploy_threads()
+        _upnp_map(node.sb)
         return node, http, lock
     from .server.httpd import YaCyHttpServer
     from .switchboard import Switchboard
     sb = Switchboard(data_dir=data_dir, config=config)
     http = YaCyHttpServer(sb, port=port, host=host).start()
     sb.deploy_threads()
+    _upnp_map(sb)
     return sb, http, lock
 
 
@@ -171,6 +188,12 @@ def main(argv: list[str] | None = None) -> int:
         wait_for_shutdown(sb)
     finally:
         print("shutting down ...")
+        upnp = getattr(sb, "upnp", None)
+        if upnp is not None:          # release router mappings (UPnP.java)
+            try:
+                upnp.delete_port_mappings()
+            except Exception:
+                pass
         node.close()
         http.close()
         release_lock(lock)
