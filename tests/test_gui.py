@@ -1,0 +1,66 @@
+"""Tray/GUI (reference gui/Tray.java + the -gui verb): headless-safe
+control surface — display probing, browser popup, shutdown wiring."""
+
+import threading
+
+from yacy_search_server_tpu import gui
+
+
+def test_headless_probe(monkeypatch):
+    monkeypatch.delenv("DISPLAY", raising=False)
+    monkeypatch.delenv("WAYLAND_DISPLAY", raising=False)
+    assert gui.display_available() is False
+    # run() is a safe no-op headless
+    gui.Tray("http://127.0.0.1:1", lambda: None).run()
+
+
+def test_open_browser_uses_opener():
+    opened = []
+    assert gui.open_browser("http://127.0.0.1:8090/",
+                            opener=lambda u: opened.append(u) or True)
+    assert opened == ["http://127.0.0.1:8090/"]
+
+
+def test_run_gui_headless_pops_browser(monkeypatch):
+    monkeypatch.delenv("DISPLAY", raising=False)
+    monkeypatch.delenv("WAYLAND_DISPLAY", raising=False)
+    opened = []
+    monkeypatch.setattr(gui, "open_browser",
+                        lambda url, opener=None: opened.append(url))
+    ev = threading.Event()
+    gui.run_gui("http://127.0.0.1:8090", ev)   # returns immediately
+    assert opened == ["http://127.0.0.1:8090"]
+    assert not ev.is_set()
+
+
+def test_verb_peeling_covers_gui():
+    import yacy_search_server_tpu.yacy as y
+    assert y.peel_verb(["-gui", "--port", "1"]) == ("-gui", ["--port", "1"])
+    assert y.peel_verb(["gui"]) == ("-gui", [])
+    assert y.peel_verb(["-shutdown"]) == ("-shutdown", [])
+    assert y.peel_verb(["--port", "1"]) == ("-start", ["--port", "1"])
+    assert y.main(["-version"]) == 0
+
+
+def test_gui_shutdown_event_closes_tray(monkeypatch):
+    """A remote shutdown must close the tray window (review fix)."""
+    import yacy_search_server_tpu.gui as g
+    closed = []
+
+    class FakeTray:
+        def __init__(self, *a, **k):
+            pass
+
+        def run(self):
+            ev.wait(5)          # blocked "mainloop"
+
+        def close(self):
+            closed.append(True)
+    monkeypatch.setattr(g, "Tray", FakeTray)
+    monkeypatch.setattr(g, "open_browser", lambda *a, **k: True)
+    ev = threading.Event()
+    t = threading.Thread(target=g.run_gui, args=("http://x", ev))
+    t.start()
+    ev.set()
+    t.join(timeout=10)
+    assert not t.is_alive() and closed

@@ -378,3 +378,10 @@ def test_new_operator_servlets(node):
     finally:
         sb.start_crawl("http://sw.test/", depth=1)
         sb.crawl_until_idle(timeout_s=30)
+
+
+def test_devicestore_dashboard(node):
+    sb, srv = node
+    st, body = _get_html(srv, "/DeviceStore_p.html")
+    assert st == 200
+    assert ("queries_served" in body) or ("host path serves" in body)

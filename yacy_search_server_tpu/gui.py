@@ -1,0 +1,99 @@
+"""Desktop GUI — tray-style control window for the running node.
+
+Capability equivalent of the reference's tray/GUI (reference:
+source/net/yacy/gui/Tray.java + gui/YaCyApp.java — an AWT system-tray
+icon whose menu opens the search page in the browser and triggers
+shutdown; the `-gui` verb starts the node with it). Implemented over
+tkinter when a display is available; on headless hosts (every server
+deployment, and this build image) it degrades to opening the browser /
+doing nothing — the reference's tray is equally inert headless.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import webbrowser
+
+
+def display_available() -> bool:
+    """A GUI can only appear with a display server and tkinter."""
+    if not (os.environ.get("DISPLAY") or os.environ.get("WAYLAND_DISPLAY")
+            or os.name == "nt"):
+        return False
+    try:
+        import tkinter  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+def open_browser(url: str, opener=None) -> bool:
+    """Open the node's search page (Tray menu 'Search' / startup
+    browser-popup behavior)."""
+    try:
+        return (opener or webbrowser.open)(url)
+    except Exception:
+        return False
+
+
+class Tray:
+    """Control window: status line + Open-Search + Shutdown buttons
+    (the tray menu's actions; tkinter has no portable tray API, so this
+    is a small always-on-top window like YaCyApp's console)."""
+
+    def __init__(self, base_url: str, on_shutdown, peer_name: str = ""):
+        self.base_url = base_url
+        self.on_shutdown = on_shutdown
+        self.peer_name = peer_name
+        self._root = None
+
+    def run(self) -> None:
+        """Blocking mainloop; returns when the window closes or
+        shutdown is picked. No-op without a display."""
+        if not display_available():
+            return
+        import tkinter as tk
+        root = tk.Tk()
+        self._root = root
+        root.title(f"YaCy-TPU {self.peer_name}".strip())
+        root.attributes("-topmost", True)
+        tk.Label(root, text=f"serving on {self.base_url}").pack(
+            padx=12, pady=6)
+        tk.Button(root, text="Open search page",
+                  command=lambda: open_browser(self.base_url)).pack(
+            fill="x", padx=12, pady=2)
+
+        def _shutdown():
+            try:
+                self.on_shutdown()
+            finally:
+                root.destroy()
+        tk.Button(root, text="Shutdown node", command=_shutdown).pack(
+            fill="x", padx=12, pady=(2, 10))
+        root.protocol("WM_DELETE_WINDOW", root.destroy)
+        root.mainloop()
+        self._root = None
+
+    def close(self) -> None:
+        root = self._root
+        if root is not None:
+            try:
+                root.after(0, root.destroy)
+            except Exception:
+                pass
+
+
+def run_gui(base_url: str, shutdown_event: threading.Event,
+            peer_name: str = "") -> None:
+    """The -gui verb body: browser popup + control window; falls back to
+    just the browser popup on headless boxes. A REMOTE shutdown
+    (Steering servlet / -shutdown verb) must also close the window, or
+    the blocked mainloop would keep the node's port and DATA lock."""
+    open_browser(base_url)
+    tray = Tray(base_url, shutdown_event.set, peer_name)
+    watcher = threading.Thread(
+        target=lambda: (shutdown_event.wait(), tray.close()),
+        name="gui-shutdown-watch", daemon=True)
+    watcher.start()
+    tray.run()

@@ -582,3 +582,51 @@ def yacy_interactive(header, post, sb):
                       "YaCy TPU P2P Web Search")))
     prop.put("former", escape_html(post.get("query", "")))
     return prop
+
+
+@servlet("DeviceStore_p")
+def device_store(header, post, sb):
+    """The serving-store dashboard: arena occupancy, prune/batch/join
+    coverage, mesh layout (observability for the device path — the
+    reference's PerformanceMemory table-tracker idea applied to the
+    TPU arena)."""
+    prop = ServerObjects()
+    ds = sb.index.devstore
+    if ds is None:
+        prop.put("enabled", 0)
+        prop.put("kind", "none")
+        prop.put("rows", 0)
+        return prop
+    prop.put("enabled", 1)
+    kind = type(ds).__name__
+    prop.put("kind", kind)
+    rows: list[tuple[str, object]] = [
+        ("queries_served", getattr(ds, "queries_served", 0)),
+        ("fallbacks", getattr(ds, "fallbacks", 0)),
+        ("join_served", getattr(ds, "join_served", 0)),
+        ("join_fallbacks", getattr(ds, "join_fallbacks", 0)),
+    ]
+    if kind == "DeviceSegmentStore":
+        rows += [
+            ("arena_rows_used", ds.arena.used_rows),
+            ("arena_rows_capacity", ds.arena.capacity_rows),
+            ("arena_bytes", ds.arena.bytes_used()),
+            ("live_rows", ds.live_rows()),
+            ("prune_rounds", ds.prune_rounds),
+            ("pruned_tiles", ds.pruned_tiles),
+            ("batching", 1 if ds._batcher is not None else 0),
+        ]
+    elif kind == "MeshSegmentStore":
+        rows += [
+            ("mesh_term_axis", ds.n_term),
+            ("mesh_doc_axis", ds.n_doc),
+            ("mesh_cells", ds.n_cells),
+            ("live_rows", ds.live_rows()),
+            ("cell_rows_max", max((c.used for c in ds._cells),
+                                  default=0)),
+        ]
+    prop.put("rows", len(rows))
+    for i, (name, v) in enumerate(rows):
+        prop.put(f"rows_{i}_key", name)
+        prop.put(f"rows_{i}_value", v)
+    return prop

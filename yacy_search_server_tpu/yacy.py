@@ -156,13 +156,18 @@ def shutdown_running(port: int = DEFAULT_PORT,
         return False
 
 
+def peel_verb(argv: list[str]) -> tuple[str, list[str]]:
+    """The reference's verbs are dash-prefixed (-start/-gui/-shutdown/
+    -version), which argparse would read as options — peel first."""
+    if argv and argv[0].lstrip("-") in ("start", "gui", "shutdown",
+                                        "version"):
+        return "-" + argv[0].lstrip("-"), argv[1:]
+    return "-start", argv
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    # the reference's verbs are dash-prefixed (-start/-shutdown/-version),
-    # which argparse would read as options — peel the verb off first
-    verb = "-start"
-    if argv and argv[0].lstrip("-") in ("start", "shutdown", "version"):
-        verb = "-" + argv.pop(0).lstrip("-")
+    verb, argv = peel_verb(argv)
     ap = argparse.ArgumentParser(prog="yacy-tpu", add_help=True)
     ap.add_argument("--data", default="DATA")
     ap.add_argument("--host", default="127.0.0.1")
@@ -185,6 +190,13 @@ def main(argv: list[str] | None = None) -> int:
     sb = getattr(node, "sb", node)
     print(f"serving on {http.base_url} (data: {args.data})")
     try:
+        if args.verb == "-gui":
+            # reference -gui: tray + browser popup beside the server
+            # (gui/Tray.java); headless boxes degrade to the popup only
+            from .gui import run_gui
+            seed = getattr(node, "seed", None)
+            run_gui(http.base_url, sb.shutdown_event,
+                    peer_name=getattr(seed, "name", ""))
         wait_for_shutdown(sb)
     finally:
         print("shutting down ...")
