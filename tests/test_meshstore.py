@@ -230,3 +230,40 @@ def test_switchboard_serves_through_mesh():
         assert ms.fallbacks == 0
     finally:
         sb.close()
+
+
+def test_mesh_pruning_engages_and_stays_exact():
+    """The per-cell block-max path must actually skip tail tiles on a
+    big term AND return exactly the streaming scan's results; a
+    tombstone newer than the pack disables it (frozen-stats contract,
+    like the single-chip store)."""
+    rng = np.random.default_rng(31)
+    th = word2hash("pruneterm")
+    n = 400_000          # ~50k rows/cell -> 2 tiles per cell
+    rwi = RWIIndex()
+    rwi.ingest_run({th: PostingsList(np.arange(n, dtype=np.int32),
+                                     _mkfeats(rng, n))})
+    ms = MeshSegmentStore(rwi, devices=_devices(), n_term=1)
+    try:
+        prof = RankingProfile()
+        s1, d1, _ = ms.rank_term(th, prof, k=20)
+        assert ms.prune_rounds >= 1
+        assert ms.pruned_tiles > 0, "no tail tiles were skipped"
+        # exactness: the full streaming scan agrees bit-for-bit
+        sp = ms.spans_for(th)[0]
+        sp_t, sp.tcounts = sp.tcounts, np.zeros_like(sp.tcounts)
+        try:
+            s2, d2, _ = ms.rank_term(th, prof, k=20)
+        finally:
+            sp.tcounts = sp_t
+        assert np.array_equal(s1, s2) and np.array_equal(d1, d2)
+        # a post-pack tombstone invalidates the frozen bounds: the next
+        # query must take the exact path and exclude the dead doc
+        victim = int(d1[0])
+        rwi.delete_doc(victim)
+        rounds0 = ms.prune_rounds
+        s3, d3, _ = ms.rank_term(th, prof, k=20)
+        assert ms.prune_rounds == rounds0      # pruned path declined
+        assert victim not in d3.tolist()
+    finally:
+        ms.close()

@@ -138,6 +138,24 @@ _PROXY_PROFILE = RankingProfile()          # the pack-time ordering profile
 _PROXY_SHIFTS = _signal_shift_vector(_PROXY_PROFILE)
 
 
+def pack_prune_stats(f16, fl):
+    """(frozen pack stats, proxy scores) — the prune layout's scoring
+    oracle, shared by the single-device and mesh pack paths so the
+    bound-safety subtleties live in ONE place."""
+    stats = _pack_stats_np(f16, fl)
+    proxy = _cardinal_np(f16, fl, stats, _PROXY_PROFILE,
+                         P.pack_language("en"))
+    return stats, proxy
+
+
+def pmax_table(sorted_proxy: np.ndarray) -> np.ndarray:
+    """Per-tile bound rows over a proxy-DESC-sorted span, margin folded
+    in and clamped (see _PMAX_MARGIN_EXTRA)."""
+    margin = (1 << _PROXY_PROFILE.tf) + _PMAX_MARGIN_EXTRA
+    return np.minimum(sorted_proxy[::TILE] + margin,
+                      INT32_MAX).astype(np.int32)
+
+
 def _bound_shift(prof: RankingProfile) -> int:
     """log2 of the bound factor M: score_q(row) <= proxy(row) << shift."""
     return int(np.max(_signal_shift_vector(prof) - _PROXY_SHIFTS))
@@ -1026,8 +1044,6 @@ class DeviceSegmentStore:
                 track(EClass.INDEX, "devstore_skip", rows)
                 return
             base = self.arena.used_rows
-            margin = (1 << _PROXY_PROFILE.tf) + _PMAX_MARGIN_EXTRA
-            lang_en = P.pack_language("en")
             meta: list[tuple] = []   # (th, rel_off, n, rel_toff, n_tiles,
             #                           stats, rel_joff)
             pmax_parts: list[np.ndarray] = []
@@ -1040,13 +1056,11 @@ class DeviceSegmentStore:
                 if p is None or len(p) == 0:
                     continue
                 f16, fl = compact_feats(p.feats)
-                stats = _pack_stats_np(f16, fl)
-                proxy = _cardinal_np(f16, fl, stats, _PROXY_PROFILE, lang_en)
+                stats, proxy = pack_prune_stats(f16, fl)
                 order = np.argsort(-proxy, kind="stable")
                 n = len(p)
                 n_tiles = (n + TILE - 1) // TILE
-                pmax_parts.append(np.minimum(
-                    proxy[order][::TILE] + margin, INT32_MAX).astype(np.int32))
+                pmax_parts.append(pmax_table(proxy[order]))
                 packed_dd = p.docids[order]
                 # docid-sorted view of the packed rows: the device
                 # conjunction's binary-search table (absolute arena rows)

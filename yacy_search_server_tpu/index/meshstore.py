@@ -38,11 +38,14 @@ duplication, and duplicate candidates in the gathered top-k dedup
 host-side (the existing cross-run duplicate rule of the single-chip
 store).
 
-Unlike the single-chip store there is no block-max pruning here: each
-device scans only ``count / n_devices`` rows, which is the mesh's own
-roofline win; per-cell pruning composes later without changing the
-layout. Host mirrors of each cell's buffers are kept so growth and
-repacking never read back from device.
+Block-max pruning composes with the sharding: each cell packs its slice
+proxy-sorted with a per-tile bound table against GLOBAL frozen pack
+stats, and an eligible query scores only a prefix of every device's
+tiles, verifying each device's unscored tail against its LOCAL k-th
+score — an exact local top-k per device makes the all_gather merge
+exact, and any failed bound escalates the prefix mesh-wide. Host
+mirrors of each cell's buffers are kept so growth and repacking never
+read back from device.
 """
 
 from __future__ import annotations
@@ -63,9 +66,11 @@ from ..ops.streaming import merge_stats
 from ..parallel.distribution import horizontal_dht_position
 from ..utils.eventtracker import EClass, update as track
 from . import postings as P
-from .devstore import (DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32, NO_FLAG,
-                       NO_LANG, TILE, _bucket_delta, _bucket_rows,
-                       _constraint_valid, _tile_valid)
+from .devstore import (_PRUNE_B, DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32,
+                       NO_FLAG, NO_LANG, TILE, _bound_shift,
+                       _bucket_delta, _bucket_rows, _constraint_valid,
+                       _pruned_span_topk, _tile_valid, pack_prune_stats,
+                       pmax_table)
 
 INT32_MAX = 2 ** 31 - 1
 
@@ -78,13 +83,23 @@ def term_shard(termhash: bytes, n_term: int) -> int:
 class MeshSpan:
     """One run's extents for a term across every mesh cell."""
 
-    __slots__ = ("starts", "counts", "total", "jstarts")
+    __slots__ = ("starts", "counts", "total", "jstarts",
+                 "tstarts", "tcounts", "stats", "dead_seq")
 
     def __init__(self, starts: np.ndarray, counts: np.ndarray,
-                 jstarts: np.ndarray | None = None):
+                 jstarts: np.ndarray | None = None,
+                 tstarts: np.ndarray | None = None,
+                 tcounts: np.ndarray | None = None,
+                 stats=None, dead_seq: int = -1):
         self.starts = starts          # int32 [n_cells] per-cell offsets
         self.counts = counts          # int32 [n_cells]
         self.jstarts = jstarts        # int32 [n_cells] join-table offsets
+        self.tstarts = tstarts        # int32 [n_cells] pmax offsets
+        self.tcounts = tcounts        # int32 [n_cells] pmax tile counts
+        # GLOBAL pack-time normalization stats (whole term, all cells):
+        # every device must prune/score in the same normalized space
+        self.stats = stats
+        self.dead_seq = dead_seq      # tombstone count at pack (devstore)
         self.total = int(counts.sum())
 
 
@@ -97,18 +112,22 @@ class _CellBuf:
     count (the pathology devstore's one-write-per-run pack avoids)."""
 
     __slots__ = ("_parts", "used", "_jparts", "jused",
-                 "feats16", "flags", "docids", "jdocids", "jpos")
+                 "_tparts", "tused",
+                 "feats16", "flags", "docids", "jdocids", "jpos", "pmax")
 
     def __init__(self):
         self.used = 0
         self.jused = 0
+        self.tused = 0
         self._parts: list[tuple] = []       # (f16, fl, dd) chunks
         self._jparts: list[tuple] = []      # (jdocids, jpos) chunks
+        self._tparts: list[np.ndarray] = []  # per-tile pmax chunks
         self.feats16 = np.zeros((0, P.NF), np.int16)
         self.flags = np.zeros(0, np.int32)
         self.docids = np.zeros(0, np.int32)
         self.jdocids = np.zeros(0, np.int32)
         self.jpos = np.zeros(0, np.int32)
+        self.pmax = np.zeros(0, np.int32)
 
     def append(self, f16, fl, dd) -> int:
         start = self.used
@@ -120,6 +139,12 @@ class _CellBuf:
         start = self.jused
         self._jparts.append((jd, jp))
         self.jused += len(jd)
+        return start
+
+    def append_pmax(self, pm: np.ndarray) -> int:
+        start = self.tused
+        self._tparts.append(pm)
+        self.tused += len(pm)
         return start
 
     def materialize(self) -> None:
@@ -137,6 +162,9 @@ class _CellBuf:
             self.jpos = np.concatenate(
                 [self.jpos] + [p[1] for p in self._jparts])
             self._jparts = []
+        if self._tparts:
+            self.pmax = np.concatenate([self.pmax] + self._tparts)
+            self._tparts = []
 
 
 class MeshSegmentStore:
@@ -175,7 +203,10 @@ class MeshSegmentStore:
         # device state (rebuilt lazily from the host mirrors)
         self._dev_arrays = None       # (feats16, flags, docids) sharded
         self._dev_join = None         # (jdocids, jpos) sharded
+        self._dev_pmax = None         # per-cell prune side-table
         self._dirty = True
+        self.prune_rounds = 0
+        self.pruned_tiles = 0
         self._dead_host = np.zeros(1 << 16, bool)
         self._dev_dead = None
         self._dirty_dead = True
@@ -225,11 +256,17 @@ class MeshSegmentStore:
                     continue
                 f16, fl = compact_feats(p.feats)
                 dd = p.docids.astype(np.int32)
+                # GLOBAL frozen stats + proxy scores over the WHOLE term:
+                # all cells prune/score in one normalized space, and the
+                # per-device tail bound stays a true upper bound
+                gstats, proxy = pack_prune_stats(f16, fl)
                 t = term_shard(th, self.n_term)
                 d_shard = dd % self.n_doc
                 starts = np.zeros(self.n_cells, np.int32)
                 counts = np.zeros(self.n_cells, np.int32)
                 jstarts = np.zeros(self.n_cells, np.int32)
+                tstarts = np.zeros(self.n_cells, np.int32)
+                tcounts = np.zeros(self.n_cells, np.int32)
                 for d in range(self.n_doc):
                     sel = d_shard == d
                     n = int(sel.sum())
@@ -237,15 +274,25 @@ class MeshSegmentStore:
                         continue
                     cell = self._cell_of(t, d)
                     buf = self._cells[cell]
-                    start = buf.append(f16[sel], fl[sel], dd[sel])
+                    # rows pack PROXY-SORTED (block-max prune layout)
+                    order = np.argsort(-proxy[sel], kind="stable")
+                    cell_dd = dd[sel][order]
+                    start = buf.append(f16[sel][order], fl[sel][order],
+                                       cell_dd)
+                    n_tiles = (n + TILE - 1) // TILE
+                    tstarts[cell] = buf.append_pmax(
+                        pmax_table(proxy[sel][order]))
+                    tcounts[cell] = n_tiles
                     # column-local docid-sorted view (device join table):
-                    # the j-th selected posting sits at cell row start+j
-                    order = np.argsort(dd[sel], kind="stable")
+                    # the j-th PACKED posting sits at cell row start+j
+                    jorder = np.argsort(cell_dd, kind="stable")
                     jstarts[cell] = buf.append_join(
-                        dd[sel][order].astype(np.int32),
-                        (start + order).astype(np.int32))
+                        cell_dd[jorder].astype(np.int32),
+                        (start + jorder).astype(np.int32))
                     starts[cell], counts[cell] = start, n
-                spans[th] = MeshSpan(starts, counts, jstarts)
+                spans[th] = MeshSpan(starts, counts, jstarts,
+                                     tstarts, tcounts, gstats,
+                                     getattr(run, "dead_seq", -1))
             self._packed[rid] = spans
             self._dirty = True
             track(EClass.INDEX, "meshstore_pack", rows)
@@ -345,6 +392,10 @@ class MeshSegmentStore:
         for i, c in enumerate(self._cells):
             jdocids[i, :c.jused] = c.jdocids
             jpos[i, :c.jused] = c.jpos
+        TC = max(max((c.tused for c in self._cells), default=1), 1)
+        pmax = np.full((self.n_cells, TC), INT32_MAX, np.int32)
+        for i, c in enumerate(self._cells):
+            pmax[i, :c.tused] = c.pmax
         sh3 = NamedSharding(self.mesh, PS(("term", "doc"), None, None))
         sh2 = NamedSharding(self.mesh, PS(("term", "doc"), None))
         self._dev_arrays = (jax.device_put(feats, sh3),
@@ -352,6 +403,7 @@ class MeshSegmentStore:
                             jax.device_put(docids, sh2))
         self._dev_join = (jax.device_put(jdocids, sh2),
                           jax.device_put(jpos, sh2))
+        self._dev_pmax = jax.device_put(pmax, sh2)
         self._dirty = False
 
     def _device_arrays(self):
@@ -400,6 +452,26 @@ class MeshSegmentStore:
                 out.append(sp)
             return out
 
+    def _pfn(self, kk: int, b: int):
+        key = ("pruned", kk, b)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(jax.shard_map(
+                partial(_mesh_pruned_shard, k=kk, b=b),
+                mesh=self.mesh,
+                in_specs=(PS(("term", "doc"), None, None),   # feats16
+                          PS(("term", "doc"), None),         # flags
+                          PS(("term", "doc"), None),         # docids
+                          PS(),                              # dead
+                          PS(("term", "doc"), None),         # pmax
+                          PS(("term", "doc"), None),         # qargs
+                          PS(), PS(), PS(), PS(),            # frozen stats
+                          PS(), PS(),                        # shift, lang
+                          PS(), PS(), PS(), PS(), PS(), PS(), PS(), PS()),
+                out_specs=(PS(), PS(), PS()),
+                check_vma=False,
+            ))
+        return self._fns[key]
+
     def _fn(self, kk: int, with_delta: bool):
         key = (kk, with_delta)
         if key not in self._fns:
@@ -435,6 +507,7 @@ class MeshSegmentStore:
                 return None
             arrays = self._device_arrays()
             dead = self._dead_array()
+            pmax = self._dev_pmax     # same snapshot as the arrays
         with self.rwi._lock:
             delta = self.rwi._ram_postings(termhash)
         if not spans and delta is None:
@@ -442,6 +515,43 @@ class MeshSegmentStore:
         with_delta = delta is not None and len(delta) > 0
         considered = sum(sp.total for sp in spans) + (
             len(delta) if with_delta else 0)
+        kk0 = max(16, 1 << (max(k, 1) - 1).bit_length())
+
+        # per-cell block-max PRUNED path: one merged span, no delta, no
+        # constraint filters, no tombstones newer than the pack. Each
+        # device scores a prefix of its proxy-sorted tiles and verifies
+        # its OWN tail bound against its LOCAL k-th score — exact local
+        # top-k per device makes the global merge exact; a failed bound
+        # on any device escalates the prefix for all.
+        no_filters = (lang_filter == NO_LANG and flag_bit == NO_FLAG
+                      and from_days is None and to_days is None)
+        if (no_filters and len(spans) == 1 and not with_delta
+                and spans[0].tcounts is not None
+                and spans[0].tcounts.max() > 0
+                and spans[0].dead_seq == len(self.rwi._tombstones)):
+            sp = spans[0]
+            st = sp.stats
+            consts = self._profile_consts(profile, language)
+            shift = np.int32(_bound_shift(profile))
+            lang_term = np.int32(255 << min(max(profile.language, 0), 15))
+            qargs = np.stack([sp.starts, sp.counts,
+                              sp.tstarts, sp.tcounts], axis=1
+                             ).astype(np.int32)
+            for b in _PRUNE_B:
+                out = self._pfn(kk0, b)(
+                    arrays[0], arrays[1], arrays[2], dead, pmax, qargs,
+                    st["col_min"], st["col_max"],
+                    np.float32(st["tf_min"]), np.float32(st["tf_max"]),
+                    shift, lang_term, *consts)
+                s, d, ok = jax.device_get(out)
+                self.prune_rounds += 1
+                if bool(ok):
+                    self.pruned_tiles += int(
+                        np.maximum(sp.tcounts - b, 0).sum())
+                    keep = (d >= 0) & (s > NEG_INF32)
+                    self.queries_served += 1
+                    return s[keep][:k], d[keep][:k], considered
+            # every bucket failed (pathological profile): full scan below
 
         starts = np.zeros((self.n_cells, self.MAX_SPANS), np.int32)
         counts = np.zeros((self.n_cells, self.MAX_SPANS), np.int32)
@@ -464,9 +574,8 @@ class MeshSegmentStore:
             [lang_filter, flag_bit,
              DAYS_NONE_LO if from_days is None else from_days,
              DAYS_NONE_HI if to_days is None else to_days], np.int32)
-        kk = max(16, 1 << (max(k, 1) - 1).bit_length())
         consts = self._profile_consts(profile, language)
-        out = self._fn(kk, with_delta)(
+        out = self._fn(kk0, with_delta)(
             *arrays, starts, counts, dead, *d_args, qfilters, *consts)
         s, d = jax.device_get(out)
         keep = (d >= 0) & (s > NEG_INF32)
@@ -687,6 +796,38 @@ def _mesh_join_shard(feats16, flags, docids, jdocids, jpos, dead, qargs,
     gd = lax.all_gather(dd[idx], axes, tiled=True)
     out_s, out_i = lax.top_k(gs, min(k, gs.shape[0]))
     return out_s, gd[out_i]
+
+
+def _mesh_pruned_shard(feats16, flags, docids, dead, pmax, qargs,
+                       col_min, col_max, tf_min, tf_max,
+                       bound_shift, lang_term,
+                       norm_coeffs, flag_bits, flag_shifts,
+                       domlength_coeff, tf_coeff, language_coeff,
+                       authority_coeff, language_pref,
+                       *, k: int, b: int):
+    """Per-device body of the block-max PRUNED mesh rank: each device
+    runs devstore's prefix-scored, tail-verified top-k over ITS slice of
+    the proxy-sorted span (frozen GLOBAL pack stats), then candidates
+    fuse by all_gather + global top-k. ok = every device's bound held —
+    a single failure escalates the prefix for the whole mesh (the merge
+    is exact iff every local top-k is exact)."""
+    feats16 = feats16[0]
+    flags = flags[0]
+    docids = docids[0]
+    pmax = pmax[0]
+    q = qargs[0]
+    axes = ("term", "doc")
+    run_s, run_d, ok = _pruned_span_topk(
+        feats16, flags, docids, dead, pmax,
+        q[0], q[1], q[2], q[3],
+        col_min, col_max, tf_min, tf_max, bound_shift, lang_term,
+        norm_coeffs, flag_bits, flag_shifts, domlength_coeff, tf_coeff,
+        language_coeff, authority_coeff, language_pref, k=k, b=b)
+    gs = lax.all_gather(run_s, axes, tiled=True)
+    gd = lax.all_gather(run_d, axes, tiled=True)
+    top_s, idx = lax.top_k(gs, min(k, gs.shape[0]))
+    all_ok = lax.pmin(ok.astype(jnp.int32), axes) > 0
+    return top_s, gd[idx], all_ok
 
 
 def _mesh_rank_shard(feats16, flags, docids, starts, counts, dead,
