@@ -338,3 +338,32 @@ def test_proxy_loopback_target_guard(tmp_path):
     finally:
         srv.httpd.server_close()
         sb.close()
+
+
+def test_public_getpageinfo_refuses_loopback(tmp_path):
+    """The PUBLIC getpageinfo mount fetches a user URL: loopback/self
+    targets must be refused (SSRF-to-admin; review fix)."""
+    from yacy_search_server_tpu.server.servlets.api import respond_pageinfo
+    from yacy_search_server_tpu.server.objects import ServerObjects
+    from yacy_search_server_tpu.switchboard import Switchboard
+    calls = []
+
+    def transport(u, h):
+        calls.append(u)
+        return (200, {"content-type": "text/html"},
+                b"<html><title>leak</title></html>")
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), transport=transport)
+    try:
+        prop = respond_pageinfo(
+            {"ext": "json"},
+            ServerObjects({"url": "http://127.0.0.1:8090/Table_API_p.html"}),
+            sb)
+        assert prop.get("error") == "target refused"
+        assert prop.get("title") == ""
+        assert not calls, "loopback target must never be fetched"
+        # a normal target still works (injected transport)
+        prop = respond_pageinfo(
+            {"ext": "json"}, ServerObjects({"url": "http://ok.test/"}), sb)
+        assert "leak" in prop.get("title")
+    finally:
+        sb.close()

@@ -385,3 +385,31 @@ def test_devicestore_dashboard(node):
     st, body = _get_html(srv, "/DeviceStore_p.html")
     assert st == 200
     assert ("queries_served" in body) or ("host path serves" in body)
+
+
+def test_api_endpoint_completions(node):
+    sb, srv = node
+    # version probe
+    st, body = _get(srv, "/version.json")
+    assert st == 200 and body["version"]
+    # public blacklist listing
+    sb.blacklist.add("default", "apibad.test/.*", types={"crawler"})
+    st, body = _get(srv, "/blacklists.json")
+    assert st == 200 and int(body["lists"]) >= 1
+    # config get/set API (admin), recorded in the api work table
+    st, body = _get(srv, "/config_p.json?key=apiTestKey&value=42")
+    assert st == 200 and body["value"] == "42"
+    assert sb.config.get("apiTestKey") == "42"
+    # per-document metadata record
+    from yacy_search_server_tpu.utils.hashes import url2hash
+    uh = url2hash("http://sw.test/").decode()
+    st, body = _get(srv, f"/yacydoc.json?urlhash={uh}")
+    assert st == 200 and body["found"] == "1"
+    assert body["url"] == "http://sw.test/"
+    assert "Sweep Root" in body["dc_title"]
+    # missing doc reports found=0
+    st, body = _get(srv, "/yacydoc.json?urlhash=AAAAAAAAAAAA")
+    assert body["found"] == "0"
+    # public getpageinfo alias serves like the _p mount
+    st, body = _get(srv, "/getpageinfo.json?url=http://sw.test/")
+    assert st == 200

@@ -148,6 +148,14 @@ def pack_prune_stats(f16, fl):
     return stats, proxy
 
 
+def prune_bound_consts(profile):
+    """(bound_shift, lang_term) — the query-side tail-bound constants.
+    Part of the pruning exactness proof; shared by the single-device and
+    mesh pruned paths so they can never diverge."""
+    return (np.int32(_bound_shift(profile)),
+            np.int32(255 << min(max(profile.language, 0), 15)))
+
+
 def pmax_table(sorted_proxy: np.ndarray) -> np.ndarray:
     """Per-tile bound rows over a proxy-DESC-sorted span, margin folded
     in and clamped (see _PMAX_MARGIN_EXTRA)."""
@@ -907,8 +915,7 @@ class _QueryBatcher:
                 feats16, flags, docids, dead, pmax,
                 starts, counts, tstarts, tcounts,
                 cmins, cmaxs, tmins, tmaxs,
-                np.int32(_bound_shift(prof)),
-                np.int32(255 << min(max(prof.language, 0), 15)),
+                *prune_bound_consts(prof),
                 *consts, k=kk, b=b)
             s, d, ok = jax.device_get(out)
             store.prune_rounds += 1
@@ -1412,8 +1419,7 @@ class DeviceSegmentStore:
                 and spans[0].dead_seq == len(self.rwi._tombstones)):
             sp = spans[0]
             st = sp.stats
-            shift = np.int32(_bound_shift(profile))
-            lang_term = np.int32(255 << min(max(profile.language, 0), 15))
+            shift, lang_term = prune_bound_consts(profile)
             for b in _PRUNE_B[prune_from:]:
                 out = _rank_pruned_kernel(
                     feats16, flags, docids, dead, pmax,

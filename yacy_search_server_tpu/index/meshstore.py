@@ -67,10 +67,10 @@ from ..parallel.distribution import horizontal_dht_position
 from ..utils.eventtracker import EClass, update as track
 from . import postings as P
 from .devstore import (_PRUNE_B, DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32,
-                       NO_FLAG, NO_LANG, TILE, _bound_shift,
-                       _bucket_delta, _bucket_rows, _constraint_valid,
-                       _pruned_span_topk, _tile_valid, pack_prune_stats,
-                       pmax_table)
+                       NO_FLAG, NO_LANG, TILE, _bucket_delta,
+                       _bucket_rows, _constraint_valid, _pruned_span_topk,
+                       _tile_valid, pack_prune_stats, pmax_table,
+                       prune_bound_consts)
 
 INT32_MAX = 2 ** 31 - 1
 
@@ -532,8 +532,7 @@ class MeshSegmentStore:
             sp = spans[0]
             st = sp.stats
             consts = self._profile_consts(profile, language)
-            shift = np.int32(_bound_shift(profile))
-            lang_term = np.int32(255 << min(max(profile.language, 0), 15))
+            shift, lang_term = prune_bound_consts(profile)
             qargs = np.stack([sp.starts, sp.counts,
                               sp.tstarts, sp.tcounts], axis=1
                              ).astype(np.int32)

@@ -399,32 +399,11 @@ class YaCyHttpServer:
         return profile
 
     def _loopback_target(self, url: str) -> bool:
-        """True when the proxy target resolves to loopback/unspecified or
-        to this node itself — a proxied fetch FROM localhost would be
-        granted localhost auto-admin by the target, so a remote client
-        must never be able to aim the proxy back at the node (SSRF →
-        admin bypass; the reference's proxy handler similarly refuses to
-        proxy to its own address)."""
-        import ipaddress
-        import socket
-        host = urlsplit(url).hostname or ""
-        if host.lower() in ("localhost", ""):
-            return True
-        addrs = []
-        try:
-            addrs.append(ipaddress.ip_address(host))
-        except ValueError:
-            if getattr(self.sb.loader, "transport", None) is not None:
-                # injected transport: the fetch never opens a real
-                # socket, so DNS says nothing about what it reaches —
-                # only literal loopback addresses are refusable
-                return False
-            try:
-                for info in socket.getaddrinfo(host, None):
-                    addrs.append(ipaddress.ip_address(info[4][0]))
-            except (socket.gaierror, ValueError, OSError):
-                return True     # unresolvable: refuse rather than fetch
-        return any(a.is_loopback or a.is_unspecified for a in addrs)
+        """Shared SSRF predicate (server/netguard.py): a proxied fetch
+        FROM localhost would be granted localhost auto-admin by the
+        target, so a remote client must never aim the node at itself."""
+        from .netguard import loopback_target
+        return loopback_target(url, self.sb.loader)
 
     def _handle_forward_proxy(self, handler, url: str) -> None:
         cfg = self.sb.config

@@ -1,0 +1,39 @@
+"""SSRF guard — shared loopback/self-target refusal.
+
+Any surface that fetches a USER-SUPPLIED url through the node's loader
+(forward proxy, *.yacy rewrite, public getpageinfo) must refuse targets
+that resolve to loopback: a fetch FROM localhost is granted localhost
+auto-admin by the target, so a remote client could read admin pages
+through the node (the round-3 ADVICE high finding). The same predicate
+rides every redirect hop via the loader's ``url_filter``.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import socket
+from urllib.parse import urlsplit
+
+
+def loopback_target(url: str, loader=None) -> bool:
+    """True when the target resolves to loopback/unspecified — refuse.
+
+    With an injected transport (zero-egress tests/simulations) no real
+    socket is opened, so DNS proves nothing: only literal loopback
+    names/addresses are refusable there."""
+    host = urlsplit(url).hostname or ""
+    if host.lower() in ("localhost", ""):
+        return True
+    addrs = []
+    try:
+        addrs.append(ipaddress.ip_address(host))
+    except ValueError:
+        if loader is not None and getattr(loader, "transport",
+                                          None) is not None:
+            return False
+        try:
+            for info in socket.getaddrinfo(host, None):
+                addrs.append(ipaddress.ip_address(info[4][0]))
+        except (socket.gaierror, ValueError, OSError):
+            return True     # unresolvable: refuse rather than fetch
+    return any(a.is_loopback or a.is_unspecified for a in addrs)

@@ -179,6 +179,7 @@ def respond_blacklists(header: dict, post: ServerObjects, sb) -> ServerObjects:
     return prop
 
 
+@servlet("getpageinfo")     # the reference ships both mounts
 @servlet("getpageinfo_p")
 def respond_pageinfo(header: dict, post: ServerObjects, sb) -> ServerObjects:
     """Fetch+parse a page for the crawl-start UI preview (reference:
@@ -191,9 +192,19 @@ def respond_pageinfo(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop.put("links", 0)
     if not url:
         return prop
+    # SSRF guard (server/netguard.py): this servlet fetches a
+    # user-supplied URL — and the bare `getpageinfo` mount is PUBLIC —
+    # so loopback/self targets are refused outright and the same
+    # predicate rides every redirect hop
+    from ..netguard import loopback_target
+    if loopback_target(url, sb.loader):
+        prop.put("error", "target refused")
+        return prop
     try:
         from ...crawler.request import Request
-        resp = sb.loader.load(Request(url=url))
+        resp = sb.loader.load(
+            Request(url=url),
+            url_filter=lambda u: not loopback_target(u, sb.loader))
         from ...document.parser.registry import parse_source
         docs = parse_source(url, resp.mime_type(), resp.content)
         if docs:
@@ -358,4 +369,82 @@ def respond_timeline(header: dict, post: ServerObjects,
         prop.put(f"events_{i}_resultcount", e.result_count)
         prop.put(f"events_{i}_ms", int(e.time_ms))
         prop.put(f"events_{i}_eol", 1 if i < len(entries) - 1 else 0)
+    return prop
+
+
+@servlet("version")
+def respond_version(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Version probe (reference: htroot/api/version.java)."""
+    from ... import __version__
+    prop = ServerObjects()
+    prop.put("version", __version__)
+    prop.put("versionstring", f"yacy-tpu/{__version__}")
+    return prop
+
+
+@servlet("blacklists")
+def respond_blacklists_public(header: dict, post: ServerObjects,
+                              sb) -> ServerObjects:
+    """Read-only blacklist listing (reference: htroot/api/blacklists.java
+    — the public read twin of blacklists_p)."""
+    prop = ServerObjects()
+    names = sb.blacklist.list_names()
+    prop.put("lists", len(names))
+    for i, name in enumerate(names):
+        prop.put(f"lists_{i}_name", escape_json(name))
+        prop.put(f"lists_{i}_entries", len(sb.blacklist.entries(name)))
+        prop.put(f"lists_{i}_eol", 1 if i < len(names) - 1 else 0)
+    return prop
+
+
+@servlet("config_p")
+def respond_config_api(header: dict, post: ServerObjects,
+                       sb) -> ServerObjects:
+    """Config get/set over the API (reference: htroot/api/config_p.java:
+    ?key=K reads, ?key=K&value=V writes; the change is API-recorded like
+    every admin action)."""
+    prop = ServerObjects()
+    key = post.get("key", "").strip()
+    prop.put("key", escape_json(key))
+    if key:
+        if post.get("value", None) is not None:
+            sb.config.set(key, post.get("value"))
+            sb.work_tables.record_api_call(
+                f"config_p.json?key={key}&value={post.get('value')}",
+                "config_p", f"set {key}")
+        prop.put("value", escape_json(str(sb.config.get(key, ""))))
+    else:
+        prop.put("value", "")
+    return prop
+
+
+@servlet("yacydoc")
+def respond_yacydoc(header: dict, post: ServerObjects,
+                    sb) -> ServerObjects:
+    """One document's metadata by urlhash or url (reference:
+    htroot/api/yacydoc.java — the dc_* record of an indexed page)."""
+    from ...utils.hashes import url2hash
+    prop = ServerObjects()
+    uh = post.get("urlhash", "").strip().encode("ascii", "replace")
+    if not uh and post.get("url", ""):
+        uh = url2hash(post.get("url"))
+    docid = sb.index.metadata.docid(uh) if uh else None
+    prop.put("found", 0 if docid is None else 1)
+    if docid is None:
+        return prop
+    row = sb.index.metadata.row(docid)
+    prop.put("urlhash", uh.decode("ascii", "replace"))
+    prop.put("url", escape_json(row.get("sku", "")))
+    prop.put("dc_title", escape_json(row.get("title", "")))
+    prop.put("dc_creator", escape_json(row.get("author", "")))
+    prop.put("dc_description", escape_json(row.get("description_txt", "")))
+    prop.put("dc_subject", escape_json(row.get("keywords", "")))
+    prop.put("dc_publisher", escape_json(row.get("publisher_t", "")))
+    prop.put("dc_language", escape_json(row.get("language_s", "")))
+    prop.put("size", row.get("size_i", 0))
+    prop.put("wordcount", row.get("wordcount_i", 0))
+    prop.put("references", row.get("references_i", 0))
+    prop.put("host", escape_json(row.get("host_s", "")))
+    prop.put("collection", escape_json(row.get("collection_sxt", "")))
+    prop.put("last_modified_days", row.get("last_modified_days_i", 0))
     return prop
