@@ -22,7 +22,7 @@ import sys
 import threading
 import time
 
-VERSION = "0.3.2"
+VERSION = "0.4.0"
 REVISION = 0        # build counter within a version (release comparison)
 
 DEFAULT_PORT = 8090
