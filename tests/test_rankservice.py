@@ -112,3 +112,46 @@ def test_worker_processes_serve_http(tmp_path):
                 p.terminate()
         server.close()
         sb.close()
+
+
+def test_socket_auth_is_per_instance_and_locked_down(tmp_path):
+    """ADVICE r3: the authkey must be random per instance (persisted 0600
+    for workers), the socket 0600, and the dispatch surface a closed
+    method allowlist — the wire is pickle, so auth IS the boundary."""
+    import os
+    import stat
+    from multiprocessing.connection import Client
+
+    from yacy_search_server_tpu.server import rankservice
+
+    sb = _owner(tmp_path)
+    sock = str(tmp_path / "rank.sock")
+    server = RankServiceServer(sb.index.devstore, sock)
+    try:
+        kp = rankservice._key_path(sock)
+        assert stat.S_IMODE(os.stat(kp).st_mode) == 0o600
+        assert stat.S_IMODE(os.stat(sock).st_mode) == 0o600
+        key = rankservice._load_authkey(sock)
+        assert len(key) == 32 and key != b"yacytpu-rank"
+        # a second instance gets a different key
+        sock2 = str(tmp_path / "rank2.sock")
+        server2 = RankServiceServer(sb.index.devstore, sock2)
+        try:
+            assert rankservice._load_authkey(sock2) != key
+        finally:
+            server2.close()
+        # wrong key: the HMAC challenge rejects the connection
+        with pytest.raises(Exception):
+            Client(sock, family="AF_UNIX", authkey=b"wrong-key")
+        # disallowed method name: refused, connection stays usable
+        conn = Client(sock, family="AF_UNIX", authkey=key)
+        conn.send(("__class__", (), {}))
+        status, out = conn.recv()
+        assert status == "err" and "not allowed" in out
+        conn.close()
+        # key file is removed with the socket on close
+        server.close()
+        assert not os.path.exists(kp)
+    finally:
+        server.close()
+        sb.close()

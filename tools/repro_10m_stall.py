@@ -1,0 +1,54 @@
+"""Round-4 repro of the BENCH_r03 10M served-path stall.
+
+Builds the headline workload (10M postings x 2 terms + metadata), runs
+the driver's 64-thread x 3 protocol, and prints per-query latency
+percentiles plus the new serving-health counters. With the batcher's
+exception logging now loud, whatever failed silently in round 3 lands in
+the log output. Run on the default (axon) platform:
+
+    python tools/repro_10m_stall.py [--n 10000000] [--threads 64]
+"""
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+logging.basicConfig(level=logging.INFO,
+                    format="%(asctime)s %(levelname).1s %(name)s %(message)s")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=10_000_000)
+    ap.add_argument("--threads", type=int, default=64)
+    ap.add_argument("--per-thread", type=int, default=3)
+    args = ap.parse_args()
+
+    from bench import _build_served_switchboard, _served_qps
+
+    t0 = time.perf_counter()
+    sb = _build_served_switchboard(args.n, n_terms=2, mesh="off")
+    print(f"build: {time.perf_counter() - t0:.1f}s", flush=True)
+
+    lats: list = []
+    t0 = time.perf_counter()
+    qps = _served_qps(sb, k=10, threads=args.threads,
+                      per_thread=args.per_thread, n_terms=2,
+                      latencies=lats)
+    wall = time.perf_counter() - t0
+    lats.sort()
+    pct = {p: round(lats[min(int(len(lats) * p / 100), len(lats) - 1)]
+                    * 1000, 1) for p in (50, 90, 95, 99, 100)}
+    print(json.dumps({
+        "qps": round(qps, 2), "wall_s": round(wall, 1),
+        "latency_ms": pct,
+        "counters": sb.index.devstore.counters(),
+    }, indent=2), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -357,6 +357,15 @@ class MeshSegmentStore:
         already one program for the whole mesh (cross-query batching
         composes later)."""
 
+    def counters(self) -> dict:
+        """Serving-health counters (devstore interface parity)."""
+        return {
+            "queries_served": self.queries_served,
+            "fallbacks": self.fallbacks,
+            "prune_rounds": self.prune_rounds,
+            "pruned_tiles": self.pruned_tiles,
+        }
+
     def close(self) -> None:
         if self.rwi.listener is self:
             self.rwi.listener = None

@@ -34,25 +34,62 @@ same restart contract as any mmap-snapshot reader).
 from __future__ import annotations
 
 import os
+import secrets
 import threading
+import time
 from multiprocessing.connection import Client, Listener
 
-_AUTHKEY = b"yacytpu-rank"
 # spawn_worker mutates process-global os.environ around start(): one at
 # a time, or concurrent spawns could leave the parent pinned to cpu
 _SPAWN_LOCK = threading.Lock()
 
+# the owner dispatches ONLY these store methods — conn.recv() is pickle
+# underneath, so the dispatch surface must be a closed set, never getattr
+# over attacker-chosen names
+_METHODS = frozenset({"rank_term", "rank_join", "count_upper"})
+
+
+def _key_path(socket_path: str) -> str:
+    return socket_path + ".key"
+
+
+def _load_authkey(socket_path: str) -> bytes:
+    with open(_key_path(socket_path), "rb") as fh:
+        return fh.read()
+
 
 class RankServiceServer:
-    """Expose the owner Switchboard's serving store on a unix socket."""
+    """Expose the owner Switchboard's serving store on a unix socket.
+
+    The wire format (multiprocessing.connection) is pickle, so transport
+    auth is the security boundary: a RANDOM per-instance authkey is
+    generated at startup and persisted mode-0600 next to the socket for
+    workers to read (a hardcoded key would hand any local user an HMAC
+    pass and, with it, arbitrary unpickling in the owner process —
+    ADVICE r3). The socket itself is also chmod 0600."""
 
     def __init__(self, store, socket_path: str):
         self.store = store
         self.socket_path = socket_path
         if os.path.exists(socket_path):
             os.unlink(socket_path)
+        self.authkey = secrets.token_bytes(32)
+        kp = _key_path(socket_path)
+        # O_EXCL on a freshly-unlinked path: a stale key file (whose mode
+        # O_CREAT would keep) or a planted symlink must never receive the
+        # new secret
+        if os.path.lexists(kp):
+            os.unlink(kp)
+        flags = os.O_WRONLY | os.O_CREAT | os.O_EXCL
+        flags |= getattr(os, "O_NOFOLLOW", 0)
+        fd = os.open(kp, flags, 0o600)
+        try:
+            os.write(fd, self.authkey)
+        finally:
+            os.close(fd)
         self.listener = Listener(socket_path, family="AF_UNIX",
-                                 authkey=_AUTHKEY)
+                                 authkey=self.authkey)
+        os.chmod(socket_path, 0o600)
         self._stop = False
         self._threads: list[threading.Thread] = []
         self._accept = threading.Thread(target=self._accept_loop,
@@ -60,11 +97,19 @@ class RankServiceServer:
         self._accept.start()
 
     def _accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
         while not self._stop:
             try:
                 conn = self.listener.accept()
+            except AuthenticationError:
+                continue    # a rejected client must not kill the acceptor
             except (OSError, EOFError):
-                return
+                # a client dying MID-HANDSHAKE raises EOF/ECONNRESET out
+                # of accept() too — only a real shutdown ends the loop
+                if self._stop:
+                    return
+                time.sleep(0.05)   # broken listener must not spin hot
+                continue
             t = threading.Thread(target=self._serve, args=(conn,),
                                  name="rank-conn", daemon=True)
             t.start()
@@ -83,6 +128,8 @@ class RankServiceServer:
             except (EOFError, OSError):
                 return
             try:
+                if method not in _METHODS:
+                    raise ValueError(f"method not allowed: {method!r}")
                 if method == "count_upper":
                     out = store.rwi.count_upper(*args)
                 else:
@@ -100,11 +147,12 @@ class RankServiceServer:
             self.listener.close()
         except OSError:
             pass
-        if os.path.exists(self.socket_path):
-            try:
-                os.unlink(self.socket_path)
-            except OSError:
-                pass
+        for path in (self.socket_path, _key_path(self.socket_path)):
+            if os.path.exists(path):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
 
 class RankServiceClient:
@@ -132,7 +180,7 @@ class RankServiceClient:
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = Client(self.socket_path, family="AF_UNIX",
-                          authkey=_AUTHKEY)
+                          authkey=_load_authkey(self.socket_path))
             self._local.conn = conn
         return conn
 
