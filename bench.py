@@ -209,7 +209,7 @@ def _config5_hybrid(k=100, ndocs=100_000, iters=20):
 
 
 def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
-                              mesh: str = "auto"):
+                              mesh: str = "auto", batch_size: int | None = None):
     """A Switchboard whose index holds `n_terms` hot terms with `n`
     postings each, plus real metadata rows for every doc — the served-path
     workload (distinct query strings so the event cache never aliases).
@@ -226,7 +226,17 @@ def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
 
     cfg = Config()
     cfg.set("index.device.mesh", mesh)
-    sb = Switchboard(data_dir=None, config=cfg)
+    if batch_size is not None:
+        cfg.set("index.device.batchSize", str(batch_size))
+    # the PRODUCT store topology: disk-backed metadata (mmap segments).
+    # A RAM-only tail at 10M docs means 30M+ live Python strings, and a
+    # major-GC pass over that heap holds the GIL for SECONDS — the last
+    # r3-class stall source (uniform ~7 s latency clusters, waiters'
+    # 1 s timeouts unable to even expire). The product serves from mmap
+    # segments, so the bench must too.
+    import tempfile
+    data_dir = tempfile.mkdtemp(prefix="yacytpu-bench-")
+    sb = Switchboard(data_dir=data_dir, config=cfg)
     rng = np.random.default_rng(0)
     # synthetic 12-char urlhashes: positional layout (6:12 = host part)
     # with `hosts` distinct hosts so host-diversity drain has real work
@@ -236,6 +246,9 @@ def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
         title=[f"doc {i}" for i in range(n)],
         host_s=[f"h{i % hosts}.example" for i in range(n)],
         size_i=[1000] * n, wordcount_i=[100] * n)
+    # freeze the tail into mmap segments: reads page in from disk, the
+    # Python-object heap stays small, and major GC stays sub-ms
+    sb.index.metadata.snapshot()
     docids = np.arange(n, dtype=np.int32)
     for t in range(n_terms):
         feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
@@ -244,6 +257,12 @@ def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
         feats[:, P.F_LANGUAGE] = P.pack_language("en")
         sb.index.rwi.ingest_run({word2hash(f"benchterm{t}"):
                                  PostingsList(docids, feats)})
+    # a deployment that can warm at startup should (and the bench must):
+    # a background kernel compile serializes against live dispatches
+    # through the tunnel — the r3 stall's third ingredient
+    pw = getattr(sb.index.devstore, "prewarm_wait", None)
+    if pw is not None:
+        pw(timeout=900.0)
     return sb
 
 
@@ -254,12 +273,19 @@ def _served_qps(sb, k=10, threads=32, per_thread=4, n_terms=8,
     `latencies` is a list, per-query BATCHED-WINDOW latencies are
     appended — the p50 the north star is stated in, falsifiable on
     locally-attached hardware (VERDICT r2 weak #4)."""
+    import gc
     import threading
     import time
     for t in range(n_terms):                  # warm every term's extents
         ev = sb.search(f"benchterm{t}", count=k)
         assert len(ev.results()) == k
     sb.search_cache.clear()
+    # the build's garbage is history: collect once, then move survivors
+    # to the permanent generation so no major-GC pass (a GIL hold that
+    # freezes every searcher AND dispatcher thread) lands mid-run —
+    # the CPython equivalent of the reference's young-gen tuning
+    gc.collect()
+    gc.freeze()
     served0 = sb.index.devstore.queries_served
 
     def worker(t):
@@ -302,6 +328,63 @@ def _config6_served_path(k=10, ndocs=1_000_000, threads=16):
     qps = _served_qps(sb, k=k, threads=threads, per_thread=5, n_terms=8)
     _emit(f"served_search_top{k}_qps_{ndocs // 1_000_000}M_postings"
           f"_x{threads}", qps, "queries/sec", 0.0)
+
+
+def _config13_modifier_mix(k=10, ndocs=1_000_000, threads=32):
+    """Config #13: BLENDED throughput of a modifier-heavy mix (VERDICT
+    r3 #5) — 50% of queries carry operators. Device-eligible shapes
+    (/language/, daterange:, 2-term conjunctions) rank on device;
+    site:/filetype: need metadata columns and take the host path by
+    design (devstore docstring). The emitted metrics report the blend
+    AND the measured device fraction, so the product's real mixed-load
+    number is on the record, not just the plain-query headline."""
+    import threading as _th
+    import time as _t
+    sb = _build_served_switchboard(ndocs, n_terms=8, hosts=256, mesh="off")
+    assert sb.index.devstore is not None
+    shapes = [
+        "benchterm{t}",                               # plain (device)
+        "benchterm{t}",                               # plain (device)
+        "benchterm{t} /language/en",                  # device (kernel filter)
+        "daterange:1970-01-02..1972-09-27 benchterm{t}",  # device
+        "site:h7.example benchterm{t}",               # host (metadata join)
+        "filetype:html benchterm{t}",                 # host
+        "benchterm{t} benchterm{u}",                  # device conjunction
+        "benchterm{t} -nosuchword",                   # device join shape
+    ]
+    # warm every shape once (compiles + extent placement)
+    for i, s in enumerate(shapes):
+        sb.search(s.format(t=i % 8, u=(i + 1) % 8), count=k).results()
+    sb.search_cache.clear()
+    served0 = sb.index.devstore.queries_served
+    join0 = sb.index.devstore.join_served
+    done = [0]
+    lk = _th.Lock()
+
+    def worker(tid):
+        for j in range(6):
+            sb.search_cache.clear()
+            s = shapes[(tid + j) % len(shapes)]
+            ev = sb.search(s.format(t=tid % 8, u=(tid + 1) % 8), count=k)
+            ev.results()
+            with lk:
+                done[0] += 1
+
+    ts = [_th.Thread(target=worker, args=(i,)) for i in range(threads)]
+    t0 = _t.perf_counter()
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    dt = _t.perf_counter() - t0
+    total = done[0]
+    dev = sb.index.devstore.queries_served - served0
+    _emit(f"modifier_mix_qps_{ndocs // 1_000_000}M_x{threads}",
+          total / dt, "queries/sec", 0.0)
+    _emit("modifier_mix_device_fraction", dev / max(total, 1),
+          "fraction", 0.0)
+    _emit("modifier_mix_device_joins",
+          sb.index.devstore.join_served - join0, "queries", 0.0)
 
 
 def _config10_mesh_served(k=10, ndocs=1_000_000, threads=16):
@@ -708,7 +791,8 @@ def main():
          8: _config8_device_join,
          9: _config9_indexing,
          11: _config11_metadata_startup,
-         12: _config12_multiproc}[args.config]()
+         12: _config12_multiproc,
+         13: _config13_modifier_mix}[args.config]()
         return
 
     # ------------------------------------------------------------------

@@ -1,0 +1,96 @@
+"""Host-side query latency budget (VERDICT r3 #9).
+
+The p50 <= 50 ms north star is tunnel-floored on this box (~110 ms round
+trip), but the HOST portion — parse, candidate drain, metadata join,
+result assembly — is measurable here: with the device mocked to answer
+instantly, per-query wall time IS the host budget. The budget asserted
+is < 5 ms p95 (AccessTracker.java:50-172 is the reference's own
+query-time accounting surface; its host work rides the same budget).
+"""
+
+import time
+
+import numpy as np
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.switchboard import Switchboard
+from yacy_search_server_tpu.utils.config import Config
+from yacy_search_server_tpu.utils.hashes import word2hash
+
+N = 20_000
+
+
+class _InstantDevice:
+    """Serving-store stand-in answering from precomputed arrays in ~0."""
+
+    small_rank_n = 0
+
+    def __init__(self, n, k=256):
+        rng = np.random.default_rng(5)
+        self._s = np.sort(rng.integers(1, 2 ** 30, k).astype(np.int32))[::-1]
+        self._d = rng.choice(n, k, replace=False).astype(np.int32)
+        self._n = n
+        self.queries_served = 0
+        self.fallbacks = 0
+        self.join_served = 0
+        self.join_fallbacks = 0
+
+    def rank_term(self, th, profile, language="en", k=100, **kw):
+        self.queries_served += 1
+        return self._s[:k].copy(), self._d[:k].copy(), self._n
+
+    def rank_join(self, inc, exc, profile, language="en", k=100, **kw):
+        self.queries_served += 1
+        self.join_served += 1
+        return self._s[:k].copy(), self._d[:k].copy(), self._n
+
+    def counters(self):
+        return {"queries_served": self.queries_served}
+
+    def close(self):
+        pass
+
+
+def test_host_side_query_budget():
+    cfg = Config()
+    cfg.set("index.device.serving", "false")
+    sb = Switchboard(data_dir=None, config=cfg)
+    try:
+        hosts = 128
+        sb.index.metadata.bulk_load(
+            [f"{i:06d}h{i % hosts:05d}".encode() for i in range(N)],
+            sku=[f"http://h{i % hosts}.example/d{i}.html" for i in range(N)],
+            title=[f"doc {i}" for i in range(N)],
+            host_s=[f"h{i % hosts}.example" for i in range(N)],
+            size_i=[1000] * N, wordcount_i=[100] * N)
+        rng = np.random.default_rng(0)
+        feats = rng.integers(0, 1000, (N, P.NF)).astype(np.int32)
+        feats[:, P.F_LANGUAGE] = P.pack_language("en")
+        sb.index.rwi.ingest_run({word2hash("budgetterm"): PostingsList(
+            np.arange(N, dtype=np.int32), feats)})
+        sb.index.devstore = _InstantDevice(N)
+
+        # warm (template/regex/caches)
+        for _ in range(3):
+            sb.search_cache.clear()
+            ev = sb.search("budgetterm", count=10)
+            assert len(ev.results()) == 10
+
+        lats = []
+        for _ in range(100):
+            sb.search_cache.clear()
+            t0 = time.perf_counter()
+            ev = sb.search("budgetterm", count=10)
+            r = ev.results()
+            lats.append(time.perf_counter() - t0)
+            assert len(r) == 10
+        lats.sort()
+        p50 = lats[50] * 1000
+        p95 = lats[95] * 1000
+        # the host's share of the p50<=50ms north star: parse + drain +
+        # metadata join + page assembly must stay a rounding error next
+        # to the device round trip
+        assert p95 < 5.0, f"host-side p95 {p95:.2f} ms (p50 {p50:.2f})"
+    finally:
+        sb.close()

@@ -148,27 +148,78 @@ def test_rank_join_parity(twin_join):
     assert not joined & excluded
 
 
-def test_join_cross_row_falls_back():
-    """Terms hashed to different TERM rows cannot join device-side (their
-    postings live on different cells) — the reference's own cross-ring
-    boundary; the store must hand the query to the host join."""
+def _words_on_rows(n_term: int, want: int = 3):
+    """Words whose term hashes land on distinct rows of the term axis,
+    first one per row in discovery order."""
+    rows: dict[int, str] = {}
+    for i in range(10_000):
+        w = f"w{i}"
+        r = term_shard(word2hash(w), n_term)
+        if r not in rows:
+            rows[r] = w
+            if len(rows) == want:
+                break
+    return list(rows.values())
+
+
+def test_join_cross_row_served_on_mesh():
+    """Terms hashed to DIFFERENT term rows now join device-side via the
+    term-axis candidate exchange (VERDICT r3 #3) — bit-identical to the
+    single-device join over the same postings, no host fallback."""
     rng = np.random.default_rng(13)
-    # find two words on different rows of a 2-row term axis
-    words = iter(f"w{i}" for i in range(1000))
-    wa = next(words)
-    wb = next(w for w in words
-              if term_shard(word2hash(w), 2) != term_shard(word2hash(wa), 2))
+    wa, wb = _words_on_rows(2, want=2)
     ta, tb = word2hash(wa), word2hash(wb)
-    dd = np.arange(5_000, dtype=np.int32)
-    terms = {ta: PostingsList(dd, _mkfeats(rng, 5_000)),
-             tb: PostingsList(dd.copy(), _mkfeats(rng, 5_000))}
-    rwi = RWIIndex()
-    rwi.ingest_run(terms)
-    ms = MeshSegmentStore(rwi, devices=_devices(), n_term=2)
+    assert term_shard(ta, 2) != term_shard(tb, 2)
+    da = np.sort(rng.choice(60_000, 20_000, replace=False)).astype(np.int32)
+    db = np.sort(rng.choice(60_000, 6_000, replace=False)).astype(np.int32)
+    terms = {ta: PostingsList(da, _mkfeats(rng, 20_000)),
+             tb: PostingsList(db, _mkfeats(rng, 6_000))}
+    rwi1, rwi2 = _twin_rwis(terms)
+    ds = DeviceSegmentStore(rwi1, device=_devices()[0])
+    ms = MeshSegmentStore(rwi2, devices=_devices(), n_term=2)
     try:
-        assert ms.rank_join([ta, tb], [], RankingProfile(), k=10) is None
-        assert ms.fallbacks >= 1
+        fb0 = ms.fallbacks
+        r1 = ds.rank_join([ta, tb], [], RankingProfile(), k=20)
+        r2 = ms.rank_join([ta, tb], [], RankingProfile(), k=20)
+        assert r1 is not None and r2 is not None
+        assert ms.fallbacks == fb0
+        assert np.array_equal(np.asarray(r1[0]), np.asarray(r2[0]))
+        assert np.array_equal(np.asarray(r1[1]), np.asarray(r2[1]))
+        assert r1[2] == r2[2] == 6_000
     finally:
+        ds.close()
+        ms.close()
+
+
+def test_join_cross_row_with_exclusion_parity():
+    """Cross-row conjunction with the EXCLUDE term on yet another row
+    distribution: include pair crosses rows and the exclusion must
+    remove its docids, matching the single-device join exactly."""
+    rng = np.random.default_rng(17)
+    wa, wb = _words_on_rows(2, want=2)
+    # an exclude word on a different row than the rare include
+    wx = next(w for w in (f"x{i}" for i in range(10_000))
+              if term_shard(word2hash(w), 2) != term_shard(word2hash(wb), 2))
+    ta, tb, tx = word2hash(wa), word2hash(wb), word2hash(wx)
+    da = np.sort(rng.choice(50_000, 15_000, replace=False)).astype(np.int32)
+    db = np.sort(rng.choice(50_000, 5_000, replace=False)).astype(np.int32)
+    dx = np.sort(rng.choice(50_000, 2_000, replace=False)).astype(np.int32)
+    terms = {ta: PostingsList(da, _mkfeats(rng, 15_000)),
+             tb: PostingsList(db, _mkfeats(rng, 5_000)),
+             tx: PostingsList(dx, _mkfeats(rng, 2_000))}
+    rwi1, rwi2 = _twin_rwis(terms)
+    ds = DeviceSegmentStore(rwi1, device=_devices()[0])
+    ms = MeshSegmentStore(rwi2, devices=_devices(), n_term=2)
+    try:
+        r1 = ds.rank_join([ta, tb], [tx], RankingProfile(), k=20)
+        r2 = ms.rank_join([ta, tb], [tx], RankingProfile(), k=20)
+        assert r1 is not None and r2 is not None
+        assert np.array_equal(np.asarray(r1[0]), np.asarray(r2[0]))
+        assert np.array_equal(np.asarray(r1[1]), np.asarray(r2[1]))
+        joined = set(np.asarray(r2[1]).tolist())
+        assert not joined & set(dx.tolist())
+    finally:
+        ds.close()
         ms.close()
 
 

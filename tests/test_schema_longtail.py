@@ -244,3 +244,143 @@ def test_www_unique_needs_actual_www_twin():
         assert m.row(d).get("www_unique_b") == 0
     finally:
         s.close()
+
+
+# -- round-4 closure to full reference parity (VERDICT r3 #6) ---------------
+
+
+def test_collection_schema_full_parity():
+    """Every CollectionSchema enum name is served — as a column or as a
+    documented representation alias (FIELD_ALIASES). Parsed live from
+    the reference when present; the embedded list pins the r4 additions
+    either way."""
+    import os
+    import re
+
+    from yacy_search_server_tpu.index.metadata import schema_field_names
+    served = set(schema_field_names())
+    for f in ("bold_val", "italic_val", "underline_val", "css_tag_sxt",
+              "fuzzy_signature_text_t", "vocabularies_sxt",
+              "cr_host_norm_i", "fresh_date_days_i",
+              "ext_ads_txt", "ext_ads_val", "ext_cms_txt", "ext_cms_val",
+              "ext_community_txt", "ext_community_val", "ext_maps_txt",
+              "ext_maps_val", "ext_title_txt", "ext_title_val",
+              "ext_tracker_txt", "ext_tracker_val",
+              "id", "last_modified", "load_date_dt", "fresh_date_dt",
+              "coordinate_p", "coordinate_p_0_coordinate",
+              "coordinate_p_1_coordinate"):
+        assert f in served, f
+    ref = "/root/reference/source/net/yacy/search/schema/CollectionSchema.java"
+    if os.path.exists(ref):
+        with open(ref, encoding="utf-8", errors="replace") as fh:
+            names = re.findall(r"^\s+([a-z_0-9]+)\(SolrType", fh.read(),
+                               re.M)
+        missing = sorted(n for n in names if n not in served)
+        assert not missing, f"collection fields missing: {missing}"
+
+
+def test_webgraph_schema_full_parity():
+    import os
+    import re
+
+    from yacy_search_server_tpu.index.webgraph import (FIELD_ALIASES,
+                                                       INT_COLS, TEXT_COLS)
+    served = set(TEXT_COLS) | set(INT_COLS) | set(FIELD_ALIASES)
+    for c in ("source_host_id_s", "target_host_id_s",
+              "source_parameter_key_sxt", "source_parameter_value_sxt",
+              "source_parameter_count_i", "target_crawldepth_i",
+              "source_cr_host_norm_i", "target_cr_host_norm_i"):
+        assert c in served, c
+    ref = "/root/reference/source/net/yacy/search/schema/WebgraphSchema.java"
+    if os.path.exists(ref):
+        with open(ref, encoding="utf-8", errors="replace") as fh:
+            names = re.findall(r"^\s+([a-z_0-9]+)\(SolrType", fh.read(),
+                               re.M)
+        missing = sorted(n for n in names if n not in served)
+        assert not missing, f"webgraph columns missing: {missing}"
+
+
+def test_emphasis_val_counts_roundtrip(seg):
+    """bold_txt dedupes to unique texts; bold_val carries the positional
+    occurrence counts (reference bold_txt/bold_val pairing)."""
+    row = _row(seg)
+    assert split_multi(row.get("bold_txt")) == ["bold words"]
+    assert split_multi_positional(row.get("bold_val")) == ["1"]
+    assert split_multi_positional(row.get("italic_val")) == ["1"]
+
+
+def test_css_tag_and_fuzzy_text(seg):
+    row = _row(seg)
+    tags = split_multi(row.get("css_tag_sxt"))
+    assert len(tags) == 2 and all(t.startswith("<link") for t in tags)
+    assert "stylesheet" in tags[0]
+    # the fuzzy profile text is the signature's preimage
+    from yacy_search_server_tpu.document.signature import (
+        fuzzy_profile_text, fuzzy_signature)
+    txt = row.get("fuzzy_signature_text_t")
+    assert txt and ":" in txt
+    body = row.get("text_t")
+    assert fuzzy_profile_text(body) == txt
+    assert row.get("fuzzy_signature_l") == fuzzy_signature(body)
+
+
+def test_evaluation_ext_fields():
+    """ext_* page-technology fields fill from real pattern matches."""
+    page = (b"<html><head><title>t</title>"
+            b"<script src='https://www.google-analytics.com/ga.js'>"
+            b"</script>"
+            b"<script src='/wp-content/themes/x/app.js'></script>"
+            b"<script src='https://pagead2.googlesyndication.com/ads.js'>"
+            b"</script></head><body>hello</body></html>")
+    s = Segment()
+    try:
+        docs = parse_source("http://ev.test/", "text/html", page)
+        s.store_document(docs[0])
+        row = s.metadata.row(s.metadata.docid(url2hash("http://ev.test/"))
+                             or 0)
+        assert split_multi_positional(
+            row.get("ext_tracker_txt")) == ["googleanalytics"]
+        assert split_multi_positional(row.get("ext_cms_txt")) == \
+            ["wordpress"]
+        assert split_multi_positional(row.get("ext_ads_txt")) == \
+            ["adsense"]
+        assert int(split_multi_positional(
+            row.get("ext_tracker_val"))[0]) >= 1
+    finally:
+        s.close()
+
+
+def test_alias_reads(seg):
+    row = _row(seg)
+    assert row.get("id") == row.urlhash.decode("ascii")
+    assert row.get("load_date_dt") == row.get("load_date_days_i")
+    assert row.get("coordinate_p_0_coordinate") == row.get("lat_d")
+    assert "," in row.get("coordinate_p")
+
+
+def test_webgraph_new_columns_roundtrip(tmp_path):
+    from yacy_search_server_tpu.index.webgraph import WebgraphStore
+
+    class _A:
+        def __init__(self, url, text=""):
+            self.url, self.text = url, text
+            self.rel = self.alt = self.name = ""
+
+    wg = WebgraphStore(str(tmp_path / "wg"))
+    try:
+        n = wg.add_document_edges(
+            1, "http://src.test/a?k=v&q=2",
+            [_A("http://tgt.test/b?x=1", "link")],
+            crawldepth=2, load_date_days=100, last_modified_days=90)
+        assert n == 1
+        row = wg.edge(0)
+        assert row["source_parameter_count_i"] == 2
+        assert row["source_parameter_key_sxt"].split("|")[0] == "k" or \
+            "k" in row["source_parameter_key_sxt"]
+        assert row["target_crawldepth_i"] == 3
+        assert row["last_modified_days_i"] == 90
+        assert len(row["source_host_id_s"]) == 6
+        assert len(row["target_host_id_s"]) == 6
+        assert row["source_host_id_s"] != row["target_host_id_s"]
+    finally:
+        wg.close()

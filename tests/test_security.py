@@ -367,3 +367,61 @@ def test_public_getpageinfo_refuses_loopback(tmp_path):
         assert "leak" in prop.get("title")
     finally:
         sb.close()
+
+
+def test_private_target_classes(tmp_path):
+    """Non-admin surfaces also refuse link-local (cloud metadata) and
+    RFC1918 targets; admins keep private targets (ADVICE r4)."""
+    from yacy_search_server_tpu.server.netguard import (loopback_target,
+                                                       private_target)
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"),
+                     transport=lambda u, h: (404, {}, b""))
+    try:
+        ld = sb.loader
+        for url in ("http://169.254.169.254/latest/meta-data/",
+                    "http://10.0.0.7/", "http://192.168.1.1/admin",
+                    "http://172.16.3.4/"):
+            assert private_target(url, ld), url
+            assert not loopback_target(url, ld), url   # admin predicate
+        assert private_target("http://127.0.0.1/x", ld)
+        assert not private_target("http://93.184.216.34/", ld)
+    finally:
+        sb.close()
+
+
+def test_pinned_connection_refuses_at_connect():
+    """The addr_guard pins the fetch to a VETTED resolution: even when
+    the URL check was bypassed (DNS rebinding), connect-time vetting
+    refuses the resolved address."""
+    import ipaddress
+
+    from yacy_search_server_tpu.crawler.loader import LoaderDispatcher
+    from yacy_search_server_tpu.crawler.request import Request
+    from yacy_search_server_tpu.server.netguard import refuse_addr
+
+    ld = LoaderDispatcher(transport=None, timeout_s=3.0)
+    resp = ld.load(Request(url="http://127.0.0.1:1/x"),
+                   addr_guard=lambda a: refuse_addr(a, allow_private=False))
+    assert resp.status == 599
+    assert "refused address" in resp.headers.get("x-error", "")
+    # sanity: the guard object itself classifies correctly
+    assert refuse_addr(ipaddress.ip_address("169.254.169.254"), False)
+    assert not refuse_addr(ipaddress.ip_address("93.184.216.34"), False)
+
+
+def test_regextest_admin_gated_by_default():
+    """RegexTest runs user regexes with no engine timeout: admin-gated
+    by default, re-openable via security.adminPaths="-RegexTest"."""
+    class Cfg(dict):
+        def get(self, k, d=None):
+            return dict.get(self, k, d)
+
+        def get_bool(self, k, d=False):
+            v = dict.get(self, k, None)
+            return d if v is None else str(v).lower() == "true"
+
+    sec = SecurityHandler(Cfg())
+    assert sec.admin_required("RegexTest", "/RegexTest.html")
+    assert not sec.admin_required("yacysearch", "/yacysearch.html")
+    sec2 = SecurityHandler(Cfg({"security.adminPaths": "-RegexTest"}))
+    assert not sec2.admin_required("RegexTest", "/RegexTest.html")

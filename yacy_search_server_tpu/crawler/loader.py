@@ -11,11 +11,16 @@ run with zero egress.
 
 from __future__ import annotations
 
+import http.client
+import ipaddress
 import os
+import socket
+import ssl
 import threading
 import time
 from urllib.parse import urlsplit
-from urllib.request import HTTPRedirectHandler, Request as UrlRequest
+from urllib.request import (HTTPHandler, HTTPRedirectHandler, HTTPSHandler,
+                            Request as UrlRequest)
 from urllib.request import build_opener
 
 from .cache import HTCache
@@ -53,6 +58,95 @@ class _FilteredRedirectHandler(_CappedRedirectHandler):
 
 
 _OPENER = build_opener(_CappedRedirectHandler)
+
+
+class _PinnedHTTPConnection(http.client.HTTPConnection):
+    """Connection that resolves ONCE, vets the RESOLVED address with the
+    opener's addr_guard, and connects to that same address — closing the
+    DNS-rebinding TOCTOU where a hostname passes the URL check and then
+    re-resolves to loopback at fetch time (server/netguard.py)."""
+
+    addr_guard = staticmethod(lambda a: False)   # set per instance
+
+    def _vetted_connect(self):
+        infos = socket.getaddrinfo(self.host, self.port,
+                                   type=socket.SOCK_STREAM)
+        last = None
+        for info in infos:
+            ip = info[4][0]
+            if self.addr_guard(ipaddress.ip_address(ip)):
+                last = OSError(f"refused address for {self.host}: {ip}")
+                continue
+            return socket.create_connection((ip, self.port),
+                                            timeout=self.timeout)
+        raise last or OSError(f"no address for {self.host}")
+
+    def connect(self):
+        self.sock = self._vetted_connect()
+
+
+class _PinnedHTTPSConnection(_PinnedHTTPConnection,
+                             http.client.HTTPSConnection):
+    def connect(self):
+        sock = self._vetted_connect()
+        self.sock = self._context.wrap_socket(
+            sock, server_hostname=self.host)
+
+
+_SSL_CONTEXT: ssl.SSLContext | None = None
+
+
+def _ssl_context() -> ssl.SSLContext:
+    """One shared verify context: create_default_context re-parses the
+    CA bundle from disk (~ms) — per-connection creation would tax every
+    hop on the guarded proxy path. wrap_socket on a shared context is
+    thread-safe."""
+    global _SSL_CONTEXT
+    if _SSL_CONTEXT is None:
+        _SSL_CONTEXT = ssl.create_default_context()
+    return _SSL_CONTEXT
+
+
+def _conn_factory(cls, guard):
+    def make(host, timeout=None, context=None):
+        conn = (cls(host, timeout=timeout,
+                    context=context or _ssl_context())
+                if cls is _PinnedHTTPSConnection
+                else cls(host, timeout=timeout))
+        conn.addr_guard = guard
+        return conn
+    return make
+
+
+class _PinnedHTTPHandler(HTTPHandler):
+    def __init__(self, addr_guard):
+        super().__init__()
+        self._guard = addr_guard
+
+    def http_open(self, req):
+        return self.do_open(
+            _conn_factory(_PinnedHTTPConnection, self._guard), req)
+
+
+class _PinnedHTTPSHandler(HTTPSHandler):
+    def __init__(self, addr_guard):
+        super().__init__()
+        self._guard = addr_guard
+
+    def https_open(self, req):
+        return self.do_open(
+            _conn_factory(_PinnedHTTPSConnection, self._guard), req)
+
+
+def _pinned_opener(url_filter, addr_guard):
+    """build_opener wiring for the pinned connection classes above."""
+    handlers = [_PinnedHTTPHandler(addr_guard),
+                _PinnedHTTPSHandler(addr_guard)]
+    if url_filter is not None:
+        handlers.append(_FilteredRedirectHandler(url_filter))
+    else:
+        handlers.append(_CappedRedirectHandler())
+    return build_opener(*handlers)
 
 
 class LoaderDispatcher:
@@ -93,13 +187,16 @@ class LoaderDispatcher:
 
     # -- transports ----------------------------------------------------------
 
-    def _fetch_http(self, url: str,
-                    url_filter=None) -> tuple[int, dict, bytes]:
+    def _fetch_http(self, url: str, url_filter=None,
+                    addr_guard=None) -> tuple[int, dict, bytes]:
         if self.transport is not None:
             return self.transport(url, {"User-Agent": self.agent})
         req = UrlRequest(url, headers={"User-Agent": self.agent})
-        opener = _OPENER if url_filter is None \
-            else build_opener(_FilteredRedirectHandler(url_filter))
+        if addr_guard is not None:
+            opener = _pinned_opener(url_filter, addr_guard)
+        else:
+            opener = _OPENER if url_filter is None \
+                else build_opener(_FilteredRedirectHandler(url_filter))
         with opener.open(req, timeout=self.timeout_s) as resp:  # nosec - crawler
             content = resp.read(self.max_size + 1)
             if len(content) > self.max_size:
@@ -130,10 +227,12 @@ class LoaderDispatcher:
 
     def load(self, request: Request,
              strategy: str = CacheStrategy.IFEXIST,
-             url_filter=None) -> Response:
+             url_filter=None, addr_guard=None) -> Response:
         """`url_filter` (url -> bool), when given, is applied to every
         HTTP redirect hop; hops it refuses abort the fetch (the initial
-        URL is the caller's own responsibility to check)."""
+        URL is the caller's own responsibility to check). `addr_guard`
+        (ipaddress -> refuse bool) additionally pins each connection to
+        a vetted resolution (netguard; DNS-rebinding defense)."""
         url = request.url
         cached = self._try_cache(url, strategy)
         if cached is not None:
@@ -170,7 +269,8 @@ class LoaderDispatcher:
             if scheme in ("http", "https", "ftp"):
                 # ftp rides urllib's built-in FTPHandler (the reference's
                 # FTPLoader is its own client; capability, not mechanism)
-                status, headers, content = self._fetch_http(url, url_filter)
+                status, headers, content = self._fetch_http(
+                    url, url_filter, addr_guard=addr_guard)
             elif scheme == "file":
                 status, headers, content = self._fetch_file(url)
             elif scheme == "smb":

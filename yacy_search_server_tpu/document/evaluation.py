@@ -1,0 +1,93 @@
+"""Page-technology evaluation — the ext_* schema field family.
+
+Capability equivalent of the reference's parser evaluation model
+(reference: source/net/yacy/cora/document/analysis/Classification.java
+neighborhood; the schema consumers are CollectionSchema.ext_ads_txt/_val,
+ext_cms_txt/_val, ext_community_txt/_val, ext_maps_txt/_val,
+ext_title_txt/_val, ext_tracker_txt/_val — filled per document from
+pattern matches over the page source). The model here is a compact
+built-in pattern table over the categories the schema names; operators
+can extend ``PATTERNS`` at runtime (the reference's model is likewise a
+data table, not code).
+
+Each category yields (names, counts): the detected technology names and
+how often each one's signature appeared — stored positionally as
+ext_<cat>_txt / ext_<cat>_val.
+"""
+
+from __future__ import annotations
+
+import re
+
+# category -> [(technology-name, compiled-signature)]
+PATTERNS: dict[str, list[tuple[str, re.Pattern]]] = {
+    "ads": [
+        ("adsense", re.compile(
+            r"pagead2\.googlesyndication|adsbygoogle", re.I)),
+        ("doubleclick", re.compile(r"doubleclick\.net", re.I)),
+        ("amazonads", re.compile(r"amazon-adsystem\.com", re.I)),
+        ("taboola", re.compile(r"taboola\.com", re.I)),
+    ],
+    "cms": [
+        ("wordpress", re.compile(r"wp-content|wp-includes|wordpress", re.I)),
+        ("joomla", re.compile(r"/media/jui/|joomla", re.I)),
+        ("drupal", re.compile(r"drupal\.js|sites/default/files|drupal",
+                              re.I)),
+        ("typo3", re.compile(r"typo3conf|typo3temp|typo3", re.I)),
+        ("mediawiki", re.compile(r"mediawiki|/wiki/index\.php", re.I)),
+        ("shopify", re.compile(r"cdn\.shopify\.com", re.I)),
+    ],
+    "community": [
+        ("disqus", re.compile(r"disqus\.com/embed|disqus", re.I)),
+        ("facebook", re.compile(
+            r"connect\.facebook\.net|facebook\.com/plugins", re.I)),
+        ("vbulletin", re.compile(r"vbulletin", re.I)),
+        ("phpbb", re.compile(r"phpbb", re.I)),
+        ("discourse", re.compile(r"discourse", re.I)),
+    ],
+    "maps": [
+        ("googlemaps", re.compile(
+            r"maps\.google\.|maps\.googleapis\.com", re.I)),
+        ("openstreetmap", re.compile(
+            r"openstreetmap\.org|osm\.org", re.I)),
+        ("leaflet", re.compile(r"leaflet(\.js|\.css)", re.I)),
+        ("openlayers", re.compile(r"openlayers|ol\.js", re.I)),
+    ],
+    "title": [
+        ("phpbb", re.compile(r"powered by phpbb", re.I)),
+        ("vbulletin", re.compile(r"powered by vbulletin", re.I)),
+        ("mediawiki", re.compile(r"- wikipedia|mediawiki", re.I)),
+    ],
+    "tracker": [
+        ("googleanalytics", re.compile(
+            r"google-analytics\.com|googletagmanager|gtag\(", re.I)),
+        ("matomo", re.compile(r"matomo\.js|piwik\.js|piwik\.php", re.I)),
+        ("hotjar", re.compile(r"hotjar\.com", re.I)),
+        ("facebookpixel", re.compile(r"fbevents\.js", re.I)),
+    ],
+}
+
+CATEGORIES = tuple(PATTERNS)
+
+
+def evaluate_page(html: str, title: str = "") -> dict[str, tuple[list[str],
+                                                                 list[int]]]:
+    """Match every category's signatures; returns
+    {category: (names, counts)} with only the matched names included.
+    `title` feeds the "title" category (generator banners live there);
+    every other category scans the raw page source."""
+    out: dict[str, tuple[list[str], list[int]]] = {}
+    for cat, rules in PATTERNS.items():
+        src = title if cat == "title" else html
+        names: list[str] = []
+        counts: list[int] = []
+        if src:
+            for name, rx in rules:
+                # finditer: counting must not materialize every match
+                # over the full page source on the indexing hot path
+                n = sum(1 for _ in rx.finditer(src))
+                if n:
+                    names.append(name)
+                    counts.append(n)
+        out[cat] = (names, counts)
+    return out

@@ -62,6 +62,7 @@ class ContentScraper(HTMLParser):
         self.tag_texts: dict[str, list[str]] = {}
         self._tagtext_stack: list[tuple[str, list[str]]] = []
         self.css: list[str] = []
+        self.css_tags: list[str] = []
         self.scripts: list[str] = []
         self.script_count = 0
         self.iframes: list[str] = []
@@ -122,6 +123,13 @@ class ContentScraper(HTMLParser):
                     self.favicon = urljoin(self._base, href)
                 elif "stylesheet" in rel:
                     self.css.append(urljoin(self._base, href))
+                    # the raw tag text (CollectionSchema css_tag_sxt);
+                    # values re-escape so the stored tag stays parseable
+                    from html import escape as _esc
+                    self.css_tags.append(
+                        "<link " + " ".join(
+                            f'{k}="{_esc(v, quote=True)}"'
+                            for k, v in a.items()) + " />")
                 elif "alternate" in rel and a.get("hreflang"):
                     self.hreflangs.append((a["hreflang"].lower(),
                                            urljoin(self._base, href)))
@@ -333,6 +341,7 @@ def parse_html(url: str, content: bytes,
     # navigation_*, opengraph_*, refresh_s, flash_b)
     doc.tag_texts = scraper.tag_texts
     doc.css = scraper.css
+    doc.css_tags = scraper.css_tags
     doc.scripts = scraper.scripts
     doc.script_count = scraper.script_count
     doc.iframes = scraper.iframes
@@ -344,6 +353,9 @@ def parse_html(url: str, content: bytes,
     doc.opengraph = {k[3:]: v for k, v in scraper.meta.items()
                      if k.startswith("og:")}
     doc.publisher_url = scraper.meta.get("og:url", "")
+    # page-technology evaluation (ext_* schema family)
+    from ..evaluation import evaluate_page
+    doc.evaluation = evaluate_page(html, title)
     # RDFa triples (reference parser/rdfa feeding the lod triple store);
     # the second scan only runs when the first pass saw REAL RDFa (og:
     # meta tags alone are already captured in doc.opengraph)

@@ -30,6 +30,25 @@ def exact_signature(text: str) -> int:
     return _h63(_WS_RE.sub(" ", text).strip().lower())
 
 
+def fuzzy_profile_text(text: str, quant_rate: float = 0.01,
+                       min_token_len: int = 2) -> str:
+    """The dominant-vocabulary profile string the fuzzy signature hashes
+    (stored as CollectionSchema.fuzzy_signature_text_t so operators can
+    inspect WHY two documents grouped as near-duplicates)."""
+    counts: dict[str, int] = {}
+    for w in _WORD_RE.findall(text.lower()):
+        if len(w) >= min_token_len:
+            counts[w] = counts.get(w, 0) + 1
+    if not counts:
+        return ""
+    max_freq = max(counts.values())
+    quant = max(1, round(max_freq * quant_rate)) if max_freq > 1 else 1
+    profile = sorted(
+        (w for w, c in counts.items() if (c // quant) > 0),
+        key=lambda w: (-(counts[w] // quant), w))[:64]
+    return " ".join(f"{w}:{counts[w] // quant}" for w in profile)
+
+
 def fuzzy_signature(text: str, quant_rate: float = 0.01,
                     min_token_len: int = 2) -> int:
     """Hash of the dominant vocabulary: words are counted, counts are
@@ -37,15 +56,4 @@ def fuzzy_signature(text: str, quant_rate: float = 0.01,
     the top quantized frequency form the profile. Layout/boilerplate
     differences that keep the same dominant words collide — which is the
     point."""
-    counts: dict[str, int] = {}
-    for w in _WORD_RE.findall(text.lower()):
-        if len(w) >= min_token_len:
-            counts[w] = counts.get(w, 0) + 1
-    if not counts:
-        return _h63("")
-    max_freq = max(counts.values())
-    quant = max(1, round(max_freq * quant_rate)) if max_freq > 1 else 1
-    profile = sorted(
-        (w for w, c in counts.items() if (c // quant) > 0),
-        key=lambda w: (-(counts[w] // quant), w))[:64]
-    return _h63(" ".join(f"{w}:{counts[w] // quant}" for w in profile))
+    return _h63(fuzzy_profile_text(text, quant_rate, min_token_len))

@@ -45,6 +45,49 @@ def _pad(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename/create inside it is durable — an
+    os.replace alone orders nothing on power loss; the store-everything
+    contract (reference IndexCell.java:115) needs the direntry on disk."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def purge_stale_journals(data_dir: str, prefix: str, keep: str) -> None:
+    """Delete `<prefix>.jsonl` / `<prefix>.NNNNNN.jsonl` journal
+    generations the manifest no longer references (shared by the
+    metadata and webgraph stores — the generation-name pattern must
+    never diverge between them)."""
+    import re
+    pat = re.compile(rf"^{re.escape(prefix)}(\.\d{{6}})?\.jsonl$")
+    try:
+        for name in os.listdir(data_dir):
+            if pat.match(name) and name != keep:
+                try:
+                    os.remove(os.path.join(data_dir, name))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
+def write_durable(path: str, data: bytes | str,
+                  encoding: str | None = None) -> None:
+    """tmp + fsync + rename + dir-fsync in one place: the crash-ordering
+    idiom every manifest/state file in the index uses."""
+    tmp = path + ".tmp"
+    mode = "wb" if encoding is None else "w"
+    with open(tmp, mode, encoding=encoding) as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
+
+
 def write_segment(path: str, n: int,
                   arrays: dict[str, np.ndarray],
                   texts: dict[str, list[str]],
@@ -98,7 +141,13 @@ def write_segment(path: str, n: int,
             f.write(b"\0" * pad)
         for b in blobs:
             f.write(b)
+        # durability before visibility: rename must never publish a
+        # segment whose pages are still only in the page cache (power
+        # loss would leave a zero-length or torn file behind the name)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path) or ".")
 
 
 class SegmentReader:
@@ -155,6 +204,18 @@ class SegmentReader:
         if lo == hi:
             return ""
         return bytes(blob[lo:hi]).decode("utf-8", "replace")
+
+    def texts_at(self, name: str, rows: np.ndarray) -> list[str]:
+        """Batched text reads: ONE fancy-indexed offsets lookup instead
+        of per-row python (the navigator/drain hot path reads several
+        fields x ~80 candidates per query)."""
+        offsets, blob = self._text_maps(name)
+        rows = np.asarray(rows, np.int64)
+        lo = np.asarray(offsets[rows], np.int64)
+        hi = np.asarray(offsets[rows + 1], np.int64)
+        return [("" if a == b else
+                 bytes(blob[a:b]).decode("utf-8", "replace"))
+                for a, b in zip(lo.tolist(), hi.tolist())]
 
     def text_column(self, name: str) -> list[str]:
         """Materialize a whole text column (compaction path)."""

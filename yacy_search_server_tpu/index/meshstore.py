@@ -600,12 +600,18 @@ class MeshSegmentStore:
     MAX_JOIN_TERMS = 6
 
     def _jfn(self, kk: int, n_inc: int, n_exc: int, r: int,
-             inc_ms: tuple, exc_ms: tuple):
-        key = (kk, n_inc, n_exc, r, inc_ms, exc_ms)
+             inc_ms: tuple, exc_ms: tuple, cross_row: bool = False):
+        """cross_row=False: all terms share a term row (column-local
+        join); True: the kernel exchanges the rare row's candidates
+        along the term axis (VERDICT r3 #3). The rare ROW rides in
+        qargs as a traced scalar, so one compile serves every row."""
+        key = (kk, n_inc, n_exc, r, inc_ms, exc_ms, cross_row)
         if key not in self._jfns:
+            body = (partial(_mesh_xjoin_shard if cross_row
+                            else _mesh_join_shard, k=kk, n_inc=n_inc,
+                            n_exc=n_exc, r=r, inc_ms=inc_ms, exc_ms=exc_ms))
             self._jfns[key] = jax.jit(jax.shard_map(
-                partial(_mesh_join_shard, k=kk, n_inc=n_inc, n_exc=n_exc,
-                        r=r, inc_ms=inc_ms, exc_ms=exc_ms),
+                body,
                 mesh=self.mesh,
                 in_specs=(PS(("term", "doc"), None, None),   # feats16
                           PS(("term", "doc"), None),         # flags
@@ -627,16 +633,20 @@ class MeshSegmentStore:
         """Multi-term conjunctive ranked top-k as one SPMD program.
 
         The vertical-partition invariant (one docid → one doc column for
-        EVERY term) makes the conjunction COLUMN-LOCAL: each device
-        membership-tests its slice of the rarest term's span against the
-        partner terms' column-local docid-sorted side tables, merges
-        features with the host join's semantics, and the per-column
-        survivors fuse by all_gather + global top-k. Terms on different
-        TERM rows cannot join device-side (their postings live on
-        different cells) — that is the reference's own cross-ring
-        boundary, where joins ship candidate lists between peers; such
-        queries fall back to the host join, as do terms with multiple
-        spans or an unflushed RAM delta."""
+        EVERY term) makes the conjunction at worst COLUMN-LOCAL: a
+        partner term on the SAME term row joins against column-local
+        docid-sorted side tables directly; a partner on a DIFFERENT term
+        row joins by a collective exchange WITHIN the doc column — the
+        rare row's candidate docids broadcast along the term axis
+        (all_gather), every row membership-tests them against its local
+        tables, and the owning row's per-candidate features reduce back
+        (psum/pmin/pmax with neutral fills). This is the mesh-native
+        version of the reference's cross-ring join-gap protocol, where
+        peers ship candidate doc lists to each other
+        (SecondarySearchSuperviser.java:198, Distribution.java:47-62) —
+        here the shipment is ~20 bytes/candidate over ICI instead of an
+        HTTP round trip (VERDICT r3 #3). Host fallback remains only for
+        multi-span terms and unflushed RAM deltas."""
         include_hashes = list(include_hashes)
         exclude_hashes = list(exclude_hashes or [])
         if not include_hashes \
@@ -668,9 +678,6 @@ class MeshSegmentStore:
                 if spans:
                     rows.add(term_shard(th, self.n_term))
                     exc_spans.append(spans[0])
-            if len(rows) > 1:      # cross-row join: host fallback
-                self.fallbacks += 1
-                return None
             arrays = self._device_arrays()
             jdocids, jpos = self._dev_join
             dead = self._dead_array()
@@ -721,7 +728,17 @@ class MeshSegmentStore:
 
         consts = self._profile_consts(profile, language)
         kk = max(16, 1 << (max(k, 1) - 1).bit_length())
-        out = self._jfn(kk, n_inc, n_exc, r, inc_ms, exc_ms)(
+        # cross-row conjunction: the kernel exchanges candidates along
+        # the term axis, anchored at the rare term's row (VERDICT r3 #3);
+        # the row is a TRACED qargs scalar (no per-row compile)
+        cross_row = len(rows) > 1
+        if cross_row:
+            qargs = np.concatenate(
+                [qargs, np.full((self.n_cells, 1),
+                                term_shard(include_hashes[rare_i],
+                                           self.n_term), np.int32)], axis=1)
+        out = self._jfn(kk, n_inc, n_exc, r, inc_ms, exc_ms,
+                        cross_row=cross_row)(
             *arrays, jdocids, jpos, dead, qargs, *consts)
         s, d = jax.device_get(out)
         keep = (d >= 0) & (s > NEG_INF32)
@@ -747,7 +764,6 @@ def _mesh_join_shard(feats16, flags, docids, jdocids, jpos, dead, qargs,
     jdocids = jdocids[0]
     jpos = jpos[0]
     q = qargs[0]
-    axes = ("term", "doc")
     start, count = q[0], q[1]
     lang_filter, flag_bit = q[2], q[3]
     from_days, to_days = q[4], q[5]
@@ -779,16 +795,30 @@ def _mesh_join_shard(feats16, flags, docids, jdocids, jpos, dead, qargs,
                                           dd, v, cnt)
         v &= ~found
 
+    return _join_score_gather(
+        f, pos_min, pos_max, hit_min, flags_or, v, dd,
+        lang_filter, flag_bit, from_days, to_days,
+        norm_coeffs, flag_bits, flag_shifts, domlength_coeff, tf_coeff,
+        language_coeff, authority_coeff, language_pref, k=k, r=r)
+
+
+def _join_score_gather(f, pos_min, pos_max, hit_min, flags_or, v, dd,
+                       lang_filter, flag_bit, from_days, to_days,
+                       norm_coeffs, flag_bits, flag_shifts,
+                       domlength_coeff, tf_coeff, language_coeff,
+                       authority_coeff, language_pref, *, k: int, r: int):
+    """Shared join epilogue (column-local AND cross-row kernels): merge
+    features with the host join's semantics, mesh-wide stats bounds
+    (ReferenceOrder.normalizeWith — one global min/max over ALL
+    survivors), score, and fuse per-device top-k by all_gather + global
+    top-k. One body so the two join paths can never diverge."""
+    axes = ("term", "doc")
     merged = f.at[:, P.F_WORDDISTANCE].set(pos_max - pos_min)
     merged = merged.at[:, P.F_HITCOUNT].set(hit_min)
     v &= _constraint_valid(merged, flags_or, lang_filter, flag_bit,
                            from_days, to_days)
-
     stats = local_stats(merged, v, jnp.zeros(r, jnp.int32),
                         num_hosts=1, with_host_counts=False)
-    # normalization bounds over ALL survivors, mesh-wide — one global
-    # min/max exactly like the single-device join's local_stats over the
-    # whole rare span (ReferenceOrder.normalizeWith)
     stats = {"col_min": lax.pmin(stats["col_min"], axes),
              "col_max": lax.pmax(stats["col_max"], axes),
              "tf_min": lax.pmin(stats["tf_min"], axes),
@@ -804,6 +834,99 @@ def _mesh_join_shard(feats16, flags, docids, jdocids, jpos, dead, qargs,
     gd = lax.all_gather(dd[idx], axes, tiled=True)
     out_s, out_i = lax.top_k(gs, min(k, gs.shape[0]))
     return out_s, gd[out_i]
+
+
+def _mesh_xjoin_shard(feats16, flags, docids, jdocids, jpos, dead, qargs,
+                      norm_coeffs, flag_bits, flag_shifts,
+                      domlength_coeff, tf_coeff, language_coeff,
+                      authority_coeff, language_pref,
+                      *, k: int, n_inc: int, n_exc: int, r: int,
+                      inc_ms: tuple, exc_ms: tuple):
+    """Per-device body of the CROSS-ROW conjunction (VERDICT r3 #3).
+
+    Terms on different term rows share doc columns (docid % n_doc is
+    term-independent), so the join becomes a term-axis exchange inside
+    each column — the TPU-native form of the reference's cross-ring
+    candidate shipment (SecondarySearchSuperviser.java:198):
+
+    1. the rare row broadcasts its candidate docids + validity along
+       the term axis (all_gather, ~5 B/candidate); the rare row index
+       is a TRACED qargs scalar, so one compile serves every row;
+    2. EVERY row membership-tests the candidates against its local
+       column join tables — non-owner cells carry count-0 windows, so
+       exactly one row per partner term finds anything;
+    3. the owner's per-candidate partner features flow back as neutral-
+       filled reductions (pmin/pmax for positions, pmin for hitcount,
+       psum for membership and flags — one nonzero contributor each,
+       ~16 B/candidate);
+    4. only the rare row scores (axis_index mask), so the global
+       all_gather top-k sees each surviving docid exactly once.
+    """
+    from .devstore import _membership_sorted
+    feats16 = feats16[0]
+    flags = flags[0]
+    docids = docids[0]
+    jdocids = jdocids[0]
+    jpos = jpos[0]
+    q = qargs[0]
+    start, count = q[0], q[1]
+    lang_filter, flag_bit = q[2], q[3]
+    from_days, to_days = q[4], q[5]
+    base = 6
+    row_rare = q[base + 2 * (n_inc + n_exc)]
+    f = lax.dynamic_slice(feats16, (start, 0), (r, P.NF)).astype(jnp.int32)
+    fl = lax.dynamic_slice(flags, (start,), (r,))
+    dd = lax.dynamic_slice(docids, (start,), (r,))
+    v = _tile_valid(dd, dead, jnp.arange(r) < count)
+
+    # (1) candidates ride the term axis: every row of this doc column
+    # sees the rare row's docids (non-rare rows hold count-0 slices)
+    gdd = lax.dynamic_index_in_dim(lax.all_gather(dd, "term"), row_rare,
+                                   0, keepdims=False)
+    gv = lax.dynamic_index_in_dim(lax.all_gather(v, "term"), row_rare,
+                                  0, keepdims=False)
+
+    big = jnp.int32(INT32_MAX)
+    pos_min = f[:, P.F_POSINTEXT]
+    pos_max = f[:, P.F_POSINTEXT]
+    hit_min = f[:, P.F_HITCOUNT]
+    flags_or = fl
+    for t in range(n_inc):
+        lo = q[base + t]
+        cnt = q[base + n_inc + t]
+        # (2) local membership — count-0 windows on non-owner rows
+        found, prow = _membership_sorted(jdocids, jpos, lo, inc_ms[t],
+                                         gdd, gv, cnt)
+        pf = feats16[prow].astype(jnp.int32)
+        # (3) owner-row contributions reduce along the term axis
+        hit = lax.psum(found.astype(jnp.int32), "term")
+        p_min = lax.pmin(jnp.where(found, pf[:, P.F_POSINTEXT], big),
+                         "term")
+        p_max = lax.pmax(jnp.where(found, pf[:, P.F_POSINTEXT], -big),
+                         "term")
+        h_min = lax.pmin(jnp.where(found, pf[:, P.F_HITCOUNT], big),
+                         "term")
+        fl_p = lax.psum(jnp.where(found, flags[prow], 0), "term")
+        gv &= hit > 0
+        pos_min = jnp.minimum(pos_min, p_min)
+        pos_max = jnp.maximum(pos_max, p_max)
+        hit_min = jnp.minimum(hit_min, h_min)
+        flags_or = flags_or | fl_p
+    for e in range(n_exc):
+        lo = q[base + 2 * n_inc + e]
+        cnt = q[base + 2 * n_inc + n_exc + e]
+        found, _prow = _membership_sorted(jdocids, jpos, lo, exc_ms[e],
+                                          gdd, gv, cnt)
+        gv &= lax.psum(found.astype(jnp.int32), "term") == 0
+
+    # (4) only the rare row's cells score — its f/fl are the real rare
+    # features, and uniqueness keeps the gathered top-k duplicate-free
+    gv &= lax.axis_index("term") == row_rare
+    return _join_score_gather(
+        f, pos_min, pos_max, hit_min, flags_or, gv, gdd,
+        lang_filter, flag_bit, from_days, to_days,
+        norm_coeffs, flag_bits, flag_shifts, domlength_coeff, tf_coeff,
+        language_coeff, authority_coeff, language_pref, k=k, r=r)
 
 
 def _mesh_pruned_shard(feats16, flags, docids, dead, pmax, qargs,

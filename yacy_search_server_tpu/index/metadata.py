@@ -46,7 +46,8 @@ import time
 import numpy as np
 
 from ..utils.hashes import dom_length_normalized, hosthash, url_comps
-from .colstore import SegmentReader, write_segment
+from .colstore import (SegmentReader, purge_stale_journals,
+                       write_segment)
 
 # Load-bearing schema fields (name -> default), subset of CollectionSchema.
 # Text-like fields live in python lists; numeric ranking signals get numpy
@@ -146,6 +147,26 @@ TEXT_FIELDS = (
     "url_file_name_tokens_t",
     "url_parameter_key_sxt",
     "url_parameter_value_sxt",
+    # -- structure occurrence counts (positional ints over the deduped
+    #    *_txt lists — CollectionSchema bold_val/italic_val/underline_val)
+    "bold_val",
+    "italic_val",
+    "underline_val",
+    # -- raw stylesheet link tags (css_tag_sxt; css_url_sxt has the urls)
+    "css_tag_sxt",
+    # -- near-duplicate grouping evidence (fuzzy_signature_text_t)
+    "fuzzy_signature_text_t",
+    # -- names of vocabularies that matched this doc (vocabularies_sxt;
+    #    vocabulary_sxt carries the matched "voc:tag" pairs)
+    "vocabularies_sxt",
+    # -- page-technology evaluation (document/evaluation.py; each
+    #    category stores detected names + positional match counts)
+    "ext_ads_txt", "ext_ads_val",
+    "ext_cms_txt", "ext_cms_val",
+    "ext_community_txt", "ext_community_val",
+    "ext_maps_txt", "ext_maps_val",
+    "ext_title_txt", "ext_title_val",
+    "ext_tracker_txt", "ext_tracker_val",
 )
 INT_FIELDS = (
     "size_i",          # byte size
@@ -224,8 +245,11 @@ INT_FIELDS = (
     "host_extent_i",           # docs this host contributes to the index
     # -- citation-rank bookkeeping + misc
     "cr_host_count_i",
+    "cr_host_norm_i",      # integer citation-rank partition (0..9)
     "rating_i",
     "schema_org_breadcrumb_i",
+    # -- content freshness date (day granularity, like the other dates)
+    "fresh_date_days_i",
 )
 DOUBLE_FIELDS = (
     "lat_d",
@@ -233,6 +257,29 @@ DOUBLE_FIELDS = (
     "cr_host_norm_d",      # citation rank (postprocessing)
     "cr_host_chance_d",    # citation-rank transition probability
 )
+
+# Reference schema names whose CONTENT this store carries under a
+# different representation (checklist closure against
+# CollectionSchema.java:34 — these are API aliases, not absent fields):
+# readers resolve them through LazyRow.get / schema surfaces, writers use
+# the canonical column.
+FIELD_ALIASES = {
+    "id": "urlhash",                      # docid IS the urlhash alias
+    "last_modified": "last_modified_days_i",   # ISO date -> day number
+    "load_date_dt": "load_date_days_i",
+    "fresh_date_dt": "fresh_date_days_i",
+    "coordinate_p": ("lat_d", "lon_d"),   # "lat,lon" point
+    "coordinate_p_0_coordinate": "lat_d",
+    "coordinate_p_1_coordinate": "lon_d",
+}
+
+
+def schema_field_names() -> list[str]:
+    """Every reference-schema-visible field name this store serves
+    (columns + representation aliases) — the parity surface
+    tests/test_schema_longtail.py checks against CollectionSchema."""
+    return sorted(set(TEXT_FIELDS) | set(INT_FIELDS) | set(DOUBLE_FIELDS)
+                  | set(FIELD_ALIASES))
 
 
 def join_multi(values) -> str:
@@ -290,6 +337,13 @@ class LazyRow:
             return s._get_int(d, k)
         if k in s._doubles:
             return s._get_double(d, k)
+        alias = FIELD_ALIASES.get(k)
+        if alias == "urlhash":
+            return (self.urlhash or b"").decode("ascii", "replace")
+        if alias == ("lat_d", "lon_d"):
+            return f"{s._get_double(d, 'lat_d')},{s._get_double(d, 'lon_d')}"
+        if alias is not None:
+            return self.get(alias, default)
         return default
 
 
@@ -330,6 +384,7 @@ class MetadataStore:
         self._facet_removed: dict[str, set[int]] = {
             f: set() for f in FACET_FIELDS}
         self._journal = None
+        self._journal_name = "metadata.jsonl"   # active journal generation
         # monotonically increasing file-name sequence (persisted in the
         # manifest): merged and snapshot segments must never reuse a live
         # file name
@@ -368,8 +423,16 @@ class MetadataStore:
                         fld: {int(k): v for k, v in d.items()}
                         for fld, d in json.load(f).items()}
                 self._rebuild_override_facets()
+            # ONLY the manifest's journal generation replays: rows in any
+            # other generation are frozen in a segment already (a crash
+            # between manifest switch and old-journal delete must not
+            # re-put them as duplicate docids — ADVICE r3)
+            self._journal_name = m.get("journal", "metadata.jsonl")
+            jp = self._path(self._journal_name)
             if os.path.exists(jp):
                 self._replay(jp)
+            purge_stale_journals(self.data_dir, "metadata",
+                                 self._journal_name)
         elif os.path.exists(jp):
             # legacy round-2 format: the jsonl IS the whole store.
             # Replay once and convert to the segmented format.
@@ -585,6 +648,63 @@ class MetadataStore:
         """Single text column read — the query-path accessor (no full-row
         DocumentMetadata materialization)."""
         return self._get_text(docid, field)
+
+    def _group_by_segment(self, docids):
+        """(out_template, tail/override positions resolved, seg->positions)
+        shared by the batched column readers."""
+        import bisect
+        seg_groups: dict[int, list[int]] = {}
+        direct: list[int] = []          # positions answered per-row
+        for pos, d in enumerate(docids):
+            if d >= self._frozen_n:
+                direct.append(pos)
+            else:
+                i = bisect.bisect_right(self._seg_bases, d) - 1
+                seg_groups.setdefault(i, []).append(pos)
+        return direct, seg_groups
+
+    def text_values(self, docids, field: str) -> list[str]:
+        """Batched text reads for the drain/navigator hot path: one
+        vectorized offsets lookup per SEGMENT instead of per-row python
+        (~7 fields x 80 candidates per query on the serving path)."""
+        docids = list(docids)
+        out = [""] * len(docids)
+        ov = self._overrides.get(field)
+        direct, seg_groups = self._group_by_segment(docids)
+        for pos in direct:
+            out[pos] = self._get_text(docids[pos], field)
+        for i, poss in seg_groups.items():
+            seg, base = self._segs[i], self._seg_bases[i]
+            if seg.has_text(field):
+                rows = np.asarray([docids[p] - base for p in poss])
+                for p, v in zip(poss, seg.texts_at(field, rows)):
+                    out[p] = v
+        if ov:
+            for pos, d in enumerate(docids):
+                if d in ov:
+                    out[pos] = ov[d]
+        return out
+
+    def int_values(self, docids, field: str) -> list[int]:
+        """Batched int reads (see text_values)."""
+        docids = list(docids)
+        out = [0] * len(docids)
+        ov = self._overrides.get(field)
+        direct, seg_groups = self._group_by_segment(docids)
+        for pos in direct:
+            out[pos] = self._get_int(docids[pos], field)
+        for i, poss in seg_groups.items():
+            seg, base = self._segs[i], self._seg_bases[i]
+            if seg.has_array(field):
+                col = seg.array(field)
+                rows = np.asarray([docids[p] - base for p in poss])
+                for p, v in zip(poss, col[rows].tolist()):
+                    out[p] = int(v)
+        if ov:
+            for pos, d in enumerate(docids):
+                if d in ov:
+                    out[pos] = int(ov[d])
+        return out
 
     def docid(self, urlhash: bytes) -> int | None:
         with self._lock:
@@ -920,35 +1040,54 @@ class MetadataStore:
         self._pending_remove += [old_a, old_b]
 
     def _persist_state(self) -> None:
-        np.save(self._path("metadata.deleted.npy.tmp.npy"),
-                np.fromiter(self._deleted, np.int64, len(self._deleted)))
-        os.replace(self._path("metadata.deleted.npy.tmp.npy"),
-                   self._path("metadata.deleted.npy"))
-        tmp = self._path("metadata.overrides.json.tmp")
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({fld: {str(k): v for k, v in d.items()}
-                       for fld, d in self._overrides.items() if d}, f)
-        os.replace(tmp, self._path("metadata.overrides.json"))
-        tmp = self._path("metadata.manifest.json.tmp")
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"segments": [os.path.basename(s.path)
-                                    for s in self._segs],
-                       "seq": self._seg_seq,
-                       "deleted": "metadata.deleted.npy",
-                       "overrides": "metadata.overrides.json"}, f)
-        os.replace(tmp, self._path("metadata.manifest.json"))
-        # now — and only now — superseded segment files can go
+        import io
+
+        from .colstore import write_durable
+        buf = io.BytesIO()
+        np.save(buf, np.fromiter(self._deleted, np.int64,
+                                 len(self._deleted)))
+        write_durable(self._path("metadata.deleted.npy"), buf.getvalue())
+        write_durable(
+            self._path("metadata.overrides.json"),
+            json.dumps({fld: {str(k): v for k, v in d.items()}
+                        for fld, d in self._overrides.items() if d}),
+            encoding="utf-8")
+        # journal truncation commits ATOMICALLY with the manifest switch
+        # (ADVICE r3): a fresh journal GENERATION is created and named in
+        # the manifest. A crash leaves either (old manifest + old
+        # journal: tail replays, new segment file is an unreferenced
+        # orphan that the next snapshot overwrites) or (new manifest +
+        # empty new journal: tail is frozen, the stale old generation is
+        # purged at open) — never a manifest whose frozen rows replay.
+        old_name = self._journal_name
+        self._journal_name = f"metadata.{self._seg_seq:06d}.jsonl"
+        self._seg_seq += 1
+        new_j = open(self._path(self._journal_name), "w", encoding="utf-8")
+        os.fsync(new_j.fileno())
+        write_durable(
+            self._path("metadata.manifest.json"),
+            json.dumps({"segments": [os.path.basename(s.path)
+                                     for s in self._segs],
+                        "seq": self._seg_seq,
+                        "journal": self._journal_name,
+                        "deleted": "metadata.deleted.npy",
+                        "overrides": "metadata.overrides.json"}),
+            encoding="utf-8")
+        # now — and only now — superseded files can go
         for p in self._pending_remove:
             try:
                 os.remove(p)
             except OSError:
                 pass
         self._pending_remove = []
-        # the journal now only needs to carry post-snapshot writes
         if self._journal:
             self._journal.close()
-        self._journal = open(self._path("metadata.jsonl"), "w",
-                             encoding="utf-8")
+        self._journal = new_j
+        if old_name != self._journal_name:
+            try:
+                os.remove(self._path(old_name))
+            except OSError:
+                pass
 
     # -- journal -------------------------------------------------------------
 
@@ -963,38 +1102,59 @@ class MetadataStore:
 
     def _replay(self, path: str) -> None:
         with open(path, "r", encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
                 rec = json.loads(line)
-                if "_del" in rec:
-                    d = self.docid(rec["_del"].encode())
-                    if d is not None:
-                        self._deleted.add(d)
+            except json.JSONDecodeError:
+                # a TORN final line is the expected kill-9 artifact (the
+                # journal fsyncs at generation boundaries, not per
+                # append) and is safe to drop. MID-FILE damage is NOT:
+                # silently skipping a put would shift every later docid
+                # off its RWI postings — refuse to open instead
+                if i == len(lines) - 1:
+                    import logging
+                    logging.getLogger("yacy.metadata").warning(
+                        "journal %s: dropped torn tail line %d",
+                        os.path.basename(path), i + 1)
                     continue
-                if "_upd" in rec:
-                    d = self.docid(rec.pop("_upd").encode())
-                    if d is not None:
-                        for field, value in rec.items():
-                            try:
-                                self.set_field(d, field, value)
-                            except KeyError:
-                                pass
-                    continue
-                urlhash = rec.pop("_id").encode()
-                unknown = [k for k in rec
-                           if k not in TEXT_FIELDS and k not in INT_FIELDS
-                           and k not in DOUBLE_FIELDS]
-                for k in unknown:
-                    rec.pop(k)
-                doc = DocumentMetadata(urlhash, **rec)
-                # inline put without re-journaling
-                journal, self._journal = self._journal, None
-                try:
-                    self.put(doc)
-                finally:
-                    self._journal = journal
+                raise ValueError(
+                    f"journal {os.path.basename(path)}: undecodable "
+                    f"record {i + 1}/{len(lines)} (mid-file damage; "
+                    "docid allocation would desynchronize)")
+            self._replay_rec(rec)
+
+    def _replay_rec(self, rec: dict) -> None:
+        if "_del" in rec:
+            d = self.docid(rec["_del"].encode())
+            if d is not None:
+                self._deleted.add(d)
+            return
+        if "_upd" in rec:
+            d = self.docid(rec.pop("_upd").encode())
+            if d is not None:
+                for field, value in rec.items():
+                    try:
+                        self.set_field(d, field, value)
+                    except KeyError:
+                        pass
+            return
+        urlhash = rec.pop("_id").encode()
+        unknown = [k for k in rec
+                   if k not in TEXT_FIELDS and k not in INT_FIELDS
+                   and k not in DOUBLE_FIELDS]
+        for k in unknown:
+            rec.pop(k)
+        doc = DocumentMetadata(urlhash, **rec)
+        # inline put without re-journaling
+        journal, self._journal = self._journal, None
+        try:
+            self.put(doc)
+        finally:
+            self._journal = journal
 
     def close(self) -> None:
         with self._lock:

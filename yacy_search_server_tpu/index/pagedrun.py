@@ -131,13 +131,21 @@ class PagedRun:
             for th in order:
                 f.write(np.ascontiguousarray(
                     terms[th].feats, dtype="<i4").tobytes())
+            f.flush()
+            os.fsync(f.fileno())
         with open(tmp_tix, "w", encoding="ascii") as f:
             f.write(f"{_MAGIC} {total} {dead_seq}\n")
             for th in order:
                 s, c = index[th]
                 f.write(f"{th.decode('ascii')} {s} {c}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        # data file lands before the index that references it; the dir
+        # fsync makes both renames durable (colstore.fsync_dir)
         os.replace(tmp_dat, path)
         os.replace(tmp_tix, _tix_path(path))
+        from .colstore import fsync_dir
+        fsync_dir(os.path.dirname(path) or ".")
         return PagedRun(path, index, total, cache, dead_seq)
 
     @staticmethod

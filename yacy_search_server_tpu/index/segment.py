@@ -169,6 +169,10 @@ class Segment:
                     self.citations.reference_counts(urlhash))),
                 lat_d=doc.lat, lon_d=doc.lon,
                 vocabulary_sxt=vocab_sxt,
+                vocabularies_sxt=",".join(
+                    sorted({v.split(":", 1)[0]
+                            for v in vocab_sxt.split(",") if v})),
+                fresh_date_days_i=doc.publish_date_days,
                 synonyms_sxt=",".join(
                     getattr(condenser, "synonym_terms", [])),
                 referrer_id_s=(referrer_urlhash or b"").decode("ascii",
@@ -205,7 +209,9 @@ class Segment:
                 self.webgraph.add_document_edges(
                     docid, doc.url, doc.anchors, crawldepth=crawldepth,
                     collection=collection,
-                    load_date_days=meta.get("load_date_days_i", 0))
+                    load_date_days=meta.get("load_date_days_i", 0),
+                    last_modified_days=meta.get("last_modified_days_i", 0),
+                    host_ranks=getattr(self, "_host_ranks", None))
 
                 # RWI block append; the catchall term gets the neutral
                 # doc-level row (not any word's flags/positions)
@@ -399,7 +405,8 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
     from urllib.parse import parse_qsl
 
     from ..document.datedetection import (dates_as_iso, dates_in_content)
-    from ..document.signature import exact_signature, fuzzy_signature
+    from ..document.signature import (exact_signature, fuzzy_profile_text,
+                                      fuzzy_signature)
     from ..utils.hashes import (_split, _split_host, host_dnc, hosthash,
                                 normalform)
     from .metadata import join_multi, join_multi_positional
@@ -514,6 +521,7 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
         canonical_equal_sku_b=canonical_equal,
         exact_signature_l=exact_signature(doc.text),
         fuzzy_signature_l=fuzzy_signature(doc.text),
+        fuzzy_signature_text_t=fuzzy_profile_text(doc.text),
         # optimistic until postprocess_uniqueness() recomputes them
         # (index/postprocess.py) — a fresh doc is unique until proven not
         title_unique_b=1, description_unique_b=1,
@@ -550,13 +558,14 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
         ddcount_i=len(doc.tag_texts.get("dd", [])),
         article_txt=join_multi(doc.tag_texts.get("article", [])),
         articlecount_i=len(doc.tag_texts.get("article", [])),
-        bold_txt=join_multi(doc.tag_texts.get("bold", [])),
+        # emphasis zones: unique texts + positional occurrence counts
+        # (CollectionSchema bold_txt/bold_val pairing)
+        **_emph_fields(doc.tag_texts),
         boldcount_i=len(doc.tag_texts.get("bold", [])),
-        italic_txt=join_multi(doc.tag_texts.get("italic", [])),
         italiccount_i=len(doc.tag_texts.get("italic", [])),
-        underline_txt=join_multi(doc.tag_texts.get("underline", [])),
         underlinecount_i=len(doc.tag_texts.get("underline", [])),
         css_url_sxt=join_multi(doc.css),
+        css_tag_sxt=join_multi(getattr(doc, "css_tags", [])),
         csscount_i=len(doc.css),
         scripts_sxt=join_multi(doc.scripts),
         scriptscount_i=doc.script_count,
@@ -585,8 +594,38 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
             k for k, _v in qsl),
         url_parameter_value_sxt=join_multi_positional(
             v for _k, v in qsl),
+        # page-technology evaluation (document/evaluation.py)
+        **_evaluation_fields(getattr(doc, "evaluation", None)),
         **h_fields,
     )
+
+
+def _emph_fields(tag_texts: dict) -> dict:
+    """bold/italic/underline: unique texts (first-seen order) + their
+    positional occurrence counts (bold_txt + bold_val etc.)."""
+    from .metadata import join_multi, join_multi_positional
+    out: dict = {}
+    for tag in ("bold", "italic", "underline"):
+        counts: dict[str, int] = {}
+        for t in tag_texts.get(tag, []):
+            counts[t] = counts.get(t, 0) + 1
+        out[f"{tag}_txt"] = join_multi(counts)
+        out[f"{tag}_val"] = join_multi_positional(
+            str(c) for t, c in counts.items() if t)
+    return out
+
+
+def _evaluation_fields(ev) -> dict:
+    """ext_<category>_txt / _val pairs from the page evaluation."""
+    if not ev:
+        return {}
+    from .metadata import join_multi_positional
+    out = {}
+    for cat, (names, counts) in ev.items():
+        out[f"ext_{cat}_txt"] = join_multi_positional(names)
+        out[f"ext_{cat}_val"] = join_multi_positional(
+            str(c) for c in counts)
+    return out
 
 
 def _md5_hex(text: str) -> str:

@@ -41,7 +41,8 @@ from collections import defaultdict
 import numpy as np
 
 from ..utils.hashes import _split, safe_host, url2hash, url_file_ext
-from .colstore import SegmentReader, write_segment
+from .colstore import (SegmentReader, purge_stale_journals,
+                       write_segment)
 
 # rel attribute coding (reference: WebgraphConfiguration.relEval:291 —
 # "me"=1, "nofollow"=2; we extend with the other machine-meaningful rels)
@@ -103,6 +104,10 @@ TEXT_COLS = (
     "target_host_organizationdnc_s",
     "target_parameter_key_sxt",
     "target_parameter_value_sxt",
+    "source_parameter_key_sxt",
+    "source_parameter_value_sxt",
+    "source_host_id_s",        # 6-char host hash of the source host
+    "target_host_id_s",
     "process_sxt",
     "harvestkey_s",
 )
@@ -121,9 +126,28 @@ INT_COLS = (
     "source_path_folders_count_i",
     "target_path_folders_count_i",
     "target_parameter_count_i",
+    "source_parameter_count_i",
     "target_alt_charcount_i",
     "target_alt_wordcount_i",
+    "target_crawldepth_i",     # source depth + 1 (the link's depth)
+    "last_modified_days_i",
+    # citation-rank partitions of both endpoints, filled at WRITE time
+    # from the segment's last blockrank pass (ops/blockrank.py stores
+    # host ranks on the segment; edges written before the first pass
+    # carry 0 — the rows are immutable, like every other column here)
+    "source_cr_host_norm_i",
+    "target_cr_host_norm_i",
 )
+
+# reference names carried under a different representation
+# (WebgraphSchema.java checklist closure; same contract as
+# metadata.FIELD_ALIASES): `id` is the internal edge row id,
+# load_date_dt/last_modified are day-granular int columns
+FIELD_ALIASES = {
+    "id": "edge_row",
+    "load_date_dt": "load_date_days_i",
+    "last_modified": "last_modified_days_i",
+}
 
 MAX_SEGMENTS = 16
 
@@ -151,6 +175,7 @@ class WebgraphStore:
         # manifest no longer references them)
         self._pending_remove: list[str] = []
         self._journal = None
+        self._journal_name = "webgraph.jsonl"   # active journal generation
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._open_disk()
@@ -173,8 +198,15 @@ class WebgraphStore:
             dp = self._path("webgraph.deleted.npy")
             if os.path.exists(dp):
                 self._dead = set(np.load(dp).tolist())
+            # only the manifest's journal generation replays — rows in
+            # any other generation are frozen already (ADVICE r3; same
+            # crash ordering as MetadataStore._persist_state)
+            self._journal_name = m.get("journal", "webgraph.jsonl")
+            jp = self._path(self._journal_name)
             if os.path.exists(jp):
                 self._replay(jp)
+            purge_stale_journals(self.data_dir, "webgraph",
+                                 self._journal_name)
             self._journal = open(jp, "a", encoding="utf-8")
         elif os.path.exists(jp):
             # legacy round-2 format: the jsonl IS the whole store
@@ -186,9 +218,18 @@ class WebgraphStore:
 
     # -- write path ----------------------------------------------------------
 
+    @staticmethod
+    def _hosthash_of(hosthash_fn, url: str) -> str:
+        try:
+            return hosthash_fn(url2hash(url)).decode("ascii", "replace")
+        except Exception:
+            return ""
+
     def add_document_edges(self, source_docid: int, source_url: str,
                            anchors, crawldepth: int = 0,
                            collection: str = "", load_date_days: int = 0,
+                           last_modified_days: int = 0,
+                           host_ranks: dict | None = None,
                            journal: bool = True) -> int:
         """Record one indexed document's outbound hyperlinks; returns the
         number of edges written (WebgraphConfiguration.getEdges parity:
@@ -197,14 +238,18 @@ class WebgraphStore:
         # scraped hrefs must never crash indexing) where raw urlsplit raises
         from urllib.parse import parse_qsl
 
-        from ..utils.hashes import _split_host, host_dnc, url_file_ext
+        from ..utils.hashes import (_split_host, host_dnc, hosthash,
+                                    url_file_ext)
         from .metadata import join_multi_positional
         src_host = safe_host(source_url)
-        src_path = _split(source_url)[3]
+        src_split = _split(source_url)
+        src_path = src_split[3]
+        src_query = src_split[4] if len(src_split) > 4 else ""
         try:
             src_id = url2hash(source_url).decode("ascii")
         except Exception:
             return 0
+        src_qs = parse_qsl(src_query, keep_blank_values=True)
 
         def _decomp(url, host, path):
             """Shared url/host decomposition columns (prefix applied by
@@ -261,6 +306,19 @@ class WebgraphStore:
                     k for k, _v in qs),
                 "target_parameter_value_sxt": join_multi_positional(
                     v for _k, v in qs),
+                "source_parameter_count_i": len(src_qs),
+                "source_parameter_key_sxt": join_multi_positional(
+                    k for k, _v in src_qs),
+                "source_parameter_value_sxt": join_multi_positional(
+                    v for _k, v in src_qs),
+                "source_host_id_s": self._hosthash_of(hosthash, source_url),
+                "target_host_id_s": self._hosthash_of(hosthash, target_url),
+                "target_crawldepth_i": crawldepth + 1,
+                "last_modified_days_i": last_modified_days,
+                "source_cr_host_norm_i": int(round(
+                    (host_ranks or {}).get(src_host, 0.0) * 10)),
+                "target_cr_host_norm_i": int(round(
+                    (host_ranks or {}).get(tgt_host, 0.0) * 10)),
                 "target_alt_charcount_i": len(alt),
                 "target_alt_wordcount_i": len(alt.split()) if alt else 0,
                 "source_id_s": src_id,
@@ -637,16 +695,27 @@ class WebgraphStore:
         self._pending_remove += old_paths
 
     def _persist_state(self) -> None:
-        np.save(self._path("webgraph.deleted.tmp.npy"),
-                np.fromiter(self._dead, np.int64, len(self._dead)))
-        os.replace(self._path("webgraph.deleted.tmp.npy"),
-                   self._path("webgraph.deleted.npy"))
-        tmp = self._path("webgraph.manifest.json.tmp")
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump({"segments": [os.path.basename(s.path)
-                                    for s in self._segs],
-                       "seq": self._seg_seq}, f)
-        os.replace(tmp, self._path("webgraph.manifest.json"))
+        import io
+
+        from .colstore import write_durable
+        buf = io.BytesIO()
+        np.save(buf, np.fromiter(self._dead, np.int64, len(self._dead)))
+        write_durable(self._path("webgraph.deleted.npy"), buf.getvalue())
+        # journal truncation commits atomically with the manifest switch
+        # via a fresh journal generation (see MetadataStore._persist_state
+        # for the crash-window argument — ADVICE r3)
+        old_name = self._journal_name
+        self._journal_name = f"webgraph.{self._seg_seq:06d}.jsonl"
+        self._seg_seq += 1
+        new_j = open(self._path(self._journal_name), "w", encoding="utf-8")
+        os.fsync(new_j.fileno())
+        write_durable(
+            self._path("webgraph.manifest.json"),
+            json.dumps({"segments": [os.path.basename(s.path)
+                                     for s in self._segs],
+                        "seq": self._seg_seq,
+                        "journal": self._journal_name}),
+            encoding="utf-8")
         for p in self._pending_remove:
             try:
                 os.remove(p)
@@ -655,8 +724,12 @@ class WebgraphStore:
         self._pending_remove = []
         if self._journal:
             self._journal.close()
-        self._journal = open(self._path("webgraph.jsonl"), "w",
-                             encoding="utf-8")
+        self._journal = new_j
+        if old_name != self._journal_name:
+            try:
+                os.remove(self._path(old_name))
+            except OSError:
+                pass
 
     def compact(self) -> None:
         """Drop all tombstoned rows: merge every segment into one (the

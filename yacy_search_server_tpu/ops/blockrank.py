@@ -121,6 +121,10 @@ def postprocess_segment(segment, web_structure, damping: float = DAMPING,
         ranks = host_ranks(web_structure, damping)
     if not ranks:
         return 0
+    # webgraph edges written AFTER this pass carry both endpoints'
+    # rank partitions (source/target_cr_host_norm_i — edge rows are
+    # immutable, so the fill happens at write time)
+    segment._host_ranks = ranks
     meta = segment.metadata
     updated = 0
     for docid in range(meta.capacity()):
@@ -129,6 +133,9 @@ def postprocess_segment(segment, web_structure, damping: float = DAMPING,
         host = meta.text_value(docid, "host_s")
         r = ranks.get(host)
         if r is not None:
-            meta.set_fields(docid, cr_host_norm_d=r)
+            # cr_host_norm_i: the reference's integer partition of the
+            # normalized rank (a 0..10 boost bucket)
+            meta.set_fields(docid, cr_host_norm_d=r,
+                            cr_host_norm_i=int(round(r * 10)))
             updated += 1
     return updated

@@ -53,17 +53,34 @@ def make_navigators(names=DEFAULT_NAVIGATORS) -> dict[str, Navigator]:
     return {n: Navigator(n, fields[n]) for n in names if n in fields}
 
 
+def _add_value(nav: Navigator, v) -> None:
+    if nav.name == "year" and v:
+        import datetime
+        v = datetime.date.fromordinal(
+            datetime.date(1970, 1, 1).toordinal() + int(v)).year
+    if nav.name == "dates" and v:
+        from ..index.metadata import split_multi
+        for date in split_multi(str(v)):
+            nav.add(date)
+        return
+    nav.add(v)
+
+
 def accumulate(navigators: dict[str, Navigator], meta) -> None:
     """Count one result document into every active navigator."""
     for nav in navigators.values():
-        v = meta.get(nav.field)
-        if nav.name == "year" and v:
-            import datetime
-            v = datetime.date.fromordinal(
-                datetime.date(1970, 1, 1).toordinal() + int(v)).year
-        if nav.name == "dates" and v:
-            from ..index.metadata import split_multi
-            for date in split_multi(str(v)):
-                nav.add(date)
-            continue
-        nav.add(v)
+        _add_value(nav, meta.get(nav.field))
+
+
+def accumulate_batch(navigators: dict[str, Navigator], store,
+                     docids) -> None:
+    """Count a CANDIDATE SET into every navigator with one batched
+    column read per field (per-row LazyRow.get over ~80 oversampled
+    candidates x 7 fields was the serving path's top host cost)."""
+    from ..index.metadata import INT_FIELDS
+    for nav in navigators.values():
+        vals = (store.int_values(docids, nav.field)
+                if nav.field in INT_FIELDS
+                else store.text_values(docids, nav.field))
+        for v in vals:
+            _add_value(nav, v)

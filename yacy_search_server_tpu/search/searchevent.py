@@ -220,10 +220,11 @@ class SearchEvent:
         self._pending.reverse()          # pop() from the end = best-first
         if self.navigators:
             meta = self.segment.metadata
-            for docid in docids.tolist():
-                row = meta.row(int(docid))
-                if row is not None:
-                    accumulate(self.navigators, row)
+            alive = [int(d) for d in docids.tolist()
+                     if not meta.is_deleted(int(d))
+                     and int(d) < meta.capacity()]
+            from .navigator import accumulate_batch
+            accumulate_batch(self.navigators, meta, alive)
         self._drain(self.query.offset + self.query.item_count)
 
     def _drain(self, need: int) -> None:

@@ -65,6 +65,19 @@ SNIPPET_DEAD = "dead"        # the fetch proved the URL gone (4xx/5xx)
 
 MAX_SNIPPET_WORKERS = 4
 
+# ONE shared pool for all page renders: per-query ThreadPoolExecutor
+# construction + join cost ~2 ms/query on the serving path (profiled in
+# r4) — more than the snippet lookups themselves under CACHEONLY
+_POOL: ThreadPoolExecutor | None = None
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        _POOL = ThreadPoolExecutor(max_workers=MAX_SNIPPET_WORKERS,
+                                   thread_name_prefix="snippet")
+    return _POOL
+
 
 class SnippetProducer:
     """Live snippet production through the crawler's loader.
@@ -118,6 +131,4 @@ class SnippetProducer:
                      words: list[str]) -> list[tuple[str, str]]:
         if len(urls) <= 1:
             return [self.produce(u, words) for u in urls]
-        with ThreadPoolExecutor(
-                max_workers=min(MAX_SNIPPET_WORKERS, len(urls))) as ex:
-            return list(ex.map(lambda u: self.produce(u, words), urls))
+        return list(_pool().map(lambda u: self.produce(u, words), urls))

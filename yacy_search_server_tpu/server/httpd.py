@@ -298,6 +298,10 @@ class YaCyHttpServer:
             header = {"ext": ext, "path": path,
                       "client_ip": handler.client_address[0],
                       "method": handler.command,
+                      # servlets mounted both public and _p can tighten
+                      # behavior for non-admin callers (getpageinfo SSRF
+                      # classes, RegexTest limits)
+                      "admin": self._is_admin(handler),
                       "host": handler.headers.get(
                           "Host", f"{self.host}:{self.port}")}
             prop = fn(header, post, self.sb)
@@ -405,6 +409,12 @@ class YaCyHttpServer:
         from .netguard import loopback_target
         return loopback_target(url, self.sb.loader)
 
+    def _private_target(self, url: str) -> bool:
+        """Non-admin SSRF predicate: also refuses link-local (cloud
+        metadata) and RFC1918 targets (server/netguard.py)."""
+        from .netguard import private_target
+        return private_target(url, self.sb.loader)
+
     def _handle_forward_proxy(self, handler, url: str) -> None:
         cfg = self.sb.config
         if not cfg.get_bool("proxyURL", False):
@@ -412,20 +422,27 @@ class YaCyHttpServer:
                        b"forward proxy disabled (config proxyURL)")
             return
         is_admin = self._is_admin(handler)
-        if self._loopback_target(url) and not is_admin:
+        # non-admin clients may not aim the proxy at loopback, link-local
+        # (cloud metadata) or LAN targets (netguard; ADVICE r4)
+        if self._private_target(url) and not is_admin:
             self._send(handler, 403, "text/plain",
                        b"proxy to this node refused")
             return
         from ..crawler.loader import CacheStrategy
         from ..crawler.request import Request
-        # the same guard rides every redirect hop: an allowed public
-        # target must not 302 the node into fetching itself
+        # the same guard rides every redirect hop, and the addr_guard
+        # pins each connection to a vetted resolution (a hostname that
+        # passed the check must not re-resolve to loopback at fetch time)
         url_filter = None if is_admin \
-            else (lambda u: not self._loopback_target(u))
+            else (lambda u: not self._private_target(u))
+        from .netguard import refuse_addr
+        addr_guard = None if is_admin \
+            else (lambda a: refuse_addr(a, allow_private=False))
         try:
             resp = self.sb.loader.load(Request(url=url),
                                        CacheStrategy.IFFRESH,
-                                       url_filter=url_filter)
+                                       url_filter=url_filter,
+                                       addr_guard=addr_guard)
         except Exception as e:
             self._send(handler, 502, "text/plain",
                        f"proxy fetch failed: {e}".encode())

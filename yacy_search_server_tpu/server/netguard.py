@@ -1,12 +1,21 @@
-"""SSRF guard — shared loopback/self-target refusal.
+"""SSRF guard — shared unsafe-target refusal + DNS-rebinding pin.
 
 Any surface that fetches a USER-SUPPLIED url through the node's loader
 (forward proxy, *.yacy rewrite, public getpageinfo) must refuse targets
 that resolve to loopback: a fetch FROM localhost is granted localhost
 auto-admin by the target, so a remote client could read admin pages
-through the node (the round-3 ADVICE high finding). The same predicate
-rides every redirect hop via the loader's ``url_filter``.
-"""
+through the node (the round-3 ADVICE high finding). For non-admin
+clients the forward proxy and getpageinfo additionally refuse
+link-local and RFC1918 targets (169.254.169.254 cloud metadata, LAN
+hosts — ADVICE r4 low). The same predicate rides every redirect hop via
+the loader's ``url_filter``.
+
+DNS-rebinding TOCTOU: checking a HOSTNAME and then fetching it re-runs
+DNS, and a hostile zone can answer differently the second time. The
+``addr_guard`` hook closes that hole — the loader's pinned connection
+classes resolve once at connect time, apply the guard to the RESOLVED
+address, and connect to that same address (crawler/loader.py
+``_PinnedHTTPConnection``)."""
 
 from __future__ import annotations
 
@@ -15,11 +24,22 @@ import socket
 from urllib.parse import urlsplit
 
 
-def loopback_target(url: str, loader=None) -> bool:
-    """True when the target resolves to loopback/unspecified — refuse.
+def refuse_addr(a, allow_private: bool = True) -> bool:
+    """Address-level predicate (also used by the loader's connect-time
+    pin): loopback/unspecified always refuse; private/link-local refuse
+    for surfaces serving non-admin clients."""
+    if a.is_loopback or a.is_unspecified:
+        return True
+    if not allow_private and (a.is_private or a.is_link_local):
+        return True
+    return False
+
+
+def unsafe_target(url: str, loader=None, allow_private: bool = True) -> bool:
+    """True when the target resolves to a refused address class.
 
     With an injected transport (zero-egress tests/simulations) no real
-    socket is opened, so DNS proves nothing: only literal loopback
+    socket is opened, so DNS proves nothing: only literal
     names/addresses are refusable there."""
     host = urlsplit(url).hostname or ""
     if host.lower() in ("localhost", ""):
@@ -36,4 +56,16 @@ def loopback_target(url: str, loader=None) -> bool:
                 addrs.append(ipaddress.ip_address(info[4][0]))
         except (socket.gaierror, ValueError, OSError):
             return True     # unresolvable: refuse rather than fetch
-    return any(a.is_loopback or a.is_unspecified for a in addrs)
+    return any(refuse_addr(a, allow_private) for a in addrs)
+
+
+def loopback_target(url: str, loader=None) -> bool:
+    """The strict predicate (loopback/unspecified only) — used where
+    private addresses are legitimate targets, e.g. LAN-federated .yacy
+    peers."""
+    return unsafe_target(url, loader, allow_private=True)
+
+
+def private_target(url: str, loader=None) -> bool:
+    """The non-admin predicate: loopback + link-local + RFC1918."""
+    return unsafe_target(url, loader, allow_private=False)

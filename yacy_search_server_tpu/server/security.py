@@ -102,12 +102,25 @@ class SecurityHandler:
                 return True
         return False
 
+    # admin-gated by default beyond the `_p` convention: RegexTest runs
+    # re.fullmatch over a fully user-supplied pattern, and CPython's
+    # backtracking engine has no timeout — a catastrophic pattern hangs
+    # a handler thread for minutes, a cheap public-CPU DoS (ADVICE r4;
+    # the reference mounts it publicly, a deliberate divergence).
+    # Operators can re-open it via security.adminPaths="-RegexTest".
+    DEFAULT_ADMIN_PATHS = ("RegexTest",)
+
     def admin_required(self, name: str, path: str) -> bool:
         """Does this servlet need admin rights?
         (Jetty9YaCySecurityHandler.checkUrlProtection equivalent)."""
         if name.endswith("_p"):
             return True
-        for pattern in self.config.get("security.adminPaths", "").split(","):
+        extra = self.config.get("security.adminPaths", "")
+        unprotect = {p.strip()[1:].strip() for p in extra.split(",")
+                     if p.strip().startswith("-")}
+        if name in self.DEFAULT_ADMIN_PATHS and name not in unprotect:
+            return True
+        for pattern in extra.split(","):
             pattern = pattern.strip()
             if pattern and (fnmatch.fnmatch(name, pattern)
                             or fnmatch.fnmatch(path, pattern)):

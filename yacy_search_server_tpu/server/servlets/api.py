@@ -195,16 +195,24 @@ def respond_pageinfo(header: dict, post: ServerObjects, sb) -> ServerObjects:
     # SSRF guard (server/netguard.py): this servlet fetches a
     # user-supplied URL — and the bare `getpageinfo` mount is PUBLIC —
     # so loopback/self targets are refused outright and the same
-    # predicate rides every redirect hop
-    from ..netguard import loopback_target
-    if loopback_target(url, sb.loader):
+    # predicate rides every redirect hop. Non-admin callers are also
+    # refused link-local (cloud metadata) and LAN targets, with the
+    # connection pinned to the vetted resolution (DNS-rebinding);
+    # admins keep private targets (probing an intranet crawl start is
+    # the UI's normal use).
+    from ..netguard import refuse_addr, unsafe_target
+    allow_private = bool(header.get("admin"))
+    if unsafe_target(url, sb.loader, allow_private=allow_private):
         prop.put("error", "target refused")
         return prop
     try:
         from ...crawler.request import Request
         resp = sb.loader.load(
             Request(url=url),
-            url_filter=lambda u: not loopback_target(u, sb.loader))
+            url_filter=lambda u: not unsafe_target(
+                u, sb.loader, allow_private=allow_private),
+            addr_guard=(None if sb.loader.transport is not None else
+                        (lambda a: refuse_addr(a, allow_private))))
         from ...document.parser.registry import parse_source
         docs = parse_source(url, resp.mime_type(), resp.content)
         if docs:

@@ -528,10 +528,14 @@ def config_htcache(header, post, sb):
 
 @servlet("RegexTest")
 def regex_test(header, post, sb):
-    """must-match/must-not-match pattern tester (reference: RegexTest.java)."""
+    """must-match/must-not-match pattern tester (reference: RegexTest.java).
+
+    Admin-gated by default (security.DEFAULT_ADMIN_PATHS — CPython's
+    backtracking engine has no timeout); input caps stay as defense in
+    depth for operators who re-open the mount."""
     prop = ServerObjects()
-    text = post.get("text", "")
-    pattern = post.get("regex", "")
+    text = post.get("text", "")[:4096]
+    pattern = post.get("regex", "")[:1024]
     prop.put("text", escape_html(text))
     prop.put("regex", escape_html(pattern))
     matched = error = ""
