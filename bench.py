@@ -234,8 +234,11 @@ def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
     # r3-class stall source (uniform ~7 s latency clusters, waiters'
     # 1 s timeouts unable to even expire). The product serves from mmap
     # segments, so the bench must too.
+    import atexit
+    import shutil
     import tempfile
     data_dir = tempfile.mkdtemp(prefix="yacytpu-bench-")
+    atexit.register(shutil.rmtree, data_dir, ignore_errors=True)
     sb = Switchboard(data_dir=data_dir, config=cfg)
     rng = np.random.default_rng(0)
     # synthetic 12-char urlhashes: positional layout (6:12 = host part)
@@ -774,7 +777,7 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--cpu-iters", type=int, default=3)
     ap.add_argument("--config", type=int,
-                    choices=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+                    choices=list(range(1, 14)),
                     help="run a BASELINE.md benchmark config instead of "
                          "the headline metric")
     args = ap.parse_args()
@@ -820,9 +823,16 @@ def main():
     hostids = np.zeros(n, dtype=np.int32)
     prof = ranking.RankingProfile()
     lang = P.pack_language("en")
-    t0 = time.perf_counter()
+    # WARMED >=3-iter CPU twin (VERDICT r3 weak #3: a single cold numpy
+    # pass understated the denominator); the protocol is pinned — keep
+    # it fixed across rounds so vs_baseline stays comparable
     np_cardinal_topk(feats, valid, hostids, prof, lang, args.k, ranking, P)
-    cpu_qps = 1.0 / (time.perf_counter() - t0)
+    cpu_iters = 3
+    t0 = time.perf_counter()
+    for _ in range(cpu_iters):
+        np_cardinal_topk(feats, valid, hostids, prof, lang, args.k,
+                         ranking, P)
+    cpu_qps = cpu_iters / (time.perf_counter() - t0)
     del feats, valid, hostids
 
     # pinned to the single-device store: the headline metric's protocol

@@ -1,0 +1,15 @@
+#!/bin/sh
+# Shared peer-address/auth resolution for the bin/ helpers.
+# YACY_HOST (default 127.0.0.1), YACY_PORT (default 8090);
+# YACY_ADMIN_USER + YACY_ADMIN_PASSWORD enable digest auth for remote
+# peers — localhost is auto-admin by default (server/security.py).
+HOST="${YACY_HOST:-127.0.0.1}"
+PORT="${YACY_PORT:-8090}"
+BASE="http://$HOST:$PORT"
+fetch() {
+    if [ -n "$YACY_ADMIN_PASSWORD" ]; then
+        curl -sSf --anyauth -u "${YACY_ADMIN_USER:-admin}:$YACY_ADMIN_PASSWORD" "$@"
+    else
+        curl -sSf "$@"
+    fi
+}

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Load an RSS feed and index all its items (reference: bin/addrss.sh).
+# Usage: bin/addrss.sh "http://host/feed.rss"
+. "$(dirname "$0")/_peer.sh"
+u=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/Load_RSS_p.json?indexAllItemContent=1&url=$u"

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Call any HTTP API path on the peer (reference: bin/apicall.sh).
+# Usage: bin/apicall.sh "Status.json"
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/$1"

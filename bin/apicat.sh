@@ -1,0 +1,4 @@
+#!/bin/sh
+# Call an API and pretty-print the JSON (reference: bin/apicat.sh).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/$1" | python3 -m json.tool

@@ -1,0 +1,4 @@
+#!/bin/sh
+# Liveness probe; exit 0 when the peer answers (reference: bin/checkalive.sh).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/Status.json" > /dev/null && echo alive

@@ -1,0 +1,4 @@
+#!/bin/sh
+# Index size + health counters (reference: bin/checkindex.sh).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/status_p.json" | python3 -m json.tool

@@ -1,0 +1,4 @@
+#!/bin/sh
+# Drop all recorded/scheduled API calls (reference: bin/clearapi.sh).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/Table_API_p.json?clear=1"

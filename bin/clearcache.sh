@@ -1,0 +1,4 @@
+#!/bin/sh
+# Clear the HTTP loader cache (reference: bin/clearcache.sh).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/ConfigHTCache_p.json?clear=1"

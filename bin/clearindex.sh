@@ -1,0 +1,4 @@
+#!/bin/sh
+# Delete the ENTIRE local index (reference: bin/clearindex.sh).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/IndexDeletion_p.json?deleteIndex=1&agree=1"

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Start a full-site crawl bounded to the host (CrawlStartSite).
+. "$(dirname "$0")/_peer.sh"
+u=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/CrawlStartSite.json?crawlingstart=1&crawlingURL=$u"

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Start a depth-0 crawl of one URL (reference: bin/up.sh single-url use).
+. "$(dirname "$0")/_peer.sh"
+u=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/Crawler_p.json?crawlingstart=1&crawlingURL=$u&crawlingDepth=${2:-0}"

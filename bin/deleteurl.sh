@@ -1,0 +1,6 @@
+#!/bin/sh
+# Delete one URL from the index (reference: bin/deleteurl.sh).
+# Usage: bin/deleteurl.sh "http://host/page.html"
+. "$(dirname "$0")/_peer.sh"
+u=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/IndexControlURLs_p.json?urlstring=$u&urldelete=1"

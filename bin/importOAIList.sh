@@ -1,0 +1,5 @@
+#!/bin/sh
+# Harvest an OAI-PMH endpoint (reference: bin/importOAIList.sh).
+. "$(dirname "$0")/_peer.sh"
+u=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/IndexImportOAIPMH_p.json?url=$u&start=1"

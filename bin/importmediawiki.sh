@@ -1,0 +1,6 @@
+#!/bin/sh
+# Import a mediawiki XML dump (reference: bin/importmediawiki.sh).
+# Usage: bin/importmediawiki.sh /path/dump.xml
+. "$(dirname "$0")/_peer.sh"
+f=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/IndexImportMediawiki_p.json?file=$f&start=1"

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Import a WARC archive (IndexImportWarc_p).
+. "$(dirname "$0")/_peer.sh"
+f=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/IndexImportWarc_p.json?file=$f&start=1"

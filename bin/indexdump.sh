@@ -1,0 +1,4 @@
+#!/bin/sh
+# Freeze the metadata/webgraph tails to disk segments (snapshot).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/Steering_p.json?snapshot=1"

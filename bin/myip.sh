@@ -1,0 +1,4 @@
+#!/bin/sh
+# The IP this peer believes it is reachable at (reference: bin/myip.sh).
+. "$(dirname "$0")/_peer.sh"
+fetch "$BASE/Status.json" | python3 -c "import json,sys;print(json.load(sys.stdin).get(\"myip\",\"unknown\"))"

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Set the admin password (reference: bin/passwd.sh).
+# Usage: bin/passwd.sh newpassword
+. "$(dirname "$0")/_peer.sh"
+p=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/ConfigAccounts_p.json?setAdmin=1&adminPassword=$p"

@@ -1,0 +1,6 @@
+#!/bin/sh
+# One query against the local peer, human-readable (reference: bin/search.sh).
+# Usage: bin/search.sh "query words"
+. "$(dirname "$0")/_peer.sh"
+q=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/yacysearch.json?query=$q" | python3 -c "import json,sys; [print(i[\"link\"], \"-\", i[\"title\"]) for c in json.load(sys.stdin)[\"channels\"] for i in c[\"items\"]]"

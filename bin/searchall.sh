@@ -1,0 +1,5 @@
+#!/bin/sh
+# Query with network search enabled (reference: bin/searchall.sh).
+. "$(dirname "$0")/_peer.sh"
+q=$(python3 -c "import urllib.parse,sys;print(urllib.parse.quote(sys.argv[1]))" "$1")
+fetch "$BASE/yacysearch.json?query=$q&resource=global"

@@ -1,0 +1,4 @@
+@echo off
+rem YaCy-TPU launcher (reference: startYACY.bat)
+cd /d "%~dp0"
+python -m yacy_search_server_tpu.yacy -start --data "%APPDATA%\YaCy-TPU\DATA" --port 8090

@@ -1,0 +1,29 @@
+; NSIS installer script for YaCy-TPU on Windows (capability analog of
+; the reference's build.nsi). Bundles the package + a launcher; expects
+; a python 3.11+ runtime on PATH (or a bundled embeddable distribution
+; dropped into .\python\ before compiling the installer).
+!define APPNAME "YaCy-TPU"
+!define APPDIR "$PROGRAMFILES64\${APPNAME}"
+Name "${APPNAME}"
+OutFile "yacy-tpu-setup.exe"
+InstallDir "${APPDIR}"
+RequestExecutionLevel admin
+
+Page directory
+Page instfiles
+
+Section "Install"
+  SetOutPath "$INSTDIR"
+  File /r "..\..\yacy_search_server_tpu"
+  File "..\..\pyproject.toml"
+  File "yacy-tpu.bat"
+  CreateDirectory "$SMPROGRAMS\${APPNAME}"
+  CreateShortCut "$SMPROGRAMS\${APPNAME}\${APPNAME}.lnk" \
+      "$INSTDIR\yacy-tpu.bat"
+  WriteUninstaller "$INSTDIR\uninstall.exe"
+SectionEnd
+
+Section "Uninstall"
+  RMDir /r "$INSTDIR"
+  RMDir /r "$SMPROGRAMS\${APPNAME}"
+SectionEnd

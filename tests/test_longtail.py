@@ -126,10 +126,14 @@ def test_yacy_domain_resolution(tmp_path):
 
 
 def test_smb_loader_driver(node):
+    """smb:// rides the BUILT-IN SMB2 client by default (round 4,
+    test_smbclient.py drives it against a real wire conversation); an
+    injected driver still overrides it (operator escape hatch)."""
     from yacy_search_server_tpu.crawler.request import Request
     sb, _srv = node
-    resp = sb.loader.load(Request(url="smb://fileserver/share/doc.txt"))
-    assert resp.status == 501           # no driver: declared degradation
+    # built-in client: unreachable host is a transport error, not a 501
+    resp = sb.loader.load(Request(url="smb://127.0.0.1:1/share/doc.txt"))
+    assert resp.status == 599 and "x-error" in resp.headers
 
     def fake_smb(url):
         return 200, {"content-type": "text/plain"}, b"smb file content"

@@ -110,6 +110,21 @@ class HTCache:
         ts = got[1].get("x-cache-date")
         return (time.time() - ts) if ts else None
 
+    def clear(self) -> int:
+        """Delete every cached response (bin/clearcache.sh /
+        ConfigHTCache_p clear); returns files removed."""
+        removed = 0
+        if self.data_dir and os.path.isdir(self.data_dir):
+            for root, _dirs, names in os.walk(self.data_dir):
+                for n in names:
+                    try:
+                        os.remove(os.path.join(root, n))
+                        removed += 1
+                    except OSError:
+                        pass
+        self._ram.clear()
+        return removed
+
     def delete(self, url: str) -> None:
         h = url2hash(url)
         with self._lock:

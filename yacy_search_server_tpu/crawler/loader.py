@@ -266,6 +266,12 @@ class LoaderDispatcher:
         scheme = urlsplit(url).scheme.lower()
         t0 = time.monotonic()
         try:
+            if scheme == "ftp" and addr_guard is not None:
+                # urllib's FTPHandler has no connect-time pin: a guarded
+                # (non-admin SSRF-sensitive) surface must not fetch ftp
+                # at all rather than fetch it unpinned
+                return Response(request, status=403, headers={
+                    "x-error": "ftp refused on guarded surface"})
             if scheme in ("http", "https", "ftp"):
                 # ftp rides urllib's built-in FTPHandler (the reference's
                 # FTPLoader is its own client; capability, not mechanism)
@@ -274,14 +280,16 @@ class LoaderDispatcher:
             elif scheme == "file":
                 status, headers, content = self._fetch_file(url)
             elif scheme == "smb":
-                # SMB loading through an injectable driver (reference:
-                # crawler/retrieval/SMBLoader.java via jcifs; no SMB
-                # client library ships in this image, so operators plug
-                # one in — same pattern as the UPnP driver)
-                if self.smb_driver is None:
-                    return Response(request, status=501, headers={
-                        "x-error": "smb driver not configured"})
-                status, headers, content = self.smb_driver(url)
+                # SMB rides the BUILT-IN SMB2 client (crawler/smbclient
+                # .py — the reference bundles jcifs for the same job,
+                # SMBLoader.java:39-60); an injected driver overrides it
+                if self.smb_driver is not None:
+                    status, headers, content = self.smb_driver(url)
+                else:
+                    from .smbclient import smb_fetch
+                    status, headers, content = smb_fetch(
+                        url, timeout=self.timeout_s,
+                        max_size=self.max_size, addr_guard=addr_guard)
             else:
                 return Response(request, status=501,
                                 headers={"x-error": f"scheme {scheme}"})

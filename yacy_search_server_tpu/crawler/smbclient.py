@@ -1,0 +1,444 @@
+"""Minimal SMB2 client — the built-in smb:// loader.
+
+Capability equivalent of the reference's SMB crawling support
+(reference: source/net/yacy/crawler/retrieval/SMBLoader.java:39-60,
+which rides the jcifs library): the crawler must fetch files and
+directory listings from SMB shares out of the box. This is a
+from-the-spec implementation of the SMB 2.0.2 dialect subset the
+loader needs — NEGOTIATE, SESSION_SETUP (anonymous/guest NTLMSSP, or
+authenticated via url userinfo), TREE_CONNECT, CREATE, READ,
+QUERY_DIRECTORY, CLOSE — over direct TCP 445 ([MS-SMB2] message
+layouts; no third-party SMB library ships in this image).
+
+Anonymous/guest is the crawler's normal posture (the reference passes
+jcifs guest credentials for public shares); NTLMv2 single-exchange auth
+covers credentialed intranet crawls.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import time
+from urllib.parse import unquote, urlsplit
+
+SMB2_MAGIC = b"\xfeSMB"
+# commands
+CMD_NEGOTIATE = 0x0000
+CMD_SESSION_SETUP = 0x0001
+CMD_TREE_CONNECT = 0x0003
+CMD_TREE_DISCONNECT = 0x0004
+CMD_CREATE = 0x0005
+CMD_CLOSE = 0x0006
+CMD_READ = 0x0008
+CMD_QUERY_DIRECTORY = 0x000E
+# NT status
+STATUS_OK = 0x00000000
+STATUS_MORE_PROCESSING = 0xC0000016
+STATUS_NO_MORE_FILES = 0x80000006
+STATUS_END_OF_FILE = 0xC0000011
+
+_DIALECT = 0x0202    # SMB 2.0.2: the floor every server speaks
+
+# NTLMSSP flags: UNICODE | REQUEST_TARGET | NTLM | ALWAYS_SIGN |
+# ANONYMOUS(when no creds) | EXTENDED_SESSIONSECURITY | 56/128
+_NTLM_BASE = 0x00000001 | 0x00000004 | 0x00000200 | 0x00008000 | 0x00080000
+_NTLM_ANON = 0x00000800
+
+
+class SMBError(OSError):
+    pass
+
+
+def _md4(data: bytes) -> bytes:
+    """MD4 (RFC 1320) for the NTLM hash — OpenSSL 3 ships with md4
+    disabled, so hashlib cannot be relied on for it."""
+    try:
+        return hashlib.new("md4", data).digest()
+    except ValueError:
+        pass
+    msg = bytearray(data)
+    ml = len(data) * 8
+    msg.append(0x80)
+    while len(msg) % 64 != 56:
+        msg.append(0)
+    msg += struct.pack("<Q", ml)
+    h = [0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476]
+
+    def lrot(x, c):
+        x &= 0xFFFFFFFF
+        return ((x << c) | (x >> (32 - c))) & 0xFFFFFFFF
+
+    for off in range(0, len(msg), 64):
+        x = struct.unpack("<16I", msg[off:off + 64])
+        a, b, c, d = h
+        # round 1: F = (b & c) | (~b & d); roles rotate a->d->c->b
+        for i in range(16):
+            k, s = i, (3, 7, 11, 19)[i % 4]
+            if i % 4 == 0:
+                a = lrot(a + ((b & c) | (~b & d)) + x[k], s)
+            elif i % 4 == 1:
+                d = lrot(d + ((a & b) | (~a & c)) + x[k], s)
+            elif i % 4 == 2:
+                c = lrot(c + ((d & a) | (~d & b)) + x[k], s)
+            else:
+                b = lrot(b + ((c & d) | (~c & a)) + x[k], s)
+        # round 2: G = (b & c) | (b & d) | (c & d)
+        order2 = (0, 4, 8, 12, 1, 5, 9, 13, 2, 6, 10, 14, 3, 7, 11, 15)
+        for i in range(16):
+            k, s = order2[i], (3, 5, 9, 13)[i % 4]
+            if i % 4 == 0:
+                a = lrot(a + ((b & c) | (b & d) | (c & d)) + x[k]
+                         + 0x5A827999, s)
+            elif i % 4 == 1:
+                d = lrot(d + ((a & b) | (a & c) | (b & c)) + x[k]
+                         + 0x5A827999, s)
+            elif i % 4 == 2:
+                c = lrot(c + ((d & a) | (d & b) | (a & b)) + x[k]
+                         + 0x5A827999, s)
+            else:
+                b = lrot(b + ((c & d) | (c & a) | (d & a)) + x[k]
+                         + 0x5A827999, s)
+        # round 3: H = b ^ c ^ d
+        order3 = (0, 8, 4, 12, 2, 10, 6, 14, 1, 9, 5, 13, 3, 11, 7, 15)
+        for i in range(16):
+            k, s = order3[i], (3, 9, 11, 15)[i % 4]
+            if i % 4 == 0:
+                a = lrot(a + (b ^ c ^ d) + x[k] + 0x6ED9EBA1, s)
+            elif i % 4 == 1:
+                d = lrot(d + (a ^ b ^ c) + x[k] + 0x6ED9EBA1, s)
+            elif i % 4 == 2:
+                c = lrot(c + (d ^ a ^ b) + x[k] + 0x6ED9EBA1, s)
+            else:
+                b = lrot(b + (c ^ d ^ a) + x[k] + 0x6ED9EBA1, s)
+        h = [(v + w) & 0xFFFFFFFF for v, w in zip(h, (a, b, c, d))]
+    return struct.pack("<4I", *h)
+
+
+def _header(cmd: int, msg_id: int, session_id: int = 0,
+            tree_id: int = 0, credits: int = 31) -> bytes:
+    return struct.pack(
+        "<4sHHIHHIIQIIQ16s",
+        SMB2_MAGIC, 64, 0, 0, cmd, credits, 0, 0,
+        msg_id, 0xFEFF, tree_id, session_id, b"\0" * 16)
+
+
+class SMB2Client:
+    """One connection to one share. Usage:
+
+        with SMB2Client("host", "share") as c:
+            names = c.listdir("dir/sub")
+            data = c.read_file("dir/sub/file.txt")
+    """
+
+    def __init__(self, host: str, share: str, port: int = 445,
+                 username: str = "", password: str = "",
+                 domain: str = "", timeout: float = 10.0):
+        self.host, self.share = host, share
+        self.username, self.password, self.domain = (username, password,
+                                                     domain)
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._msg_id = 0
+        self._session_id = 0
+        self._tree_id = 0
+        self._negotiate()
+        self._session_setup()
+        self._tree_connect()
+
+    # -- transport -----------------------------------------------------------
+
+    def _send_recv(self, cmd: int, body: bytes) -> tuple[int, bytes]:
+        """One request/response; returns (nt_status, response body)."""
+        hdr = _header(cmd, self._msg_id, self._session_id, self._tree_id)
+        self._msg_id += 1
+        pkt = hdr + body
+        self._sock.sendall(struct.pack(">I", len(pkt)) + pkt)
+        raw = self._recv_exact(4)
+        (ln,) = struct.unpack(">I", raw)
+        resp = self._recv_exact(ln)
+        if resp[:4] != SMB2_MAGIC:
+            raise SMBError("not an SMB2 response")
+        status = struct.unpack_from("<I", resp, 8)[0]
+        self._last_tree_id = struct.unpack_from("<I", resp, 36)[0]
+        sid = struct.unpack_from("<Q", resp, 40)[0]
+        if sid and not self._session_id:
+            self._session_id = sid
+        return status, resp[64:]
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            got = self._sock.recv(n - len(buf))
+            if not got:
+                raise SMBError("connection closed")
+            buf += got
+        return buf
+
+    # -- handshake -----------------------------------------------------------
+
+    def _negotiate(self) -> None:
+        body = struct.pack("<HHHH4x16s8x", 36, 1, 1, 0,
+                           os.urandom(16)) + struct.pack("<H", _DIALECT)
+        status, resp = self._send_recv(CMD_NEGOTIATE, body)
+        if status != STATUS_OK:
+            raise SMBError(f"negotiate failed: 0x{status:08x}")
+        dialect = struct.unpack_from("<H", resp, 4)[0]
+        if dialect != _DIALECT:
+            raise SMBError(f"server chose unsupported dialect "
+                           f"0x{dialect:04x}")
+
+    def _session_setup(self) -> None:
+        type1 = self._ntlm_type1()
+        status, resp = self._send_recv(CMD_SESSION_SETUP,
+                                       self._setup_body(type1))
+        if status == STATUS_OK:
+            return            # server granted without a challenge
+        if status != STATUS_MORE_PROCESSING:
+            raise SMBError(f"session setup failed: 0x{status:08x}")
+        off, ln = struct.unpack_from("<HH", resp, 4)
+        blob = resp[off - 64:off - 64 + ln]
+        type3 = self._ntlm_type3(blob)
+        status, _ = self._send_recv(CMD_SESSION_SETUP,
+                                    self._setup_body(type3))
+        if status != STATUS_OK:
+            raise SMBError(f"authentication failed: 0x{status:08x}")
+
+    @staticmethod
+    def _setup_body(token: bytes) -> bytes:
+        # SecurityBufferOffset is from the SMB2 header start (64 + 24)
+        return struct.pack("<HBBIIHHQ", 25, 0, 1, 0, 0, 88,
+                           len(token), 0) + token
+
+    def _ntlm_type1(self) -> bytes:
+        flags = _NTLM_BASE | (0 if self.password else _NTLM_ANON)
+        return (b"NTLMSSP\0" + struct.pack("<I", 1)
+                + struct.pack("<I", flags)
+                + struct.pack("<HHI", 0, 0, 0)     # domain (empty)
+                + struct.pack("<HHI", 0, 0, 0))    # workstation (empty)
+
+    def _ntlm_type3(self, type2: bytes) -> bytes:
+        """Anonymous (empty responses) or NTLMv2 over the challenge."""
+        if not type2.startswith(b"NTLMSSP\0"):
+            # some servers wrap in SPNEGO; find the embedded NTLMSSP
+            i = type2.find(b"NTLMSSP\0")
+            if i < 0:
+                raise SMBError("no NTLM challenge in security blob")
+            type2 = type2[i:]
+        challenge = type2[24:32]
+        user = self.username.encode("utf-16le")
+        dom = self.domain.encode("utf-16le")
+        if self.password:
+            # NTLMv2: HMAC-MD5 chain over the server challenge + a
+            # client blob ([MS-NLMP] 3.3.2)
+            ntlm_hash = _md4(self.password.encode("utf-16le"))
+            v2_key = hmac.new(
+                ntlm_hash,
+                (self.username.upper() + self.domain).encode("utf-16le"),
+                "md5").digest()
+            ts = int((time.time() + 11644473600) * 10_000_000)
+            cblob = (b"\x01\x01" + b"\0" * 6 + struct.pack("<Q", ts)
+                     + os.urandom(8) + b"\0" * 4
+                     + self._type2_target_info(type2) + b"\0" * 4)
+            proof = hmac.new(v2_key, challenge + cblob, "md5").digest()
+            nt_resp = proof + cblob
+            lm_resp = b"\0" * 24
+        else:
+            nt_resp = b""
+            lm_resp = b"\0"      # 1-byte LM response marks ANONYMOUS
+        flags = _NTLM_BASE | (0 if self.password else _NTLM_ANON)
+        payload_off = 64 + 8     # fixed part of the type-3 message
+        fields = []
+        payload = b""
+
+        def field(data: bytes) -> None:
+            nonlocal payload
+            fields.append(struct.pack("<HHI", len(data), len(data),
+                                      payload_off + len(payload)))
+            payload += data
+
+        field(lm_resp)
+        field(nt_resp)
+        field(dom)
+        field(user)
+        field(b"")               # workstation
+        field(b"")               # session key
+        return (b"NTLMSSP\0" + struct.pack("<I", 3) + b"".join(fields)
+                + struct.pack("<I", flags) + payload)
+
+    @staticmethod
+    def _type2_target_info(type2: bytes) -> bytes:
+        ln, _maxlen, off = struct.unpack_from("<HHI", type2, 40)
+        return type2[off:off + ln]
+
+    def _tree_connect(self) -> None:
+        path = f"\\\\{self.host}\\{self.share}".encode("utf-16le")
+        body = struct.pack("<HHHH", 9, 0, 72, len(path)) + path
+        status, resp = self._send_recv(CMD_TREE_CONNECT, body)
+        if status != STATUS_OK:
+            raise SMBError(f"tree connect failed: 0x{status:08x}")
+        # TreeId lives in the response HEADER; re-read it from there is
+        # awkward with our framing, so issue: headers were consumed in
+        # _send_recv — stash tree id by re-parsing is done there instead.
+        # (TreeId is at header offset 36; _send_recv keeps the raw resp.)
+        self._tree_id = self._last_tree_id
+
+    # -- files ---------------------------------------------------------------
+
+    def _create(self, path: str, directory: bool) -> tuple[bytes, int]:
+        name = path.replace("/", "\\").strip("\\").encode("utf-16le")
+        body = struct.pack(
+            "<HBBIQQIIIIIHHII", 57, 0, 0, 2, 0, 0,
+            0x00120089,                       # read/attrs access
+            0x10 if directory else 0,         # FILE_ATTRIBUTE_DIRECTORY
+            7,                                # share read/write/delete
+            1,                                # FILE_OPEN
+            0x21 if directory else 0x40,      # dir|reparse / non-dir
+            120, len(name), 0, 0) + (name or b"\0\0")
+        status, resp = self._send_recv(CMD_CREATE, body)
+        if status != STATUS_OK:
+            raise SMBError(f"open failed for {path!r}: 0x{status:08x}")
+        eof = struct.unpack_from("<Q", resp, 48)[0]
+        file_id = resp[64:80]
+        return file_id, eof
+
+    def _close(self, file_id: bytes) -> None:
+        body = struct.pack("<HHI", 24, 0, 0) + file_id
+        self._send_recv(CMD_CLOSE, body)
+
+    def read_file(self, path: str, max_size: int = 64 << 20) -> bytes:
+        fid, eof = self._create(path, directory=False)
+        try:
+            if eof > max_size:
+                raise SMBError(f"file exceeds max size: {eof}")
+            out = bytearray()     # bytes += would be O(n^2) at 64 MB
+            off = 0
+            while off < eof:
+                chunk = min(65536, eof - off)
+                body = struct.pack("<HBBIQ16sIIIHH", 49, 0x50, 0, chunk,
+                                   off, fid, 0, 0, 0, 0, 0) + b"\0"
+                status, resp = self._send_recv(CMD_READ, body)
+                if status == STATUS_END_OF_FILE:
+                    break
+                if status != STATUS_OK:
+                    raise SMBError(f"read failed: 0x{status:08x}")
+                doff = resp[2]
+                dlen = struct.unpack_from("<I", resp, 4)[0]
+                out += resp[doff - 64:doff - 64 + dlen]
+                off += dlen
+                if dlen == 0:
+                    break
+            return bytes(out)
+        finally:
+            self._close(fid)
+
+    def listdir(self, path: str = "") -> list[tuple[str, bool, int]]:
+        """[(name, is_dir, size)] via FileDirectoryInformation."""
+        fid, _eof = self._create(path, directory=True)
+        try:
+            pattern = "*".encode("utf-16le")
+            out: list[tuple[str, bool, int]] = []
+            first = True
+            while True:
+                body = struct.pack("<HBBI16sHHI", 33, 1, 0, 0, fid,
+                                   96, len(pattern), 65536) + pattern
+                status, resp = self._send_recv(CMD_QUERY_DIRECTORY, body)
+                if status == STATUS_NO_MORE_FILES:
+                    break
+                if status != STATUS_OK:
+                    if first:
+                        raise SMBError(
+                            f"listing failed: 0x{status:08x}")
+                    break
+                first = False
+                boff = struct.unpack_from("<H", resp, 2)[0]
+                blen = struct.unpack_from("<I", resp, 4)[0]
+                buf = resp[boff - 64:boff - 64 + blen]
+                pos = 0
+                while True:
+                    nxt = struct.unpack_from("<I", buf, pos)[0]
+                    eof = struct.unpack_from("<Q", buf, pos + 40)[0]
+                    attrs = struct.unpack_from("<I", buf, pos + 56)[0]
+                    nlen = struct.unpack_from("<I", buf, pos + 60)[0]
+                    name = buf[pos + 64:pos + 64 + nlen].decode(
+                        "utf-16le", "replace")
+                    if name not in (".", ".."):
+                        out.append((name, bool(attrs & 0x10), eof))
+                    if nxt == 0:
+                        break
+                    pos += nxt
+            return out
+        finally:
+            self._close(fid)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def smb_fetch(url: str, timeout: float = 10.0,
+              max_size: int = 64 << 20,
+              addr_guard=None) -> tuple[int, dict, bytes]:
+    """Loader driver: fetch an smb:// url (file bytes, or an HTML
+    directory listing the parser can follow — the reference's SMBLoader
+    emits exactly such listing pages for directories). `addr_guard`
+    (ipaddress -> refuse bool) pins the connection to a vetted
+    resolution, same contract as the HTTP transport."""
+    import ipaddress
+
+    parts = urlsplit(url)
+    host = parts.hostname or ""
+    user = unquote(parts.username or "")
+    password = unquote(parts.password or "")
+    segs = [s for s in (parts.path or "").split("/") if s]
+    if not host or not segs:
+        return 400, {"x-error": "smb url needs //host/share"}, b""
+    share, path = segs[0], "/".join(unquote(s) for s in segs[1:])
+    if addr_guard is not None:
+        # resolve once, vet, and CONNECT TO the vetted address (the
+        # UNC path keeps the hostname; only the socket target pins)
+        try:
+            infos = socket.getaddrinfo(host, parts.port or 445,
+                                       type=socket.SOCK_STREAM)
+        except OSError as e:
+            return 599, {"x-error": f"resolve failed: {e}"}, b""
+        host = ""
+        for info in infos:
+            if not addr_guard(ipaddress.ip_address(info[4][0])):
+                host = info[4][0]
+                break
+        if not host:
+            return 403, {"x-error": "refused address"}, b""
+    try:
+        with SMB2Client(host, share, port=parts.port or 445,
+                        username=user, password=password,
+                        timeout=timeout) as c:
+            is_dir = (parts.path or "").endswith("/") or not path
+            if not is_dir:
+                try:
+                    data = c.read_file(path, max_size=max_size)
+                    return 200, {"content-type":
+                                 "application/octet-stream"}, data
+                except SMBError:
+                    is_dir = True       # open-as-file failed: try listing
+            entries = c.listdir(path)
+            base = url.rstrip("/")
+            rows = "".join(
+                f'<a href="{base}/{name}{"/" if d else ""}">{name}</a><br>'
+                for name, d, _sz in sorted(entries))
+            page = (f"<html><head><title>Index of {url}</title></head>"
+                    f"<body><h1>Index of {url}</h1>{rows}</body></html>")
+            return 200, {"content-type": "text/html"}, page.encode()
+    except (OSError, SMBError) as e:
+        return 599, {"x-error": str(e)}, b""

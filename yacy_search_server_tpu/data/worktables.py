@@ -105,6 +105,10 @@ class WorkTables:
             ran = True
         return ran
 
+    def clear(self) -> None:
+        """Drop every recorded/scheduled API call (bin/clearapi.sh)."""
+        self.tables.clear("api")
+
     def calls(self) -> list[dict]:
         return sorted(self.tables.rows(TABLE_API),
                       key=lambda r: -r.get("date_recording", 0))

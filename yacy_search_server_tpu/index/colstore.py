@@ -48,10 +48,16 @@ def _pad(n: int) -> int:
 def fsync_dir(path: str) -> None:
     """fsync a DIRECTORY so a rename/create inside it is durable — an
     os.replace alone orders nothing on power loss; the store-everything
-    contract (reference IndexCell.java:115) needs the direntry on disk."""
-    fd = os.open(path, os.O_RDONLY)
+    contract (reference IndexCell.java:115) needs the direntry on disk.
+    Best-effort: platforms without directory fds (Windows) skip it."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
     try:
         os.fsync(fd)
+    except OSError:
+        pass
     finally:
         os.close(fd)
 
@@ -77,8 +83,10 @@ def purge_stale_journals(data_dir: str, prefix: str, keep: str) -> None:
 def write_durable(path: str, data: bytes | str,
                   encoding: str | None = None) -> None:
     """tmp + fsync + rename + dir-fsync in one place: the crash-ordering
-    idiom every manifest/state file in the index uses."""
-    tmp = path + ".tmp"
+    idiom every manifest/state file in the index uses. The tmp name is
+    process-unique — two processes snapshotting the same store must
+    last-writer-win, not crash each other's rename."""
+    tmp = f"{path}.tmp{os.getpid()}"
     mode = "wb" if encoding is None else "w"
     with open(tmp, mode, encoding=encoding) as f:
         f.write(data)

@@ -180,6 +180,13 @@ def _bucket_delta(n: int) -> int:
     return ((n + TILE - 1) // TILE) * TILE
 
 
+def _pmax_window(max_tcount: int) -> int:
+    """Static tail-walk window for the vmapped b=1 kernel: the pow2
+    bucket of the batch's largest tile count (bounded compile shapes;
+    lanes past a slot's span are masked, so over-reading is safe)."""
+    return 1 << max(6, (max(max_tcount, 1) - 1).bit_length())
+
+
 
 
 # ---------------------------------------------------------------------------
@@ -525,6 +532,83 @@ def _rank_pruned_kernel(feats16, flags, docids, dead, pmax,
         col_min, col_max, tf_min, tf_max, bound_shift, lang_term,
         norm_coeffs, flag_bits, flag_shifts, domlength_coeff, tf_coeff,
         language_coeff, authority_coeff, language_pref, k=k, b=b)
+
+
+def _pack_batch1(starts, counts, tstarts, tcounts, cmins, cmaxs,
+                 tmins, tmaxs, bound_shift, lang_term):
+    """(qi, qf): the whole batch descriptor in TWO host buffers — each
+    separate kernel argument is a separate transfer through a remote
+    tunnel, and at 10 buffers that overhead dwarfed the kernel (the
+    same lesson the join kernel's qargs packing recorded in r2)."""
+    bs = len(starts)
+    qi = np.concatenate([
+        np.asarray([bound_shift, lang_term], np.int32),
+        starts, counts, tstarts, tcounts,
+        cmins.ravel(), cmaxs.ravel()]).astype(np.int32)
+    qf = np.concatenate([tmins, tmaxs]).astype(np.float32)
+    return qi, qf, bs
+
+
+@partial(jax.jit, static_argnames=("k", "maxt", "bs"))
+def _rank_pruned_batch1_kernel(feats16, flags, docids, dead, pmax,
+                               qi, qf,
+                               norm_coeffs, flag_bits, flag_shifts,
+                               domlength_coeff, tf_coeff, language_coeff,
+                               authority_coeff, language_pref,
+                               k: int, maxt: int, bs: int):
+    """The b=1 batched pruned kernel, vmapped: every slot scores its ONE
+    proxy-best tile and bound-verifies the tail IN PARALLEL. The general
+    kernel's lax.map runs slots sequentially on device — at 16 slots
+    that made the dispatch ~2.5x the tunnel round trip, and with serial
+    searcher threads per-query LATENCY is the throughput (the r4
+    ~170 q/s plateau). b=1 is the steady-state case (proxy ordering
+    makes the first tile almost always sufficient); escalations stay on
+    the general kernel. `maxt` is the static tail-walk window (bucketed
+    max tile count in the batch). Descriptors arrive packed in qi/qf
+    (_pack_batch1)."""
+    bound_shift, lang_term = qi[0], qi[1]
+    starts = qi[2:2 + bs]
+    counts = qi[2 + bs:2 + 2 * bs]
+    tstarts = qi[2 + 2 * bs:2 + 3 * bs]
+    tcounts = qi[2 + 3 * bs:2 + 4 * bs]
+    cmins = qi[2 + 4 * bs:2 + 4 * bs + bs * P.NF].reshape(bs, P.NF)
+    cmaxs = qi[2 + 4 * bs + bs * P.NF:].reshape(bs, P.NF)
+    tmins = qf[:bs]
+    tmaxs = qf[bs:]
+
+    def one(start, count, tstart, tcount, cmin, cmax, tmin, tmax):
+        f = lax.dynamic_slice(feats16, (start, 0), (TILE, P.NF))
+        fl = lax.dynamic_slice(flags, (start,), (TILE,))
+        dd = lax.dynamic_slice(docids, (start,), (TILE,))
+        v = _tile_valid(dd, dead, jnp.arange(TILE) < count)
+        stats = {"col_min": cmin, "col_max": cmax,
+                 "tf_min": tmin, "tf_max": tmax,
+                 "host_counts": jnp.zeros((1,), jnp.int32)}
+        sc = cardinal_from_stats(f, v, jnp.zeros(TILE, jnp.int32), stats,
+                                 norm_coeffs, flag_bits, flag_shifts,
+                                 domlength_coeff, tf_coeff, language_coeff,
+                                 authority_coeff, language_pref,
+                                 fast_div=True, flags=fl)
+        run_s, idx = lax.top_k(sc, k)
+        run_d = dd[idx]
+        theta = run_s[k - 1]
+        j = jnp.arange(maxt)
+        # clipped gather, not dynamic_slice: lanes past the span are
+        # masked by j >= tcount, so clipping can never misalign
+        pm = pmax[jnp.clip(tstart + j, 0, pmax.shape[0] - 1)]
+        pos = jnp.maximum(bound_shift, 0)
+        neg = jnp.maximum(-bound_shift, 0)
+        cap = jnp.int32(INT32_MAX - 2048) - lang_term
+        shifted = jnp.where(pm > (cap >> pos), cap, pm << pos) >> neg
+        # j=0 is the scored tile; j>=tcount is past the span (pad slots
+        # have tcount 0 -> vacuously ok, and their all-invalid rows
+        # already scored NEG_INF)
+        ok = ((j < 1) | (j >= tcount)
+              | (shifted + lang_term <= theta)).all()
+        return run_s, run_d, ok
+
+    return jax.vmap(one)(starts, counts, tstarts, tcounts,
+                         cmins, cmaxs, tmins, tmaxs)
 
 
 @partial(jax.jit, static_argnames=("k", "b"))
@@ -1020,12 +1104,13 @@ class _QueryBatcher:
                 cmaxs[i] = sp.stats["col_max"]
                 tmins[i] = sp.stats["tf_min"]
                 tmaxs[i] = sp.stats["tf_max"]
-            out = _rank_pruned_batch_kernel(
-                feats16, flags, docids, dead, pmax,
-                starts, counts, tstarts, tcounts,
-                cmins, cmaxs, tmins, tmaxs,
-                *prune_bound_consts(prof),
-                *consts, k=kk, b=b)
+            qi, qf, nbs = _pack_batch1(
+                starts, counts, tstarts, tcounts, cmins, cmaxs,
+                tmins, tmaxs, *prune_bound_consts(prof))
+            out = _rank_pruned_batch1_kernel(
+                feats16, flags, docids, dead, pmax, qi, qf,
+                *consts, k=kk, maxt=_pmax_window(store._max_tcount),
+                bs=nbs)
             s, d, ok = jax.device_get(out)
             store.prune_rounds += 1
             for i, it in enumerate(items):
@@ -1134,6 +1219,12 @@ class DeviceSegmentStore:
         self._prewarm_on = False        # set by enable_batching
         self._prewarm_key = None        # arena shapes last prewarmed
         self._prewarm_running = False
+        # ONE store-wide tail-walk bucket for the b=1 kernel: deriving
+        # maxt per batch/span would mint fresh (maxt) compile keys at
+        # serve time — a 10-40 s inline jit through the tunnel, the
+        # exact stall class prewarm exists to prevent. Over-reading a
+        # small span's window is masked, so the global bucket is safe.
+        self._max_tcount = 1
         # seed tombstones recorded before this store existed (restart path)
         for docid in rwi._tombstones:
             self.arena.mark_dead(docid)
@@ -1212,6 +1303,9 @@ class DeviceSegmentStore:
             self._packed[rid] = {
                 th: Span(base + o, n, tbase + to, nt, st, dseq, jbase + jo)
                 for th, o, n, to, nt, st, jo in meta}
+            for _th, _o, _n, _to, nt, _st, _jo in meta:
+                if nt > self._max_tcount:
+                    self._max_tcount = nt
             track(EClass.INDEX, "devstore_pack", rows)
         # packing may have grown the arena: compiled shapes re-key
         self._maybe_prewarm()
@@ -1294,7 +1388,7 @@ class DeviceSegmentStore:
         if not getattr(self, "_prewarm_on", False):
             return
         with self._lock:
-            key = (self.arena._cap, self.arena._doc_cap, self.arena._tcap)
+            key = self._prewarm_shape_key()
             if self._prewarm_running or key == self._prewarm_key:
                 return
             self._prewarm_running = True
@@ -1303,12 +1397,10 @@ class DeviceSegmentStore:
             try:
                 while True:
                     with self._lock:
-                        key = (self.arena._cap, self.arena._doc_cap,
-                               self.arena._tcap)
+                        key = self._prewarm_shape_key()
                     self.prewarm_kernels()
                     with self._lock:
-                        now = (self.arena._cap, self.arena._doc_cap,
-                               self.arena._tcap)
+                        now = self._prewarm_shape_key()
                         if now == key:
                             self._prewarm_key = key
                             self._prewarm_running = False
@@ -1351,8 +1443,17 @@ class DeviceSegmentStore:
             zc = np.zeros((bs, P.NF), np.int32)
             d_args = (np.zeros((1, P.NF), np.int16),
                       np.zeros(1, np.int32), np.full(1, -1, np.int32))
+            max_tc = self._max_tcount
+            qi, qf, nbs = _pack_batch1(zi, zi, zi, zi, zc, zc, zf, zf,
+                                       shift, lang_term)
             for kk in kks:
-                for b in _PRUNE_B:
+                # the steady-state b=1 vmapped kernel at the CURRENT
+                # span-size bucket, then the escalation buckets
+                out = _rank_pruned_batch1_kernel(
+                    feats16, flags, docids, dead, pmax, qi, qf,
+                    *consts, k=kk, maxt=_pmax_window(max_tc), bs=nbs)
+                jax.device_get(out)
+                for b in _PRUNE_B[1:]:
                     out = _rank_pruned_batch_kernel(
                         feats16, flags, docids, dead, pmax,
                         zi, zi, zi, zi, zc, zc, zf, zf,
@@ -1389,12 +1490,17 @@ class DeviceSegmentStore:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                key = (self.arena._cap, self.arena._doc_cap,
-                       self.arena._tcap)
+                key = self._prewarm_shape_key()
                 if not self._prewarm_running and self._prewarm_key == key:
                     return True
             time.sleep(0.25)
         return False
+
+    def _prewarm_shape_key(self) -> tuple:
+        """Everything that re-keys a kernel compile: buffer capacities
+        AND the b=1 tail-walk bucket (callers hold self._lock)."""
+        return (self.arena._cap, self.arena._doc_cap, self.arena._tcap,
+                _pmax_window(self._max_tcount))
 
     def counters(self) -> dict:
         """Serving-health counters (the headline bench emits these —
@@ -1481,11 +1587,20 @@ class DeviceSegmentStore:
             tstarts[0], tcounts[0] = sp.tstart, sp.tcount
             cmins[0], cmaxs[0] = st["col_min"], st["col_max"]
             tmins[0], tmaxs[0] = st["tf_min"], st["tf_max"]
-            out = _rank_pruned_batch_kernel(
-                feats16, flags, docids, dead, pmax,
-                starts, counts, tstarts, tcounts,
-                cmins, cmaxs, tmins, tmaxs,
-                shift, lang_term, *consts, k=kk, b=b)
+            if b == 1:
+                qi, qf, nbs = _pack_batch1(
+                    starts, counts, tstarts, tcounts, cmins, cmaxs,
+                    tmins, tmaxs, shift, lang_term)
+                out = _rank_pruned_batch1_kernel(
+                    feats16, flags, docids, dead, pmax, qi, qf,
+                    *consts, k=kk, maxt=_pmax_window(self._max_tcount),
+                    bs=nbs)
+            else:
+                out = _rank_pruned_batch_kernel(
+                    feats16, flags, docids, dead, pmax,
+                    starts, counts, tstarts, tcounts,
+                    cmins, cmaxs, tmins, tmaxs,
+                    shift, lang_term, *consts, k=kk, b=b)
             s, d, ok = jax.device_get(out)
             return s[0], d[0], bool(ok[0])
         out = _rank_pruned_kernel(

@@ -433,9 +433,12 @@ class MetadataStore:
                 self._replay(jp)
             purge_stale_journals(self.data_dir, "metadata",
                                  self._journal_name)
-        elif os.path.exists(jp):
+        elif os.path.exists(jp) and os.path.getsize(jp) > 0:
             # legacy round-2 format: the jsonl IS the whole store.
-            # Replay once and convert to the segmented format.
+            # Replay once and convert to the segmented format. (An EMPTY
+            # legacy journal needs no conversion — converting would
+            # WRITE into the data dir, which a read-only worker opening
+            # the owner's store must never do.)
             self._replay(jp)
             self._journal = open(jp, "a", encoding="utf-8")
             self.snapshot()
@@ -914,10 +917,18 @@ class MetadataStore:
             "uh_sorted": hashes[order],
             "uh_order": order.astype(np.int64),
         }
+        # ALL-DEFAULT columns are omitted: readers fall back to ""/0 for
+        # absent names (has_text/has_array), and a 10M-row segment whose
+        # ~100 sparse schema columns each carry an 80 MB offsets array
+        # would be ~15 GB of zeros (r4 disk-full incident)
         for f in INT_FIELDS:
-            arrays[f] = np.asarray(self._ints[f], dtype=np.int64)
+            col = np.asarray(self._ints[f], dtype=np.int64)
+            if col.any():
+                arrays[f] = col
         for f in DOUBLE_FIELDS:
-            arrays[f] = np.asarray(self._doubles[f], dtype=np.float64)
+            col = np.asarray(self._doubles[f], dtype=np.float64)
+            if col.any():
+                arrays[f] = col
         facets_meta: dict = {}
         for f in FACET_FIELDS:
             values, starts, counts, rows = [], [], [], []
@@ -938,7 +949,11 @@ class MetadataStore:
             facets_meta[f] = {"values": values, "starts": starts,
                               "counts": counts}
             arrays[f"facet_rows:{f}"] = np.asarray(rows, dtype=np.int32)
-        texts = {f: self._text[f] for f in TEXT_FIELDS}
+        texts = {}
+        for f in TEXT_FIELDS:
+            col = self._text[f]
+            if any(col):        # all-empty columns are omitted (see above)
+                texts[f] = col
         write_segment(path, n, arrays, texts, meta={"facets": facets_meta})
 
     def _merge_smallest(self) -> None:
@@ -974,9 +989,13 @@ class MetadataStore:
             return col
 
         for f in INT_FIELDS:
-            arrays[f] = merged_numeric(f, np.int64)
+            col = merged_numeric(f, np.int64)
+            if col.any():       # all-default columns are omitted
+                arrays[f] = col
         for f in DOUBLE_FIELDS:
-            arrays[f] = merged_numeric(f, np.float64)
+            col = merged_numeric(f, np.float64)
+            if col.any():
+                arrays[f] = col
         for f in TEXT_FIELDS:
             col = (a.text_column(f) if a.has_text(f) else [""] * a.n) + \
                   (b.text_column(f) if b.has_text(f) else [""] * b.n)
@@ -989,7 +1008,8 @@ class MetadataStore:
             for docid in self._deleted:
                 if base <= docid < base + n:
                     col[docid - base] = ""
-            texts[f] = col
+            if any(col):
+                texts[f] = col
         # rebuild facet tables from the merged columns. Overridden rows'
         # values were FOLDED into the columns above, so they index here
         # like any other row — and their shadow state (the _facet_removed
@@ -999,7 +1019,7 @@ class MetadataStore:
         facets_meta: dict = {}
         for f in FACET_FIELDS:
             byval: dict[str, list[int]] = {}
-            col = texts[f]
+            col = texts.get(f, [""] * n)
             for i_row in range(n):
                 docid = base + i_row
                 if docid in self._deleted:
@@ -1101,31 +1121,33 @@ class MetadataStore:
         self._journal.flush()
 
     def _replay(self, path: str) -> None:
+        # streamed with one-line lookahead (a legacy full-history
+        # journal can be GBs; readlines() would double startup RSS):
+        # a TORN FINAL line is the expected kill-9 artifact and drops;
+        # MID-FILE damage refuses to open — silently skipping a put
+        # would shift every later docid off its RWI postings
+        bad: tuple[int, str] | None = None
         with open(path, "r", encoding="utf-8") as f:
-            lines = f.readlines()
-        for i, line in enumerate(lines):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                # a TORN final line is the expected kill-9 artifact (the
-                # journal fsyncs at generation boundaries, not per
-                # append) and is safe to drop. MID-FILE damage is NOT:
-                # silently skipping a put would shift every later docid
-                # off its RWI postings — refuse to open instead
-                if i == len(lines) - 1:
-                    import logging
-                    logging.getLogger("yacy.metadata").warning(
-                        "journal %s: dropped torn tail line %d",
-                        os.path.basename(path), i + 1)
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
                     continue
-                raise ValueError(
-                    f"journal {os.path.basename(path)}: undecodable "
-                    f"record {i + 1}/{len(lines)} (mid-file damage; "
-                    "docid allocation would desynchronize)")
-            self._replay_rec(rec)
+                if bad is not None:
+                    raise ValueError(
+                        f"journal {os.path.basename(path)}: undecodable "
+                        f"record {bad[0] + 1} (mid-file damage; docid "
+                        "allocation would desynchronize)")
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    bad = (i, line)
+                    continue
+                self._replay_rec(rec)
+        if bad is not None:
+            import logging
+            logging.getLogger("yacy.metadata").warning(
+                "journal %s: dropped torn tail line %d",
+                os.path.basename(path), bad[0] + 1)
 
     def _replay_rec(self, rec: dict) -> None:
         if "_del" in rec:

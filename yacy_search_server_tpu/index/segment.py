@@ -405,11 +405,12 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
     from urllib.parse import parse_qsl
 
     from ..document.datedetection import (dates_as_iso, dates_in_content)
-    from ..document.signature import (exact_signature, fuzzy_profile_text,
-                                      fuzzy_signature)
+    from ..document.signature import (_h63, exact_signature,
+                                      fuzzy_profile_text)
     from ..utils.hashes import (_split, _split_host, host_dnc, hosthash,
                                 normalform)
     from .metadata import join_multi, join_multi_positional
+    fuzzy_profile = fuzzy_profile_text(doc.text)
 
     # link arrays, partitioned by host (inbound = same host); protocol
     # arrays stay positionally aligned with their stub arrays
@@ -520,8 +521,10 @@ def _schema_breadth_fields(doc: Document, host: str) -> dict:
         host_subdomain_s=subdom,
         canonical_equal_sku_b=canonical_equal,
         exact_signature_l=exact_signature(doc.text),
-        fuzzy_signature_l=fuzzy_signature(doc.text),
-        fuzzy_signature_text_t=fuzzy_profile_text(doc.text),
+        # signature = hash of the profile text: compute the (full-text
+        # tokenize + count) profile ONCE, hash it here
+        fuzzy_signature_l=_h63(fuzzy_profile),
+        fuzzy_signature_text_t=fuzzy_profile,
         # optimistic until postprocess_uniqueness() recomputes them
         # (index/postprocess.py) — a fresh doc is unique until proven not
         title_unique_b=1, description_unique_b=1,

@@ -208,12 +208,15 @@ class WebgraphStore:
             purge_stale_journals(self.data_dir, "webgraph",
                                  self._journal_name)
             self._journal = open(jp, "a", encoding="utf-8")
-        elif os.path.exists(jp):
+        elif os.path.exists(jp) and os.path.getsize(jp) > 0:
             # legacy round-2 format: the jsonl IS the whole store
             self._replay(jp)
             self._journal = open(jp, "a", encoding="utf-8")
             self.snapshot()
         else:
+            # (an EMPTY legacy journal needs no conversion — converting
+            # would WRITE into the data dir, which a read-only worker
+            # opening the owner's store must never do)
             self._journal = open(jp, "a", encoding="utf-8")
 
     # -- write path ----------------------------------------------------------
@@ -578,8 +581,15 @@ class WebgraphStore:
             n = len(self._ints["source_docid_i"])
             if n:
                 arrays: dict[str, np.ndarray] = {}
+                # all-default columns are omitted — readers fall back
+                # to 0/"" for absent names (metadata.py's disk-size
+                # rationale; the ix_* index tables always persist)
                 for c in INT_COLS:
-                    arrays[c] = np.asarray(self._ints[c], np.int64)
+                    col = np.asarray(self._ints[c], np.int64)
+                    # the retirement key persists even all-zero (docid 0
+                    # is a real document)
+                    if col.any() or c == "source_docid_i":
+                        arrays[c] = col
                 # secondary index tables (sorted key -> local row)
                 docids = arrays["source_docid_i"]
                 order = np.argsort(docids, kind="stable")
@@ -602,7 +612,8 @@ class WebgraphStore:
                     hrows.extend(rows)
                     pos += len(rows)
                 arrays["ix_host_rows"] = np.asarray(hrows, np.int32)
-                texts = {c: self._text[c] for c in TEXT_COLS}
+                texts = {c: self._text[c] for c in TEXT_COLS
+                         if any(self._text[c])}
                 segname = f"webgraph.{self._seg_seq:06d}.seg"
                 self._seg_seq += 1
                 write_segment(self._path(segname), n, arrays, texts,
@@ -676,9 +687,16 @@ class WebgraphStore:
         arrays["ix_host_rows"] = np.asarray(hrows, np.int32)
         segname = f"webgraph.{self._seg_seq:06d}.seg"
         self._seg_seq += 1
-        write_segment(self._path(segname), n, arrays, texts,
-                      meta={"hosts": {"values": values, "starts": starts,
-                                      "counts": counts}})
+        # all-default columns are omitted at write (readers default);
+        # index tables and the retirement key always persist
+        write_segment(
+            self._path(segname), n,
+            {c: col for c, col in arrays.items()
+             if c.startswith("ix_") or c == "source_docid_i"
+             or col.any()},
+            {c: col for c, col in texts.items() if any(col)},
+            meta={"hosts": {"values": values, "starts": starts,
+                            "counts": counts}})
         dropped = span - n
         old_paths = [s.path for s in victims]
         for s in victims:

@@ -705,6 +705,16 @@ class SearchEventCache:
             self._events[qid] = ev
         return ev
 
+    def event_by_id(self, qid: str) -> "SearchEvent | None":
+        """Look up a LIVE event by its query id — the progressive
+        per-item delivery surface (reference: htroot/yacysearchitem.java
+        reads the cached event while feeders still run)."""
+        with self._lock:
+            ev = self._events.get(qid)
+            if ev is not None:
+                ev.touched = time.time()
+            return ev
+
     def cleanup_locked(self) -> None:
         now = time.time()
         dead = [k for k, e in self._events.items()

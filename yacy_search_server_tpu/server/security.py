@@ -102,13 +102,17 @@ class SecurityHandler:
                 return True
         return False
 
-    # admin-gated by default beyond the `_p` convention: RegexTest runs
-    # re.fullmatch over a fully user-supplied pattern, and CPython's
-    # backtracking engine has no timeout — a catastrophic pattern hangs
-    # a handler thread for minutes, a cheap public-CPU DoS (ADVICE r4;
-    # the reference mounts it publicly, a deliberate divergence).
-    # Operators can re-open it via security.adminPaths="-RegexTest".
-    DEFAULT_ADMIN_PATHS = ("RegexTest",)
+    # admin-gated by default beyond the `_p` convention (operators can
+    # re-open any of these via security.adminPaths="-Name"):
+    # - RegexTest runs re.fullmatch over a fully user-supplied pattern
+    #   and CPython's backtracking engine has no timeout — a
+    #   catastrophic pattern is a cheap public-CPU DoS (ADVICE r4; the
+    #   reference mounts it publicly, a deliberate divergence)
+    # - share writes uploaded surrogates into the indexer's intake
+    # - CrawlStartSite starts a depth-99 site crawl
+    # - ynetSearch relays fetches; ViewImage fetches user urls
+    DEFAULT_ADMIN_PATHS = ("RegexTest", "share", "CrawlStartSite",
+                           "ynetSearch")
 
     def admin_required(self, name: str, path: str) -> bool:
         """Does this servlet need admin rights?

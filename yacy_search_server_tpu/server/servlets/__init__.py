@@ -45,4 +45,5 @@ def _ensure_loaded() -> None:
         return
     _loaded = True
     from . import (yacysearch, status, admin, api, boards,  # noqa: F401
-                   federate, graphics, operator, proxy, monitoring)
+                   breadth, federate, graphics, operator, proxy,
+                   monitoring)

@@ -79,6 +79,12 @@ def respond_steering(header: dict, post: ServerObjects, sb) -> ServerObjects:
         import threading
         threading.Timer(0.5, sb.shutdown_event.set).start()
         prop.put("info", "shutdown in 0.5s")
+    elif post.get("snapshot"):
+        # freeze the store tails to disk segments (bin/indexdump.sh —
+        # the persisted state IS the dump in this architecture)
+        sb.index.metadata.snapshot()
+        sb.index.webgraph.snapshot()
+        prop.put("info", "snapshot complete")
     else:
         prop.put("info", "")
     prop.put("uptime_s", int(__import__("time").time() - sb.started))

@@ -99,6 +99,11 @@ def respond_bookmarks(header: dict, post: ServerObjects, sb) -> ServerObjects:
 @servlet("ConfigAccounts_p")
 def respond_accounts(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop = ServerObjects()
+    if post.get("setAdmin") and post.get("adminPassword"):
+        # the bin/passwd.sh surface (reference passwd.sh writes the
+        # admin credential)
+        sb.config.set("adminAccountPassword", post.get("adminPassword"))
+        prop.put("passwordset", 1)
     action = post.get("action", "list")
     user = post.get("user", "")
     if action == "create" and user:
@@ -162,6 +167,9 @@ def respond_api_table(header: dict, post: ServerObjects, sb) -> ServerObjects:
         sb.work_tables.set_schedule(
             post.get("schedule_pk"), post.get_int("repeat_count", 0),
             post.get("repeat_unit", "days"))
+    if post.get("clear"):
+        sb.work_tables.clear()
+        prop.put("cleared", 1)
     calls = sb.work_tables.calls()
     prop.put("calls", len(calls))
     for i, c in enumerate(calls[: post.get_int("maxrows", 100)]):

@@ -363,6 +363,14 @@ def index_deletion(header, post, sb):
     deleted = 0
     url = post.get("urldelete", "").strip()
     host = post.get("hostdelete", "").strip().lower()
+    if post.get("deleteIndex") and post.get("agree"):
+        # the full wipe (reference IndexDeletion_p "delete the index"
+        # with its are-you-sure gate; bin/clearindex.sh)
+        meta = sb.index.metadata
+        for d in range(meta.capacity()):
+            if not meta.is_deleted(d) and sb.index.remove_document(
+                    meta.urlhash_of(d)):
+                deleted += 1
     if url:
         if sb.index.remove_document(url2hash(url)):
             deleted += 1
@@ -504,6 +512,9 @@ def config_htcache(header, post, sb):
     cfg = sb.config
     if post.get("set", "") and post.get("maxCacheSize", ""):
         cfg.set("proxyCacheSize", post.get("maxCacheSize"))
+    if post.get("clear"):
+        prop.put("cleared", sb.htcache.clear())
+        _HTCACHE_STATS.pop(getattr(sb.htcache, "data_dir", None), None)
     data_dir = getattr(sb.htcache, "data_dir", None)
     # the full-walk stat is expensive on big caches: cache it briefly
     cached = _HTCACHE_STATS.get(data_dir)

@@ -38,6 +38,8 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
         mem = 0
     prop.put("usedmemory", mem)
     prop.put("pid", os.getpid())
+    seed = getattr(getattr(sb, "node", None), "my_seed", None)
+    prop.put("myip", getattr(seed, "ip", "") or "127.0.0.1")
     return prop
 
 

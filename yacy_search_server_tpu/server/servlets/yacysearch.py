@@ -197,6 +197,43 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop.put("hasnext", 1 if (more and got_n) else 0)
     prop.put("nexturl", f"yacysearch.html?query={qq}"
                         f"&startRecord={offset + count}{suffix}")
+    # progressive delivery handle: the page's script can pull items
+    # one-by-one from /yacysearchitem.html?eventID=...&item=N while
+    # remote feeders are still filling the event
+    prop.put("eventID", esc(event.query.query_id()))
+    return prop
+
+
+@servlet("yacysearchitem")
+def respond_item(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """ONE result item of a cached search event, as a standalone
+    fragment — progressive per-item result delivery (reference:
+    htroot/yacysearchitem.java reading SearchEventCache while feeders
+    run, SearchEvent.java:534-543). `item` indexes into the event's
+    ranked results; remote results that arrived since the page rendered
+    become visible here without re-running the query."""
+    prop = ServerObjects()
+    eid = post.get("eventID", "")
+    item = max(post.get_int("item", 0), 0)
+    ext = header.get("ext", "html")
+    esc = _esc_for(ext)
+    prop.put("found", 0)
+    prop.put("eventID", esc(eid))
+    prop.put("item", item)
+    ev = sb.search_cache.event_by_id(eid) if eid else None
+    if ev is None:
+        return prop
+    rs = ev.results(offset=item, count=1)
+    prop.put("total", ev.results_available())
+    if not rs:
+        return prop
+    r = rs[0]
+    prop.put("found", 1)
+    prop.put("link", esc(r.url))
+    prop.put("title", esc(r.title or r.url))
+    prop.put("description", esc(r.snippet or ""))
+    prop.put("host", esc(r.host or ""))
+    prop.put("score", r.score)
     return prop
 
 

@@ -102,7 +102,12 @@ class Switchboard:
                 if self.config.get_bool("index.device.batching", True):
                     self.index.devstore.enable_batching(
                         max_batch=self.config.get_int(
-                            "index.device.batchSize", 16))
+                            "index.device.batchSize", 16),
+                        dispatchers=self.config.get_int(
+                            # dispatcher threads sit blocked in the
+                            # device round trip; 8 saturates the tunnel
+                            # (16 measured no better at 10M/64thr)
+                            "index.device.dispatchers", 8))
             except ValueError:
                 raise
             except Exception:  # no usable jax backend: host path serves
@@ -154,6 +159,10 @@ class Switchboard:
         self.messages = MessageBoard(self.tables)
         self.bookmarks = BookmarksDB(self.tables)
         self.userdb = UserDB(self.tables)
+        # recently searched terms/viewed items for the UI session
+        # (reference: Switchboard.trail served by api/trail_p.java)
+        from collections import deque
+        self.trail: deque = deque(maxlen=100)
         from .data.contentcontrol import ContentControl
         from .document.vocabulary import TripleStore, VocabularyLibrary
         self.vocabularies = VocabularyLibrary(sub("DICTIONARIES"))
@@ -453,6 +462,9 @@ class Switchboard:
             # cache bypass (benchmarks / debugging): a fresh event per
             # call — paging over it is the caller's problem
             event = SearchEvent(q, self.index, loader=self.loader)
+        if query_string and (not self.trail
+                             or self.trail[-1] != query_string):
+            self.trail.append(query_string)
         from .search.accesstracker import QueryLogEntry
         self.access_tracker.add(QueryLogEntry(
             query=query_string, timestamp=t0,
