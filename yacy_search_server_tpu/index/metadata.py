@@ -385,6 +385,9 @@ class MetadataStore:
             f: set() for f in FACET_FIELDS}
         self._journal = None
         self._journal_name = "metadata.jsonl"   # active journal generation
+        # bumped on every mutation that can change facet membership —
+        # the device filter-bitmap cache keys on it (index/devstore.py)
+        self.facet_version = 0
         # monotonically increasing file-name sequence (persisted in the
         # manifest): merged and snapshot segments must never reuse a live
         # file name
@@ -472,6 +475,7 @@ class MetadataStore:
         docid's postings.
         """
         with self._lock:
+            self.facet_version += 1
             old = self.docid(doc.urlhash)
             if old is not None:
                 self._deleted.add(old)
@@ -516,6 +520,7 @@ class MetadataStore:
             if len(col) != n:
                 raise ValueError(f"column {name}: {len(col)} rows != {n}")
         with self._lock:
+            self.facet_version += 1
             base = self._frozen_n + len(self._tail_hashes)
             self._tail_map.update(
                 (uh, base + i) for i, uh in enumerate(urlhashes))
@@ -547,6 +552,7 @@ class MetadataStore:
         FROZEN rows land in the override maps (journaled; folded into
         segment files at merge time)."""
         with self._lock:
+            self.facet_version += 1
             changed = {}
             for field, value in fields.items():
                 if field in INT_FIELDS:
@@ -593,6 +599,7 @@ class MetadataStore:
 
     def delete(self, urlhash: bytes) -> int | None:
         with self._lock:
+            self.facet_version += 1
             docid = self.docid(urlhash)
             if docid is not None:
                 self._deleted.add(docid)

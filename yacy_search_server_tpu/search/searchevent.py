@@ -276,7 +276,16 @@ class SearchEvent:
                for th in inc) <= thresh:
             return None
         m = q.modifier
-        if m.sitehost or m.tld or m.filetype or m.protocol or m.date_sort:
+        if m.date_sort:
+            return None
+        facet_mods = bool(m.sitehost or m.tld or m.filetype or m.protocol)
+        # metadata-constrained modifiers (site:/tld:/filetype:/protocol)
+        # serve on device for SINGLE-term queries via a cached facet
+        # docid bitmap (VERDICT r3 #5 widening); conjunctions with them
+        # keep the host join
+        if facet_mods and (len(inc) != 1 or exc
+                           or not getattr(ds, "supports_filter_bitmap",
+                                          False)):
             return None
         if q.profile.authority > 12:
             return None
@@ -286,16 +295,68 @@ class SearchEvent:
                        else NO_LANG)
         flag_bit = NO_FLAG if flag is None else flag
         if len(inc) == 1 and not exc:
+            if facet_mods:
+                # residency pre-check: building+uploading a bitmap for a
+                # term the store will decline anyway is dead work (and
+                # would trigger a pointless background prewarm)
+                spans = ds.spans_for(inc[0])
+                if spans is None or len(spans) > ds.MAX_SPANS:
+                    return None
+            # the kwarg only goes to stores that declared support (the
+            # facet_mods gate above guarantees allow is None otherwise)
+            extra = ({"allow_bitmap": self._facet_filter_bitmap(ds, m)}
+                     if facet_mods else {})
             with StageTimer(EClass.SEARCH, "DEVRANK"):
                 return ds.rank_term(
                     inc[0], q.profile, q.lang, k=k,
                     lang_filter=lang_filter, flag_bit=flag_bit,
-                    from_days=m.from_days, to_days=m.to_days)
+                    from_days=m.from_days, to_days=m.to_days, **extra)
         with StageTimer(EClass.SEARCH, "DEVJOIN"):
             return ds.rank_join(
                 inc, exc, q.profile, q.lang, k=k,
                 lang_filter=lang_filter, flag_bit=flag_bit,
                 from_days=m.from_days, to_days=m.to_days)
+
+    def _facet_filter_bitmap(self, ds, m):
+        """Device filter bitmap for the active metadata modifiers —
+        SAME membership semantics as the host path's _modifier_mask
+        (site: exact host or subdomain; tld: suffix; filetype/protocol:
+        equality), cached on device per (modifier combo, facet version,
+        capacity)."""
+        meta = self.segment.metadata
+        parts = []
+        if m.sitehost:
+            parts.append(("site", m.sitehost.lower()))
+        if m.tld:
+            parts.append(("tld", m.tld.lower()))
+        if m.filetype:
+            parts.append(("ft", m.filetype.lower()))
+        if m.protocol:
+            parts.append(("proto", m.protocol.lower()))
+        key = (tuple(parts), getattr(meta, "facet_version", 0),
+               meta.capacity())
+
+        def docids_fn():
+            allowed = None
+            for kind, val in parts:
+                if kind == "site":
+                    suffix = "." + val
+                    got = meta.facet_docids(
+                        "host_s",
+                        lambda h: h == val or h.endswith(suffix))
+                elif kind == "tld":
+                    suffix = "." + val
+                    got = meta.facet_docids(
+                        "host_s", lambda h: h.endswith(suffix))
+                elif kind == "ft":
+                    got = meta.facet_docids("url_file_ext_s", val)
+                else:
+                    got = meta.facet_docids("url_protocol_s", val)
+                allowed = got if allowed is None else \
+                    np.intersect1d(allowed, got, assume_unique=False)
+            return allowed if allowed is not None else np.empty(0, np.int64)
+
+        return ds.filter_bitmap(key, docids_fn)
 
     def _dense_rerank(self, scores, docids):
         """M7 second stage: add dense cosine similarity into the sparse
@@ -386,6 +447,26 @@ class SearchEvent:
             return None
         if q.modifier.author:
             if q.modifier.author.lower() not in (m.get("author") or "").lower():
+                return None
+        # metadata-facet recheck (site:/tld:/filetype:/protocol): the
+        # device path filters by a facet BITMAP that may be up to
+        # FILTER_TTL_S stale under active indexing (devstore
+        # .filter_bitmap) — a stale false positive dies here, so staleness
+        # only ever DELAYS inclusion (the reference's soft-commit lag)
+        mod = q.modifier
+        if mod.sitehost or mod.tld or mod.filetype or mod.protocol:
+            host = (m.get("host_s") or "").lower()
+            if mod.sitehost:
+                want = mod.sitehost.lower()
+                if host != want and not host.endswith("." + want):
+                    return None
+            if mod.tld and not host.endswith("." + mod.tld.lower()):
+                return None
+            if mod.filetype and (m.get("url_file_ext_s") or "").lower() \
+                    != mod.filetype.lower():
+                return None
+            if mod.protocol and not url.lower().startswith(
+                    mod.protocol.lower() + ":"):
                 return None
         if q.modifier.keyword:
             if q.modifier.keyword.lower() not in (m.get("keywords") or "").lower():
