@@ -6,4 +6,4 @@ ranking as fused JAX/Pallas kernels, DHT axes as jax.sharding mesh axes,
 and the P2P WAN protocol as a host-side RPC layer.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
