@@ -390,3 +390,52 @@ def test_idx_and_list_rpcs(trio):
     shared = a.protocol.fetch_blacklist(b.seed)
     assert "spam.test/.*" in shared
     assert "internal.test/.*" not in shared   # unshared list never leaks
+
+
+def test_secondary_search_closes_cross_peer_join_gap(trio):
+    """SecondarySearchSuperviser parity (VERDICT r3 weak #6): a URL
+    whose query words live on DIFFERENT peers is a conjunctive hit no
+    single peer can produce. The secondary round must (a) join the
+    per-word abstracts, (b) ask each holding peer for exactly ITS words
+    restricted to the join urls, and (c) surface the document."""
+    import numpy as np
+
+    from yacy_search_server_tpu.index import postings as P
+    from yacy_search_server_tpu.index.postings import PostingsList
+    from yacy_search_server_tpu.peers.remotesearch import RemoteSearch
+    from yacy_search_server_tpu.utils.hashes import url2hash, word2hash
+
+    net, nodes = trio
+    asker, pa, pb = nodes
+    url = "http://joingap.test/doc.html"
+    uh = url2hash(url)
+    wa, wb = word2hash("splitworda"), word2hash("splitwordb")
+
+    def seed_doc(node, wh):
+        docid = node.sb.index.metadata.put(
+            __import__("yacy_search_server_tpu.index.metadata",
+                       fromlist=["metadata_from_parsed"]
+                       ).metadata_from_parsed(
+                uh, url, "join gap doc", "joined body text",
+                host_s="joingap.test"))
+        feats = np.zeros((1, P.NF), np.int32)
+        feats[0, P.F_HITCOUNT] = 3
+        node.sb.index.rwi.ingest_run(
+            {wh: PostingsList(np.asarray([docid], np.int32), feats)})
+
+    seed_doc(pa, wa)      # peer A holds only word A for the url
+    seed_doc(pb, wb)      # peer B holds only word B
+    ev = asker.sb.search("splitworda splitwordb", count=10)
+    assert not ev.results()               # locally unjoinable
+    rs = RemoteSearch(ev, asker.seeddb, asker.dist, asker.protocol,
+                      timeout_s=5.0)
+    rs.start(with_abstracts=True)
+    rs.join()
+    assert not ev.results()               # no single peer joined it
+    started = rs.secondary_search()
+    assert started >= 2                   # both holders asked, targeted
+    rs.join(5.0)
+    got = {r.urlhash for r in ev.results()}
+    assert uh in got, "join-gap document did not surface"
+    # repeat rounds never re-ask a peer
+    assert rs.secondary_search() == 0

@@ -128,10 +128,15 @@ class Protocol:
                exclude_hashes: list[bytes] | None = None,
                count: int = 10, timeout_ms: int = 3000,
                lang: str = "", contentdom: int = 0,
-               with_abstracts: bool = False) -> tuple[bool, dict]:
+               with_abstracts: bool = False,
+               urls: list[bytes] | None = None) -> tuple[bool, dict]:
         """Remote search RPC (Protocol.search / htroot/yacy/search.java):
         the peer runs a local search and returns result rows + optional
-        per-word url-hash abstracts for the secondary join round."""
+        per-word url-hash abstracts for the secondary join round.
+        `urls` is the SECONDARY search shape (Protocol
+        .secondaryRemoteSearch): restrict the peer's answer to these
+        url hashes — the caller already knows, from the abstract join,
+        that they complete a cross-peer conjunction."""
         payload = {
             "query": [h.decode("ascii") for h in wordhashes],
             "exclude": [h.decode("ascii") for h in (exclude_hashes or [])],
@@ -139,6 +144,8 @@ class Protocol:
             "contentdom": contentdom,
             "abstracts": "words" if with_abstracts else "",
         }
+        if urls:
+            payload["urls"] = [u.decode("ascii") for u in urls]
         return self._call(target, "search", payload)
 
     # -- index transfer ------------------------------------------------------

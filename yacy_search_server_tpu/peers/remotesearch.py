@@ -65,6 +65,9 @@ class RemoteSearch:
         self._abstracts: dict[bytes, dict[bytes, set[bytes]]] = \
             defaultdict(lambda: defaultdict(set))
         self._abs_lock = threading.Lock()
+        # peers already asked in a secondary round (checkedPeers —
+        # repeat rounds must not re-ask)
+        self._checked_secondary: set[bytes] = set()
 
     # -- primary round -------------------------------------------------------
 
@@ -109,7 +112,8 @@ class RemoteSearch:
         return len(targets)
 
     def _one_peer(self, target: Seed, with_abstracts: bool,
-                  wordhashes: list[bytes] | None = None) -> None:
+                  wordhashes: list[bytes] | None = None,
+                  urls: list[bytes] | None = None) -> None:
         q = self.event.query
         include = wordhashes or q.goal.include_hashes
         ok, reply = self.protocol.search(
@@ -117,7 +121,7 @@ class RemoteSearch:
             count=self.per_peer_count,
             timeout_ms=int(self.timeout_s * 1000),
             lang=q.lang, contentdom=q.contentdom,
-            with_abstracts=with_abstracts)
+            with_abstracts=with_abstracts, urls=urls)
         if not ok:
             return
         entries = _entries_from_links(
@@ -146,11 +150,16 @@ class RemoteSearch:
     # -- secondary round (abstract-driven join completion) -------------------
 
     def secondary_search(self, max_peers: int = 8) -> int:
-        """Close multi-word join gaps: a URL listed in the abstracts of
-        every query word — but by DIFFERENT peers — is a conjunctive hit
-        no single peer could produce. Ask each peer that holds a partial
-        view to search again (it will join against the postings it has)
-        (SecondarySearchSuperviser.java:198 semantics, simplified)."""
+        """Close multi-word join gaps with TARGETED per-peer requests: a
+        URL listed in the abstracts of every query word — but by
+        DIFFERENT peers — is a conjunctive hit no single peer could
+        produce on its own. For each such peer, ask again with (a) only
+        the words that peer's abstracts actually hold for its URLs and
+        (b) the URL set itself as a constraint, so the peer answers
+        exactly the join-gap documents (the reference's per-peer
+        abstractJoin → wordsFromPeer → secondaryRemoteSearch protocol,
+        SecondarySearchSuperviser.java:130-197; repeat rounds skip
+        already-checked peers)."""
         include = self.event.query.goal.include_hashes
         if len(include) < 2:
             return 0
@@ -158,31 +167,52 @@ class RemoteSearch:
             abstracts = {wh: dict(m) for wh, m in self._abstracts.items()}
         if len(abstracts) < len(include):
             return 0
-        # urls present for EVERY word somewhere in the network
+        # abstract JOIN: urls present for EVERY word somewhere in the
+        # network, with the combined holder set per url
         common: set[bytes] | None = None
         for wh in include:
             urls = set(abstracts.get(wh, {}).keys())
             common = urls if common is None else (common & urls)
         if not common:
             return 0
-        # peers that hold at least one word for a common url but were not
-        # able to join all words locally -> re-ask them
-        peers_to_ask: set[bytes] = set()
+        my_hash = getattr(getattr(self.seeddb, "my_seed", None), "hash",
+                          None)
+        # per-PEER url targets: a peer is asked only about urls whose
+        # join spans peers (a single-holder url needs no second round)
+        peer_urls: dict[bytes, set[bytes]] = {}
         for uh in common:
             holders: set[bytes] = set()
             for wh in include:
                 holders |= abstracts[wh].get(uh, set())
-            if len(holders) > 1:      # the join spans peers
-                peers_to_ask |= holders
+            if len(holders) <= 1:
+                continue
+            for ph in holders:
+                if ph != my_hash:
+                    peer_urls.setdefault(ph, set()).add(uh)
         started = 0
-        for ph in list(peers_to_ask)[:max_peers]:
+        for ph, urls in peer_urls.items():
+            if started >= max_peers:
+                break               # budget counts peers actually ASKED:
+            #                         ineligible holders must not consume
+            #                         slots, or repeat rounds starve
+            if ph in self._checked_secondary:
+                continue            # never ask a peer twice
             seed = self.seeddb.get(ph)
             if seed is None:
                 continue
+            # the words THIS peer can contribute for its target urls
+            words = [wh for wh in include
+                     if any(ph in abstracts[wh].get(uh, ())
+                            for uh in urls)]
+            if not words:
+                continue
+            self._checked_secondary.add(ph)
             th = threading.Thread(
-                target=self._one_peer, args=(seed, False),
+                target=self._one_peer,
+                args=(seed, False, words, sorted(urls)),
                 name=f"secondary-{seed.name}", daemon=True)
             th.start()
             self._threads.append(th)
             started += 1
+        self.event.remote_peers_asked += started
         return started

@@ -89,6 +89,52 @@ class PeerServer:
 
     # -- search (the remote side of scatter-gather) --------------------------
 
+    def _resolve_urls(self, want_urls: list[bytes],
+                      include: list[bytes]) -> list[dict]:
+        """Secondary-search answer: verify each requested url hash
+        against THIS peer's postings for the requested words and return
+        its metadata row. Ranking is the ASKER's job (it fuses into its
+        own event heap); membership is ours — a url whose docid appears
+        in every requested word's postings here is exactly the
+        contribution the abstract join predicted."""
+        import numpy as np
+        meta = self.sb.index.metadata
+        plists = {wh: self.sb.index.rwi.get(wh) for wh in include}
+        links = []
+        for uh in want_urls:
+            docid = meta.docid(uh)
+            if docid is None:
+                continue
+            score = 0
+            ok = True
+            for wh, plist in plists.items():
+                pos = np.flatnonzero(plist.docids == docid) \
+                    if len(plist) else []
+                if len(pos) == 0:
+                    ok = False
+                    break
+                from ..index import postings as iP
+                score += int(plist.feats[int(pos[0]), iP.F_HITCOUNT])
+            if not ok:
+                continue
+            row = meta.row(docid)
+            if row is None:
+                continue
+            links.append({
+                "urlhash": uh.decode("ascii", "replace"),
+                "url": row.get("sku", ""),
+                "title": row.get("title", "") or row.get("sku", ""),
+                "host": row.get("host_s", ""), "score": score,
+                "filetype": row.get("url_file_ext_s", ""),
+                "language": row.get("language_s", ""),
+                "size": row.get("size_i", 0),
+                "wordcount": row.get("wordcount_i", 0),
+                "lastmod_days": row.get("last_modified_days_i", 0),
+                "references": row.get("references_i", 0),
+                "snippet": "",
+            })
+        return links
+
     def do_search(self, payload: dict) -> dict:
         """Run a local search on behalf of a remote peer
         (htroot/yacy/search.java:330 creates its own SearchEvent)."""
@@ -106,18 +152,34 @@ class PeerServer:
         # the words themselves — privacy property of the reference wire)
         q.goal._include_hashes_override = include
         q.goal._exclude_hashes_override = exclude
-        ev = SearchEvent(q, self.sb.index)
-        links = []
-        for e in ev.results(offset=0, count=count):
-            links.append({
-                "urlhash": e.urlhash.decode("ascii", "replace"),
-                "url": e.url, "title": e.title, "host": e.host,
-                "score": int(e.score), "filetype": e.filetype,
-                "language": e.language, "size": e.size,
-                "wordcount": e.wordcount, "lastmod_days": e.lastmod_days,
-                "references": e.references, "snippet": e.snippet,
-            })
-        reply = {"joincount": ev.local_rwi_considered, "links": links}
+        # secondary-search constraint: only these url hashes may answer
+        # (the asking peer's abstract join proved they complete a
+        # cross-peer conjunction — search.java's urls parameter).
+        # Capped: an unbounded list must not bypass the per-RPC work
+        # clamp (the reference caps its abstracts at 512 hashes)
+        want_urls = [u.encode("ascii")
+                     for u in payload.get("urls", [])[:64]] or None
+        if want_urls is not None:
+            # resolve DIRECTLY against the index: a ranked-search fetch
+            # would silently drop a join-gap url that ranks below its
+            # cutoff — the exact document this request exists to recover
+            links = self._resolve_urls(want_urls, include)
+        else:
+            ev = SearchEvent(q, self.sb.index)
+            links = []
+            for e in ev.results(offset=0, count=count):
+                links.append({
+                    "urlhash": e.urlhash.decode("ascii", "replace"),
+                    "url": e.url, "title": e.title, "host": e.host,
+                    "score": int(e.score), "filetype": e.filetype,
+                    "language": e.language, "size": e.size,
+                    "wordcount": e.wordcount,
+                    "lastmod_days": e.lastmod_days,
+                    "references": e.references, "snippet": e.snippet,
+                })
+        reply = {"joincount": (ev.local_rwi_considered
+                               if want_urls is None else len(links)),
+                 "links": links}
         if payload.get("abstracts") == "words":
             # per-word url-hash abstracts for the secondary join round
             # (search.java:398-427 serializes compressed abstracts)
