@@ -193,6 +193,25 @@ def _pmax_window(max_tcount: int) -> int:
 # Kernels
 # ---------------------------------------------------------------------------
 
+def _chunked_topk(sc, k: int, ch: int = 1024):
+    """Exact drop-in for ``lax.top_k(sc, min(k, n))`` on long vectors:
+    per-chunk winners then a small global top_k. Any element of the
+    global top-k is a top-min(k,ch) element of its chunk (beaten by >=k
+    globally implies beaten by >=k within the chunk a fortiori), so the
+    result is score-exact; one full-width top_k was the dominant cost of
+    the TILE-wide kernels. Falls back to the plain op when the shape
+    doesn't chunk evenly."""
+    n = sc.shape[0]
+    kk = min(k, n)
+    if n <= ch or n % ch:
+        return lax.top_k(sc, kk)
+    ck = min(k, ch)
+    cs, ci = lax.top_k(sc.reshape(n // ch, ch), ck)
+    flat_i = (ci + jnp.arange(n // ch)[:, None] * ch).reshape(-1)
+    ts, ti = lax.top_k(cs.reshape(-1), kk)
+    return ts, flat_i[ti]
+
+
 def _constraint_valid(f, fl, lang_filter, flag_bit, from_days, to_days):
     v = (lang_filter == NO_LANG) | (
         f[:, P.F_LANGUAGE].astype(jnp.int32) == lang_filter)
@@ -315,7 +334,7 @@ def _rank_spans_kernel(feats16, flags, docids, dead,
         def body(i, run):
             f, fl, dd, v = tile_of(start, count, i)
             sc = score_rows(f, fl, v)
-            tile_s, tile_i = lax.top_k(sc, min(k, TILE))
+            tile_s, tile_i = _chunked_topk(sc, k)
             return merge_topk(run, tile_s, dd[tile_i])
         return lax.fori_loop(0, n_tiles, body, carry)
 
@@ -514,7 +533,7 @@ def _pruned_span_topk(feats16, flags, docids, dead, pmax,
                                  authority_coeff, language_pref,
                                  fast_div=True, flags=fl)
         run_s, run_d = run
-        tile_s, tile_i = lax.top_k(sc, min(k, TILE))
+        tile_s, tile_i = _chunked_topk(sc, k)
         s = jnp.concatenate([run_s, tile_s])
         d = jnp.concatenate([run_d, dd[tile_i]])
         top_s, idx = lax.top_k(s, k)
@@ -611,7 +630,7 @@ def _rank_pruned_batch1_kernel(feats16, flags, docids, dead, pmax,
                                  domlength_coeff, tf_coeff, language_coeff,
                                  authority_coeff, language_pref,
                                  fast_div=True, flags=fl)
-        run_s, idx = lax.top_k(sc, k)
+        run_s, idx = _chunked_topk(sc, k)
         run_d = dd[idx]
         theta = run_s[k - 1]
         j = jnp.arange(maxt)
