@@ -308,8 +308,9 @@ def test_every_servlet_renders_html(node):
     (reference: every htroot servlet ships an .html template)."""
     sb, srv = node
     servlets.lookup("Status")
-    skip = {"yacysearch", "yacysearchitem", "gsasearch", "suggest",
-            "select", "solr/select",
+    skip = {"yacysearch", "yacysearchitem", "yacysearchtrailer",
+            "gsasearch", "suggest", "select", "solr/select",
+            "Banner", "autoconfig",
             "opensearchdescription", "citation", "feed", "snapshot",
             "webstructure", "linkstructure", "schema", "termlist_p",
             "timeline_p", "latency_p", "status_p", "table_p", "push_p",
@@ -470,3 +471,49 @@ def test_round4_breadth_pages(node):
         srv, "/CrawlStartSite.html?crawlingstart=1&crawlingURL="
              "http%3A%2F%2Fsw.test%2F")
     assert st == 200
+
+
+def test_round4_second_sweep_pages(node):
+    """The audited page-gap closure: crawler monitors, blacklist
+    maintenance, account views, geo/fragment APIs render real state."""
+    sb, srv = node
+    st, body = _get_html(srv, "/IndexCreateQueues_p.html")
+    assert st == 200 and "local" in body
+    st, body = _get_html(srv, "/IndexCreateParserErrors_p.html")
+    assert st == 200
+    st, body = _get_html(srv, "/ConfigAccountList_p.html")
+    assert st == 200
+    # blacklist import -> export round-trip
+    st, body = _get_html(
+        srv, "/BlacklistImpExp_p.html?list=t&import=bad.example%2F.*")
+    assert st == 200 and "bad.example" in body
+    assert "bad.example/.*" in sb.blacklist.entries("t")
+    st, body = _get_html(srv, "/BlacklistCleaner_p.html")
+    assert st == 200
+    # proxy indexing toggle persists
+    _get_html(srv, "/ProxyIndexingMonitor_p.html?set=1&proxyURL=on")
+    assert sb.config.get_bool("proxyURL", False)
+    # quick crawl bookmarklet page
+    st, body = _get_html(srv, "/QuickCrawlLink_p.html")
+    assert st == 200 and "QuickCrawlLink_p" in body
+    # geo search api answers (no coordinates in the fixture -> 0 places)
+    st, body = _get_html(srv, "/yacysearch_location.html?query=words")
+    assert st == 200
+    # trailer fragment for a cached event
+    ev = sb.search("words", count=5)
+    from urllib.parse import quote
+    st, body = _get_html(
+        srv, f"/yacysearchtrailer.html?eventID={quote(ev.query.query_id())}")
+    assert st == 200
+    # banner PNG + autoconfig XML are machine formats
+    import urllib.request as _u
+    with _u.urlopen(srv.base_url + "/Banner.png", timeout=10) as r:
+        assert r.read()[:8] == b"\x89PNG\r\n\x1a\n"
+    with _u.urlopen(srv.base_url + "/autoconfig.xml", timeout=10) as r:
+        assert b"OpenSearchDescription" in r.read()
+    # profile + content control + share config pages
+    for p in ("/ConfigProfile_p.html?save=1&name=tester",
+              "/ContentControl_p.html", "/IndexShare_p.html"):
+        st, _b = _get_html(srv, p)
+        assert st == 200, p
+    assert sb.config.get("profile.name") == "tester"

@@ -149,6 +149,22 @@ class HostBalancer:
                     else:
                         q.close()
 
+    def clear(self) -> int:
+        """Drop every pending request (the queue monitor's clear
+        action); journals compact empty via close. Returns dropped."""
+        with self._lock:
+            dropped = sum(len(q) for q in self._queues.values())
+            for q in self._queues.values():
+                # empty the queue FIRST so close() compacts the journal
+                # to nothing (a bare close would resurrect the entries
+                # at next startup)
+                while q.pop() is not None:
+                    pass
+                q.close()
+            self._queues.clear()
+            self._rr.clear()
+            return dropped
+
     def push(self, req: Request) -> bool:
         hk = host_key(req.url)
         with self._lock:
@@ -235,6 +251,11 @@ class NoticedURL:
 
     def size(self, stack: str) -> int:
         return len(self.stacks[stack])
+
+    def clear(self, stack: str) -> int:
+        """Drop every pending request of one stack (the queue monitor's
+        clear action); returns requests dropped."""
+        return self.stacks[stack].clear()
 
     def exists_in_any(self, url: str) -> bool:
         return any(b.has_url(url) for b in self.stacks.values())

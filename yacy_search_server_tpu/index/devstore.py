@@ -203,7 +203,9 @@ def _chunked_topk(sc, k: int, ch: int = 1024):
     doesn't chunk evenly."""
     n = sc.shape[0]
     kk = min(k, n)
-    if n <= ch or n % ch:
+    if n <= ch or n % ch or k >= ch:
+        # k >= ch would keep every chunk element — strictly MORE work
+        # than the plain op (deep pagination reaches kk >= 1024)
         return lax.top_k(sc, kk)
     ck = min(k, ch)
     cs, ci = lax.top_k(sc.reshape(n // ch, ch), ck)
