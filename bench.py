@@ -501,6 +501,11 @@ def _config8_device_join(iters=10):
     # device served them (served vs fallback in a mixed load)
     import threading as _th
     ds.enable_batching()
+    # one query under batching triggers the join-family prewarm (buckets
+    # 1/4/16); wait it out like a deployment warming before traffic —
+    # a 14-46 s tunnel compile landing mid-round convoys the watchdog
+    ds.rank_join(inc, exc, prof, "en", k=100)
+    ds.join_prewarm_wait()
     threads, per_thread = 16, 4
 
     def worker():

@@ -1,0 +1,123 @@
+"""Microbenchmark: join-membership strategies at config-8 shapes.
+
+Compares, on the real device:
+  A) sort-merge membership (current _membership_sorted): sort (r+m) tagged keys
+  B) searchsorted membership: binary-search r targets into the m-sorted segment
+each solo and under lax.map / vmap batching, at the config-8 shapes
+(rare span r=300k bucket, include partner m=1M, exclude m=80k).
+
+Run:  python tools/microbench_join.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+R = 300_000          # rare span bucket (config 8)
+M_INC = 1 << 20      # include partner segment (1M)
+M_EXC = 81_920       # exclusion segment
+
+
+def make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    cap = 1 << 29
+    targets = rng.integers(0, cap, R, dtype=np.int32)
+    b_inc = np.sort(rng.integers(0, cap, M_INC).astype(np.int32))
+    b_exc = np.sort(rng.integers(0, cap, M_EXC).astype(np.int32))
+    p_inc = rng.integers(0, 1 << 20, M_INC, dtype=np.int32)
+    p_exc = rng.integers(0, 1 << 20, M_EXC, dtype=np.int32)
+    return (jnp.asarray(targets), jnp.asarray(b_inc), jnp.asarray(p_inc),
+            jnp.asarray(b_exc), jnp.asarray(p_exc))
+
+
+def member_sort(bd, bp, targets):
+    """Current approach: one sort of tagged (A|B) keys."""
+    r = targets.shape[0]
+    m = bd.shape[0]
+    cap = 1 << 29
+    a_key = jnp.clip(targets, 0, cap) * 2
+    b_key = jnp.minimum(bd, cap + 1) * 2 + 1
+    keys = jnp.concatenate([a_key, b_key])
+    payload = jnp.concatenate([jnp.arange(r, dtype=jnp.int32), bp])
+    sk, sp = lax.sort((keys, payload), num_keys=1)
+    next_key = jnp.concatenate([sk[1:], jnp.full((1,), -5, jnp.int32)])
+    next_pay = jnp.concatenate([sp[1:], jnp.zeros(1, jnp.int32)])
+    is_a = (sk & 1) == 0
+    hit = is_a & (next_key == sk + 1)
+    a_idx = jnp.where(is_a, sp, r)
+    found = jnp.zeros(r, bool).at[a_idx].set(hit, mode="drop")
+    prow = jnp.zeros(r, jnp.int32).at[a_idx].set(
+        jnp.where(hit, next_pay, 0), mode="drop")
+    return found, prow
+
+
+def member_bsearch(bd, bp, targets):
+    """searchsorted membership: the segment is ALREADY sorted."""
+    p = jnp.searchsorted(bd, targets)
+    p = jnp.clip(p, 0, bd.shape[0] - 1)
+    found = bd[p] == targets
+    return found, jnp.where(found, bp[p], 0)
+
+
+def join_body(member):
+    def body(targets, b_inc, p_inc, b_exc, p_exc):
+        f1, pr1 = member(b_inc, p_inc, targets)
+        f2, _ = member(b_exc, p_exc, targets)
+        v = f1 & ~f2
+        # stand-in epilogue: gather + reduce so nothing is dead-code'd
+        return jnp.sum(jnp.where(v, pr1, 0)), jnp.sum(v)
+    return body
+
+
+def bench(fn, args, label, iters=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters * 1000
+    print(f"{label:46s} {dt:9.2f} ms/call")
+    return dt
+
+
+def main():
+    targets, b_inc, p_inc, b_exc, p_exc = make_data()
+    solo_sort = jax.jit(join_body(member_sort))
+    solo_bs = jax.jit(join_body(member_bsearch))
+    print(f"device: {jax.devices()[0]}")
+    bench(solo_sort, (targets, b_inc, p_inc, b_exc, p_exc), "solo sort-merge")
+    bench(solo_bs, (targets, b_inc, p_inc, b_exc, p_exc), "solo searchsorted")
+
+    for bs in (4, 16):
+        tb = jnp.stack([targets] * bs)
+
+        def mapped(member):
+            def run(tb, b_inc, p_inc, b_exc, p_exc):
+                return lax.map(
+                    lambda t: join_body(member)(t, b_inc, p_inc, b_exc, p_exc),
+                    tb)
+            return jax.jit(run)
+
+        def vmapped(member):
+            def run(tb, b_inc, p_inc, b_exc, p_exc):
+                return jax.vmap(
+                    lambda t: join_body(member)(t, b_inc, p_inc, b_exc, p_exc)
+                )(tb)
+            return jax.jit(run)
+
+        args = (tb, b_inc, p_inc, b_exc, p_exc)
+        d = bench(mapped(member_sort), args, f"lax.map sort-merge bs={bs}")
+        print(f"{'':46s} {d/bs:9.2f} ms/query")
+        d = bench(vmapped(member_sort), args, f"vmap    sort-merge bs={bs}")
+        print(f"{'':46s} {d/bs:9.2f} ms/query")
+        d = bench(mapped(member_bsearch), args, f"lax.map searchsorted bs={bs}")
+        print(f"{'':46s} {d/bs:9.2f} ms/query")
+        d = bench(vmapped(member_bsearch), args, f"vmap    searchsorted bs={bs}")
+        print(f"{'':46s} {d/bs:9.2f} ms/query")
+
+
+if __name__ == "__main__":
+    main()
