@@ -270,7 +270,10 @@ def parse_html(url: str, content: bytes,
         scraper.feed(html)
         scraper.close()
     except Exception:
-        pass   # salvage whatever was scraped before the failure
+        # salvage whatever was scraped before the failure
+        import logging
+        logging.getLogger("parser.html").debug(
+            "scraper aborted mid-document for %s", url, exc_info=True)
 
     text = _WS_RE.sub(" ", "".join(scraper.text_parts)).strip()
     title = _WS_RE.sub(" ", "".join(scraper.title_parts)).strip()

@@ -103,7 +103,9 @@ def _exif_info(content: bytes) -> tuple[int, int, dict, float, float]:
                 lat = dms(gps[2], gps.get(1, "N"), ("S",))
                 lon = dms(gps[4], gps.get(3, "E"), ("W",))
         except Exception:
-            pass
+            import logging
+            logging.getLogger("parser.exif").debug(
+                "malformed EXIF block skipped", exc_info=True)
     return w, h, texts, lat, lon
 
 
@@ -121,7 +123,9 @@ def parse_image(url: str, content: bytes,
             w, h = w or w2, h or h2
             texts.update(exif)
         except Exception:
-            pass
+            import logging
+            logging.getLogger("parser.exif").debug(
+                "EXIF segment unreadable in JPEG", exc_info=True)
     elif content[:4] in (b"II*\x00", b"MM\x00*"):      # TIFF
         try:
             w, h, texts, lat, lon = _exif_info(content)

@@ -170,7 +170,11 @@ def extract_triples(html: str | bytes,
         scraper.feed(html)
         scraper.close()
     except Exception:
-        pass                    # salvage what was collected
+        # salvage what was collected before the failure
+        import logging
+        logging.getLogger("parser.rdfa").debug(
+            "RDFa scrape aborted mid-document for %s", base_url,
+            exc_info=True)
     scraper.flush()
     # dedup, preserving order
     return list(dict.fromkeys(scraper.triples))

@@ -81,7 +81,9 @@ class Tray:
             try:
                 root.after(0, root.destroy)
             except Exception:
-                pass
+                import logging
+                logging.getLogger("gui").debug(
+                    "tk teardown raced window close", exc_info=True)
 
 
 def run_gui(base_url: str, shutdown_event: threading.Event,

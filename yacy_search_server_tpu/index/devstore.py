@@ -2162,6 +2162,10 @@ class DeviceSegmentStore:
         t = threading.Thread(target=run, name="devstore-join-prewarm",
                              daemon=True)
         with self._lock:
+            # prune finished prewarms so a long-lived server doesn't hold
+            # one dead Thread per compile family for its whole uptime
+            self._join_prewarm_threads = [
+                x for x in self._join_prewarm_threads if x.is_alive()]
             self._join_prewarm_threads.append(t)
         t.start()
 

@@ -298,13 +298,15 @@ class ConcurrentUpdateConnector:
                 # update must be visible: counter + log line, and the id
                 # cache must stop claiming the document is present
                 self.failed += 1
+                import logging as _logging
                 if op == "add":
                     from ..utils.hashes import url2hash
                     try:
                         self._remember(url2hash(payload.url), False)
                     except Exception:
-                        pass
-                import logging as _logging
+                        _logging.getLogger("federate.update").debug(
+                            "presence-cache invalidation failed for %s",
+                            payload.url, exc_info=True)
                 _logging.getLogger("federate.update").warning(
                     "dropped %s update: %s", op, e)
             finally:

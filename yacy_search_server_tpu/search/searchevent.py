@@ -679,7 +679,11 @@ class SearchEvent:
                 try:
                     self.segment.remove_document(e.urlhash)
                 except Exception:
-                    pass
+                    import logging
+                    logging.getLogger("search.snippets").warning(
+                        "dead-document purge failed for %r; the index "
+                        "still claims a URL the snippet fetch proved gone",
+                        e.urlhash, exc_info=True)
         return evicted
 
     def one_result(self, item: int) -> ResultEntry | None:

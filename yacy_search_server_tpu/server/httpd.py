@@ -320,8 +320,8 @@ class YaCyHttpServer:
             try:
                 self._send(handler, 500, "text/plain",
                            f"server error: {e}".encode("utf-8"))
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # client hung up (or its wfile closed) before the 500
 
     def _translation(self):
         """Lazy-loaded translation table for the configured UI language
@@ -466,7 +466,10 @@ class YaCyHttpServer:
             try:
                 self.sb.to_indexer(resp, self._proxy_profile())
             except Exception:
-                pass
+                import logging
+                logging.getLogger("httpd.proxy").warning(
+                    "proxy page not handed to indexer: %s", resp.url,
+                    exc_info=True)
         ctype = resp.headers.get("content-type",
                                  "application/octet-stream")
         self._send(handler, 200, ctype, resp.content)

@@ -127,7 +127,10 @@ def load_rss(header: dict, post: ServerObjects, sb) -> ServerObjects:
                 sb.index.store_document(d)
                 indexed += 1
             except Exception:
-                pass
+                import logging
+                logging.getLogger("servlets.rss").warning(
+                    "RSS item not indexed: %s", getattr(d, "url", "?"),
+                    exc_info=True)
         from urllib.parse import quote
         sb.work_tables.record_api_call(
             f"/Load_RSS_p.html?indexAllItemContent=1&url={quote(url)}",
@@ -250,7 +253,9 @@ def view_image(header: dict, post: ServerObjects, sb) -> ServerObjects:
                 content = resp.content
                 ctype = resp.headers.get("content-type", "image/png")
         except Exception:
-            pass
+            import logging
+            logging.getLogger("servlets.image").debug(
+                "remote image fetch failed for %s", u, exc_info=True)
     if content is None:
         prop.put("error", "not available")
         return prop

@@ -625,7 +625,9 @@ class Switchboard:
                 try:
                     self.index.rwi.merge_runs(max_runs=1)
                 except Exception:
-                    pass
+                    import logging
+                    logging.getLogger("switchboard.jobs").warning(
+                        "background RWI run merge failed", exc_info=True)
                 return True
         return False
 

@@ -84,8 +84,8 @@ def _teardown_locked(root: logging.Logger) -> None:
         for sink in _listener.handlers:
             try:
                 sink.close()
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # sink already closed or its fd gone at teardown
         _listener = None
     for h in list(root.handlers):
         root.removeHandler(h)

@@ -80,7 +80,9 @@ class _MemoryControl:
                 try:
                     hook()
                 except Exception:
-                    pass
+                    import logging
+                    logging.getLogger("utils.memory").warning(
+                        "load-shedding hook %r failed", hook, exc_info=True)
             gc.collect()
             return self.available() >= size
         return False

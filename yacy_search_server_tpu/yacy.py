@@ -102,7 +102,9 @@ def startup(data_dir: str, port: int = DEFAULT_PORT, host: str = "127.0.0.1",
             if upnp.add_port_mapping(port):
                 sb_like.upnp = upnp
         except Exception:
-            pass
+            import logging
+            logging.getLogger("yacy.upnp").debug(
+                "UPnP port mapping unavailable", exc_info=True)
 
     if p2p:
         from .peers.node import P2PNode
@@ -205,7 +207,9 @@ def main(argv: list[str] | None = None) -> int:
             try:
                 upnp.delete_port_mappings()
             except Exception:
-                pass
+                import logging
+                logging.getLogger("yacy.upnp").debug(
+                    "UPnP unmap failed at shutdown", exc_info=True)
         node.close()
         http.close()
         release_lock(lock)
