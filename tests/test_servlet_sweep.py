@@ -316,7 +316,8 @@ def test_every_servlet_renders_html(node):
             "timeline_p", "latency_p", "status_p", "table_p", "push_p",
             "api/push_p", "blacklists_p", "getpageinfo_p", "proxy",
             "postprocessing_p", "NetworkPicture", "PerformanceGraph",
-            "WebStructurePicture_p", "robots"}   # machine formats/binary
+            "WebStructurePicture_p", "AccessPicture_p", "PeerLoadPicture",
+            "SearchEventPicture", "robots"}   # machine formats/binary
     failures = []
     for name in sorted(servlets._REGISTRY):
         if name in skip:

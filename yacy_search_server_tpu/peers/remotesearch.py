@@ -109,6 +109,7 @@ class RemoteSearch:
             th.start()
             self._threads.append(th)
         self.event.remote_peers_asked += len(targets)
+        self.event.asked_peers.extend(targets)
         return len(targets)
 
     def _one_peer(self, target: Seed, with_abstracts: bool,
@@ -213,6 +214,7 @@ class RemoteSearch:
                 name=f"secondary-{seed.name}", daemon=True)
             th.start()
             self._threads.append(th)
+            self.event.asked_peers.append(seed)
             started += 1
         self.event.remote_peers_asked += started
         return started

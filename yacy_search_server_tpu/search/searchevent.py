@@ -137,6 +137,11 @@ class SearchEvent:
         self.local_rwi_evicted = 0
         self.remote_peers_asked = 0
         self.remote_results = 0
+        # which peers this event scattered to and which answered — the
+        # live state behind the per-event network picture (reference:
+        # htroot/SearchEventPicture.java over SearchEvent.primarySearch)
+        self.asked_peers: list = []
+        self.result_peer_hashes: set[bytes] = set()
         # one-shot latch for query-time heuristics: they fire when the
         # event is created, never again on cache hits/paging (the
         # reference's heuristics are per-search-event)
@@ -552,6 +557,13 @@ class SearchEvent:
         into the live event (the reference's addNodes path)."""
         added = 0
         for e in entries:
+            src = getattr(e, "source", None)
+            if src and src != "local":
+                try:
+                    self.result_peer_hashes.add(
+                        src.encode("ascii") if isinstance(src, str) else src)
+                except UnicodeEncodeError:
+                    pass  # non-hash source label: nothing to mark
             if self._insert(e):
                 added += 1
         self.remote_results += added
@@ -775,6 +787,9 @@ class SearchEventCache:
         self.ttl_s = ttl_s
         self._events: dict[str, SearchEvent] = {}
         self._lock = threading.Lock()
+        # most recent event id — the default subject of the search-event
+        # picture (reference: SearchEventCache.lastEventID)
+        self.last_event_id: str | None = None
 
     def get_event(self, query: QueryParams, segment: Segment,
                   loader=None) -> SearchEvent:
@@ -788,6 +803,7 @@ class SearchEventCache:
         with self._lock:
             self.cleanup_locked()
             self._events[qid] = ev
+            self.last_event_id = qid
         return ev
 
     def event_by_id(self, qid: str) -> "SearchEvent | None":

@@ -25,9 +25,11 @@ decision, not an omission — audited against the full htroot listing):
   covers the capability)
 - LAN scanning: CrawlStartScanner_p / ServerScannerList (a network
   scanner is out of scope for a search node's default surface)
-- graphics variants: AccessPicture_p / PeerLoadPicture /
-  SearchEventPicture / cytag (NetworkPicture, PerformanceGraph,
-  WebStructurePicture_p and Banner cover the raster surface)
+- graphics variants: cytag (a per-peer event-dot tag image for the
+  retired yacy.net homepage; NetworkPicture, PerformanceGraph,
+  WebStructurePicture_p, Banner, AccessPicture_p, PeerLoadPicture and
+  SearchEventPicture cover the raster surface — the last three live,
+  round 5)
 - thin redirect/ack shells the SPA-less UI does not need: goto_p,
   SettingsAck_p, CrawlMonitorRemoteStart, HostBrowserAdmin_p
   (HostBrowser serves both), BlogComments (Blog covers it),

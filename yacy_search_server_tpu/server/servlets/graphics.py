@@ -47,6 +47,66 @@ def respond_structure_picture(header: dict, post: ServerObjects,
     return prop
 
 
+@servlet("AccessPicture_p")
+def respond_access_picture(header: dict, post: ServerObjects,
+                           sb) -> ServerObjects:
+    """Access-grid PNG: who hit this node lately, who it's connected to
+    (reference: htroot/AccessPicture_p.java)."""
+    from ...visualization.graphs import access_picture
+    prop = ServerObjects()
+    w = max(32, min(1920, post.get_int("width", 1024)))
+    h = max(24, min(1440, post.get_int("height", 576)))
+    name = "peer"
+    seeddb = getattr(sb, "seeddb", None)
+    if seeddb is not None and getattr(seeddb, "my_seed", None) is not None:
+        name = seeddb.my_seed.name
+    img = access_picture(getattr(sb, "access_tracker", None), name,
+                         seeddb=seeddb, width=w, height=h,
+                         cellsize=max(6, post.get_int("cellsize", 18)))
+    prop.raw_body = img.png_bytes()
+    prop.raw_ctype = "image/png"
+    return prop
+
+
+@servlet("PeerLoadPicture")
+def respond_peer_load_picture(header: dict, post: ServerObjects,
+                              sb) -> ServerObjects:
+    """Busy-thread load pie PNG (reference: htroot/PeerLoadPicture.java)."""
+    from ...visualization.graphs import peer_load_picture
+    prop = ServerObjects()
+    w = max(40, min(1920, post.get_int("width", 800)))
+    h = max(30, min(1440, post.get_int("height", 600)))
+    img = peer_load_picture(getattr(sb, "threads", None), width=w, height=h,
+                            showidle=post.get("showidle", "1") != "0")
+    prop.raw_body = img.png_bytes()
+    prop.raw_ctype = "image/png"
+    return prop
+
+
+@servlet("SearchEventPicture")
+def respond_search_event_picture(header: dict, post: ServerObjects,
+                                 sb) -> ServerObjects:
+    """Per-search-event network PNG: which peers the last (or named)
+    search scattered to and which answered (reference:
+    htroot/SearchEventPicture.java)."""
+    from ...visualization.graphs import search_event_picture
+    from ...visualization.raster import RasterPlotter
+    prop = ServerObjects()
+    cache = getattr(sb, "search_cache", None)
+    eid = post.get("event") or (cache.last_event_id if cache else None)
+    ev = cache.event_by_id(eid) if (cache and eid) else None
+    if ev is None:
+        img = RasterPlotter(1, 1, background=(0, 0, 0))   # empty image
+    else:
+        img = search_event_picture(
+            getattr(sb, "seeddb", None), ev,
+            width=max(32, min(1920, post.get_int("width", 640))),
+            height=max(24, min(1440, post.get_int("height", 480))))
+    prop.raw_body = img.png_bytes()
+    prop.raw_ctype = "image/png"
+    return prop
+
+
 @servlet("Vocabulary_p")
 def respond_vocabulary(header: dict, post: ServerObjects,
                        sb) -> ServerObjects:

@@ -54,6 +54,151 @@ def network_graph(seeddb, width: int = 480, height: int = 480,
     return img
 
 
+def access_picture(tracker, peer_name: str, seeddb=None,
+                   width: int = 1024, height: int = 576,
+                   cellsize: int = 18) -> RasterPlotter:
+    """Live access-grid picture: this peer centered on a hex-dot grid,
+    hosts that accessed it in the last 10 minutes stacked down the left
+    edge with beams to the center (beam brightness ~ access count), and
+    connected remote peers down the right edge (capability equivalent of
+    the reference's incoming-access / outgoing-connection columns;
+    reference: htroot/AccessPicture_p.java:108-218 over
+    serverAccessTracker + ConnectionInfo)."""
+    img = RasterPlotter(width, height, background=BG)
+    # hex lattice: offset every other row by half a cell
+    for gy, y in enumerate(range(cellsize // 2, height, cellsize)):
+        xoff = cellsize // 2 if gy % 2 else 0
+        for x in range(xoff + cellsize // 2, width, cellsize):
+            img.dot(x, y, (24, 24, 56))
+    cx, cy = width // 2, height // 2
+    img.dot(cx, cy, PEER, radius=6)
+    img.circle(cx, cy, 12, RING)
+    img.text(cx - 40, cy - 24, "THIS YACY PEER", TEXT)
+    img.text(cx - 3 * len(peer_name), cy + 16, peer_name[:20].upper(), TEXT)
+
+    slots = max(1, (height - 40) // (2 * cellsize))
+    hosts = tracker.access_hosts()[:slots] if tracker is not None else []
+    for i, (host, count) in enumerate(hosts):
+        y = 20 + i * 2 * cellsize
+        # brightness scales with access count (the reference scales by
+        # recency bucket; count is the equivalent live signal here)
+        g = min(255, 96 + 16 * count)
+        img.line(70, y, cx - 14, cy, (40, g // 2, 40))
+        img.dot(64, y, (64, g, 64), radius=3)
+        img.text(4, y - 3, f"{host[:10].upper()} {count}", TEXT)
+
+    peers = (seeddb.active_seeds()[:slots]
+             if seeddb is not None else [])
+    for i, s in enumerate(peers):
+        y = 20 + i * 2 * cellsize
+        img.line(cx + 14, cy, width - 70, y, (70, 70, 110))
+        img.dot(width - 64, y, PEER_PASSIVE, radius=3)
+        img.text(width - 60, y - 3, s.name[:10].upper(), TEXT)
+    img.text(10, height - 14,
+             f"{len(hosts)} ACCESS HOSTS  {len(peers)} PEERS", TEXT)
+    return img
+
+
+# thread-group slices of the peer-load pie and their colors (the
+# reference's CircleThreadPiece groups, PeerLoadPicture.java:29-34)
+_LOAD_GROUPS = {
+    "dht-distribution": ("DHT-DISTRIBUTION", (119, 136, 153)),
+    "peer-ping": ("YACY CORE", (255, 230, 160)),
+}
+_IDLE_COLOR = (170, 255, 170)
+_MISC_COLOR = (190, 50, 180)
+
+
+def peer_load_picture(registry, width: int = 800, height: int = 600,
+                      showidle: bool = True) -> RasterPlotter:
+    """Pie chart of where the node's busy threads spend their cycles:
+    idle vs busy per thread group (capability equivalent of the
+    reference's thread-load pie, htroot/PeerLoadPicture.java over
+    BusyThread exec/sleep times; here the BusyThread analog counts
+    busy/idle cycles weighted by their sleep intervals)."""
+    img = RasterPlotter(width, height, background=BG)
+    idle_t, misc_t = 0.0, 0.0
+    groups = {k: 0.0 for k in _LOAD_GROUPS}
+    names = registry.names() if registry is not None else []
+    for name in names:
+        th = registry.get(name)
+        if th is None:
+            continue
+        busy = th.busy_cycles * max(th.busy_sleep_s, 0.01)
+        idle_t += th.idle_cycles * max(th.idle_sleep_s, 0.01)
+        matched = False
+        for key in _LOAD_GROUPS:
+            if key in name:
+                groups[key] += busy
+                matched = True
+                break
+        if not matched:
+            misc_t += busy
+    slices = [(label, groups[key], color)
+              for key, (label, color) in _LOAD_GROUPS.items()
+              if groups[key] > 0]
+    if misc_t > 0:
+        slices.append(("MISC", misc_t, _MISC_COLOR))
+    if showidle and idle_t > 0:
+        slices.append(("IDLE", idle_t, _IDLE_COLOR))
+    total = sum(v for _, v, _ in slices)
+    cx, cy = width // 2, height // 2
+    r = min(width, height) // 2 - 60
+    if total <= 0:
+        img.circle(cx, cy, r, RING)
+        img.text(cx - 40, cy, "NO LOAD DATA", TEXT)
+        return img
+    ang = 0.0
+    ly = 16
+    for label, v, color in slices:
+        span = 2 * math.pi * v / total
+        img.sector(cx, cy, r, ang, ang + span, color)
+        mid = ang + span / 2
+        lx = int(cx + (r + 14) * math.sin(mid))
+        lyy = int(cy - (r + 14) * math.cos(mid))
+        img.text(min(lx, width - 6 * len(label) - 2), lyy,
+                 label, TEXT)
+        img.rect(8, ly, 18, ly + 8, color, fill=True)
+        img.text(24, ly + 1, f"{label} {100 * v / total:.0f}", TEXT)
+        ly += 14
+        ang += span
+    img.circle(cx, cy, r, RING)
+    return img
+
+
+def search_event_picture(seeddb, event, width: int = 640,
+                         height: int = 480) -> RasterPlotter:
+    """Picture of ONE search event on the DHT ring: the asked remote
+    peers at their ring positions with beams from this peer — bright
+    for peers that returned results, dim for silent ones (capability
+    equivalent of the reference's per-event network picture,
+    htroot/SearchEventPicture.java via
+    NetworkGraph.getSearchEventPicture)."""
+    img = RasterPlotter(width, height, background=BG)
+    cx, cy = width // 2, height // 2
+    r = min(width, height) // 2 - 50
+    img.circle(cx, cy, r, RING)
+    img.dot(cx, cy, ME, radius=5)
+    my = getattr(seeddb, "my_seed", None) if seeddb is not None else None
+    img.text(cx + 8, cy - 3,
+             (my.name if my is not None else "ME")[:12], TEXT)
+    asked = list(getattr(event, "asked_peers", []) or [])
+    returned = set((getattr(event, "result_peer_hashes", None) or ()))
+    for s in asked:
+        ang = 2 * math.pi * (s.ring_position() / LONG_MAX) - math.pi / 2
+        x = int(cx + r * math.cos(ang))
+        y = int(cy + r * math.sin(ang))
+        hot = s.hash in returned
+        img.line(cx, cy, x, y, PEER if hot else (60, 80, 60))
+        img.dot(x, y, PEER if hot else PEER_PASSIVE, radius=3 if hot else 2)
+        img.text(x + 6, y - 3, s.name[:12], TEXT)
+    q = getattr(getattr(event, "query", None), "querystring", "")
+    img.text(10, 10, f"SEARCH: {q[:40].upper()}", TEXT)
+    img.text(10, height - 14,
+             f"{len(asked)} PEERS ASKED  {len(returned)} ANSWERED", TEXT)
+    return img
+
+
 def web_structure_graph(web_structure, width: int = 640, height: int = 480,
                         max_hosts: int = 24) -> RasterPlotter:
     """Host link graph: top hosts on a circle, edges for host->host links."""

@@ -83,6 +83,31 @@ class RasterPlotter:
             self.pix[y0:y1 + 1, x0] = color
             self.pix[y0:y1 + 1, x1] = color
 
+    def sector(self, cx: int, cy: int, radius: int,
+               a0: float, a1: float, color) -> None:
+        """Filled pie sector from angle a0 to a1 (radians, clockwise from
+        12 o'clock — the pie-chart convention of the reference's
+        peer-load picture). Vectorized: one angle/radius mask over the
+        bounding box."""
+        y0, y1 = max(0, cy - radius), min(self.height, cy + radius + 1)
+        x0, x1 = max(0, cx - radius), min(self.width, cx + radius + 1)
+        if y0 >= y1 or x0 >= x1 or a1 <= a0:
+            return
+        yy, xx = np.mgrid[y0:y1, x0:x1]
+        dx, dy = xx - cx, yy - cy
+        inside = dx * dx + dy * dy <= radius * radius
+        # angle measured clockwise from 12 o'clock
+        ang = np.mod(np.arctan2(dx, -dy), 2 * math.pi)
+        if a1 - a0 >= 2 * math.pi - 1e-9:
+            mask = inside
+        else:
+            lo, hi = np.mod(a0, 2 * math.pi), np.mod(a1, 2 * math.pi)
+            if lo <= hi:
+                mask = inside & (ang >= lo) & (ang < hi)
+            else:                      # sector wraps past 12 o'clock
+                mask = inside & ((ang >= lo) | (ang < hi))
+        self.pix[y0:y1, x0:x1][mask] = color
+
     def text(self, x: int, y: int, s: str, color) -> None:
         cx = x
         for ch in s.upper():
