@@ -56,16 +56,72 @@ def test_worktables_schedule_and_execute():
 
 def test_wikicode_rendering():
     html = wikicode_to_html(
-        "== Title ==\n'''bold''' and ''italic''\n* one\n* two\n----\n"
+        "'''bold''' and ''italic''\n* one\n* two\n----\n"
         "[[OtherPage|label]] and [http://x.test ext]")
-    assert "<h6>Title</h6>" in html
     assert "<b>bold</b>" in html and "<i>italic</i>" in html
     assert html.count("<li>") == 2 and "<ul>" in html
     assert "<hr/>" in html
     assert '<a href="Wiki.html?page=OtherPage">label</a>' in html
-    assert '<a href="http://x.test">ext</a>' in html
+    assert 'href="http://x.test"' in html and ">ext</a>" in html
     # markup input is escaped (no raw html injection)
     assert "<script>" not in wikicode_to_html("<script>alert(1)</script>")
+
+
+def test_wikicode_headings_anchors_and_toc():
+    """=n= maps to <hn> with anchors; >=2 headings emit a TOC box
+    (reference WikiCode.java Tags.HEADLINE_1..6 + the TOC directory)."""
+    html = wikicode_to_html(
+        "= Top =\ntext\n== Sub Part ==\nmore\n=== Deep ===\nx")
+    assert '<h1><a name="Top"></a>Top</h1>' in html
+    assert '<h2><a name="Sub_Part"></a>Sub Part</h2>' in html
+    assert '<h3><a name="Deep"></a>Deep</h3>' in html
+    assert 'class="WikiTOCBox"' in html
+    assert '<a href="#Sub_Part" class="WikiTOC">' in html
+    # a single heading renders without the TOC box
+    assert "WikiTOCBox" not in wikicode_to_html("== Only ==\nbody")
+
+
+def test_wikicode_tables():
+    html = wikicode_to_html(
+        '{| border="1" evil="x"\n|- align="center"\n'
+        "| a || '''b'''\n|-\n! h1 !! h2\n| c\n|}")
+    assert '<table border="1">' in html
+    assert "evil" not in html                      # allowlist filtered
+    assert '<tr align="center">' in html
+    assert "<td>a</td>" in html and "<td><b>b</b></td>" in html
+    assert "<th>h1</th>" in html and "<th>h2</th>" in html
+    # two rows: the "| c" cell continues the header row (no |- between)
+    assert html.count("<tr") == 2 and "</table>" in html
+    # a bare line inside a table renders intact, not as a clipped cell
+    html2 = wikicode_to_html("{|\nhello world\n| cell\n|}")
+    assert "hello world" in html2 and "ello world</td>" not in html2
+
+
+def test_wikicode_nested_and_definition_lists():
+    html = wikicode_to_html(
+        "* a\n** a1\n** a2\n* b\n## n1\n;term:meaning\n;other")
+    assert html.count("<ul>") == 2 and html.count("<ol>") == 2
+    assert "<li>a1</li>" in html
+    assert "<dl>" in html and "<dt>term</dt><dd>meaning</dd>" in html
+    assert "<dt>other</dt>" in html
+
+
+def test_wikicode_blocks_and_media():
+    html = wikicode_to_html(
+        ": quoted\n:: deeper\nplain\n pre line\nnormal\n"
+        "<pre>\nraw '''not bold'''\n</pre>\n"
+        "'''''both'''''\n<s>gone</s> <u>under</u>\n"
+        "[[Image:http://x.test/i.png|right|my pic]]\n"
+        "[[Youtube:abc123]]\n{{metadata|x}}keep")
+    assert html.count("<blockquote>") == 2
+    assert "<pre>\npre line" in html
+    assert "raw '''not bold'''" in html            # verbatim inside <pre>
+    assert "<b><i>both</i></b>" in html
+    assert '<span class="strike">gone</span>' in html
+    assert '<span class="underline">under</span>' in html
+    assert '<img src="http://x.test/i.png"' in html
+    assert "youtube.com/embed/abc123" in html
+    assert "metadata|x" not in html and "keep" in html
 
 
 def test_wiki_versions_blog_messages():
@@ -80,7 +136,7 @@ def test_wiki_versions_blog_messages():
 
     pk = blog.add("Hello", "== post ==", author="alice")
     assert blog.entries()[0]["subject"] == "Hello"
-    assert "<h6>post</h6>" in blog.render(pk)
+    assert ">post</h2>" in blog.render(pk)
     blog.comment(pk, "bob", "nice")
     assert blog.get(pk)["comments"][0]["author"] == "bob"
 

@@ -16,74 +16,340 @@ import time
 from .tables import Tables
 
 
-# -- WikiCode markup (subset of reference WikiCode.java) ----------------------
+# -- WikiCode markup (full markup engine; reference WikiCode.java) ------------
 
-_RE_H = [(re.compile(rf"^{'=' * n}\s*(.+?)\s*{'=' * n}\s*$"), f"h{8 - n}")
-         for n in (6, 5, 4, 3, 2)]
+_RE_H = [(re.compile(rf"^({'=' * n})\s*(.+?)\s*{'=' * n}\s*$"), n)
+         for n in (6, 5, 4, 3, 2, 1)]
+_RE_BOLD_ITALIC = re.compile(r"'''''(.+?)'''''")
 _RE_BOLD = re.compile(r"'''(.+?)'''")
 _RE_ITALIC = re.compile(r"''(.+?)''")
-_RE_LINK_EXT = re.compile(r"\[(https?://[^\s\]]+)(?:\s+([^\]]+))?\]")
-_RE_LINK_WIKI = re.compile(r"\[\[([^\]|]+)(?:\|([^\]]+))?\]\]")
+_RE_STRIKE = re.compile(r"&lt;s&gt;(.*?)&lt;/s&gt;", re.S)
+_RE_UNDERLINE = re.compile(r"&lt;u&gt;(.*?)&lt;/u&gt;", re.S)
+_RE_LINK_EXT = re.compile(r"\[((?:https?|ftp)://[^\s\]]+)(?:\s+([^\]]+))?\]")
+_RE_LINK_WIKI = re.compile(r"\[\[([^\]|]+)(?:\|([^\]]*))?\]\]")
+_RE_METADATA = re.compile(r"\{\{[^{}]*\}\}")
+_RE_ANCHOR_STRIP = re.compile(r"[^a-zA-Z0-9_]")
+
+# table cell/row properties the renderer lets through (everything else a
+# page author writes is dropped — the reference allowlists the same way)
+_TABLE_PROPS = frozenset(
+    ("rowspan", "colspan", "vspace", "hspace", "cellspacing", "cellpadding",
+     "border", "align", "valign", "bgcolor", "width", "height"))
+_ALIGN_VALUES = frozenset(("left", "right", "center", "justify", "top",
+                           "middle", "bottom"))
+
+
+def _attr(v: str) -> str:
+    """Attribute-position neutralization: the surrounding text is escaped
+    with quote=False, so values must not be able to close the quote."""
+    return v.replace('"', "%22").replace("'", "%27")
+
+
+def _table_props(spec: str) -> str:
+    """Filter `key="value"`/`key=value` table properties through the
+    allowlist; align/valign values are further value-checked."""
+    keep = []
+    for m in re.finditer(r"([a-zA-Z]+)\s*=\s*\"?([^\s\"]+)\"?", spec):
+        key, val = m.group(1).lower(), m.group(2)
+        if key not in _TABLE_PROPS:
+            continue
+        if key in ("align", "valign") and val.lower() not in _ALIGN_VALUES:
+            continue
+        keep.append(f'{key}="{_attr(val)}"')
+    return (" " + " ".join(keep)) if keep else ""
+
+
+def _media_link(target: str, label: str | None) -> str | None:
+    """[[Image:...]] / [[Youtube:...]] / [[Vimeo:...]] embeds."""
+    low = target.lower()
+    if low.startswith("image:"):
+        src = target[6:].strip()
+        align, caption = "", label
+        if label in ("left", "right", "center"):
+            align, caption = f' align="{label}"', None
+        alt = caption or src.rsplit("/", 1)[-1]
+        return f'<img src="{_attr(src)}" alt="{_attr(alt)}"{align}/>'
+    if low.startswith("youtube:"):
+        vid = _attr(target[8:].strip())
+        return (f'<iframe width="425" height="350" frameborder="0" '
+                f'src="//www.youtube.com/embed/{vid}"></iframe>')
+    if low.startswith("vimeo:"):
+        vid = _attr(target[6:].strip())
+        return (f'<iframe width="425" height="350" frameborder="0" '
+                f'src="//player.vimeo.com/video/{vid}"></iframe>')
+    return None
+
+
+def _inline(line: str) -> str:
+    """Span-level markup inside one line (input already HTML-escaped)."""
+    line = _RE_METADATA.sub("", line)        # {{template}} metadata: drop
+    line = _RE_BOLD_ITALIC.sub(r"<b><i>\1</i></b>", line)
+    line = _RE_BOLD.sub(r"<b>\1</b>", line)
+    line = _RE_ITALIC.sub(r"<i>\1</i>", line)
+    line = _RE_STRIKE.sub(r'<span class="strike">\1</span>', line)
+    line = _RE_UNDERLINE.sub(r'<span class="underline">\1</span>', line)
+
+    def wiki_link(m):
+        target = m.group(1).strip()
+        media = _media_link(target, m.group(2))
+        if media is not None:
+            return media
+        label = m.group(2) or target
+        return (f'<a href="Wiki.html?page={_attr(target)}">{label}</a>')
+
+    line = _RE_LINK_WIKI.sub(wiki_link, line)
+    line = _RE_LINK_EXT.sub(
+        lambda m: f'<a href="{_attr(m.group(1))}" class="extern">'
+                  f'{m.group(2) or m.group(1)}</a>', line)
+    return line
+
+
+def _anchor(title: str) -> str:
+    return _RE_ANCHOR_STRIP.sub("", title.strip().replace(" ", "_"))
+
+
+class _WikiRenderer:
+    """Line-oriented WikiCode renderer with the reference's block model:
+    nested */# lists, ;:-definition lists, :-indent blockquotes, leading-
+    space preformat, {| |} tables, <pre> verbatim blocks, = headings =
+    with anchors and a generated table of contents."""
+
+    def __init__(self):
+        self.out: list[str] = []
+        self.list_stack: list[str] = []     # open "ul"/"ol" nesting
+        self.quote_depth = 0
+        self.in_dl = False
+        self.in_pre_block = False           # <pre>..</pre> verbatim
+        self.in_space_pre = False           # leading-space preformat
+        self.in_table = False
+        self.in_row = False
+        self.headings: list[tuple[int, str, str]] = []  # level, title, anchor
+
+    # -- block-state closers --------------------------------------------------
+
+    def _close_lists(self, depth: int = 0) -> None:
+        while len(self.list_stack) > depth:
+            self.out.append(f"</{self.list_stack.pop()}>")
+
+    def _close_quote(self, depth: int = 0) -> None:
+        while self.quote_depth > depth:
+            self.out.append("</blockquote>")
+            self.quote_depth -= 1
+
+    def _close_dl(self) -> None:
+        if self.in_dl:
+            self.out.append("</dl>")
+            self.in_dl = False
+
+    def _close_space_pre(self) -> None:
+        if self.in_space_pre:
+            self.out.append("</pre>")
+            self.in_space_pre = False
+
+    def _close_row(self) -> None:
+        if self.in_row:
+            self.out.append("</tr>")
+            self.in_row = False
+
+    def _close_blocks(self) -> None:
+        self._close_lists()
+        self._close_quote()
+        self._close_dl()
+        self._close_space_pre()
+
+    # -- table ----------------------------------------------------------------
+
+    def _table_line(self, line: str) -> None:
+        if line.startswith("{|"):
+            self.in_table = True
+            self.out.append(f"<table{_table_props(line[2:])}>")
+            return
+        if line.startswith("|}"):
+            self._close_row()
+            self.out.append("</table>")
+            self.in_table = False
+            return
+        if line.startswith("|-"):
+            self._close_row()
+            self.out.append(f"<tr{_table_props(line[2:])}>")
+            self.in_row = True
+            return
+        if not line.startswith(("|", "!")):
+            # plain content inside {| ... |}: render inline, not as a
+            # cell (a bare line must not lose its first character)
+            if line.strip():
+                self.out.append(_inline(line))
+            return
+        tag = "th" if line.startswith("!") else "td"
+        body = line[1:]
+        sep = "!!" if tag == "th" else "||"
+        if not self.in_row:
+            self.out.append("<tr>")
+            self.in_row = True
+        for cell in body.split(sep):
+            # optional `props | content` prefix inside the cell
+            props = ""
+            if "|" in cell:
+                head, rest = cell.split("|", 1)
+                if head and "=" in head and "[" not in head:
+                    got = _table_props(head)
+                    if got:
+                        props, cell = got, rest
+            self.out.append(f"<{tag}{props}>{_inline(cell.strip())}</{tag}>")
+
+    # -- main loop ------------------------------------------------------------
+
+    def feed(self, raw: str) -> None:
+        line = html.escape(raw.rstrip(), quote=False)
+
+        # verbatim <pre> blocks (escaped form after html.escape)
+        if self.in_pre_block:
+            if line.strip() == "&lt;/pre&gt;":
+                self.out.append("</pre>")
+                self.in_pre_block = False
+            else:
+                self.out.append(line)
+            return
+        if line.strip() == "&lt;pre&gt;":
+            self._close_blocks()
+            self.out.append("<pre>")
+            self.in_pre_block = True
+            return
+
+        if self.in_table:
+            self._table_line(line)
+            return
+        if line.startswith("{|"):
+            self._close_blocks()
+            self._table_line(line)
+            return
+
+        if line.strip() == "----":
+            self._close_blocks()
+            self.out.append("<hr/>")
+            return
+
+        for rex, n in _RE_H:
+            m = rex.match(line)
+            if m:
+                self._close_blocks()
+                title = _inline(m.group(2))
+                anchor = _anchor(re.sub(r"<[^>]+>", "", title))
+                self.headings.append((n, title, anchor))
+                self.out.append(
+                    f'<h{n}><a name="{anchor}"></a>{title}</h{n}>')
+                return
+
+        # nested * / # lists: prefix run of list glyphs sets the depth
+        m = re.match(r"([*#]+)\s*(.*)$", line)
+        if m:
+            glyphs, body = m.group(1), m.group(2)
+            self._close_quote()
+            self._close_dl()
+            self._close_space_pre()
+            want = ["ul" if g == "*" else "ol" for g in glyphs]
+            # unwind where the nesting diverges, then open the rest
+            keep = 0
+            while (keep < len(self.list_stack) and keep < len(want)
+                   and self.list_stack[keep] == want[keep]):
+                keep += 1
+            self._close_lists(keep)
+            for tag in want[keep:]:
+                self.out.append(f"<{tag}>")
+                self.list_stack.append(tag)
+            self.out.append(f"<li>{_inline(body)}</li>")
+            return
+
+        # definition list: ;term:definition  (or continuation ":def")
+        if line.startswith(";"):
+            self._close_lists()
+            self._close_quote()
+            if not self.in_dl:
+                self.out.append("<dl>")
+                self.in_dl = True
+            body = line[1:]
+            if ":" in body:
+                term, desc = body.split(":", 1)
+                self.out.append(f"<dt>{_inline(term.strip())}</dt>"
+                                f"<dd>{_inline(desc.strip())}</dd>")
+            else:
+                self.out.append(f"<dt>{_inline(body.strip())}</dt>")
+            return
+        if self.in_dl and line.startswith(":"):
+            self.out.append(f"<dd>{_inline(line[1:].strip())}</dd>")
+            return
+
+        # ':' indentation → nested blockquotes
+        m = re.match(r"(:+)\s*(.*)$", line)
+        if m:
+            depth, body = len(m.group(1)), m.group(2)
+            self._close_lists()
+            self._close_dl()
+            while self.quote_depth < depth:
+                self.out.append("<blockquote>")
+                self.quote_depth += 1
+            self._close_quote(depth)
+            self.out.append(_inline(body) + "<br/>")
+            return
+
+        # leading space → preformatted code
+        if raw.startswith(" ") and raw.strip():
+            self._close_lists()
+            self._close_quote()
+            self._close_dl()
+            if not self.in_space_pre:
+                self.out.append("<pre>")
+                self.in_space_pre = True
+            self.out.append(line[1:])
+            return
+
+        self._close_blocks()
+        if not line.strip():
+            self.out.append("<p/>")
+        else:
+            self.out.append(_inline(line) + "<br/>")
+
+    def toc(self) -> str:
+        """The reference inserts a WikiTOCBox when a page carries more
+        than one heading."""
+        if len(self.headings) < 2:
+            return ""
+        rows = ['<div class="WikiTOCBox"><b>Contents</b><br/>']
+        top = min(n for n, _, _ in self.headings)
+        for n, title, anchor in self.headings:
+            indent = "&nbsp;" * (4 * (n - top))
+            rows.append(f'{indent}<a href="#{anchor}" class="WikiTOC">'
+                        f"{title}</a><br/>")
+        rows.append("</div>")
+        return "\n".join(rows)
+
+    def html(self) -> str:
+        if self.in_pre_block or self.in_space_pre:
+            self.out.append("</pre>")
+            self.in_pre_block = self.in_space_pre = False
+        if self.in_table:
+            self._close_row()
+            self.out.append("</table>")
+            self.in_table = False
+        self._close_blocks()
+        body = "\n".join(self.out)
+        toc = self.toc()
+        return (toc + "\n" + body) if toc else body
 
 
 def wikicode_to_html(text: str) -> str:
-    """Render the load-bearing WikiCode subset: == headings ==, '''bold''',
-    ''italic'', [[page]] / [[page|label]], [url label], * / # lists,
-    ---- rules, blank-line paragraphs."""
-    out: list[str] = []
-    in_list: str | None = None
-
-    def close_list():
-        nonlocal in_list
-        if in_list:
-            out.append(f"</{in_list}>")
-            in_list = None
-
-    def _attr(v: str) -> str:
-        # tags are escaped below with quote=False; attribute values must
-        # still neutralize quotes so hrefs cannot break out
-        return v.replace('"', "%22").replace("'", "%27")
-
+    """Render full WikiCode: =headings= (1-6) with anchors + TOC,
+    '''''bold-italic'''''/'''bold'''/''italic'', <s>/<u> spans, nested
+    */# lists, ;:-definition lists, :-indent blockquotes, leading-space
+    and <pre> preformat, {| ... |} tables with attribute allowlist,
+    [[page]] / [[page|label]] / [[Image:...]] / [[Youtube:..]] /
+    [[Vimeo:..]], [url label] external links, {{metadata}} removal,
+    ---- rules, blank-line paragraphs (reference:
+    source/net/yacy/data/wiki/WikiCode.java)."""
+    r = _WikiRenderer()
     for raw in text.splitlines():
-        line = html.escape(raw.rstrip(), quote=False)
-        line = _RE_BOLD.sub(r"<b>\1</b>", line)
-        line = _RE_ITALIC.sub(r"<i>\1</i>", line)
-        line = _RE_LINK_WIKI.sub(
-            lambda m: f'<a href="Wiki.html?page={_attr(m.group(1).strip())}">'
-                      f'{m.group(2) or m.group(1)}</a>', line)
-        line = _RE_LINK_EXT.sub(
-            lambda m: f'<a href="{_attr(m.group(1))}">'
-                      f'{m.group(2) or m.group(1)}</a>',
-            line)
-        if line.strip() == "----":
-            close_list()
-            out.append("<hr/>")
-            continue
-        matched_h = False
-        for rex, tag in _RE_H:
-            m = rex.match(line)
-            if m:
-                close_list()
-                out.append(f"<{tag}>{m.group(1)}</{tag}>")
-                matched_h = True
-                break
-        if matched_h:
-            continue
-        if line.startswith(("* ", "# ")):
-            want = "ul" if line[0] == "*" else "ol"
-            if in_list != want:
-                close_list()
-                out.append(f"<{want}>")
-                in_list = want
-            out.append(f"<li>{line[2:]}</li>")
-            continue
-        close_list()
-        if not line.strip():
-            out.append("<p/>")
-        else:
-            out.append(line + "<br/>")
-    close_list()
-    return "\n".join(out)
+        r.feed(raw)
+    return r.html()
 
 
 class WikiBoard:
