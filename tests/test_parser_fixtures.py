@@ -344,3 +344,128 @@ def test_https_error_none_on_healthy_server(tmp_path):
     finally:
         srv.close()
         sb.close()
+
+
+# -- round-5 formats: apk / dwg / mm / sid (fixtures built in-test) -----------
+
+def _axml_pool(strings, utf8=False):
+    """Encode a ResStringPool chunk (the test's independent encoder —
+    the parser must decode what the spec says, not what it wrote)."""
+    blobs, offs, pos = [], [], 0
+    for s in strings:
+        if utf8:
+            b = s.encode("utf-8")
+            assert len(s) < 128 and len(b) < 128
+            blob = bytes((len(s), len(b))) + b + b"\0"
+        else:
+            u = s.encode("utf-16-le")
+            assert len(s) < 0x8000
+            blob = struct.pack("<H", len(s)) + u + b"\0\0"
+        offs.append(pos)
+        blobs.append(blob)
+        pos += len(blob)
+    data = b"".join(blobs)
+    if len(data) % 4:
+        data += b"\0" * (4 - len(data) % 4)
+    header_sz = 28
+    strings_start = header_sz + 4 * len(strings)
+    size = strings_start + len(data)
+    return (struct.pack("<HHIIIIII", 0x0001, header_sz, size,
+                        len(strings), 0, 0x100 if utf8 else 0,
+                        strings_start, 0)
+            + struct.pack(f"<{len(strings)}I", *offs) + data)
+
+
+def _axml_start_element(pool, tag, attrs):
+    si = {s: i for i, s in enumerate(pool)}
+    body = struct.pack("<IIII", 1, 0xFFFFFFFF, 0xFFFFFFFF, si[tag])
+    body += struct.pack("<HHHHHH", 0x14, 20, len(attrs), 0, 0, 0)
+    for k, v in attrs.items():
+        body += struct.pack("<III", 0xFFFFFFFF, si[k], si[v])
+        body += struct.pack("<HBBI", 8, 0, 0x03, si[v])   # TYPE_STRING
+    return struct.pack("<HHI", 0x0102, 16, 8 + len(body)) + body
+
+
+def _axml(utf8=False):
+    pool = ["manifest", "package", "versionName", "uses-permission",
+            "name", "org.example.tpuapp", "5.0",
+            "android.permission.INTERNET"]
+    chunks = _axml_pool(pool, utf8=utf8)
+    chunks += _axml_start_element(pool, "manifest",
+                                  {"package": "org.example.tpuapp",
+                                   "versionName": "5.0"})
+    chunks += _axml_start_element(
+        pool, "uses-permission",
+        {"name": "android.permission.INTERNET"})
+    return struct.pack("<HHI", 0x0003, 8, 8 + len(chunks)) + chunks
+
+
+@pytest.mark.parametrize("utf8", [False, True])
+def test_apk(tmp_path, utf8):
+    import zipfile
+    arsc_pool = _axml_pool(["Visit http://apk.example/home now",
+                            "TPU App"], utf8=utf8)
+    arsc = struct.pack("<HHI", 0x0002, 12, 12 + len(arsc_pool)) \
+        + struct.pack("<I", 1) + arsc_pool
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("AndroidManifest.xml", _axml(utf8=utf8))
+        zf.writestr("resources.arsc", arsc)
+        zf.writestr("classes.dex", b"dex\n035\0")
+    doc = parse_source("http://t/app.apk",
+                       "application/vnd.android.package-archive",
+                       buf.getvalue())[0]
+    assert "org.example.tpuapp" in doc.title and "5.0" in doc.title
+    assert "android.permission.INTERNET" in doc.keywords
+    assert "classes.dex" in doc.text
+    assert any(a.url == "http://apk.example/home" for a in doc.anchors)
+
+
+def test_dwg():
+    body = (b"AC1015" + b"\0" * 58
+            + b"Floor Plan Level Two\0" + b"\x07" * 30
+            + "Projekt München".encode("utf-16-le") + b"\0\0")
+    doc = parse_source("http://t/plan.dwg", "application/dwg", body)[0]
+    assert doc.description == "AutoCAD 2000"
+    assert "Floor Plan Level Two" in doc.text
+    assert "Projekt München" in doc.text
+    import pytest as _pytest
+    from yacy_search_server_tpu.document.parser.appparsers import parse_dwg
+    from yacy_search_server_tpu.document.parser.errors import ParserError
+    with _pytest.raises(ParserError):
+        parse_dwg("http://t/x.dwg", b"XXXXXX not a drawing")
+
+
+def test_mm():
+    mm = ("<map version=\"1.0.1\"><node TEXT=\"Mind Map Root\">"
+          "<node TEXT=\"In München steht ein Hofbräuhaus\">"
+          "<node TEXT=\"child idea\"/></node>"
+          "<node TEXT=\"second branch\"/></node></map>").encode("utf-8")
+    doc = parse_source("http://t/ideas.mm", "application/freemind", mm)[0]
+    assert doc.title == "Mind Map Root"
+    assert "München" in doc.text and "child idea. second branch." in doc.text
+
+
+def test_sid():
+    hdr = bytearray(0x80)
+    hdr[0:4] = b"PSID"
+    struct.pack_into(">H", hdr, 4, 2)          # version 2
+    struct.pack_into(">H", hdr, 14, 3)         # songs
+    hdr[0x16:0x16 + 12] = b"Last Ninja 2"
+    hdr[0x36:0x36 + 11] = b"Matt Gray\0\0"
+    hdr[0x56:0x56 + 9] = b"1988 C64\0"
+    doc = parse_source("http://t/tune.sid", "audio/prs.sid", bytes(hdr))[0]
+    assert doc.title == "Last Ninja 2"
+    assert doc.author == "Matt Gray"
+    assert "1988 C64" in doc.description
+    assert "songs: 3" in doc.text
+
+
+def test_registry_dispatches_31_formats():
+    """The four round-5 formats close the parser zoo: extension dispatch
+    covers every reference registry family (TextParser.java:78-160)."""
+    from yacy_search_server_tpu.document.parser import registry
+    assert {"apk", "dwg", "mm", "sid"} <= set(registry._EXT_PARSERS)
+    families = {f.__name__ for f in registry._EXT_PARSERS.values()} \
+        | {f.__name__ for f in registry._MIME_PARSERS.values()}
+    assert len(families) >= 25

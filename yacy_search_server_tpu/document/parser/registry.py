@@ -21,6 +21,7 @@ import zipfile
 from urllib.parse import urlsplit
 
 from ..document import Document
+from .appparsers import parse_apk, parse_dwg, parse_mm, parse_sid
 from .htmlparser import parse_html
 from .swfparser import parse_swf
 from .pdfparser import parse_pdf
@@ -91,6 +92,13 @@ _MIME_PARSERS = {
     "audio/x-wav": parse_audio, "audio/wav": parse_audio,
     "audio/x-aiff": parse_audio, "audio/mp4": parse_audio,
     "application/x-bittorrent": parse_torrent,
+    # application formats (round 5: the last four registry formats)
+    "application/vnd.android.package-archive": parse_apk,
+    "application/dwg": parse_dwg, "applications/vnd.dwg": parse_dwg,
+    "application/freemind": parse_mm, "application/x-freemind": parse_mm,
+    "audio/prs.sid": parse_sid, "audio/psid": parse_sid,
+    "audio/x-psid": parse_sid, "audio/sidtune": parse_sid,
+    "audio/x-sidtune": parse_sid,
 }
 
 _EXT_PARSERS = {
@@ -115,6 +123,7 @@ _EXT_PARSERS = {
     "flac": parse_audio, "wav": parse_audio, "aiff": parse_audio,
     "aif": parse_audio, "m4a": parse_audio,
     "torrent": parse_torrent,
+    "apk": parse_apk, "dwg": parse_dwg, "mm": parse_mm, "sid": parse_sid,
 }
 
 _ARCHIVE_MIMES = {"application/zip", "application/x-zip-compressed",
