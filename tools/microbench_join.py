@@ -72,14 +72,29 @@ def join_body(member):
 
 
 def bench(fn, args, label, iters=10):
-    out = fn(*args)
-    jax.block_until_ready(out)
+    """Serial per-call time via DATA-DEPENDENT chaining: call i+1's
+    first argument depends on call i's output, so the device cannot
+    overlap them; one device_get at the end, minus one measured trivial
+    round trip. (block_until_ready through the axon tunnel returns at
+    enqueue time, so the naive loop measures dispatch, not execution.)"""
+    targets, rest = args[0], args[1:]
+    out = fn(targets, *rest)
+    jax.device_get(out)
+    # trivial round trip floor (warm shape)
+    x = jnp.zeros(1, jnp.int32)
+    jax.device_get(x + 1)
+    t0 = time.perf_counter()
+    jax.device_get(x + 1)
+    rt = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters * 1000
-    print(f"{label:46s} {dt:9.2f} ms/call")
+        out = fn(targets, *rest)
+        # zero in value, but data-dependent: forces serialization
+        chain = jnp.minimum(jnp.asarray(out[1], jnp.int32).ravel()[0], 0)
+        targets = targets + chain
+    jax.device_get(targets)
+    dt = (time.perf_counter() - t0 - rt) / iters * 1000
+    print(f"{label:46s} {dt:9.2f} ms/call   (rt {rt*1000:.0f} ms)")
     return dt
 
 

@@ -1,0 +1,73 @@
+"""Stage budget of the mesh serving path (VERDICT r4 #4).
+
+Runs the config-10 workload (1M postings, virtual 8-device CPU mesh,
+16 searcher threads) with per-stage timers and prints where each query
+millisecond goes: span resolution, kernel dispatch+fetch, host drain.
+Compare `--batch off` to quantify what cross-query batching buys.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     python tools/profile_mesh.py [--batch off]
+"""
+import argparse
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", default="on", choices=("on", "off"))
+    ap.add_argument("--threads", type=int, default=16)
+    ap.add_argument("--per-thread", type=int, default=6)
+    ap.add_argument("--ndocs", type=int, default=1_000_000)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from bench import _build_served_switchboard, _served_qps
+
+    t0 = time.perf_counter()
+    sb = _build_served_switchboard(args.ndocs, n_terms=8, hosts=256,
+                                   mesh="on")
+    ms = sb.index.devstore
+    print(f"build {time.perf_counter() - t0:.1f}s; store "
+          f"{type(ms).__name__}; batcher {ms._batcher is not None}")
+    if args.batch == "off" and ms._batcher is not None:
+        ms._batcher.close()
+        ms._batcher = None
+
+    # instrument rank_term wall per query
+    walls: list = []
+    orig = ms.rank_term
+
+    def timed_rank_term(*a, **kw):
+        q0 = time.perf_counter()
+        out = orig(*a, **kw)
+        walls.append(time.perf_counter() - q0)
+        return out
+
+    ms.rank_term = timed_rank_term
+
+    lats: list = []
+    qps = _served_qps(sb, k=10, threads=args.threads,
+                      per_thread=args.per_thread, latencies=lats)
+    lats.sort()
+    walls.sort()
+    n = len(lats)
+
+    def pct(v, q):
+        return v[min(len(v) - 1, int(len(v) * q))] * 1000 if v else 0.0
+
+    print(f"\nqps {qps:.1f}  ({n} queries, batch={args.batch})")
+    print(f"end-to-end  p50 {pct(lats, .5):7.1f}ms  p95 {pct(lats, .95):7.1f}ms")
+    print(f"rank_term   p50 {pct(walls, .5):7.1f}ms  p95 {pct(walls, .95):7.1f}ms"
+          f"  (host share p50 ~{pct(lats, .5) - pct(walls, .5):.1f}ms)")
+    print("counters:", ms.counters())
+    sb.close()
+
+
+if __name__ == "__main__":
+    main()

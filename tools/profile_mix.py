@@ -1,0 +1,92 @@
+"""Per-shape latency profile of the config-13 modifier mix.
+
+Runs the exact config-13 protocol but records per-query wall time keyed
+by query shape, so the blend's bottleneck is visible: which shape burns
+the time, and whether it rides the device batcher, the join path, or the
+host metadata path.
+
+Run:  python tools/profile_mix.py [--threads 32]
+"""
+import argparse
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+from bench import _build_served_switchboard  # noqa: E402
+
+
+SHAPES = [
+    ("plain", "benchterm{t}"),
+    ("plain2", "benchterm{t}"),
+    ("lang", "benchterm{t} /language/en"),
+    ("daterange", "daterange:1970-01-02..1972-09-27 benchterm{t}"),
+    ("site", "site:h7.example benchterm{t}"),
+    ("filetype", "filetype:html benchterm{t}"),
+    ("conj", "benchterm{t} benchterm{u}"),
+    ("neg", "benchterm{t} -nosuchword"),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threads", type=int, default=32)
+    ap.add_argument("--per-thread", type=int, default=6)
+    args = ap.parse_args()
+    k = 10
+    sb = _build_served_switchboard(1_000_000, n_terms=8, hosts=256,
+                                   mesh="off")
+    for i, (_, s) in enumerate(SHAPES):
+        t0 = time.perf_counter()
+        sb.search(s.format(t=i % 8, u=(i + 1) % 8), count=k).results()
+        print(f"warm {SHAPES[i][0]:10s} {time.perf_counter() - t0:7.2f}s",
+              flush=True)
+    t0 = time.perf_counter()
+    sb.index.devstore.join_prewarm_wait()
+    print(f"join prewarm wait {time.perf_counter() - t0:7.2f}s", flush=True)
+    sb.search_cache.clear()
+    lat = {name: [] for name, _ in SHAPES}
+    lk = threading.Lock()
+
+    def worker(tid):
+        for j in range(args.per_thread):
+            sb.search_cache.clear()
+            name, s = SHAPES[(tid + j) % len(SHAPES)]
+            q0 = time.perf_counter()
+            ev = sb.search(s.format(t=tid % 8, u=(tid + 1) % 8), count=k)
+            ev.results()
+            dt = time.perf_counter() - q0
+            with lk:
+                lat[name].append(dt)
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(args.threads)]
+    t0 = time.perf_counter()
+    for th in ts:
+        th.start()
+    for th in ts:
+        th.join()
+    wall = time.perf_counter() - t0
+    total = sum(len(v) for v in lat.values())
+    print(f"\ntotal {total} queries in {wall:.2f}s = {total/wall:.1f} q/s")
+    print(f"{'shape':10s} {'n':>4s} {'p50ms':>8s} {'p95ms':>8s} "
+          f"{'max_ms':>8s} {'sum_s':>7s}")
+    for name, v in lat.items():
+        if not v:
+            continue
+        sv = sorted(v)
+        p50 = sv[len(sv) // 2] * 1000
+        p95 = sv[min(len(sv) - 1, int(len(sv) * 0.95))] * 1000
+        print(f"{name:10s} {len(v):4d} {p50:8.1f} {p95:8.1f} "
+              f"{sv[-1]*1000:8.1f} {sum(v):7.2f}")
+    ds = sb.index.devstore
+    print("counters:", ds.counters())
+    if ds._batcher is not None and ds._batcher.slow_log:
+        print("slow dispatches (ms, n_plain, n_join, n_families):")
+        for row in ds._batcher.slow_log:
+            print("   ", row)
+
+
+if __name__ == "__main__":
+    main()

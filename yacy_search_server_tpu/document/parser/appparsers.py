@@ -23,6 +23,7 @@ import io
 import re
 import struct
 import zipfile
+import zlib
 from xml.etree import ElementTree
 
 from ..document import DT_APP, DT_AUDIO, Anchor, Document
@@ -162,8 +163,8 @@ def parse_apk(url: str, content: bytes,
         title = " ".join(x for x in (name, package, version) if x)
         parts.append(title + ".")
         parts.extend(p + "." for p in permissions)
-    except (KeyError, ParserError):
-        pass  # no/undecodable manifest: still index entries + resources
+    except (KeyError, ParserError, zipfile.BadZipFile, zlib.error):
+        pass  # no/undecodable/corrupt manifest: still index the rest
     entries = zf.namelist()
     parts.extend(e + "." for e in entries)
     anchors: list[Anchor] = []
@@ -172,7 +173,7 @@ def parse_apk(url: str, content: bytes,
             parts.append(s + ".")
             for m in _URL_RE.finditer(s):
                 anchors.append(Anchor(url=m.group(0)))
-    except KeyError:
+    except (KeyError, zipfile.BadZipFile, zlib.error):
         pass
     return [Document(
         url=url, mime_type="application/vnd.android.package-archive",

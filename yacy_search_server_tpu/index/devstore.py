@@ -484,6 +484,12 @@ def _join_topk(feats16, flags, docids, dead, jdocids, jpos,
     pos_max = f[:, P.F_POSINTEXT]
     hit_min = f[:, P.F_HITCOUNT]
     flags_or = fl
+    # merge uses exactly TWO partner feature columns; gathering them from
+    # column views instead of whole (NF,) rows cuts the random-HBM
+    # payload per lane ~4x (34 B -> 8 B incl. flags) — the join is
+    # gather-bandwidth-bound at 1M-lane rare spans (r5 mix profile)
+    pos_col = feats16[:, P.F_POSINTEXT]
+    hit_col = feats16[:, P.F_HITCOUNT]
     for t in range(n_inc):
         lo = qargs[base + t]
         cnt = qargs[base + n_inc + t]
@@ -494,10 +500,10 @@ def _join_topk(feats16, flags, docids, dead, jdocids, jpos,
             found, prow = _membership_sorted(jdocids, jpos, lo, inc_ms[t],
                                              dd, v, cnt)
         v &= found
-        pf = feats16[prow].astype(jnp.int32)
-        pos_min = jnp.minimum(pos_min, pf[:, P.F_POSINTEXT])
-        pos_max = jnp.maximum(pos_max, pf[:, P.F_POSINTEXT])
-        hit_min = jnp.minimum(hit_min, pf[:, P.F_HITCOUNT])
+        pp = pos_col[prow].astype(jnp.int32)
+        pos_min = jnp.minimum(pos_min, pp)
+        pos_max = jnp.maximum(pos_max, pp)
+        hit_min = jnp.minimum(hit_min, hit_col[prow].astype(jnp.int32))
         # partner rows for misses gather row 0's flags — mask them out
         flags_or = flags_or | jnp.where(found, flags[prow], 0)
     ebase = base + 3 * n_inc
@@ -537,16 +543,16 @@ def _rank_join_batch_kernel(feats16, flags, docids, dead, jdocids, jpos,
                             authority_coeff, language_pref,
                             k: int, n_inc: int, n_exc: int, r: int,
                             inc_ms: tuple = (), exc_ms: tuple = ()):
-    """Batched conjunctions: lax.map of the join body over stacked
+    """Batched conjunctions: vmap of the join body over stacked
     per-query descriptor vectors (VERDICT r2 weak #2 — join throughput
     must batch like the single-term path; one device round trip serves a
     whole group of concurrent conjunctive searches that share the same
-    bucketed compile shape). Deliberately NOT vmapped: the body is
-    dominated by the membership SORT, which already saturates the chip
-    for one slot — a vmapped variant measured no faster (r4) and
-    multiplies transient memory by the batch width. Conjunctions whose
-    partners all carry join bitmaps take _rank_join_bm_batch_kernel
-    instead, which IS vmapped (gathers parallelize across slots)."""
+    bucketed compile shape). vmapped, NOT lax.map: with serialization
+    measured by data-dependent chaining (tools/microbench_join.py — the
+    r4 enqueue-time measurements undercounted by ~10^4×), the vmapped
+    sort-merge body runs 45 ms/query at bs=4 vs 74 ms under lax.map's
+    serial slots and 347 ms solo; transient sort memory is ×bs but
+    bounded by the batch cap (MAX_JOIN_BATCH)."""
     def one(q):
         return _join_topk(
             feats16, flags, docids, dead, jdocids, jpos, q,
@@ -555,7 +561,7 @@ def _rank_join_batch_kernel(feats16, flags, docids, dead, jdocids, jpos,
             k=k, n_inc=n_inc, n_exc=n_exc, r=r,
             inc_ms=inc_ms, exc_ms=exc_ms)
 
-    return lax.map(one, qargs_batch)
+    return jax.vmap(one)(qargs_batch)
 
 
 @partial(jax.jit, static_argnames=("k", "n_inc", "n_exc", "r",
@@ -575,8 +581,10 @@ def _rank_join_bm_batch_kernel(feats16, flags, docids, dead, jdocids, jpos,
     gathers + elementwise work, so the batch vmaps: all slots gather in
     parallel, ~14 ms/query at bs=16 vs ~25 ms serialized (measured,
     config-8 shapes). A mixed batch (some partner too small for a
-    bitmap) still lax.maps — vmapping a slot that sorts measured slower
-    than running the slots back to back."""
+    bitmap) also vmaps — chained-serialization measurement
+    (tools/microbench_join.py) shows the vmapped sort body beats
+    lax.map's serial slots at every batch width, reversing the r4
+    enqueue-time conclusion."""
     def one(q):
         return _join_topk(
             feats16, flags, docids, dead, jdocids, jpos, q,
@@ -586,9 +594,7 @@ def _rank_join_bm_batch_kernel(feats16, flags, docids, dead, jdocids, jpos,
             inc_ms=inc_ms, exc_ms=exc_ms,
             bmtab=bmtab, inc_bm=inc_bm, exc_bm=exc_bm)
 
-    if all(inc_bm) and all(exc_bm):
-        return jax.vmap(one)(qargs_batch)
-    return lax.map(one, qargs_batch)
+    return jax.vmap(one)(qargs_batch)
 
 
 def _pruned_span_topk(feats16, flags, docids, dead, pmax,
@@ -607,6 +613,11 @@ def _pruned_span_topk(feats16, flags, docids, dead, pmax,
     tail tile must satisfy (pmax << bound_shift) + lang_term <= theta (the
     running k-th score) for the result to be exact. Returns
     (scores, docids, ok); ok=False means the caller escalates b.
+
+    Constraint-filtered queries never reach this body: the proxy bound
+    only holds in the frozen unfiltered-stats score domain, while
+    host-parity scoring normalizes over the FILTERED candidate set
+    (tried and reverted in r5 — the streaming scan serves them).
     """
     stats = {"col_min": col_min, "col_max": col_max,
              "tf_min": tf_min, "tf_max": tf_max,
@@ -1104,6 +1115,18 @@ class _QueryBatcher:
         self.dispatch_ms_max = 0.0
         self.exceptions = 0          # dispatch raised (was silent before)
         self.timeouts = 0            # queries that withdrew after WATCHDOG_S
+        # per-QUERY time series (bounded): the wall of the dispatch a
+        # query rode in, and the kernel-call+fetch wall of its group —
+        # the decomposition that makes the local-attach p50 claim
+        # computable (VERDICT r4 #3: p50_local = host + kernel, with
+        # kernel separated from the tunnel round trip)
+        from collections import deque
+        self._ms_lock = threading.Lock()   # extends race counters() reads
+        self.query_dispatch_ms: "deque" = deque(maxlen=20000)
+        self.query_kernel_ms: "deque" = deque(maxlen=20000)
+        # (ms, n_plain, n_join, n_join_families) of dispatches > 500 ms —
+        # the slow-dispatch composition trace the profiler prints
+        self.slow_log: "deque" = deque(maxlen=100)
         # ONE batch-former + a POOL of dispatcher threads. The former
         # owns the incoming queue, so a concurrent burst lands in FULL
         # batches (competing dispatchers would fragment it ~max_batch/4
@@ -1174,9 +1197,10 @@ class _QueryBatcher:
         kk, n_inc, n_exc, r, inc_ms, exc_ms, inc_bm, exc_bm = statics
         item = {"kind": "join", "arrays": arrays, "join": join_arrays,
                 "dead": dead, "qargs": qargs, "statics": statics,
-                # all-bitmap joins vmap (parallel slots): they batch to
-                # max_batch like pruned queries; sort-merge joins keep
-                # the small cap (serial lax.map slots convoy a batch)
+                # all-bitmap joins (pure gathers) batch to max_batch
+                # like pruned queries; sort-merge joins keep the small
+                # cap (per-query device time is flat past bs=4 while
+                # batch wall and sort memory grow — see MAX_JOIN_BATCH)
                 "joincap": (self.max_batch
                             if (n_inc + n_exc) and all(inc_bm + exc_bm)
                             else self.MAX_JOIN_BATCH),
@@ -1251,10 +1275,20 @@ class _QueryBatcher:
                         break
             while True:
                 if len(batch) >= self.max_batch or joins_full():
-                    self._ready.put(batch)   # full: wait for a slot
+                    # full: hand over, blocking per part until the pool
+                    # frees slots
+                    for part in self._split_parts(batch):
+                        self._ready.put(part)
                     break
                 try:
-                    self._ready.put_nowait(batch)
+                    parts = self._split_parts(batch)
+                    self._ready.put_nowait(parts[0])
+                    # remaining parts (other join families) go to other
+                    # dispatchers — a single dispatcher running families
+                    # back to back serialized the whole mixed load while
+                    # the pool idled (the r4 modifier-mix convoy)
+                    for part in parts[1:]:
+                        self._ready.put(part)
                     break
                 except _queue.Full:
                     # pool saturated: the batch cannot run yet anyway —
@@ -1269,6 +1303,30 @@ class _QueryBatcher:
                         break
                     if self._claim(nxt):
                         batch.append(nxt)
+
+    def _split_parts(self, batch: list[dict]) -> list[list[dict]]:
+        """Partition a formed batch so no dispatcher serializes unrelated
+        device calls: non-join queries in one part (they ride ONE batched
+        kernel), each join compile family (statics + profile + language)
+        in its own part. Families dispatch as separate kernel calls
+        anyway — keeping them in one batch just ran them back to back in
+        one dispatcher while the rest of the pool idled."""
+        plain = [it for it in batch if it.get("kind") != "join"]
+        fams: dict[tuple, list[dict]] = {}
+        for it in batch:
+            if it.get("kind") == "join":
+                key = (it["statics"], it["profile"].to_external_string(),
+                       it["lang"])
+                fams.setdefault(key, []).append(it)
+        parts = [plain] if plain else []
+        for fam in fams.values():
+            # chunk a big family to its batch cap here, not inside one
+            # dispatcher: each chunk is one kernel call, and separate
+            # parts ride separate dispatchers' round trips concurrently
+            cap = min(it.get("joincap", self.MAX_JOIN_BATCH)
+                      for it in fam)
+            parts.extend(fam[i:i + cap] for i in range(0, len(fam), cap))
+        return parts or [batch]
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -1289,8 +1347,15 @@ class _QueryBatcher:
                     it["ev"].set()
             ms = (time.perf_counter() - t0) * 1000.0
             self.dispatches += 1
+            with self._ms_lock:
+                self.query_dispatch_ms.extend([ms] * len(batch))
             if ms > self.dispatch_ms_max:
                 self.dispatch_ms_max = ms
+            if ms > 500.0:
+                joins = [it for it in batch if it.get("kind") == "join"]
+                self.slow_log.append(
+                    (round(ms, 1), len(batch) - len(joins), len(joins),
+                     len({it["statics"] for it in joins})))
             if ms > 1000.0:
                 track(EClass.SEARCH, "SLOWDISPATCH", len(batch), ms)
 
@@ -1348,11 +1413,15 @@ class _QueryBatcher:
             qi, qf, nbs = _pack_batch1(
                 starts, counts, tstarts, tcounts, cmins, cmaxs,
                 tmins, tmaxs, *prune_bound_consts(prof))
+            t0k = time.perf_counter()
             out = _rank_pruned_batch1_kernel(
                 feats16, flags, docids, dead, pmax, qi, qf,
                 *consts, k=kk, maxt=_pmax_window(store._max_tcount),
                 bs=nbs)
             s, d, ok = jax.device_get(out)
+            with self._ms_lock:
+                self.query_kernel_ms.extend(
+                    [(time.perf_counter() - t0k) * 1000.0] * len(items))
             store.prune_rounds += 1
             for i, it in enumerate(items):
                 if bool(ok[i]):
@@ -1363,11 +1432,13 @@ class _QueryBatcher:
             for it in items:
                 it["ev"].set()
 
-    # SORT-MERGE joins per dispatch: that kernel is a lax.map (slots run
-    # SEQUENTIALLY on device — its per-slot footprint is too big to
-    # vmap), so a big join batch serializes in ONE dispatcher while the
-    # pool idles. Cap at 4 and spread the rest across dispatchers.
-    # All-bitmap joins vmap and batch to max_batch (item["joincap"]).
+    # SORT-MERGE join batches cap at 4: the body vmaps (r5 — chained
+    # measurement reversed the r4 lax.map conclusion), but per-query
+    # device time is flat from bs=4 to bs=16 (~45 ms, chip saturated by
+    # the sorts) while the batch WALL and transient sort memory grow
+    # ~linearly — bs=4 keeps each dispatcher's occupancy near one round
+    # trip so the pool pipelines. All-bitmap joins (pure gathers) batch
+    # to max_batch (item["joincap"]).
     MAX_JOIN_BATCH = 4
 
     @staticmethod
@@ -1420,6 +1491,7 @@ class _QueryBatcher:
                     qb = np.zeros((bs, len(first["qargs"])), np.int32)
                     for i, it in enumerate(chunk):
                         qb[i] = it["qargs"]   # pad rows: count 0 -> empty
+                    t0k = time.perf_counter()
                     if any_bm:
                         out = _rank_join_bm_batch_kernel(
                             *first["arrays"], first["dead"],
@@ -1434,6 +1506,10 @@ class _QueryBatcher:
                             qb, *consts, k=kk, n_inc=n_inc, n_exc=n_exc,
                             r=r, inc_ms=inc_ms, exc_ms=exc_ms)
                     s, d = jax.device_get(out)
+                    with self._ms_lock:
+                        self.query_kernel_ms.extend(
+                            [(time.perf_counter() - t0k) * 1000.0]
+                            * len(chunk))
                     for i, it in enumerate(chunk):
                         it["res"] = ("ok", s[i], d[i])
             except Exception:
@@ -1477,6 +1553,13 @@ class DeviceSegmentStore:
         # many conjunctions the device served vs handed to the host join
         self.join_served = 0
         self.join_fallbacks = 0
+        self.join_degraded_plain = 0  # join-shaped, served by rank_term
+        #   (every exclusion was a nonexistent term)
+        # trivial-dispatch round trip to the device (measured at prewarm;
+        # ~110 ms through the axon dev tunnel, ~0 locally attached) — the
+        # tunnel share of every kernel wall, so counters() can emit
+        # tunnel-corrected kernel-ms percentiles (VERDICT r4 #3)
+        self.tunnel_rt_ms = 0.0
         # join compile families whose batch buckets were background-warmed
         self._join_warmed: set = set()
         self._join_prewarm_threads: list = []
@@ -1758,6 +1841,7 @@ class DeviceSegmentStore:
                         *consts, k=kk, n_spans=self.MAX_SPANS,
                         with_delta=False, with_filter=wf)
                     jax.device_get(out)
+            self.measure_tunnel_rt()
             track(EClass.INDEX, "devstore_prewarm", len(kks))
             log.info("prewarm: %d kernel shapes in %.1fs",
                      len(kks) * (len(_PRUNE_B) + 1
@@ -1790,11 +1874,54 @@ class DeviceSegmentStore:
         return (self.arena._cap, self.arena._doc_cap, self.arena._tcap,
                 _pmax_window(self._max_tcount), self._filter_words)
 
+    def measure_tunnel_rt(self, samples: int = 5) -> float:
+        """Floor-estimate the trivial dispatch+fetch round trip to the
+        device (the tunnel/PCIe share of every kernel wall): min of
+        `samples` one-element dispatches on an already-warm shape."""
+        try:
+            x = self.arena._dev(np.zeros(1, np.int32))
+            jax.device_get(x + 1)                    # compile the tiny op
+            best = float("inf")
+            for _ in range(samples):
+                t0 = time.perf_counter()
+                jax.device_get(x + 1)
+                best = min(best, (time.perf_counter() - t0) * 1000.0)
+            self.tunnel_rt_ms = round(best, 1)
+        except Exception:
+            log.exception("tunnel RT measurement failed")
+        return self.tunnel_rt_ms
+
+    @staticmethod
+    def _pctl(series, q: float) -> float:
+        sv = sorted(series)
+        if not sv:
+            return 0.0
+        return round(sv[min(len(sv) - 1, int(len(sv) * q))], 1)
+
     def counters(self) -> dict:
         """Serving-health counters (the headline bench emits these —
-        VERDICT r3 #1: a silent stall must never hide again)."""
+        VERDICT r3 #1: a silent stall must never hide again).
+
+        `dispatch_ms_p50/p95` are per-QUERY walls of the batch dispatch
+        each query rode in; `kernel_ms_p50/p95` are the kernel-call+fetch
+        walls minus the measured trivial round trip (`tunnel_rt_ms`) —
+        i.e. the device-time share that survives on locally-attached
+        hardware, making p50_local = host_ms + kernel_ms_p50 a
+        computable claim rather than arithmetic-by-assertion."""
         b = self._batcher
+        if b:
+            with b._ms_lock:
+                dseries = list(b.query_dispatch_ms)
+                kraw = list(b.query_kernel_ms)
+        else:
+            dseries, kraw = [], []
+        kseries = [max(0.0, v - self.tunnel_rt_ms) for v in kraw]
         return {
+            "tunnel_rt_ms": self.tunnel_rt_ms,
+            "dispatch_ms_p50": self._pctl(dseries, 0.50),
+            "dispatch_ms_p95": self._pctl(dseries, 0.95),
+            "kernel_ms_p50": self._pctl(kseries, 0.50),
+            "kernel_ms_p95": self._pctl(kseries, 0.95),
             "queries_served": self.queries_served,
             "fallbacks": self.fallbacks,
             "prune_rounds": self.prune_rounds,
@@ -1804,6 +1931,7 @@ class DeviceSegmentStore:
             "batch_ineligible": self.batch_ineligible,
             "join_served": self.join_served,
             "join_fallbacks": self.join_fallbacks,
+            "join_degraded_plain": self.join_degraded_plain,
             "batch_dispatches": b.dispatches if b else 0,
             "batch_dispatch_ms_max": round(b.dispatch_ms_max, 1) if b
             else 0.0,
@@ -1923,8 +2051,9 @@ class DeviceSegmentStore:
                   lang_filter: int = NO_LANG, flag_bit: int = NO_FLAG,
                   from_days: int | None = None, to_days: int | None = None):
         """Coverage-counting wrapper around the device conjunction: every
-        eligible-shaped query lands in join_served or join_fallbacks (the
-        mixed-load coverage surface bench config 8 reports)."""
+        eligible-shaped query lands in join_served, join_fallbacks, or
+        join_degraded_plain (the mixed-load coverage surface bench
+        config 8 reports)."""
         out = self._rank_join_impl(
             include_hashes, exclude_hashes, profile, language, k,
             lang_filter, flag_bit, from_days, to_days)
@@ -1932,6 +2061,21 @@ class DeviceSegmentStore:
             with self._lock:
                 self.join_fallbacks += 1
             return None
+        if out == "plain":
+            # every exclusion resolved to a nonexistent term: this is a
+            # single-term query in join clothing — the pruned path
+            # serves it (block-max pruning beats an unpruned join scan).
+            # Counted so the join coverage contract stays a PARTITION:
+            # every join-shaped query lands in exactly one of
+            # join_served / join_fallbacks / join_degraded_plain (a
+            # degraded query that rank_term then declines still counts
+            # only here — its host fallback shows up in `fallbacks`).
+            with self._lock:
+                self.join_degraded_plain += 1
+            return self.rank_term(
+                include_hashes[0], profile, language, k=k,
+                lang_filter=lang_filter, flag_bit=flag_bit,
+                from_days=from_days, to_days=to_days)
         if out is not None:
             with self._lock:
                 self.join_served += 1
@@ -2002,6 +2146,8 @@ class DeviceSegmentStore:
                     self.fallbacks += 1
                     return "declined"
 
+        if len(inc_spans) == 1 and not exc_spans:
+            return "plain"   # all excludes were nonexistent terms
         rare_i = min(range(len(inc_spans)),
                      key=lambda i: inc_spans[i].count)
         rare = inc_spans[rare_i]
@@ -2288,6 +2434,13 @@ class DeviceSegmentStore:
         # transfer is a full round trip, and the round trip IS the latency
         # floor — see BASELINE.md served-path notes)
 
+        # constraint-filtered queries stay on the exact streaming scan:
+        # host-parity semantics normalize scores over the FILTERED
+        # candidate set (ReferenceOrder.normalizeWith over the
+        # accumulated container), and the pruning proxy bound only
+        # holds in the frozen unfiltered-stats score domain — routing
+        # filtered queries through the pruned path was tried in r5 and
+        # reverted (scores diverged ~2.6% from the host oracle).
         no_filters = (lang_filter == NO_LANG and flag_bit == NO_FLAG
                       and from_days is None and to_days is None
                       and allow_bitmap is None)

@@ -50,8 +50,11 @@ read back from device.
 
 from __future__ import annotations
 
+import logging
 import threading
 from functools import partial
+
+log = logging.getLogger("index.meshstore")
 
 import jax
 import jax.numpy as jnp
@@ -167,6 +170,170 @@ class _CellBuf:
             self._tparts = []
 
 
+class _MeshQueryBatcher:
+    """Cross-query batching for the mesh pruned path: concurrent
+    single-term searches that share (profile, language, k) ride ONE
+    vmapped SPMD dispatch (VERDICT r4 #4 — the unbatched mesh paid one
+    full dispatch per query, so 16 searchers serialized; the devstore
+    batcher's former/claim/watchdog pattern applies unchanged, shrunk to
+    the mesh's needs: one dispatcher is enough because the whole mesh is
+    one program)."""
+
+    WATCHDOG_S = 2.0
+    MAX_BATCH = 8
+
+    def __init__(self, store: "MeshSegmentStore",
+                 max_batch: int = MAX_BATCH):
+        import queue as _queue
+        self.store = store
+        self.max_batch = max_batch
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._stop = False
+        self.dispatches = 0
+        self.timeouts = 0
+        self.exceptions = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="meshstore-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _claim(item: dict) -> bool:
+        with item["lk"]:
+            if item["taken"]:
+                return False
+            item["taken"] = True
+            return True
+
+    def submit(self, termhash: bytes, profile, language: str, kk: int):
+        """Blocking; ("ok", scores, docids) | ("prune_fail",) |
+        ("ineligible",) | ("timeout",)."""
+        item = {"th": termhash, "profile": profile, "lang": language,
+                "kk": kk, "ev": threading.Event(), "res": ("ineligible",),
+                "lk": threading.Lock(), "taken": False}
+        self._q.put(item)
+        if item["ev"].wait(timeout=self.WATCHDOG_S):
+            return item["res"]
+        if self._claim(item):
+            self.timeouts += 1
+            return ("timeout",)
+        if item["ev"].wait(timeout=self.WATCHDOG_S):
+            return item["res"]
+        self.timeouts += 1
+        return ("timeout",)
+
+    def close(self) -> None:
+        self._stop = True
+        self._q.put(None)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        return 1 if n <= 1 else (4 if n <= 4 else _MeshQueryBatcher
+                                 .MAX_BATCH)
+
+    def _loop(self) -> None:
+        import queue as _queue
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if not self._claim(item):
+                continue
+            batch = [item]
+            while len(batch) < self.max_batch:
+                try:
+                    nxt = self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)
+                    break
+                if self._claim(nxt):
+                    batch.append(nxt)
+            try:
+                self._dispatch(batch)
+            except Exception:
+                self.exceptions += 1
+                log.exception("mesh batch dispatch failed (%d queries "
+                              "retry solo)", len(batch))
+                for it in batch:
+                    it["res"] = ("ineligible",)
+                    it["ev"].set()
+
+    def _dispatch(self, batch: list[dict]) -> None:
+        store = self.store
+        with store._lock:
+            arrays = store._device_arrays()
+            dead = store._dead_array()
+            pmax = store._dev_pmax
+            spans = {it["th"]: store.spans_for(it["th"]) for it in batch}
+        with store.rwi._lock:
+            tomb = len(store.rwi._tombstones)
+            has_delta = {th: bool(store.rwi._ram.get(th))
+                         for th in spans}
+        groups: dict[tuple, list[dict]] = {}
+        for it in batch:
+            sp = spans[it["th"]]
+            if (sp is None or len(sp) != 1 or sp[0].tcounts is None
+                    or sp[0].tcounts.max() <= 0
+                    or sp[0].dead_seq != tomb or has_delta[it["th"]]):
+                it["ev"].set()       # ("ineligible",): caller goes solo
+                continue
+            it["span"] = sp[0]
+            key = (it["profile"].to_external_string(), it["lang"],
+                   it["kk"])
+            groups.setdefault(key, []).append(it)
+        for (_, lang, kk), items in groups.items():
+            prof = items[0]["profile"]
+            consts = store._profile_consts(prof, lang)
+            shift, lang_term = prune_bound_consts(prof)
+            bs = self._bucket(len(items))
+            nc = store.n_cells
+            qargs = np.zeros((nc, bs, 4), np.int32)   # pad: count 0
+            cmin = np.zeros((bs, P.NF), np.int32)
+            cmax = np.zeros((bs, P.NF), np.int32)
+            tmin = np.zeros(bs, np.float32)
+            tmax = np.zeros(bs, np.float32)
+            for i, it in enumerate(items):
+                sp = it["span"]
+                qargs[:, i, 0] = sp.starts
+                qargs[:, i, 1] = sp.counts
+                qargs[:, i, 2] = sp.tstarts
+                qargs[:, i, 3] = sp.tcounts
+                cmin[i] = sp.stats["col_min"]
+                cmax[i] = sp.stats["col_max"]
+                tmin[i] = sp.stats["tf_min"]
+                tmax[i] = sp.stats["tf_max"]
+            pending = list(range(len(items)))
+            for b in _PRUNE_B:
+                out = store._pbfn(kk, b, bs)(
+                    *arrays, dead, pmax, qargs, cmin, cmax, tmin, tmax,
+                    shift, lang_term, *consts)
+                s, d, ok = jax.device_get(out)
+                self.dispatches += 1
+                store.prune_rounds += 1
+                still = []
+                for i in pending:
+                    if bool(ok[i]):
+                        sp = items[i]["span"]
+                        store.pruned_tiles += int(
+                            np.maximum(sp.tcounts - b, 0).sum())
+                        items[i]["res"] = ("ok", s[i], d[i])
+                        items[i]["ev"].set()
+                        # satisfied slot becomes a free pad slot for the
+                        # escalation rounds (count/tcount 0): the next
+                        # bucket must not re-score it
+                        qargs[:, i, :] = 0
+                    else:
+                        still.append(i)
+                pending = still
+                if not pending:
+                    break
+            for i in pending:          # bound never held: solo full scan
+                items[i]["res"] = ("prune_fail",)
+                items[i]["ev"].set()
+
+
 class MeshSegmentStore:
     """Span registry + SPMD query dispatch over a sharded arena.
 
@@ -214,6 +381,7 @@ class MeshSegmentStore:
         self._profile_key = None
         self._fns: dict[tuple, object] = {}
         self._jfns: dict[tuple, object] = {}
+        self._batcher: _MeshQueryBatcher | None = None
         for docid in rwi._tombstones:
             self.mark_dead(docid)
         for run in list(rwi._runs):
@@ -352,21 +520,33 @@ class MeshSegmentStore:
             for run in list(self.rwi._runs):
                 self.on_run_added(run)
 
-    def enable_batching(self, **_kw) -> None:
-        """Accepted for devstore interface parity; the SPMD dispatch is
-        already one program for the whole mesh (cross-query batching
-        composes later)."""
+    def enable_batching(self, max_batch: int = 8, **_kw) -> None:
+        """Cross-query batching for the pruned path (r5): concurrent
+        eligible searches share one vmapped SPMD dispatch. Extra devstore
+        kwargs (dispatchers) are accepted and ignored — the mesh runs
+        one program, so one dispatcher thread drains the queue."""
+        if self._batcher is None:
+            self._batcher = _MeshQueryBatcher(
+                self, max_batch=min(max_batch,
+                                    _MeshQueryBatcher.MAX_BATCH))
 
     def counters(self) -> dict:
         """Serving-health counters (devstore interface parity)."""
+        b = self._batcher
         return {
             "queries_served": self.queries_served,
             "fallbacks": self.fallbacks,
             "prune_rounds": self.prune_rounds,
             "pruned_tiles": self.pruned_tiles,
+            "batch_dispatches": b.dispatches if b else 0,
+            "batch_timeouts": b.timeouts if b else 0,
+            "batch_exceptions": b.exceptions if b else 0,
         }
 
     def close(self) -> None:
+        if self._batcher is not None:
+            self._batcher.close()
+            self._batcher = None
         if self.rwi.listener is self:
             self.rwi.listener = None
 
@@ -481,6 +661,26 @@ class MeshSegmentStore:
             ))
         return self._fns[key]
 
+    def _pbfn(self, kk: int, b: int, bs: int):
+        key = ("pruned_batch", kk, b, bs)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(jax.shard_map(
+                partial(_mesh_pruned_batch_shard, k=kk, b=b),
+                mesh=self.mesh,
+                in_specs=(PS(("term", "doc"), None, None),   # feats16
+                          PS(("term", "doc"), None),         # flags
+                          PS(("term", "doc"), None),         # docids
+                          PS(),                              # dead
+                          PS(("term", "doc"), None),         # pmax
+                          PS(("term", "doc"), None, None),   # qargs [C,bs,4]
+                          PS(), PS(), PS(), PS(),            # per-q stats
+                          PS(), PS(),                        # shift, lang
+                          PS(), PS(), PS(), PS(), PS(), PS(), PS(), PS()),
+                out_specs=(PS(), PS(), PS()),
+                check_vma=False,
+            ))
+        return self._fns[key]
+
     def _fn(self, kk: int, with_delta: bool):
         key = (kk, with_delta)
         if key not in self._fns:
@@ -538,6 +738,25 @@ class MeshSegmentStore:
                 and spans[0].tcounts is not None
                 and spans[0].tcounts.max() > 0
                 and spans[0].dead_seq == len(self.rwi._tombstones)):
+            # batched dispatch first: concurrent eligible queries ride
+            # one vmapped SPMD program (r4 #4 — the per-query dispatch
+            # serialized concurrent searchers)
+            if (self._batcher is not None
+                    and threading.current_thread()
+                    is not self._batcher._thread):
+                res = self._batcher.submit(termhash, profile, language,
+                                           kk0)
+                if res[0] == "ok":
+                    s, d = res[1], res[2]
+                    keep = (d >= 0) & (s > NEG_INF32)
+                    self.queries_served += 1
+                    return s[keep][:k], d[keep][:k], considered
+                # prune_fail: the batch already walked the full bucket
+                # ladder — go straight to the exact full scan below;
+                # ineligible/timeout continue into the solo ladder
+                batch_prune_failed = res[0] == "prune_fail"
+            else:
+                batch_prune_failed = False
             sp = spans[0]
             st = sp.stats
             consts = self._profile_consts(profile, language)
@@ -545,7 +764,7 @@ class MeshSegmentStore:
             qargs = np.stack([sp.starts, sp.counts,
                               sp.tstarts, sp.tcounts], axis=1
                              ).astype(np.int32)
-            for b in _PRUNE_B:
+            for b in () if batch_prune_failed else _PRUNE_B:
                 out = self._pfn(kk0, b)(
                     arrays[0], arrays[1], arrays[2], dead, pmax, qargs,
                     st["col_min"], st["col_max"],
@@ -959,6 +1178,49 @@ def _mesh_pruned_shard(feats16, flags, docids, dead, pmax, qargs,
     top_s, idx = lax.top_k(gs, min(k, gs.shape[0]))
     all_ok = lax.pmin(ok.astype(jnp.int32), axes) > 0
     return top_s, gd[idx], all_ok
+
+
+def _mesh_pruned_batch_shard(feats16, flags, docids, dead, pmax, qargs,
+                             col_min, col_max, tf_min, tf_max,
+                             bound_shift, lang_term,
+                             norm_coeffs, flag_bits, flag_shifts,
+                             domlength_coeff, tf_coeff, language_coeff,
+                             authority_coeff, language_pref,
+                             *, k: int, b: int):
+    """Batched per-device body of the pruned mesh rank: `bs` concurrent
+    queries vmap over ONE shard_map program — qargs [1, bs, 4] carries
+    each query's local span window on this cell, per-query pack stats
+    ride replicated [bs, ...] rows. Cross-mesh fusion then runs
+    all_gather once for the whole batch (tiled=False keeps the query
+    axis intact) and a vmapped global top-k per slot. This is the mesh
+    form of the devstore batcher's one-round-trip-per-wave contract
+    (VERDICT r4 #4: each mesh query used to pay its own SPMD dispatch,
+    serializing 16 searchers on the dispatch path)."""
+    feats16 = feats16[0]
+    flags = flags[0]
+    docids = docids[0]
+    pmax = pmax[0]
+    q = qargs[0]                         # [bs, 4]
+    axes = ("term", "doc")
+
+    def one(qrow, cmin, cmax, tmin, tmax):
+        return _pruned_span_topk(
+            feats16, flags, docids, dead, pmax,
+            qrow[0], qrow[1], qrow[2], qrow[3],
+            cmin, cmax, tmin, tmax, bound_shift, lang_term,
+            norm_coeffs, flag_bits, flag_shifts, domlength_coeff,
+            tf_coeff, language_coeff, authority_coeff, language_pref,
+            k=k, b=b)
+
+    run_s, run_d, ok = jax.vmap(one)(q, col_min, col_max, tf_min, tf_max)
+    gs = lax.all_gather(run_s, axes)     # [n_dev, bs, k]
+    gd = lax.all_gather(run_d, axes)
+    gs = jnp.moveaxis(gs, 0, 1).reshape(run_s.shape[0], -1)  # [bs, n_dev*k]
+    gd = jnp.moveaxis(gd, 0, 1).reshape(run_d.shape[0], -1)
+    top_s, idx = jax.vmap(lambda s: lax.top_k(s, min(k, s.shape[0])))(gs)
+    top_d = jnp.take_along_axis(gd, idx, axis=1)
+    all_ok = lax.pmin(ok.astype(jnp.int32), axes) > 0        # [bs]
+    return top_s, top_d, all_ok
 
 
 def _mesh_rank_shard(feats16, flags, docids, starts, counts, dead,
