@@ -399,6 +399,10 @@ def _config13_modifier_mix(k=10, ndocs=1_000_000, threads=32):
     # tunnel compile landing mid-run convoys the watchdog
     for i, s in enumerate(shapes):
         sb.search(s.format(t=i % 8, u=(i + 1) % 8), count=k).results()
+    # the first site:/filetype: warm query built the facet bitmap, which
+    # re-keys the filtered kernel shapes and kicks a fresh background
+    # prewarm — wait that out too, or its compiles land mid-measurement
+    sb.index.devstore.prewarm_wait(timeout=900.0)
     sb.index.devstore.join_prewarm_wait()
     sb.search_cache.clear()
     served0 = sb.index.devstore.queries_served

@@ -383,3 +383,27 @@ def test_facet_filter_bitmap_parity():
         assert ds._filter_cache[combo][0] > ver0      # rebuilt, new ver
     finally:
         sb.close()
+
+
+def test_filtered_stats_cache_hit_is_bit_identical():
+    """The repeated-modifier fast path (cached filtered stats skip the
+    stream scan's stats pass) returns exactly the cold path's results,
+    and tombstones invalidate it (snapshot identity keying)."""
+    rng = np.random.default_rng(9)
+    idx = RWIIndex()
+    p = _plist(rng, 3000)
+    p.feats[:1500, P.F_LANGUAGE] = P.pack_language("de")
+    idx.add_many(TH, p)
+    idx.flush()
+    ds = _store(idx)
+    de = P.pack_language("de")
+    cold = ds.rank_term(TH, RankingProfile(), k=50, lang_filter=de)
+    assert ds._span_stats_cache, "stats were not cached"
+    hot = ds.rank_term(TH, RankingProfile(), k=50, lang_filter=de)
+    assert np.array_equal(cold[0], hot[0])
+    assert np.array_equal(cold[1], hot[1])
+    # tombstone moves the snapshot: the stale entry must not be used
+    victim = int(cold[1][0])
+    idx.delete_doc(victim)
+    after = ds.rank_term(TH, RankingProfile(), k=50, lang_filter=de)
+    assert victim not in after[1].tolist()

@@ -1,0 +1,109 @@
+"""Direct (device_get-per-call) kernel timings — the cross-check for the
+chained measurements: T_direct = tunnel_rt + kernel_exec, so
+kernel_exec = T_direct - rt without any chaining machinery.
+
+Run:  python tools/microbench_direct.py
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax                                                   # noqa: E402
+import jax.numpy as jnp                                      # noqa: E402
+
+from yacy_search_server_tpu.index import postings as P       # noqa: E402
+from yacy_search_server_tpu.index.postings import PostingsList  # noqa: E402
+from yacy_search_server_tpu.index.rwi import RWIIndex        # noqa: E402
+from yacy_search_server_tpu.index.devstore import (          # noqa: E402
+    DAYS_NONE_HI, DAYS_NONE_LO, DeviceSegmentStore, NO_FLAG,
+    _pack_batch1, _pmax_window, _rank_pruned_batch1_kernel,
+    _rank_spans_kernel, prune_bound_consts)
+from yacy_search_server_tpu.ops.ranking import RankingProfile  # noqa: E402
+from yacy_search_server_tpu.utils.hashes import word2hash    # noqa: E402
+
+
+def direct(fn, label, iters=6):
+    out = fn()
+    jax.device_get(out)             # warm (compile) + sync
+    x = jnp.zeros(1, jnp.int32)
+    jax.device_get(x + 1)
+    rts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(x + 1)
+        rts.append(time.perf_counter() - t0)
+    rt = min(rts)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.device_get(fn())
+        times.append(time.perf_counter() - t0)
+    best = min(times) * 1000
+    print(f"{label:52s} {best:9.1f} ms/call  (rt {rt*1000:.0f} ms, "
+          f"kernel ~{best - rt*1000:.0f} ms)", flush=True)
+
+
+def main():
+    n = 1_000_000
+    rng = np.random.default_rng(0)
+    feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    docids = np.arange(n, dtype=np.int32)
+    rwi = RWIIndex()
+    th = word2hash("dterm")
+    rwi.ingest_run({th: PostingsList(docids, feats)})
+    ds = DeviceSegmentStore(rwi)
+    print("device:", jax.devices()[0])
+    prof = RankingProfile()
+    consts = ds._profile_consts(prof, "en")
+    with ds._lock:
+        feats16, flags, dd = ds.arena.arrays()
+        dead = ds.arena.dead_array()
+        pmax = ds.arena._pmax
+    sp = ds.spans_for(th)[0]
+    st = sp.stats
+    shift, lang_term = prune_bound_consts(prof)
+
+    bs = 16
+    starts = np.full(bs, sp.start, np.int32)
+    counts = np.full(bs, sp.count, np.int32)
+    tstarts = np.full(bs, sp.tstart, np.int32)
+    tcounts = np.full(bs, sp.tcount, np.int32)
+    cmins = np.tile(st["col_min"], (bs, 1)).astype(np.int32)
+    cmaxs = np.tile(st["col_max"], (bs, 1)).astype(np.int32)
+    tmins = np.full(bs, st["tf_min"], np.float32)
+    tmaxs = np.full(bs, st["tf_max"], np.float32)
+    qi, qf, nbs = _pack_batch1(starts, counts, tstarts, tcounts,
+                               cmins, cmaxs, tmins, tmaxs, shift,
+                               lang_term)
+
+    direct(lambda: _rank_pruned_batch1_kernel(
+        feats16, flags, dd, dead, pmax, qi, qf, *consts,
+        k=16, maxt=_pmax_window(ds._max_tcount), bs=nbs),
+        "pruned b=1 batch bs=16 @1M (direct)")
+
+    zstarts = np.zeros(ds.MAX_SPANS, np.int32)
+    zcounts = np.zeros(ds.MAX_SPANS, np.int32)
+    zstarts[0], zcounts[0] = sp.start, sp.count
+    d_args = (np.zeros((1, P.NF), np.int16), np.zeros(1, np.int32),
+              np.full(1, -1, np.int32))
+
+    direct(lambda: _rank_spans_kernel(
+        feats16, flags, dd, dead, zstarts, zcounts, *d_args,
+        np.zeros(1, np.uint32),
+        np.int32(P.pack_language("en")), np.int32(NO_FLAG),
+        np.int32(DAYS_NONE_LO), np.int32(DAYS_NONE_HI),
+        np.zeros(P.NF, np.int32), np.zeros(P.NF, np.int32),
+        np.float32(0), np.float32(0),
+        *consts, k=16, n_spans=ds.MAX_SPANS,
+        with_delta=False, with_filter=False),
+        "exact stream scan + lang filter @1M (direct)")
+    ds.close()
+
+
+if __name__ == "__main__":
+    main()

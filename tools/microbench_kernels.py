@@ -1,0 +1,136 @@
+"""Serial device-time of each serving kernel at the config-13 shapes.
+
+Data-dependent chaining (the next call's count argument depends on the
+previous result, zero in value) forces the device to serialize calls, so
+ms/call is true execution time, not enqueue time. This is the budget
+behind the modifier-mix blend: which kernel actually owns the device.
+
+Run:  python tools/microbench_kernels.py
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax                                                   # noqa: E402
+import jax.numpy as jnp                                      # noqa: E402
+
+from yacy_search_server_tpu.index import postings as P       # noqa: E402
+from yacy_search_server_tpu.index.postings import PostingsList  # noqa: E402
+from yacy_search_server_tpu.index.rwi import RWIIndex        # noqa: E402
+from yacy_search_server_tpu.index.devstore import (          # noqa: E402
+    DeviceSegmentStore, _PRUNE_B, _pack_batch1, _pmax_window,
+    _rank_pruned_batch1_kernel, _rank_spans_kernel, NO_FLAG, NO_LANG,
+    DAYS_NONE_LO, DAYS_NONE_HI, prune_bound_consts)
+from yacy_search_server_tpu.ops.ranking import RankingProfile  # noqa: E402
+
+
+def chain_bench(fn, label, iters=8):
+    """fn(jitter) -> out where jitter is an int32 scalar (0); successive
+    calls chain through min(out_scalar, 0) so the device serializes."""
+    out = fn(jnp.int32(0))
+    jax.block_until_ready(out)
+    x = jnp.zeros(1, jnp.int32)
+    jax.device_get(x + 1)
+    t0 = time.perf_counter()
+    jax.device_get(x + 1)
+    rt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jit = jnp.int32(0)
+    for _ in range(iters):
+        out = fn(jit)
+        first = jax.tree_util.tree_leaves(out)[0]
+        jit = jnp.minimum(jnp.asarray(first, jnp.int32).ravel()[0], 0)
+    jax.device_get(jit)
+    dt = (time.perf_counter() - t0 - rt) / iters * 1000
+    print(f"{label:52s} {dt:9.1f} ms/call")
+    return dt
+
+
+def main():
+    n = 1_000_000
+    rng = np.random.default_rng(0)
+    feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    docids = np.arange(n, dtype=np.int32)
+    rwi = RWIIndex()
+    from yacy_search_server_tpu.utils.hashes import word2hash
+    th1, th2 = word2hash("kterm1"), word2hash("kterm2")
+    rwi.ingest_run({th1: PostingsList(docids, feats),
+                    th2: PostingsList(docids, feats.copy())})
+    ds = DeviceSegmentStore(rwi)
+    print("device:", jax.devices()[0])
+    prof = RankingProfile()
+    consts = ds._profile_consts(prof, "en")
+    with ds._lock:
+        feats16, flags, dd = ds.arena.arrays()
+        dead = ds.arena.dead_array()
+        pmax = ds.arena._pmax
+    sp = ds.spans_for(th1)[0]
+    st = sp.stats
+    shift, lang_term = prune_bound_consts(prof)
+
+    # 1. b=1 batched pruned kernel, bs=16 (the headline workhorse)
+    bs = 16
+    starts = np.full(bs, sp.start, np.int32)
+    counts = np.full(bs, sp.count, np.int32)
+    tstarts = np.full(bs, sp.tstart, np.int32)
+    tcounts = np.full(bs, sp.tcount, np.int32)
+    cmins = np.tile(st["col_min"], (bs, 1)).astype(np.int32)
+    cmaxs = np.tile(st["col_max"], (bs, 1)).astype(np.int32)
+    tmins = np.full(bs, st["tf_min"], np.float32)
+    tmaxs = np.full(bs, st["tf_max"], np.float32)
+    qi, qf, nbs = _pack_batch1(starts, counts, tstarts, tcounts,
+                               cmins, cmaxs, tmins, tmaxs, shift,
+                               lang_term)
+    qi_d = jnp.asarray(qi)
+
+    def pruned16(jit):
+        return _rank_pruned_batch1_kernel(
+            feats16, flags, dd, dead, pmax, qi_d + jit, jnp.asarray(qf),
+            *consts, k=16, maxt=_pmax_window(ds._max_tcount), bs=nbs)
+
+    d = chain_bench(pruned16, "pruned b=1 batch bs=16 @1M")
+    print(f"{'':52s} {d/bs:9.1f} ms/query")
+
+    # 2. exact streaming scan (the lang/daterange/facet path)
+    zstarts = np.zeros(ds.MAX_SPANS, np.int32)
+    zcounts = np.zeros(ds.MAX_SPANS, np.int32)
+    zstarts[0], zcounts[0] = sp.start, sp.count
+    d_args = (jnp.zeros((1, P.NF), jnp.int16), jnp.zeros(1, jnp.int32),
+              jnp.full(1, -1, jnp.int32))
+    zs = jnp.asarray(zstarts)
+
+    def stream(jit):
+        return _rank_spans_kernel(
+            feats16, flags, dd, dead, zs + jit, jnp.asarray(zcounts),
+            *d_args, jnp.zeros(1, jnp.uint32),
+            jnp.int32(P.pack_language("en")), jnp.int32(NO_FLAG),
+            jnp.int32(DAYS_NONE_LO), jnp.int32(DAYS_NONE_HI),
+            np.zeros(P.NF, np.int32), np.zeros(P.NF, np.int32),
+            np.float32(0), np.float32(0),
+            *consts, k=16, n_spans=ds.MAX_SPANS,
+            with_delta=False, with_filter=False)
+
+    chain_bench(stream, "exact stream scan + lang filter @1M")
+
+    # 3. device conjunction through the public path (bitmap membership)
+    t0 = time.perf_counter()
+    out = ds.rank_join([th1, th2], [], prof, "en", k=10)
+    assert out is not None
+    print(f"{'join via rank_join (incl host+fetch), warm':52s} "
+          f"{(time.perf_counter() - t0) * 1000:9.1f} ms (one-shot)")
+    t0 = time.perf_counter()
+    iters = 5
+    for _ in range(iters):
+        ds.rank_join([th1, th2], [], prof, "en", k=10)
+    print(f"{'join via rank_join steady (serialized fetches)':52s} "
+          f"{(time.perf_counter() - t0) / iters * 1000:9.1f} ms/query")
+    ds.close()
+
+
+if __name__ == "__main__":
+    main()

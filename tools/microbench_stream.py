@@ -1,0 +1,173 @@
+"""Bisect the stream-scan kernel's 2.2 s/1M anomaly: size scaling,
+loop-vs-flat structure, and the cardinal scoring epilogue in isolation.
+
+Run:  python tools/microbench_stream.py
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax                                                   # noqa: E402
+import jax.numpy as jnp                                      # noqa: E402
+from jax import lax                                          # noqa: E402
+
+from yacy_search_server_tpu.index import postings as P       # noqa: E402
+from yacy_search_server_tpu.ops.ranking import (             # noqa: E402
+    RankingProfile, cardinal_from_stats, local_stats)
+
+TILE = 32_768
+
+
+def chain(fn, label, iters=8):
+    out = fn(jnp.int32(0))
+    jax.block_until_ready(out)
+    x = jnp.zeros(1, jnp.int32)
+    jax.device_get(x + 1)
+    t0 = time.perf_counter()
+    jax.device_get(x + 1)
+    rt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jit = jnp.int32(0)
+    for _ in range(iters):
+        out = fn(jit)
+        first = jax.tree_util.tree_leaves(out)[0]
+        jit = jnp.minimum(jnp.asarray(first, jnp.int32).ravel()[0], 0)
+    jax.device_get(jit)
+    dt = (time.perf_counter() - t0 - rt) / iters * 1000
+    print(f"{label:56s} {dt:9.1f} ms/call", flush=True)
+    return dt
+
+
+def consts_for(profile, language):
+    from yacy_search_server_tpu.ops.ranking import _coeff_arrays
+    return _coeff_arrays(profile, language)
+
+
+def main():
+    print("device:", jax.devices()[0])
+    n = 1_000_000
+    rng = np.random.default_rng(0)
+    f32 = rng.integers(0, 1000, (n, P.NF)).astype(np.int16)
+    fl = rng.integers(0, 2 ** 20, n).astype(np.int32)
+    dd = np.arange(n, dtype=np.int32)
+    feats16 = jnp.asarray(f32)
+    flags = jnp.asarray(fl)
+    docids = jnp.asarray(dd)
+    dead = jnp.zeros(1 << 16, bool)
+
+    prof = RankingProfile()
+    lang = P.pack_language("en")
+    big, small = jnp.int32(2**31 - 1), jnp.int32(-(2**31 - 1))
+
+    # stats for the scoring-only kernels
+    host_stats = {"col_min": jnp.asarray(np.min(f32, 0).astype(np.int32)),
+                  "col_max": jnp.asarray(np.max(f32, 0).astype(np.int32)),
+                  "tf_min": jnp.float32(0.0), "tf_max": jnp.float32(1.0),
+                  "host_counts": jnp.zeros((1,), jnp.int32)}
+
+    try:
+        from yacy_search_server_tpu.index.devstore import (
+            DeviceSegmentStore)
+        from yacy_search_server_tpu.index.rwi import RWIIndex
+        from yacy_search_server_tpu.index.postings import PostingsList
+        from yacy_search_server_tpu.utils.hashes import word2hash
+        rwi = RWIIndex()
+        rwi.ingest_run({word2hash("sterm"):
+                        PostingsList(dd, f32.astype(np.int32))})
+        ds = DeviceSegmentStore(rwi)
+        consts = ds._profile_consts(prof, "en")
+    except Exception as e:
+        print("consts via store failed:", e)
+        return
+
+    # A) one flat pass: stats reduction over the whole 1M block
+    @jax.jit
+    def flat_stats(jit):
+        f = feats16.astype(jnp.int32) + jit
+        return (jnp.min(f, axis=0), jnp.max(f, axis=0))
+
+    chain(flat_stats, "A flat min/max stats @1M (one pass, no loop)")
+
+    # B) flat cardinal scoring + topk over the whole 1M block
+    @jax.jit
+    def flat_score(jit):
+        v = jnp.ones(n, bool)
+        sc = cardinal_from_stats(
+            feats16.astype(jnp.int32) + jit, v,
+            jnp.zeros(n, jnp.int32), host_stats, *consts,
+            fast_div=True, flags=flags)
+        return lax.top_k(sc, 16)
+
+    chain(flat_score, "B flat cardinal+topk @1M (one pass)")
+
+    # C) fori_loop of 31 tiles: stats only (the stream pass-1 shape)
+    @jax.jit
+    def loop_stats(jit):
+        def body(i, st):
+            off = i * TILE + jit
+            f = lax.dynamic_slice(feats16, (off, 0),
+                                  (TILE, P.NF)).astype(jnp.int32)
+            return (jnp.minimum(st[0], jnp.min(f, 0)),
+                    jnp.maximum(st[1], jnp.max(f, 0)))
+        init = (jnp.full((P.NF,), big), jnp.full((P.NF,), small))
+        return lax.fori_loop(0, 31, body, init)
+
+    chain(loop_stats, "C fori_loop 31 tiles: stats only")
+
+    # D) fori_loop of 31 tiles: cardinal + running topk (pass-2 shape)
+    @jax.jit
+    def loop_score(jit):
+        def body(i, run):
+            off = i * TILE + jit
+            f = lax.dynamic_slice(feats16, (off, 0),
+                                  (TILE, P.NF)).astype(jnp.int32)
+            flt = lax.dynamic_slice(flags, (off,), (TILE,))
+            ddt = lax.dynamic_slice(docids, (off,), (TILE,))
+            v = jnp.ones(TILE, bool)
+            sc = cardinal_from_stats(f, v, jnp.zeros(TILE, jnp.int32),
+                                     host_stats, *consts,
+                                     fast_div=True, flags=flt)
+            ts, ti = lax.top_k(sc, 16)
+            s = jnp.concatenate([run[0], ts])
+            d = jnp.concatenate([run[1], ddt[ti]])
+            top_s, idx = lax.top_k(s, 16)
+            return top_s, d[idx]
+        init = (jnp.full((16,), -(2**31 - 1), jnp.int32),
+                jnp.full((16,), -1, jnp.int32))
+        return lax.fori_loop(0, 31, body, init)
+
+    chain(loop_score, "D fori_loop 31 tiles: cardinal + running topk")
+
+    # E) the real stream kernel for comparison
+    from yacy_search_server_tpu.index.devstore import (
+        _rank_spans_kernel, NO_FLAG, DAYS_NONE_LO, DAYS_NONE_HI)
+    zstarts = np.zeros(ds.MAX_SPANS, np.int32)
+    zcounts = np.zeros(ds.MAX_SPANS, np.int32)
+    zcounts[0] = n
+    d_args = (jnp.zeros((1, P.NF), jnp.int16), jnp.zeros(1, jnp.int32),
+              jnp.full(1, -1, jnp.int32))
+    with ds._lock:
+        af, afl, add_ = ds.arena.arrays()
+        adead = ds.arena.dead_array()
+    zs = jnp.asarray(zstarts)
+
+    def stream(jit):
+        return _rank_spans_kernel(
+            af, afl, add_, adead, zs + jit, jnp.asarray(zcounts),
+            *d_args, jnp.zeros(1, jnp.uint32),
+            jnp.int32(lang), jnp.int32(NO_FLAG),
+            jnp.int32(DAYS_NONE_LO), jnp.int32(DAYS_NONE_HI),
+            np.zeros(P.NF, np.int32), np.zeros(P.NF, np.int32),
+            np.float32(0), np.float32(0),
+            *consts, k=16, n_spans=ds.MAX_SPANS,
+            with_delta=False, with_filter=False)
+
+    chain(stream, "E real _rank_spans_kernel @1M")
+    ds.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -43,8 +43,9 @@ def main():
         print(f"warm {SHAPES[i][0]:10s} {time.perf_counter() - t0:7.2f}s",
               flush=True)
     t0 = time.perf_counter()
+    sb.index.devstore.prewarm_wait(timeout=900.0)   # re-keyed by bitmap
     sb.index.devstore.join_prewarm_wait()
-    print(f"join prewarm wait {time.perf_counter() - t0:7.2f}s", flush=True)
+    print(f"prewarm wait {time.perf_counter() - t0:7.2f}s", flush=True)
     sb.search_cache.clear()
     lat = {name: [] for name, _ in SHAPES}
     lk = threading.Lock()

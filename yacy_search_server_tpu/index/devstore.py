@@ -249,16 +249,19 @@ def _bitmap_member(allow, dd):
 
 
 @partial(jax.jit,
-         static_argnames=("k", "n_spans", "with_delta", "with_filter"))
+         static_argnames=("k", "n_spans", "with_delta", "with_filter",
+                          "with_ext_stats"))
 def _rank_spans_kernel(feats16, flags, docids, dead,
                        starts, counts,
                        d_feats16, d_flags, d_docids, allow,
                        lang_filter, flag_bit, from_days, to_days,
+                       ext_cmin, ext_cmax, ext_tfmin, ext_tfmax,
                        norm_coeffs, flag_bits, flag_shifts,
                        domlength_coeff, tf_coeff, language_coeff,
                        authority_coeff, language_pref,
                        k: int, n_spans: int, with_delta: bool,
-                       with_filter: bool = False):
+                       with_filter: bool = False,
+                       with_ext_stats: bool = False):
     """Score up to `n_spans` arena extents (+ an optional delta block) and
     return the global top-k. Two streamed passes: stats, then score+top-k.
 
@@ -268,7 +271,13 @@ def _rank_spans_kernel(feats16, flags, docids, dead,
     arrays). `with_filter` masks rows to the `allow` docid bitmap — the
     device path for site:/tld:/filetype:/protocol modifiers (these used
     to be host-only; VERDICT r3 #5 widening).
-    """
+
+    Returns (scores[k], docids[k], cmin, cmax, tfmin, tfmax) — the
+    filtered-set stats ride back so the host can CACHE them per
+    (term, filters, snapshot): a repeated modifier query then passes
+    them in (`with_ext_stats=True`, the ext_* args) and the kernel
+    skips pass 1 entirely — exact same normalization domain, half the
+    streamed reads (r5; the modifier mix is stream-scan-bound)."""
     def tile_of(span_start, span_count, i):
         off = span_start + i * TILE
         f = lax.dynamic_slice(feats16, (off, 0), (TILE, P.NF))
@@ -298,22 +307,36 @@ def _rank_spans_kernel(feats16, flags, docids, dead,
             return merge_stats(st, stats_of(f, v))
         return lax.fori_loop(0, n_tiles, body, carry)
 
-    big, small = jnp.int32(2 ** 31 - 1), jnp.int32(-(2 ** 31 - 1))
-    stats = {"col_min": jnp.full((P.NF,), big),
-             "col_max": jnp.full((P.NF,), small),
-             "tf_min": jnp.float32(jnp.inf), "tf_max": jnp.float32(-jnp.inf),
-             "host_counts": jnp.zeros((1,), jnp.int32)}
-    for s in range(n_spans):
-        stats = span_stats(stats, s)
-    if with_delta:
-        d_n = d_docids.shape[0]
-        d_v = _tile_valid(d_docids, dead, jnp.ones(d_n, bool))
-        d_v &= _constraint_valid(d_feats16, d_flags, lang_filter, flag_bit,
-                                 from_days, to_days)
-        if with_filter:
-            d_v &= _bitmap_member(allow, d_docids)
-        d_st = stats_of(d_feats16, d_v)
-        stats = merge_stats(stats, d_st)
+    if with_ext_stats:
+        stats = {"col_min": ext_cmin, "col_max": ext_cmax,
+                 "tf_min": ext_tfmin, "tf_max": ext_tfmax,
+                 "host_counts": jnp.zeros((1,), jnp.int32)}
+        if with_delta:
+            d_n = d_docids.shape[0]
+            d_v = _tile_valid(d_docids, dead, jnp.ones(d_n, bool))
+            d_v &= _constraint_valid(d_feats16, d_flags, lang_filter,
+                                     flag_bit, from_days, to_days)
+            if with_filter:
+                d_v &= _bitmap_member(allow, d_docids)
+    else:
+        big = jnp.int32(2 ** 31 - 1)
+        small = jnp.int32(-(2 ** 31 - 1))
+        stats = {"col_min": jnp.full((P.NF,), big),
+                 "col_max": jnp.full((P.NF,), small),
+                 "tf_min": jnp.float32(jnp.inf),
+                 "tf_max": jnp.float32(-jnp.inf),
+                 "host_counts": jnp.zeros((1,), jnp.int32)}
+        for s in range(n_spans):
+            stats = span_stats(stats, s)
+        if with_delta:
+            d_n = d_docids.shape[0]
+            d_v = _tile_valid(d_docids, dead, jnp.ones(d_n, bool))
+            d_v &= _constraint_valid(d_feats16, d_flags, lang_filter,
+                                     flag_bit, from_days, to_days)
+            if with_filter:
+                d_v &= _bitmap_member(allow, d_docids)
+            d_st = stats_of(d_feats16, d_v)
+            stats = merge_stats(stats, d_st)
 
     # -- pass 2: score tiles, merge running top-k ---------------------------
     def score_rows(f, fl, v):
@@ -350,7 +373,8 @@ def _rank_spans_kernel(feats16, flags, docids, dead,
         sc = score_rows(d_feats16, d_flags, d_v)
         tile_s, tile_i = lax.top_k(sc, min(k, sc.shape[0]))
         run = merge_topk(run, tile_s, d_docids[tile_i])
-    return run
+    return run + (stats["col_min"], stats["col_max"],
+                  stats["tf_min"], stats["tf_max"])
 
 
 # docids are bounded below 2^29 so key = docid*2+tag fits int32 (the
@@ -547,12 +571,15 @@ def _rank_join_batch_kernel(feats16, flags, docids, dead, jdocids, jpos,
     per-query descriptor vectors (VERDICT r2 weak #2 — join throughput
     must batch like the single-term path; one device round trip serves a
     whole group of concurrent conjunctive searches that share the same
-    bucketed compile shape). vmapped, NOT lax.map: with serialization
-    measured by data-dependent chaining (tools/microbench_join.py — the
-    r4 enqueue-time measurements undercounted by ~10^4×), the vmapped
-    sort-merge body runs 45 ms/query at bs=4 vs 74 ms under lax.map's
-    serial slots and 347 ms solo; transient sort memory is ×bs but
-    bounded by the batch cap (MAX_JOIN_BATCH)."""
+    bucketed compile shape). vmapped, NOT lax.map: chained-serialization
+    measurement (tools/microbench_join.py) shows the vmapped body
+    consistently beats lax.map's serial slots at every batch width
+    (~1.6× at bs=4 under the same measurement overhead; chained
+    ABSOLUTE numbers carry a constant per-call sync cost through the
+    dev tunnel, so only their ratios are meaningful —
+    tools/microbench_direct.py is the absolute-time cross-check).
+    Transient sort memory is ×bs but bounded by the batch cap
+    (MAX_JOIN_BATCH)."""
     def one(q):
         return _join_topk(
             feats16, flags, docids, dead, jdocids, jpos, q,
@@ -579,12 +606,11 @@ def _rank_join_bm_batch_kernel(feats16, flags, docids, dead, jdocids, jpos,
     in the building — config 8 and the modifier mix were bounded by its
     serial slots). When EVERY membership is bitmap-mode the body is pure
     gathers + elementwise work, so the batch vmaps: all slots gather in
-    parallel, ~14 ms/query at bs=16 vs ~25 ms serialized (measured,
-    config-8 shapes). A mixed batch (some partner too small for a
-    bitmap) also vmaps — chained-serialization measurement
-    (tools/microbench_join.py) shows the vmapped sort body beats
-    lax.map's serial slots at every batch width, reversing the r4
-    enqueue-time conclusion."""
+    parallel. A mixed batch (some partner too small for a bitmap) also
+    vmaps — chained-serialization RATIOS (tools/microbench_join.py)
+    show the vmapped sort body beats lax.map's serial slots at every
+    batch width, reversing the r4 conclusion (absolute chained numbers
+    carry a constant tunnel sync cost; see microbench_direct.py)."""
     def one(q):
         return _join_topk(
             feats16, flags, docids, dead, jdocids, jpos, q,
@@ -1433,12 +1459,12 @@ class _QueryBatcher:
                 it["ev"].set()
 
     # SORT-MERGE join batches cap at 4: the body vmaps (r5 — chained
-    # measurement reversed the r4 lax.map conclusion), but per-query
-    # device time is flat from bs=4 to bs=16 (~45 ms, chip saturated by
-    # the sorts) while the batch WALL and transient sort memory grow
-    # ~linearly — bs=4 keeps each dispatcher's occupancy near one round
-    # trip so the pool pipelines. All-bitmap joins (pure gathers) batch
-    # to max_batch (item["joincap"]).
+    # ratios reversed the r4 lax.map conclusion), but per-query device
+    # time is flat past bs=4 (chip saturated by the sorts) while the
+    # batch WALL and transient sort memory grow ~linearly — bs=4 keeps
+    # each dispatcher's occupancy near one round trip so the pool
+    # pipelines. All-bitmap joins (pure gathers) batch to max_batch
+    # (item["joincap"]).
     MAX_JOIN_BATCH = 4
 
     @staticmethod
@@ -1555,6 +1581,11 @@ class DeviceSegmentStore:
         self.join_fallbacks = 0
         self.join_degraded_plain = 0  # join-shaped, served by rank_term
         #   (every exclusion was a nonexistent term)
+        # (term, filters, snapshot ids) -> filtered normalization stats;
+        # lets a repeated modifier query skip the stream scan's stats
+        # pass (bounded; cleared wholesale when full — snapshot churn
+        # invalidates by id anyway)
+        self._span_stats_cache: dict = {}
         # trivial-dispatch round trip to the device (measured at prewarm;
         # ~110 ms through the axon dev tunnel, ~0 locally attached) — the
         # tunnel share of every kernel wall, so counters() can emit
@@ -1790,7 +1821,27 @@ class DeviceSegmentStore:
         10-40 s, which round 3 paid mid-run on the first batch-dispatch
         failure (the 12-36 s p95 stalls of BENCH_r03). Dummy dispatches
         carry count-0 descriptors, so each costs one compile + one empty
-        round trip. kks default to PREWARM_KKS (see its derivation)."""
+        round trip. kks default to PREWARM_KKS (see its derivation).
+
+        Each shape warms independently with one retry: a transient
+        remote-compile RPC failure must not abort the whole pass and
+        leave every LATER shape cold (observed through the dev tunnel:
+        one 'response body closed' error cost the entire warm set and
+        resurfaced 10-30 s mid-run compiles)."""
+        def warm(call) -> bool:
+            for attempt in (1, 2):
+                try:
+                    jax.device_get(call())
+                    return True
+                except Exception:
+                    if attempt == 2:
+                        log.exception(
+                            "prewarm shape failed twice; skipping "
+                            "(first live use will compile it)")
+                        return False
+                    time.sleep(1.0)
+            return False
+
         try:
             t0 = time.perf_counter()
             with self._lock:
@@ -1811,16 +1862,14 @@ class DeviceSegmentStore:
             for kk in kks:
                 # the steady-state b=1 vmapped kernel at the CURRENT
                 # span-size bucket, then the escalation buckets
-                out = _rank_pruned_batch1_kernel(
+                warm(lambda kk=kk: _rank_pruned_batch1_kernel(
                     feats16, flags, docids, dead, pmax, qi, qf,
-                    *consts, k=kk, maxt=_pmax_window(max_tc), bs=nbs)
-                jax.device_get(out)
+                    *consts, k=kk, maxt=_pmax_window(max_tc), bs=nbs))
                 for b in _PRUNE_B[1:]:
-                    out = _rank_pruned_batch_kernel(
+                    warm(lambda kk=kk, b=b: _rank_pruned_batch_kernel(
                         feats16, flags, docids, dead, pmax,
                         zi, zi, zi, zi, zc, zc, zf, zf,
-                        shift, lang_term, *consts, k=kk, b=b)
-                    jax.device_get(out)
+                        shift, lang_term, *consts, k=kk, b=b))
                 # the exact streaming scan (constraint filters and
                 # exhausted pruning take this path; delta shapes have
                 # their own buckets and stay first-use), plus its
@@ -1831,16 +1880,22 @@ class DeviceSegmentStore:
                     variants.append(
                         (np.zeros(self._filter_words, np.uint32), True))
                 for allow, wf in variants:
-                    out = _rank_spans_kernel(
-                        feats16, flags, docids, dead,
-                        np.zeros(self.MAX_SPANS, np.int32),
-                        np.zeros(self.MAX_SPANS, np.int32), *d_args,
-                        allow,
-                        np.int32(NO_LANG), np.int32(NO_FLAG),
-                        np.int32(DAYS_NONE_LO), np.int32(DAYS_NONE_HI),
-                        *consts, k=kk, n_spans=self.MAX_SPANS,
-                        with_delta=False, with_filter=wf)
-                    jax.device_get(out)
+                    zero_ext = (np.zeros(P.NF, np.int32),
+                                np.zeros(P.NF, np.int32),
+                                np.float32(0), np.float32(0))
+                    for ext in (False, True):  # + the cached-stats twin
+                        warm(lambda allow=allow, wf=wf, ext=ext, kk=kk:
+                             _rank_spans_kernel(
+                                 feats16, flags, docids, dead,
+                                 np.zeros(self.MAX_SPANS, np.int32),
+                                 np.zeros(self.MAX_SPANS, np.int32),
+                                 *d_args, allow,
+                                 np.int32(NO_LANG), np.int32(NO_FLAG),
+                                 np.int32(DAYS_NONE_LO),
+                                 np.int32(DAYS_NONE_HI), *zero_ext,
+                                 *consts, k=kk, n_spans=self.MAX_SPANS,
+                                 with_delta=False, with_filter=wf,
+                                 with_ext_stats=ext))
             self.measure_tunnel_rt()
             track(EClass.INDEX, "devstore_prewarm", len(kks))
             log.info("prewarm: %d kernel shapes in %.1fs",
@@ -2281,26 +2336,10 @@ class DeviceSegmentStore:
 
         def run():
             try:
-                t0 = time.perf_counter()
-                any_bm = any(inc_bm) or any(exc_bm)
-                consts = self._profile_consts(profile, language)
-                jdocids, jpos = join[0], join[1]
-                for bs in sorted(caps):
-                    qb = np.zeros((bs, qlen), np.int32)
-                    if any_bm:
-                        out = _rank_join_bm_batch_kernel(
-                            *arrays, dead, jdocids, jpos, join[2], qb,
-                            *consts, k=kk, n_inc=n_inc, n_exc=n_exc, r=r,
-                            inc_ms=inc_ms, exc_ms=exc_ms,
-                            inc_bm=inc_bm, exc_bm=exc_bm)
-                    else:
-                        out = _rank_join_batch_kernel(
-                            *arrays, dead, jdocids, jpos, qb,
-                            *consts, k=kk, n_inc=n_inc, n_exc=n_exc,
-                            r=r, inc_ms=inc_ms, exc_ms=exc_ms)
-                    jax.device_get(out)
-                track(EClass.SEARCH, "join_prewarm", len(caps),
-                      time.perf_counter() - t0)
+                self._join_prewarm_body(arrays, join, dead, kk, n_inc,
+                                        n_exc, r, inc_ms, exc_ms, inc_bm,
+                                        exc_bm, caps, qlen, profile,
+                                        language)
             except Exception:
                 log.exception("join shape prewarm failed (buckets will "
                               "compile on first use instead)")
@@ -2314,6 +2353,43 @@ class DeviceSegmentStore:
                 x for x in self._join_prewarm_threads if x.is_alive()]
             self._join_prewarm_threads.append(t)
         t.start()
+
+    def _join_prewarm_body(self, arrays, join, dead, kk, n_inc, n_exc, r,
+                           inc_ms, exc_ms, inc_bm, exc_bm, caps, qlen,
+                           profile, language) -> None:
+        t0 = time.perf_counter()
+        any_bm = any(inc_bm) or any(exc_bm)
+        consts = self._profile_consts(profile, language)
+        jdocids, jpos = join[0], join[1]
+        for bs in sorted(caps):
+            qb = np.zeros((bs, qlen), np.int32)
+            # per-bucket retry: one transient remote-compile RPC
+            # failure must not leave the LATER buckets cold
+            for attempt in (1, 2):
+                try:
+                    if any_bm:
+                        out = _rank_join_bm_batch_kernel(
+                            *arrays, dead, jdocids, jpos, join[2],
+                            qb, *consts, k=kk, n_inc=n_inc,
+                            n_exc=n_exc, r=r,
+                            inc_ms=inc_ms, exc_ms=exc_ms,
+                            inc_bm=inc_bm, exc_bm=exc_bm)
+                    else:
+                        out = _rank_join_batch_kernel(
+                            *arrays, dead, jdocids, jpos, qb,
+                            *consts, k=kk, n_inc=n_inc, n_exc=n_exc,
+                            r=r, inc_ms=inc_ms, exc_ms=exc_ms)
+                    jax.device_get(out)
+                    break
+                except Exception:
+                    if attempt == 2:
+                        log.exception(
+                            "join bucket %d prewarm failed twice; "
+                            "skipping (first use compiles it)", bs)
+                    else:
+                        time.sleep(1.0)
+        track(EClass.SEARCH, "join_prewarm", len(caps),
+              time.perf_counter() - t0)
 
     def join_prewarm_wait(self, timeout: float = 600.0) -> bool:
         """Block until every in-flight join-family prewarm finishes (a
@@ -2506,16 +2582,53 @@ class DeviceSegmentStore:
                      else np.zeros(1, np.uint32))
             if allow_bitmap is not None:
                 self.filtered_served += 1
+            # filtered-stats cache: the normalization stats of a
+            # (term, filters) combo are frozen for one arena+tombstone
+            # snapshot — a repeated modifier query skips the stats pass
+            # (half the streamed reads; same score domain bit-for-bit).
+            # Snapshot freshness is checked by weakref IDENTITY against
+            # the live arrays (raw id()s could be reused by the
+            # allocator after GC and silently match a stale entry).
+            # Deltas contribute rows to the stats, so delta queries
+            # never cache.
+            import weakref
+            skey = None if with_delta else (
+                termhash, int(lang_filter), int(flag_bit),
+                from_days, to_days)
+            cached = None
+            if skey is not None:
+                got = self._span_stats_cache.get(skey)
+                if got is not None:
+                    fref, dref, aref, stats4 = got
+                    if (fref() is feats16 and dref() is dead
+                            and aref() is allow_bitmap):
+                        cached = stats4
+            zero_ext = (np.zeros(P.NF, np.int32), np.zeros(P.NF, np.int32),
+                        np.float32(0), np.float32(0))
             out = _rank_spans_kernel(
                 feats16, flags, docids, dead,
                 starts, counts, *d_args, allow,
                 np.int32(lang_filter), np.int32(flag_bit),
                 np.int32(DAYS_NONE_LO if from_days is None else from_days),
                 np.int32(DAYS_NONE_HI if to_days is None else to_days),
+                *(cached if cached is not None else zero_ext),
                 *consts, k=kk, n_spans=self.MAX_SPANS,
                 with_delta=with_delta,
-                with_filter=allow_bitmap is not None)
-            s, d = jax.device_get(out)  # one combined fetch
+                with_filter=allow_bitmap is not None,
+                with_ext_stats=cached is not None)
+            s, d, cmin, cmax, tfmin, tfmax = \
+                jax.device_get(out)  # one combined fetch
+            if skey is not None and cached is None:
+                _none_ref = (lambda: None)
+                with self._lock:
+                    if len(self._span_stats_cache) >= 256:
+                        self._span_stats_cache.clear()  # snapshot turned
+                    self._span_stats_cache[skey] = (
+                        weakref.ref(feats16), weakref.ref(dead),
+                        weakref.ref(allow_bitmap)
+                        if allow_bitmap is not None else _none_ref,
+                        (cmin, cmax, np.float32(tfmin),
+                         np.float32(tfmax)))
         keep = (d >= 0) & (s > NEG_INF32)
         s, d = s[keep], d[keep]
         # cross-run duplicate docids are possible after raw transfer
