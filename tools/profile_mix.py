@@ -37,15 +37,23 @@ def main():
     k = 10
     sb = _build_served_switchboard(1_000_000, n_terms=8, hosts=256,
                                    mesh="off")
-    for i, (_, s) in enumerate(SHAPES):
-        t0 = time.perf_counter()
-        sb.search(s.format(t=i % 8, u=(i + 1) % 8), count=k).results()
-        print(f"warm {SHAPES[i][0]:10s} {time.perf_counter() - t0:7.2f}s",
-              flush=True)
-    t0 = time.perf_counter()
-    sb.index.devstore.prewarm_wait(timeout=900.0)   # re-keyed by bitmap
-    sb.index.devstore.join_prewarm_wait()
-    print(f"prewarm wait {time.perf_counter() - t0:7.2f}s", flush=True)
+    # warm TWICE: the second pass rides the caches the first pass
+    # populated (stats cache -> ext-stats kernel variant; facet bitmaps)
+    # so any compile the background prewarm missed — it is best-effort
+    # through a flaky tunnel — lands here, never mid-measurement
+    for rnd in range(2):
+        for i, (_, s) in enumerate(SHAPES):
+            t0 = time.perf_counter()
+            sb.search_cache.clear()
+            sb.search(s.format(t=i % 8, u=(i + 1) % 8), count=k).results()
+            print(f"warm{rnd} {SHAPES[i][0]:10s} "
+                  f"{time.perf_counter() - t0:7.2f}s", flush=True)
+        if rnd == 0:
+            t0 = time.perf_counter()
+            sb.index.devstore.prewarm_wait(timeout=900.0)  # bitmap re-key
+            sb.index.devstore.join_prewarm_wait()
+            print(f"prewarm wait {time.perf_counter() - t0:7.2f}s",
+                  flush=True)
     sb.search_cache.clear()
     lat = {name: [] for name, _ in SHAPES}
     lk = threading.Lock()

@@ -130,6 +130,24 @@ _pack_stats_np = pack_stats_host
 _cardinal_np = cardinal_from_stats_host
 
 
+def _warm_retry(call, attempts: int = 2, backoff_s: float = 1.0) -> bool:
+    """Shared prewarm policy: run one compile+dispatch, retrying once on
+    a transient failure (remote-compile RPC flakes through the dev
+    tunnel); a persistent failure skips ONLY this shape — first live use
+    compiles it."""
+    for attempt in range(1, attempts + 1):
+        try:
+            jax.device_get(call())
+            return True
+        except Exception:
+            if attempt == attempts:
+                log.exception("prewarm shape failed %d times; skipping "
+                              "(first live use will compile it)", attempts)
+                return False
+            time.sleep(backoff_s)
+    return False
+
+
 def _signal_shift_vector(prof: RankingProfile) -> np.ndarray:
     """Every signal's shift coefficient in one fixed order (for the
     cross-profile bound max_s(cq_s - cp_s))."""
@@ -308,16 +326,16 @@ def _rank_spans_kernel(feats16, flags, docids, dead,
         return lax.fori_loop(0, n_tiles, body, carry)
 
     if with_ext_stats:
+        if with_delta:
+            # cached stats cannot cover a RAM delta's rows — scoring the
+            # delta against stats that exclude it would silently leave
+            # the host-parity score domain (callers skip the cache for
+            # delta queries; enforce the contract at trace time)
+            raise ValueError("with_ext_stats is incompatible with "
+                             "with_delta: cached stats exclude delta rows")
         stats = {"col_min": ext_cmin, "col_max": ext_cmax,
                  "tf_min": ext_tfmin, "tf_max": ext_tfmax,
                  "host_counts": jnp.zeros((1,), jnp.int32)}
-        if with_delta:
-            d_n = d_docids.shape[0]
-            d_v = _tile_valid(d_docids, dead, jnp.ones(d_n, bool))
-            d_v &= _constraint_valid(d_feats16, d_flags, lang_filter,
-                                     flag_bit, from_days, to_days)
-            if with_filter:
-                d_v &= _bitmap_member(allow, d_docids)
     else:
         big = jnp.int32(2 ** 31 - 1)
         small = jnp.int32(-(2 ** 31 - 1))
@@ -1823,24 +1841,17 @@ class DeviceSegmentStore:
         carry count-0 descriptors, so each costs one compile + one empty
         round trip. kks default to PREWARM_KKS (see its derivation).
 
-        Each shape warms independently with one retry: a transient
-        remote-compile RPC failure must not abort the whole pass and
-        leave every LATER shape cold (observed through the dev tunnel:
-        one 'response body closed' error cost the entire warm set and
-        resurfaced 10-30 s mid-run compiles)."""
+        Each shape warms independently with one retry (_warm_retry): a
+        transient remote-compile RPC failure must not abort the whole
+        pass and leave every LATER shape cold (observed through the dev
+        tunnel: one 'response body closed' error cost the entire warm
+        set and resurfaced 10-30 s mid-run compiles)."""
+        warmed = [0]
+
         def warm(call) -> bool:
-            for attempt in (1, 2):
-                try:
-                    jax.device_get(call())
-                    return True
-                except Exception:
-                    if attempt == 2:
-                        log.exception(
-                            "prewarm shape failed twice; skipping "
-                            "(first live use will compile it)")
-                        return False
-                    time.sleep(1.0)
-            return False
+            ok = _warm_retry(call)
+            warmed[0] += ok
+            return ok
 
         try:
             t0 = time.perf_counter()
@@ -1897,10 +1908,8 @@ class DeviceSegmentStore:
                                  with_delta=False, with_filter=wf,
                                  with_ext_stats=ext))
             self.measure_tunnel_rt()
-            track(EClass.INDEX, "devstore_prewarm", len(kks))
-            log.info("prewarm: %d kernel shapes in %.1fs",
-                     len(kks) * (len(_PRUNE_B) + 1
-                                 + (1 if self._filter_words else 0)),
+            track(EClass.INDEX, "devstore_prewarm", warmed[0])
+            log.info("prewarm: %d kernel shapes in %.1fs", warmed[0],
                      time.perf_counter() - t0)
         except Exception:
             log.exception("kernel prewarm failed (queries will compile "
@@ -2363,31 +2372,23 @@ class DeviceSegmentStore:
         jdocids, jpos = join[0], join[1]
         for bs in sorted(caps):
             qb = np.zeros((bs, qlen), np.int32)
-            # per-bucket retry: one transient remote-compile RPC
+
+            def one_bucket(qb=qb):
+                if any_bm:
+                    return _rank_join_bm_batch_kernel(
+                        *arrays, dead, jdocids, jpos, join[2],
+                        qb, *consts, k=kk, n_inc=n_inc,
+                        n_exc=n_exc, r=r,
+                        inc_ms=inc_ms, exc_ms=exc_ms,
+                        inc_bm=inc_bm, exc_bm=exc_bm)
+                return _rank_join_batch_kernel(
+                    *arrays, dead, jdocids, jpos, qb,
+                    *consts, k=kk, n_inc=n_inc, n_exc=n_exc,
+                    r=r, inc_ms=inc_ms, exc_ms=exc_ms)
+
+            # shared per-shape retry: one transient remote-compile RPC
             # failure must not leave the LATER buckets cold
-            for attempt in (1, 2):
-                try:
-                    if any_bm:
-                        out = _rank_join_bm_batch_kernel(
-                            *arrays, dead, jdocids, jpos, join[2],
-                            qb, *consts, k=kk, n_inc=n_inc,
-                            n_exc=n_exc, r=r,
-                            inc_ms=inc_ms, exc_ms=exc_ms,
-                            inc_bm=inc_bm, exc_bm=exc_bm)
-                    else:
-                        out = _rank_join_batch_kernel(
-                            *arrays, dead, jdocids, jpos, qb,
-                            *consts, k=kk, n_inc=n_inc, n_exc=n_exc,
-                            r=r, inc_ms=inc_ms, exc_ms=exc_ms)
-                    jax.device_get(out)
-                    break
-                except Exception:
-                    if attempt == 2:
-                        log.exception(
-                            "join bucket %d prewarm failed twice; "
-                            "skipping (first use compiles it)", bs)
-                    else:
-                        time.sleep(1.0)
+            _warm_retry(one_bucket)
         track(EClass.SEARCH, "join_prewarm", len(caps),
               time.perf_counter() - t0)
 
@@ -2592,9 +2593,14 @@ class DeviceSegmentStore:
             # Deltas contribute rows to the stats, so delta queries
             # never cache.
             import weakref
+            # id(allow_bitmap) distinguishes filter combos in the KEY
+            # (interleaved site:a/site:b must not evict each other); a
+            # stale id reuse cannot serve wrong stats because the
+            # weakref identity check below still has to pass
             skey = None if with_delta else (
                 termhash, int(lang_filter), int(flag_bit),
-                from_days, to_days)
+                from_days, to_days,
+                id(allow_bitmap) if allow_bitmap is not None else 0)
             cached = None
             if skey is not None:
                 got = self._span_stats_cache.get(skey)
@@ -2621,8 +2627,13 @@ class DeviceSegmentStore:
             if skey is not None and cached is None:
                 _none_ref = (lambda: None)
                 with self._lock:
-                    if len(self._span_stats_cache) >= 256:
-                        self._span_stats_cache.clear()  # snapshot turned
+                    # FIFO-evict one entry at the cap (a wholesale clear
+                    # would collapse the hit rate for >256-combo
+                    # workloads; stale-snapshot entries die on their
+                    # weakref check regardless)
+                    while len(self._span_stats_cache) >= 256:
+                        self._span_stats_cache.pop(
+                            next(iter(self._span_stats_cache)))
                     self._span_stats_cache[skey] = (
                         weakref.ref(feats16), weakref.ref(dead),
                         weakref.ref(allow_bitmap)
