@@ -823,6 +823,251 @@ def _config9_indexing(ndocs=2000):
     _emit("indexing_docs_per_sec", dps, "docs/sec", dps / 1.0)
 
 
+def _roofline_mode(n: int, k: int = 16):
+    """--roofline: silicon accounting over every registered kernel
+    (ISSUE 1). Each kernel in ops/roofline.KERNELS is dispatched
+    directly against an `n`-row synthetic arena (min-of-3 warm timing),
+    paired with its analytical cost model, and emitted as one JSON line
+    carrying analytical FLOPs/bytes, achieved FLOP/s and GB/s, util_pct
+    vs the configured device peak, and the compute-/memory-bound
+    verdict. A summary line carries the per-query p50/p95 util_pct the
+    rank-service counters also report. The human-readable
+    achieved-vs-peak table goes to stderr (BASELINE/README form)."""
+    import jax
+    import jax.numpy as jnp
+
+    from yacy_search_server_tpu.index import devstore as DS
+    from yacy_search_server_tpu.index import postings as P
+    from yacy_search_server_tpu.ops import blockrank as B
+    from yacy_search_server_tpu.ops import dense as DN
+    from yacy_search_server_tpu.ops import ranking as R
+    from yacy_search_server_tpu.ops import roofline as RF
+    from yacy_search_server_tpu.ops import streaming as S
+    from yacy_search_server_tpu.utils.profiler import PROFILER
+
+    peak = RF.device_peak()
+    PROFILER.set_peak(peak)
+    PROFILER.clear()
+    rng = np.random.default_rng(0)
+    TILE = DS.TILE
+    rows = max(TILE, ((n + TILE - 1) // TILE) * TILE)
+    cap = rows + TILE                     # spare tile (arena contract)
+    feats = rng.integers(0, 1000, (cap, P.NF), dtype=np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, cap, dtype=np.int32)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, cap, dtype=np.int32)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    f16_np, fl_np = R.compact_feats(feats)
+    dev = jax.devices()[0]
+    put = lambda a: jax.device_put(a, dev)   # noqa: E731
+    f16, fl = put(f16_np), put(fl_np)
+    dd = put(np.arange(cap, dtype=np.int32))
+    valid = put(np.ones(cap, bool))
+    hostids = put(np.zeros(cap, np.int32))
+    doc_cap = 1 << 16
+    dead = put(np.zeros(doc_cap, bool))
+    n_tiles = rows // TILE
+    tcap = max(1 << 12, n_tiles)
+    pmax = put(np.full(tcap, 2 ** 31 - 1, np.int32))
+    jcap = 1 << max(17, (rows - 1).bit_length())
+    jd_np = np.full(jcap, 2 ** 31 - 1, np.int32)
+    jd_np[:rows] = np.arange(rows, dtype=np.int32)
+    jd, jp = put(jd_np), put(np.zeros(jcap, np.int32))
+    nwords = 1 << 15
+    bmtab = put(np.zeros((2, nwords, 2), np.int32))
+    prof = R.RankingProfile()
+    bits, shifts = prof.flag_coeffs()
+    consts = (put(prof.norm_coeffs()), put(bits), put(shifts),
+              put(np.int32(prof.domlength)), put(np.int32(prof.tf)),
+              put(np.int32(prof.language)), put(np.int32(prof.authority)),
+              put(np.int32(P.pack_language("en"))))
+
+    def timed(name, call, queries=1, **shape):
+        jax.block_until_ready(call())          # compile + warm
+        wall = min(_t_one(call) for _ in range(3))
+        PROFILER.record(name, wall, queries=queries, **shape)
+
+    def _t_one(call):
+        t0 = time.perf_counter()
+        jax.block_until_ready(call())
+        return time.perf_counter() - t0
+
+    # block scorer kernels over the full n-row block
+    cj = jax.jit(lambda *a: R.cardinal_scores16(*a, with_authority=False))
+    timed("cardinal_scores16",
+          lambda: cj(f16, fl, valid, hostids, None, *consts), n=cap)
+    timed("score_topk16",
+          lambda: R.score_topk16(f16, fl, dd, valid, hostids, *consts,
+                                 k=k, with_authority=False), n=cap, k=k)
+    f32 = put(feats)
+    timed("score_topk",
+          lambda: R.score_topk(f32, dd, valid, hostids, *consts, k=k),
+          n=cap, k=k)
+    del f32
+    tile = min(1 << 20, rows)
+    timed("scan_score_topk",
+          lambda: S.scan_score_topk(
+              f16, fl, dd, valid, hostids,
+              {"col_min": put(f16_np.astype(np.int32).min(0)),
+               "col_max": put(f16_np.astype(np.int32).max(0)),
+               "tf_min": np.float32(0), "tf_max": np.float32(1),
+               "host_counts": put(np.zeros(1, np.int32))},
+              *consts, k=k, tile=tile), n=cap, k=k, tile=tile)
+    def _stream_once():
+        S.stream_score_topk(f16_np, fl_np,
+                            np.arange(cap, dtype=np.int32),
+                            np.zeros(cap, np.int32),
+                            consts[:7], consts[7], k=100)
+        return 0.0
+    _stream_once()                      # compile the chunk shapes
+    PROFILER.record("stream_score_topk",
+                    min(_t_one(_stream_once) for _ in range(3)),
+                    queries=1, n=cap, k=100)
+
+    # BM25 + the dense rerank family (config-5 candidate-set sizes)
+    t = 3
+    timed("bm25_topk",
+          lambda: R.bm25_topk(
+              jnp.asarray(rng.integers(0, 8, (cap, t)).astype(np.float32)),
+              dd, jnp.ones(t, jnp.int32), jnp.int32(cap), valid, dd, k=k),
+          n=cap, t=t, k=k)
+    nd = min(cap, 131072)
+    dv = put(rng.standard_normal((nd, DN.DIM)).astype(np.float32))
+    sp = put(rng.integers(0, 1 << 20, nd).astype(np.float32))
+    vd = put(np.ones(nd, bool))
+    qv = put(rng.standard_normal(DN.DIM).astype(np.float32))
+    timed("hybrid_rerank_topk",
+          lambda: DN.hybrid_rerank_topk(qv, dv, sp, vd, jnp.float32(0.5),
+                                        k=100), n=nd, k=100)
+    qb = put(rng.standard_normal((16, DN.DIM)).astype(np.float32))
+    spb = put(rng.integers(0, 1 << 20, (16, nd)).astype(np.float32))
+    vb = put(np.ones((16, nd), bool))
+    timed("hybrid_rerank_topk_batch",
+          lambda: DN.hybrid_rerank_topk_batch(qb, dv, spb, vb,
+                                              jnp.float32(0.5), k=100),
+          queries=16, n=nd, b=16, k=100)
+    timed("dense_boost_topk",
+          lambda: DN.dense_boost_topk(qv, dv,
+                                      put(rng.integers(
+                                          0, 1 << 20, nd).astype(np.int32)),
+                                      vd, jnp.float32(0.5), k=100),
+          n=nd, k=100)
+
+    # BlockRank power iteration (MAX_ITERS is the trip-count upper bound
+    # — the kernel may converge earlier, so util is a floor)
+    hosts, edges = 4096, 65536
+    timed("_power_iterate_sparse",
+          lambda: B._power_iterate_sparse(
+              put(rng.integers(0, hosts, edges).astype(np.int32)),
+              put(rng.integers(0, hosts, edges).astype(np.int32)),
+              put(np.ones(edges, np.float32)),
+              put(np.zeros(hosts, bool)), jnp.float32(B.DAMPING),
+              n=hosts),
+          n=hosts, edges=edges, iters=B.MAX_ITERS)
+
+    # devstore serving kernels against the synthetic arena span
+    ns = DS.DeviceSegmentStore.MAX_SPANS
+    starts = np.zeros(ns, np.int32)
+    counts = np.zeros(ns, np.int32)
+    counts[0] = rows
+    d_args = (np.zeros((1, P.NF), np.int16), np.zeros(1, np.int32),
+              np.full(1, -1, np.int32))
+    zero_ext = (np.zeros(P.NF, np.int32), np.zeros(P.NF, np.int32),
+                np.float32(0), np.float32(0))
+    timed("_rank_spans_kernel",
+          lambda: DS._rank_spans_kernel(
+              f16, fl, dd, dead, starts, counts, *d_args,
+              np.zeros(1, np.uint32), np.int32(DS.NO_LANG),
+              np.int32(DS.NO_FLAG), np.int32(DS.DAYS_NONE_LO),
+              np.int32(DS.DAYS_NONE_HI), *zero_ext, *consts, k=k,
+              n_spans=ns, with_delta=False),
+          rows=rows, n_spans=ns, k=k)
+    bs = 16
+    qi_scan = np.zeros((bs, 2 * ns + 4), np.int32)
+    qi_scan[:, ns] = rows                    # every slot scans the span
+    qi_scan[:, 2 * ns + 1] = DS.NO_FLAG
+    qi_scan[:, 2 * ns + 2] = DS.DAYS_NONE_LO
+    qi_scan[:, 2 * ns + 3] = DS.DAYS_NONE_HI
+    timed("_rank_scan_batch_kernel",
+          lambda: DS._rank_scan_batch_kernel(
+              f16, fl, dd, dead, qi_scan, *consts, k=k, n_spans=ns,
+              bs=bs),
+          queries=bs, rows=bs * rows, n_spans=ns, k=k)
+    st = DS.pack_prune_stats(f16_np[:rows], fl_np[:rows])[0]
+    shift, lang_term = DS.prune_bound_consts(prof)
+    sb1 = np.zeros(bs, np.int32)
+    cnt1 = np.zeros(bs, np.int32)
+    tst1 = np.zeros(bs, np.int32)
+    tct1 = np.zeros(bs, np.int32)
+    cnt1[:] = rows
+    tct1[:] = n_tiles
+    cmin = np.tile(st["col_min"], (bs, 1)).astype(np.int32)
+    cmax = np.tile(st["col_max"], (bs, 1)).astype(np.int32)
+    tmin = np.full(bs, st["tf_min"], np.float32)
+    tmax = np.full(bs, st["tf_max"], np.float32)
+    maxt = DS._pmax_window(n_tiles)
+    qi, qf, nbs = DS._pack_batch1(sb1, cnt1, tst1, tct1, cmin, cmax,
+                                  tmin, tmax, shift, lang_term)
+    timed("_rank_pruned_batch1_kernel",
+          lambda: DS._rank_pruned_batch1_kernel(
+              f16, fl, dd, dead, pmax, qi, qf, *consts, k=k, maxt=maxt,
+              bs=nbs),
+          queries=bs, bs=bs, tile=TILE, maxt=maxt, k=k, cap=cap,
+          doc_cap=doc_cap, tcap=tcap)
+    timed("_rank_pruned_kernel",
+          lambda: DS._rank_pruned_kernel(
+              f16, fl, dd, dead, pmax, np.int32(0), np.int32(rows),
+              np.int32(0), np.int32(n_tiles), st["col_min"],
+              st["col_max"], st["tf_min"], st["tf_max"], shift,
+              lang_term, *consts, k=k, b=1),
+          b=1, tile=TILE, bs=1, k=k)
+    b_esc = min(8, n_tiles)
+    timed("_rank_pruned_batch_kernel",
+          lambda: DS._rank_pruned_batch_kernel(
+              f16, fl, dd, dead, pmax, sb1, cnt1, tst1, tct1, cmin,
+              cmax, tmin, tmax, shift, lang_term, *consts, k=k, b=b_esc),
+          queries=bs, b=b_esc, tile=TILE, bs=bs, k=k)
+    r_join = min(rows, DS.DeviceSegmentStore.MAX_JOIN_ROWS)
+    m_join = min(r_join, 1 << 16)
+    qargs = np.zeros((4, 9), np.int32)
+    qargs[:, 1] = r_join
+    timed("_rank_join_batch_kernel",
+          lambda: DS._rank_join_batch_kernel(
+              f16, fl, dd, dead, jd, jp, qargs, *consts, k=k, n_inc=1,
+              n_exc=0, r=r_join, inc_ms=(m_join,), exc_ms=()),
+          queries=4, r=r_join, m=m_join, n_inc=1, n_exc=0, bs=4, k=k)
+    timed("_rank_join_bm_batch_kernel",
+          lambda: DS._rank_join_bm_batch_kernel(
+              f16, fl, dd, dead, jd, jp, bmtab, qargs, *consts, k=k,
+              n_inc=1, n_exc=0, r=r_join, inc_ms=(0,), exc_ms=(),
+              inc_bm=(True,), exc_bm=()),
+          queries=4, r=r_join, n_inc=1, n_exc=0, bs=4, k=k,
+          doc_cap=doc_cap, jcap=jcap, nslots=2, nwords=nwords)
+
+    points = {p.kernel: p for p in PROFILER.snapshot()}
+    missing = [kn for kn in RF.registered() if kn not in points]
+    assert not missing, f"kernels without roofline samples: {missing}"
+    util = PROFILER.query_util()
+    print(json.dumps({
+        "metric": "roofline_summary", "device": peak.name,
+        "peak_tflops": round(peak.flops_per_s / 1e12, 3),
+        "peak_gbps": round(peak.bytes_per_s / 1e9, 1),
+        "ridge_flops_per_byte": round(peak.ridge, 2),
+        "rows": rows,
+        "util_pct_p50": round(util["util_pct_p50"], 3),
+        "util_pct_p95": round(util["util_pct_p95"], 3),
+        "bound": util["bound"]}))
+    for kn in RF.registered():
+        p = points[kn]
+        print(json.dumps({
+            "metric": "roofline_kernel", "kernel": kn,
+            "flops": round(p.flops, 1), "bytes": round(p.bytes, 1),
+            "intensity": round(p.intensity, 3),
+            "achieved_gflops_s": round(p.achieved_flops_per_s / 1e9, 3),
+            "achieved_gbps": round(p.achieved_bytes_per_s / 1e9, 3),
+            "util_pct": p.util_pct, "bound": p.bound}))
+    print(RF.ascii_table(list(points.values()), peak), file=sys.stderr)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000_000,
@@ -845,8 +1090,17 @@ def main():
                     choices=list(range(1, 14)),
                     help="run a BASELINE.md benchmark config instead of "
                          "the headline metric")
+    ap.add_argument("--roofline", action="store_true",
+                    help="silicon accounting: dispatch every registered "
+                         "kernel against an --n-row block and emit "
+                         "analytical FLOPs/bytes, achieved FLOP/s / "
+                         "GB/s, util%% vs the device peak, and the "
+                         "compute-/memory-bound verdict (ISSUE 1)")
     args = ap.parse_args()
 
+    if args.roofline:
+        _roofline_mode(args.n, k=16)
+        return
     if args.config in (6, 10):
         fn = _config6_served_path if args.config == 6 \
             else _config10_mesh_served

@@ -70,8 +70,45 @@ def test_wedged_dispatcher_still_completes_fast():
         _assert_scores_match(out, ds.rwi, 10)
         assert dt < 2.0, f"wedged dispatcher stalled the query {dt:.1f}s"
         assert b.timeouts >= 1
+        # cause attribution: a dispatcher held the query in a wedged
+        # kernel call — the stall bucket, not a backlog bucket
+        assert b.timeout_worker_stall >= 1
+        assert b.timeout_queue_full == 0
     finally:
         ds.close()
+
+
+def test_mesh_batcher_attributes_wedged_dispatch():
+    """The mesh batcher's watchdog counter carries the same cause
+    buckets (queue-full / flush-deadline / worker-stall); a wedged
+    dispatch lands in worker_stall."""
+    from yacy_search_server_tpu.index.meshstore import _MeshQueryBatcher
+
+    b = _MeshQueryBatcher.__new__(_MeshQueryBatcher)
+    import queue as _q
+    b.store = None
+    b.max_batch = 4
+    b._q = _q.Queue()
+    b._stop = False
+    b.dispatches = b.timeouts = b.exceptions = 0
+    b.timeout_queue_full = b.timeout_flush_deadline = 0
+    b.timeout_worker_stall = 0
+    b.WATCHDOG_S = 0.2
+    b._dispatch = lambda batch: time.sleep(5.0)
+    t = threading.Thread(target=b._loop, daemon=True)
+    t.start()
+    try:
+        res = b.submit(TH, RankingProfile(), "en", 16)
+        assert res == ("timeout",)
+        assert b.timeout_worker_stall == 1
+        assert b.timeout_queue_full == 0
+        # a second query while the lone dispatcher is wedged never gets
+        # claimed: the queue-full bucket
+        res = b.submit(TH, RankingProfile(), "en", 16)
+        assert res == ("timeout",)
+        assert b.timeout_queue_full == 1
+    finally:
+        b.close()
 
 
 def test_dispatch_exception_answers_solo_and_counts():
@@ -166,5 +203,10 @@ def test_64_thread_protocol_latency_ceiling():
         c = ds.counters()
         assert c["batch_exceptions"] == 0
         assert c["stream_scans"] == 0      # pruned path served everything
+        # healthy serving NEVER stalls a dispatch: whatever transient
+        # backlog timeouts the 1-core box produces, the worker-stall
+        # bucket stays zero (the r5 artifacts' lone unexplained
+        # batch_timeout is now attributable — and must not be a stall)
+        assert c["batch_timeout_worker_stall"] == 0
     finally:
         ds.close()

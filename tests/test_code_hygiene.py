@@ -34,3 +34,63 @@ def test_no_silent_broad_excepts():
     assert not offenders, (
         "silent `except Exception: pass` — log the failure or narrow the "
         "exception type:\n  " + "\n  ".join(offenders))
+
+
+# -- silicon accounting coverage (ISSUE 1) -----------------------------------
+# Every named device kernel (jit- or pallas-compiled) in ops/ and
+# index/devstore.py must carry a cost-model entry in ops/roofline.KERNELS
+# — or an explicit, reasoned exemption in ops/roofline.EXEMPT. A kernel
+# without either is invisible to the roofline layer: its perf claims
+# cannot be stated against the silicon, which is exactly the r5 gap this
+# subsystem closes.
+
+_JIT_DECO = re.compile(r"\s*@(?:functools\.partial\(\s*)?"
+                       r"(?:partial\()?jax\.jit|\s*@jax\.jit")
+
+
+def _named_kernels(path: pathlib.Path):
+    """Function names defined directly under a jit decorator (plus any
+    function containing a pallas_call)."""
+    lines = path.read_text(encoding="utf-8").splitlines()
+    current_def = None
+    for i, line in enumerate(lines):
+        m = re.match(r"\s*def\s+(\w+)", line)
+        if m:
+            current_def = m.group(1)
+        if "pallas_call(" in line and current_def:
+            yield current_def    # pallas kernels are named by their host fn
+            continue
+        if not _JIT_DECO.match(line):
+            continue
+        # the decorator may span continuation lines (static_argnames
+        # tuples); the next `def` names the kernel — and one MUST follow,
+        # or the scanner itself has a hole (a silent miss here would
+        # green-light an unregistered kernel)
+        for j in range(i + 1, min(i + 16, len(lines))):
+            dm = re.match(r"\s*def\s+(\w+)", lines[j])
+            if dm:
+                yield dm.group(1)
+                break
+        else:
+            raise AssertionError(
+                f"{path.name}:{i + 1}: jit decorator with no `def` in "
+                f"the next 15 lines — widen the scanner window")
+
+
+def test_every_device_kernel_has_a_cost_model():
+    from yacy_search_server_tpu.ops import roofline
+
+    sources = sorted((PKG / "ops").glob("*.py"))
+    sources.append(PKG / "index" / "devstore.py")
+    missing = []
+    for p in sources:
+        for name in _named_kernels(p):
+            if name in roofline.KERNELS:
+                continue
+            if name in roofline.EXEMPT:
+                continue   # documented decision, not a hole
+            missing.append(f"{p.relative_to(PKG.parent)}::{name}")
+    assert not missing, (
+        "device kernels without a roofline cost model (register in "
+        "ops/roofline.KERNELS or exempt WITH A REASON in "
+        "ops/roofline.EXEMPT):\n  " + "\n  ".join(missing))

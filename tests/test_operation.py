@@ -96,6 +96,10 @@ def test_release_manager():
 
 
 def test_signed_release_verify_and_stage(tmp_path):
+    # the signing half needs the optional cryptography package (the
+    # PRODUCT path fails closed without it — covered by
+    # test_signed_release_fails_closed_on_text_fetcher)
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import \
         Ed25519PrivateKey
     from cryptography.hazmat.primitives.serialization import (

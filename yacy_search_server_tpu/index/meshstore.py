@@ -67,6 +67,7 @@ from ..ops.ranking import (RankingProfile, cardinal_from_stats,
                            compact_feats, local_stats)
 from ..ops.streaming import merge_stats
 from ..parallel.distribution import horizontal_dht_position
+from ..parallel.mesh import shard_map
 from ..utils.eventtracker import EClass, update as track
 from . import postings as P
 from .devstore import (_PRUNE_B, DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32,
@@ -191,6 +192,16 @@ class _MeshQueryBatcher:
         self._stop = False
         self.dispatches = 0
         self.timeouts = 0
+        # timeout cause buckets (devstore._QueryBatcher parity; the r5
+        # artifacts' lone unexplained `batch_timeouts: 1` motivated
+        # attributing every timeout): queue_full = never claimed off the
+        # incoming queue; flush_deadline = claimed into a forming batch
+        # that missed the handoff; worker_stall = a dispatch held it in
+        # a kernel call past both watchdog windows (must stay zero in
+        # healthy serving — asserted by the batcher stall tests)
+        self.timeout_queue_full = 0
+        self.timeout_flush_deadline = 0
+        self.timeout_worker_stall = 0
         self.exceptions = 0
         self._thread = threading.Thread(target=self._loop,
                                         name="meshstore-batcher",
@@ -198,11 +209,13 @@ class _MeshQueryBatcher:
         self._thread.start()
 
     @staticmethod
-    def _claim(item: dict) -> bool:
+    def _claim(item: dict, stage: str | None = None) -> bool:
         with item["lk"]:
             if item["taken"]:
                 return False
             item["taken"] = True
+            if stage is not None:
+                item["stage"] = stage
             return True
 
     def submit(self, termhash: bytes, profile, language: str, kk: int):
@@ -215,11 +228,17 @@ class _MeshQueryBatcher:
         if item["ev"].wait(timeout=self.WATCHDOG_S):
             return item["res"]
         if self._claim(item):
+            # never claimed off the queue: backlog, not a wedge
             self.timeouts += 1
+            self.timeout_queue_full += 1
             return ("timeout",)
         if item["ev"].wait(timeout=self.WATCHDOG_S):
             return item["res"]
         self.timeouts += 1
+        if item.get("stage") == "dispatch":
+            self.timeout_worker_stall += 1
+        else:
+            self.timeout_flush_deadline += 1
         return ("timeout",)
 
     def close(self) -> None:
@@ -237,7 +256,7 @@ class _MeshQueryBatcher:
             item = self._q.get()
             if item is None:
                 return
-            if not self._claim(item):
+            if not self._claim(item, stage="form"):
                 continue
             batch = [item]
             while len(batch) < self.max_batch:
@@ -248,8 +267,10 @@ class _MeshQueryBatcher:
                 if nxt is None:
                     self._q.put(None)
                     break
-                if self._claim(nxt):
+                if self._claim(nxt, stage="form"):
                     batch.append(nxt)
+            for it in batch:    # timeout attribution: now dispatching
+                it["stage"] = "dispatch"
             try:
                 self._dispatch(batch)
             except Exception:
@@ -540,6 +561,11 @@ class MeshSegmentStore:
             "pruned_tiles": self.pruned_tiles,
             "batch_dispatches": b.dispatches if b else 0,
             "batch_timeouts": b.timeouts if b else 0,
+            "batch_timeout_queue_full": b.timeout_queue_full if b else 0,
+            "batch_timeout_flush_deadline":
+                b.timeout_flush_deadline if b else 0,
+            "batch_timeout_worker_stall":
+                b.timeout_worker_stall if b else 0,
             "batch_exceptions": b.exceptions if b else 0,
         }
 
@@ -644,7 +670,7 @@ class MeshSegmentStore:
     def _pfn(self, kk: int, b: int):
         key = ("pruned", kk, b)
         if key not in self._fns:
-            self._fns[key] = jax.jit(jax.shard_map(
+            self._fns[key] = jax.jit(shard_map(
                 partial(_mesh_pruned_shard, k=kk, b=b),
                 mesh=self.mesh,
                 in_specs=(PS(("term", "doc"), None, None),   # feats16
@@ -664,7 +690,7 @@ class MeshSegmentStore:
     def _pbfn(self, kk: int, b: int, bs: int):
         key = ("pruned_batch", kk, b, bs)
         if key not in self._fns:
-            self._fns[key] = jax.jit(jax.shard_map(
+            self._fns[key] = jax.jit(shard_map(
                 partial(_mesh_pruned_batch_shard, k=kk, b=b),
                 mesh=self.mesh,
                 in_specs=(PS(("term", "doc"), None, None),   # feats16
@@ -684,7 +710,7 @@ class MeshSegmentStore:
     def _fn(self, kk: int, with_delta: bool):
         key = (kk, with_delta)
         if key not in self._fns:
-            self._fns[key] = jax.jit(jax.shard_map(
+            self._fns[key] = jax.jit(shard_map(
                 partial(_mesh_rank_shard, k=kk, with_delta=with_delta),
                 mesh=self.mesh,
                 in_specs=(PS(("term", "doc"), None, None),   # feats16
@@ -829,7 +855,7 @@ class MeshSegmentStore:
             body = (partial(_mesh_xjoin_shard if cross_row
                             else _mesh_join_shard, k=kk, n_inc=n_inc,
                             n_exc=n_exc, r=r, inc_ms=inc_ms, exc_ms=exc_ms))
-            self._jfns[key] = jax.jit(jax.shard_map(
+            self._jfns[key] = jax.jit(shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(PS(("term", "doc"), None, None),   # feats16

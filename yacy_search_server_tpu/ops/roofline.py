@@ -1,0 +1,447 @@
+"""Roofline cost accounting — every serving kernel gets a silicon number.
+
+The perf story so far measured kernels against CPU twins (bench.py
+`vs_baseline`); nothing said how far from the HARDWARE's ceiling a kernel
+runs (VERDICT r5 weak #7: "no hardware-relative utilization number exists
+anywhere"). This module is the analytical half of that accounting:
+
+- a **cost model registry**: for each named serving kernel, closed-form
+  FLOPs / bytes-moved as functions of its shape parameters. Two byte
+  models per kernel, because they answer different questions:
+
+  * ``bytes``  — COMPULSORY traffic: operands that must stream from HBM
+    plus results written back, assuming perfect fusion (the roofline
+    denominator — achieved GB/s against the HBM peak is only meaningful
+    over bytes that physically must move).
+  * ``xla_bytes`` — fusion-boundary traffic as XLA's HloCostAnalysis
+    models it (operand + output bytes of each fusion, whole operand
+    arrays counted for dynamic-slice reads). Coefficients are calibrated
+    against ``jax.jit(...).lower().compile().cost_analysis()`` on the CPU
+    backend and PINNED BY TEST (tests/test_roofline.py: within 10% on 3
+    representative shapes per kernel) — a kernel edit that changes the
+    dataflow breaks the pin and forces the model to be re-derived.
+
+  ``flops`` follows XLA's arithmetic-op counting (elementwise int ops
+  count as flops), so one number serves both the cross-check and the
+  achieved-FLOP/s roofline axis.
+
+- a **per-device peak table** (TPU generations + the CPU test backend),
+  overridable via config/env — utilization is stated against a DECLARED
+  peak, never a guessed one.
+
+- the **roofline verdict**: arithmetic intensity (flops/byte) against the
+  device ridge point classifies each kernel compute- vs memory-bound;
+  ``util_pct`` is achieved-vs-peak along the BINDING axis.
+
+Loop-carried kernels (lax.scan / fori_loop bodies) are modeled per
+executed step and multiplied by the trip count — XLA's cost analysis
+counts a loop body ONCE regardless of trip count, so the cross-check for
+those kernels compares the per-step body cost (see tests).
+
+References: Williams et al., "Roofline: an insightful visual performance
+model" (CACM 2009); arXiv:2110.06051 and arXiv:1406.3170 frame the dense
+rerank and postings/top-k efficiency in exactly these absolute
+compute/byte terms.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..index import postings as P
+
+# compact-block row: int16 feats + int32 flags + int32 docids
+ROW_BYTES = P.NF * 2 + 4 + 4
+# + the tombstone-bitmap gather (bool per row)
+ROW_BYTES_DEAD = ROW_BYTES + 1
+
+
+@dataclass(frozen=True)
+class Cost:
+    """One kernel execution's analytical cost."""
+
+    flops: float       # arithmetic ops (XLA counting conventions)
+    bytes: float       # compulsory HBM traffic (roofline denominator)
+    xla_bytes: float   # fusion-boundary traffic (cost_analysis parity)
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity in FLOPs per compulsory byte."""
+        return self.flops / max(self.bytes, 1.0)
+
+
+@dataclass(frozen=True)
+class DevicePeak:
+    """Declared hardware ceilings for one device kind."""
+
+    name: str
+    flops_per_s: float     # dense-compute peak (bf16 MXU on TPU)
+    bytes_per_s: float     # HBM bandwidth peak
+
+    @property
+    def ridge(self) -> float:
+        """Intensity (flops/byte) where the roofline bends."""
+        return self.flops_per_s / self.bytes_per_s
+
+
+# Published peaks per device generation (the `device_kind` strings jax
+# reports). v5e: 197 TFLOP/s bf16, 819 GB/s HBM. The CPU entry is a
+# deliberately conservative single-core envelope for the test backend —
+# utilization numbers on CPU are for plumbing tests, not claims.
+PEAKS: dict[str, DevicePeak] = {
+    "tpu v5 lite": DevicePeak("TPU v5e", 197e12, 819e9),
+    "tpu v5e": DevicePeak("TPU v5e", 197e12, 819e9),
+    "tpu v4": DevicePeak("TPU v4", 275e12, 1228e9),
+    "tpu v3": DevicePeak("TPU v3", 123e12, 900e9),
+    "tpu v2": DevicePeak("TPU v2", 46e12, 700e9),
+    "cpu": DevicePeak("CPU (1-core envelope)", 5e10, 2.5e10),
+}
+
+
+def device_peak(device=None) -> DevicePeak:
+    """The peak table entry for a jax device (env/config overridable:
+    YACY_ROOFLINE_PEAK_FLOPS / YACY_ROOFLINE_PEAK_GBPS take precedence —
+    deployments on unlisted silicon declare their own ceiling)."""
+    kind = "cpu"
+    if device is not None:
+        kind = getattr(device, "device_kind", "cpu").lower()
+    else:
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind.lower()
+        except Exception:   # no backend at all: the CPU envelope stands
+            kind = "cpu"
+    peak = PEAKS.get(kind)
+    if peak is None:
+        # unknown accelerator: fall back by family, never crash serving
+        peak = next((p for k, p in PEAKS.items()
+                     if k != "cpu" and k in kind), PEAKS["cpu"])
+    env_f = os.environ.get("YACY_ROOFLINE_PEAK_FLOPS")
+    env_b = os.environ.get("YACY_ROOFLINE_PEAK_GBPS")
+    if env_f or env_b:
+        peak = DevicePeak(
+            peak.name + " (overridden)",
+            float(env_f) if env_f else peak.flops_per_s,
+            float(env_b) * 1e9 if env_b else peak.bytes_per_s)
+    return peak
+
+
+@dataclass(frozen=True)
+class RooflinePoint:
+    """A kernel execution placed on the roofline."""
+
+    kernel: str
+    flops: float
+    bytes: float
+    wall_s: float
+    achieved_flops_per_s: float
+    achieved_bytes_per_s: float
+    intensity: float
+    bound: str          # "memory" | "compute"
+    util_pct: float     # achieved vs peak along the binding axis
+
+
+def roofline_point(kernel: str, cost: Cost, wall_s: float,
+                   peak: DevicePeak) -> RooflinePoint:
+    """Place one measured execution against the device roofline."""
+    wall_s = max(wall_s, 1e-9)
+    af = cost.flops / wall_s
+    ab = cost.bytes / wall_s
+    bound = "memory" if cost.intensity < peak.ridge else "compute"
+    if bound == "memory":
+        util = 100.0 * ab / peak.bytes_per_s
+    else:
+        util = 100.0 * af / peak.flops_per_s
+    return RooflinePoint(kernel, cost.flops, cost.bytes, wall_s,
+                         af, ab, cost.intensity, bound, round(util, 3))
+
+
+# ---------------------------------------------------------------------------
+# Cost models
+# ---------------------------------------------------------------------------
+# Per-row coefficient provenance: compulsory bytes are counted from the
+# arrays the kernel streams (ROW_BYTES per candidate row, plus gathers /
+# side-tables / outputs); flops and xla_bytes coefficients are calibrated
+# against the CPU-backend HloCostAnalysis (jax 0.4.37) and pinned by
+# tests/test_roofline.py — each entry's comment records the fit.
+#
+# Loop-carried kernels (lax.scan / fori_loop / lax.map bodies) are modeled
+# PER EXECUTED STEP × trip count; HloCostAnalysis counts a loop body once
+# regardless of trip count, so their cross-check compares the unit-trip
+# cost (tests pass the one-step shape).
+
+# cardinal scorer over a compact block (ops/ranking.cardinal_scores16):
+# stats + normalize + shifted sum + tf + flags. XLA (no-authority trace):
+# 529 flops/row, 438.3 xla-bytes/row, constant over n in [4k, 131k]
+_CARDINAL_FLOPS_ROW = 529.0
+_CARDINAL_XBYTES_ROW = 438.3
+# + fused lax.top_k (score_topk16): 544 / 454.3 per row at serving k's
+_TOPK16_FLOPS_ROW = 544.0
+_TOPK16_XBYTES_ROW = 454.3
+# int32 twin (score_topk): 456 flops/row, 587.6 xla-bytes/row (wider
+# reads, no int16 widening ops)
+_TOPK32_FLOPS_ROW = 456.0
+_TOPK32_XBYTES_ROW = 587.6
+# scan_score_topk loop body (stats precomputed; score + merge per tile):
+# 439 flops/row; 59 xla-bytes/row on a >=2-step trace
+_SCAN_FLOPS_ROW = 439.0
+_SCAN_XBYTES_ROW = 59.0
+# streaming stats pass (ops/ranking.local_stats, no host counts)
+_STATS_FLOPS_ROW = 113.0
+_STATS_XBYTES_ROW = 387.3
+# devstore streamed spans kernel: stats + score passes per tile plus the
+# constraint mask; each span's fori body counts once: 673 flops and
+# 587 xla-bytes per (span, TILE-row)
+_SPANS_FLOPS_ROW = 673.0
+_SPANS_XBYTES_ROW = 587.0
+# b=1 vmapped pruned kernel: one scored tile per slot; vmap (unlike
+# lax.map) scales the count with bs: 453 flops/row; xla bytes are a
+# 36.4/row slope over the scored tiles plus the whole-operand arena
+# arrays (dynamic_slice reads charge the full operand in the XLA model)
+_PRUNED1_FLOPS_ROW = 453.0
+_PRUNED1_XBYTES_ROW = 36.4
+# pruned escalation kernel body (lax.map slot × fori tile, counted once)
+_PRUNEDB_FLOPS_ROW = 449.0
+_PRUNEDB_XBYTES_ROW = 64.6
+# sort-merge join: fit over (r, m) at n_inc=1/n_exc=0, bs=1:
+# flops = 560·r + 34·m; xla_bytes = 762·r + 90·m
+_JOIN_FLOPS_R, _JOIN_FLOPS_M = 560.0, 34.0
+_JOIN_XBYTES_R, _JOIN_XBYTES_M = 762.2, 90.1
+# bitmap-membership join: 607 flops/row·slot; 747 xla-bytes/row·slot
+# plus the side-table operands
+_JOINBM_FLOPS_ROW = 607.0
+_JOINBM_XBYTES_ROW = 747.2
+
+
+def _c_cardinal_scores16(n: int) -> Cost:
+    return Cost(flops=_CARDINAL_FLOPS_ROW * n,
+                bytes=ROW_BYTES * n + 4 * n,      # feats+flags + i32 out
+                xla_bytes=_CARDINAL_XBYTES_ROW * n)
+
+
+def _c_score_topk16(n: int, k: int = 16) -> Cost:
+    return Cost(flops=_TOPK16_FLOPS_ROW * n,
+                bytes=ROW_BYTES * n + 8 * k,
+                xla_bytes=_TOPK16_XBYTES_ROW * n)
+
+
+def _c_score_topk(n: int, k: int = 16) -> Cost:
+    return Cost(flops=_TOPK32_FLOPS_ROW * n,
+                bytes=(P.NF * 4 + 8) * n + 8 * k,
+                xla_bytes=_TOPK32_XBYTES_ROW * n)
+
+
+def _c_scan_score_topk(n: int, k: int = 16, tile: int = 1 << 20) -> Cost:
+    steps = max(1, -(-n // tile))
+    rows = steps * tile
+    return Cost(flops=_SCAN_FLOPS_ROW * rows,
+                bytes=ROW_BYTES * rows + 8 * k,
+                xla_bytes=_SCAN_XBYTES_ROW * rows)
+
+
+def _c_stream_score_topk(n: int, k: int = 100, chunk: int = 1 << 21) -> Cost:
+    # host driver, not a jit kernel: two device passes (stats, then
+    # score+merge) over every chunk — the composition of the calibrated
+    # local_stats and scan-body coefficients
+    return Cost(flops=(_STATS_FLOPS_ROW + _SCAN_FLOPS_ROW) * n,
+                bytes=2 * ROW_BYTES * n + 8 * k,
+                xla_bytes=(_STATS_XBYTES_ROW + _SCAN_XBYTES_ROW) * n)
+
+
+def _c_rank_spans(rows: int, n_spans: int = 8, k: int = 16,
+                  with_stats_pass: bool = True) -> Cost:
+    """The exact streaming scan (_rank_spans_kernel): stats + score
+    passes over `rows` tile-rows (sum of span counts rounded up to whole
+    tiles). The cross-check shape is rows = n_spans × TILE (one fori
+    step per unrolled span slot). `with_stats_pass=False` models the
+    cached-ext-stats twin: pass 1 skipped, half the streamed reads
+    (673 = 113 stats + 560 score per row — the coefficients compose)."""
+    if with_stats_pass:
+        flops, xbytes, passes = _SPANS_FLOPS_ROW, _SPANS_XBYTES_ROW, 2
+    else:
+        flops = _SPANS_FLOPS_ROW - _STATS_FLOPS_ROW
+        xbytes = _SPANS_XBYTES_ROW - _STATS_XBYTES_ROW
+        passes = 1
+    return Cost(flops=flops * rows,
+                bytes=passes * ROW_BYTES_DEAD * rows + 8 * k,
+                xla_bytes=xbytes * rows)
+
+
+def _c_rank_pruned_batch1(bs: int, tile: int = 32_768, maxt: int = 64,
+                          k: int = 16, cap: int = 0, doc_cap: int = 0,
+                          tcap: int = 0) -> Cost:
+    """The steady-state b=1 batched pruned kernel: each slot scores ONE
+    proxy-best tile and bound-walks its pmax tail. cap/doc_cap/tcap are
+    the arena capacities (whole-operand terms in the XLA byte model)."""
+    rows = bs * tile
+    return Cost(flops=_PRUNED1_FLOPS_ROW * rows,
+                bytes=ROW_BYTES_DEAD * rows + 4 * bs * maxt + 8 * bs * k,
+                xla_bytes=_PRUNED1_XBYTES_ROW * rows
+                + ROW_BYTES * cap + doc_cap + 4 * tcap)
+
+
+def _c_rank_pruned(b: int, tile: int = 32_768, bs: int = 1,
+                   k: int = 16) -> Cost:
+    """The escalation pruned kernel: `b` scored tiles per slot (lax.map
+    over slots; unit-trip cost = one tile body)."""
+    rows = bs * b * tile
+    return Cost(flops=_PRUNEDB_FLOPS_ROW * rows,
+                bytes=ROW_BYTES_DEAD * rows + 8 * bs * k,
+                xla_bytes=_PRUNEDB_XBYTES_ROW * rows)
+
+
+def _c_rank_join(r: int, m: int = 0, n_inc: int = 1, n_exc: int = 0,
+                 bs: int = 1, k: int = 16) -> Cost:
+    """Sort-merge device conjunction: rare span of `r` rows, one (r+m)
+    sort-merge membership per partner segment of `m` rows (`n_inc` +
+    `n_exc` partner memberships, the kernel statics' counts)."""
+    partners = max(n_inc + n_exc, 1)
+    flops = bs * r * (_JOIN_FLOPS_R + 146.0 * (partners - 1)) \
+        + bs * _JOIN_FLOPS_M * m * partners
+    # compulsory: rare rows once; per partner 12 B of gathered columns
+    # per lane + the (docid, pos) segment streamed for the sort
+    comp = bs * (ROW_BYTES_DEAD * r + partners * (12 * r + 8 * m) + 8 * k)
+    return Cost(flops=flops, bytes=comp,
+                xla_bytes=bs * (_JOIN_XBYTES_R * r
+                                + 292.0 * r * (partners - 1)
+                                + _JOIN_XBYTES_M * m * partners))
+
+
+def _c_rank_join_bm(r: int, n_inc: int = 1, n_exc: int = 0, bs: int = 1,
+                    k: int = 16, doc_cap: int = 0, jcap: int = 0,
+                    nslots: int = 0, nwords: int = 0) -> Cost:
+    """Bitmap-membership conjunction: 2 gathers per lane per partner
+    instead of the (r+m) sort — O(r) regardless of partner size."""
+    partners = max(n_inc + n_exc, 1)
+    flops = bs * r * (_JOINBM_FLOPS_ROW + 160.0 * (partners - 1))
+    comp = bs * (ROW_BYTES_DEAD * r + partners * 20 * r + 8 * k)
+    side = doc_cap + 8 * jcap + 8 * nslots * nwords
+    return Cost(flops=flops, bytes=comp,
+                xla_bytes=bs * (_JOINBM_XBYTES_ROW
+                                + 300.0 * (partners - 1)) * r + side)
+
+
+def _c_bm25_topk(n: int, t: int = 3, k: int = 16) -> Cost:
+    # XLA fit: flops = (6t + 10)/row and xla_bytes = (4t + 43.5)/row,
+    # exact at t in {3, 5, 8}
+    return Cost(flops=(6.0 * t + 10.0) * n,
+                bytes=(4 * t + 8) * n + 8 * k,
+                xla_bytes=(4.0 * t + 43.5) * n)
+
+
+def _c_hybrid_rerank(n: int, dim: int = 256, k: int = 100) -> Cost:
+    # matvec (2·dim) + normalize/blend/top_k; XLA: (4·dim + 11) flops
+    # and (4·dim + 43.5) bytes per row at dim 256. Compulsory traffic is
+    # the f32 doc-matrix read (bf16 cast happens in registers)
+    return Cost(flops=(4.0 * dim + 11.0) * n,
+                bytes=4 * n * dim + 5 * n + 8 * k,
+                xla_bytes=(4.0 * dim + 43.5) * n)
+
+
+def _c_hybrid_rerank_batch(n: int, b: int = 16, dim: int = 256,
+                           k: int = 100) -> Cost:
+    """The MXU case: B queries amortize one doc-matrix read. XLA fit:
+    flops = 2·b·n·dim + 11·b·n + 2·dim·n; bytes = 12·dim·n + 43.6·b·n."""
+    return Cost(flops=2.0 * b * n * dim + 11.0 * b * n + 2.0 * dim * n,
+                bytes=4 * n * dim + b * (5 * n + 8 * k),
+                xla_bytes=12.0 * dim * n + 43.6 * b * n)
+
+
+def _c_dense_boost(n: int, dim: int = 256, k: int = 100) -> Cost:
+    return Cost(flops=(4.0 * dim + 22.0) * n,
+                bytes=4 * n * dim + 9 * n + 8 * k,
+                xla_bytes=(4.0 * dim + 29.0) * n)
+
+
+def _c_power_iterate(n: int, edges: int, iters: int = 1) -> Cost:
+    """BlockRank power iteration (ops/blockrank._power_iterate_sparse):
+    per-iteration segment-sum over the edge list, × the trip count (the
+    while body counts once in the XLA model; iters=1 is the cross-check
+    shape). Fit: flops = 4·e + 11·n + 13; bytes = 20·e + 57.5·n + 366."""
+    return Cost(flops=(4.0 * edges + 11.0 * n + 13.0) * iters,
+                bytes=(12 * edges + 8 * n) * iters,
+                xla_bytes=(20.0 * edges + 57.5 * n + 366.0) * iters)
+
+
+# kernel name -> cost fn; names match the python symbol the kernel is
+# defined under (tests/test_code_hygiene.py walks the sources and demands
+# an entry — or an explicit exemption — for every named jit kernel in
+# ops/ and index/devstore.py)
+KERNELS: dict[str, object] = {
+    "cardinal_scores16": _c_cardinal_scores16,
+    "score_topk16": _c_score_topk16,
+    "score_topk": _c_score_topk,
+    "scan_score_topk": _c_scan_score_topk,
+    "stream_score_topk": _c_stream_score_topk,
+    "bm25_topk": _c_bm25_topk,
+    "hybrid_rerank_topk": _c_hybrid_rerank,
+    "hybrid_rerank_topk_batch": _c_hybrid_rerank_batch,
+    "dense_boost_topk": _c_dense_boost,
+    "_power_iterate_sparse": _c_power_iterate,
+    "_rank_spans_kernel": _c_rank_spans,
+    "_rank_pruned_kernel": _c_rank_pruned,
+    "_rank_pruned_batch1_kernel": _c_rank_pruned_batch1,
+    "_rank_pruned_batch_kernel": _c_rank_pruned,
+    "_rank_scan_batch_kernel": _c_rank_spans,
+    "_rank_join_batch_kernel": _c_rank_join,
+    "_rank_join_bm_batch_kernel": _c_rank_join_bm,
+}
+
+# jit-compiled functions that are NOT serving kernels: maintenance
+# writes and glue whose cost is dominated by the copy XLA itself
+# reports. Each exemption carries its reason (the hygiene test prints
+# them, so an exemption is a documented decision, not a hole).
+EXEMPT: dict[str, str] = {
+    "_write_rows1": "arena maintenance write (device-side copy), "
+                    "not a query-path kernel",
+    "_write_rows2": "arena maintenance write, not a query-path kernel",
+    "_write_rows3": "arena maintenance write, not a query-path kernel",
+}
+
+
+def cost(kernel: str, **shape) -> Cost:
+    """The analytical cost of one `kernel` execution at `shape`."""
+    fn = KERNELS.get(kernel)
+    if fn is None:
+        raise KeyError(f"no cost model registered for kernel {kernel!r}")
+    return fn(**shape)
+
+
+def registered() -> list[str]:
+    return sorted(KERNELS)
+
+
+def xla_cost(jitfn, *args, **kwargs) -> tuple[float, float]:
+    """(flops, bytes accessed) from XLA's compiled cost analysis, or
+    (nan, nan) when the backend doesn't expose it."""
+    try:
+        analysis = jitfn.lower(*args, **kwargs).compile().cost_analysis()
+    except Exception:
+        return float("nan"), float("nan")
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not analysis:
+        return float("nan"), float("nan")
+    return (float(analysis.get("flops", float("nan"))),
+            float(analysis.get("bytes accessed", float("nan"))))
+
+
+def ascii_table(points: list[RooflinePoint], peak: DevicePeak) -> str:
+    """The achieved-vs-peak table (BASELINE/README artifact form)."""
+    head = (f"device peak: {peak.name} — "
+            f"{peak.flops_per_s / 1e12:.1f} TFLOP/s, "
+            f"{peak.bytes_per_s / 1e9:.0f} GB/s, "
+            f"ridge {peak.ridge:.1f} flops/byte")
+    rows = [head,
+            f"{'kernel':<28}{'GFLOPs':>9}{'MB':>9}{'int.':>7}"
+            f"{'GF/s':>9}{'GB/s':>8}{'bound':>9}{'util%':>8}"]
+    for p in points:
+        rows.append(
+            f"{p.kernel:<28}{p.flops / 1e9:>9.3f}{p.bytes / 1e6:>9.1f}"
+            f"{p.intensity:>7.1f}{p.achieved_flops_per_s / 1e9:>9.2f}"
+            f"{p.achieved_bytes_per_s / 1e9:>8.2f}{p.bound:>9}"
+            f"{p.util_pct:>8.2f}")
+    return "\n".join(rows)

@@ -43,6 +43,18 @@ from ..ops import ranking as R
 NEG_INF_I32 = -(2**31 - 1)
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable shard_map: `jax.shard_map` (jax >= 0.5, `check_vma`
+    kwarg) with a fallback to `jax.experimental.shard_map` (0.4.x, where
+    the same knob is spelled `check_rep`)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def best_devices(need: int | None = None, prefer_cpu: bool = False):
     """Device pool for an n-way mesh.
 
@@ -116,7 +128,7 @@ def _cardinal_shard(feats, docids, valid, hostids, norm_coeffs, flag_bits,
 
 def build_sharded_cardinal(mesh: Mesh, k: int, num_hosts: int):
     """jit-compiled sharded cardinal+top-k over `mesh` ('doc' axis)."""
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_cardinal_shard, k=k, num_hosts=num_hosts),
         mesh=mesh,
         in_specs=(PS("doc"), PS("doc"), PS("doc"), PS("doc"),
@@ -155,7 +167,7 @@ def _bm25_shard(tf, doclen, df, ndocs, valid, docids, *, k: int,
 
 def build_sharded_bm25(mesh: Mesh, k: int, k1: float = 1.2, b: float = 0.75):
     """jit-compiled sharded BM25+top-k over the ('term','doc') mesh."""
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_bm25_shard, k=k, k1=k1, b=b),
         mesh=mesh,
         in_specs=(PS("doc", "term"), PS("doc"), PS("term"), PS(),

@@ -97,6 +97,91 @@ def respond_viewfile(header: dict, post: ServerObjects, sb) -> ServerObjects:
     return prop
 
 
+@servlet("Performance_Roofline_p")
+def respond_roofline(header: dict, post: ServerObjects,
+                     sb) -> ServerObjects:
+    """Silicon accounting dashboard (ISSUE 1): every serving kernel's
+    achieved FLOP/s / GB/s placed against the device roofline, plus the
+    per-query utilization percentiles the rank-service counters carry.
+    `format=png` renders the log-log roofline chart via the raster
+    layer; the default response is the numeric table (template/API
+    form, like DeviceStore_p)."""
+    from ...ops import roofline as RF
+    from ...utils.profiler import PROFILER
+
+    peak = PROFILER.peak
+    points = PROFILER.snapshot()
+    if post.get("format", "") == "png":
+        prop = ServerObjects()
+        prop.raw_body = _roofline_png(points, peak)
+        prop.raw_ctype = "image/png"
+        return prop
+    prop = ServerObjects()
+    prop.put("device", escape_json(peak.name))
+    prop.put("peak_tflops", round(peak.flops_per_s / 1e12, 3))
+    prop.put("peak_gbps", round(peak.bytes_per_s / 1e9, 1))
+    prop.put("ridge_flops_per_byte", round(peak.ridge, 2))
+    util = PROFILER.query_util()
+    prop.put("util_pct_p50", util["util_pct_p50"])
+    prop.put("util_pct_p95", util["util_pct_p95"])
+    prop.put("bound", util["bound"])
+    prop.put("kernels", len(points))
+    for i, p in enumerate(points):
+        prop.put(f"kernels_{i}_name", p.kernel)
+        prop.put(f"kernels_{i}_gflops", round(p.flops / 1e9, 3))
+        prop.put(f"kernels_{i}_mbytes", round(p.bytes / 1e6, 2))
+        prop.put(f"kernels_{i}_intensity", round(p.intensity, 2))
+        prop.put(f"kernels_{i}_achieved_gflops_s",
+                 round(p.achieved_flops_per_s / 1e9, 3))
+        prop.put(f"kernels_{i}_achieved_gbytes_s",
+                 round(p.achieved_bytes_per_s / 1e9, 3))
+        prop.put(f"kernels_{i}_bound", p.bound)
+        prop.put(f"kernels_{i}_util_pct", p.util_pct)
+    return prop
+
+
+def _roofline_png(points, peak, w: int = 640, h: int = 360) -> bytes:
+    """Log-log roofline: the memory-bandwidth diagonal and the compute
+    ceiling, with one dot per profiled kernel at (intensity, achieved
+    FLOP/s)."""
+    import math
+
+    from ...visualization.raster import RasterPlotter
+    img = RasterPlotter(w, h, background=(10, 10, 30))
+    x0, y0, x1, y1 = 56, 24, w - 16, h - 44
+    lx_min, lx_max = -2.0, 4.0                 # intensity 0.01..10^4 f/B
+    ly_max = math.log10(max(peak.flops_per_s, 1.0))
+    ly_min = ly_max - 8.0                      # 8 decades of FLOP/s
+
+    def px(v):
+        lv = min(max(math.log10(max(v, 1e-9)), lx_min), lx_max)
+        return int(x0 + (lv - lx_min) / (lx_max - lx_min) * (x1 - x0))
+
+    def py(v):
+        lv = min(max(math.log10(max(v, 1.0)), ly_min), ly_max)
+        return int(y1 - (lv - ly_min) / (ly_max - ly_min) * (y1 - y0))
+
+    img.rect(x0, y0, x1, y1, (60, 60, 90))
+    # the two roofs meet at the ridge point
+    ridge = peak.ridge
+    img.line(px(10 ** lx_min), py(10 ** lx_min * peak.bytes_per_s),
+             px(ridge), py(peak.flops_per_s), (230, 180, 60))
+    img.line(px(ridge), py(peak.flops_per_s),
+             px(10 ** lx_max), py(peak.flops_per_s), (230, 180, 60))
+    img.text(x0 + 4, y0 + 4,
+             f"{peak.name}  {peak.flops_per_s / 1e12:.0f} TF/S  "
+             f"{peak.bytes_per_s / 1e9:.0f} GB/S", (200, 200, 220))
+    for i, p in enumerate(points):
+        x, y = px(p.intensity), py(p.achieved_flops_per_s)
+        color = (120, 200, 255) if p.bound == "memory" else (255, 140, 160)
+        img.dot(x, y, color, radius=3)
+        img.text(min(x + 6, w - 120), max(y - 4, y0 + 2),
+                 f"{p.kernel[:16].upper()} {p.util_pct:.1f}", color)
+    img.text(x0, h - 32, "X: FLOPS/BYTE   Y: FLOP/S   "
+             "BLUE: MEMORY-BOUND  RED: COMPUTE-BOUND", (160, 160, 180))
+    return img.png_bytes()
+
+
 @servlet("PerformanceGraph")
 def respond_perfgraph(header: dict, post: ServerObjects, sb) -> ServerObjects:
     """EventTracker time-series as a PNG bar graph (ProfilingGraph)."""

@@ -622,6 +622,7 @@ def device_store(header, post, sb):
         ("join_fallbacks", getattr(ds, "join_fallbacks", 0)),
     ]
     if kind == "DeviceSegmentStore":
+        c = ds.counters()
         rows += [
             ("arena_rows_used", ds.arena.used_rows),
             ("arena_rows_capacity", ds.arena.capacity_rows),
@@ -630,6 +631,11 @@ def device_store(header, post, sb):
             ("prune_rounds", ds.prune_rounds),
             ("pruned_tiles", ds.pruned_tiles),
             ("batching", 1 if ds._batcher is not None else 0),
+            # silicon accounting (Performance_Roofline_p has the full
+            # per-kernel table; these are the per-query headline fields)
+            ("util_pct_p50", c["util_pct_p50"]),
+            ("util_pct_p95", c["util_pct_p95"]),
+            ("bound", c["bound"]),
         ]
     elif kind == "MeshSegmentStore":
         rows += [

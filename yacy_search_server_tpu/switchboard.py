@@ -107,7 +107,12 @@ class Switchboard:
                             # dispatcher threads sit blocked in the
                             # device round trip; 8 saturates the tunnel
                             # (16 measured no better at 10M/64thr)
-                            "index.device.dispatchers", 8))
+                            "index.device.dispatchers", 8),
+                        # batch exact stream scans (the r5 modifier
+                        # mix's solo dispatches) too — off by default
+                        # until the mix protocol commits the win
+                        scan_batching=self.config.get_bool(
+                            "index.device.scanBatching", False))
             except ValueError:
                 raise
             except Exception:  # no usable jax backend: host path serves
