@@ -1068,6 +1068,90 @@ def _roofline_mode(n: int, k: int = 16):
     print(RF.ascii_table(list(points.values()), peak), file=sys.stderr)
 
 
+def _trace_overhead_mode(n: int, threads: int = 16, per_thread: int = 10,
+                         windows: int = 3, budget_pct: float = 2.0):
+    """--trace-overhead (ISSUE 2): serving p50/p95 with the tracing
+    spine ON vs OFF, interleaved windows so drift hits both modes
+    equally. The spine ships enabled by default, so the overhead budget
+    is a pinned contract: p50 regression must stay under `budget_pct`%.
+    Emits one JSON line carrying the measured pair."""
+    from yacy_search_server_tpu.utils import tracing
+
+    import gc
+    import threading as _threading
+
+    sb = _build_served_switchboard(n, n_terms=2, mesh="off")
+    assert sb.index.devstore is not None, "device serving must be on"
+
+    def window(latencies):
+        """One measured window: `threads` searchers, `per_thread`
+        queries each, use_cache=False so every query ranks (a cache
+        hit would skip the very path under measurement)."""
+        def worker(t):
+            for _ in range(per_thread):
+                q0 = time.perf_counter()
+                ev = sb.search(f"benchterm{t % 2}", k_page, use_cache=False)
+                assert len(ev.results()) == k_page
+                latencies.append(time.perf_counter() - q0)
+        ts = [_threading.Thread(target=worker, args=(t,))
+              for t in range(threads)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+
+    k_page = 10
+    # warm both modes (kernel compiles, arena placement) outside the
+    # measured windows
+    tracing.set_enabled(True)
+    window([])
+    tracing.set_enabled(False)
+    window([])
+    gc.collect()
+    gc.freeze()
+    served0 = sb.index.devstore.queries_served
+
+    def pctl(sv, q):
+        # one nearest-rank convention with the servlet/profiler side
+        return tracing._pctl(sv, q) * 1000.0
+
+    p50s = {False: [], True: []}
+    lats_all = {False: [], True: []}
+    for w in range(max(1, windows)):
+        for mode in (False, True):          # interleaved: OFF then ON
+            tracing.set_enabled(mode)
+            lats: list = []
+            window(lats)
+            lats.sort()
+            p50s[mode].append(pctl(lats, 0.50))
+            lats_all[mode].extend(lats)
+    tracing.set_enabled(True)               # the product default stays on
+    total = 2 * windows * threads * per_thread
+    ranked = sb.index.devstore.queries_served - served0
+    assert ranked >= total, \
+        f"only {ranked}/{total} measured queries were device-ranked"
+    p50_off = sorted(p50s[False])[len(p50s[False]) // 2]
+    p50_on = sorted(p50s[True])[len(p50s[True]) // 2]
+    for m in lats_all.values():
+        m.sort()
+    overhead_pct = ((p50_on - p50_off) / max(p50_off, 1e-9)) * 100.0
+    print(json.dumps({
+        "metric": "trace_overhead",
+        "n_postings": n,
+        "threads": threads,
+        "queries_per_mode": threads * per_thread * windows,
+        "p50_ms_tracing_off": round(p50_off, 3),
+        "p50_ms_tracing_on": round(p50_on, 3),
+        "p95_ms_tracing_off": round(pctl(lats_all[False], 0.95), 3),
+        "p95_ms_tracing_on": round(pctl(lats_all[True], 0.95), 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "budget_pct": budget_pct,
+    }))
+    assert overhead_pct < budget_pct, (
+        f"tracing overhead {overhead_pct:.2f}% exceeds the "
+        f"{budget_pct}% stay-on-by-default budget")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000_000,
@@ -1096,10 +1180,18 @@ def main():
                          "analytical FLOPs/bytes, achieved FLOP/s / "
                          "GB/s, util%% vs the device peak, and the "
                          "compute-/memory-bound verdict (ISSUE 1)")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="serving p50/p95 with the tracing spine on vs "
+                         "off, interleaved windows; asserts the p50 "
+                         "regression stays < 2%% so tracing can ship "
+                         "enabled by default (ISSUE 2)")
     args = ap.parse_args()
 
     if args.roofline:
         _roofline_mode(args.n, k=16)
+        return
+    if args.trace_overhead:
+        _trace_overhead_mode(args.n if args.n != 10_000_000 else 200_000)
         return
     if args.config in (6, 10):
         fn = _config6_served_path if args.config == 6 \

@@ -94,3 +94,60 @@ def test_every_device_kernel_has_a_cost_model():
         "device kernels without a roofline cost model (register in "
         "ops/roofline.KERNELS or exempt WITH A REASON in "
         "ops/roofline.EXEMPT):\n  " + "\n  ".join(missing))
+
+
+# -- tracing coverage (ISSUE 2) ----------------------------------------------
+# Every @servlet handler that measures a wall (a `t0 = time.time()` /
+# `time.perf_counter()` start it later subtracts) or touches the roofline
+# profiler must open a trace/span — or carry a reasoned exemption below.
+# A new endpoint that times itself without joining the span spine would
+# silently drop out of the waterfall Performance_Trace_p renders, which
+# is exactly the blind spot the tracing subsystem closes.
+
+TRACING_EXEMPT = {
+    # these READ profiler/tracing aggregates to render dashboards; they
+    # serve no query and measure no request wall of their own
+    "respond_roofline": "renders PROFILER aggregates, serves no query",
+    "respond_metrics": "exposition endpoint reading counters only",
+    "respond_trace": "renders the tracing ring itself",
+}
+
+_WALL_START = re.compile(
+    r"\bt0\w*\s*=\s*time\.(?:time|monotonic|perf_counter)\(\)")
+_PROFILER_USE = re.compile(r"\bPROFILER\b")
+_TRACED = re.compile(r"\btracing\.(?:trace|span|span_in|begin)\b")
+
+
+def _servlet_functions(path: pathlib.Path):
+    """(function name, body source) for every @servlet-decorated def."""
+    import ast
+    src = path.read_text(encoding="utf-8")
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            if isinstance(deco, ast.Call) and \
+                    getattr(deco.func, "id", "") == "servlet":
+                yield node.name, ast.get_source_segment(src, node) or ""
+                break
+
+
+def test_wall_measuring_servlets_open_spans():
+    offenders = []
+    for p in sorted((PKG / "server" / "servlets").glob("*.py")):
+        for name, body in _servlet_functions(p):
+            measures = bool(_WALL_START.search(body)
+                            or _PROFILER_USE.search(body))
+            if not measures:
+                continue
+            if name in TRACING_EXEMPT:
+                continue
+            if _TRACED.search(body):
+                continue
+            offenders.append(f"{p.name}::{name}")
+    assert not offenders, (
+        "servlet handlers that measure a wall (or use the profiler) "
+        "without opening a tracing span — wrap the handler in "
+        "tracing.trace(...) or add a reasoned TRACING_EXEMPT entry:\n  "
+        + "\n  ".join(offenders))

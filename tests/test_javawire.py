@@ -179,3 +179,43 @@ def test_quoted_boundary_and_seed0_separation():
     other, extra, _t = out
     assert other is None                      # responder unknown
     assert [s.name for s in extra] == ["gossip"]
+
+
+def test_trace_part_rides_the_java_wire():
+    """ISSUE 2 satellite: outgoing Java-wire calls carry the active
+    trace id as an extra multipart part; without a trace no part is
+    emitted; the codec round-trips it like any other part."""
+    from yacy_search_server_tpu.utils import tracing
+    tracing.set_enabled(True)
+    parts = jw.basic_request_parts("AAAAbbbbCCCC", None, "saltsalt")
+    assert jw.TRACE_PART not in parts          # no active trace: absent
+    with tracing.trace("javawire-call") as r:
+        tid = r.ctx[0]
+        parts = jw.basic_request_parts("AAAAbbbbCCCC", None, "saltsalt")
+        assert parts[jw.TRACE_PART] == tid
+        body, ctype = jw.multipart_encode(parts)
+        back = jw.multipart_decode(body, ctype)
+        assert back[jw.TRACE_PART] == tid
+    tracing.clear()
+
+
+def test_inbound_unknown_trace_part_is_tolerated(two_nodes):
+    """The server side ignores the xtrace part like any unknown part:
+    a hello carrying one still round-trips (tolerate-and-ignore)."""
+    from yacy_search_server_tpu.utils import tracing
+    a, b, srv_b = two_nodes
+
+    def http_post(url, body, ctype):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.read()
+
+    client = jw.JavaWireClient(a.seed, http_post)
+    with tracing.trace("hello-under-trace"):
+        out = client.hello("127.0.0.1", srv_b.port,
+                           target_hash=b.seed.hash.decode("ascii"))
+    assert out is not None and out[0] is not None
+    assert out[0].hash == b.seed.hash
+    assert b.seeddb.get(a.seed.hash) is not None
+    tracing.clear()

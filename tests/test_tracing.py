@@ -1,0 +1,429 @@
+"""Distributed query tracing (ISSUE 2): the span spine across servlet →
+SearchEvent → device/mesh kernels → P2P fan-out, the `/metrics`
+exposition, and the Performance_Trace_p surface.
+
+The acceptance shape: ONE search against a two-node loopback network
+must yield ONE trace — the originator's trace id — containing servlet,
+SearchEvent, device-kernel and remote-peer spans, with the remote
+node's spans carrying the originator's id over the wire propagation
+path (payload `_trace` / the X-YaCy-Trace header)."""
+
+import threading
+
+import pytest
+
+from yacy_search_server_tpu.document.document import Document
+from yacy_search_server_tpu.peers.node import P2PNode
+from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+from yacy_search_server_tpu.server.objects import ServerObjects
+from yacy_search_server_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    tracing.set_enabled(True)
+    tracing.clear()
+    yield
+    tracing.set_enabled(True)
+    tracing.clear()
+
+
+# -- spine unit behavior -----------------------------------------------------
+
+def test_span_nesting_and_ring():
+    with tracing.trace("root", q="x") as r:
+        tid = r.ctx[0]
+        with tracing.span("child"):
+            tracing.emit("kernel.fake", 2.5, batch=4)
+    rec = tracing.get_trace(tid)
+    assert rec is not None and rec.done
+    names = {s.name for s in rec.spans}
+    assert names == {"root", "child", "kernel.fake"}
+    by = {s.name: s for s in rec.spans}
+    assert by["child"].parent == by["root"].sid
+    assert by["kernel.fake"].parent == by["child"].sid
+    assert by["kernel.fake"].dur_ms == 2.5
+    assert rec.duration_ms() >= by["child"].dur_ms
+
+
+def test_disabled_and_untraced_are_noop_singletons():
+    # outside any trace: the shared no-op object, nothing recorded
+    s1 = tracing.span("a")
+    s2 = tracing.span("b")
+    assert s1 is s2
+    tracing.emit("orphan", 1.0)
+    assert tracing.traces(10) == []
+    # disabled: trace() itself is the no-op too
+    tracing.set_enabled(False)
+    assert tracing.trace("root") is tracing.span("x")
+    with tracing.trace("root"):
+        pass
+    assert tracing.traces(10) == []
+
+
+def test_ring_and_span_bounds():
+    for i in range(tracing.MAX_TRACES + 20):
+        with tracing.trace(f"t{i}"):
+            pass
+    assert len(tracing.traces(10_000)) == tracing.MAX_TRACES
+    assert tracing.dropped_traces == 20
+
+
+def test_cross_thread_span_in():
+    with tracing.trace("root") as r:
+        ctx = r.ctx
+
+        def worker():
+            with tracing.span_in(ctx, "other-thread"):
+                pass
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    rec = tracing.get_trace(ctx[0])
+    assert "other-thread" in {s.name for s in rec.spans}
+
+
+def test_remote_trace_rejects_junk_ids():
+    assert tracing.remote_trace("x", "peer.search") is tracing.span("n")
+    assert tracing.remote_trace("a" * 200, "peer.search") \
+        is tracing.span("n")
+    with tracing.remote_trace("deadbeef1234", "peer.search", peer="p"):
+        pass
+    rec = tracing.get_trace("deadbeef1234")
+    assert rec is not None
+    assert rec.spans[0].attrs["peer"] == "p"
+
+
+def test_stage_summary_names_tail_dominant_stage():
+    for _ in range(4):
+        with tracing.trace("req"):
+            # the request wrapper covers everything but must never be
+            # named as the dominant STAGE
+            tracing.emit("switchboard.search", 60.0)
+            tracing.emit("search.fast", 1.0)
+            tracing.emit("search.slow", 50.0)
+    # pipeline traces are a different workload: excluded by default
+    with tracing.trace("pipeline.index"):
+        tracing.emit("index.storedocumentindex", 500.0)
+    s = tracing.stage_summary()
+    assert s["tail_dominant_stage"] == "search.slow"
+    assert s["stages"]["search.slow"]["p95_ms"] >= 50.0
+    # root spans never win dominance (they cover their children)
+    assert "req" in s["stages"]
+    assert "index.storedocumentindex" not in s["stages"]
+    # the all-workload view folds the pipeline back in
+    s_all = tracing.stage_summary(exclude_roots=())
+    assert s_all["tail_dominant_stage"] == "index.storedocumentindex"
+
+
+def test_export_jsonl():
+    import json
+    with tracing.trace("req") as r:
+        tid = r.ctx[0]
+        tracing.emit("stage", 3.0)
+    lines = tracing.export_jsonl(10).splitlines()
+    rows = [json.loads(ln) for ln in lines]
+    assert any(row["trace_id"] == tid and
+               any(s["name"] == "stage" for s in row["spans"])
+               for row in rows)
+
+
+# -- pipeline tracing --------------------------------------------------------
+
+SITE = {
+    "http://trace.test/": (
+        b"<html><head><title>Trace Home</title></head>"
+        b"<body>tracing pipeline document flow</body></html>"),
+    "http://trace.test/robots.txt": b"",
+}
+
+
+def _transport(url, headers):
+    if url in SITE:
+        return 200, {"content-type": "text/html"}, SITE[url]
+    return 404, {}, b""
+
+
+def test_indexing_pipeline_emits_one_trace_per_document(tmp_path):
+    from yacy_search_server_tpu.switchboard import Switchboard
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), transport=_transport)
+    sb.latency.min_delta_s = 0.0
+    try:
+        sb.start_crawl("http://trace.test/", depth=0)
+        sb.crawl_until_idle(timeout_s=30)
+        recs = [r for r in tracing.traces(100)
+                if r.root_name == "pipeline.index"]
+        assert recs, "no pipeline trace recorded"
+        rec = recs[0]
+        names = {s.name for s in rec.spans}
+        # ONE span per stage: the StageTimer bridge records it under the
+        # attached entry context (no duplicate span_in wrapper)
+        stages = {"index.parsedocument", "index.condensedocument",
+                  "index.webstructureanalysis", "index.storedocumentindex"}
+        assert stages | {"pipeline.index"} <= names
+        # exactly ONE span per pipeline stage (nested segment-level
+        # spans like index.storedocument may ride along, duplicates not)
+        all_names = [s.name for s in rec.spans]
+        for st in stages:
+            assert all_names.count(st) == 1, all_names
+        assert rec.done
+    finally:
+        sb.close()
+
+
+# -- two-node loopback: the acceptance trace ---------------------------------
+
+def _doc(url, title, text):
+    return Document(url=url, title=title, text=text,
+                    mime_type="text/html", language="en")
+
+
+@pytest.fixture
+def duo(tmp_path):
+    net = LoopbackNetwork()
+    nodes = []
+    for name in ("origin", "remote"):
+        port = 8000 + sum(name.encode()) % 1000
+        n = P2PNode(name, net, data_dir=str(tmp_path / name), port=port,
+                    partition_exponent=2, redundancy=1)
+        nodes.append(n)
+    for n in nodes:
+        n.bootstrap([m.seed for m in nodes if m is not n])
+        n.ping()
+    for n in nodes:
+        n.ping()
+    yield nodes
+    for n in nodes:
+        n.close()
+
+
+def _index_docs(node, tag, n=30):
+    for i in range(n):
+        node.sb.index.store_document(_doc(
+            f"http://{tag}{i % 3}.example/d{i}.html",
+            f"{tag} doc {i} tracing",
+            f"distributed tracing span spine document {tag} " * 4))
+    node.sb.index.rwi.flush()
+
+
+def test_cross_peer_trace_assembly(duo):
+    """One servlet search on the originator fans out to the remote peer;
+    every layer's spans land under ONE trace id, including the remote
+    node's — the wire propagation contract."""
+    a, b = duo
+    _index_docs(a, "alpha")
+    _index_docs(b, "beta")
+    if a.sb.index.devstore is not None:
+        # tiny index: drop the small-candidate gate so the device path
+        # serves (the production gate would host-serve 30 postings)
+        a.sb.index.devstore.small_rank_n = 0
+        # warm the kernels OUTSIDE the traced request so the batcher
+        # watchdog isn't spent on first-use compiles
+        a.sb.search("tracing", count=5, use_cache=False)
+        a.sb.search_cache.clear()
+        tracing.clear()
+
+    from yacy_search_server_tpu.server.servlets.yacysearch import respond
+    header = {"ext": "json"}
+    post = ServerObjects({"query": "tracing", "resource": "global"})
+    prop = respond(header, post, a.sb)
+    assert prop.get("items", 0) or prop.get("found", 0)
+
+    recs = [r for r in tracing.traces(50)
+            if r.root_name == "servlet.yacysearch"]
+    assert len(recs) == 1, "one search must be one trace"
+    rec = recs[0]
+    names = {s.name for s in rec.spans}
+    # servlet + SearchEvent layers
+    assert "servlet.yacysearch" in names
+    assert "switchboard.search" in names
+    assert names & {"search.devrank", "search.join", "search.presort",
+                    "search.normalizing"}, names
+    # device kernel span (batched stamp or the profiler bridge)
+    if a.sb.index.devstore is not None:
+        assert any(n.startswith("kernel.") for n in names), names
+        assert "search.devrank" in names, names
+    # P2P fan-out + the REMOTE node's segment under the SAME trace id
+    assert "peers.fanout" in names
+    assert "peers.remotesearch" in names
+    remote_spans = [s for s in rec.spans if s.name == "peer.search"]
+    assert remote_spans, "remote peer recorded no span under the trace"
+    b_hash = b.seed.hash.decode("ascii")
+    assert any(s.attrs.get("peer") == b_hash for s in remote_spans)
+    # the remote peer's own SearchEvent stages nest under its segment
+    remote_sids = {s.sid for s in remote_spans}
+    assert any(s.parent in remote_sids for s in rec.spans
+               if s.name.startswith("search.")), \
+        "remote SearchEvent stages must parent under peer.search"
+    # fusion of the remote results back into the live event
+    assert "search.fusion_remote" in names
+
+    # rendered by Performance_Trace_p: the span table and the waterfall
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        respond_trace)
+    tprop = respond_trace({"ext": "json"},
+                          ServerObjects({"trace": rec.trace_id}), a.sb)
+    assert tprop.get_int("spans", 0) == len(rec.spans)
+    png = respond_trace({"ext": "png"},
+                        ServerObjects({"trace": rec.trace_id,
+                                       "format": "png"}), a.sb)
+    assert isinstance(png.raw_body, bytes)
+    assert png.raw_body[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_trace_servlet_lists_recent_and_summary(duo):
+    a, _b = duo
+    _index_docs(a, "gamma", n=6)
+    a.sb.search("tracing", count=3)
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        respond_trace)
+    prop = respond_trace({"ext": "json"}, ServerObjects({}), a.sb)
+    assert prop.get_int("traces", 0) >= 1
+    assert prop.get_int("enabled", 0) == 1
+    assert prop.get("tail_dominant_stage", "") != ""
+    jl = respond_trace({"ext": "jsonl"},
+                       ServerObjects({"format": "jsonl"}), a.sb)
+    assert jl.raw_body and "trace_id" in jl.raw_body
+
+
+# -- /metrics exposition -----------------------------------------------------
+
+def _parse_exposition(text):
+    """Minimal format check: every non-comment line is `name[{labels}]
+    value`, HELP/TYPE precede their family's samples."""
+    import re
+    samples = []
+    seen_type = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            if line.startswith("# TYPE "):
+                name, kind = line.split()[2:4]
+                assert kind in ("counter", "gauge", "histogram", "summary")
+                seen_type.add(name)
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                     r"(\{[^}]*\})?\s+(-?[0-9.eE+-]+)$", line)
+        assert m, f"bad exposition line: {line!r}"
+        assert m.group(1) in seen_type, f"sample before TYPE: {line!r}"
+        samples.append((m.group(1), m.group(2) or "", float(m.group(3))))
+    return samples
+
+
+def test_metrics_exposition(duo):
+    a, _b = duo
+    _index_docs(a, "delta", n=6)
+    a.sb.search("tracing", count=3)
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        prometheus_text)
+    text = prometheus_text(a.sb)
+    samples = _parse_exposition(text)
+    names = {s[0] for s in samples}
+    assert "yacy_log_dropped_records_total" in names
+    assert "yacy_stage_events_total" in names
+    assert "yacy_crawler_queue_depth" in names
+    assert "yacy_pipeline_processed_total" in names
+    assert "yacy_index_documents" in names
+    # node-level DHT counters (the switchboard belongs to a P2PNode)
+    assert "yacy_dht_transferred_postings_total" in names
+    # batcher cause buckets when the device store serves
+    if a.sb.index.devstore is not None:
+        causes = {lbl for (n, lbl, _v) in samples
+                  if n == "yacy_batch_timeouts_total"}
+        assert {'{cause="queue_full"}', '{cause="flush_deadline"}',
+                '{cause="worker_stall"}'} <= causes
+
+
+def test_metrics_servlet_content_type(duo):
+    a, _b = duo
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        respond_metrics)
+    prop = respond_metrics({"ext": "html"}, ServerObjects({}), a.sb)
+    assert prop.raw_ctype.startswith("text/plain; version=0.0.4")
+    assert prop.raw_body.endswith("\n")
+
+
+def test_queues_servlet_exposes_log_drops(duo):
+    a, _b = duo
+    from yacy_search_server_tpu.server.servlets.admin import respond_queues
+    prop = respond_queues({"ext": "json"}, ServerObjects({}), a.sb)
+    assert prop.get("log_dropped_records") is not None
+
+
+# -- mesh path ---------------------------------------------------------------
+
+def test_mesh_batcher_emits_spans_under_one_trace():
+    import numpy as np
+    import jax
+    devs = jax.devices("cpu")
+    if len(devs) < 8:
+        pytest.skip("need 8 cpu devices")
+    from yacy_search_server_tpu.index import postings as P
+    from yacy_search_server_tpu.index.meshstore import MeshSegmentStore
+    from yacy_search_server_tpu.index.postings import PostingsList
+    from yacy_search_server_tpu.index.rwi import RWIIndex
+    from yacy_search_server_tpu.ops.ranking import RankingProfile
+    from yacy_search_server_tpu.utils.hashes import word2hash
+
+    rng = np.random.default_rng(3)
+    n = 20_000
+    th = word2hash("meshtraceterm")
+    feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    rwi = RWIIndex()
+    rwi.ingest_run({th: PostingsList(np.arange(n, dtype=np.int32), feats)})
+    ms = MeshSegmentStore(rwi, devices=devs[:8], n_term=2)
+    try:
+        ms.enable_batching(max_batch=4)
+        prof = RankingProfile()
+        ms.rank_term(th, prof, k=10)        # warm: compile outside trace
+        tracing.clear()
+        with tracing.trace("mesh-query") as r:
+            tid = r.ctx[0]
+            got = ms.rank_term(th, prof, k=10)
+        assert got is not None
+        rec = tracing.get_trace(tid)
+        names = {s.name for s in rec.spans}
+        assert "mesh.batch" in names, names
+        assert any(nm.startswith("kernel.") for nm in names), names
+    finally:
+        ms.close()
+
+
+# -- X-YaCy-Trace over real HTTP sockets -------------------------------------
+
+def test_trace_header_propagates_over_http(tmp_path):
+    """The originator's trace id crosses a REAL socket as the
+    X-YaCy-Trace header (HttpTransport emits it, httpd parses it back,
+    PeerServer roots the remote segment under it)."""
+    from yacy_search_server_tpu.peers.transport import HttpTransport
+    nodes = []
+    for name in ("httptrace-a", "httptrace-b"):
+        t = HttpTransport(timeout_s=10.0)
+        n = P2PNode(name, t, data_dir=str(tmp_path / name),
+                    partition_exponent=1, redundancy=1)
+        n.serve_http()
+        nodes.append(n)
+    a, b = nodes
+    try:
+        a.bootstrap([b.seed])
+        b.bootstrap([a.seed])
+        a.ping()
+        b.ping()
+        _index_docs(b, "htb", n=6)
+        tracing.clear()
+        with tracing.trace("http-search") as r:
+            tid = r.ctx[0]
+            ev = a.search("tracing", count=3)
+        assert ev.remote_peers_asked >= 1
+        rec = tracing.get_trace(tid)
+        assert rec is not None
+        remote = [s for s in rec.spans if s.name == "peer.search"]
+        assert remote, "remote segment missing under the trace"
+        assert remote[0].attrs.get("peer") == b.seed.hash.decode("ascii")
+    finally:
+        for n in nodes:
+            n.close()

@@ -72,6 +72,7 @@ from ..ops.ranking import (_ACTIVE_COLS, RankingProfile,
 from ..ops.streaming import merge_stats
 from ..utils.eventtracker import EClass, update as track
 from ..utils.profiler import PROFILER
+from ..utils import tracing
 from . import postings as P
 
 log = logging.getLogger("yacy.devstore")
@@ -1315,7 +1316,27 @@ class _QueryBatcher:
         """Queue the item, wait out the watchdog; returns the result or
         ("timeout",) — after which the CALLER serves the query itself
         (the solo kernels share the batch kernels' compile shapes, so a
-        withdrawn query never pays a fresh jit compile)."""
+        withdrawn query never pays a fresh jit compile).
+
+        Tracing: the whole enqueue→flush→dispatch wait is one span on
+        the SUBMITTER's trace; the dispatcher stamps the item with its
+        group's kernel wall (the same wall the profiler records), which
+        is re-emitted here as a child span — dispatcher threads carry no
+        trace context of their own."""
+        sp = tracing.span("devstore.batch", kind=item.get("kind", "term"))
+        with sp:
+            res = self._submit_wait_inner(item)
+            km = item.get("kernel_ms")
+            # a withdrawn query's late-stamped dispatch is discarded
+            # work: the solo retry emits the REAL kernel span, and a
+            # timeout emit here would double-count the query's wall
+            if km is not None and res[0] != "timeout":
+                tracing.emit(f"kernel.{item.get('kernel_name', '?')}",
+                             km, batch=item.get("batch_n", 0))
+            sp.set(outcome=res[0])
+        return res
+
+    def _submit_wait_inner(self, item: dict):
         ev = item["ev"]
         self._q.put(item)
         if ev.wait(timeout=self.WATCHDOG_S):
@@ -1617,6 +1638,10 @@ class _QueryBatcher:
             wall = time.perf_counter() - t0k
             with self._ms_lock:
                 self.query_kernel_ms.extend([wall * 1000.0] * len(items))
+            for it in items:     # trace stamps: re-emitted by submitters
+                it["kernel_ms"] = wall * 1000.0
+                it["kernel_name"] = "_rank_pruned_batch1_kernel"
+                it["batch_n"] = len(items)
             # silicon accounting: the device share of this dispatch (wall
             # minus the measured trivial round trip) against the cost of
             # the ACTIVE slots (pad slots stream nothing that matters)
@@ -1690,6 +1715,10 @@ class _QueryBatcher:
                 with self._ms_lock:
                     self.query_kernel_ms.extend([wall * 1000.0]
                                                 * len(chunk))
+                for it in chunk:
+                    it["kernel_ms"] = wall * 1000.0
+                    it["kernel_name"] = "_rank_scan_batch_kernel"
+                    it["batch_n"] = len(chunk)
                 PROFILER.record(
                     "_rank_scan_batch_kernel",
                     max(wall - store.tunnel_rt_ms / 1e3, 1e-6),
@@ -1778,6 +1807,12 @@ class _QueryBatcher:
                     with self._ms_lock:
                         self.query_kernel_ms.extend(
                             [wall * 1000.0] * len(chunk))
+                    for it in chunk:
+                        it["kernel_ms"] = wall * 1000.0
+                        it["kernel_name"] = (
+                            "_rank_join_bm_batch_kernel" if any_bm
+                            else "_rank_join_batch_kernel")
+                        it["batch_n"] = len(chunk)
                     windows = tuple(m for m in inc_ms + exc_ms if m)
                     PROFILER.record(
                         ("_rank_join_bm_batch_kernel" if any_bm

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from functools import partial
 
 log = logging.getLogger("index.meshstore")
@@ -69,6 +70,7 @@ from ..ops.streaming import merge_stats
 from ..parallel.distribution import horizontal_dht_position
 from ..parallel.mesh import shard_map
 from ..utils.eventtracker import EClass, update as track
+from ..utils import tracing
 from . import postings as P
 from .devstore import (_PRUNE_B, DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32,
                        NO_FLAG, NO_LANG, TILE, _bucket_delta,
@@ -220,10 +222,24 @@ class _MeshQueryBatcher:
 
     def submit(self, termhash: bytes, profile, language: str, kk: int):
         """Blocking; ("ok", scores, docids) | ("prune_fail",) |
-        ("ineligible",) | ("timeout",)."""
+        ("ineligible",) | ("timeout",). Traced like the devstore
+        batcher: one "mesh.batch" span on the submitter's trace, plus
+        the dispatcher-stamped kernel wall as a child span."""
         item = {"th": termhash, "profile": profile, "lang": language,
                 "kk": kk, "ev": threading.Event(), "res": ("ineligible",),
                 "lk": threading.Lock(), "taken": False}
+        sp = tracing.span("mesh.batch")
+        with sp:
+            res = self._submit_wait(item)
+            km = item.get("kernel_ms")
+            # withdrawn dispatch: the solo retry owns the kernel span
+            if km is not None and res[0] != "timeout":
+                tracing.emit(f"kernel.{item.get('kernel_name', '?')}",
+                             km, batch=item.get("batch_n", 0))
+            sp.set(outcome=res[0])
+        return res
+
+    def _submit_wait(self, item: dict):
         self._q.put(item)
         if item["ev"].wait(timeout=self.WATCHDOG_S):
             return item["res"]
@@ -327,10 +343,12 @@ class _MeshQueryBatcher:
                 tmax[i] = sp.stats["tf_max"]
             pending = list(range(len(items)))
             for b in _PRUNE_B:
+                t0k = time.perf_counter()
                 out = store._pbfn(kk, b, bs)(
                     *arrays, dead, pmax, qargs, cmin, cmax, tmin, tmax,
                     shift, lang_term, *consts)
                 s, d, ok = jax.device_get(out)
+                wall_ms = (time.perf_counter() - t0k) * 1000.0
                 self.dispatches += 1
                 store.prune_rounds += 1
                 still = []
@@ -340,6 +358,9 @@ class _MeshQueryBatcher:
                         store.pruned_tiles += int(
                             np.maximum(sp.tcounts - b, 0).sum())
                         items[i]["res"] = ("ok", s[i], d[i])
+                        items[i]["kernel_ms"] = wall_ms
+                        items[i]["kernel_name"] = "_mesh_pruned_kernel"
+                        items[i]["batch_n"] = len(items)
                         items[i]["ev"].set()
                         # satisfied slot becomes a free pad slot for the
                         # escalation rounds (count/tcount 0): the next

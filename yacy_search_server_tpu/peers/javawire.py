@@ -35,8 +35,15 @@ import hashlib
 import secrets
 import time
 
+from ..utils import tracing
 from ..utils.base64order import enhanced_coder
 from .seed import Seed
+
+# multipart part name carrying the trace id on the Java wire (the
+# HTTP-header equivalent of tracing.TRACE_HEADER; a real YaCy peer
+# ignores unknown parts, and our inbound handlers do the same — the
+# tolerate-and-ignore contract, test_javawire)
+TRACE_PART = "xtrace"
 
 # ---------------------------------------------------------------------------
 # crypt.simpleEncode / simpleDecode
@@ -219,6 +226,13 @@ def basic_request_parts(my_hash: str, target_hash: str | None, salt: str,
     parts["key"] = salt
     if network_magic:
         parts["magicmd5"] = magic_md5(salt, my_hash, network_magic)
+    # distributed tracing rides the Java wire too: every outgoing call
+    # built on basicRequestParts (hello, search, transferRWI) carries
+    # the active trace id as an extra part; receivers that don't know
+    # it ignore it like any unknown part
+    tid = tracing.current_trace_id()
+    if tid is not None:
+        parts[TRACE_PART] = tid
     return parts
 
 

@@ -52,6 +52,10 @@ class P2PNode:
         self.seed.flags_accept_remote_crawl = accept_remote_crawl
         self.seeddb = SeedDB(self.seed, data_dir)
         self.sb.seeddb = self.seeddb     # status/graphics servlets read it
+        # servlet-level P2P access: yacysearch's resource=global fan-out
+        # and /metrics' DHT counters reach the peer stack through the
+        # switchboard (httpd's *.yacy rewrite already expects sb.node)
+        self.sb.node = self
         self.dist = Distribution(partition_exponent)
         self.redundancy = redundancy
         self.news = NewsPool(data_dir)
@@ -221,25 +225,46 @@ class P2PNode:
         Cluster mode (reference: cluster.peers.yacydomain allowlist ->
         Searchdom.CLUSTER): when `cluster_peers` is set, the scatter goes to
         exactly that fixed peer set instead of DHT-selected targets."""
+        event = self.sb.search(query_string, count=count)
+        if remote:
+            self.scatter(event, count, timeout_s=timeout_s,
+                         secondary=secondary)
+        return event
+
+    def scatter(self, event: SearchEvent, count: int,
+                timeout_s: float | None = None,
+                secondary: bool = True) -> int:
+        """Remote scatter-gather into a live event — THE fan-out used by
+        both node.search and the servlet's resource=global path, so
+        cluster mode (the cluster_peers allowlist) and the secondary
+        abstract-join round apply no matter which surface asked.
+        Returns the number of peers asked."""
+        if not self.seeddb.active:
+            return 0
+        # a CACHED event carries the trace of the request that created
+        # it (possibly long finished): this scatter belongs to the
+        # request driving it NOW, so its fan-out spans re-parent here
+        from ..utils import tracing
+        cur = tracing.current()
+        if cur is not None:
+            event.trace_ctx = cur
         if timeout_s is None:
             timeout_s = self.network_unit.remotesearch_maxtime_ms / 1000.0
         per_peer = max(count, self.network_unit.remotesearch_maxcount)
-        event = self.sb.search(query_string, count=count)
-        if remote and self.seeddb.active:
-            rs = RemoteSearch(event, self.seeddb, self.dist, self.protocol,
-                              redundancy=self.redundancy,
-                              per_peer_count=per_peer, timeout_s=timeout_s)
-            if self.cluster_peers:
-                allowed = {n.lower() for n in self.cluster_peers}
-                targets = [s for s in self.seeddb.active_seeds()
-                           if s.name.lower() in allowed]
-                rs.start_fixed(targets)
-            else:
-                rs.start()
-            rs.join()
-            if secondary and rs.secondary_search():
-                rs.join(timeout_s / 2)
-        return event
+        rs = RemoteSearch(event, self.seeddb, self.dist, self.protocol,
+                          redundancy=self.redundancy,
+                          per_peer_count=per_peer, timeout_s=timeout_s)
+        if self.cluster_peers:
+            allowed = {n.lower() for n in self.cluster_peers}
+            targets = [s for s in self.seeddb.active_seeds()
+                       if s.name.lower() in allowed]
+            asked = rs.start_fixed(targets)
+        else:
+            asked = rs.start()
+        rs.join()
+        if secondary and rs.secondary_search():
+            rs.join(timeout_s / 2)
+        return asked
 
     # -- HTTP face (DCN deployment) ------------------------------------------
 

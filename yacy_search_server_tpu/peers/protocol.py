@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..index.postings import NF, PostingsList
+from ..utils import tracing
 from .seed import Seed, SeedDB
 from .transport import PeerUnreachable, Transport
 
@@ -56,6 +57,13 @@ class Protocol:
 
     def _call(self, target: Seed, endpoint: str, payload: dict
               ) -> tuple[bool, dict]:
+        # trace propagation: the active trace id rides every outgoing
+        # RPC in-band (tracing.PAYLOAD_KEY); HttpTransport promotes it
+        # to the X-YaCy-Trace header on the real wire, and the remote
+        # PeerServer roots its spans under it — one trace network-wide
+        tid = tracing.current_trace_id()
+        if tid is not None and tracing.PAYLOAD_KEY not in payload:
+            payload = {**payload, tracing.PAYLOAD_KEY: tid}
         try:
             reply = self.transport.rpc(target.hash, endpoint, payload)
         except PeerUnreachable:

@@ -18,6 +18,7 @@ from collections import defaultdict
 
 from ..parallel.distribution import Distribution
 from ..search.searchevent import ResultEntry, SearchEvent
+from ..utils import tracing
 from .dht import select_search_targets
 from .protocol import Protocol
 from .seed import Seed, SeedDB
@@ -115,19 +116,27 @@ class RemoteSearch:
     def _one_peer(self, target: Seed, with_abstracts: bool,
                   wordhashes: list[bytes] | None = None,
                   urls: list[bytes] | None = None) -> None:
-        q = self.event.query
-        include = wordhashes or q.goal.include_hashes
-        ok, reply = self.protocol.search(
-            target, include, q.goal.exclude_hashes,
-            count=self.per_peer_count,
-            timeout_ms=int(self.timeout_s * 1000),
-            lang=q.lang, contentdom=q.contentdom,
-            with_abstracts=with_abstracts, urls=urls)
-        if not ok:
-            return
-        entries = _entries_from_links(
-            reply.get("links", []), source=target.hash.decode("ascii"))
-        self.event.add_remote_results(entries)
+        # fan-out threads start with an empty context: parent this
+        # peer's leg under the trace the event was born in, so the
+        # scatter (and the wire-propagated remote segment) stays one
+        # trace (utils/tracing — the span spine)
+        with tracing.span_in(self.event.trace_ctx, "peers.remotesearch",
+                             peer=target.name,
+                             secondary=urls is not None) as sp:
+            q = self.event.query
+            include = wordhashes or q.goal.include_hashes
+            ok, reply = self.protocol.search(
+                target, include, q.goal.exclude_hashes,
+                count=self.per_peer_count,
+                timeout_ms=int(self.timeout_s * 1000),
+                lang=q.lang, contentdom=q.contentdom,
+                with_abstracts=with_abstracts, urls=urls)
+            sp.set(ok=ok, links=len(reply.get("links", [])) if ok else 0)
+            if not ok:
+                return
+            entries = _entries_from_links(
+                reply.get("links", []), source=target.hash.decode("ascii"))
+            self.event.add_remote_results(entries)
         if with_abstracts:
             with self._abs_lock:
                 for wh_s, uhs in reply.get("abstracts", {}).items():

@@ -15,6 +15,7 @@ import time
 from ..index.metadata import (DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS,
                               DocumentMetadata)
 from ..index.postings import NF
+from ..utils import tracing
 from .protocol import MAX_RWI_ENTRIES_PER_CALL, decode_postings
 from .seed import Seed, SeedDB
 
@@ -45,6 +46,20 @@ class PeerServer:
         fn = getattr(self, "do_" + endpoint, None)
         if fn is None:
             return {"error": f"unknown endpoint {endpoint}"}
+        # distributed tracing: an inbound trace id (in-band from the
+        # loopback/JSON wire, X-YaCy-Trace via server/httpd.py) roots
+        # THIS peer's spans under the ORIGINATOR's trace — the remote
+        # segment of one network-wide trace. The span carries this
+        # node's identity so cross-peer assembly can attribute it.
+        tid = payload.pop(tracing.PAYLOAD_KEY, None) \
+            if isinstance(payload, dict) else None
+        if tid is not None and tracing.enabled():
+            me = self.seeddb.my_seed
+            with tracing.remote_trace(
+                    str(tid), f"peer.{endpoint}",
+                    peer=me.hash.decode("ascii", "replace"),
+                    peer_name=me.name):
+                return fn(payload)
         return fn(payload)
 
     # -- membership ----------------------------------------------------------

@@ -129,10 +129,20 @@ class HttpTransport:
         base = self._resolve(target_hash)
         if not base:
             raise PeerUnreachable(target_hash.decode("ascii", "replace"))
+        # the trace id travels as a real HTTP header on the wire (the
+        # server side parses X-YaCy-Trace back into the payload); keep
+        # the JSON body free of transport concerns
+        from ..utils import tracing
+        headers = {"Content-Type": "application/json"}
+        tid = payload.get(tracing.PAYLOAD_KEY)
+        if tid is not None:
+            payload = {k: v for k, v in payload.items()
+                       if k != tracing.PAYLOAD_KEY}
+            if tracing.valid_trace_id(tid):
+                headers[tracing.TRACE_HEADER] = tid
         body = _json.dumps(payload).encode("utf-8")
         req = urllib.request.Request(
-            f"{base}/yacy/{endpoint}.html", data=body,
-            headers={"Content-Type": "application/json"})
+            f"{base}/yacy/{endpoint}.html", data=body, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 reply = _json.loads(r.read().decode("utf-8"))

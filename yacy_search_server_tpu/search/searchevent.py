@@ -40,6 +40,7 @@ from ..ops.ranking import (CD_ALL, CD_APP, CD_AUDIO, CD_IMAGE, CD_TEXT,
                            CD_VIDEO, CardinalRanker)
 from ..utils.bitfield import (FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO,
                               FLAG_CAT_HASIMAGE, FLAG_CAT_HASVIDEO)
+from ..utils import tracing
 from ..utils.eventtracker import EClass, StageTimer
 from ..utils.hashes import hosthash
 from ..utils.topk import WeakPriorityQueue
@@ -149,6 +150,10 @@ class SearchEvent:
         self._pending: list[tuple[int, int]] = []  # lazily-drained ranked
         self._drained = 0                          # local entries drained
         self._ranker = CardinalRanker(query.profile, query.lang)
+        # the trace this event was born under: remote feeder threads and
+        # late-merging producers parent their spans here (the contextvar
+        # does not cross the fan-out thread boundary)
+        self.trace_ctx = tracing.current()
         self._run_local()
 
     # -- local batched path --------------------------------------------------
@@ -556,6 +561,16 @@ class SearchEvent:
         """Feeder entry point for remote peers (M5): merge asynchronously
         into the live event (the reference's addNodes path)."""
         added = 0
+        src0 = entries[0].source if entries else ""
+        with tracing.span_in(self.trace_ctx, "search.fusion_remote",
+                             n=len(entries), peer=src0):
+            added = self._add_remote_locked(entries)
+        self.remote_results += added
+        self.touched = time.time()
+        return added
+
+    def _add_remote_locked(self, entries: list[ResultEntry]) -> int:
+        added = 0
         for e in entries:
             src = getattr(e, "source", None)
             if src and src != "local":
@@ -566,8 +581,6 @@ class SearchEvent:
                     pass  # non-hash source label: nothing to mark
             if self._insert(e):
                 added += 1
-        self.remote_results += added
-        self.touched = time.time()
         return added
 
     # -- consumption ---------------------------------------------------------
@@ -646,6 +659,10 @@ class SearchEvent:
         """Fill missing snippets; returns how many entries were evicted
         (reference: concurrent snippet workers + deleteIfSnippetFail,
         SearchEvent.java:1862-1948)."""
+        with tracing.span("search.snippets", n=len(entries)):
+            return self._produce_snippets_inner(entries)
+
+    def _produce_snippets_inner(self, entries: list[ResultEntry]) -> int:
         from .snippet import (SNIPPET_DEAD, SNIPPET_OK, SnippetProducer)
         q = self.query
         words = q.goal.include_words

@@ -516,6 +516,14 @@ class YaCyHttpServer:
         if self.peer_server is None:
             self._send(handler, 404, "text/plain", b"p2p disabled")
             return
+        # distributed tracing: the originator's trace id arrives in the
+        # X-YaCy-Trace header (peers/transport.HttpTransport emits it);
+        # hand it to the PeerServer in-band so loopback and HTTP wires
+        # share one code path (peers/server.py roots the remote spans)
+        from ..utils import tracing
+        wire_tid = handler.headers.get(tracing.TRACE_HEADER)
+        if wire_tid and tracing.PAYLOAD_KEY not in params:
+            params = {**params, tracing.PAYLOAD_KEY: wire_tid}
         endpoint = path[len("/yacy/"):]
         if endpoint.endswith(".html"):
             endpoint = endpoint[:-5]

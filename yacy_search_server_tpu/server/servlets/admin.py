@@ -173,6 +173,10 @@ def respond_queues(header: dict, post: ServerObjects, sb) -> ServerObjects:
         prop.put(pre + "avgexecms", f"{m.avg_exec_ms:.3f}")
         prop.put(pre + "workers", m.workers)
         prop.put(pre + "eol", 1 if i < len(procs) - 1 else 0)
+    # async-logging health: records lost to the bounded queue were
+    # counted (utils/logging.py) but surfaced nowhere until ISSUE 2
+    from ...utils import logging as ylog
+    prop.put("log_dropped_records", ylog.dropped_count())
     threads = getattr(sb, "threads", None)
     names = threads.names() if threads else []
     prop.put("busythreads", len(names))

@@ -10,6 +10,7 @@ EventTracker time-series rendered as a PNG via ProfilingGraph)."""
 
 from __future__ import annotations
 
+from ...utils import tracing
 from ...utils.eventtracker import EClass, events
 from ...utils.memory import MemoryControl
 from ..objects import ServerObjects, escape_json
@@ -206,4 +207,313 @@ def respond_perfgraph(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop = ServerObjects()
     prop.raw_body = img.png_bytes()
     prop.raw_ctype = "image/png"
+    return prop
+
+
+# ---------------------------------------------------------------------------
+# distributed tracing surface (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+# span-name prefix -> waterfall bar color (one hue per layer)
+_TRACE_COLORS = [
+    ("servlet.", (120, 200, 255)),
+    ("switchboard.", (160, 220, 160)),
+    ("search.", (90, 200, 140)),
+    ("devstore.", (255, 190, 90)),
+    ("mesh.", (255, 190, 90)),
+    ("kernel.", (255, 140, 160)),
+    ("peers.", (200, 160, 255)),
+    ("peer.", (200, 160, 255)),
+    ("index.", (180, 180, 120)),
+]
+
+
+def _span_color(name: str):
+    for prefix, color in _TRACE_COLORS:
+        if name.startswith(prefix):
+            return color
+    return (170, 170, 190)
+
+
+@servlet("Performance_Trace_p")
+def respond_trace(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Per-request stage attribution (ISSUE 2): the recent-trace table,
+    per-stage p50/p95 with the tail-dominant stage named, and — for one
+    trace — the span list or a waterfall PNG rendered on the raster
+    layer. `format=jsonl` exports the retained ring for offline
+    analysis."""
+    fmt = post.get("format", "")
+    tid = post.get("trace", "")
+    if fmt == "jsonl":
+        prop = ServerObjects()
+        prop.raw_body = tracing.export_jsonl(post.get_int("count", 50))
+        prop.raw_ctype = "application/jsonl; charset=utf-8"
+        return prop
+    if tid and fmt == "png":
+        rec = tracing.get_trace(tid)
+        prop = ServerObjects()
+        prop.raw_body = _trace_waterfall_png(rec)
+        prop.raw_ctype = "image/png"
+        return prop
+    prop = ServerObjects()
+    prop.put("enabled", 1 if tracing.enabled() else 0)
+    prop.put("dropped_traces", tracing.dropped_traces)
+    prop.put("dropped_spans", tracing.dropped_spans)
+    if tid:
+        rec = tracing.get_trace(tid)
+        if rec is None:
+            prop.put("info", "unknown trace")
+            prop.put("spans", 0)
+            return prop
+        prop.put("trace_id", escape_json(rec.trace_id))
+        prop.put("root", escape_json(rec.root_name))
+        prop.put("duration_ms", round(rec.duration_ms(), 3))
+        t0 = min((s.ts for s in rec.spans), default=rec.created)
+        prop.put("spans", len(rec.spans))
+        for i, s in enumerate(rec.spans):
+            p = f"spans_{i}_"
+            prop.put(p + "name", escape_json(s.name))
+            prop.put(p + "offset_ms", round((s.ts - t0) * 1000.0, 3))
+            prop.put(p + "dur_ms", round(s.dur_ms, 3))
+            prop.put(p + "parent", escape_json(s.parent))
+            prop.put(p + "attrs", escape_json(
+                " ".join(f"{k}={v}" for k, v in s.attrs.items())))
+        return prop
+    recs = tracing.traces(post.get_int("count", 25))
+    prop.put("traces", len(recs))
+    for i, rec in enumerate(recs):
+        p = f"traces_{i}_"
+        prop.put(p + "trace_id", escape_json(rec.trace_id))
+        prop.put(p + "root", escape_json(rec.root_name))
+        prop.put(p + "duration_ms", round(rec.duration_ms(), 3))
+        prop.put(p + "spans", len(rec.spans))
+        prop.put(p + "done", 1 if rec.done else 0)
+    # serving-stage summary by default; workload=all folds the sampled
+    # per-document pipeline traces in too
+    summary = tracing.stage_summary(
+        exclude_roots=() if post.get("workload", "") == "all"
+        else ("pipeline.index",))
+    stages = sorted(summary["stages"].items(),
+                    key=lambda kv: -kv[1]["p95_ms"])
+    prop.put("tail_dominant_stage",
+             escape_json(summary["tail_dominant_stage"]))
+    prop.put("stages", len(stages))
+    for i, (name, st) in enumerate(stages):
+        p = f"stages_{i}_"
+        prop.put(p + "name", escape_json(name))
+        prop.put(p + "count", st["count"])
+        prop.put(p + "p50_ms", st["p50_ms"])
+        prop.put(p + "p95_ms", st["p95_ms"])
+    return prop
+
+
+def _trace_waterfall_png(rec, w: int = 760, h: int = 0) -> bytes:
+    """One trace as a waterfall: a bar per span, x = offset within the
+    trace, width = duration, one color per layer prefix."""
+    from ...visualization.raster import RasterPlotter
+    spans = sorted(rec.spans, key=lambda s: s.ts) if rec else []
+    row_h = 14
+    h = h or max(80, 48 + row_h * len(spans))
+    img = RasterPlotter(w, h, background=(10, 10, 30))
+    if rec is None or not spans:
+        img.text(8, 8, "NO SUCH TRACE / NO SPANS", (200, 200, 220))
+        return img.png_bytes()
+    t0 = min(s.ts for s in spans)
+    t1 = max(s.ts + s.dur_ms / 1000.0 for s in spans)
+    total_ms = max((t1 - t0) * 1000.0, 1e-3)
+    img.text(8, 6, f"TRACE {rec.trace_id}  {total_ms:.1f} MS  "
+             f"{len(spans)} SPANS", (200, 200, 220))
+    x0, x1 = 200, w - 12
+    for i, s in enumerate(spans):
+        y = 28 + i * row_h
+        color = _span_color(s.name)
+        img.text(8, y, s.name[:24].upper(), color)
+        bx0 = x0 + int((s.ts - t0) * 1000.0 / total_ms * (x1 - x0))
+        bx1 = bx0 + max(2, int(s.dur_ms / total_ms * (x1 - x0)))
+        img.rect(bx0, y + 2, min(bx1, x1), y + row_h - 4, color,
+                 fill=True)
+    img.text(8, h - 12, f"SCALE: {total_ms:.1f} MS ACROSS", (160, 160, 180))
+    return img.png_bytes()
+
+
+# ---------------------------------------------------------------------------
+# /metrics — Prometheus text exposition (ISSUE 2): one endpoint unifying
+# every counter the codebase keeps but scatters
+# ---------------------------------------------------------------------------
+
+
+def _prom_escape(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+class _Prom:
+    """Tiny exposition builder: families declared once, samples appended
+    in declaration order (the text-format contract: all samples of a
+    family are consecutive, HELP/TYPE precede them)."""
+
+    def __init__(self):
+        self.lines: list[str] = []
+
+    def family(self, name: str, kind: str, help_: str):
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {kind}")
+
+    def sample(self, name: str, value, labels: dict | None = None):
+        if labels:
+            lbl = ",".join(f'{k}="{_prom_escape(v)}"'
+                           for k, v in labels.items())
+            name = f"{name}{{{lbl}}}"
+        if isinstance(value, float):
+            value = round(value, 6)
+        self.lines.append(f"{name} {value}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def prometheus_text(sb) -> str:
+    """Assemble the node's unified metric surface: eventtracker series,
+    roofline utilization, device/mesh batcher health (incl. the
+    queue_full/flush_deadline/worker_stall cause buckets), crawler
+    queue depths, pipeline stages, DHT transfer counts, the logging
+    drop counter (counted at utils/logging.py but surfaced nowhere
+    until now) and the tracing ring's own accounting."""
+    from ...crawler.frontier import StackType
+    from ...utils import logging as ylog
+    from ...utils.eventtracker import totals
+    from ...utils.profiler import PROFILER
+
+    p = _Prom()
+
+    p.family("yacy_log_dropped_records_total", "counter",
+             "log records dropped by the bounded async logging queue")
+    p.sample("yacy_log_dropped_records_total", ylog.dropped_count())
+
+    p.family("yacy_stage_events_total", "counter",
+             "eventtracker stage executions per (class,label)")
+    tot = totals()
+    for (ecl, label), (n_ev, _items, _ms) in sorted(
+            tot.items(), key=lambda kv: (kv[0][0].value, kv[0][1])):
+        p.sample("yacy_stage_events_total", n_ev,
+                 {"class": ecl.value, "label": label})
+    p.family("yacy_stage_duration_ms_total", "counter",
+             "cumulative wall per eventtracker stage")
+    for (ecl, label), (_n, _items, ms) in sorted(
+            tot.items(), key=lambda kv: (kv[0][0].value, kv[0][1])):
+        p.sample("yacy_stage_duration_ms_total", ms,
+                 {"class": ecl.value, "label": label})
+
+    util = PROFILER.query_util()
+    p.family("yacy_roofline_util_pct", "gauge",
+             "per-query achieved utilization vs device peak")
+    p.sample("yacy_roofline_util_pct", util["util_pct_p50"],
+             {"quantile": "p50"})
+    p.sample("yacy_roofline_util_pct", util["util_pct_p95"],
+             {"quantile": "p95"})
+    p.family("yacy_roofline_kernel_util_pct", "gauge",
+             "per-kernel achieved utilization vs device peak")
+    for pt in PROFILER.snapshot():
+        p.sample("yacy_roofline_kernel_util_pct", pt.util_pct,
+                 {"kernel": pt.kernel, "bound": pt.bound})
+
+    ds = sb.index.devstore
+    if ds is not None:
+        c = ds.counters()
+        p.family("yacy_batch_timeouts_total", "counter",
+                 "batcher watchdog timeouts by cause bucket "
+                 "(worker_stall must stay 0 in healthy serving)")
+        for cause in ("queue_full", "flush_deadline", "worker_stall"):
+            p.sample("yacy_batch_timeouts_total",
+                     c.get(f"batch_timeout_{cause}", 0), {"cause": cause})
+        p.family("yacy_device_serving_total", "counter",
+                 "device store serving counters")
+        for key in ("queries_served", "fallbacks", "stream_scans",
+                    "filtered_served", "join_served", "join_fallbacks",
+                    "batch_dispatches", "batch_exceptions",
+                    "batch_ineligible", "prune_rounds"):
+            if key in c:
+                p.sample("yacy_device_serving_total", c[key],
+                         {"counter": key})
+        p.family("yacy_device_latency_ms", "gauge",
+                 "per-query dispatch/kernel wall percentiles")
+        for key in ("dispatch_ms_p50", "dispatch_ms_p95",
+                    "kernel_ms_p50", "kernel_ms_p95", "tunnel_rt_ms"):
+            if key in c:
+                p.sample("yacy_device_latency_ms", c[key], {"stat": key})
+
+    p.family("yacy_crawler_queue_depth", "gauge",
+             "frontier stack depths")
+    for stack in (StackType.LOCAL, StackType.GLOBAL, StackType.REMOTE,
+                  StackType.NOLOAD):
+        p.sample("yacy_crawler_queue_depth", sb.noticed.size(stack),
+                 {"stack": stack})
+
+    p.family("yacy_pipeline_processed_total", "counter",
+             "documents through each indexing pipeline stage")
+    p.family("yacy_pipeline_errors_total", "counter",
+             "stage handler errors")
+    p.family("yacy_pipeline_queued", "gauge", "stage queue depth")
+    procs = [sb._parse_proc, sb._condense_proc, sb._structure_proc,
+             sb._store_proc]
+    for proc in procs:
+        p.sample("yacy_pipeline_processed_total", proc.metrics.processed,
+                 {"stage": proc.name})
+    for proc in procs:
+        p.sample("yacy_pipeline_errors_total", proc.metrics.errors,
+                 {"stage": proc.name})
+    for proc in procs:
+        p.sample("yacy_pipeline_queued", proc.queue.qsize(),
+                 {"stage": proc.name})
+
+    p.family("yacy_index_documents", "gauge", "documents in the index")
+    p.sample("yacy_index_documents", sb.index.doc_count())
+    p.family("yacy_index_rwi_postings", "gauge",
+             "postings in the reverse word index")
+    p.sample("yacy_index_rwi_postings", sb.index.rwi_size())
+    p.family("yacy_search_cached_events", "gauge",
+             "live events in the search event cache")
+    p.sample("yacy_search_cached_events", len(sb.search_cache))
+    p.family("yacy_indexed_documents_total", "counter",
+             "documents stored by this node since start")
+    p.sample("yacy_indexed_documents_total", sb.indexed_count)
+
+    node = getattr(sb, "node", None)
+    if node is not None:
+        p.family("yacy_dht_transferred_postings_total", "counter",
+                 "postings shipped to DHT target peers")
+        p.sample("yacy_dht_transferred_postings_total",
+                 node.dispatcher.transferred_postings)
+        p.family("yacy_dht_received_total", "counter",
+                 "index transfer receipts by kind")
+        p.sample("yacy_dht_received_total", node.server.received_rwi_count,
+                 {"kind": "rwi"})
+        p.sample("yacy_dht_received_total", node.server.received_url_count,
+                 {"kind": "url"})
+        p.family("yacy_peers", "gauge", "seed directory population")
+        p.sample("yacy_peers", len(node.seeddb.active), {"state": "active"})
+        p.sample("yacy_peers", len(node.seeddb.passive),
+                 {"state": "passive"})
+        p.sample("yacy_peers", len(node.seeddb.potential),
+                 {"state": "potential"})
+
+    p.family("yacy_traces_retained", "gauge",
+             "completed traces in the tracing ring")
+    p.sample("yacy_traces_retained", len(tracing.traces(tracing.MAX_TRACES)))
+    p.family("yacy_trace_drops_total", "counter",
+             "traces/spans dropped at the ring bounds")
+    p.sample("yacy_trace_drops_total", tracing.dropped_traces,
+             {"kind": "traces"})
+    p.sample("yacy_trace_drops_total", tracing.dropped_spans,
+             {"kind": "spans"})
+    return p.text()
+
+
+@servlet("metrics")
+def respond_metrics(header: dict, post: ServerObjects,
+                    sb) -> ServerObjects:
+    """GET /metrics — Prometheus text exposition format 0.0.4."""
+    prop = ServerObjects()
+    prop.raw_body = prometheus_text(sb)
+    prop.raw_ctype = "text/plain; version=0.0.4; charset=utf-8"
     return prop

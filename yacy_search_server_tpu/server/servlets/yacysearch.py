@@ -14,6 +14,7 @@ from __future__ import annotations
 import time
 from urllib.parse import quote
 
+from ...utils import tracing
 from ..objects import (ServerObjects, escape_html, escape_json, escape_xml)
 from . import servlet
 
@@ -113,8 +114,28 @@ def _esc_for(ext: str):
             }.get(ext, escape_html)
 
 
+def _remote_fanout(sb, event, count: int) -> None:
+    """Scatter to the P2P network when this switchboard belongs to a
+    node (P2PNode publishes itself as sb.node) — the reference's
+    resource=global search (yacysearch.java local/global resource
+    param). Fired once per event: paging over the cached event must not
+    re-ask the network. Delegates to P2PNode.scatter so cluster mode
+    and the secondary abstract-join round behave exactly like
+    node.search."""
+    node = getattr(sb, "node", None)
+    if node is None or event.remote_peers_asked:
+        return
+    with tracing.span("peers.fanout"):
+        node.scatter(event, count)
+
+
 @servlet("yacysearch")
 def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    with tracing.trace("servlet.yacysearch", ext=header.get("ext", "")):
+        return _respond_search(header, post, sb)
+
+
+def _respond_search(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop = ServerObjects()
     query = post.get("query", post.get("search", "")).strip()
     count = min(max(post.get_int("maximumRecords", post.get_int("count", 10)), 1), 100)
@@ -143,6 +164,8 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
                       hybrid=post.get_bool("hybrid", False),
                       contentdom=contentdom,
                       use_cache=not post.get_bool("nocache", False))
+    if post.get("resource", "") == "global":
+        _remote_fanout(sb, event, count)
     if image_mode:
         # image serving mode: ranked pages expand into per-image entries
         # (reference SearchEvent.java:2178-2280 + the yacysearchitem
@@ -201,6 +224,9 @@ def respond(header: dict, post: ServerObjects, sb) -> ServerObjects:
     # one-by-one from /yacysearchitem.html?eventID=...&item=N while
     # remote feeders are still filling the event
     prop.put("eventID", esc(event.query.query_id()))
+    # the request's trace id: paste into Performance_Trace_p?trace=...
+    # to see this exact search's waterfall
+    prop.put("traceID", esc(tracing.current_trace_id() or ""))
     return prop
 
 
@@ -212,6 +238,11 @@ def respond_item(header: dict, post: ServerObjects, sb) -> ServerObjects:
     run, SearchEvent.java:534-543). `item` indexes into the event's
     ranked results; remote results that arrived since the page rendered
     become visible here without re-running the query."""
+    with tracing.trace("servlet.yacysearchitem"):
+        return _respond_item(header, post, sb)
+
+
+def _respond_item(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop = ServerObjects()
     eid = post.get("eventID", "")
     item = max(post.get_int("item", 0), 0)
@@ -242,6 +273,11 @@ def respond_gsa(header: dict, post: ServerObjects, sb) -> ServerObjects:
     """GSA-compatible parameter mapping: q, num, start → the same search
     (reference: GSAsearchServlet.java maps the GSA request onto an
     internal search and emits <GSP> XML)."""
+    with tracing.trace("servlet.gsasearch"):
+        return _respond_gsa(header, post, sb)
+
+
+def _respond_gsa(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop = ServerObjects()
     query = post.get("q", "").strip()
     count = min(max(post.get_int("num", 10), 1), 100)

@@ -41,6 +41,7 @@ from .document.parser import ParserError, parse_source
 from .index.segment import Segment
 from .search.searchevent import SearchEvent, SearchEventCache
 from .search.query import QueryParams
+from .utils import tracing
 from .utils.config import Config
 from .utils.eventtracker import EClass, StageTimer
 from .utils.workflow import BusyThread, ThreadRegistry, WorkflowProcessor
@@ -55,6 +56,10 @@ class IndexingEntry:
     profile: CrawlProfile
     documents: list[Document] = field(default_factory=list)
     condensers: list[Condenser] = field(default_factory=list)
+    # per-document pipeline trace handle (utils/tracing.begin): stages
+    # run on decoupled worker threads, so the context travels on the
+    # work item, not the contextvar
+    trace: object = None
 
 
 class Switchboard:
@@ -63,6 +68,15 @@ class Switchboard:
                  transport=None, pipeline_workers: int = 2):
         self.config = config or Config()
         self.data_dir = data_dir
+        # tracing is on by default (the <2% overhead contract is pinned
+        # by bench.py --trace-overhead). The flag is process-global
+        # (co-hosted loopback nodes share one spine), so only an
+        # EXPLICIT config setting touches it — a default-config
+        # switchboard must not clobber another node's choice or an
+        # operator's runtime set_enabled()
+        if "tracing.enabled" in set(self.config.keys()):
+            tracing.set_enabled(
+                self.config.get_bool("tracing.enabled", True))
         sub = (lambda s: os.path.join(data_dir, s)) if data_dir else (
             lambda s: None)
         if data_dir:
@@ -196,6 +210,7 @@ class Switchboard:
         self.threads = ThreadRegistry()
 
         self.indexed_count = 0
+        self._pipeline_seq = 0   # pipeline trace sampling counter
         self.started = time.time()
         self._closed = False
         # set by signal handlers or the Steering servlet; the launcher's
@@ -336,16 +351,37 @@ class Switchboard:
     # -- indexing pipeline ---------------------------------------------------
 
     def to_indexer(self, response: Response, profile: CrawlProfile) -> None:
-        """Pipeline entry (Switchboard.toIndexer)."""
+        """Pipeline entry (Switchboard.toIndexer). Admitted entries get
+        a trace: the 4 stages run on decoupled worker threads, so the
+        handle rides the entry and every stage's StageTimer span lands
+        under it (utils/tracing.PipelineTrace). SAMPLED (1 in
+        tracing.pipelineSampleEvery, first document always) — an active
+        crawl tracing every document would flood the bounded trace
+        ring and evict the search traces within seconds."""
         reason = response.indexable()
         if reason is not None:
             self.crawl_queues.error_cache.push(
                 response.request.urlhash(), response.url, reason)
             return
-        self._parse_proc.enqueue(IndexingEntry(response, profile))
+        entry = IndexingEntry(response, profile)
+        every = self.config.get_int("tracing.pipelineSampleEvery", 16)
+        seq = self._pipeline_seq
+        self._pipeline_seq = seq + 1
+        if every > 0 and seq % every == 0:
+            entry.trace = tracing.begin("pipeline.index", url=response.url)
+        self._parse_proc.enqueue(entry)
+
+    @staticmethod
+    def _trace_ctx(entry: IndexingEntry):
+        return entry.trace.ctx if entry.trace is not None else None
+
+    def _end_trace(self, entry: IndexingEntry, **attrs) -> None:
+        if entry.trace is not None:
+            entry.trace.end(**attrs)
 
     def _stage_parse(self, entry: IndexingEntry):
-        with StageTimer(EClass.INDEX, "parseDocument", 1):
+        with tracing.attached(self._trace_ctx(entry)), \
+                StageTimer(EClass.INDEX, "parseDocument", 1):
             resp = entry.response
             try:
                 entry.documents = parse_source(
@@ -354,6 +390,7 @@ class Switchboard:
             except ParserError as e:
                 self.crawl_queues.error_cache.push(
                     resp.request.urlhash(), resp.url, f"parser: {e}")
+                self._end_trace(entry, outcome="parser_error")
                 return None
             # discovered hyperlinks -> stacker (depth+1), the crawl loop
             if entry.profile.depth > resp.request.depth:
@@ -364,7 +401,8 @@ class Switchboard:
             return entry
 
     def _stage_condense(self, entry: IndexingEntry):
-        with StageTimer(EClass.INDEX, "condenseDocument", 1):
+        with tracing.attached(self._trace_ctx(entry)), \
+                StageTimer(EClass.INDEX, "condenseDocument", 1):
             entry.documents = [d for d in entry.documents
                                if not getattr(d, "noindex", False)
                                and entry.profile.index_allowed(d.url)]
@@ -375,14 +413,16 @@ class Switchboard:
             return entry
 
     def _stage_structure(self, entry: IndexingEntry):
-        with StageTimer(EClass.INDEX, "webStructureAnalysis", 1):
+        with tracing.attached(self._trace_ctx(entry)), \
+                StageTimer(EClass.INDEX, "webStructureAnalysis", 1):
             for doc in entry.documents:
                 self.web_structure.add_document(doc.url, [
                     a.url for a in doc.anchors])
             return entry
 
     def _stage_store(self, entry: IndexingEntry):
-        with StageTimer(EClass.INDEX, "storeDocumentIndex", 1):
+        with tracing.attached(self._trace_ctx(entry)), \
+                StageTimer(EClass.INDEX, "storeDocumentIndex", 1):
             req = entry.response.request
             # snapshot the loaded rendition when the profile asks for it
             # (Transactions.store on the indexing path)
@@ -406,6 +446,7 @@ class Switchboard:
                 for s_, p_, o_ in getattr(doc, "rdf_triples", []):
                     self.triplestore.add(s_, p_, o_)
                 self.indexed_count += 1
+            self._end_trace(entry, documents=len(entry.documents))
             return None
 
     def flush_pipeline(self, timeout_s: float = 30.0) -> None:
@@ -423,6 +464,18 @@ class Switchboard:
                offset: int = 0, hybrid: bool = False,
                client: str = "", contentdom: str = "",
                use_cache: bool = True) -> SearchEvent:
+        # root trace for direct callers (node.search, benchmarks, the
+        # federation connectors); under a servlet's trace this degrades
+        # to a child span — one request stays one trace
+        with tracing.trace("switchboard.search", q=query_string[:64],
+                           count=count, offset=offset):
+            return self._search_traced(query_string, count, offset,
+                                       hybrid, client, contentdom,
+                                       use_cache)
+
+    def _search_traced(self, query_string: str, count: int,
+                       offset: int, hybrid: bool, client: str,
+                       contentdom: str, use_cache: bool) -> SearchEvent:
         q = QueryParams.parse(query_string)
         q.item_count = count
         q.offset = offset

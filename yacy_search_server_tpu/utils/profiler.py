@@ -29,6 +29,7 @@ from collections import deque
 
 from ..ops import roofline
 from ..ops.roofline import Cost, DevicePeak, RooflinePoint, roofline_point
+from . import tracing
 
 
 class RooflineProfiler:
@@ -64,6 +65,15 @@ class RooflineProfiler:
         samples (each query in the batch experienced this dispatch)."""
         if not self.enabled:
             return
+        # tracing bridge: a kernel wall measured under an active trace
+        # becomes a child span — nothing is re-timed (solo dispatches run
+        # on the query's own thread; batched dispatches have no trace
+        # context here and emit theirs from the submitter instead).
+        # Guarded here so the untraced hot path pays one contextvar
+        # read, not a name allocation (record() is pinned < 10 µs)
+        if tracing.current() is not None:
+            tracing.emit(f"kernel.{kernel}", wall_s * 1000.0,
+                         queries=queries)
         # insertion order is stable per call site, so the unsorted item
         # tuple memoizes just as well (worst case: one extra entry per
         # distinct kwarg order)
@@ -102,11 +112,8 @@ class RooflineProfiler:
 
     # -- reading -------------------------------------------------------------
 
-    @staticmethod
-    def _pctl(sv: list, q: float) -> float:
-        if not sv:
-            return 0.0
-        return sv[min(len(sv) - 1, int(len(sv) * q))]
+    # one nearest-rank convention across the observability layer
+    _pctl = staticmethod(tracing._pctl)
 
     def query_util(self) -> dict:
         """Per-query utilization summary for the rank-service stats."""

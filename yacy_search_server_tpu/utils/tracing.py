@@ -1,0 +1,453 @@
+"""Distributed query tracing — the span spine across every serving layer.
+
+Where `utils/eventtracker.py` records FLAT (label, count, duration)
+tuples with no causality, this module carries a trace id through the
+whole request path — servlet → SearchEvent → device/mesh batcher +
+kernel → P2P fan-out → remote peer — so a slow query's wall can be
+attributed to the stage that actually spent it ("Repeatability Corner
+Cases in Document Ranking": tail behavior hides in stage interactions,
+not stage averages; PAPERS.md).
+
+Design rules (the EventTracker discipline, applied to spans):
+
+- **Zero-alloc when disabled / untraced.** `span()` returns ONE shared
+  no-op object unless tracing is enabled AND a trace is active on the
+  calling context. A hot path outside any trace pays a contextvar read.
+- **Context-carried.** The active (trace_id, span_id) rides a
+  contextvar, so nested spans parent correctly across the synchronous
+  call tree; explicit `attach()` / `span_in()` / `emit()` carry the
+  context across thread handoffs (batcher items, pipeline stages,
+  remote fan-out threads).
+- **Bounded per-node ring.** Completed spans accumulate per trace in an
+  insertion-ordered dict capped at `MAX_TRACES` traces of `MAX_SPANS`
+  spans each; overflow increments drop counters instead of growing.
+  Late spans (straggler peers merging after the root closed) still land
+  in the ring — the same late-merge discipline as the result heap.
+- **Wire-propagated.** `peers/protocol.py` stamps the active trace id
+  into every RPC payload (`_trace`); `HttpTransport` moves it into the
+  ``X-YaCy-Trace`` header, `server/httpd.py` parses it back, and
+  `peers/server.py` opens the remote segment under the ORIGINATOR's
+  trace id — so a scatter-gather search is one trace network-wide.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import secrets
+import threading
+import time
+from collections import OrderedDict
+from contextvars import ContextVar
+from dataclasses import dataclass, field
+
+# wire header carrying the trace id between peers (parsed in
+# server/httpd.py for HTTP, peers/javawire.py part "xtrace" for the
+# Java wire, payload key "_trace" for the in-band transports)
+TRACE_HEADER = "X-YaCy-Trace"
+PAYLOAD_KEY = "_trace"
+
+MAX_TRACES = 256          # completed-trace ring size per node/process
+MAX_SPANS = 1024          # spans retained per trace
+
+_enabled = True
+_lock = threading.Lock()
+_ctx: ContextVar = ContextVar("yacy_trace_ctx", default=None)
+_span_seq = itertools.count(1)
+
+# traces dropped from the ring / spans dropped at the per-trace cap
+dropped_traces = 0
+dropped_spans = 0
+
+
+@dataclass
+class Span:
+    """One completed span. `ts` is wall-clock start (epoch seconds),
+    `dur_ms` the measured wall; `parent` is "" for trace-root and
+    remote-segment roots."""
+
+    sid: str
+    parent: str
+    name: str
+    ts: float
+    dur_ms: float
+    attrs: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"sid": self.sid, "parent": self.parent, "name": self.name,
+                "ts": round(self.ts, 6), "dur_ms": round(self.dur_ms, 3),
+                **({"attrs": self.attrs} if self.attrs else {})}
+
+
+@dataclass
+class TraceRecord:
+    trace_id: str
+    root_name: str
+    created: float
+    spans: list = field(default_factory=list)
+    done: bool = False
+    dropped: int = 0
+
+    def duration_ms(self) -> float:
+        """Wall covered by the trace: root span duration when recorded,
+        else the spread of whatever spans exist (remote segments)."""
+        for s in self.spans:
+            if s.parent == "" and s.name == self.root_name:
+                return s.dur_ms
+        if not self.spans:
+            return 0.0
+        t0 = min(s.ts for s in self.spans)
+        t1 = max(s.ts + s.dur_ms / 1000.0 for s in self.spans)
+        return (t1 - t0) * 1000.0
+
+    def to_json(self) -> dict:
+        return {"trace_id": self.trace_id, "root": self.root_name,
+                "created": round(self.created, 6),
+                "duration_ms": round(self.duration_ms(), 3),
+                "dropped_spans": self.dropped,
+                "spans": [s.to_json() for s in self.spans]}
+
+
+_ring: "OrderedDict[str, TraceRecord]" = OrderedDict()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(8)
+
+
+def valid_trace_id(tid) -> bool:
+    """Inbound (wire) ids are untrusted: bound length + charset so a
+    hostile peer cannot flood the ring with junk keys."""
+    return (isinstance(tid, str) and 4 <= len(tid) <= 64
+            and all(c.isalnum() or c in "-_" for c in tid))
+
+
+def _new_sid() -> str:
+    return f"s{next(_span_seq)}"
+
+
+def _register(trace_id: str, root_name: str) -> TraceRecord:
+    global dropped_traces
+    with _lock:
+        rec = _ring.get(trace_id)
+        if rec is None:
+            rec = TraceRecord(trace_id, root_name, time.time())
+            _ring[trace_id] = rec
+            while len(_ring) > MAX_TRACES:
+                _ring.popitem(last=False)
+                dropped_traces += 1
+        return rec
+
+
+def _record(trace_id: str, span: Span) -> None:
+    global dropped_spans
+    with _lock:
+        rec = _ring.get(trace_id)
+        if rec is None:
+            # late span for an evicted trace: count it, don't resurrect
+            dropped_spans += 1
+            return
+        if len(rec.spans) >= MAX_SPANS:
+            rec.dropped += 1
+            dropped_spans += 1
+            return
+        rec.spans.append(span)
+
+
+# -- context -----------------------------------------------------------------
+
+def current() -> tuple[str, str] | None:
+    """The active (trace_id, span_id), or None."""
+    return _ctx.get()
+
+
+def current_trace_id() -> str | None:
+    ctx = _ctx.get()
+    return ctx[0] if ctx else None
+
+
+def attach(ctx: tuple[str, str] | None):
+    """Set the active context (cross-thread handoff); returns the token
+    for `detach`."""
+    return _ctx.set(ctx)
+
+
+def detach(token) -> None:
+    _ctx.reset(token)
+
+
+# -- span context managers ---------------------------------------------------
+
+class _NoopSpan:
+    """Shared do-nothing span: the zero-alloc path when tracing is off
+    or no trace is active."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_tid", "_sid", "_parent", "_name", "_attrs",
+                 "_t0", "_ts", "_token", "_root", "_end_trace")
+
+    def __init__(self, tid: str, parent: str, name: str, attrs: dict,
+                 root: bool = False, end_trace: bool = False):
+        self._tid = tid
+        self._sid = _new_sid()
+        self._parent = parent
+        self._name = name
+        self._attrs = attrs
+        self._root = root
+        self._end_trace = end_trace
+
+    def __enter__(self):
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        self._token = _ctx.set((self._tid, self._sid))
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        _ctx.reset(self._token)
+        if etype is not None:
+            self._attrs["error"] = etype.__name__
+        _record(self._tid, Span(
+            self._sid, self._parent, self._name, self._ts,
+            (time.perf_counter() - self._t0) * 1000.0, self._attrs))
+        if self._end_trace:
+            with _lock:
+                rec = _ring.get(self._tid)
+                if rec is not None:
+                    rec.done = True
+        return False
+
+    def set(self, **attrs) -> None:
+        self._attrs.update(attrs)
+
+    @property
+    def ctx(self) -> tuple[str, str]:
+        return (self._tid, self._sid)
+
+
+def trace(name: str, trace_id: str | None = None, **attrs):
+    """Root span: starts a new trace (and registers it in the ring).
+    If a trace is already active on this context, degrades to a child
+    span — one request is one trace, however the layers nest."""
+    if not _enabled:
+        return _NOOP
+    cur = _ctx.get()
+    if cur is not None:
+        return _LiveSpan(cur[0], cur[1], name, attrs)
+    tid = trace_id or new_trace_id()
+    _register(tid, name)
+    return _LiveSpan(tid, "", name, attrs, root=True, end_trace=True)
+
+
+def span(name: str, **attrs):
+    """Child span under the active trace; no-op (shared object, zero
+    alloc) when tracing is off or no trace is active."""
+    if not _enabled:
+        return _NOOP
+    cur = _ctx.get()
+    if cur is None:
+        return _NOOP
+    return _LiveSpan(cur[0], cur[1], name, attrs)
+
+
+def span_in(ctx: tuple[str, str] | None, name: str, **attrs):
+    """Child span under an EXPLICIT context (cross-thread handoff:
+    pipeline entries, batcher items, remote fan-out threads). The
+    context is attached for the span's duration so nested spans and the
+    profiler bridge parent correctly."""
+    if not _enabled or ctx is None:
+        return _NOOP
+    return _LiveSpan(ctx[0], ctx[1], name, attrs)
+
+
+def attached(ctx: tuple[str, str] | None):
+    """Attach a context for a block WITHOUT recording a span of its own
+    — for code that already times itself through a bridged surface
+    (StageTimer): the bridge's span lands under `ctx`, and nothing is
+    double-recorded."""
+    return _Attached(ctx)
+
+
+class _Attached:
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._token = _ctx.set(self._ctx)
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.reset(self._token)
+        return False
+
+
+def remote_trace(trace_id: str, name: str, **attrs):
+    """Server side of wire propagation: open THIS node's segment of a
+    trace that originated elsewhere. Registers the originator's trace id
+    in the local ring (so the segment is inspectable here too) and roots
+    a span under it."""
+    if not _enabled or not valid_trace_id(trace_id):
+        return _NOOP
+    _register(trace_id, name)
+    return _LiveSpan(trace_id, "", name, attrs)
+
+
+def emit(name: str, dur_ms: float, ctx: tuple[str, str] | None = None,
+         ts: float | None = None, **attrs) -> None:
+    """Record an already-measured wall as a completed span — the bridge
+    for timings taken elsewhere (the roofline profiler's kernel walls,
+    the batcher's per-dispatch walls). Uses the active context unless an
+    explicit one is given; silently a no-op outside any trace."""
+    if not _enabled:
+        return
+    c = ctx if ctx is not None else _ctx.get()
+    if c is None:
+        return
+    if ts is None:
+        ts = time.time() - dur_ms / 1000.0
+    _record(c[0], Span(_new_sid(), c[1], name, ts, dur_ms, attrs))
+
+
+# -- pipeline (begin/end across async stages) --------------------------------
+
+class PipelineTrace:
+    """Explicit begin/end trace handle for work that flows through
+    queue-decoupled stages (the 4-stage indexing pipeline): the handle
+    travels on the work item, each stage opens `span_in(handle.ctx,...)`,
+    and the last stage (or a drop) calls `end()`."""
+
+    __slots__ = ("tid", "sid", "name", "attrs", "_ts", "_t0", "_done")
+
+    def __init__(self, tid: str, name: str, attrs: dict):
+        self.tid = tid
+        self.sid = _new_sid()
+        self.name = name
+        self.attrs = attrs
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        self._done = False
+
+    @property
+    def ctx(self) -> tuple[str, str]:
+        return (self.tid, self.sid)
+
+    def end(self, **attrs) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.attrs.update(attrs)
+        _record(self.tid, Span(
+            self.sid, "", self.name, self._ts,
+            (time.perf_counter() - self._t0) * 1000.0, self.attrs))
+        with _lock:
+            rec = _ring.get(self.tid)
+            if rec is not None:
+                rec.done = True
+
+
+def begin(name: str, **attrs) -> PipelineTrace | None:
+    """Start a detached trace (see PipelineTrace); None when disabled —
+    callers pass the handle around and every span_in(None, ...) is
+    free."""
+    if not _enabled:
+        return None
+    t = PipelineTrace(new_trace_id(), name, attrs)
+    _register(t.tid, name)
+    return t
+
+
+# -- reading -----------------------------------------------------------------
+
+def traces(n: int = 50) -> list[TraceRecord]:
+    """Most recent `n` traces, newest first."""
+    with _lock:
+        recs = list(_ring.values())
+    return recs[::-1][:max(0, n)]
+
+
+def get_trace(trace_id: str) -> TraceRecord | None:
+    with _lock:
+        return _ring.get(trace_id)
+
+
+def clear() -> None:
+    global dropped_traces, dropped_spans
+    with _lock:
+        _ring.clear()
+        dropped_traces = 0
+        dropped_spans = 0
+
+
+def export_jsonl(n: int = 50) -> str:
+    """Recent traces as JSONL, one trace per line (the export surface
+    Performance_Trace_p serves with format=jsonl)."""
+    return "\n".join(json.dumps(t.to_json()) for t in traces(n))
+
+
+def _pctl(sv: list, q: float) -> float:
+    if not sv:
+        return 0.0
+    return sv[min(len(sv) - 1, int(len(sv) * q))]
+
+
+# request wrappers that cover (nearly) the whole request wall without
+# being a stage themselves: excluded from tail dominance even when they
+# appear as child spans (switchboard.search nests under servlet roots)
+WRAPPER_SPANS = frozenset({"switchboard.search"})
+
+
+def stage_summary(recs: list[TraceRecord] | None = None,
+                  exclude_roots: tuple = ("pipeline.index",)) -> dict:
+    """Per-stage p50/p95 over the retained traces plus the
+    tail-dominant stage — the stage whose p95 wall is largest, i.e.
+    where the slow quantile of requests actually goes. BASELINE.md:
+    latency claims must name this stage.
+
+    `exclude_roots` drops whole trace CLASSES from the aggregation —
+    by default the per-document indexing traces, whose index.* stages
+    would otherwise skew a search-latency verdict (different
+    workload). Pass `exclude_roots=()` for the all-workload view."""
+    if recs is None:
+        recs = traces(MAX_TRACES)
+    recs = [r for r in recs if r.root_name not in exclude_roots]
+    by_name: dict[str, list] = {}
+    for rec in recs:
+        for s in rec.spans:
+            by_name.setdefault(s.name, []).append(s.dur_ms)
+    out = {}
+    for name, walls in by_name.items():
+        walls.sort()
+        out[name] = {"count": len(walls),
+                     "p50_ms": round(_pctl(walls, 0.50), 3),
+                     "p95_ms": round(_pctl(walls, 0.95), 3)}
+    # root spans and request wrappers cover their children; exclude
+    # them from dominance so the verdict names an actual STAGE
+    roots = {rec.root_name for rec in recs} | set(WRAPPER_SPANS)
+    inner = {k: v for k, v in out.items() if k not in roots}
+    tail = max(inner, key=lambda k: inner[k]["p95_ms"]) if inner else ""
+    return {"stages": out, "tail_dominant_stage": tail}
