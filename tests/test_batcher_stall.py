@@ -54,6 +54,7 @@ def test_wedged_dispatcher_still_completes_fast():
     ds = _built_store()
     try:
         ds.enable_batching(max_batch=4, dispatchers=1, prewarm=False)
+        ds._topk_cache.enabled = False   # a cache hit would skip the wedge
         # compile the batch + solo shapes first (not what this test times)
         assert ds.rank_term(TH, RankingProfile(), k=10) is not None
         b = ds._batcher
@@ -90,6 +91,9 @@ def test_mesh_batcher_attributes_wedged_dispatch():
     b.max_batch = 4
     b._q = _q.Queue()
     b._stop = False
+    b._ctr_lock = threading.Lock()
+    b.pipeline = True
+    b._inflight = _q.Queue(maxsize=2)
     b.dispatches = b.timeouts = b.exceptions = 0
     b.timeout_queue_full = b.timeout_flush_deadline = 0
     b.timeout_worker_stall = 0
@@ -118,6 +122,7 @@ def test_dispatch_exception_answers_solo_and_counts():
     ds = _built_store()
     try:
         ds.enable_batching(max_batch=4, dispatchers=1, prewarm=False)
+        ds._topk_cache.enabled = False   # a cache hit would skip the boom
         assert ds.rank_term(TH, RankingProfile(), k=10) is not None
         b = ds._batcher
 
@@ -172,6 +177,9 @@ def test_64_thread_protocol_latency_ceiling():
     ds = _built_store(n=40_000)
     try:
         ds.enable_batching(max_batch=16, prewarm=False)
+        # the result cache would serve every repeat with zero dispatches
+        # — this test exists to hammer the DISPATCH path, so turn it off
+        ds._topk_cache.enabled = False
         # warmup compiles the batch shape (the driver protocol warms too)
         assert ds.rank_term(TH, RankingProfile(), k=10) is not None
         served0 = ds.queries_served

@@ -133,6 +133,58 @@ def _servlet_functions(path: pathlib.Path):
                 break
 
 
+# -- pipelined dispatch hygiene (ISSUE 3) ------------------------------------
+# (a) Every completer / in-flight queue in the batchers must be BOUNDED:
+# an unbounded queue of issued-but-unfetched device buffers is unbounded
+# in-flight device memory — the backpressure of a maxsize is the cap.
+# (b) Every packed-I/O kernel variant must carry a roofline cost model
+# REGISTERED BY NAME (an EXEMPT entry is not acceptable for a serving
+# kernel): keeps PR 1's every-kernel-accounted invariant.
+
+_INFLIGHT_QUEUE = re.compile(
+    r"self\.(_inflight|_completions|_ready)\b[^=\n]*=\s*"
+    r"_?queue\.Queue\(([^)]*)\)")
+
+
+def test_completer_and_inflight_queues_are_bounded():
+    offenders = []
+    seen_inflight = 0
+    for rel in ("index/devstore.py", "index/meshstore.py"):
+        src = (PKG / rel).read_text(encoding="utf-8")
+        for m in _INFLIGHT_QUEUE.finditer(src):
+            if m.group(1) == "_inflight":
+                seen_inflight += 1
+            if "maxsize" not in m.group(2):
+                offenders.append(f"{rel}::{m.group(1)}")
+    # the scanner must actually see both batchers' in-flight queues —
+    # a rename that dodges the regex fails here instead of passing
+    assert seen_inflight >= 2, \
+        "in-flight completion queues not found (renamed? widen scanner)"
+    assert not offenders, (
+        "completer/in-flight queues without a maxsize bound (unbounded "
+        "in-flight device memory):\n  " + "\n  ".join(offenders))
+
+
+PACKED_KERNELS = (
+    "score_topk16_packed",
+    "_rank_spans_packed_kernel",
+    "_rank_pruned_batch1_packed_kernel",
+    "_rank_scan_batch_packed_kernel",
+    "_rank_join_batch_packed_kernel",
+    "_rank_join_bm_batch_packed_kernel",
+)
+
+
+def test_packed_kernel_variants_have_registered_cost_models():
+    from yacy_search_server_tpu.ops import roofline
+
+    missing = [k for k in PACKED_KERNELS if k not in roofline.KERNELS]
+    assert not missing, (
+        "packed-output kernel variants without a roofline cost model "
+        "(register in ops/roofline.KERNELS; EXEMPT is not acceptable "
+        "for serving kernels):\n  " + "\n  ".join(missing))
+
+
 def test_wall_measuring_servlets_open_spans():
     offenders = []
     for p in sorted((PKG / "server" / "servlets").glob("*.py")):

@@ -333,6 +333,9 @@ def test_mesh_batched_queries_match_solo_and_actually_batch():
     rwi.ingest_run(terms)
     ms = MeshSegmentStore(rwi, devices=_devices(), n_term=2)
     try:
+        # the result cache would serve every repeat with zero dispatches
+        # — this test exists to exercise the BATCH dispatch path
+        ms._topk_cache.enabled = False
         prof = RankingProfile()
         solo = {th: ms.rank_term(th, prof, k=10) for th in terms}
         ms.enable_batching(max_batch=8)

@@ -1,0 +1,253 @@
+"""Versioned top-k result cache (ISSUE 3 tentpole part 3).
+
+The k-result answer itself is the cached object (the succinct-top-k
+stance): an LRU keyed on (termhash, profile, language, k) whose entries
+carry the ARENA EPOCH they were computed against. These tests pin the
+two contracts the acceptance criteria state:
+
+- a repeat of an identical query answers from cache with ZERO device
+  work (no batcher dispatch, no round trip) and BIT-IDENTICAL results;
+- a flush/merge/repack (or delete) between two identical queries
+  produces a `rank_cache_stale` — never a stale hit — and the recomputed
+  answer matches the cold path on the new snapshot.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.devstore import DeviceSegmentStore
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.index.rwi import RWIIndex
+from yacy_search_server_tpu.ops.ranking import CardinalRanker, RankingProfile
+
+TH = b"cachetermAAA"
+
+
+def _plist(rng, n, base=0):
+    docids = np.arange(base, base + n, dtype=np.int32)
+    feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    return PostingsList(docids, feats)
+
+
+def _built_store(n=20_000, batching=True):
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(np.random.default_rng(1), n))
+    idx.flush()
+    ds = DeviceSegmentStore(idx)
+    if batching:
+        ds.enable_batching(max_batch=4, dispatchers=1, prewarm=False)
+    return ds
+
+
+def _oracle(idx, k=10):
+    return CardinalRanker(RankingProfile(), "en").rank(idx.get(TH), None,
+                                                       k=k)
+
+
+def test_repeat_hits_with_zero_device_work_and_bit_identical():
+    ds = _built_store()
+    try:
+        cold = ds.rank_term(TH, RankingProfile(), k=10)
+        assert cold is not None
+        c0 = ds.counters()
+        hit = ds.rank_term(TH, RankingProfile(), k=10)
+        c1 = ds.counters()
+        assert c1["rank_cache_hits"] == c0["rank_cache_hits"] + 1
+        # zero device work: no new batcher dispatch, no new round trip
+        assert c1["batch_dispatches"] == c0["batch_dispatches"]
+        assert c1["device_round_trips"] == c0["device_round_trips"]
+        # bit-identical, and both equal the host oracle
+        np.testing.assert_array_equal(np.asarray(cold[0]),
+                                      np.asarray(hit[0]))
+        np.testing.assert_array_equal(np.asarray(cold[1]),
+                                      np.asarray(hit[1]))
+        assert cold[2] == hit[2]
+        ws, wd = _oracle(ds.rwi)
+        np.testing.assert_array_equal(np.asarray(hit[0]), ws)
+        # the hit still counts as a served query
+        assert c1["queries_served"] == c0["queries_served"] + 1
+    finally:
+        ds.close()
+
+
+def test_k_buckets_share_entries_and_profiles_do_not():
+    ds = _built_store()
+    try:
+        out10 = ds.rank_term(TH, RankingProfile(), k=10)
+        c0 = ds.counters()
+        out13 = ds.rank_term(TH, RankingProfile(), k=13)  # same kk=16
+        c1 = ds.counters()
+        assert c1["rank_cache_hits"] == c0["rank_cache_hits"] + 1
+        np.testing.assert_array_equal(np.asarray(out10[0]),
+                                      np.asarray(out13[0][:10]))
+        # a different profile is a different key: miss, not a wrong hit
+        prof2 = RankingProfile(tf=10)
+        out2 = ds.rank_term(TH, prof2, k=10)
+        c2 = ds.counters()
+        assert c2["rank_cache_hits"] == c1["rank_cache_hits"]
+        assert out2 is not None
+    finally:
+        ds.close()
+
+
+def test_flush_between_identical_queries_is_stale_not_stale_hit():
+    """The acceptance contract: flush between two identical queries ->
+    rank_cache_stale, recomputed answer parity-checked against a cold
+    path on the same (new) snapshot."""
+    ds = _built_store()
+    try:
+        assert ds.rank_term(TH, RankingProfile(), k=10) is not None
+        # new postings land + flush: the arena epoch moves
+        ds.rwi.add_many(TH, _plist(np.random.default_rng(2), 500,
+                                   base=100_000))
+        ds.rwi.flush()
+        c0 = ds.counters()
+        out = ds.rank_term(TH, RankingProfile(), k=10)
+        c1 = ds.counters()
+        assert c1["rank_cache_stale"] >= c0["rank_cache_stale"] + 1
+        # parity against a cold path on the SAME snapshot: clear the
+        # cache and recompute
+        ds._topk_cache.clear()
+        cold = ds.rank_term(TH, RankingProfile(), k=10)
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.asarray(cold[0]))
+        np.testing.assert_array_equal(np.asarray(out[1]),
+                                      np.asarray(cold[1]))
+        ws, _ = _oracle(ds.rwi)
+        np.testing.assert_array_equal(np.asarray(out[0]), ws)
+    finally:
+        ds.close()
+
+
+def test_unflushed_delta_declines_cache_service():
+    """A RAM delta changes answers WITHOUT an epoch bump: the cache must
+    decline (neither a hit nor a wrong answer) until the flush."""
+    ds = _built_store()
+    try:
+        assert ds.rank_term(TH, RankingProfile(), k=10) is not None
+        ds.rwi.add_many(TH, _plist(np.random.default_rng(3), 200,
+                                   base=200_000))
+        c0 = ds.counters()
+        out = ds.rank_term(TH, RankingProfile(), k=10)
+        c1 = ds.counters()
+        assert c1["rank_cache_hits"] == c0["rank_cache_hits"]
+        assert out[2] == 20_000 + 200      # delta rows included
+        ws, _ = _oracle(ds.rwi)
+        np.testing.assert_array_equal(np.asarray(out[0]), ws)
+    finally:
+        ds.close()
+
+
+def test_merge_and_repack_invalidate():
+    idx = RWIIndex()
+    rng = np.random.default_rng(4)
+    for i in range(3):
+        idx.add_many(TH, _plist(rng, 2000, base=i * 10_000))
+        idx.flush()
+    ds = DeviceSegmentStore(idx)
+    try:
+        assert ds.rank_term(TH, RankingProfile(), k=10) is not None
+        idx.merge_runs(max_runs=1)
+        c0 = ds.counters()
+        out = ds.rank_term(TH, RankingProfile(), k=10)
+        c1 = ds.counters()
+        assert c1["rank_cache_stale"] >= c0["rank_cache_stale"] + 1
+        ws, _ = _oracle(idx)
+        np.testing.assert_array_equal(np.asarray(out[0]), ws)
+        # repack: same rows, new arena — still a correct invalidation
+        e0 = ds.arena_epoch
+        ds.repack()
+        assert ds.arena_epoch > e0
+        c2 = ds.counters()
+        out2 = ds.rank_term(TH, RankingProfile(), k=10)
+        c3 = ds.counters()
+        assert c3["rank_cache_stale"] >= c2["rank_cache_stale"] + 1
+        np.testing.assert_array_equal(np.asarray(out2[0]), ws)
+    finally:
+        ds.close()
+
+
+def test_delete_invalidates_and_dead_doc_never_resurfaces():
+    ds = _built_store(batching=False)
+    try:
+        out = ds.rank_term(TH, RankingProfile(), k=10)
+        victim = int(np.asarray(out[1])[0])
+        ds.rwi.delete_doc(victim)
+        got = ds.rank_term(TH, RankingProfile(), k=10)
+        assert victim not in np.asarray(got[1]).tolist()
+        assert ds.counters()["rank_cache_stale"] >= 1
+        ws, wd = _oracle(ds.rwi)
+        np.testing.assert_array_equal(np.asarray(got[0]), ws)
+    finally:
+        ds.close()
+
+
+def test_searchevent_cache_gate_serves_small_terms_from_cache():
+    """Cache-aware eligibility: a term below the SMALL_RANK_N host gate
+    still answers from the device store's result cache on repeats once
+    an entry exists (the cost-based gates do not apply to a hit)."""
+    from yacy_search_server_tpu.index.segment import Segment
+    from yacy_search_server_tpu.search.query import QueryParams
+    from yacy_search_server_tpu.search.searchevent import SearchEvent
+    from yacy_search_server_tpu.utils.hashes import word2hash
+
+    seg = Segment(max_ram_postings=10 ** 9)
+    th = word2hash("cachegate")
+    seg.rwi.ingest_run({th: _plist(np.random.default_rng(5), 512)})
+    ds = seg.enable_device_serving()
+    try:
+        # small term: SearchEvent's gate routes it to the host path, so
+        # no cache entry forms through the event. Seed one directly at
+        # the event's k bucket (count=10 -> k_need 80 -> kk 128).
+        direct = ds.rank_term(th, RankingProfile(), k=100)
+        assert direct is not None
+        q = QueryParams.parse("cachegate")
+        c0 = ds.counters()
+        ev = SearchEvent(q, seg)
+        c1 = ds.counters()
+        assert c1["rank_cache_hits"] > c0["rank_cache_hits"]
+        assert ev.local_rwi_considered == 512
+    finally:
+        seg.close()
+
+
+def test_mesh_store_cache_parity_and_invalidation():
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("need >=2 cpu devices")
+    from yacy_search_server_tpu.index.meshstore import MeshSegmentStore
+
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(np.random.default_rng(6), 20_000))
+    idx.flush()
+    ms = MeshSegmentStore(idx, devices=devs[:2], n_term=1)
+    try:
+        cold = ms.rank_term(TH, RankingProfile(), k=10)
+        assert cold is not None
+        c0 = ms.counters()
+        hit = ms.rank_term(TH, RankingProfile(), k=10)
+        c1 = ms.counters()
+        assert c1["rank_cache_hits"] == c0["rank_cache_hits"] + 1
+        assert c1["device_round_trips"] == c0["device_round_trips"]
+        np.testing.assert_array_equal(np.asarray(cold[0]),
+                                      np.asarray(hit[0]))
+        np.testing.assert_array_equal(np.asarray(cold[1]),
+                                      np.asarray(hit[1]))
+        # flush invalidates (mesh parity with the devstore contract)
+        idx.add_many(TH, _plist(np.random.default_rng(7), 300,
+                                base=50_000))
+        idx.flush()
+        c2 = ms.counters()
+        out = ms.rank_term(TH, RankingProfile(), k=10)
+        c3 = ms.counters()
+        assert c3["rank_cache_stale"] >= c2["rank_cache_stale"] + 1
+        ws, _ = _oracle(idx)
+        np.testing.assert_array_equal(np.asarray(out[0]), ws)
+    finally:
+        ms.close()

@@ -221,6 +221,12 @@ def test_cross_peer_trace_assembly(duo):
         # watchdog isn't spent on first-use compiles
         a.sb.search("tracing", count=5, use_cache=False)
         a.sb.search_cache.clear()
+        # the warm query populated the top-k result cache: clear it so
+        # the traced request exercises the kernel span spine (a cache
+        # hit would — correctly — record no kernel span at all)
+        cache = getattr(a.sb.index.devstore, "_topk_cache", None)
+        if cache is not None:
+            cache.clear()
         tracing.clear()
 
     from yacy_search_server_tpu.server.servlets.yacysearch import respond
@@ -380,6 +386,7 @@ def test_mesh_batcher_emits_spans_under_one_trace():
         ms.enable_batching(max_batch=4)
         prof = RankingProfile()
         ms.rank_term(th, prof, k=10)        # warm: compile outside trace
+        ms._topk_cache.clear()   # a cache hit would bypass the batcher
         tracing.clear()
         with tracing.trace("mesh-query") as r:
             tid = r.ctx[0]

@@ -73,10 +73,10 @@ from ..utils.eventtracker import EClass, update as track
 from ..utils import tracing
 from . import postings as P
 from .devstore import (_PRUNE_B, DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32,
-                       NO_FLAG, NO_LANG, TILE, _bucket_delta,
-                       _bucket_rows, _constraint_valid, _pruned_span_topk,
-                       _tile_valid, pack_prune_stats, pmax_table,
-                       prune_bound_consts)
+                       NO_FLAG, NO_LANG, TILE, _TopkCache, _bucket_delta,
+                       _bucket_rows, _constraint_valid, _emit_rt_spans,
+                       _pruned_span_topk, _tile_valid, pack_prune_stats,
+                       pmax_table, prune_bound_consts)
 
 INT32_MAX = 2 ** 31 - 1
 
@@ -186,25 +186,41 @@ class _MeshQueryBatcher:
     MAX_BATCH = 8
 
     def __init__(self, store: "MeshSegmentStore",
-                 max_batch: int = MAX_BATCH):
+                 max_batch: int = MAX_BATCH, pipeline: bool = True):
         import queue as _queue
         self.store = store
         self.max_batch = max_batch
         self._q: "_queue.Queue" = _queue.Queue()
         self._stop = False
+        # counters mutate UNDER _ctr_lock (devstore parity: the bare
+        # `+=` from dispatcher + submitter threads could lose increments)
+        self._ctr_lock = threading.Lock()
         self.dispatches = 0
         self.timeouts = 0
         # timeout cause buckets (devstore._QueryBatcher parity; the r5
         # artifacts' lone unexplained `batch_timeouts: 1` motivated
         # attributing every timeout): queue_full = never claimed off the
-        # incoming queue; flush_deadline = claimed into a forming batch
-        # that missed the handoff; worker_stall = a dispatch held it in
-        # a kernel call past both watchdog windows (must stay zero in
-        # healthy serving — asserted by the batcher stall tests)
+        # incoming queue; flush_deadline = backlog (forming, in-flight
+        # queue wait, or a just-started fetch); worker_stall = wedged in
+        # the dispatcher's issue or in a fetch older than a watchdog
+        # window (must stay zero in healthy serving — asserted by the
+        # batcher stall tests)
         self.timeout_queue_full = 0
         self.timeout_flush_deadline = 0
         self.timeout_worker_stall = 0
         self.exceptions = 0
+        # pipelined dispatch (devstore parity, shrunk to one completer:
+        # the mesh runs ONE SPMD program at a time): the dispatcher
+        # ISSUES the first-bucket kernel and hands the in-flight buffer
+        # here; the completer fetches, distributes, and walks the rare
+        # escalation ladder synchronously. BOUNDED queue: backpressure
+        # caps in-flight device memory (hygiene-tested).
+        self.pipeline = bool(pipeline)
+        self._inflight: "_queue.Queue" = _queue.Queue(maxsize=2)
+        self._completer = threading.Thread(target=self._completer_loop,
+                                           name="meshstore-completer",
+                                           daemon=True)
+        self._completer.start()
         self._thread = threading.Thread(target=self._loop,
                                         name="meshstore-batcher",
                                         daemon=True)
@@ -236,6 +252,10 @@ class _MeshQueryBatcher:
             if km is not None and res[0] != "timeout":
                 tracing.emit(f"kernel.{item.get('kernel_name', '?')}",
                              km, batch=item.get("batch_n", 0))
+                for stage in ("issue", "device", "fetch"):
+                    ms = item.get(f"{stage}_ms")
+                    if ms is not None:
+                        tracing.emit(f"kernel.{stage}", ms)
             sp.set(outcome=res[0])
         return res
 
@@ -245,21 +265,40 @@ class _MeshQueryBatcher:
             return item["res"]
         if self._claim(item):
             # never claimed off the queue: backlog, not a wedge
-            self.timeouts += 1
-            self.timeout_queue_full += 1
+            with self._ctr_lock:
+                self.timeouts += 1
+                self.timeout_queue_full += 1
             return ("timeout",)
         if item["ev"].wait(timeout=self.WATCHDOG_S):
             return item["res"]
-        self.timeouts += 1
-        if item.get("stage") == "dispatch":
-            self.timeout_worker_stall += 1
-        else:
-            self.timeout_flush_deadline += 1
+        with self._ctr_lock:
+            self.timeouts += 1
+            # devstore attribution parity: stall = wedged in issue or in
+            # a fetch older than a watchdog window; in-flight queue wait
+            # and fresh fetches are backlog (flush_deadline)
+            st = item.get("stage")
+            ft = item.get("fetch_t0")
+            if st == "dispatch" or (
+                    st == "fetch" and ft is not None
+                    and time.perf_counter() - ft > self.WATCHDOG_S):
+                self.timeout_worker_stall += 1
+            else:
+                self.timeout_flush_deadline += 1
         return ("timeout",)
 
     def close(self) -> None:
+        import queue as _queue
         self._stop = True
         self._q.put(None)
+        try:
+            # bounded: a full queue behind a wedged fetch must not hang
+            # close() (the completer is a daemon either way)
+            self._inflight.put(None, timeout=5.0)
+        except _queue.Full:
+            pass
+        completer = getattr(self, "_completer", None)
+        if completer is not None:
+            completer.join(timeout=10.0)
 
     @staticmethod
     def _bucket(n: int) -> int:
@@ -290,14 +329,23 @@ class _MeshQueryBatcher:
             try:
                 self._dispatch(batch)
             except Exception:
-                self.exceptions += 1
+                with self._ctr_lock:
+                    self.exceptions += 1
                 log.exception("mesh batch dispatch failed (%d queries "
                               "retry solo)", len(batch))
                 for it in batch:
-                    it["res"] = ("ineligible",)
-                    it["ev"].set()
+                    # issued items belong to the completer (forcing them
+                    # ineligible here would double-dispatch the query)
+                    if not it.get("issued") and not it["ev"].is_set():
+                        it["res"] = ("ineligible",)
+                        it["ev"].set()
 
     def _dispatch(self, batch: list[dict]) -> None:
+        """Issue-only half of the pipelined dispatch: groups the batch,
+        ISSUES each group's first-bucket SPMD kernel (async dispatch)
+        and hands the in-flight buffers to the completer — the
+        dispatcher is back forming the next wave while this one's round
+        trip is in the air."""
         store = self.store
         with store._lock:
             arrays = store._device_arrays()
@@ -341,26 +389,84 @@ class _MeshQueryBatcher:
                 cmax[i] = sp.stats["col_max"]
                 tmin[i] = sp.stats["tf_min"]
                 tmax[i] = sp.stats["tf_max"]
-            pending = list(range(len(items)))
+            t0k = time.perf_counter()
+            out = store._pbfn(kk, _PRUNE_B[0], bs)(
+                *arrays, dead, pmax, qargs, cmin, cmax, tmin, tmax,
+                shift, lang_term, *consts)
+            rec = {"out": out, "items": items, "qargs": qargs,
+                   "stats": (cmin, cmax, tmin, tmax),
+                   "consts": consts, "shift": shift,
+                   "lang_term": lang_term, "kk": kk, "bs": bs,
+                   "arrays": arrays, "dead": dead, "pmax": pmax,
+                   "t0k": t0k,
+                   "issue_ms": (time.perf_counter() - t0k) * 1000.0}
+            for it in items:
+                it["stage"] = "inflight"   # issued, awaiting the completer
+                it["issued"] = True        # the completer owns the answer
+            if self.pipeline:
+                self._inflight.put(rec)
+            else:
+                self._complete(rec)
+
+    def _completer_loop(self) -> None:
+        while True:
+            rec = self._inflight.get()
+            if rec is None:
+                return
+            self._complete(rec)
+
+    def _complete(self, rec: dict) -> None:
+        """Blocking half: fetch the in-flight first-bucket result (ONE
+        packed transfer), distribute, and walk the rare escalation
+        ladder synchronously for any slot whose bound failed."""
+        store = self.store
+        items = rec["items"]
+        kk, bs = rec["kk"], rec["bs"]
+        qargs = rec["qargs"]
+        cmin, cmax, tmin, tmax = rec["stats"]
+        pending = list(range(len(items)))
+        out, t0k = rec["out"], rec["t0k"]
+        issued_at = t0k + rec["issue_ms"] / 1e3
+        try:
             for b in _PRUNE_B:
-                t0k = time.perf_counter()
-                out = store._pbfn(kk, b, bs)(
-                    *arrays, dead, pmax, qargs, cmin, cmax, tmin, tmax,
-                    shift, lang_term, *consts)
-                s, d, ok = jax.device_get(out)
+                if out is None:     # escalation bucket: issue inline
+                    t0k = time.perf_counter()
+                    out = store._pbfn(kk, b, bs)(
+                        *rec["arrays"], rec["dead"], rec["pmax"], qargs,
+                        cmin, cmax, tmin, tmax, rec["shift"],
+                        rec["lang_term"], *rec["consts"])
+                    issued_at = time.perf_counter()
+                tf0 = time.perf_counter()
+                for it in items:   # timeout attribution: fetch running
+                    it["fetch_t0"] = tf0
+                    it["stage"] = "fetch"
+                host = jax.device_get(out)   # ONE packed fetch
+                out = None
+                store.count_round_trip()
+                fetch_ms = (time.perf_counter() - tf0) * 1000.0
+                device_ms = (tf0 - issued_at) * 1000.0
+                s = host[:, :kk]
+                d = host[:, kk:2 * kk]
+                ok = host[:, 2 * kk] != 0
                 wall_ms = (time.perf_counter() - t0k) * 1000.0
-                self.dispatches += 1
-                store.prune_rounds += 1
+                with self._ctr_lock:
+                    self.dispatches += 1
+                with store._lock:   # completer + query threads write
+                    store.prune_rounds += 1
                 still = []
                 for i in pending:
                     if bool(ok[i]):
                         sp = items[i]["span"]
-                        store.pruned_tiles += int(
-                            np.maximum(sp.tcounts - b, 0).sum())
+                        with store._lock:
+                            store.pruned_tiles += int(
+                                np.maximum(sp.tcounts - b, 0).sum())
                         items[i]["res"] = ("ok", s[i], d[i])
                         items[i]["kernel_ms"] = wall_ms
                         items[i]["kernel_name"] = "_mesh_pruned_kernel"
                         items[i]["batch_n"] = len(items)
+                        items[i]["issue_ms"] = rec["issue_ms"]
+                        items[i]["device_ms"] = device_ms
+                        items[i]["fetch_ms"] = fetch_ms
                         items[i]["ev"].set()
                         # satisfied slot becomes a free pad slot for the
                         # escalation rounds (count/tcount 0): the next
@@ -374,6 +480,15 @@ class _MeshQueryBatcher:
             for i in pending:          # bound never held: solo full scan
                 items[i]["res"] = ("prune_fail",)
                 items[i]["ev"].set()
+        except Exception:
+            with self._ctr_lock:
+                self.exceptions += 1
+            log.exception("mesh batch completion failed (%d queries "
+                          "retry solo)", len(items))
+            for it in items:
+                if not it["ev"].is_set():
+                    it["res"] = ("ineligible",)
+                    it["ev"].set()
 
 
 class MeshSegmentStore:
@@ -409,6 +524,12 @@ class MeshSegmentStore:
         self._garbage_rows = 0
         self.queries_served = 0
         self.fallbacks = 0
+        # versioned top-k result cache + its epoch (devstore parity):
+        # bumps on every flush/merge/repack/delete so a cached answer is
+        # served only against the snapshot it was computed on
+        self.arena_epoch = 0
+        self._topk_cache = _TopkCache()
+        self.device_round_trips = 0
         # device state (rebuilt lazily from the host mirrors)
         self._dev_arrays = None       # (feats16, flags, docids) sharded
         self._dev_join = None         # (jdocids, jpos) sharded
@@ -447,7 +568,23 @@ class MeshSegmentStore:
 
     # -- packing (listener protocol) ----------------------------------------
 
+    def _bump_epoch(self) -> None:
+        with self._lock:
+            self.arena_epoch += 1
+
+    def count_round_trip(self) -> None:
+        with self._lock:
+            self.device_round_trips += 1
+
     def on_run_added(self, run) -> None:
+        # epoch bumps land AFTER their mutation (devstore parity): a
+        # racing result-cache insert is then born-stale, never live-stale
+        try:
+            self._on_run_added_inner(run)
+        finally:
+            self._bump_epoch()
+
+    def _on_run_added_inner(self, run) -> None:
         with self._lock:
             rid = id(run)
             if rid in self._packed:
@@ -512,6 +649,7 @@ class MeshSegmentStore:
             spans = self._packed.pop(id(run), None)
             if spans:
                 self._garbage_rows += sum(sp.total for sp in spans.values())
+            self._bump_epoch()
             used = sum(c.used for c in self._cells)
             if (self._garbage_rows * 2 > max(used, 1)
                     and self._garbage_rows > 4 * TILE):
@@ -524,6 +662,7 @@ class MeshSegmentStore:
                 live = set(new_run.term_hashes())
                 self._packed[id(new_run)] = {
                     th: sp for th, sp in spans.items() if th in live}
+            self._bump_epoch()
 
     def on_doc_deleted(self, docid: int) -> None:
         self.mark_dead(docid)
@@ -535,6 +674,7 @@ class MeshSegmentStore:
                 sp = spans.pop(termhash, None)
                 if sp is not None:
                     self._garbage_rows += sp.total
+            self._bump_epoch()
 
     def mark_dead(self, docid: int) -> None:
         with self._lock:
@@ -547,6 +687,7 @@ class MeshSegmentStore:
                 self._dead_host = grown
             self._dead_host[docid] = True
             self._dirty_dead = True
+            self._bump_epoch()
 
     def live_rows(self) -> int:
         with self._lock:
@@ -560,17 +701,42 @@ class MeshSegmentStore:
             self._garbage_rows = 0
             self._dirty = True
             for run in list(self.rwi._runs):
-                self.on_run_added(run)
+                self.on_run_added(run)      # bumps the epoch per run
+            self._bump_epoch()              # incl. the zero-run rebuild
 
-    def enable_batching(self, max_batch: int = 8, **_kw) -> None:
+    def enable_batching(self, max_batch: int = 8,
+                        pipeline: bool = True, **_kw) -> None:
         """Cross-query batching for the pruned path (r5): concurrent
-        eligible searches share one vmapped SPMD dispatch. Extra devstore
-        kwargs (dispatchers) are accepted and ignored — the mesh runs
-        one program, so one dispatcher thread drains the queue."""
+        eligible searches share one vmapped SPMD dispatch, now issued
+        asynchronously and fetched by a completer (devstore parity).
+        Extra devstore kwargs (dispatchers, completer_depth) are
+        accepted and ignored — the mesh runs one program, so one
+        dispatcher + one completer drain the queue."""
         if self._batcher is None:
             self._batcher = _MeshQueryBatcher(
                 self, max_batch=min(max_batch,
-                                    _MeshQueryBatcher.MAX_BATCH))
+                                    _MeshQueryBatcher.MAX_BATCH),
+                pipeline=pipeline)
+
+    def rank_cache_get(self, termhash: bytes, profile,
+                       language: str = "en", k: int = 100):
+        """Versioned top-k cache lookup (devstore parity): the full
+        final answer of a previous identical query, valid only while the
+        arena epoch is unchanged and the term carries no RAM delta."""
+        kk = max(16, 1 << (max(k, 1) - 1).bit_length())
+        key = (termhash, profile.to_external_string(), language, kk)
+        with self.rwi._lock:
+            if self.rwi._ram.get(termhash):
+                return None
+        with self._lock:
+            epoch = self.arena_epoch
+        got = self._topk_cache.get(key, epoch)
+        if got is None:
+            return None
+        s, d, considered = got
+        with self._lock:
+            self.queries_served += 1
+        return s[:k], d[:k], considered
 
     def counters(self) -> dict:
         """Serving-health counters (devstore interface parity)."""
@@ -578,6 +744,10 @@ class MeshSegmentStore:
         return {
             "queries_served": self.queries_served,
             "fallbacks": self.fallbacks,
+            "rank_cache_hits": self._topk_cache.hits,
+            "rank_cache_stale": self._topk_cache.stale,
+            "arena_epoch": self.arena_epoch,
+            "device_round_trips": self.device_round_trips,
             "prune_rounds": self.prune_rounds,
             "pruned_tiles": self.pruned_tiles,
             "batch_dispatches": b.dispatches if b else 0,
@@ -711,7 +881,7 @@ class MeshSegmentStore:
     def _pbfn(self, kk: int, b: int, bs: int):
         key = ("pruned_batch", kk, b, bs)
         if key not in self._fns:
-            self._fns[key] = jax.jit(shard_map(
+            fn = shard_map(
                 partial(_mesh_pruned_batch_shard, k=kk, b=b),
                 mesh=self.mesh,
                 in_specs=(PS(("term", "doc"), None, None),   # feats16
@@ -725,7 +895,17 @@ class MeshSegmentStore:
                           PS(), PS(), PS(), PS(), PS(), PS(), PS(), PS()),
                 out_specs=(PS(), PS(), PS()),
                 check_vma=False,
-            ))
+            )
+
+            # packed [bs, 2k+1] output (scores ++ docids ++ ok): the
+            # batch path fetches ONE replicated buffer per wave instead
+            # of three (each separately fetched array is a round trip)
+            def packed(*args, _fn=fn):
+                s, d, ok = _fn(*args)
+                return jnp.concatenate(
+                    [s, d, ok[:, None].astype(jnp.int32)], axis=1)
+
+            self._fns[key] = jax.jit(packed)
         return self._fns[key]
 
     def _fn(self, kk: int, with_delta: bool):
@@ -756,6 +936,12 @@ class MeshSegmentStore:
 
         Same contract as ``DeviceSegmentStore.rank_term``: returns
         (scores, docids, considered) or None for host fallback."""
+        cacheable = (lang_filter == NO_LANG and flag_bit == NO_FLAG
+                     and from_days is None and to_days is None)
+        if cacheable:
+            got = self.rank_cache_get(termhash, profile, language, k)
+            if got is not None:
+                return got
         with self._lock:
             spans = self.spans_for(termhash)
             if spans is None or len(spans) > self.MAX_SPANS:
@@ -764,6 +950,7 @@ class MeshSegmentStore:
             arrays = self._device_arrays()
             dead = self._dead_array()
             pmax = self._dev_pmax     # same snapshot as the arrays
+            epoch0 = self.arena_epoch
         with self.rwi._lock:
             delta = self.rwi._ram_postings(termhash)
         if not spans and delta is None:
@@ -772,6 +959,15 @@ class MeshSegmentStore:
         considered = sum(sp.total for sp in spans) + (
             len(delta) if with_delta else 0)
         kk0 = max(16, 1 << (max(k, 1) - 1).bit_length())
+
+        def cache_put(s, d):
+            """Insert the FINAL (post keep/dedup) answer under the
+            snapshot's epoch (a concurrent flush leaves it born-stale)."""
+            if cacheable and not with_delta:
+                self._topk_cache.put(
+                    (termhash, profile.to_external_string(), language,
+                     kk0), epoch0, np.asarray(s), np.asarray(d),
+                    considered)
 
         # per-cell block-max PRUNED path: one merged span, no delta, no
         # constraint filters, no tombstones newer than the pack. Each
@@ -796,8 +992,11 @@ class MeshSegmentStore:
                 if res[0] == "ok":
                     s, d = res[1], res[2]
                     keep = (d >= 0) & (s > NEG_INF32)
-                    self.queries_served += 1
-                    return s[keep][:k], d[keep][:k], considered
+                    s, d = s[keep], d[keep]
+                    with self._lock:   # exact under concurrency
+                        self.queries_served += 1
+                    cache_put(s, d)
+                    return s[:k], d[:k], considered
                 # prune_fail: the batch already walked the full bucket
                 # ladder — go straight to the exact full scan below;
                 # ineligible/timeout continue into the solo ladder
@@ -812,19 +1011,29 @@ class MeshSegmentStore:
                               sp.tstarts, sp.tcounts], axis=1
                              ).astype(np.int32)
             for b in () if batch_prune_failed else _PRUNE_B:
+                t0s = time.perf_counter()
                 out = self._pfn(kk0, b)(
                     arrays[0], arrays[1], arrays[2], dead, pmax, qargs,
                     st["col_min"], st["col_max"],
                     np.float32(st["tf_min"]), np.float32(st["tf_max"]),
                     shift, lang_term, *consts)
+                t1s = time.perf_counter()
                 s, d, ok = jax.device_get(out)
-                self.prune_rounds += 1
+                self.count_round_trip()
+                _emit_rt_spans((t1s - t0s) * 1e3,
+                               (time.perf_counter() - t1s) * 1e3)
+                with self._lock:   # completer writes these too
+                    self.prune_rounds += 1
+                    if bool(ok):
+                        self.pruned_tiles += int(
+                            np.maximum(sp.tcounts - b, 0).sum())
                 if bool(ok):
-                    self.pruned_tiles += int(
-                        np.maximum(sp.tcounts - b, 0).sum())
                     keep = (d >= 0) & (s > NEG_INF32)
-                    self.queries_served += 1
-                    return s[keep][:k], d[keep][:k], considered
+                    s, d = s[keep], d[keep]
+                    with self._lock:   # exact under concurrency
+                        self.queries_served += 1
+                    cache_put(s, d)
+                    return s[:k], d[:k], considered
             # every bucket failed (pathological profile): full scan below
 
         starts = np.zeros((self.n_cells, self.MAX_SPANS), np.int32)
@@ -849,9 +1058,14 @@ class MeshSegmentStore:
              DAYS_NONE_LO if from_days is None else from_days,
              DAYS_NONE_HI if to_days is None else to_days], np.int32)
         consts = self._profile_consts(profile, language)
+        t0f = time.perf_counter()
         out = self._fn(kk0, with_delta)(
             *arrays, starts, counts, dead, *d_args, qfilters, *consts)
+        t1f = time.perf_counter()
         s, d = jax.device_get(out)
+        self.count_round_trip()
+        _emit_rt_spans((t1f - t0f) * 1e3,
+                       (time.perf_counter() - t1f) * 1e3)
         keep = (d >= 0) & (s > NEG_INF32)
         s, d = s[keep], d[keep]
         # gathered candidates may repeat a docid (replicated delta rows;
@@ -860,7 +1074,9 @@ class MeshSegmentStore:
         if len(first) != len(d):
             sel = np.sort(first)
             s, d = s[sel], d[sel]
-        self.queries_served += 1
+        with self._lock:   # exact under concurrency
+            self.queries_served += 1
+        cache_put(s, d)
         return s[:k], d[:k], considered
 
     MAX_JOIN_TERMS = 6
@@ -1003,12 +1219,18 @@ class MeshSegmentStore:
                 [qargs, np.full((self.n_cells, 1),
                                 term_shard(include_hashes[rare_i],
                                            self.n_term), np.int32)], axis=1)
+        t0j = time.perf_counter()
         out = self._jfn(kk, n_inc, n_exc, r, inc_ms, exc_ms,
                         cross_row=cross_row)(
             *arrays, jdocids, jpos, dead, qargs, *consts)
+        t1j = time.perf_counter()
         s, d = jax.device_get(out)
+        self.count_round_trip()
+        _emit_rt_spans((t1j - t0j) * 1e3,
+                       (time.perf_counter() - t1j) * 1e3)
         keep = (d >= 0) & (s > NEG_INF32)
-        self.queries_served += 1
+        with self._lock:   # exact under concurrency
+            self.queries_served += 1
         return s[keep][:k], d[keep][:k], considered
 
 

@@ -410,6 +410,29 @@ def score_topk16(feats16: jnp.ndarray, flags: jnp.ndarray,
     return top_scores, docids[top_idx], top_idx
 
 
+@partial(jax.jit, static_argnames=("k", "with_authority"))
+def score_topk16_packed(feats16: jnp.ndarray, flags: jnp.ndarray,
+                        docids: jnp.ndarray, valid: jnp.ndarray,
+                        hostids: jnp.ndarray, norm_coeffs: jnp.ndarray,
+                        flag_bits: jnp.ndarray, flag_shifts: jnp.ndarray,
+                        domlength_coeff: jnp.ndarray,
+                        tf_coeff: jnp.ndarray,
+                        language_coeff: jnp.ndarray,
+                        authority_coeff: jnp.ndarray,
+                        language_pref: jnp.ndarray, k: int,
+                        with_authority: bool = True):
+    """score_topk16 with a packed [2k] int32 output (scores ++ docids):
+    ONE device->host transfer per query — through a remote tunnel every
+    separately fetched array is its own round trip, and the upload path
+    (CardinalRanker.rank over a candidate block) paid two."""
+    s, d, _ = score_topk16(feats16, flags, docids, valid, hostids,
+                           norm_coeffs, flag_bits, flag_shifts,
+                           domlength_coeff, tf_coeff, language_coeff,
+                           authority_coeff, language_pref, k,
+                           with_authority=with_authority)
+    return jnp.concatenate([s, d])
+
+
 @partial(jax.jit, static_argnames=("k",))
 def score_topk(feats: jnp.ndarray, docids: jnp.ndarray, valid: jnp.ndarray,
                hostids: jnp.ndarray, norm_coeffs: jnp.ndarray,
@@ -607,14 +630,14 @@ class CardinalRanker:
         kk = min(k, npad)
         feats16, flags = compact_feats(feats)
         norm, bits, shifts, dl, tf, lang_c, auth, lang = self._device_consts()
-        s, d, _ = score_topk16(jnp.asarray(feats16), jnp.asarray(flags),
-                               jnp.asarray(docids), jnp.asarray(valid),
-                               jnp.asarray(hostids),
-                               norm, bits, shifts,
-                               dl, tf, lang_c, auth,
-                               lang, kk,
-                               with_authority=self.profile.authority > 12)
-        s, d = np.asarray(s), np.asarray(d)
+        out = score_topk16_packed(
+            jnp.asarray(feats16), jnp.asarray(flags),
+            jnp.asarray(docids), jnp.asarray(valid),
+            jnp.asarray(hostids),
+            norm, bits, shifts, dl, tf, lang_c, auth, lang, kk,
+            with_authority=self.profile.authority > 12)
+        host = np.asarray(out)       # one packed fetch (scores ++ docids)
+        s, d = host[:kk], host[kk:]
         keep = d >= 0
         keep &= s > -(2**31 - 1)
         return s[keep][:k], d[keep][:k]

@@ -388,6 +388,15 @@ KERNELS: dict[str, object] = {
     "_rank_scan_batch_kernel": _c_rank_spans,
     "_rank_join_batch_kernel": _c_rank_join,
     "_rank_join_bm_batch_kernel": _c_rank_join_bm,
+    # packed-I/O variants (one transfer each way per dispatch): the
+    # wrapped body IS the unpacked kernel, so the cost model is shared —
+    # the concat epilogue is noise against the row streams
+    "score_topk16_packed": _c_score_topk16,
+    "_rank_spans_packed_kernel": _c_rank_spans,
+    "_rank_pruned_batch1_packed_kernel": _c_rank_pruned_batch1,
+    "_rank_scan_batch_packed_kernel": _c_rank_spans,
+    "_rank_join_batch_packed_kernel": _c_rank_join,
+    "_rank_join_bm_batch_packed_kernel": _c_rank_join_bm,
 }
 
 # jit-compiled functions that are NOT serving kernels: maintenance

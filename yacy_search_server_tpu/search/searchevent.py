@@ -41,7 +41,7 @@ from ..ops.ranking import (CD_ALL, CD_APP, CD_AUDIO, CD_IMAGE, CD_TEXT,
 from ..utils.bitfield import (FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO,
                               FLAG_CAT_HASIMAGE, FLAG_CAT_HASVIDEO)
 from ..utils import tracing
-from ..utils.eventtracker import EClass, StageTimer
+from ..utils.eventtracker import EClass, StageTimer, update as track
 from ..utils.hashes import hosthash
 from ..utils.topk import WeakPriorityQueue
 from .navigator import accumulate, make_navigators
@@ -272,6 +272,43 @@ class SearchEvent:
         inc, exc = q.goal.include_hashes, q.goal.exclude_hashes
         if not inc:
             return None
+        m = q.modifier
+        from ..index.devstore import NO_FLAG, NO_LANG
+        flag = _CD_FLAG.get(q.contentdom)
+        lang_filter = (P.pack_language(m.language) if m.language
+                       else NO_LANG)
+        flag_bit = NO_FLAG if flag is None else flag
+        facet_mods = bool(m.sitehost or m.tld or m.filetype or m.protocol)
+        # ONE predicate for "plain single term, no constraints of any
+        # kind" — the cacheable shape. Derived from the SAME variables
+        # the routing gates below test, so a new gate added there that
+        # constrains results must extend this conjunction too (and gets
+        # reviewed against it), not drift past a hand-copied list.
+        unconstrained = (len(inc) == 1 and not exc and not m.date_sort
+                         and not facet_mods
+                         and lang_filter == NO_LANG
+                         and flag_bit == NO_FLAG
+                         and m.from_days is None and m.to_days is None
+                         and q.profile.authority <= 12)
+        # cache-aware eligibility: a repeated hot term answers from the
+        # store's versioned top-k result cache with ZERO device work, so
+        # none of the cost-based gates below apply to it — in particular
+        # the small-candidate host gate (count_upper takes the RWI lock
+        # and a cache hit is cheaper than even that host scoring)
+        if unconstrained:
+            peek = getattr(ds, "rank_cache_get", None)
+            if peek is not None:
+                q0 = time.perf_counter()
+                got = peek(inc[0], q.profile, q.lang, k)
+                if got is not None:
+                    # the stage still lands in BOTH observability
+                    # surfaces (attributable, with zero device work
+                    # behind it); hit-only so misses don't double-count
+                    # the real DEVRANK stage below
+                    wall_ms = (time.perf_counter() - q0) * 1000.0
+                    track(EClass.SEARCH, "DEVRANK", len(got[1]), wall_ms)
+                    tracing.emit("search.devrank", wall_ms, cache="hit")
+                    return got
         # tiny candidate sets: the host path scores them in microseconds
         # (ops/ranking.SMALL_RANK_N numpy twin); a device dispatch — and
         # through a remote tunnel, a full round trip — would dominate.
@@ -285,10 +322,8 @@ class SearchEvent:
         if min(self.segment.rwi.count_upper(th)
                for th in inc) <= thresh:
             return None
-        m = q.modifier
         if m.date_sort:
             return None
-        facet_mods = bool(m.sitehost or m.tld or m.filetype or m.protocol)
         # metadata-constrained modifiers (site:/tld:/filetype:/protocol)
         # serve on device for SINGLE-term queries via a cached facet
         # docid bitmap (VERDICT r3 #5 widening); conjunctions with them
@@ -299,11 +334,6 @@ class SearchEvent:
             return None
         if q.profile.authority > 12:
             return None
-        from ..index.devstore import NO_FLAG, NO_LANG
-        flag = _CD_FLAG.get(q.contentdom)
-        lang_filter = (P.pack_language(m.language) if m.language
-                       else NO_LANG)
-        flag_bit = NO_FLAG if flag is None else flag
         if len(inc) == 1 and not exc:
             if facet_mods:
                 # residency pre-check: building+uploading a bitmap for a

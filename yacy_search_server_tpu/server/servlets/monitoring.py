@@ -431,7 +431,11 @@ def prometheus_text(sb) -> str:
         for key in ("queries_served", "fallbacks", "stream_scans",
                     "filtered_served", "join_served", "join_fallbacks",
                     "batch_dispatches", "batch_exceptions",
-                    "batch_ineligible", "prune_rounds"):
+                    "batch_ineligible", "prune_rounds",
+                    # versioned top-k result cache (hits serve with zero
+                    # device work; stale = correct epoch invalidations)
+                    "rank_cache_hits", "rank_cache_stale",
+                    "device_round_trips"):
             if key in c:
                 p.sample("yacy_device_serving_total", c[key],
                          {"counter": key})

@@ -631,6 +631,14 @@ def device_store(header, post, sb):
             ("prune_rounds", ds.prune_rounds),
             ("pruned_tiles", ds.pruned_tiles),
             ("batching", 1 if ds._batcher is not None else 0),
+            # versioned top-k result cache + round-trip accounting
+            ("rank_cache_hits", c["rank_cache_hits"]),
+            ("rank_cache_stale", c["rank_cache_stale"]),
+            ("arena_epoch", c["arena_epoch"]),
+            ("device_round_trips", c["device_round_trips"]),
+            ("rt_per_query",
+             round(c["device_round_trips"]
+                   / max(c["queries_served"], 1), 3)),
             # silicon accounting (Performance_Roofline_p has the full
             # per-kernel table; these are the per-query headline fields)
             ("util_pct_p50", c["util_pct_p50"]),

@@ -126,7 +126,15 @@ class Switchboard:
                         # mix's solo dispatches) too — off by default
                         # until the mix protocol commits the win
                         scan_batching=self.config.get_bool(
-                            "index.device.scanBatching", False))
+                            "index.device.scanBatching", False),
+                        # pipelined dispatch: issue async, fetch in the
+                        # completer pool (one round trip per wave);
+                        # completerDepth bounds in-flight waves per
+                        # dispatcher
+                        pipeline=self.config.get_bool(
+                            "index.device.pipeline", True),
+                        completer_depth=self.config.get_int(
+                            "index.device.completerDepth", 2))
             except ValueError:
                 raise
             except Exception:  # no usable jax backend: host path serves
