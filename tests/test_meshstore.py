@@ -340,6 +340,8 @@ def test_mesh_batched_queries_match_solo_and_actually_batch():
         solo = {th: ms.rank_term(th, prof, k=10) for th in terms}
         ms.enable_batching(max_batch=8)
         d0 = ms._batcher.dispatches
+        from yacy_search_server_tpu.utils import histogram as hg
+        c0 = hg.histogram("mesh.collective").count
         results: dict = {}
 
         def worker(th):
@@ -355,6 +357,12 @@ def test_mesh_batched_queries_match_solo_and_actually_batch():
                 t.join()
         assert ms._batcher.dispatches > d0
         assert ms._batcher.exceptions == 0
+        # the mesh.collective histogram records once per SPMD program,
+        # never once per batched query (16 queries rode far fewer
+        # dispatches; a per-query record would inflate count 16x)
+        batched = hg.histogram("mesh.collective").count - c0
+        assert batched == ms._batcher.dispatches - d0, \
+            (batched, ms._batcher.dispatches - d0)
         for th in terms:
             s1, d1, c1 = solo[th]
             s2, d2, c2 = results[th]

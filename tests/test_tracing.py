@@ -94,7 +94,14 @@ def test_remote_trace_rejects_junk_ids():
     assert rec.spans[0].attrs["peer"] == "p"
 
 
-def test_stage_summary_names_tail_dominant_stage():
+def test_spans_feed_the_windowed_stage_table():
+    """The stage p50/p95 verdict (formerly a per-call trace-ring walk)
+    is maintained incrementally: every recorded span lands in the
+    windowed histogram for its name, and histogram.stage_table names
+    the tail-dominant stage — wrappers and background workloads
+    excluded (full dominance semantics pinned in test_histogram)."""
+    from yacy_search_server_tpu.utils import histogram as hg
+    hg.reset()
     for _ in range(4):
         with tracing.trace("req"):
             # the request wrapper covers everything but must never be
@@ -102,18 +109,19 @@ def test_stage_summary_names_tail_dominant_stage():
             tracing.emit("switchboard.search", 60.0)
             tracing.emit("search.fast", 1.0)
             tracing.emit("search.slow", 50.0)
-    # pipeline traces are a different workload: excluded by default
+    # pipeline/indexing stages are a different workload: excluded by
+    # default from the serving verdict
     with tracing.trace("pipeline.index"):
         tracing.emit("index.storedocumentindex", 500.0)
-    s = tracing.stage_summary()
+    s = hg.stage_table()
     assert s["tail_dominant_stage"] == "search.slow"
     assert s["stages"]["search.slow"]["p95_ms"] >= 50.0
-    # root spans never win dominance (they cover their children)
-    assert "req" in s["stages"]
+    assert s["stages"]["search.slow"]["count"] == 4
     assert "index.storedocumentindex" not in s["stages"]
     # the all-workload view folds the pipeline back in
-    s_all = tracing.stage_summary(exclude_roots=())
+    s_all = hg.stage_table(exclude_prefixes=())
     assert s_all["tail_dominant_stage"] == "index.storedocumentindex"
+    hg.reset()
 
 
 def test_export_jsonl():
@@ -296,10 +304,14 @@ def test_trace_servlet_lists_recent_and_summary(duo):
 
 def _parse_exposition(text):
     """Minimal format check: every non-comment line is `name[{labels}]
-    value`, HELP/TYPE precede their family's samples."""
+    value` with an optional OpenMetrics exemplar suffix on histogram
+    buckets, HELP/TYPE precede their family's samples (histogram
+    families declare TYPE on the base name; their samples carry the
+    `_bucket`/`_sum`/`_count` suffixes)."""
     import re
     samples = []
     seen_type = set()
+    hist_families = set()
     for line in text.splitlines():
         if not line.strip():
             continue
@@ -308,12 +320,22 @@ def _parse_exposition(text):
                 name, kind = line.split()[2:4]
                 assert kind in ("counter", "gauge", "histogram", "summary")
                 seen_type.add(name)
+                if kind == "histogram":
+                    hist_families.add(name)
             continue
         m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
-                     r"(\{[^}]*\})?\s+(-?[0-9.eE+-]+)$", line)
+                     r"(\{[^}]*\})?\s+(-?[0-9.eE+-]+|\+Inf)"
+                     r"(\s+#\s+\{[^}]*\}\s+-?[0-9.eE+-]+"
+                     r"(\s+-?[0-9.eE+-]+)?)?$", line)
         assert m, f"bad exposition line: {line!r}"
-        assert m.group(1) in seen_type, f"sample before TYPE: {line!r}"
-        samples.append((m.group(1), m.group(2) or "", float(m.group(3))))
+        name = m.group(1)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in seen_type or base in hist_families, \
+            f"sample before TYPE: {line!r}"
+        if m.group(4):
+            assert base in hist_families, \
+                f"exemplar on a non-histogram family: {line!r}"
+        samples.append((name, m.group(2) or "", float(m.group(3))))
     return samples
 
 

@@ -23,6 +23,7 @@ from urllib.request import (HTTPHandler, HTTPRedirectHandler, HTTPSHandler,
                             Request as UrlRequest)
 from urllib.request import build_opener
 
+from ..utils import histogram, tracing
 from .cache import HTCache
 from .latency import Latency
 from .request import Request, Response
@@ -294,6 +295,10 @@ class LoaderDispatcher:
                 return Response(request, status=501,
                                 headers={"x-error": f"scheme {scheme}"})
             elapsed = time.monotonic() - t0
+            # crawler fetch wall -> windowed histogram (ISSUE 4): the
+            # health engine's frontier/fetch rules read this family
+            histogram.observe("crawler.fetch", elapsed * 1000.0,
+                              tracing.current_trace_id())
             if request.host:
                 self.latency.update_after_load(request.host, elapsed)
             resp = Response(request, status=status, headers=headers,

@@ -72,7 +72,7 @@ from ..ops.ranking import (_ACTIVE_COLS, RankingProfile,
 from ..ops.streaming import merge_stats
 from ..utils.eventtracker import EClass, update as track
 from ..utils.profiler import PROFILER
-from ..utils import tracing
+from ..utils import histogram, tracing
 from . import postings as P
 
 log = logging.getLogger("yacy.devstore")
@@ -212,12 +212,18 @@ def _pmax_window(max_tcount: int) -> int:
 
 def _emit_rt_spans(issue_ms: float, fetch_ms: float,
                    device_ms: float = 0.0) -> None:
-    """Emit the issue/device/fetch round-trip decomposition as child
-    spans under the active trace (no-op untraced). Solo dispatches fetch
+    """Record the issue/device/fetch round-trip decomposition: as child
+    spans under the active trace (which feeds the windowed histograms
+    through the span record, exemplar included), or straight into the
+    histograms when untraced — the kernel-stage p50/p95 on /metrics
+    covers every dispatch either way (ISSUE 4). Solo dispatches fetch
     immediately after issuing, so their in-flight `device` window is ~0
     and the device time rides inside `fetch`; the pipelined batch path
     stamps a real in-flight window (see _QueryBatcher._complete)."""
     if tracing.current() is None:
+        histogram.observe("kernel.issue", issue_ms)
+        histogram.observe("kernel.device", device_ms)
+        histogram.observe("kernel.fetch", fetch_ms)
         return
     tracing.emit("kernel.issue", issue_ms)
     tracing.emit("kernel.device", device_ms)
@@ -1572,6 +1578,8 @@ class _QueryBatcher:
         is re-emitted here as a child span — dispatcher threads carry no
         trace context of their own."""
         sp = tracing.span("devstore.batch", kind=item.get("kind", "term"))
+        untraced = sp is tracing._NOOP
+        t_sub = time.perf_counter()
         with sp:
             res = self._submit_wait_inner(item)
             km = item.get("kernel_ms")
@@ -1579,18 +1587,28 @@ class _QueryBatcher:
             # work: the solo retry emits the REAL kernel span, and a
             # timeout emit here would double-count the query's wall
             if km is not None and res[0] != "timeout":
-                tracing.emit(f"kernel.{item.get('kernel_name', '?')}",
-                             km, batch=item.get("batch_n", 0))
+                if not untraced:
+                    tracing.emit(f"kernel.{item.get('kernel_name', '?')}",
+                                 km, batch=item.get("batch_n", 0))
                 # round-trip decomposition (pipelined dispatch): issue =
                 # host-side async dispatch of the jitted call; device =
                 # the in-flight window (device executing while the
                 # dispatcher already issues the next part); fetch = the
-                # completer's blocking device->host transfer
+                # completer's blocking device->host transfer.  Traced,
+                # the emits feed the histograms through the span record;
+                # untraced, record directly (ISSUE 4: the /metrics
+                # distributions must cover the whole workload)
                 for stage in ("issue", "device", "fetch"):
                     ms = item.get(f"{stage}_ms")
                     if ms is not None:
-                        tracing.emit(f"kernel.{stage}", ms)
+                        if untraced:
+                            histogram.observe(f"kernel.{stage}", ms)
+                        else:
+                            tracing.emit(f"kernel.{stage}", ms)
             sp.set(outcome=res[0])
+        if untraced:
+            histogram.observe("devstore.batch",
+                              (time.perf_counter() - t_sub) * 1000.0)
         return res
 
     def _submit_wait_inner(self, item: dict):

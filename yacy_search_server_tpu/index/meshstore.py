@@ -70,7 +70,7 @@ from ..ops.streaming import merge_stats
 from ..parallel.distribution import horizontal_dht_position
 from ..parallel.mesh import shard_map
 from ..utils.eventtracker import EClass, update as track
-from ..utils import tracing
+from ..utils import histogram, tracing
 from . import postings as P
 from .devstore import (_PRUNE_B, DAYS_NONE_HI, DAYS_NONE_LO, NEG_INF32,
                        NO_FLAG, NO_LANG, TILE, _TopkCache, _bucket_delta,
@@ -245,18 +245,30 @@ class _MeshQueryBatcher:
                 "kk": kk, "ev": threading.Event(), "res": ("ineligible",),
                 "lk": threading.Lock(), "taken": False}
         sp = tracing.span("mesh.batch")
+        untraced = sp is tracing._NOOP
+        t_sub = time.perf_counter()
         with sp:
             res = self._submit_wait(item)
             km = item.get("kernel_ms")
             # withdrawn dispatch: the solo retry owns the kernel span
+            # (the mesh.collective histogram records once per SPMD
+            # program in _complete, NOT here — per-query recording
+            # would inflate it by the batch factor)
             if km is not None and res[0] != "timeout":
-                tracing.emit(f"kernel.{item.get('kernel_name', '?')}",
-                             km, batch=item.get("batch_n", 0))
+                if not untraced:
+                    tracing.emit(f"kernel.{item.get('kernel_name', '?')}",
+                                 km, batch=item.get("batch_n", 0))
                 for stage in ("issue", "device", "fetch"):
                     ms = item.get(f"{stage}_ms")
                     if ms is not None:
-                        tracing.emit(f"kernel.{stage}", ms)
+                        if untraced:
+                            histogram.observe(f"kernel.{stage}", ms)
+                        else:
+                            tracing.emit(f"kernel.{stage}", ms)
             sp.set(outcome=res[0])
+        if untraced:
+            histogram.observe("mesh.batch",
+                              (time.perf_counter() - t_sub) * 1000.0)
         return res
 
     def _submit_wait(self, item: dict):
@@ -449,6 +461,10 @@ class _MeshQueryBatcher:
                 d = host[:, kk:2 * kk]
                 ok = host[:, 2 * kk] != 0
                 wall_ms = (time.perf_counter() - t0k) * 1000.0
+                # ONE record per SPMD program execution — recording at
+                # the submitters would inflate count/sum by the batch
+                # factor (every batched query carries the same wall)
+                histogram.observe("mesh.collective", wall_ms)
                 with self._ctr_lock:
                     self.dispatches += 1
                 with store._lock:   # completer + query threads write
@@ -1022,6 +1038,11 @@ class MeshSegmentStore:
                 self.count_round_trip()
                 _emit_rt_spans((t1s - t0s) * 1e3,
                                (time.perf_counter() - t1s) * 1e3)
+                # solo SPMD program wall: one mesh.collective record per
+                # dispatch (the batched path records in _complete)
+                histogram.observe("mesh.collective",
+                                  (time.perf_counter() - t0s) * 1e3,
+                                  tracing.current_trace_id())
                 with self._lock:   # completer writes these too
                     self.prune_rounds += 1
                     if bool(ok):
@@ -1066,6 +1087,9 @@ class MeshSegmentStore:
         self.count_round_trip()
         _emit_rt_spans((t1f - t0f) * 1e3,
                        (time.perf_counter() - t1f) * 1e3)
+        histogram.observe("mesh.collective",
+                          (time.perf_counter() - t0f) * 1e3,
+                          tracing.current_trace_id())
         keep = (d >= 0) & (s > NEG_INF32)
         s, d = s[keep], d[keep]
         # gathered candidates may repeat a docid (replicated delta rows;
@@ -1228,6 +1252,9 @@ class MeshSegmentStore:
         self.count_round_trip()
         _emit_rt_spans((t1j - t0j) * 1e3,
                        (time.perf_counter() - t1j) * 1e3)
+        histogram.observe("mesh.collective",
+                          (time.perf_counter() - t0j) * 1e3,
+                          tracing.current_trace_id())
         keep = (d >= 0) & (s > NEG_INF32)
         with self._lock:   # exact under concurrency
             self.queries_served += 1

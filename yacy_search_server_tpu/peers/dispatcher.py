@@ -23,6 +23,7 @@ import numpy as np
 from ..index.metadata import DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS
 from ..index.postings import PostingsList
 from ..parallel.distribution import Distribution
+from ..utils import histogram
 from .dht import select_distribution_targets
 from .protocol import Protocol
 from .seed import Seed, SeedDB
@@ -62,8 +63,14 @@ class Transmission:
     def transmit(self, protocol: Protocol) -> tuple[bool, float]:
         """-> (ok, pause_s): the receiver's backpressure hint
         (transferRWI 'pause' reply field)."""
+        t0 = time.perf_counter()
         ok, reply = protocol.transfer_index(
             self.target, self.containers, self.metadata_rows)
+        # DHT transfer wall -> windowed histogram (ISSUE 4): transfers
+        # run on node background loops, so this site records directly
+        # rather than through the span bridge
+        histogram.observe("dht.transfer",
+                          (time.perf_counter() - t0) * 1000.0)
         try:
             pause = float(reply.get("pause", 0) or 0)
         except (TypeError, ValueError):

@@ -23,9 +23,11 @@ import base64
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, unquote, urlsplit
 
+from ..utils import histogram, tracing
 from .objects import ServerObjects
 from .templates import TemplateEngine
 from . import servlets
@@ -302,18 +304,34 @@ class YaCyHttpServer:
                       # behavior for non-admin callers (getpageinfo SSRF
                       # classes, RegexTest limits)
                       "admin": self._is_admin(handler),
+                      # content negotiation (the /metrics endpoint
+                      # upgrades to OpenMetrics + exemplars on it)
+                      "accept": handler.headers.get("Accept", ""),
                       "host": handler.headers.get(
                           "Host", f"{self.host}:{self.port}")}
-            prop = fn(header, post, self.sb)
-            if isinstance(prop.raw_body, bytes):    # binary (PNG graphics)
-                self._send(handler, 200,
-                           prop.raw_ctype or "application/octet-stream",
-                           prop.raw_body)
-                return
-            body = self._render(name, ext, prop)
-            ctype = prop.raw_ctype or _CONTENT_TYPES.get(
-                ext, "text/html; charset=utf-8")
-            self._send(handler, 200, ctype, body.encode("utf-8"))
+            # servlet serving wall -> windowed histogram (ISSUE 4): the
+            # full dispatch+render wall of EVERY servlet — including
+            # ones that raise into the 500 handler below (the finally:
+            # a wedged endpoint must not vanish from the very SLO
+            # histogram that would page on it).  When the servlet
+            # rooted a trace, its id becomes the histogram exemplar so
+            # a slow bucket on /metrics links to the waterfall
+            tracing.clear_last_trace_id()
+            t_sv = time.perf_counter()
+            try:
+                prop = fn(header, post, self.sb)
+                if isinstance(prop.raw_body, bytes):  # binary (PNG etc.)
+                    body = prop.raw_body
+                    ctype = prop.raw_ctype or "application/octet-stream"
+                else:
+                    body = self._render(name, ext, prop).encode("utf-8")
+                    ctype = prop.raw_ctype or _CONTENT_TYPES.get(
+                        ext, "text/html; charset=utf-8")
+            finally:
+                histogram.observe("servlet.serving",
+                                  (time.perf_counter() - t_sv) * 1000.0,
+                                  tracing.last_trace_id())
+            self._send(handler, 200, ctype, body)
         except BrokenPipeError:
             pass
         except Exception as e:  # CrashProtectionHandler parity

@@ -1,0 +1,108 @@
+"""Performance_Health_p — the node health dashboard (ISSUE 4).
+
+The operator surface of `utils/health.py`: the live rule table
+(state / cause / evidence / since), per-histogram windowed percentiles
+with a bucket-distribution sparkline, and the flight recorder's incident
+list with a raw JSONL download.  The capability successor of the
+reference's PerformanceQueues_p/PerformanceMemory_p pages — except the
+node evaluated itself before the page was loaded."""
+
+from __future__ import annotations
+
+import time
+
+from ...utils import histogram
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _sparkline(counts, width: int = 24) -> str:
+    """Bucket-count vector -> a fixed-width unicode sparkline (the
+    distribution shape at a glance; empty histogram -> all blanks)."""
+    if not counts:
+        return ""
+    chunk = max(1, (len(counts) + width - 1) // width)
+    groups = [sum(counts[i:i + chunk])
+              for i in range(0, len(counts), chunk)]
+    peak = max(groups)
+    if peak <= 0:
+        return _SPARK[0] * len(groups)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   1 + int(g / peak * (len(_SPARK) - 2)))] if g else
+        _SPARK[0]
+        for g in groups)
+
+
+@servlet("Performance_Health_p")
+def respond_health(header: dict, post: ServerObjects,
+                   sb) -> ServerObjects:
+    prop = ServerObjects()
+    eng = getattr(sb, "health", None)
+    if eng is None:
+        prop.put("info", "health engine not available")
+        prop.put("rules", 0)
+        return prop
+    # incident download: registry-name lookup only (no caller paths)
+    if post.get("format", "") == "incident":
+        body = eng.incident_body(post.get("name", ""))
+        prop.raw_body = body if body is not None else "{}"
+        prop.raw_ctype = "application/jsonl; charset=utf-8"
+        return prop
+    # operators (and tests) can force an evaluation pass from the page
+    if post.get("tick", "") == "1":
+        eng.tick()
+    now = time.time()
+    prop.put("overall", eng.overall())
+    prop.put("status_value", eng.status_value())
+    prop.put("tick_count", eng.tick_count)
+    prop.put("last_tick_age_s",
+             round(now - eng.last_tick, 1) if eng.last_tick else -1)
+    prop.put("snapshots_retained", len(eng.snapshots))
+
+    rows = eng.rule_table()
+    prop.put("rules", len(rows))
+    for i, (name, desc, st) in enumerate(rows):
+        pre = f"rules_{i}_"
+        prop.put(pre + "name", escape_json(name))
+        prop.put(pre + "description", escape_json(desc))
+        prop.put(pre + "state", st.state)
+        prop.put(pre + "cause", escape_json(st.cause))
+        prop.put(pre + "since_s",
+                 round(now - st.since, 1) if st.since else 0.0)
+        prop.put(pre + "evidence", escape_json(" ".join(
+            f"{k}={v}" for k, v in st.evidence.items())))
+
+    hists = [h for h in histogram.all_histograms()
+             if h.windowed_count() > 0 or h.count > 0]
+    prop.put("histograms", len(hists))
+    for i, h in enumerate(hists):
+        pre = f"histograms_{i}_"
+        counts = h.windowed_counts()
+        prop.put(pre + "name", escape_json(h.name))
+        prop.put(pre + "window_count", sum(counts))
+        prop.put(pre + "total_count", h.count)
+        prop.put(pre + "p50_ms", round(
+            histogram.percentile_from_counts(counts, 0.50), 3))
+        prop.put(pre + "p95_ms", round(
+            histogram.percentile_from_counts(counts, 0.95), 3))
+        prop.put(pre + "p99_ms", round(
+            histogram.percentile_from_counts(counts, 0.99), 3))
+        prop.put(pre + "spark", _sparkline(counts))
+        exes = [e for e in h.snapshot()["exemplars"] if e is not None]
+        # the slowest exemplar links the family to a concrete trace
+        prop.put(pre + "exemplar_trace",
+                 escape_json(max(exes, key=lambda e: e[1])[0])
+                 if exes else "")
+
+    incs = list(eng.incidents)
+    prop.put("incidents", len(incs))
+    for i, inc in enumerate(reversed(incs)):
+        pre = f"incidents_{i}_"
+        prop.put(pre + "name", escape_json(inc["name"]))
+        prop.put(pre + "time", int(inc["ts"]))
+        prop.put(pre + "rules", escape_json(",".join(inc["rules"])))
+        prop.put(pre + "file", escape_json(inc["path"] or ""))
+    return prop

@@ -10,7 +10,7 @@ EventTracker time-series rendered as a PNG via ProfilingGraph)."""
 
 from __future__ import annotations
 
-from ...utils import tracing
+from ...utils import histogram, tracing
 from ...utils.eventtracker import EClass, events
 from ...utils.memory import MemoryControl
 from ..objects import ServerObjects, escape_json
@@ -289,10 +289,15 @@ def respond_trace(header: dict, post: ServerObjects, sb) -> ServerObjects:
         prop.put(p + "spans", len(rec.spans))
         prop.put(p + "done", 1 if rec.done else 0)
     # serving-stage summary by default; workload=all folds the sampled
-    # per-document pipeline traces in too
-    summary = tracing.stage_summary(
-        exclude_roots=() if post.get("workload", "") == "all"
-        else ("pipeline.index",))
+    # per-document pipeline stages in too.  Answered from the WINDOWED
+    # histograms (ISSUE 4 satellite): the old path re-walked every span
+    # of the 256-trace ring per page load to recompute the same p50/p95
+    # the histograms now maintain incrementally — and these percentiles
+    # cover the last ~3 minutes of the whole workload, not whatever the
+    # ring happens to retain
+    summary = histogram.stage_table(
+        exclude_prefixes=() if post.get("workload", "") == "all"
+        else histogram.BACKGROUND_PREFIXES)
     stages = sorted(summary["stages"].items(),
                     key=lambda kv: -kv[1]["p95_ms"])
     prop.put("tail_dominant_stage",
@@ -350,41 +355,66 @@ def _prom_escape(v: str) -> str:
 class _Prom:
     """Tiny exposition builder: families declared once, samples appended
     in declaration order (the text-format contract: all samples of a
-    family are consecutive, HELP/TYPE precede them)."""
+    family are consecutive, HELP/TYPE precede them).  In OpenMetrics
+    mode counter families are declared on the suffix-free base name
+    (the spec reserves `_total` for the sample and forbids it on the
+    family), and only then may bucket samples carry exemplars."""
 
-    def __init__(self):
+    def __init__(self, openmetrics: bool = False):
         self.lines: list[str] = []
+        self.openmetrics = openmetrics
 
     def family(self, name: str, kind: str, help_: str):
+        if self.openmetrics and kind == "counter" \
+                and name.endswith("_total"):
+            name = name[:-len("_total")]
         self.lines.append(f"# HELP {name} {help_}")
         self.lines.append(f"# TYPE {name} {kind}")
 
-    def sample(self, name: str, value, labels: dict | None = None):
+    def sample(self, name: str, value, labels: dict | None = None,
+               exemplar: tuple | None = None):
         if labels:
             lbl = ",".join(f'{k}="{_prom_escape(v)}"'
                            for k, v in labels.items())
             name = f"{name}{{{lbl}}}"
         if isinstance(value, float):
             value = round(value, 6)
-        self.lines.append(f"{name} {value}")
+        line = f"{name} {value}"
+        if exemplar is not None:
+            # OpenMetrics exemplar syntax: `# {trace_id="..."} value ts`
+            # — the link from a slow histogram bucket straight to its
+            # Performance_Trace_p waterfall (ISSUE 4)
+            tid, ex_v, ex_ts = exemplar
+            line += (f' # {{trace_id="{_prom_escape(tid)}"}} '
+                     f"{round(ex_v, 6)} {round(ex_ts, 3)}")
+        self.lines.append(line)
 
     def text(self) -> str:
         return "\n".join(self.lines) + "\n"
 
 
-def prometheus_text(sb) -> str:
+def prometheus_text(sb, include_buckets: bool = True,
+                    openmetrics: bool = False) -> str:
     """Assemble the node's unified metric surface: eventtracker series,
     roofline utilization, device/mesh batcher health (incl. the
     queue_full/flush_deadline/worker_stall cause buckets), crawler
     queue depths, pipeline stages, DHT transfer counts, the logging
     drop counter (counted at utils/logging.py but surfaced nowhere
-    until now) and the tracing ring's own accounting."""
+    until now), the windowed latency histograms and the tracing ring's
+    own accounting.  `include_buckets=False` skips the per-bucket
+    histogram samples (every family still exposes `_sum`/`_count`) —
+    the health tick's evaluation surface, which reads no buckets and
+    must stay cheap at its 5 s cadence.  `openmetrics=True` switches to
+    the OpenMetrics dialect: suffix-free counter family declarations,
+    `# {trace_id=...}` bucket exemplars and the `# EOF` trailer —
+    features the classic 0.0.4 expfmt parser rejects, so they never
+    appear on the default form."""
     from ...crawler.frontier import StackType
     from ...utils import logging as ylog
     from ...utils.eventtracker import totals
     from ...utils.profiler import PROFILER
 
-    p = _Prom()
+    p = _Prom(openmetrics=openmetrics)
 
     p.family("yacy_log_dropped_records_total", "counter",
              "log records dropped by the bounded async logging queue")
@@ -417,28 +447,44 @@ def prometheus_text(sb) -> str:
         p.sample("yacy_roofline_kernel_util_pct", pt.util_pct,
                  {"kernel": pt.kernel, "bound": pt.bound})
 
+    # device families are emitted even when no device store serves (all
+    # zeros): the health rules reference these series by exact key, and
+    # the no-dead-rules hygiene gate requires every reference to resolve
+    # on every node configuration
     ds = sb.index.devstore
+    c = ds.counters() if ds is not None else {}
+    p.family("yacy_batch_timeouts_total", "counter",
+             "batcher watchdog timeouts by cause bucket "
+             "(worker_stall must stay 0 in healthy serving)")
+    for cause in ("queue_full", "flush_deadline", "worker_stall"):
+        p.sample("yacy_batch_timeouts_total",
+                 c.get(f"batch_timeout_{cause}", 0), {"cause": cause})
+    p.family("yacy_device_serving_total", "counter",
+             "device store serving counters")
+    for key in ("queries_served", "fallbacks", "stream_scans",
+                "filtered_served", "join_served", "join_fallbacks",
+                "batch_dispatches", "batch_exceptions",
+                "batch_ineligible", "prune_rounds",
+                # versioned top-k result cache (hits serve with zero
+                # device work; stale = correct epoch invalidations)
+                "rank_cache_hits", "rank_cache_stale",
+                "device_round_trips"):
+        p.sample("yacy_device_serving_total", c.get(key, 0),
+                 {"counter": key})
+    p.family("yacy_device_arena_epoch", "gauge",
+             "arena epoch (bumps on flush/merge/repack/delete; the "
+             "stale-spike health rule reads its churn)")
+    p.sample("yacy_device_arena_epoch", c.get("arena_epoch", 0))
+    p.family("yacy_batcher_queue_depth", "gauge",
+             "batcher incoming / in-flight queue depths (the backlog "
+             "health rule watches the growth trend)")
+    b = getattr(ds, "_batcher", None) if ds is not None else None
+    p.sample("yacy_batcher_queue_depth",
+             b._q.qsize() if b is not None else 0, {"queue": "incoming"})
+    p.sample("yacy_batcher_queue_depth",
+             b._inflight.qsize() if b is not None else 0,
+             {"queue": "inflight"})
     if ds is not None:
-        c = ds.counters()
-        p.family("yacy_batch_timeouts_total", "counter",
-                 "batcher watchdog timeouts by cause bucket "
-                 "(worker_stall must stay 0 in healthy serving)")
-        for cause in ("queue_full", "flush_deadline", "worker_stall"):
-            p.sample("yacy_batch_timeouts_total",
-                     c.get(f"batch_timeout_{cause}", 0), {"cause": cause})
-        p.family("yacy_device_serving_total", "counter",
-                 "device store serving counters")
-        for key in ("queries_served", "fallbacks", "stream_scans",
-                    "filtered_served", "join_served", "join_fallbacks",
-                    "batch_dispatches", "batch_exceptions",
-                    "batch_ineligible", "prune_rounds",
-                    # versioned top-k result cache (hits serve with zero
-                    # device work; stale = correct epoch invalidations)
-                    "rank_cache_hits", "rank_cache_stale",
-                    "device_round_trips"):
-            if key in c:
-                p.sample("yacy_device_serving_total", c[key],
-                         {"counter": key})
         p.family("yacy_device_latency_ms", "gauge",
                  "per-query dispatch/kernel wall percentiles")
         for key in ("dispatch_ms_p50", "dispatch_ms_p95",
@@ -510,14 +556,64 @@ def prometheus_text(sb) -> str:
              {"kind": "traces"})
     p.sample("yacy_trace_drops_total", tracing.dropped_spans,
              {"kind": "spans"})
-    return p.text()
+
+    # -- windowed latency histograms (ISSUE 4): one Prometheus histogram
+    # family per registered Histogram — cumulative _bucket/_sum/_count
+    # (monotonic by contract) with trace-id exemplars on the buckets the
+    # slow requests landed in.  EVERY registered histogram appears here
+    # by construction (iterating the registry is the hygiene gate).
+    for h in histogram.all_histograms():
+        fam = histogram.prom_name(h.name)
+        snap = h.snapshot()
+        p.family(fam, "histogram", h.help)
+        if include_buckets:
+            exs = snap["exemplars"] if openmetrics \
+                else [None] * len(snap["exemplars"])
+            cum = 0
+            for i, le in enumerate(histogram.BUCKET_BOUNDS_MS):
+                cum += snap["counts"][i]
+                p.sample(fam + "_bucket", cum, {"le": f"{le:g}"},
+                         exemplar=exs[i])
+            cum += snap["counts"][-1]
+            p.sample(fam + "_bucket", cum, {"le": "+Inf"},
+                     exemplar=exs[-1])
+        p.sample(fam + "_sum", round(snap["sum_ms"], 3))
+        p.sample(fam + "_count", snap["count"])
+
+    # -- health engine (ISSUE 4): the overall gauge + one gauge per rule
+    # (0 ok / 1 warn / 2 critical) so an alertmanager can page on the
+    # same states Performance_Health_p shows
+    eng = getattr(sb, "health", None)
+    if eng is not None:
+        p.family("yacy_health_status", "gauge",
+                 "overall node health (0 ok / 1 warn / 2 critical)")
+        p.sample("yacy_health_status", eng.status_value())
+        p.family("yacy_health_rule", "gauge",
+                 "per-rule health state (0 ok / 1 warn / 2 critical)")
+        for name, _desc, st in eng.rule_table():
+            p.sample("yacy_health_rule",
+                     {"ok": 0, "warn": 1, "critical": 2}[st.state],
+                     {"rule": name})
+        p.family("yacy_health_incidents_total", "counter",
+                 "flight-recorder incident dumps since start")
+        p.sample("yacy_health_incidents_total", eng.incident_count)
+    return p.text() + ("# EOF\n" if openmetrics else "")
 
 
 @servlet("metrics")
 def respond_metrics(header: dict, post: ServerObjects,
                     sb) -> ServerObjects:
-    """GET /metrics — Prometheus text exposition format 0.0.4."""
+    """GET /metrics — Prometheus text exposition.  Classic 0.0.4 by
+    default; an Accept header naming openmetrics-text (what a
+    Prometheus server with exemplar support negotiates) or
+    `format=openmetrics` upgrades to OpenMetrics WITH the trace-id
+    exemplars — which a classic parser would reject, so they never
+    appear on the 0.0.4 form."""
+    om = ("openmetrics" in header.get("accept", "")
+          or post.get("format", "") == "openmetrics")
     prop = ServerObjects()
-    prop.raw_body = prometheus_text(sb)
-    prop.raw_ctype = "text/plain; version=0.0.4; charset=utf-8"
+    prop.raw_body = prometheus_text(sb, openmetrics=om)
+    prop.raw_ctype = (
+        "application/openmetrics-text; version=1.0.0; charset=utf-8"
+        if om else "text/plain; version=0.0.4; charset=utf-8")
     return prop

@@ -240,6 +240,14 @@ class Switchboard:
             "parseDocument", self._stage_parse, workers=pipeline_workers,
             queue_size=200, next_stage=self._condense_proc)
 
+        # node health engine (ISSUE 4): rules + SLO burn rates + flight
+        # recorder over the same series /metrics exports.  Constructed
+        # here (cheap: no evaluation), driven by the 15_health busy
+        # thread — or directly by tests/Performance_Health_p
+        from .utils.health import HealthEngine
+        self.health = HealthEngine(
+            self, incidents_dir=sub("HEALTH") if data_dir else None)
+
         # data-store migrations: rows written by an older release are
         # upgraded in place once, tracked by the STORE_VERSION marker in
         # the data dir (reference: migration.java version-gated rewrites,
@@ -639,6 +647,14 @@ class Switchboard:
         self.threads.deploy(BusyThread(
             "20_scheduler", self.scheduler_job,
             idle_sleep_s=60.0, busy_sleep_s=10.0))
+        if self.config.get_bool("health.enabled", True):
+            tick_s = self.config.get_float("health.tickS", 5.0)
+            self.threads.deploy(BusyThread(
+                # busy pacing while unhealthy: an unhealthy node
+                # re-evaluates (and recovers its rules) at twice the
+                # healthy cadence
+                "15_health", self.health.tick_job,
+                idle_sleep_s=tick_s, busy_sleep_s=max(1.0, tick_s / 2)))
         self.threads.deploy(BusyThread(
             "25_contentcontrol", self._content_control_job,
             idle_sleep_s=30.0, busy_sleep_s=5.0))

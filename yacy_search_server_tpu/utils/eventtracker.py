@@ -14,7 +14,7 @@ from collections import deque
 from dataclasses import dataclass
 from enum import Enum
 
-from . import tracing
+from . import histogram, tracing
 
 
 class EClass(Enum):
@@ -89,7 +89,12 @@ class StageTimer:
     ``<class>.<label>`` — every existing StageTimer site (search
     stages, pipeline stages, crawl stages) joins the trace waterfall
     without a second timing call. Outside a trace the span handle is
-    the shared no-op object (zero alloc)."""
+    the shared no-op object (zero alloc).
+
+    Histogram bridge (ISSUE 4): a traced stage reaches the windowed
+    histograms through the span record (with its trace-id exemplar); an
+    UNTRACED stage records here directly — so the per-stage p50/p95 on
+    `/metrics` covers the whole workload, not just the traced slice."""
 
     def __init__(self, eclass: EClass, label: str, count: int = 0):
         self.eclass, self.label, self.count = eclass, label, count
@@ -102,7 +107,10 @@ class StageTimer:
         return self
 
     def __exit__(self, *exc):
-        update(self.eclass, self.label, self.count,
-               (time.monotonic() - self._t0) * 1000.0)
+        ms = (time.monotonic() - self._t0) * 1000.0
+        update(self.eclass, self.label, self.count, ms)
         self._span.__exit__(*exc)
+        if self._span is tracing._NOOP:
+            histogram.observe(
+                f"{self.eclass.value}.{self.label.lower()}", ms)
         return False

@@ -1,0 +1,468 @@
+"""Node health engine — rules, SLO burn rates, and a flight recorder.
+
+The survey's coordinator-free P2P premise means no central control plane
+ever notices a sick peer: each node must watch itself (SURVEY §1; the
+reference's PerformanceQueues_p/PerformanceMemory_p pages are the
+Java-era, human-polled version).  PRs 2–3 built the raw signals — trace
+spine, `/metrics` counters, batcher cause buckets, result-cache and
+round-trip counters — but nothing CONSUMED them: a degrading node looked
+healthy until a human loaded a servlet.  This module is the consumer
+(ISSUE 4 tentpole):
+
+- **Declarative rules** evaluated by a switchboard busy-thread tick.
+  Each rule reads only series that exist on the `/metrics` exposition
+  (hygiene-tested: a rule referencing a dead series fails the build)
+  and yields ``ok | warn | critical`` with a human-readable cause and
+  the evidence values that justify it.
+- **SLO burn rates.** The serving objective (p95 ≤ X ms, i.e. ≤ budget%
+  of requests over X) is judged over a FAST window (the newest histogram
+  rotation) and a SLOW window (all retained rotations): paging only when
+  both burn — the standard multiwindow discipline that ignores blips but
+  catches real burns fast ("Repeatability Corner Cases in Document
+  Ranking": detection must compare distributions, not single samples).
+- **Flight recorder.** Every tick appends the parsed `/metrics` sample
+  set to a bounded ring; when any rule ENTERS ``critical`` (edge, rate
+  limited) the ring is dumped as a JSONL incident file — snapshots,
+  firing rules, histogram exemplar trace ids, and recent traces — so a
+  postmortem never depends on someone having been watching.
+
+The engine deliberately evaluates rules against the same exposition
+pipeline the `/metrics` endpoint serves, rendered WITHOUT the
+per-bucket histogram samples (no rule reads buckets, and ~100 bucket
+lines per family would dominate the tick's cost): every counter, gauge
+and histogram `_sum`/`_count` a rule can reference carries exactly the
+value a concurrent scrape would see.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+from . import histogram, tracing
+
+OK, WARN, CRITICAL = "ok", "warn", "critical"
+_SEVERITY = {OK: 0, WARN: 1, CRITICAL: 2}
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})?)\s+(-?[0-9.eE+-]+)"
+    r"(?:\s+#.*)?$")
+
+
+def parse_exposition(text: str) -> dict:
+    """Prometheus text -> {'family{labels}': value}.  Keys are the exact
+    sample prefixes the exposition rendered (exemplar suffixes
+    stripped), so rule series references are checked against reality."""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m:
+            out[m.group(1)] = float(m.group(2))
+    return out
+
+
+@dataclass
+class RuleState:
+    state: str = OK
+    cause: str = ""
+    since: float = 0.0
+    evidence: dict = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One detector: `series` lists every exposition sample the
+    evaluator reads (the hygiene contract), `evaluate` maps the
+    snapshot history to (state, cause, evidence)."""
+
+    name: str
+    description: str
+    series: tuple
+    evaluate: Callable
+
+
+class RuleCtx:
+    """What a rule may look at: the snapshot history (newest last) and
+    the windowed histograms."""
+
+    def __init__(self, history, trend_ticks: int):
+        self._hist = history
+        self.trend_ticks = trend_ticks
+
+    def value(self, key: str, default: float = 0.0) -> float:
+        if not self._hist:
+            return default
+        return self._hist[-1][1].get(key, default)
+
+    def ago(self, key: str, n: int, default: float = 0.0) -> float:
+        """Value n ticks back (clamped to the oldest retained)."""
+        if not self._hist:
+            return default
+        i = max(0, len(self._hist) - 1 - n)
+        return self._hist[i][1].get(key, default)
+
+    def delta(self, key: str, n: int | None = None) -> float:
+        n = self.trend_ticks if n is None else n
+        return self.value(key) - self.ago(key, n)
+
+    def ticks(self) -> int:
+        return len(self._hist)
+
+    @staticmethod
+    def hist(name: str):
+        return histogram.get(name)
+
+
+# ---------------------------------------------------------------------------
+# the rule set
+# ---------------------------------------------------------------------------
+
+def build_rules(cfg) -> list:
+    """The node's detectors.  Thresholds read config once at build time
+    (the engine is rebuilt on config edits via `Switchboard` restart —
+    the reference's model for performance knobs)."""
+    g = cfg.get_float
+    gi = cfg.get_int
+    slo_ms = g("health.sloServingP95Ms", 250.0)
+    budget = max(1e-6, g("health.sloBudgetPct", 5.0) / 100.0)
+    min_qps = g("health.sloMinQps", 1.0)
+    fast_crit = g("health.sloFastBurnCritical", 6.0)
+    slow_crit = g("health.sloSlowBurnCritical", 3.0)
+    stall_ticks = gi("health.stallRecoveryTicks", 3)
+    backlog_warn = gi("health.backlogWarnDepth", 4)
+    backlog_crit = gi("health.backlogCriticalDepth", 16)
+    drops_crit = gi("health.logDropsCritical", 100)
+    min_act = gi("health.cacheMinActivity", 50)
+
+    def slo_serving(ctx: RuleCtx):
+        h = ctx.hist("servlet.serving")
+        # fast = the current slot + the last closed one: the current
+        # slot alone is near-empty right after each rotation and would
+        # flap the qps floor mid-burn
+        frac_fast, n_fast = h.fraction_over(slo_ms, last=2)
+        frac_slow, n_slow = h.fraction_over(slo_ms)
+        qps_fast = n_fast / h.window_seconds(2)
+        ev = {"slo_ms": slo_ms, "qps_fast": round(qps_fast, 3),
+              "frac_over_fast": round(frac_fast, 4),
+              "frac_over_slow": round(frac_slow, 4),
+              "requests_windowed": n_slow}
+        if qps_fast < min_qps:
+            return OK, "below SLO traffic floor", ev
+        fast_burn = frac_fast / budget
+        slow_burn = frac_slow / budget
+        ev["fast_burn"] = round(fast_burn, 2)
+        ev["slow_burn"] = round(slow_burn, 2)
+        if fast_burn >= fast_crit and slow_burn >= slow_crit:
+            return CRITICAL, (
+                f"serving SLO burning {fast_burn:.1f}x budget (fast) / "
+                f"{slow_burn:.1f}x (slow): p95 objective {slo_ms}ms"), ev
+        if fast_burn >= 1.0 and slow_burn >= 1.0:
+            return WARN, (
+                f"serving error budget burning at {slow_burn:.1f}x "
+                f"sustainable rate"), ev
+        return OK, "within SLO", ev
+
+    _hits = 'yacy_device_serving_total{counter="rank_cache_hits"}'
+    _served = 'yacy_device_serving_total{counter="queries_served"}'
+    _stale = 'yacy_device_serving_total{counter="rank_cache_stale"}'
+    _epoch = "yacy_device_arena_epoch"
+    _stallkey = 'yacy_batch_timeouts_total{cause="worker_stall"}'
+    _qin = 'yacy_batcher_queue_depth{queue="incoming"}'
+    _qfl = 'yacy_batcher_queue_depth{queue="inflight"}'
+    _drops = "yacy_log_dropped_records_total"
+    _frontier = 'yacy_crawler_queue_depth{stack="local"}'
+    _fetches = "yacy_crawler_fetch_ms_count"
+
+    def cache_collapse(ctx: RuleCtx):
+        dq = ctx.delta(_served)
+        dh = ctx.delta(_hits)
+        tot_q = ctx.value(_served)
+        tot_h = ctx.value(_hits)
+        longterm = tot_h / tot_q if tot_q > 0 else 0.0
+        recent = dh / dq if dq > 0 else 0.0
+        ev = {"recent_hit_ratio": round(recent, 4),
+              "longterm_hit_ratio": round(longterm, 4),
+              "queries_in_window": int(dq)}
+        if dq < min_act or longterm < 0.2:
+            return OK, "cache not load-bearing / low activity", ev
+        if recent < 0.1 * longterm:
+            return CRITICAL, (
+                f"result-cache hit ratio collapsed: {recent:.0%} recent "
+                f"vs {longterm:.0%} lifetime"), ev
+        if recent < 0.25 * longterm:
+            return WARN, (
+                f"result-cache hit ratio degrading: {recent:.0%} recent "
+                f"vs {longterm:.0%} lifetime"), ev
+        return OK, "cache hit ratio steady", ev
+
+    def stale_spike(ctx: RuleCtx):
+        dq = ctx.delta(_served)
+        ds = ctx.delta(_stale)
+        de = ctx.delta(_epoch)
+        ratio = ds / dq if dq > 0 else 0.0
+        ev = {"stale_in_window": int(ds), "epoch_moves": int(de),
+              "stale_ratio": round(ratio, 4),
+              "queries_in_window": int(dq)}
+        if dq < min_act or ratio <= 0.2:
+            return OK, "stale rate nominal", ev
+        if de > 0:
+            return WARN, (
+                f"stale spike ({ratio:.0%}) during arena-epoch churn "
+                f"({int(de)} moves) — expected invalidation storm"), ev
+        return CRITICAL, (
+            f"stale rate {ratio:.0%} with NO epoch movement — "
+            f"unexplained cache invalidation"), ev
+
+    def backlog(ctx: RuleCtx):
+        depth = ctx.value(_qin) + ctx.value(_qfl)
+        before = (ctx.ago(_qin, ctx.trend_ticks)
+                  + ctx.ago(_qfl, ctx.trend_ticks))
+        ev = {"depth": int(depth), "depth_before": int(before),
+              "incoming": int(ctx.value(_qin)),
+              "inflight": int(ctx.value(_qfl))}
+        growing = depth > before
+        if depth >= backlog_crit and growing:
+            return CRITICAL, (
+                f"batcher backlog {int(depth)} and growing "
+                f"(was {int(before)})"), ev
+        if depth >= backlog_warn and growing:
+            return WARN, (
+                f"batcher queues growing: {int(before)} -> "
+                f"{int(depth)}"), ev
+        return OK, "queues draining", ev
+
+    def worker_stall(ctx: RuleCtx):
+        cur = ctx.value(_stallkey)
+        recent = cur - ctx.ago(_stallkey, stall_ticks)
+        ev = {"worker_stall_total": int(cur),
+              "new_in_window": int(recent)}
+        if recent > 0:
+            return CRITICAL, (
+                f"{int(recent)} worker_stall timeout(s) in the last "
+                f"{stall_ticks} ticks — a kernel call is wedged"), ev
+        return OK, "no recent stalls", ev
+
+    def log_drops(ctx: RuleCtx):
+        d = ctx.delta(_drops)
+        ev = {"dropped_in_window": int(d),
+              "dropped_total": int(ctx.value(_drops))}
+        if d >= drops_crit:
+            return CRITICAL, (
+                f"{int(d)} log records dropped in the window — the "
+                f"async log writer cannot keep up"), ev
+        if d > 0:
+            return WARN, f"{int(d)} log records dropped in the window", ev
+        return OK, "no log drops", ev
+
+    def frontier_starvation(ctx: RuleCtx):
+        def starving(i: int) -> bool:
+            # at tick `i` ago: frontier empty while that tick still
+            # fetched — the frontier isn't keeping the fetcher fed
+            return (ctx.ago(_frontier, i) == 0
+                    and ctx.ago(_fetches, i) - ctx.ago(_fetches, i + 1)
+                    > 0)
+        ev = {"frontier_local": int(ctx.value(_frontier)),
+              "fetches_in_window": int(ctx.delta(_fetches))}
+        # TWO consecutive starving ticks: a finished crawl legitimately
+        # drains the frontier to 0 while its last fetches land, but its
+        # fetching stops within one tick — only a crawl that KEEPS
+        # fetching against an empty frontier is starving
+        if ctx.ticks() >= 3 and starving(0) and starving(1):
+            return WARN, (
+                "crawler kept fetching across two ticks with an empty "
+                "local frontier — crawl starving"), ev
+        return OK, "frontier fed or crawl idle", ev
+
+    return [
+        Rule("slo_serving_p95",
+             f"servlet serving p95 <= {slo_ms}ms at >= {min_qps} qps "
+             "(fast <=60s / slow ~3min burn-rate windows)",
+             ("yacy_servlet_serving_ms_count",), slo_serving),
+        Rule("rank_cache_collapse",
+             "top-k result-cache hit ratio collapse vs lifetime",
+             (_hits, _served), cache_collapse),
+        Rule("stale_rate_spike",
+             "cache stale-rate spike judged against arena-epoch churn",
+             (_stale, _served, _epoch), stale_spike),
+        Rule("batcher_backlog",
+             "batcher incoming/in-flight queue growth trend",
+             (_qin, _qfl), backlog),
+        Rule("worker_stall",
+             "batcher worker_stall timeouts (wedged kernel call)",
+             (_stallkey,), worker_stall),
+        Rule("log_drops",
+             "async logging queue drops",
+             (_drops,), log_drops),
+        Rule("crawler_frontier_starvation",
+             "active crawl with an empty local frontier",
+             (_frontier, _fetches), frontier_starvation),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class HealthEngine:
+    """Owns the rule set, the snapshot ring, and the incident dumper.
+    Constructed cheaply at switchboard init; all work happens in
+    `tick()` (driven by the `15_health` busy thread, or directly by
+    tests/operators)."""
+
+    def __init__(self, sb, incidents_dir: str | None = None):
+        self.sb = sb
+        cfg = sb.config
+        self.rules = build_rules(cfg)
+        self.trend_ticks = cfg.get_int("health.trendTicks", 6)
+        self.cooldown_s = cfg.get_float("health.incidentCooldownS", 300.0)
+        self.snapshots: deque = deque(
+            maxlen=cfg.get_int("health.flightSnapshots", 240))
+        self.snapshot_dump_max = cfg.get_int(
+            "health.incidentSnapshotMax", 60)
+        self.states: dict[str, RuleState] = {
+            r.name: RuleState(since=time.time()) for r in self.rules}
+        self.incidents: deque = deque(maxlen=32)
+        self.incident_count = 0          # monotonic (the deque is a ring)
+        self.tick_count = 0
+        self.last_tick = 0.0
+        self._last_incident_ts = 0.0
+        self._lock = threading.Lock()
+        self._dir = incidents_dir
+        if incidents_dir:
+            os.makedirs(incidents_dir, exist_ok=True)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _exposition(self) -> str:
+        # bucket-free: no rule reads per-bucket samples, and rendering
+        # ~100 bucket lines per family each tick would dominate the
+        # tick's cost (the <2% --health-overhead budget)
+        from ..server.servlets.monitoring import prometheus_text
+        return prometheus_text(self.sb, include_buckets=False)
+
+    def tick(self, now: float | None = None) -> str:
+        """One evaluation pass: snapshot `/metrics`, evaluate every
+        rule, dump an incident on an ok/warn->critical edge (rate
+        limited).  Returns the overall state."""
+        now = time.time() if now is None else now
+        # idle histogram families must not freeze their windows (a
+        # sticky SLO verdict after traffic stops): the tick drives
+        # rotation for whatever recording's lazy rotation missed
+        histogram.rotate_due()
+        # bucket-free exposition: the ring (and incident dumps) keep the
+        # _sum/_count + counter/gauge granularity
+        snap = parse_exposition(self._exposition())
+        with self._lock:
+            self.snapshots.append((now, snap))
+            ctx = RuleCtx(list(self.snapshots), self.trend_ticks)
+            entered_critical = []
+            for rule in self.rules:
+                try:
+                    state, cause, ev = rule.evaluate(ctx)
+                except Exception as e:  # a broken rule must be VISIBLE
+                    state, cause, ev = WARN, f"rule error: {e!r}", {}
+                st = self.states[rule.name]
+                if state != st.state:
+                    if state == CRITICAL:
+                        entered_critical.append(rule.name)
+                    st.since = now
+                st.state, st.cause, st.evidence = state, cause, ev
+            self.tick_count += 1
+            self.last_tick = now
+            if entered_critical and \
+                    now - self._last_incident_ts >= self.cooldown_s:
+                self._last_incident_ts = now
+                self._dump_incident(now, entered_critical)
+        return self.overall()
+
+    def tick_job(self) -> bool:
+        """BusyThread adapter: busy pacing while the node is unhealthy."""
+        return self.tick() != OK
+
+    def overall(self) -> str:
+        worst = max((_SEVERITY[s.state] for s in self.states.values()),
+                    default=0)
+        return [OK, WARN, CRITICAL][worst]
+
+    def status_value(self) -> int:
+        """0 ok / 1 warn / 2 critical — the `health_status` gauge."""
+        return _SEVERITY[self.overall()]
+
+    def rule_table(self) -> list:
+        """(name, description, state, cause, since, evidence) rows for
+        the servlet and the exposition."""
+        return [(r.name, r.description, self.states[r.name])
+                for r in self.rules]
+
+    # -- hygiene -------------------------------------------------------------
+
+    def undefined_series(self) -> list:
+        """Rule series references that do NOT resolve against the live
+        exposition — must be empty (the no-dead-rules build gate)."""
+        keys = set(parse_exposition(self._exposition()))
+        missing = []
+        for r in self.rules:
+            for s in r.series:
+                if s not in keys:
+                    missing.append(f"{r.name}: {s}")
+        return missing
+
+    # -- flight recorder -----------------------------------------------------
+
+    def _dump_incident(self, now: float, entered: list) -> None:
+        """Serialize the ring + firing rules + exemplars + recent traces
+        as one JSONL incident (called under `_lock`, edge-triggered and
+        rate-limited by the caller)."""
+        lines = [json.dumps({
+            "kind": "incident", "ts": round(now, 3),
+            "entered_critical": entered,
+            "rules": [{
+                "name": name, "state": st.state, "cause": st.cause,
+                "since": round(st.since, 3), "evidence": st.evidence,
+            } for name, _d, st in self.rule_table()],
+        })]
+        snaps = list(self.snapshots)[-self.snapshot_dump_max:]
+        for ts, samples in snaps:
+            lines.append(json.dumps({
+                "kind": "snapshot", "ts": round(ts, 3),
+                "series": samples}))
+        for h in histogram.all_histograms():
+            for ex in h.snapshot()["exemplars"]:
+                if ex is not None:
+                    lines.append(json.dumps({
+                        "kind": "exemplar", "family": h.name,
+                        "trace_id": ex[0], "value_ms": round(ex[1], 3),
+                        "ts": round(ex[2], 3)}))
+        for t in tracing.traces(20):
+            lines.append(json.dumps({"kind": "trace", **t.to_json()}))
+        body = "\n".join(lines) + "\n"
+        name = f"incident-{int(now)}-{entered[0]}.jsonl"
+        path = None
+        if self._dir:
+            path = os.path.join(self._dir, name)
+            try:
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(body)
+            except OSError:
+                path = None   # a full disk must not kill the tick; the
+                # in-memory copy below still serves the servlet download
+        self.incident_count += 1
+        self.incidents.append({
+            "name": name, "ts": now, "rules": list(entered),
+            "path": path, "body": body})
+
+    def incident_body(self, name: str) -> str | None:
+        """Download surface: by registry name only (never a caller
+        path — no traversal)."""
+        for inc in self.incidents:
+            if inc["name"] == name:
+                return inc["body"]
+        return None

@@ -41,6 +41,8 @@ from collections import OrderedDict
 from contextvars import ContextVar
 from dataclasses import dataclass, field
 
+from . import histogram
+
 # wire header carrying the trace id between peers (parsed in
 # server/httpd.py for HTTP, peers/javawire.py part "xtrace" for the
 # Java wire, payload key "_trace" for the in-band transports)
@@ -150,6 +152,14 @@ def _register(trace_id: str, root_name: str) -> TraceRecord:
 
 def _record(trace_id: str, span: Span) -> None:
     global dropped_spans
+    # every completed span ALSO lands in the windowed histogram for its
+    # name, carrying its trace id as the exemplar — the one wiring point
+    # that gives every traced wall (servlet roots, StageTimer bridge
+    # spans, batcher spans, kernel emits, remote segments) a
+    # distribution on /metrics with a link back to the waterfall
+    # (ISSUE 4).  Recorded even when the ring drops the span: the
+    # histogram measures the workload, the ring retains evidence.
+    histogram.observe(span.name, span.dur_ms, trace_id)
     with _lock:
         rec = _ring.get(trace_id)
         if rec is None:
@@ -164,6 +174,23 @@ def _record(trace_id: str, span: Span) -> None:
 
 
 # -- context -----------------------------------------------------------------
+
+# trace id of the most recent ROOT span completed on this context: lets
+# a caller that wraps traced work (httpd's servlet dispatch wall) stamp
+# its histogram exemplar with the request's trace even though the trace
+# closed inside the callee.  Per-context (thread-per-request), cleared
+# by the wrapper before dispatch.
+_last_root: ContextVar = ContextVar("yacy_last_root_trace", default=None)
+
+
+def last_trace_id() -> str | None:
+    """Trace id of the most recent root span completed on this context."""
+    return _last_root.get()
+
+
+def clear_last_trace_id() -> None:
+    _last_root.set(None)
+
 
 def current() -> tuple[str, str] | None:
     """The active (trace_id, span_id), or None."""
@@ -233,6 +260,8 @@ class _LiveSpan:
         _record(self._tid, Span(
             self._sid, self._parent, self._name, self._ts,
             (time.perf_counter() - self._t0) * 1000.0, self._attrs))
+        if self._root:
+            _last_root.set(self._tid)
         if self._end_trace:
             with _lock:
                 rec = _ring.get(self._tid)
@@ -409,45 +438,11 @@ def export_jsonl(n: int = 50) -> str:
     return "\n".join(json.dumps(t.to_json()) for t in traces(n))
 
 
-def _pctl(sv: list, q: float) -> float:
-    if not sv:
-        return 0.0
-    return sv[min(len(sv) - 1, int(len(sv) * q))]
-
-
-# request wrappers that cover (nearly) the whole request wall without
-# being a stage themselves: excluded from tail dominance even when they
-# appear as child spans (switchboard.search nests under servlet roots)
-WRAPPER_SPANS = frozenset({"switchboard.search"})
-
-
-def stage_summary(recs: list[TraceRecord] | None = None,
-                  exclude_roots: tuple = ("pipeline.index",)) -> dict:
-    """Per-stage p50/p95 over the retained traces plus the
-    tail-dominant stage — the stage whose p95 wall is largest, i.e.
-    where the slow quantile of requests actually goes. BASELINE.md:
-    latency claims must name this stage.
-
-    `exclude_roots` drops whole trace CLASSES from the aggregation —
-    by default the per-document indexing traces, whose index.* stages
-    would otherwise skew a search-latency verdict (different
-    workload). Pass `exclude_roots=()` for the all-workload view."""
-    if recs is None:
-        recs = traces(MAX_TRACES)
-    recs = [r for r in recs if r.root_name not in exclude_roots]
-    by_name: dict[str, list] = {}
-    for rec in recs:
-        for s in rec.spans:
-            by_name.setdefault(s.name, []).append(s.dur_ms)
-    out = {}
-    for name, walls in by_name.items():
-        walls.sort()
-        out[name] = {"count": len(walls),
-                     "p50_ms": round(_pctl(walls, 0.50), 3),
-                     "p95_ms": round(_pctl(walls, 0.95), 3)}
-    # root spans and request wrappers cover their children; exclude
-    # them from dominance so the verdict names an actual STAGE
-    roots = {rec.root_name for rec in recs} | set(WRAPPER_SPANS)
-    inner = {k: v for k, v in out.items() if k not in roots}
-    tail = max(inner, key=lambda k: inner[k]["p95_ms"]) if inner else ""
-    return {"stages": out, "tail_dominant_stage": tail}
+# the one nearest-rank convention across the observability layer lives
+# in utils/histogram.py; this alias survives for the callers that
+# learned it here (profiler, bench).  The per-stage p50/p95 summary
+# (formerly stage_summary, a full ring walk per call) lives in
+# histogram.stage_table now: every span feeds the windowed histograms
+# at record time, so the table is maintained incrementally and covers
+# untraced work too.
+_pctl = histogram.pctl
