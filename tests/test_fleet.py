@@ -1,0 +1,717 @@
+"""Coordinator-free fleet observability (ISSUE 5): gossiped metric
+digests riding the existing peer exchanges, mergeable mesh-wide
+percentiles, fleet-level health rules, cross-peer trace assembly, the
+Network_Health_p surface and the DATA/HEALTH retention cap.
+
+The acceptance shape: a 3-node loopback mesh where each node digests
+DIFFERENT windowed count vectors; the mesh-wide p95 computed from
+merged digests on ANY node equals the p95 over the union of the three
+raw vectors EXACTLY (merge is lossless by construction), and an
+injected slow peer trips the peer-outlier fleet rule, naming that
+peer's seed hash in the flight-recorder incident file."""
+
+import json
+import urllib.request
+
+import pytest
+
+from yacy_search_server_tpu.peers import javawire as jw
+from yacy_search_server_tpu.peers.node import P2PNode
+from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+from yacy_search_server_tpu.server.objects import ServerObjects
+from yacy_search_server_tpu.switchboard import Switchboard
+from yacy_search_server_tpu.utils import fleet as F
+from yacy_search_server_tpu.utils import histogram as hg
+from yacy_search_server_tpu.utils import tracing
+from yacy_search_server_tpu.utils.health import parse_exposition
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observability():
+    hg.reset()
+    hg.set_enabled(True)
+    tracing.set_enabled(True)
+    tracing.clear()
+    yield
+    hg.reset()
+    hg.set_enabled(True)
+    tracing.set_enabled(True)
+    tracing.clear()
+
+
+def _vec(ms_to_count: dict) -> list:
+    """Synthetic windowed bucket-count vector: {latency_ms: count}."""
+    v = [0] * hg.N_BUCKETS
+    for ms, c in ms_to_count.items():
+        v[hg.bucket_index(ms)] += c
+    return v
+
+
+def _gossip_now(node):
+    """Make this node's gossip eager + deterministic for tests."""
+    node.sb.fleet.send_interval_s = 0.0
+    node.sb.fleet.render_ttl_s = 0.0
+
+
+# -- sparse codec (the digest wire form) -------------------------------------
+
+def test_sparse_counts_roundtrip_lossless():
+    v = _vec({0.5: 3, 5.0: 1000, 250.0: 7, 60_000.0: 2})
+    sp = hg.counts_to_sparse(v)
+    assert hg.counts_from_sparse(sp) == v
+    # empty vector -> empty sparse -> zeros back
+    assert hg.counts_from_sparse(hg.counts_to_sparse([0] * hg.N_BUCKETS)) \
+        == [0] * hg.N_BUCKETS
+
+
+def test_sparse_decode_is_tolerant():
+    assert hg.counts_from_sparse(None) is None
+    assert hg.counts_from_sparse("junk") is None
+    assert hg.counts_from_sparse({"i": [1, 2], "c": [3]}) is None
+    assert hg.counts_from_sparse({"i": [1], "c": [-5]}) is None
+    assert hg.counts_from_sparse({"i": [1], "c": ["x"]}) is None
+    # a FUTURE grid with more buckets clamps into this build's edge
+    # bucket instead of failing the merge (version-skew tolerance)
+    got = hg.counts_from_sparse({"i": [10_000], "c": [4]})
+    assert got is not None and got[hg.N_BUCKETS - 1] == 4
+
+
+# -- digest render -----------------------------------------------------------
+
+def test_digest_renders_all_fields_within_budget(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        for fam in F.DIGEST_FAMILIES:
+            for _ in range(50):
+                hg.observe(fam, 12.0)
+        sb.fleet.render_ttl_s = 0.0
+        d = sb.fleet.render()
+        assert d["v"] == F.DIGEST_VERSION
+        assert set(F.DIGEST_FAMILIES) == set(d["hist"])
+        assert d["rules"] and "worker_stall" in d["rules"]
+        assert 0 < sb.fleet.last_digest_bytes <= sb.fleet.byte_budget
+        # seq is monotonic across renders
+        assert sb.fleet.render()["seq"] > d["seq"]
+    finally:
+        sb.close()
+
+
+def test_digest_over_budget_trims_families_not_the_wire(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        for fam in F.DIGEST_FAMILIES:
+            for i in range(hg.N_BUCKETS - 1):
+                h = hg.histogram(fam)
+                h.counts[i] += 10 ** 9       # worst-case dense vectors
+                h._win[h._wi][i] += 10 ** 9
+        sb.fleet.render_ttl_s = 0.0
+        sb.fleet.byte_budget = 512
+        d = sb.fleet.render()
+        assert sb.fleet.last_digest_bytes <= 512
+        assert d.get("trimmed") == 1
+        assert len(d["hist"]) < len(F.DIGEST_FAMILIES)
+    finally:
+        sb.close()
+
+
+def test_no_dead_digest_fields_every_field_resolves_on_metrics(tmp_path):
+    """ISSUE 5 hygiene satellite (mirrors the no-dead-rules gate): every
+    field a digest emits must map to a series on the local /metrics
+    exposition — a dead field is wire tax on every peer exchange."""
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        prometheus_text)
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        for fam in F.DIGEST_FAMILIES:
+            hg.observe(fam, 5.0)
+        sb.fleet.render_ttl_s = 0.0
+        d = sb.fleet.render()
+        mapping = F.digest_series(d)
+        # every digest field is covered by the mapping
+        for field in ("health", "epoch"):
+            assert field in mapping
+        for fam in d["hist"]:
+            assert f"hist.{fam}" in mapping
+        for rule in d["rules"]:
+            assert f"rules.{rule}" in mapping
+        samples = parse_exposition(prometheus_text(sb))
+        missing = [f"{field} -> {series}"
+                   for field, series in mapping.items()
+                   if series not in samples]
+        assert not missing, (
+            "digest fields with no /metrics series:\n  "
+            + "\n  ".join(missing))
+    finally:
+        sb.close()
+
+
+# -- ingest: version-skew tolerance ------------------------------------------
+
+def test_ingest_tolerates_skew_and_rejects_junk(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        fl = sb.fleet
+        fl.my_hash = "MYOWNHASH000"
+        base = {"v": 99, "peer": "PEERAAAA0001", "seq": 1, "ts": 1e9,
+                "hist": {"servlet.serving":
+                         hg.counts_to_sparse(_vec({10.0: 40}))},
+                "rules": {"worker_stall": 0, "rule_from_the_future": 1},
+                "health": 0,
+                "field_from_the_future": {"x": 1}}    # unknown: ignored
+        assert fl.ingest(base)
+        rows = fl.peer_rows()
+        assert len(rows) == 1 and rows[0]["hash"] == "PEERAAAA0001"
+        # missing families are ABSENT, not zero: no percentile invented
+        assert rows[0]["quantiles"]["dht.transfer"] is None
+        assert rows[0]["quantiles"]["servlet.serving"] is not None
+        # merged view: the absent family contributes nothing
+        assert sum(fl.merged_counts("dht.transfer")) == \
+            sum(fl.local_counts("dht.transfer"))
+        # replayed/out-of-order digests are dropped
+        assert not fl.ingest(dict(base))
+        # malformed hist family dropped individually, digest survives
+        newer = dict(base)
+        newer["seq"] = 2
+        newer["hist"] = {"servlet.serving": "garbage",
+                         "kernel.device":
+                         hg.counts_to_sparse(_vec({3.0: 5}))}
+        assert fl.ingest(newer)
+        row = fl.peer_rows()[0]
+        assert row["quantiles"]["servlet.serving"] is None
+        assert row["quantiles"]["kernel.device"] is not None
+        # rejected outright: no peer hash / own reflection / non-dict
+        assert not fl.ingest({"v": 1, "seq": 3})
+        assert not fl.ingest({"v": 1, "peer": "MYOWNHASH000", "seq": 3})
+        assert not fl.ingest("junk")
+        # ...and a forged far-future ts (anti-lockout: a genuine
+        # digest's fresh ts must always beat any ACCEPTED prior ts, so
+        # a spoofer cannot wedge the replay gate against the victim)
+        import time as _time
+        forged = dict(base)
+        forged["seq"] = 10 ** 9
+        forged["ts"] = _time.time() + 10 ** 6
+        assert not fl.ingest(forged)
+        # a victim's genuine newer-ts digest still lands after a
+        # same-peer spoof with inflated seq — even one whose ts sits
+        # just INSIDE the skew window (accepted ts is clamped to the
+        # receiver's clock, so a later genuine ts always beats it)
+        spoof = dict(base)
+        spoof["seq"] = 2 ** 31
+        spoof["ts"] = _time.time() + F.MAX_TS_SKEW_S - 1.0
+        assert fl.ingest(spoof)
+        _time.sleep(0.01)
+        genuine = dict(base)
+        genuine["seq"] = 3
+        genuine["ts"] = _time.time()
+        assert fl.ingest(genuine)
+        assert fl.ignored_count >= 5
+    finally:
+        sb.close()
+
+
+def test_stale_digests_evicted(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        fl = sb.fleet
+        fl.stale_s = 0.0
+        assert fl.ingest({"v": 1, "peer": "PEERBBBB0002", "seq": 1,
+                          "ts": 1e9})
+        import time
+        time.sleep(0.01)
+        assert fl.fresh() == []          # aged out of the mesh view
+        assert fl.peer_rows() == []
+    finally:
+        sb.close()
+
+
+# -- the 3-node loopback acceptance ------------------------------------------
+
+@pytest.fixture
+def trio(tmp_path):
+    net = LoopbackNetwork()
+    nodes = []
+    for name in ("alpha", "beta", "gamma"):
+        port = 8000 + sum(name.encode()) % 1000
+        n = P2PNode(name, net, data_dir=str(tmp_path / name), port=port,
+                    partition_exponent=2, redundancy=1)
+        _gossip_now(n)
+        nodes.append(n)
+    yield net, nodes
+    for n in nodes:
+        n.close()
+
+
+VEC_FAST_A = {1.0: 500, 5.0: 500}
+VEC_FAST_B = {2.0: 700, 8.0: 300}
+VEC_SLOW_C = {2000.0: 100}
+
+
+def _wire_counts(trio_nodes):
+    """Give each co-hosted node its OWN windowed count vectors (the
+    histogram registry is process-global, so without this seam all
+    three loopback nodes would digest identical counts) and gossip
+    them through real hello exchanges."""
+    a, b, c = trio_nodes
+    vecs = {id(a): _vec(VEC_FAST_A), id(b): _vec(VEC_FAST_B),
+            id(c): _vec(VEC_SLOW_C)}
+    for n in trio_nodes:
+        v = vecs[id(n)]
+        n.sb.fleet.set_local_counts_fn(
+            lambda fam, _v=v: _v if fam == "servlet.serving" else [])
+    for n in trio_nodes:
+        n.bootstrap([m.seed for m in trio_nodes if m is not n])
+        n.ping()
+    for n in trio_nodes:
+        n.ping()
+    return [_vec(VEC_FAST_A), _vec(VEC_FAST_B), _vec(VEC_SLOW_C)]
+
+
+def test_mesh_percentiles_from_merged_digests_are_exact(trio):
+    """ISSUE 5 acceptance: the mesh-wide p95 any node computes from
+    merged digests equals the p95 over the three nodes' raw count
+    vectors EXACTLY — the merge is lossless by construction."""
+    _net, nodes = trio
+    raw = _wire_counts(nodes)
+    union = hg.merge_counts(raw)
+    for q in (0.50, 0.95, 0.99):
+        expected = hg.percentile_from_counts(union, q)
+        for n in nodes:
+            # every node holds 2 peer digests + its own counts
+            assert len(n.sb.fleet.fresh()) == 2, n.seed.name
+            got = n.sb.fleet.mesh_percentile("servlet.serving", q)
+            assert got == expected, (n.seed.name, q)
+    # and the merged vectors themselves agree bucket-for-bucket
+    for n in nodes:
+        assert n.sb.fleet.merged_counts("servlet.serving") == union
+
+
+def test_slow_peer_trips_outlier_rule_and_names_it_in_incident(
+        trio, tmp_path):
+    """ISSUE 5 acceptance: the injected slow peer (gamma) exceeds the
+    merged p95 by the configured factor; the peer-outlier fleet rule
+    goes critical on ANY other node and the flight-recorder incident
+    names gamma's seed hash."""
+    _net, nodes = trio
+    a, _b, c = nodes
+    _wire_counts(nodes)
+    gamma_hash = c.seed.hash.decode("ascii")
+    assert a.sb.health.tick() == "critical"
+    st = a.sb.health.states["fleet_peer_outlier"]
+    assert st.state == "critical"
+    assert gamma_hash in st.cause
+    assert st.evidence["outlier_peer"] == gamma_hash
+    # the incident file names the dragging peer
+    files = sorted((tmp_path / "alpha" / "HEALTH").glob(
+        "incident-*fleet_peer_outlier*.jsonl"))
+    assert files, "no fleet_peer_outlier incident dumped"
+    body = files[0].read_text()
+    assert gamma_hash in body
+    head = json.loads(body.splitlines()[0])
+    assert "fleet_peer_outlier" in head["entered_critical"]
+    # the fleet gauges back the rule on /metrics
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        prometheus_text)
+    samples = parse_exposition(prometheus_text(a.sb))
+    assert samples["yacy_fleet_peers"] == 2.0
+    key = ('yacy_fleet_merged_latency_ms{family="servlet.serving",'
+           'quantile="p95"}')
+    assert samples[key] > 0
+
+
+def test_fleet_rules_ok_without_peers(tmp_path):
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        sb.health.tick()
+        for name in ("fleet_slo_serving", "fleet_peer_outlier",
+                     "fleet_critical_peers"):
+            st = sb.health.states[name]
+            assert st.state == "ok", name
+            assert "no fleet peers" in st.cause
+        assert not sb.health.undefined_series()
+    finally:
+        sb.close()
+
+
+def test_fleet_critical_peers_rule_reads_digest_rule_states(trio):
+    _net, nodes = trio
+    a, _b, c = nodes
+    _wire_counts(nodes)
+    # gamma's NEXT digest reports a wedged kernel; deliver it to alpha
+    import time as _time
+    gamma_hash = c.seed.hash.decode("ascii")
+    sick = {"v": 1, "peer": gamma_hash, "seq": 10 ** 6,
+            "ts": _time.time(),
+            "rules": {"worker_stall": 2}, "health": 2}
+    assert a.sb.fleet.ingest(sick)
+    a.sb.health.tick()
+    st = a.sb.health.states["fleet_critical_peers"]
+    assert st.state == "critical"
+    assert "worker_stall" in st.cause
+    assert gamma_hash in st.evidence["names"]
+
+
+def test_outlier_rule_uses_leave_one_out_baseline(tmp_path):
+    """A HIGH-traffic outlier must not mask itself: when the slow peer
+    contributes half the mesh samples, its samples set the merged p95
+    (local/merged ~1x), but the rule judges it against the REST of the
+    mesh and still fires, naming the peer."""
+    import time as _time
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        fl = sb.fleet
+        fl.my_hash = "SELFAAAA0001"
+        fast = _vec({2.0: 1000})
+        fl.set_local_counts_fn(
+            lambda fam: fast if fam == "servlet.serving" else [])
+        slow = _vec({2000.0: 1000})       # 50% of the merged samples
+        assert fl.ingest({"v": 1, "peer": "SLOWCCCC0003", "seq": 1,
+                          "ts": _time.time(),
+                          "hist": {"servlet.serving":
+                                   hg.counts_to_sparse(slow)}})
+        merged = fl.merged_counts("servlet.serving")
+        slow_p95 = hg.percentile_from_counts(slow, 0.95)
+        # the masking regime: the outlier's own p95 IS the merged p95
+        assert slow_p95 <= 3.0 * hg.percentile_from_counts(merged, 0.95)
+        sb.health.tick()
+        st = sb.health.states["fleet_peer_outlier"]
+        assert st.state == "critical"
+        assert st.evidence["outlier_peer"] == "SLOWCCCC0003"
+        assert st.evidence["rest_p95_ms"] < st.evidence["outlier_p95_ms"]
+    finally:
+        sb.close()
+
+
+def test_failed_rpc_releases_digest_rate_limit_slot(trio):
+    """outgoing_digest charges the per-peer rate-limit slot BEFORE the
+    RPC runs; a digest attached to a call that then failed never
+    arrived, so the slot is released and the next successful exchange
+    re-sends instead of leaving the peer stale for a send interval."""
+    net, nodes = trio
+    a, b, _c = nodes
+    fl = a.sb.fleet
+    fl.send_interval_s = 100.0             # make the slot observable
+    net.unregister(b.seed.hash)            # b drops off the wire
+    ok, _reply = a.protocol.hello(b.seed)
+    assert not ok
+    # the failed call's slot was rolled back: the digest is offered
+    # again immediately (charging the slot anew)
+    assert fl.outgoing_digest(b.seed.hash) is not None
+    # and the recharged slot rate-limits as usual
+    assert fl.outgoing_digest(b.seed.hash) is None
+
+
+# -- gossip rides every transport --------------------------------------------
+
+def test_digest_gossip_over_real_http_sockets(tmp_path):
+    """The digest survives the JSON-over-HTTP wire: two nodes on real
+    sockets exchange digests inside the ordinary hello ping."""
+    from yacy_search_server_tpu.peers.transport import HttpTransport
+    nodes = []
+    for name in ("fleethttp-a", "fleethttp-b"):
+        t = HttpTransport(timeout_s=10.0)
+        n = P2PNode(name, t, data_dir=str(tmp_path / name),
+                    partition_exponent=1, redundancy=1)
+        _gossip_now(n)
+        n.serve_http()
+        nodes.append(n)
+    a, b = nodes
+    try:
+        hg.observe("servlet.serving", 42.0)
+        a.bootstrap([b.seed])
+        b.bootstrap([a.seed])
+        a.ping()
+        rows = {r["hash"] for r in a.sb.fleet.peer_rows()}
+        assert b.seed.hash.decode("ascii") in rows
+        rows_b = {r["hash"] for r in b.sb.fleet.peer_rows()}
+        assert a.seed.hash.decode("ascii") in rows_b
+    finally:
+        for n in nodes:
+            n.close()
+
+
+def test_digest_part_rides_the_java_wire(tmp_path):
+    """The javawire `xdigest` part round-trips: part codec, client
+    attachment, and ingest by the httpd Java-hello branch."""
+    d = {"v": 1, "peer": "JAVAPEER0001", "seq": 3, "ts": 1e9,
+         "hist": {"servlet.serving":
+                  hg.counts_to_sparse(_vec({7.0: 9}))}}
+    # codec round trip
+    part = jw.encode_digest_part(d)
+    assert jw.decode_digest_part(part) == d
+    assert jw.decode_digest_part("not json") is None
+    assert jw.decode_digest_part("[1,2]") is None
+    # client attaches the part when a provider is wired
+    seen = {}
+
+    def fake_post(url, body, ctype):
+        seen.update(jw.multipart_decode(body, ctype))
+        return jw.table_encode({"message": "ok"})
+
+    from yacy_search_server_tpu.peers.seed import Seed
+    client = jw.JavaWireClient(Seed(b"AAAAbbbbCCCC", name="me"),
+                               fake_post,
+                               digest_provider=lambda _t: d)
+    client.hello("127.0.0.1", 1)
+    assert jw.decode_digest_part(seen[jw.DIGEST_PART]) == d
+    # ...and a real httpd ingests it on the Java hello branch
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    net = LoopbackNetwork()
+    b_node = P2PNode("javafleet-b", net, data_dir=str(tmp_path / "b"))
+    srv = YaCyHttpServer(b_node.sb, port=0,
+                         peer_server=b_node.server).start()
+    try:
+        def http_post(url, body, ctype):
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": ctype})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.read()
+        a_seed = Seed(b"JAVAPEER0001", name="javapeer")
+        wire = jw.JavaWireClient(a_seed, http_post,
+                                 digest_provider=lambda _t: d)
+        out = wire.hello("127.0.0.1", srv.port)
+        assert out is not None
+        rows = {r["hash"] for r in b_node.sb.fleet.peer_rows()}
+        assert "JAVAPEER0001" in rows
+    finally:
+        srv.close()
+        b_node.close()
+
+
+# -- cross-peer trace assembly -----------------------------------------------
+
+def test_tracefetch_endpoint_serves_segments_by_trace_id(trio):
+    _net, (a, b, _c) = trio
+    _wire_counts((a, b, _c))
+    with tracing.trace("demo.root") as r:
+        tid = r.ctx[0]
+        tracing.emit("demo.stage", 4.0)
+    ok, reply = a.protocol.fetch_trace(b.seed, tid)
+    assert ok
+    assert reply["peer"] == b.seed.hash.decode("ascii")
+    assert {s["name"] for s in reply["spans"]} == \
+        {"demo.root", "demo.stage"}
+    # junk / unknown trace ids answer empty, never crash
+    ok, reply = a.protocol.fetch_trace(b.seed, "???")
+    assert ok and reply["spans"] == []
+    ok, reply = a.protocol.fetch_trace(b.seed, "feedfacefeed")
+    assert ok and reply["spans"] == []
+
+
+def test_merge_remote_spans_remaps_colliding_sids():
+    """Cross-process semantics: two nodes both name spans s1, s2...; a
+    fetched segment whose sids collide with different local spans is
+    renamed under a source prefix with parent links kept consistent —
+    and re-merging the same segment adds nothing (idempotence)."""
+    with tracing.trace("origin.root") as r:
+        tid = r.ctx[0]
+    local_sid = tracing.get_trace(tid).spans[0].sid
+    foreign = [
+        {"sid": local_sid, "parent": "", "name": "peer.search",
+         "ts": 1000.0, "dur_ms": 9.0, "attrs": {"peer": "REMOTEPEER01"}},
+        {"sid": "zz9", "parent": local_sid, "name": "search.devrank",
+         "ts": 1000.001, "dur_ms": 5.0},
+    ]
+    assert tracing.merge_remote_spans(tid, foreign, "REMOTEPEER01") == 2
+    rec = tracing.get_trace(tid)
+    by_name = {s.name: s for s in rec.spans}
+    remote_root = by_name["peer.search"]
+    assert remote_root.sid != local_sid            # renamed, no clobber
+    assert by_name["search.devrank"].parent == remote_root.sid
+    assert remote_root.attrs["fetched_from"] == "REMOTEPEER01"
+    n_before = len(rec.spans)
+    assert tracing.merge_remote_spans(tid, foreign, "REMOTEPEER01") == 0
+    assert len(tracing.get_trace(tid).spans) == n_before
+    # a REPEAT fetch carrying a NEW child that parents on the colliding
+    # sid must follow the earlier rename, not attach to the unrelated
+    # local span that owns the raw sid
+    later = foreign + [{"sid": "zz10", "parent": local_sid,
+                        "name": "search.fusion_remote",
+                        "ts": 1000.002, "dur_ms": 1.0}]
+    assert tracing.merge_remote_spans(tid, later, "REMOTEPEER01") == 1
+    by_name = {s.name: s for s in tracing.get_trace(tid).spans}
+    assert by_name["search.fusion_remote"].parent == remote_root.sid
+    # junk input never registers anything
+    assert tracing.merge_remote_spans("???", foreign, "x") == 0
+    assert tracing.merge_remote_spans(tid, "junk", "x") == 0
+
+
+def _doc(url, title, text):
+    from yacy_search_server_tpu.document.document import Document
+    return Document(url=url, title=title, text=text,
+                    mime_type="text/html", language="en")
+
+
+def test_assembled_waterfall_covers_all_responding_peers(trio):
+    """ISSUE 5 satellite: a traced resource=global search on the
+    originator, assembled via the tracefetch endpoint, yields a
+    waterfall with spans from ALL responding peers — and assembly is
+    idempotent (co-hosted rings share spans; nothing is duplicated)."""
+    _net, nodes = trio
+    a, b, c = nodes
+    for n in nodes:
+        n.bootstrap([m.seed for m in nodes if m is not n])
+        n.ping()
+    for n in nodes:
+        n.ping()
+    for i, n in enumerate((b, c)):
+        for j in range(6):
+            n.sb.index.store_document(_doc(
+                f"http://peer{i}.example/d{j}.html",
+                f"fleet doc {i}-{j}", "fleet assembly span spine " * 3))
+        n.sb.index.rwi.flush()
+    tracing.clear()
+    from yacy_search_server_tpu.server.servlets.yacysearch import respond
+    post = ServerObjects({"query": "fleet", "resource": "global"})
+    prop = respond({"ext": "json"}, post, a.sb)
+    assert prop.get("items", 0) or prop.get("found", 0)
+    recs = [r for r in tracing.traces(50)
+            if r.root_name == "servlet.yacysearch"]
+    assert len(recs) == 1
+    tid = recs[0].trace_id
+    n_before = len(recs[0].spans)
+    # the servlet's assemble affordance fetches every peer's segment
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        respond_trace)
+    tprop = respond_trace(
+        {"ext": "json"}, ServerObjects({"trace": tid, "assemble": "1"}),
+        a.sb)
+    assert tprop.get("assembled_spans") is not None
+    rec = tracing.get_trace(tid)
+    # no duplicates: co-hosted rings already share the remote spans, so
+    # assembly must recognize every fetched span as present
+    assert len(rec.spans) == n_before
+    assert tprop.get_int("spans", 0) == len(rec.spans)
+    remote = [s for s in rec.spans if s.name == "peer.search"]
+    peers_seen = {s.attrs.get("peer") for s in remote}
+    assert {b.seed.hash.decode("ascii"),
+            c.seed.hash.decode("ascii")} <= peers_seen
+    # the fan-out spans carry peer_hash: assemble_trace reads it back
+    # to target exactly the asked peers (never 16 arbitrary ones)
+    fanout = [s for s in rec.spans if s.name == "peers.remotesearch"]
+    assert {s.attrs.get("peer_hash") for s in fanout} >= \
+        {b.seed.hash.decode("ascii"), c.seed.hash.decode("ascii")}
+    # the assembled waterfall renders
+    png = respond_trace({"ext": "png"},
+                        ServerObjects({"trace": tid, "format": "png"}),
+                        a.sb)
+    assert png.raw_body[:8] == b"\x89PNG\r\n\x1a\n"
+
+
+def test_trace_segment_fetch_over_real_http(tmp_path):
+    """Real-HTTP variant of the segment fetch: the tracefetch RPC and
+    its span payload survive JSON serialization over a socket."""
+    from yacy_search_server_tpu.peers.transport import HttpTransport
+    nodes = []
+    for name in ("tracefetch-a", "tracefetch-b"):
+        t = HttpTransport(timeout_s=10.0)
+        n = P2PNode(name, t, data_dir=str(tmp_path / name),
+                    partition_exponent=1, redundancy=1)
+        n.serve_http()
+        nodes.append(n)
+    a, b = nodes
+    try:
+        a.bootstrap([b.seed])
+        b.bootstrap([a.seed])
+        a.ping()
+        with tracing.trace("http.segment") as r:
+            tid = r.ctx[0]
+            tracing.emit("search.devrank", 3.25, peer="x")
+        ok, reply = a.protocol.fetch_trace(b.seed, tid)
+        assert ok and reply["peer"] == b.seed.hash.decode("ascii")
+        names = {s["name"] for s in reply["spans"]}
+        assert {"http.segment", "search.devrank"} <= names
+        sp = next(s for s in reply["spans"]
+                  if s["name"] == "search.devrank")
+        assert sp["dur_ms"] == 3.25 and sp["attrs"]["peer"] == "x"
+    finally:
+        for n in nodes:
+            n.close()
+
+
+# -- Network_Health_p surface ------------------------------------------------
+
+def test_network_health_servlet_peer_table_and_merged_view(trio):
+    from yacy_search_server_tpu.server.servlets.health import (
+        respond_network_health)
+    _net, nodes = trio
+    a, b, c = nodes
+    _wire_counts(nodes)
+    prop = respond_network_health({"ext": "json"},
+                                  ServerObjects({"tick": "1"}), a.sb)
+    assert prop.get("my_hash") == a.seed.hash.decode("ascii")
+    assert prop.get_int("peers", 0) == 2
+    hashes = {prop.get(f"peers_{i}_hash") for i in range(2)}
+    assert hashes == {b.seed.hash.decode("ascii"),
+                      c.seed.hash.decode("ascii")}
+    for i in range(2):
+        assert prop.get_int(f"peers_{i}_seq", 0) >= 1
+        assert prop.get_int(f"peers_{i}_bytes", 0) > 0
+        assert float(prop.get(f"peers_{i}_age_s")) >= 0
+        # absent families show '-' (never fake zeros)
+        assert prop.get(f"peers_{i}_dht_transfer_p95") == "-"
+        assert prop.get(f"peers_{i}_servlet_serving_p95") != "-"
+    # merged-vs-local comparison rows with sparklines
+    fams = {prop.get(f"families_{i}_name")
+            for i in range(prop.get_int("families", 0))}
+    assert set(F.DIGEST_FAMILIES) == fams
+    i = [i for i in range(prop.get_int("families", 0))
+         if prop.get(f"families_{i}_name") == "servlet.serving"][0]
+    assert prop.get_int(f"families_{i}_mesh_count", 0) > \
+        prop.get_int(f"families_{i}_local_count", 0)
+    assert prop.get(f"families_{i}_mesh_spark")
+    # fleet rule table present
+    rn = prop.get_int("rules", 0)
+    names = {prop.get(f"rules_{i}_name") for i in range(rn)}
+    assert {"fleet_slo_serving", "fleet_peer_outlier",
+            "fleet_critical_peers"} <= names
+
+
+def test_network_health_servlet_without_fleet_table(tmp_path):
+    from yacy_search_server_tpu.server.servlets.health import (
+        respond_network_health)
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        sb.fleet = None
+        prop = respond_network_health({"ext": "json"},
+                                      ServerObjects({}), sb)
+        assert prop.get_int("peers", -1) == 0
+    finally:
+        sb.fleet = None
+        sb.close()
+
+
+# -- DATA/HEALTH retention cap (ISSUE 5 satellite) ---------------------------
+
+def test_incident_directory_keeps_newest_n_files(tmp_path):
+    import os
+    import time as _time
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        eng = sb.health
+        eng.incident_keep = 5
+        eng.cooldown_s = 0.0
+        inc_dir = tmp_path / "DATA" / "HEALTH"
+        # pre-existing old incidents (a long-lived node's directory)
+        inc_dir.mkdir(parents=True, exist_ok=True)
+        for i in range(8):
+            p = inc_dir / f"incident-{1000 + i}-old_rule.jsonl"
+            p.write_text("{}")
+            os.utime(p, (1000 + i, 1000 + i))
+        # a real dump triggers the prune
+        eng._last_incident_ts = 0.0
+        with eng._lock:
+            eng._dump_incident(_time.time(), ["worker_stall"])
+        files = sorted(f.name for f in inc_dir.glob("incident-*.jsonl"))
+        assert len(files) == 5
+        # the newest survive: the 4 youngest old files + the new dump
+        assert any("worker_stall" in f for f in files)
+        assert "incident-1000-old_rule.jsonl" not in files
+        assert "incident-1006-old_rule.jsonl" in files
+        # non-incident files are never touched
+        keep = inc_dir / "operator-notes.txt"
+        keep.write_text("mine")
+        with eng._lock:
+            eng._dump_incident(_time.time() + 1, ["worker_stall"])
+        assert keep.exists()
+    finally:
+        sb.close()

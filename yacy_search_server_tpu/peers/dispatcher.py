@@ -66,11 +66,16 @@ class Transmission:
         t0 = time.perf_counter()
         ok, reply = protocol.transfer_index(
             self.target, self.containers, self.metadata_rows)
+        wall_ms = (time.perf_counter() - t0) * 1000.0
         # DHT transfer wall -> windowed histogram (ISSUE 4): transfers
         # run on node background loops, so this site records directly
         # rather than through the span bridge
-        histogram.observe("dht.transfer",
-                          (time.perf_counter() - t0) * 1000.0)
+        histogram.observe("dht.transfer", wall_ms)
+        # fleet digests piggyback on the transferRWI chunks inside
+        # transfer_index (Protocol._call); the observed wall feeds the
+        # per-peer RTT column of the fleet table (ISSUE 5)
+        if ok and getattr(protocol, "fleet", None) is not None:
+            protocol.fleet.note_rtt(self.target.hash, wall_ms)
         try:
             pause = float(reply.get("pause", 0) or 0)
         except (TypeError, ValueError):

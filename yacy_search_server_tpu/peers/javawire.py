@@ -32,9 +32,11 @@ from __future__ import annotations
 
 import gzip as _gzip
 import hashlib
+import json as _json
 import secrets
 import time
 
+from ..utils import fleet as fleetdigest
 from ..utils import tracing
 from ..utils.base64order import enhanced_coder
 from .seed import Seed
@@ -44,6 +46,28 @@ from .seed import Seed
 # ignores unknown parts, and our inbound handlers do the same — the
 # tolerate-and-ignore contract, test_javawire)
 TRACE_PART = "xtrace"
+
+# multipart part carrying the fleet metric digest (ISSUE 5): the Java
+# wire's rendition of the in-band `_digest` payload key.  Same
+# tolerate-and-ignore contract — a real YaCy peer drops the unknown
+# part, and a malformed part decodes to None and is ignored.
+DIGEST_PART = "xdigest"
+
+
+def encode_digest_part(digest: dict) -> str:
+    """Digest dict -> the `xdigest` part value (compact JSON, the one
+    encoding utils/fleet shares across transports)."""
+    return fleetdigest.encode_digest(digest)
+
+
+def decode_digest_part(part: str):
+    """Tolerant decode of an `xdigest` part; None on malformed input
+    (the receiving hello handler ignores it like any unknown part)."""
+    try:
+        obj = _json.loads(part)
+    except (TypeError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
 
 # ---------------------------------------------------------------------------
 # crypt.simpleEncode / simpleDecode
@@ -290,11 +314,16 @@ class JavaWireClient:
 
     def __init__(self, my_seed: Seed, http_post,
                  network_name: str = "freeworld",
-                 network_magic: str = ""):
+                 network_magic: str = "", digest_provider=None):
         self.my_seed = my_seed
         self.http_post = http_post
         self.network_name = network_name
         self.network_magic = network_magic
+        # callable(target_hash | None) -> digest dict | None (normally
+        # FleetTable.outgoing_digest, so the Java wire honors the SAME
+        # per-peer rate limit as the JSON transports): when set, hellos
+        # carry the fleet digest as the xdigest part
+        self.digest_provider = digest_provider
 
     def hello(self, target_host: str, target_port: int,
               target_hash: str | None = None):
@@ -307,6 +336,10 @@ class JavaWireClient:
         parts["count"] = "20"
         parts["magic"] = "0"
         parts["seed"] = encode_seed(self.my_seed)
+        if self.digest_provider is not None:
+            d = self.digest_provider(target_hash)
+            if d:
+                parts[DIGEST_PART] = encode_digest_part(d)
         body, ctype = multipart_encode(parts)
         url = f"http://{target_host}:{target_port}/yacy/hello.html"
         try:

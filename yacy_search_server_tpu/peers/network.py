@@ -54,6 +54,12 @@ class Network:
             if ok:
                 reached += 1
         self.ping_rounds += 1
+        # fleet digests ride the hellos above (Protocol._call piggyback);
+        # the ping cycle is also the fleet table's staleness driver — a
+        # peer that stopped answering ages out of the merged mesh view
+        # on the same cadence it ages out of the seed directory
+        if self.protocol.fleet is not None:
+            self.protocol.fleet.evict_stale()
         return reached
 
     def bootstrap_from_seedlist(self, source: Seed) -> int:

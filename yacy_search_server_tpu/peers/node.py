@@ -60,7 +60,11 @@ class P2PNode:
         self.redundancy = redundancy
         self.news = NewsPool(data_dir)
         self.sb.news = self.news     # feed servlet reads the pool from sb
-        self.protocol = Protocol(self.seeddb, p2p_transport, news=self.news)
+        # fleet observability (ISSUE 5): the switchboard's fleet table
+        # learns this node's identity and rides every protocol exchange
+        self.sb.fleet.my_hash = self.seed.hash.decode("ascii", "replace")
+        self.protocol = Protocol(self.seeddb, p2p_transport,
+                                 news=self.news, fleet=self.sb.fleet)
         self.server = PeerServer(self.sb, self.seeddb,
                                  accept_remote_index=accept_remote_index,
                                  accept_remote_crawl=accept_remote_crawl,
@@ -265,6 +269,75 @@ class P2PNode:
         if secondary and rs.secondary_search():
             rs.join(timeout_s / 2)
         return asked
+
+    # -- cross-peer trace assembly (ISSUE 5) ----------------------------------
+
+    def assemble_trace(self, trace_id: str, max_peers: int = 16,
+                       timeout_s: float = 5.0) -> int:
+        """Fetch the remote segments of `trace_id` from active peers and
+        merge them into the local ring (Performance_Trace_p's assemble
+        affordance): the originator of a resource=global search renders
+        the FULL distributed waterfall instead of an opaque fan-out gap.
+        Fetches run CONCURRENTLY against a deadline (the RemoteSearch
+        fan-out discipline) so one slow/dead peer costs one timeout, not
+        a serial sum across the whole page load.  The peers the traced
+        search ACTUALLY asked come first (their hashes ride the
+        `peers.remotesearch` span attrs), so a large mesh never
+        exhausts `max_peers` on uninvolved nodes; remaining slots fall
+        back to active peers (remote segments can exist on peers whose
+        fan-out span was lost).  Returns the number of spans merged (0
+        when every peer's segment was already present — the idempotence
+        contract)."""
+        import threading
+
+        from ..utils import tracing
+        merged = [0]
+        lock = threading.Lock()
+
+        def fetch(seed):
+            ok, reply = self.protocol.fetch_trace(seed, trace_id)
+            if not ok:
+                return
+            spans = reply.get("spans")
+            src = reply.get("peer") or seed.hash.decode("ascii", "replace")
+            if spans:
+                n = tracing.merge_remote_spans(trace_id, spans, src)
+                with lock:
+                    merged[0] += n
+
+        targets: list = []
+        seen: set = set()
+        rec = tracing.get_trace(trace_id)
+        if rec is not None:
+            for s in rec.spans:
+                ph = s.attrs.get("peer_hash")
+                if not isinstance(ph, str):
+                    continue
+                seed = self.seeddb.get(ph.encode("ascii", "replace"))
+                if seed is not None and seed.hash not in seen:
+                    seen.add(seed.hash)
+                    targets.append(seed)
+        for seed in self.seeddb.active_seeds():
+            if len(targets) >= max_peers:
+                break
+            if seed.hash not in seen:
+                seen.add(seed.hash)
+                targets.append(seed)
+
+        threads = []
+        for seed in targets[:max_peers]:
+            th = threading.Thread(target=fetch, args=(seed,),
+                                  name=f"tracefetch-{seed.name}",
+                                  daemon=True)
+            th.start()
+            threads.append(th)
+        t_end = time.monotonic() + timeout_s
+        for th in threads:
+            left = t_end - time.monotonic()
+            if left <= 0:
+                break
+            th.join(left)
+        return merged[0]
 
     # -- HTTP face (DCN deployment) ------------------------------------------
 

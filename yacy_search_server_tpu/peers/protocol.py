@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..index.postings import NF, PostingsList
+from ..utils import fleet as fleetdigest
 from ..utils import tracing
 from .seed import Seed, SeedDB
 from .transport import PeerUnreachable, Transport
@@ -48,10 +49,12 @@ def decode_postings(table: dict) -> tuple[list[bytes], np.ndarray]:
 class Protocol:
     """Stateless client methods bound to (my seeddb, transport)."""
 
-    def __init__(self, seeddb: SeedDB, transport: Transport, news=None):
+    def __init__(self, seeddb: SeedDB, transport: Transport, news=None,
+                 fleet=None):
         self.seeddb = seeddb
         self.transport = transport
         self.news = news            # NewsPool | None (peers/news.py)
+        self.fleet = fleet          # FleetTable | None (utils/fleet.py)
 
     # -- plumbing ------------------------------------------------------------
 
@@ -64,18 +67,39 @@ class Protocol:
         tid = tracing.current_trace_id()
         if tid is not None and tracing.PAYLOAD_KEY not in payload:
             payload = {**payload, tracing.PAYLOAD_KEY: tid}
+        # fleet gossip piggyback (ISSUE 5): the metric digest rides the
+        # SAME exchanges the DHT already pays for — hello pings, remote
+        # searches, transferRWI chunks — per-peer rate-limited inside
+        # outgoing_digest so chunked transfers don't re-send it
+        dig = None
+        if self.fleet is not None and \
+                fleetdigest.PAYLOAD_KEY not in payload:
+            dig = self.fleet.outgoing_digest(target.hash)
+            if dig is not None:
+                payload = {**payload, fleetdigest.PAYLOAD_KEY: dig}
         try:
             reply = self.transport.rpc(target.hash, endpoint, payload)
         except PeerUnreachable:
+            # a digest attached to a failed call never arrived: release
+            # the per-peer rate-limit slot so the next successful call
+            # re-sends instead of leaving the peer stale for an interval
+            if dig is not None:
+                self.fleet.send_failed(target.hash)
             self.seeddb.disconnected(target.hash)
             return False, {}
         except Exception:
             # a crashing remote handler (HTTP 500 equivalent) is a failed
             # call, not a sender crash: callers rely on the False return to
             # re-enqueue in-flight index transfers instead of losing them
+            if dig is not None:
+                self.fleet.send_failed(target.hash)
             self.seeddb.disconnected(target.hash)
             return False, {}
         self.seeddb.connected(target)
+        if self.fleet is not None and isinstance(reply, dict):
+            d = reply.pop(fleetdigest.PAYLOAD_KEY, None)
+            if d is not None:
+                self.fleet.ingest(d)
         return True, reply
 
     # -- membership ----------------------------------------------------------
@@ -218,6 +242,13 @@ class Protocol:
                 return False, {}
             reply = {**reply, **reply2}
         return True, reply
+
+    def fetch_trace(self, target: Seed, trace_id: str) -> tuple[bool, dict]:
+        """Cross-peer trace assembly (ISSUE 5): pull the peer's retained
+        segment of a trace out of its ring by trace id (server side:
+        PeerServer.do_tracefetch).  The reply carries the answering
+        peer's hash so merged spans stay attributable."""
+        return self._call(target, "tracefetch", {"trace": trace_id})
 
     def idx(self, target: Seed) -> dict:
         """Peer index statistics (htroot/yacy/idx.java server side).

@@ -14,6 +14,7 @@ reference's "deadline + late-merge" paging behavior (SURVEY.md §7).
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 
 from ..parallel.distribution import Distribution
@@ -120,17 +121,28 @@ class RemoteSearch:
         # peer's leg under the trace the event was born in, so the
         # scatter (and the wire-propagated remote segment) stays one
         # trace (utils/tracing — the span spine)
+        # peer_hash on the span: the cross-peer assembly reads it back
+        # to fetch trace segments from exactly the peers this search
+        # actually asked (node.assemble_trace)
         with tracing.span_in(self.event.trace_ctx, "peers.remotesearch",
                              peer=target.name,
+                             peer_hash=target.hash.decode("ascii",
+                                                          "replace"),
                              secondary=urls is not None) as sp:
             q = self.event.query
             include = wordhashes or q.goal.include_hashes
+            t0 = time.perf_counter()
             ok, reply = self.protocol.search(
                 target, include, q.goal.exclude_hashes,
                 count=self.per_peer_count,
                 timeout_ms=int(self.timeout_s * 1000),
                 lang=q.lang, contentdom=q.contentdom,
                 with_abstracts=with_abstracts, urls=urls)
+            # the fleet peer table shows each peer's last observed RPC
+            # wall next to its gossiped digest (Network_Health_p)
+            if ok and self.protocol.fleet is not None:
+                self.protocol.fleet.note_rtt(
+                    target.hash, (time.perf_counter() - t0) * 1000.0)
             sp.set(ok=ok, links=len(reply.get("links", [])) if ok else 0)
             if not ok:
                 return

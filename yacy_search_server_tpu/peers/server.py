@@ -15,6 +15,7 @@ import time
 from ..index.metadata import (DOUBLE_FIELDS, INT_FIELDS, TEXT_FIELDS,
                               DocumentMetadata)
 from ..index.postings import NF
+from ..utils import fleet as fleetdigest
 from ..utils import tracing
 from .protocol import MAX_RWI_ENTRIES_PER_CALL, decode_postings
 from .seed import Seed, SeedDB
@@ -53,14 +54,32 @@ class PeerServer:
         # node's identity so cross-peer assembly can attribute it.
         tid = payload.pop(tracing.PAYLOAD_KEY, None) \
             if isinstance(payload, dict) else None
+        # fleet gossip (ISSUE 5): an inbound digest lands in the fleet
+        # table, and a digest-bearing caller gets ours back on the same
+        # reply (mutual exchange — old peers that never send a digest
+        # never receive one, the version-skew contract)
+        dig = payload.pop(fleetdigest.PAYLOAD_KEY, None) \
+            if isinstance(payload, dict) else None
+        fl = getattr(self.sb, "fleet", None)
+        if dig is not None and fl is not None:
+            fl.ingest(dig)
         if tid is not None and tracing.enabled():
             me = self.seeddb.my_seed
             with tracing.remote_trace(
                     str(tid), f"peer.{endpoint}",
                     peer=me.hash.decode("ascii", "replace"),
                     peer_name=me.name):
-                return fn(payload)
-        return fn(payload)
+                reply = fn(payload)
+        else:
+            reply = fn(payload)
+        if fl is not None and isinstance(dig, dict) \
+                and isinstance(reply, dict):
+            caller = dig.get("peer")
+            if isinstance(caller, str) and caller:
+                rd = fl.outgoing_digest(caller)
+                if rd is not None:
+                    reply = {**reply, fleetdigest.PAYLOAD_KEY: rd}
+        return reply
 
     # -- membership ----------------------------------------------------------
 
@@ -206,6 +225,28 @@ class PeerServer:
                 abstracts[wh.decode("ascii")] = uhs
             reply["abstracts"] = abstracts
         return reply
+
+    # -- cross-peer trace assembly (ISSUE 5) ---------------------------------
+
+    def do_tracefetch(self, payload: dict) -> dict:
+        """Serve this node's retained segment of a trace out of the
+        local ring by trace id, so the ORIGINATOR of a distributed
+        search can assemble the full waterfall instead of rendering an
+        opaque resource=global gap (client: Protocol.fetch_trace,
+        merge: tracing.merge_remote_spans via P2PNode.assemble_trace)."""
+        tid = str(payload.get("trace", ""))
+        me = self.seeddb.my_seed
+        out = {"trace_id": tid,
+               "peer": me.hash.decode("ascii", "replace"),
+               "root": "", "spans": [], "truncated": 0}
+        if not tracing.valid_trace_id(tid):
+            return out
+        seg = tracing.trace_segment(tid)
+        if seg is not None:
+            out["root"] = seg["root"]
+            out["spans"] = seg["spans"]
+            out["truncated"] = seg["truncated"]
+        return out
 
     # -- index transfer (receive) --------------------------------------------
 

@@ -570,6 +570,15 @@ class YaCyHttpServer:
                 self._send(handler, 200, "text/plain; charset=utf-8",
                            b"message=authentication failed\n")
                 return
+            # a fleet digest riding the Java wire as the xdigest part
+            # (peers/javawire.DIGEST_PART) lands in the fleet table the
+            # same way the in-band `_digest` key does on the JSON wire
+            fl = getattr(self.sb, "fleet", None)
+            if fl is not None and params.get(javawire.DIGEST_PART):
+                dig = javawire.decode_digest_part(
+                    params[javawire.DIGEST_PART])
+                if dig is not None:
+                    fl.ingest(dig)
             # translate the Java formats at the edge, then delegate to
             # THE hello implementation (PeerServer.do_hello owns seed
             # ingest, live counts, and the gossip batch)

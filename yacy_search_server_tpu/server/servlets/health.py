@@ -1,16 +1,23 @@
-"""Performance_Health_p — the node health dashboard (ISSUE 4).
+"""Performance_Health_p + Network_Health_p — node and FLEET health.
 
-The operator surface of `utils/health.py`: the live rule table
-(state / cause / evidence / since), per-histogram windowed percentiles
-with a bucket-distribution sparkline, and the flight recorder's incident
-list with a raw JSONL download.  The capability successor of the
-reference's PerformanceQueues_p/PerformanceMemory_p pages — except the
-node evaluated itself before the page was loaded."""
+`Performance_Health_p` is the operator surface of `utils/health.py`
+(ISSUE 4): the live rule table (state / cause / evidence / since),
+per-histogram windowed percentiles with a bucket-distribution sparkline,
+and the flight recorder's incident list with a raw JSONL download.
+
+`Network_Health_p` is its fleet-level sibling (ISSUE 5): the per-peer
+digest table (state / percentiles / staleness / seq / wire size), the
+merged-vs-local histogram comparison per digest family (any node shows
+the SAME eventually-consistent mesh view — no scrape coordinator), and
+the fleet_* rule table.  The capability successor of the reference's
+Network.html peer list — except with latency distributions instead of
+just counts."""
 
 from __future__ import annotations
 
 import time
 
+from ...utils import fleet as fleetmod
 from ...utils import histogram
 from ..objects import ServerObjects, escape_json
 from . import servlet
@@ -105,4 +112,89 @@ def respond_health(header: dict, post: ServerObjects,
         prop.put(pre + "time", int(inc["ts"]))
         prop.put(pre + "rules", escape_json(",".join(inc["rules"])))
         prop.put(pre + "file", escape_json(inc["path"] or ""))
+    return prop
+
+
+@servlet("Network_Health_p")
+def respond_network_health(header: dict, post: ServerObjects,
+                           sb) -> ServerObjects:
+    """The fleet dashboard (ISSUE 5): peer digest table, merged-vs-local
+    percentiles per digest family, and the fleet_* rule states."""
+    prop = ServerObjects()
+    fl = getattr(sb, "fleet", None)
+    eng = getattr(sb, "health", None)
+    if fl is None:
+        prop.put("info", "fleet table not available")
+        prop.put("peers", 0)
+        return prop
+    if post.get("tick", "") == "1" and eng is not None:
+        eng.tick()
+    d = fl.render()
+    prop.put("my_hash", escape_json(fl.my_hash))
+    prop.put("gossip_enabled", 1 if fl.enabled else 0)
+    prop.put("digest_seq", d.get("seq", 0))
+    prop.put("digest_bytes", fl.last_digest_bytes)
+    prop.put("digest_byte_budget", fl.byte_budget)
+    prop.put("stale_after_s", fl.stale_s)
+    prop.put("digests_received", fl.received_count)
+    prop.put("digests_ignored", fl.ignored_count)
+
+    rows = fl.peer_rows()
+    prop.put("peers", len(rows))
+    for i, r in enumerate(rows):
+        pre = f"peers_{i}_"
+        prop.put(pre + "hash", escape_json(r["hash"]))
+        prop.put(pre + "state", r["state"])
+        prop.put(pre + "age_s", r["age_s"])
+        prop.put(pre + "seq", r["seq"])
+        prop.put(pre + "bytes", r["bytes"])
+        prop.put(pre + "rtt_ms",
+                 round(r["rtt_ms"], 1) if r["rtt_ms"] is not None else "-")
+        for fam in fleetmod.DIGEST_FAMILIES:
+            key = pre + fam.replace(".", "_") + "_"
+            qs = r["quantiles"].get(fam)
+            if qs is None:
+                # absent family (version skew / no traffic): shown as
+                # '-', NEVER as a fake zero percentile
+                for lbl in ("p50", "p95", "p99"):
+                    prop.put(key + lbl, "-")
+            else:
+                for lbl, v in zip(("p50", "p95", "p99"), qs):
+                    prop.put(key + lbl, round(v, 2))
+
+    # merged-vs-local comparison: the mesh-wide distribution any node
+    # can compute from digests, next to this node's own windowed view
+    fams = fleetmod.DIGEST_FAMILIES
+    prop.put("families", len(fams))
+    for i, fam in enumerate(fams):
+        pre = f"families_{i}_"
+        local = fl.local_counts(fam)
+        merged = fl.merged_counts(fam)
+        prop.put(pre + "name", escape_json(fam))
+        prop.put(pre + "local_count", sum(local) if local else 0)
+        prop.put(pre + "mesh_count", sum(merged))
+        for lbl, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            prop.put(pre + "local_" + lbl, round(
+                histogram.percentile_from_counts(local, q)
+                if local else 0.0, 2))
+            prop.put(pre + "mesh_" + lbl, round(
+                histogram.percentile_from_counts(merged, q), 2))
+        prop.put(pre + "local_spark", _sparkline(local or []))
+        prop.put(pre + "mesh_spark", _sparkline(merged))
+
+    now = time.time()
+    frules = [(n, desc, st) for (n, desc, st) in
+              (eng.rule_table() if eng is not None else [])
+              if n.startswith("fleet_")]
+    prop.put("rules", len(frules))
+    for i, (name, desc, st) in enumerate(frules):
+        pre = f"rules_{i}_"
+        prop.put(pre + "name", escape_json(name))
+        prop.put(pre + "description", escape_json(desc))
+        prop.put(pre + "state", st.state)
+        prop.put(pre + "cause", escape_json(st.cause))
+        prop.put(pre + "since_s",
+                 round(now - st.since, 1) if st.since else 0.0)
+        prop.put(pre + "evidence", escape_json(" ".join(
+            f"{k}={v}" for k, v in st.evidence.items())))
     return prop

@@ -260,6 +260,14 @@ def respond_trace(header: dict, post: ServerObjects, sb) -> ServerObjects:
     prop.put("dropped_traces", tracing.dropped_traces)
     prop.put("dropped_spans", tracing.dropped_spans)
     if tid:
+        # cross-peer assembly (ISSUE 5): fetch the trace's remote
+        # segments out of the asked peers' rings and merge them here, so
+        # the waterfall below shows the WHOLE distributed request
+        # instead of an opaque resource=global gap
+        if post.get("assemble", "") == "1":
+            node = getattr(sb, "node", None)
+            prop.put("assembled_spans",
+                     node.assemble_trace(tid) if node is not None else 0)
         rec = tracing.get_trace(tid)
         if rec is None:
             prop.put("info", "unknown trace")
@@ -546,6 +554,45 @@ def prometheus_text(sb, include_buckets: bool = True,
                  {"state": "passive"})
         p.sample("yacy_peers", len(node.seeddb.potential),
                  {"state": "potential"})
+
+    # -- fleet observability (ISSUE 5): the coordinator-free mesh view.
+    # Emitted on EVERY node (zeros without peers): the fleet_* health
+    # rules reference these series by exact key, and the no-dead-rules
+    # hygiene gate requires every reference to resolve everywhere.
+    from ...utils import fleet as fleetdigest
+    fl = getattr(sb, "fleet", None)
+    if fl is not None:
+        fl.render()       # keep the digest-size gauge honest per scrape
+    peers_fresh = fl.fresh() if fl is not None else []
+    p.family("yacy_fleet_peers", "gauge",
+             "fresh peer metric digests retained in the fleet table")
+    p.sample("yacy_fleet_peers", len(peers_fresh))
+    p.family("yacy_fleet_digests_total", "counter",
+             "digest gossip traffic (rendered locally, received from "
+             "peers, ignored as invalid/replayed)")
+    for kind, v in (("rendered", fl.rendered_count if fl else 0),
+                    ("received", fl.received_count if fl else 0),
+                    ("ignored", fl.ignored_count if fl else 0)):
+        p.sample("yacy_fleet_digests_total", v, {"kind": kind})
+    p.family("yacy_fleet_digest_bytes", "gauge",
+             "wire size of the last rendered local digest "
+             "(budget: fleet.byteBudget, default 2048)")
+    p.sample("yacy_fleet_digest_bytes",
+             fl.last_digest_bytes if fl else 0)
+    p.family("yacy_fleet_merged_latency_ms", "gauge",
+             "mesh-wide percentiles from merged local+peer digest "
+             "bucket vectors (lossless merge, no coordinator)")
+    for fam in fleetdigest.DIGEST_FAMILIES:
+        counts = fl.merged_counts(fam) if fl is not None else None
+        for q, lbl in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = histogram.percentile_from_counts(counts, q) \
+                if counts else 0.0
+            p.sample("yacy_fleet_merged_latency_ms", round(v, 3),
+                     {"family": fam, "quantile": lbl})
+    p.family("yacy_fleet_peer_reported_critical", "gauge",
+             "fresh peers whose digest reports critical health")
+    p.sample("yacy_fleet_peer_reported_critical",
+             len([e for e in peers_fresh if e.get("health") == 2]))
 
     p.family("yacy_traces_retained", "gauge",
              "completed traces in the tracing ring")

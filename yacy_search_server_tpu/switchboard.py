@@ -240,6 +240,14 @@ class Switchboard:
             "parseDocument", self._stage_parse, workers=pipeline_workers,
             queue_size=200, next_stage=self._condense_proc)
 
+        # fleet observability (ISSUE 5): the digest renderer + per-peer
+        # digest table.  Constructed on EVERY switchboard (the fleet
+        # health rules and /metrics yacy_fleet_* families reference it
+        # unconditionally); the peer stack wires identity + gossip in
+        # (peers/node.py)
+        from .utils.fleet import FleetTable
+        self.fleet = FleetTable(self)
+
         # node health engine (ISSUE 4): rules + SLO burn rates + flight
         # recorder over the same series /metrics exports.  Constructed
         # here (cheap: no evaluation), driven by the 15_health busy

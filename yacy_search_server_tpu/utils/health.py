@@ -90,12 +90,14 @@ class Rule:
 
 
 class RuleCtx:
-    """What a rule may look at: the snapshot history (newest last) and
-    the windowed histograms."""
+    """What a rule may look at: the snapshot history (newest last), the
+    windowed histograms, and the fleet digest table (ISSUE 5 — the
+    fleet_* rules judge the MESH, not just this node)."""
 
-    def __init__(self, history, trend_ticks: int):
+    def __init__(self, history, trend_ticks: int, fleet=None):
         self._hist = history
         self.trend_ticks = trend_ticks
+        self.fleet = fleet
 
     def value(self, key: str, default: float = 0.0) -> float:
         if not self._hist:
@@ -262,6 +264,106 @@ def build_rules(cfg) -> list:
             return WARN, f"{int(d)} log records dropped in the window", ev
         return OK, "no log drops", ev
 
+    # -- fleet rules (ISSUE 5): the mesh view over gossiped digests ----------
+
+    fleet_min_qps = g("health.fleetSloMinQps", 1.0)
+    outlier_factor = g("health.fleetOutlierFactor", 3.0)
+    outlier_min_mesh = gi("health.fleetOutlierMinSamples", 50)
+    outlier_min_peer = gi("health.fleetOutlierMinPeerSamples", 20)
+
+    def fleet_slo(ctx: RuleCtx):
+        fl = ctx.fleet
+        peers = fl.fresh() if fl is not None else []
+        if not peers:
+            return OK, "no fleet peers gossiping", {"peers": 0}
+        counts = fl.merged_counts("servlet.serving")
+        total = sum(counts)
+        window_s = histogram.WINDOWS * histogram.ROTATE_EVERY_S
+        qps = total / window_s
+        frac = histogram.fraction_over_counts(counts, slo_ms)
+        ev = {"peers": len(peers), "mesh_requests": total,
+              "mesh_qps": round(qps, 3), "frac_over": round(frac, 4),
+              "slo_ms": slo_ms,
+              "mesh_p95_ms": round(
+                  histogram.percentile_from_counts(counts, 0.95), 1)}
+        if qps < fleet_min_qps:
+            return OK, "below mesh SLO traffic floor", ev
+        burn = frac / budget
+        ev["burn"] = round(burn, 2)
+        if burn >= slow_crit:
+            return CRITICAL, (
+                f"mesh serving SLO burning {burn:.1f}x budget across "
+                f"{len(peers) + 1} nodes (p95 objective {slo_ms}ms)"), ev
+        if burn >= 1.0:
+            return WARN, (f"mesh error budget burning at {burn:.1f}x "
+                          f"sustainable rate"), ev
+        return OK, "mesh within SLO", ev
+
+    def fleet_outlier(ctx: RuleCtx):
+        fl = ctx.fleet
+        peers = fl.fresh() if fl is not None else []
+        if not peers:
+            return OK, "no fleet peers gossiping", {"peers": 0}
+        merged = fl.merged_counts("servlet.serving")
+        total = sum(merged)
+        ev = {"peers": len(peers), "mesh_requests": total}
+        if total < outlier_min_mesh:
+            return OK, "insufficient mesh traffic", ev
+        mesh_p95 = histogram.percentile_from_counts(merged, 0.95)
+        ev["mesh_p95_ms"] = round(mesh_p95, 2)
+        rows = [(fl.my_hash, fl.local_counts("servlet.serving"))] \
+            if fl.my_hash else []
+        rows += [(e["peer"], e["hist"].get("servlet.serving"))
+                 for e in peers]
+        worst = None
+        for phash, counts in rows:
+            if not counts or sum(counts) < outlier_min_peer:
+                continue        # absent/thin family: no verdict, not zero
+            # leave-one-out baseline: judge the peer against the REST of
+            # the mesh, not a merged p95 its own samples already drag —
+            # a high-traffic outlier would otherwise mask itself (its
+            # samples set the merged tail, so local/merged stays ~1x)
+            rest = [max(0, m - c) for m, c in zip(merged, counts)]
+            if sum(rest) < outlier_min_peer:
+                continue        # no baseline to judge against
+            rest_p95 = histogram.percentile_from_counts(rest, 0.95)
+            p95 = histogram.percentile_from_counts(counts, 0.95)
+            if p95 > outlier_factor * rest_p95 \
+                    and (worst is None or p95 > worst[1]):
+                worst = (phash, p95, rest_p95)
+        if worst is not None:
+            ev["outlier_peer"] = worst[0]
+            ev["outlier_p95_ms"] = round(worst[1], 2)
+            ev["rest_p95_ms"] = round(worst[2], 2)
+            return CRITICAL, (
+                f"peer {worst[0]} drags the mesh tail: local p95 "
+                f"{worst[1]:.0f}ms vs rest-of-mesh p95 {worst[2]:.0f}ms "
+                f"(> {outlier_factor:g}x)"), ev
+        return OK, "no peer outlier", ev
+
+    def fleet_critical(ctx: RuleCtx):
+        fl = ctx.fleet
+        peers = fl.fresh() if fl is not None else []
+        crit = sorted(e["peer"] for e in peers if e.get("health") == 2)
+        stalls = sorted(e["peer"] for e in peers
+                        if e.get("rules", {}).get("worker_stall") == 2)
+        ev = {"peers": len(peers), "critical_peers": len(crit),
+              "worker_stall_peers": len(stalls),
+              "names": ",".join(sorted(set(crit + stalls))[:8])}
+        if not peers:
+            return OK, "no fleet peers gossiping", ev
+        if stalls:
+            return CRITICAL, (
+                f"{len(stalls)} peer(s) report a wedged kernel "
+                f"(worker_stall): {ev['names']}"), ev
+        if len(crit) * 2 >= len(peers):
+            return CRITICAL, (f"{len(crit)}/{len(peers)} fleet peers "
+                              f"critical: {ev['names']}"), ev
+        if crit:
+            return WARN, (f"{len(crit)} fleet peer(s) critical: "
+                          f"{ev['names']}"), ev
+        return OK, "fleet peers healthy", ev
+
     def frontier_starvation(ctx: RuleCtx):
         def starving(i: int) -> bool:
             # at tick `i` ago: frontier empty while that tick still
@@ -304,6 +406,23 @@ def build_rules(cfg) -> list:
         Rule("crawler_frontier_starvation",
              "active crawl with an empty local frontier",
              (_frontier, _fetches), frontier_starvation),
+        Rule("fleet_slo_serving",
+             f"mesh-wide serving SLO burn rate over MERGED peer digests "
+             f"(p95 objective {slo_ms}ms; coordinator-free federation)",
+             ("yacy_fleet_peers",
+              'yacy_fleet_merged_latency_ms{family="servlet.serving",'
+              'quantile="p95"}'), fleet_slo),
+        Rule("fleet_peer_outlier",
+             f"peer whose local serving p95 exceeds the merged mesh p95 "
+             f"by > {outlier_factor:g}x (names the dragging seed)",
+             ("yacy_fleet_peers",
+              'yacy_fleet_merged_latency_ms{family="servlet.serving",'
+              'quantile="p95"}'), fleet_outlier),
+        Rule("fleet_critical_peers",
+             "fleet peers whose digests report critical health or a "
+             "wedged kernel (worker_stall)",
+             ("yacy_fleet_peers", "yacy_fleet_peer_reported_critical"),
+             fleet_critical),
     ]
 
 
@@ -327,6 +446,10 @@ class HealthEngine:
             maxlen=cfg.get_int("health.flightSnapshots", 240))
         self.snapshot_dump_max = cfg.get_int(
             "health.incidentSnapshotMax", 60)
+        # DATA/HEALTH retention cap (ISSUE 5 satellite): incident writes
+        # are rate-limited but the directory grew unboundedly — keep the
+        # newest N files, delete older on every write
+        self.incident_keep = cfg.get_int("health.incidentKeepFiles", 50)
         self.states: dict[str, RuleState] = {
             r.name: RuleState(since=time.time()) for r in self.rules}
         self.incidents: deque = deque(maxlen=32)
@@ -362,7 +485,8 @@ class HealthEngine:
         snap = parse_exposition(self._exposition())
         with self._lock:
             self.snapshots.append((now, snap))
-            ctx = RuleCtx(list(self.snapshots), self.trend_ticks)
+            ctx = RuleCtx(list(self.snapshots), self.trend_ticks,
+                          fleet=getattr(self.sb, "fleet", None))
             entered_critical = []
             for rule in self.rules:
                 try:
@@ -454,10 +578,28 @@ class HealthEngine:
             except OSError:
                 path = None   # a full disk must not kill the tick; the
                 # in-memory copy below still serves the servlet download
+            self._prune_incident_files()
         self.incident_count += 1
         self.incidents.append({
             "name": name, "ts": now, "rules": list(entered),
             "path": path, "body": body})
+
+    def _prune_incident_files(self) -> None:
+        """Enforce the DATA/HEALTH retention cap: newest
+        `health.incidentKeepFiles` incident files stay, older ones go
+        (oldest-mtime first; name-embedded timestamps break ties)."""
+        if not self._dir or self.incident_keep <= 0:
+            return
+        try:
+            names = [f for f in os.listdir(self._dir)
+                     if f.startswith("incident-") and f.endswith(".jsonl")]
+            names.sort(key=lambda f: (
+                os.path.getmtime(os.path.join(self._dir, f)), f))
+            for f in names[:-self.incident_keep]:
+                os.remove(os.path.join(self._dir, f))
+        except OSError:
+            return    # retention must never kill the tick; the next
+            # successful write retries the prune
 
     def incident_body(self, name: str) -> str | None:
         """Download surface: by registry name only (never a caller

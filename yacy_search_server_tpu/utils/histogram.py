@@ -117,6 +117,63 @@ def merge_counts(count_vectors) -> list:
     return out
 
 
+def fraction_over_counts(counts, threshold_ms: float) -> float:
+    """Fraction of a bucket-count vector above `threshold_ms` (the
+    straddling bucket contributes linearly) — the burn-rate numerator,
+    shared by the per-node SLO rule (via Histogram.fraction_over) and
+    the fleet-level rule over MERGED peer digests (utils/fleet.py)."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    ti = bucket_index(threshold_ms)
+    bad = float(sum(counts[ti + 1:]))
+    lo = BUCKET_BOUNDS_MS[ti - 1] if ti > 0 else 0.0
+    hi = BUCKET_BOUNDS_MS[ti] if ti < N_BUCKETS - 1 \
+        else BUCKET_BOUNDS_MS[-1]
+    if hi > lo:
+        bad += counts[ti] * max(0.0, min(1.0, (hi - threshold_ms)
+                                         / (hi - lo)))
+    return bad / total
+
+
+def counts_to_sparse(counts) -> dict:
+    """Bucket-count vector -> the digest wire form `{"i": [...], "c":
+    [...]}` (indices + counts of the non-empty buckets only).  Lossless:
+    `counts_from_sparse` reconstructs the exact vector, so merged
+    mesh-wide percentiles equal the ones computed from the raw vectors
+    (the ISSUE 5 acceptance property)."""
+    idx: list[int] = []
+    cts: list[int] = []
+    for i, c in enumerate(counts):
+        if c:
+            idx.append(i)
+            cts.append(int(c))
+    return {"i": idx, "c": cts}
+
+
+def counts_from_sparse(obj) -> list | None:
+    """Tolerant decode of the digest wire form; None on malformed input
+    (the caller drops the family, never the whole digest).  Indices
+    outside this build's grid — a future version with more buckets —
+    clamp into the edge buckets instead of failing the merge."""
+    if not isinstance(obj, dict):
+        return None
+    idx, cts = obj.get("i"), obj.get("c")
+    if not isinstance(idx, (list, tuple)) or \
+            not isinstance(cts, (list, tuple)) or len(idx) != len(cts):
+        return None
+    out = [0] * N_BUCKETS
+    try:
+        for i, c in zip(idx, cts):
+            i, c = int(i), int(c)
+            if c < 0:
+                return None
+            out[min(max(i, 0), N_BUCKETS - 1)] += c
+    except (TypeError, ValueError):
+        return None
+    return out
+
+
 class Histogram:
     """One latency family: cumulative counts (Prometheus) + a windowed
     ring (operator percentiles) + per-bucket trace-id exemplars."""
@@ -216,15 +273,7 @@ class Histogram:
         total = sum(counts)
         if total <= 0:
             return 0.0, 0
-        ti = bucket_index(threshold_ms)
-        bad = float(sum(counts[ti + 1:]))
-        lo = BUCKET_BOUNDS_MS[ti - 1] if ti > 0 else 0.0
-        hi = BUCKET_BOUNDS_MS[ti] if ti < N_BUCKETS - 1 \
-            else BUCKET_BOUNDS_MS[-1]
-        if hi > lo:
-            bad += counts[ti] * max(0.0, min(1.0, (hi - threshold_ms)
-                                             / (hi - lo)))
-        return bad / total, total
+        return fraction_over_counts(counts, threshold_ms), total
 
     def snapshot(self) -> dict:
         """Cumulative view for the Prometheus exposition."""

@@ -438,6 +438,104 @@ def export_jsonl(n: int = 50) -> str:
     return "\n".join(json.dumps(t.to_json()) for t in traces(n))
 
 
+# -- cross-peer trace assembly (ISSUE 5) -------------------------------------
+#
+# A distributed search is ONE trace id network-wide (the wire
+# propagation above), but each peer's spans live in ITS ring: the
+# originator sees an opaque `peers.remotesearch` gap where the remote
+# work happened.  The `tracefetch` wire endpoint (peers/server.py)
+# serves a trace's local segment by id; the originator merges fetched
+# segments back into its record (P2PNode.assemble_trace), and
+# Performance_Trace_p renders the full distributed waterfall.
+
+def trace_segment(trace_id: str,
+                  max_spans: int = MAX_SPANS) -> dict | None:
+    """This node's retained segment of a trace, wire-serializable (the
+    server side of the `tracefetch` endpoint).  `truncated` counts
+    spans NOT shipped (ring-side drops + any cap applied here): an
+    assembled waterfall must be able to say it is incomplete rather
+    than silently omit the tail."""
+    with _lock:
+        rec = _ring.get(trace_id)
+        if rec is None:
+            return None
+        return {"trace_id": rec.trace_id, "root": rec.root_name,
+                "truncated": rec.dropped
+                + max(0, len(rec.spans) - max_spans),
+                "spans": [s.to_json() for s in rec.spans[:max_spans]]}
+
+
+def merge_remote_spans(trace_id: str, spans, source: str) -> int:
+    """Merge a fetched remote segment into the local ring; returns the
+    number of spans actually added.
+
+    Dedup + collision rules: a span whose (sid, name, start) already
+    exists locally is the SAME span seen through a co-hosted ring and is
+    skipped; a colliding sid with different content (two processes both
+    count spans from s1) is renamed under a `source`-derived prefix,
+    with parent links inside the fetched batch remapped consistently.
+    Merged spans do NOT feed the windowed histograms — the remote node
+    already observed them into its own, and they arrive in its digest.
+    """
+    global dropped_spans
+    if not _enabled or not valid_trace_id(trace_id) \
+            or not isinstance(spans, list) or not spans:
+        return 0
+    incoming = []
+    for sj in spans[:MAX_SPANS]:
+        if not isinstance(sj, dict):
+            continue
+        try:
+            sid = str(sj["sid"])
+            name = str(sj["name"])
+            ts = float(sj.get("ts", 0.0))
+            dur = float(sj.get("dur_ms", 0.0))
+            parent = str(sj.get("parent", ""))
+            attrs = sj.get("attrs")
+            attrs = dict(attrs) if isinstance(attrs, dict) else {}
+        except (KeyError, TypeError, ValueError):
+            continue
+        incoming.append((sid, parent, name, ts, dur, attrs))
+    if not incoming:
+        return 0
+    root_name = next((n for _s, p, n, _t, _d, _a in incoming if p == ""),
+                     incoming[0][2])
+    rec = _register(trace_id, root_name)
+    src = "".join(c for c in str(source) if c.isalnum())[:6] or "remote"
+    merged = 0
+    with _lock:
+        existing = {s.sid: s for s in rec.spans}
+        remap: dict[str, str] = {}
+        fresh = []
+        for sid, parent, name, ts, dur, attrs in incoming:
+            ex = existing.get(sid)
+            if ex is not None and ex.name == name \
+                    and abs(ex.ts - ts) < 0.002:
+                continue                    # same span, co-hosted ring
+            nsid = sid if ex is None else f"{src}.{sid}"
+            ex2 = existing.get(nsid)
+            if ex2 is not None and ex2.name == name \
+                    and abs(ex2.ts - ts) < 0.002:
+                # merged by an earlier fetch (idempotence) — but still
+                # record the rename: a NEW span in this batch may
+                # parent on the colliding sid and must follow it to the
+                # renamed copy, not the originator's unrelated local span
+                remap[sid] = nsid
+                continue
+            remap[sid] = nsid
+            attrs.setdefault("fetched_from", str(source))
+            fresh.append(Span(nsid, parent, name, ts, dur, attrs))
+        for s in fresh:
+            s.parent = remap.get(s.parent, s.parent)
+            if len(rec.spans) >= MAX_SPANS:
+                rec.dropped += 1
+                dropped_spans += 1
+                continue
+            rec.spans.append(s)
+            merged += 1
+    return merged
+
+
 # the one nearest-rank convention across the observability layer lives
 # in utils/histogram.py; this alias survives for the callers that
 # learned it here (profiler, bench).  The per-stage p50/p95 summary
