@@ -172,6 +172,7 @@ PACKED_KERNELS = (
     "_rank_scan_batch_packed_kernel",
     "_rank_join_batch_packed_kernel",
     "_rank_join_bm_batch_packed_kernel",
+    "_rerank_fwd_batch_packed_kernel",
 )
 
 

@@ -200,3 +200,55 @@ def test_hybrid_rerank_batch_matches_solo():
         assert np.array_equal(np.asarray(bi[i]), np.asarray(si))
         np.testing.assert_allclose(np.asarray(bs[i]), np.asarray(ss),
                                    rtol=2e-2, atol=2e-2)
+
+
+def test_get_block_zero_fills_missing_vectors(tmp_path):
+    # a docid with postings but no stored vector (dense.put not landed,
+    # or never stored) must gather zeros — the host-gather legacy rerank
+    # feeds get_block raw candidate docids and a crash here fails the
+    # whole hybrid query
+    st = DenseVectorStore(str(tmp_path / "dense"), dim=16)
+    st.put(3, np.ones(16, np.float32))
+    got = st.get_block(np.array([3, 10_000, -1]))
+    assert got.shape == (3, 16)
+    assert np.allclose(got[0], 1.0)
+    assert not got[1].any() and not got[2].any()
+
+
+def test_device_block_patch_matches_full_upload(tmp_path):
+    import jax
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    st = DenseVectorStore(str(tmp_path / "dense"), dim=16)
+    for i in range(40):
+        st.put(i, rng.normal(size=16).astype(np.float32))
+    fwd0, v0 = st.device_block(dev)
+    # writes move the version; the next device_block PATCHES the
+    # resident block (only dirty rows cross the wire) and must be
+    # bit-identical to a from-scratch upload
+    for i in (2, 7, 39, 41):
+        st.put(i, rng.normal(size=16).astype(np.float32))
+    fwd1, v1 = st.device_block(dev)
+    assert v1 > v0
+    st2 = DenseVectorStore(dim=16)
+    st2._vecs = st._vecs.copy()
+    st2._n = st._n
+    fwd_ref, _ = st2.device_block(dev)
+    np.testing.assert_array_equal(np.asarray(fwd1), np.asarray(fwd_ref))
+    # cached: same version answers without a transfer
+    fwd2, v2 = st.device_block(dev)
+    assert v2 == v1 and fwd2 is fwd1
+
+
+def test_device_block_over_budget_releases_block(tmp_path, monkeypatch):
+    import jax
+    dev = jax.devices()[0]
+    st = DenseVectorStore(str(tmp_path / "dense"), dim=16)
+    st.put(0, np.ones(16, np.float32))
+    assert st.device_block(dev) is not None
+    assert st._fwd is not None
+    # the index grows past the residency budget: the block can never be
+    # served again and must not stay pinned on device
+    monkeypatch.setattr(DenseVectorStore, "DEVICE_BUDGET_BYTES", 1)
+    assert st.device_block(dev) is None
+    assert st._fwd is None and st._fwd_device is None

@@ -176,6 +176,20 @@ def test_xla_dense_boost(n):
     _close(c.xla_bytes, by, f"dense_boost[{n}] bytes")
 
 
+@pytest.mark.parametrize("nb,bs,cap", (
+    (16, 4, 1 << 12), (128, 16, 1 << 14), (1024, 8, 1 << 14)))
+def test_xla_rerank_fwd_batch_packed(nb, bs, cap):
+    """The serving rerank family (ISSUE 6): bs fused descriptors
+    gathering from a [cap, dim] f16 device-resident forward index."""
+    fwd = jnp.zeros((cap, 256), jnp.float16)
+    qi = jnp.zeros((bs, 2 + 2 * nb + 256), jnp.int32)
+    flops, by = _xla(D._rerank_fwd_batch_packed_kernel, fwd, qi,
+                     nb=nb, bs=bs)
+    c = RF.cost("_rerank_fwd_batch_packed_kernel", bs=bs, nb=nb, cap=cap)
+    _close(c.flops, flops, f"rerank_fwd[{nb},{bs},{cap}] flops")
+    _close(c.xla_bytes, by, f"rerank_fwd[{nb},{bs},{cap}] bytes")
+
+
 @pytest.mark.parametrize("n,e", ((1024, 8192), (1024, 16384), (2048, 8192)))
 def test_xla_power_iterate_unit_step(n, e):
     from yacy_search_server_tpu.ops import blockrank as B

@@ -19,6 +19,16 @@ from ..ops.dense import DIM, ENCODER_VERSION
 
 
 class DenseVectorStore:
+    # device-residency cap for the forward index: beyond it the rerank
+    # path falls back to the host gather (a 1 GiB f16 block is ~2M docs
+    # at dim 256 — past that the block belongs in the tiered-residency
+    # work of ROADMAP item 4, not in one monolithic upload)
+    DEVICE_BUDGET_BYTES = 1 << 30
+    # dirty-row bookkeeping cap for the device-block patch path (see
+    # device_block): a set bigger than this costs more than the full
+    # re-upload it would save
+    _DIRTY_CAP = 1 << 16
+
     def __init__(self, data_dir: str | None = None, dim: int = DIM):
         self.dim = dim
         self.data_dir = data_dir
@@ -27,6 +37,28 @@ class DenseVectorStore:
         self._lock = threading.Lock()
         self._dirty = 0
         self.stale_encoder = False
+        # vector-content version: bumps on EVERY write (put / re-encode)
+        # — the hybrid top-k cache keys on it (plus ENCODER_VERSION), so
+        # a cached hybrid answer can never survive a vector or encoder
+        # change (the arena epoch only covers postings mutations)
+        self.version = 0
+        # device-resident forward index (the M7 rerank's doc-vector
+        # block, resident like the postings arena): uploaded lazily,
+        # re-uploaded when the content version moves; rows pad to a
+        # pow2 bucket so compile shapes stay bounded
+        self._fwd = None
+        self._fwd_version = -1
+        self._fwd_device = None
+        # serializes uploads among device_block callers WITHOUT holding
+        # the write lock across the device transfer: indexers keep
+        # putting vectors while a (possibly seconds-long, through a
+        # remote tunnel) re-upload is in flight
+        self._fwd_lock = threading.Lock()
+        # rows written since the last device upload: device_block
+        # scatters ONLY these into the resident block (indexing cadence
+        # must not re-ship the whole index per query wave); None =
+        # overflowed past _DIRTY_CAP, full re-upload on next access
+        self._fwd_dirty: set | None = set()
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             p = self._path()
@@ -61,14 +93,128 @@ class DenseVectorStore:
                     [self._vecs, np.zeros_like(self._vecs)])
             self._vecs[docid] = vec.astype(np.float16)
             self._n = max(self._n, docid + 1)
+            self.version += 1
             self._dirty += 1
+            if self._fwd_dirty is not None:
+                self._fwd_dirty.add(docid)
+                if len(self._fwd_dirty) > self._DIRTY_CAP:
+                    # past the cap a full re-upload is cheaper than the
+                    # bookkeeping; None = "patch set overflowed"
+                    self._fwd_dirty = None
             if self.data_dir and self._dirty >= 512:
                 self._save_locked()
 
     def get_block(self, docids: np.ndarray) -> np.ndarray:
-        """[len(docids), dim] float16 gather (device-transfer unit)."""
+        """[len(docids), dim] float16 gather (device-transfer unit).
+
+        Docids without a stored vector gather zeros (zero boost), the
+        same contract the device forward index gives pad rows — a
+        postings row whose dense.put hasn't landed yet (or never will)
+        must rank by its sparse score, not crash the hybrid query."""
         with self._lock:
-            return self._vecs[np.asarray(docids, dtype=np.int64)]
+            ids = np.asarray(docids, dtype=np.int64)
+            out = np.zeros((len(ids), self.dim), np.float16)
+            ok = (ids >= 0) & (ids < self._n)
+            out[ok] = self._vecs[ids[ok]]
+            return out
+
+    def _rows_locked(self) -> int:
+        # pow2 row bucket (>=256) — the ONE derivation shared by the
+        # prewarm shape key and the uploaded block (divergence would
+        # warm shapes device_block never dispatches)
+        return 1 << max(8, (max(self._n, 1) - 1).bit_length())
+
+    def device_rows(self) -> int:
+        """The forward index's padded device row bucket (a compile-shape
+        key for the devstore prewarm)."""
+        with self._lock:
+            return self._rows_locked()
+
+    def device_block(self, device):
+        """The device-resident forward index: ([rows, dim] float16 on
+        `device`, content version) — or None when the block exceeds
+        DEVICE_BUDGET_BYTES (callers fall back to the host gather).
+
+        Block-resident like the postings arena: one upload serves every
+        subsequent rerank dispatch, so the per-query host-side
+        ``get_block`` gather + upload round trip disappears from the
+        serving path. Stale on any vector write (the version moved);
+        a stale block is PATCHED on device — only the rows written
+        since the last upload cross the wire (a steady indexer must not
+        cost one full-index transfer per query wave) — falling back to
+        a wholesale re-upload when the row bucket grew, the dirty set
+        overflowed, or more than a quarter of the block changed. Rows
+        pad to a pow2 bucket (>=256) so a growing index mints a bounded
+        set of compile shapes; docids past the bucket simply have no
+        vector yet and the kernel scores them with zero boost."""
+        import jax
+        with self._fwd_lock:
+            with self._lock:
+                rows = self._rows_locked()
+                if rows * self.dim * 2 > self.DEVICE_BUDGET_BYTES:
+                    # release the last in-budget block: it can never be
+                    # served again, and up to 1 GiB of pinned device
+                    # memory would otherwise shadow the postings arena
+                    # for the rest of the process
+                    self._fwd = None
+                    self._fwd_device = None
+                    self._fwd_version = -1
+                    return None
+                if (self._fwd is not None
+                        and self._fwd_version == self.version
+                        and self._fwd_device is device
+                        and self._fwd.shape[0] == rows):
+                    return self._fwd, self._fwd_version
+                # snapshot under the write lock, then release it for
+                # the transfer: a put() racing the upload lands AFTER
+                # `ver`, so the cached block is immediately stale and
+                # the next call patches it in — but the indexer never
+                # blocked on the transfer
+                ver = self.version
+                base, dirty = self._fwd, self._fwd_dirty
+                patch = (base is not None and dirty is not None
+                         and self._fwd_device is device
+                         and base.shape[0] == rows
+                         and 0 < len(dirty) <= rows // 4)
+                if patch:
+                    idx = np.fromiter(dirty, np.int64, len(dirty))
+                    sub = self._vecs[idx]
+                else:
+                    buf = np.zeros((rows, self.dim), np.float16)
+                    buf[:self._n] = self._vecs[:self._n]
+                self._fwd_dirty = set()
+            try:
+                if patch:
+                    # scatter only the dirty rows into the resident
+                    # block; the index count pads to a pow2 bucket
+                    # (bounded compile shapes) — pad lanes repeat idx[0]
+                    # with its own row, so duplicate indices carry
+                    # identical values
+                    nb = 1 << max(4, (len(idx) - 1).bit_length())
+                    pidx = np.full(nb, idx[0], np.int32)
+                    pidx[:len(idx)] = idx
+                    psub = np.repeat(sub[:1], nb, axis=0)
+                    psub[:len(idx)] = sub
+                    fwd = base.at[jax.device_put(pidx, device)].set(
+                        jax.device_put(psub, device))
+                else:
+                    fwd = jax.device_put(buf, device)
+            except BaseException:
+                # a failed transfer must not LOSE the snapshotted dirty
+                # rows: _fwd/_fwd_version are unchanged, so a later
+                # patch would scatter only post-failure writes onto the
+                # old base and serve these rows stale-as-fresh
+                with self._lock:
+                    if dirty is None or self._fwd_dirty is None:
+                        self._fwd_dirty = None
+                    else:
+                        self._fwd_dirty |= dirty
+                raise
+            with self._lock:
+                self._fwd = fwd
+                self._fwd_version = ver
+                self._fwd_device = device
+            return fwd, ver
 
     def __len__(self) -> int:
         return self._n

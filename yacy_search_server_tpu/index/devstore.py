@@ -1628,6 +1628,13 @@ class _QueryBatcher:
         # hanging)
         if ev.wait(timeout=self.WATCHDOG_S):
             return item["res"]
+        with item["lk"]:
+            if ev.is_set():     # finish landed between wait and lock
+                return item["res"]
+            # the caller will serve this query solo — a late batched
+            # finish must neither deliver it nor count it (the exact
+            # per-family query counters would double-count otherwise)
+            item["abandoned"] = True
         with self._ms_lock:
             self.timeouts += 1
             # stall = the item's OWN kernel work is wedged: held in the
@@ -1671,6 +1678,20 @@ class _QueryBatcher:
                 "lang": language, "kk": kk, "filters": filters,
                 "ev": threading.Event(), "res": ("ineligible",),
                 "lk": threading.Lock(), "taken": False}
+        return self._submit_wait(item)
+
+    def submit_rerank(self, qrow: np.ndarray, nb: int, n: int, fwd):
+        """Blocking batched dense rerank (index.device.rerankBatching);
+        returns ("ok", scores, docids) | ("timeout",). `qrow` is the
+        slot's fused descriptor (ops/dense.pack_rerank_row), `nb` its
+        static candidate-lane bucket, `fwd` the forward-index snapshot
+        the caller resolved — its identity is part of the dispatch
+        group key, so a concurrent vector re-upload can never mix
+        forward-index versions inside one kernel call."""
+        item = {"kind": "rerank", "qrow": qrow, "nb": nb, "n": n,
+                "fwd": fwd, "ev": threading.Event(),
+                "res": ("ineligible",), "lk": threading.Lock(),
+                "taken": False}
         return self._submit_wait(item)
 
     def submit_join(self, arrays, join_arrays, dead, qargs,
@@ -1812,7 +1833,7 @@ class _QueryBatcher:
         anyway — keeping them in one batch just ran them back to back in
         one dispatcher while the rest of the pool idled."""
         plain = [it for it in batch if it.get("kind") not in
-                 ("join", "scan")]
+                 ("join", "scan", "rerank")]
         fams: dict[tuple, list[dict]] = {}
         for it in batch:
             if it.get("kind") == "join":
@@ -1830,6 +1851,14 @@ class _QueryBatcher:
                        it["kk"])
                 scans.setdefault(key, []).append(it)
         parts.extend(scans.values())
+        # rerank groups likewise: one fused MXU dispatch per candidate-
+        # lane bucket (the compile family); the forward-index snapshot
+        # is re-grouped at dispatch time (_dispatch_reranks)
+        reranks: dict[int, list[dict]] = {}
+        for it in batch:
+            if it.get("kind") == "rerank":
+                reranks.setdefault(it["nb"], []).append(it)
+        parts.extend(reranks.values())
         for fam in fams.values():
             # chunk a big family to its batch cap here, not inside one
             # dispatcher: each chunk is one kernel call, and separate
@@ -1952,12 +1981,15 @@ class _QueryBatcher:
     def _dispatch(self, batch: list[dict]) -> None:
         joins = [it for it in batch if it.get("kind") == "join"]
         scans = [it for it in batch if it.get("kind") == "scan"]
+        reranks = [it for it in batch if it.get("kind") == "rerank"]
         batch = [it for it in batch
-                 if it.get("kind") not in ("join", "scan")]
+                 if it.get("kind") not in ("join", "scan", "rerank")]
         if joins:
             self._dispatch_joins(joins)
         if scans:
             self._dispatch_scans(scans)
+        if reranks:
+            self._dispatch_reranks(reranks)
         if not batch:
             return
         store = self.store
@@ -2137,6 +2169,75 @@ class _QueryBatcher:
                     out, finish, chunk, "_rank_scan_batch_packed_kernel",
                     t0k, issue_ms)
 
+    def _dispatch_reranks(self, items: list[dict]) -> None:
+        """Batched dense rerank: group by (forward-index snapshot,
+        candidate-lane bucket), one fused _rerank_fwd_batch_packed_kernel
+        MXU dispatch per group — B concurrent hybrid queries' second
+        stages ride one round trip instead of a solo device hop each
+        (the last solo kernel wired into the pipeline; ROADMAP item 1).
+        Fixed batch shape bs=max_batch: pad slots carry n_valid 0 and
+        cost only their masked gather lanes."""
+        from ..ops.dense import _rerank_fwd_batch_packed_kernel
+        store = self.store
+        groups: dict[tuple, list[dict]] = {}
+        for it in items:
+            groups.setdefault((id(it["fwd"]), it["nb"]), []).append(it)
+        bs = self.max_batch
+        for (_fid, nb), its in groups.items():
+            fwd = its[0]["fwd"]
+            rowlen = len(its[0]["qrow"])
+            for pos in range(0, len(its), bs):
+                chunk = its[pos:pos + bs]
+                qi = np.zeros((bs, rowlen), np.int32)
+                for i, it in enumerate(chunk):
+                    qi[i] = it["qrow"]
+                t0k = time.perf_counter()
+                out = _rerank_fwd_batch_packed_kernel(fwd, qi, nb=nb,
+                                                      bs=bs)
+                issue_ms = (time.perf_counter() - t0k) * 1000.0
+
+                def finish(host, chunk=chunk, nb=nb, t0k=t0k, fwd=fwd,
+                           bs=bs):
+                    wall = time.perf_counter() - t0k
+                    with self._ms_lock:
+                        self.query_kernel_ms.extend([wall * 1000.0]
+                                                    * len(chunk))
+                    for it in chunk:
+                        it["kernel_ms"] = wall * 1000.0
+                        it["kernel_name"] = \
+                            "_rerank_fwd_batch_packed_kernel"
+                        it["batch_n"] = len(chunk)
+                    PROFILER.record(
+                        "_rerank_fwd_batch_packed_kernel",
+                        max(wall - store.tunnel_rt_ms / 1e3, 1e-6),
+                        queries=len(chunk), bs=bs, nb=nb,
+                        dim=int(fwd.shape[1]), cap=int(fwd.shape[0]))
+                    results = [("ok", host[i, :it["n"]].copy(),
+                                host[i, nb:nb + it["n"]].copy())
+                               for i, it in enumerate(chunk)]
+                    # ONE store-lock acquisition for the whole chunk
+                    # (concurrent completer finishes contend here); the
+                    # count lands before each ev.set() so a waiter that
+                    # wakes — and the hammer test that joins it — always
+                    # sees its own query counted. Safe nesting: nothing
+                    # acquires store._lock while holding an item lk
+                    with store._lock:
+                        store.rerank_dispatches += 1
+                        for it, res in zip(chunk, results):
+                            with it["lk"]:
+                                if it.get("abandoned"):
+                                    # the waiter gave up and served this
+                                    # query solo (counted there) — a
+                                    # late delivery would double-count
+                                    continue
+                                store.rerank_queries += 1
+                                it["res"] = res
+                                it["ev"].set()
+
+                self._submit_completion(
+                    out, finish, chunk, "_rerank_fwd_batch_packed_kernel",
+                    t0k, issue_ms)
+
     # SORT-MERGE join batches cap at 4: the body vmaps (r5 — chained
     # ratios reversed the r4 lax.map conclusion), but per-query device
     # time is flat past bs=4 (chip saturated by the sorts) while the
@@ -2303,6 +2404,19 @@ class DeviceSegmentStore:
         self.join_fallbacks = 0
         self.join_degraded_plain = 0  # join-shaped, served by rank_term
         #   (every exclusion was a nonexistent term)
+        # batched dense rerank (the hybrid second stage as a pipeline
+        # kernel family — ROADMAP item 1): dispatches vs queries gives
+        # the mean coalescing factor the bench gate asserts (>1 under
+        # concurrent hybrid load); cache hits serve with ZERO device
+        # work; fallbacks took the host-gather legacy path
+        self.rerank_dispatches = 0
+        self.rerank_queries = 0
+        self.rerank_cache_hits = 0
+        self.rerank_fallbacks = 0
+        # the dense doc-vector store (attach_dense): source of the
+        # device-resident forward index the rerank kernels gather from
+        self._dense = None
+        self._rerank_batching = False   # set by enable_batching
         # (term, filters, snapshot ids) -> filtered normalization stats;
         # lets a repeated modifier query skip the stream scan's stats
         # pass (bounded; cleared wholesale when full — snapshot churn
@@ -2522,7 +2636,8 @@ class DeviceSegmentStore:
                         prewarm: bool | None = None,
                         scan_batching: bool = False,
                         completer_depth: int = 2,
-                        pipeline: bool = True) -> None:
+                        pipeline: bool = True,
+                        rerank_batching: bool = True) -> None:
         """Coalesce concurrent pruned queries into pooled batch dispatches.
 
         `prewarm` compiles every escalation shape in a background thread
@@ -2531,8 +2646,13 @@ class DeviceSegmentStore:
         `scan_batching` (config index.device.scanBatching) additionally
         routes exact stream scans — the constraint-filtered queries that
         rode solo dispatches in the r5 modifier mix — through the same
-        batcher."""
+        batcher. `rerank_batching` (config index.device.rerankBatching,
+        on by default — the --rerank-overhead gate commits the win)
+        routes hybrid dense reranks through it too; off, reranks
+        dispatch the same packed kernel solo (the parity-test A/B
+        switch)."""
         self._scan_batching = bool(scan_batching)
+        self._rerank_batching = bool(rerank_batching)
         if self._batcher is None:
             self._batcher = _QueryBatcher(self, max_batch=max_batch,
                                           dispatchers=dispatchers,
@@ -2671,6 +2791,27 @@ class DeviceSegmentStore:
                                  *consts, k=kk, n_spans=self.MAX_SPANS,
                                  with_delta=False, with_filter=wf,
                                  with_ext_stats=ext))
+            # the rerank family at the current forward-index shape: the
+            # hybrid second stage must never compile mid-traffic either.
+            # Its lane bucket is rerank_bucket(len(sparse answer)) — a
+            # term with fewer matches than k lands on ANY pow2 below the
+            # kk ladder, so every bucket up to max(kks) is reachable,
+            # not just the ladder values (ladder-first ordering: those
+            # are still the common case)
+            if self._dense is not None:
+                got = self._dense.device_block(self.arena.device)
+                if got is not None:
+                    from ..ops.dense import _rerank_fwd_batch_packed_kernel
+                    fwd, _v = got
+                    dim = int(fwd.shape[1])
+                    nbs = list(kks) + [
+                        b for b in (16 << i for i in range(20))
+                        if b <= max(kks) and b not in kks]
+                    for nb in nbs:
+                        qi0 = np.zeros((bs, 2 + 2 * nb + dim), np.int32)
+                        warm(lambda nb=nb, qi0=qi0, fwd=fwd:
+                             _rerank_fwd_batch_packed_kernel(
+                                 fwd, qi0, nb=nb, bs=bs))
             self.measure_tunnel_rt()
             track(EClass.INDEX, "devstore_prewarm", warmed[0])
             log.info("prewarm: %d kernel shapes in %.1fs", warmed[0],
@@ -2697,10 +2838,14 @@ class DeviceSegmentStore:
         return False
 
     def _prewarm_shape_key(self) -> tuple:
-        """Everything that re-keys a kernel compile: buffer capacities
-        AND the b=1 tail-walk bucket (callers hold self._lock)."""
+        """Everything that re-keys a kernel compile: buffer capacities,
+        the b=1 tail-walk bucket, and the forward-index row bucket
+        (callers hold self._lock)."""
+        fwd_rows = (self._dense.device_rows()
+                    if self._dense is not None else 0)
         return (self.arena._cap, self.arena._doc_cap, self.arena._tcap,
-                _pmax_window(self._max_tcount), self._filter_words)
+                _pmax_window(self._max_tcount), self._filter_words,
+                fwd_rows)
 
     def measure_tunnel_rt(self, samples: int = 5) -> float:
         """Floor-estimate the trivial dispatch+fetch round trip to the
@@ -2776,6 +2921,14 @@ class DeviceSegmentStore:
             "join_served": self.join_served,
             "join_fallbacks": self.join_fallbacks,
             "join_degraded_plain": self.join_degraded_plain,
+            # batched hybrid rerank: queries / dispatches is the mean
+            # coalescing factor (the --rerank-overhead gate asserts > 1
+            # under concurrent hybrid load); cache hits are full hybrid
+            # answers served with zero device work
+            "rerank_dispatches": self.rerank_dispatches,
+            "rerank_queries": self.rerank_queries,
+            "rerank_cache_hits": self.rerank_cache_hits,
+            "rerank_fallbacks": self.rerank_fallbacks,
             "batch_dispatches": b.dispatches if b else 0,
             "batch_dispatch_ms_max": round(b.dispatch_ms_max, 1) if b
             else 0.0,
@@ -3281,6 +3434,146 @@ class DeviceSegmentStore:
                 ev = self._filter_inflight.pop(combo, None)
             if ev is not None:
                 ev.set()
+
+    # -- batched hybrid dense rerank (the forward-index kernel family) ------
+
+    def attach_dense(self, dense) -> None:
+        """Wire the segment's DenseVectorStore: its device-resident
+        forward index is what the rerank kernels gather doc vectors
+        from, and its content version keys the hybrid top-k cache."""
+        self._dense = dense
+
+    def rerank_boost(self, qvec, sparse_scores, docids, alpha):
+        """Dense rerank of one query's sparse top-k on device — the
+        hybrid second stage as a first-class batcher kernel family.
+
+        Gathers the candidates' doc vectors from the device-resident
+        forward index (no host-side get_block gather + per-query
+        upload), blends the fixed-scale cosine boost into the sparse
+        cardinal scores (dense_boost_topk semantics) and returns
+        (scores, docids) best-first under the pinned (score DESC,
+        docid ASC) tie discipline. Routed through the _QueryBatcher
+        (`rerank` part kind) when rerank batching is on, so concurrent
+        hybrid queries coalesce into ONE MXU dispatch riding the
+        issue→completer pipeline; otherwise (or on timeout) the SAME
+        packed kernel dispatches solo at the shared compile shape.
+        Returns None when no forward index is available (no dense store
+        attached, or the block exceeds its device budget) — the caller
+        keeps the host-gather legacy path."""
+        from ..ops.dense import (RERANK_MAX_N,
+                                 _rerank_fwd_batch_packed_kernel,
+                                 pack_rerank_row, rerank_bucket)
+        dense = self._dense
+        if dense is None:
+            return None
+        n = int(len(docids))
+        if n == 0:
+            return (np.empty(0, np.int32), np.empty(0, np.int32))
+        if n > RERANK_MAX_N:
+            with self._lock:
+                self.rerank_fallbacks += 1
+            return None
+        got = dense.device_block(self.arena.device)
+        if got is None:
+            with self._lock:
+                self.rerank_fallbacks += 1
+            return None
+        fwd, _ver = got
+        nb = rerank_bucket(n)
+        row = pack_rerank_row(qvec, sparse_scores, docids, alpha, nb)
+        if (self._rerank_batching and self._batcher is not None
+                and threading.current_thread()
+                not in self._batcher._threads):
+            res = self._batcher.submit_rerank(row, nb, n, fwd)
+            if res[0] == "ok":
+                return res[1], res[2]
+            # "timeout": the solo dispatch below serves the query along
+            # the same compile shape (bs=max_batch with pad slots)
+        bs = self._batcher.max_batch if self._batcher is not None else 1
+        qi = np.zeros((bs, len(row)), np.int32)
+        qi[0] = row
+        t0 = time.perf_counter()
+        out = _rerank_fwd_batch_packed_kernel(fwd, qi, nb=nb, bs=bs)
+        t1 = time.perf_counter()
+        host = jax.device_get(out)
+        self.count_round_trip()
+        _emit_rt_spans((t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3)
+        PROFILER.record(
+            "_rerank_fwd_batch_packed_kernel",
+            max(time.perf_counter() - t0 - self.tunnel_rt_ms / 1e3, 1e-6),
+            queries=1, bs=bs, nb=nb, dim=int(fwd.shape[1]),
+            cap=int(fwd.shape[0]))
+        with self._lock:
+            self.rerank_dispatches += 1
+            self.rerank_queries += 1
+        return host[0, :n], host[0, nb:nb + n]
+
+    def hybrid_vector_version(self) -> int:
+        """The attached dense store's vector-content version (-1 when no
+        dense store) — callers snapshot it BEFORE computing a hybrid
+        answer and key the cache put on the snapshot (see
+        hybrid_cache_put)."""
+        dense = self._dense
+        return dense.version if dense is not None else -1
+
+    def _hybrid_cache_key(self, termhash: bytes, profile, language: str,
+                          k: int, alpha, dv: int | None = None) -> tuple:
+        """Hybrid entries extend the sparse cache key with the blend
+        alpha, the ENCODER version and the vector-content version: an
+        encoder swap or any vector write re-keys every hybrid entry
+        (the arena epoch the entry carries only covers postings
+        mutations). Keyed on the EXACT k, not the kk bucket — the
+        rerank input is the sparse stage's [:k] trim, so entries from
+        different k are different answers."""
+        from ..ops.dense import ENCODER_VERSION
+        if dv is None:
+            dv = self.hybrid_vector_version()
+        return (termhash, profile.to_external_string(), language, k,
+                "hybrid", round(float(alpha), 6), ENCODER_VERSION, dv)
+
+    def hybrid_cache_get(self, termhash: bytes, profile,
+                         language: str = "en", k: int = 100,
+                         alpha: float = 0.5):
+        """Versioned top-k cache lookup for a FULL hybrid answer
+        (sparse rank + dense rerank) — ZERO device work on a hit,
+        bit-identical to the cold two-stage path. Same freshness gates
+        as rank_cache_get: live arena epoch, no unflushed RAM delta;
+        encoder/vector changes invalidate through the key itself."""
+        with self.rwi._lock:
+            if self.rwi._ram.get(termhash):
+                return None
+        with self._lock:
+            epoch = self.arena_epoch
+        got = self._topk_cache.get(
+            self._hybrid_cache_key(termhash, profile, language, k, alpha),
+            epoch)
+        if got is None:
+            return None
+        s, d, considered = got
+        with self._lock:
+            self.rerank_cache_hits += 1
+            self.queries_served += 1
+        return s, d, considered
+
+    def hybrid_cache_put(self, termhash: bytes, profile, language: str,
+                         k: int, alpha: float, epoch0: int, s, d,
+                         considered: int, dv0: int | None = None) -> None:
+        """Insert a computed hybrid answer under the epoch captured
+        BEFORE its sparse stage ran: any postings mutation since leaves
+        the entry born-stale (recomputed next lookup), never served.
+
+        dv0 is the vector-content version snapshotted at the same point
+        (hybrid_vector_version) — keying the put on the LIVE version
+        instead would let a vector write that races the rerank file the
+        pre-write answer under the post-write key, where lookups would
+        serve it as fresh. Under the snapshot key a raced entry is
+        simply unreachable (lookups key on the live version, which has
+        moved past it). None keys on the live version — only for
+        callers that know no write can race (tests)."""
+        self._topk_cache.put(
+            self._hybrid_cache_key(termhash, profile, language, k, alpha,
+                                   dv=dv0),
+            epoch0, np.asarray(s), np.asarray(d), considered)
 
     def rank_cache_get(self, termhash: bytes, profile,
                        language: str = "en", k: int = 100):

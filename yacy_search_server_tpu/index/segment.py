@@ -97,6 +97,9 @@ class Segment:
         if self.devstore is None:
             self.devstore = DeviceSegmentStore(
                 self.rwi, device=device, budget_bytes=budget_bytes)
+            # hybrid rerank serves from the device-resident forward
+            # index of this segment's doc vectors (batched second stage)
+            self.devstore.attach_dense(self.dense)
         return self.devstore
 
     def enable_mesh_serving(self, devices=None, n_term: int = 1,

@@ -22,6 +22,7 @@ from zlib import crc32
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 DIM = 256
 _SEED = 0x5EED
@@ -157,6 +158,123 @@ def dense_boost_topk(qvec: jnp.ndarray, doc_vecs: jnp.ndarray,
     final = sparse_scores.astype(jnp.int32) + boost
     final = jnp.where(valid, final, jnp.int32(-(2**31 - 1)))
     return jax.lax.top_k(final, k)
+
+
+# -- batched serving rerank over the device-resident forward index ----------
+#
+# The serving path's rerank (cardinal-domain boost, one score domain with
+# the sparse first stage) as a BATCHED kernel family: B concurrent
+# queries' candidate sets gather their doc vectors from one device-
+# resident forward index (index/dense.DenseVectorStore.device_block) and
+# contract against their query vectors in a single bf16 MXU dispatch —
+# the (B,dim)x(dim,N) shape hybrid_rerank_topk_batch proved (7.08x CPU)
+# finally wired into serving, riding the devstore _QueryBatcher's
+# issue→completer pipeline like every other kernel family.
+#
+# Tie discipline (arxiv 1807.05798): the final order is (score DESC,
+# then internal docid ASC) — pinned so solo/batched/packed/cached rerank
+# paths can never disagree on ties, which would flap the versioned
+# top-k result cache between bit-different answers of equal score.
+
+# candidate-count buckets (pow2, min 16) bound the compile-shape count;
+# pad lanes carry docid -1 and are masked by the per-slot valid count
+RERANK_MAX_N = 1 << 14
+
+
+def rerank_bucket(n: int) -> int:
+    """Static candidate-lane bucket for one rerank slot."""
+    return 1 << max(4, (max(n, 1) - 1).bit_length())
+
+
+def pack_rerank_row(qvec: np.ndarray, sparse_scores: np.ndarray,
+                    docids: np.ndarray, alpha: float, nb: int) -> np.ndarray:
+    """ONE fused int32 descriptor for one rerank slot — qvec (bit-cast
+    float32), sparse cardinal scores, candidate docids and the blend
+    alpha ride a single host buffer, so a dispatch wave is one
+    host->device transfer (each separate argument is a full round trip
+    through a remote tunnel — the M78 packing lesson).
+
+    Layout: [n_valid, alpha_bits, docids[nb], sparse[nb], qvec_bits[dim]].
+    """
+    n = len(docids)
+    dim = len(qvec)
+    row = np.zeros(2 + 2 * nb + dim, np.int32)
+    row[0] = n
+    row[1] = np.float32(alpha).view(np.int32)
+    row[2:2 + n] = np.asarray(docids, np.int32)
+    row[2 + nb:2 + nb + n] = np.asarray(sparse_scores, np.int32)
+    row[2 + 2 * nb:] = np.asarray(qvec, np.float32).view(np.int32)
+    return row
+
+
+@functools.partial(jax.jit, static_argnames=("nb", "bs"))
+def _rerank_fwd_batch_packed_kernel(fwd, qi, nb: int, bs: int):
+    """Batched cardinal-domain dense rerank against the device-resident
+    forward index, packed I/O: `qi` [bs, 2 + 2*nb + dim] fused
+    descriptors (pack_rerank_row), output [bs, 2*nb] = scores ++ docids
+    per slot — ONE transfer each way per dispatch wave.
+
+    Each slot gathers its candidates' doc vectors from `fwd`
+    ([cap, dim] float16), contracts them against its query vector in
+    bf16 (f32 accumulate — the MXU shape), adds the fixed-scale boost
+    into the sparse cardinal scores (dense_boost_topk semantics, slot
+    for slot), and sorts by (score DESC, docid ASC) — the pinned tie
+    discipline. Candidates OUTSIDE the forward index's coverage (no
+    vector stored yet) keep their sparse score with zero boost — vector
+    absence must never drop a sparse result. Pad lanes (beyond a slot's
+    n_valid) sort last with NEG_INF scores."""
+    dim = fwd.shape[1]
+    cap = fwd.shape[0]
+    nvalid = qi[:, 0]
+    alpha = lax.bitcast_convert_type(qi[:, 1], jnp.float32)
+    docids = qi[:, 2:2 + nb]
+    sparse = qi[:, 2 + nb:2 + 2 * nb]
+    qvecs = lax.bitcast_convert_type(qi[:, 2 + 2 * nb:], jnp.float32)
+    dv = fwd[jnp.clip(docids, 0, cap - 1)]          # (bs, nb, dim) gather
+    sims = jnp.einsum("bd,bnd->bn", qvecs.astype(jnp.bfloat16),
+                      dv.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+    in_cov = (docids >= 0) & (docids < cap)
+    sims = jnp.where(in_cov, sims, 0.0)
+    boost = jnp.round(sims * alpha[:, None]
+                      * DENSE_BOOST_SCALE).astype(jnp.int32)
+    lanes = jnp.arange(nb)[None, :]
+    valid = lanes < nvalid[:, None]
+    neg = jnp.int32(-(2 ** 31 - 1))
+    final = jnp.where(valid, sparse + boost, neg)
+    # (score DESC, docid ASC): ascending two-key sort on (-score, docid);
+    # pad lanes tie-key to INT32_MAX so they stay behind real candidates
+    skey = -final
+    tkey = jnp.where(valid, docids, jnp.int32(2 ** 31 - 1))
+
+    def one(sk, tk, f, d):
+        _sk, _tk, fs, ds = lax.sort((sk, tk, f, d), num_keys=2)
+        return fs, ds
+
+    fs, ds = jax.vmap(one)(skey, tkey, final, docids)
+    return jnp.concatenate([fs, ds], axis=1)
+
+
+def rerank_fwd_np(qvec, fwd, sparse_scores, docids, alpha):
+    """CPU oracle for _rerank_fwd_batch_packed_kernel (one slot):
+    bf16-rounded matmul inputs like the kernel, float32 accumulation,
+    and the SAME (score DESC, docid ASC) tie discipline. Accumulation
+    order may still differ from the device dot (a few units of rounded
+    boost) — compare closeness per docid, not bit-exact scores; device
+    paths among THEMSELVES are bit-exact at a shared compile shape."""
+    import ml_dtypes
+    docids = np.asarray(docids, np.int64)
+    in_cov = (docids >= 0) & (docids < fwd.shape[0])
+    dv = fwd[np.clip(docids, 0, fwd.shape[0] - 1)]
+    sims = (dv.astype(ml_dtypes.bfloat16).astype(np.float32)
+            @ np.asarray(qvec).astype(ml_dtypes.bfloat16)
+            .astype(np.float32))
+    sims = np.where(in_cov, sims, 0.0)
+    boost = np.round(sims * np.float32(alpha)
+                     * np.float32(DENSE_BOOST_SCALE)).astype(np.int32)
+    final = np.asarray(sparse_scores, np.int32) + boost
+    order = np.lexsort((docids, -final.astype(np.int64)))
+    return final[order], np.asarray(docids, np.int32)[order]
 
 
 def dense_boost_topk_np(qvec, doc_vecs, sparse_scores, valid, alpha, k):

@@ -356,6 +356,33 @@ def _c_dense_boost(n: int, dim: int = 256, k: int = 100) -> Cost:
                 xla_bytes=(4.0 * dim + 29.0) * n)
 
 
+# batched forward-index rerank (the hybrid second stage as a batcher
+# kernel family): per candidate lane one dim-wide bf16 dot (2·dim) +
+# blend/round + the two-key (score, docid) tie sort ≈ 545, plus a
+# per-slot descriptor decode ≈ 650. XLA bytes: the whole-operand
+# forward index (gather charges the full array) + 2128/lane + 3086/slot
+# — exact at (nb, bs) in {16..1024}×{4..16}, dim 256 (jax 0.4.37 CPU)
+_RERANK_FLOPS_LANE_EXTRA = 545.0
+_RERANK_FLOPS_SLOT = 650.0
+_RERANK_XBYTES_LANE = 2128.0
+_RERANK_XBYTES_SLOT = 3086.0
+
+
+def _c_rerank_fwd_batch(bs: int = 16, nb: int = 128, dim: int = 256,
+                        cap: int = 0) -> Cost:
+    """_rerank_fwd_batch_packed_kernel: bs slots × nb candidate lanes
+    gathering from a [cap, dim] f16 forward index. Compulsory traffic:
+    the gathered doc vectors (2·dim B/lane), the fused descriptor in,
+    the packed scores++docids out."""
+    lanes = bs * nb
+    return Cost(flops=(2.0 * dim + _RERANK_FLOPS_LANE_EXTRA) * lanes
+                + _RERANK_FLOPS_SLOT * bs,
+                bytes=2 * dim * lanes + 4 * (2 + 2 * nb + dim) * bs
+                + 8 * lanes,
+                xla_bytes=2 * cap * dim + _RERANK_XBYTES_LANE * lanes
+                + _RERANK_XBYTES_SLOT * bs)
+
+
 def _c_power_iterate(n: int, edges: int, iters: int = 1) -> Cost:
     """BlockRank power iteration (ops/blockrank._power_iterate_sparse):
     per-iteration segment-sum over the edge list, × the trip count (the
@@ -392,6 +419,7 @@ KERNELS: dict[str, object] = {
     # wrapped body IS the unpacked kernel, so the cost model is shared —
     # the concat epilogue is noise against the row streams
     "score_topk16_packed": _c_score_topk16,
+    "_rerank_fwd_batch_packed_kernel": _c_rerank_fwd_batch,
     "_rank_spans_packed_kernel": _c_rank_spans,
     "_rank_pruned_batch1_packed_kernel": _c_rank_pruned_batch1,
     "_rank_scan_batch_packed_kernel": _c_rank_spans,

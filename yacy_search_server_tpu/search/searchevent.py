@@ -162,6 +162,11 @@ class SearchEvent:
         q = self.query
         k_need = max(q.item_count + q.offset, 10) * TOPK_OVERSAMPLE
 
+        # hybrid-cache plumbing: _device_local may serve a FULL cached
+        # hybrid answer (rerank included, zero device work) or hand back
+        # the put context for inserting the one computed below
+        self._rerank_done = False
+        self._hybrid_put = None
         # steady-state path: rank placed device blocks (uploads only the
         # RAM delta); None -> host path (term not resident / query shape
         # needs host-side data)
@@ -170,9 +175,15 @@ class SearchEvent:
             scores, docids, self.local_rwi_considered = placed
             if len(docids) == 0:
                 return
-            if q.hybrid:
+            if q.hybrid and not self._rerank_done:
                 with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
                     scores, docids = self._dense_rerank(scores, docids)
+                if self._hybrid_put is not None:
+                    ds, th, epoch0, dv0 = self._hybrid_put
+                    ds.hybrid_cache_put(
+                        th, q.profile, q.lang, k_need, q.hybrid_alpha,
+                        epoch0, scores, docids,
+                        self.local_rwi_considered, dv0=dv0)
             self._fill_results(scores, docids)
             return
 
@@ -296,6 +307,35 @@ class SearchEvent:
         # the small-candidate host gate (count_upper takes the RWI lock
         # and a cache hit is cheaper than even that host scoring)
         if unconstrained:
+            # hybrid queries peek the HYBRID cache first: a hit is the
+            # full two-stage answer (sparse rank + dense rerank),
+            # bit-identical with zero device work; keyed additionally
+            # on (alpha, encoder version, vector version) so it can
+            # never survive an encoder swap or a vector write. A miss
+            # remembers the put context — the epoch BEFORE the sparse
+            # stage runs, so a racing flush leaves the entry born-stale
+            if q.hybrid:
+                hpeek = getattr(ds, "hybrid_cache_get", None)
+                if hpeek is not None:
+                    q0 = time.perf_counter()
+                    got = hpeek(inc[0], q.profile, q.lang, k,
+                                q.hybrid_alpha)
+                    if got is not None:
+                        wall_ms = (time.perf_counter() - q0) * 1000.0
+                        track(EClass.SEARCH, "DEVRANK", len(got[1]),
+                              wall_ms)
+                        tracing.emit("search.devrank", wall_ms,
+                                     cache="hybrid_hit")
+                        self._rerank_done = True
+                        return got
+                    # the vector-content version is snapshotted HERE,
+                    # with the epoch: a vector write racing the rerank
+                    # below must leave the entry unreachable, not filed
+                    # under the post-write key as if fresh
+                    self._hybrid_put = (ds, inc[0], ds.arena_epoch,
+                                        ds.hybrid_vector_version())
+            # the sparse peek still serves hybrid queries' FIRST stage
+            # (a hybrid-cache miss can ride a sparse hit into rerank)
             peek = getattr(ds, "rank_cache_get", None)
             if peek is not None:
                 q0 = time.perf_counter()
@@ -400,24 +440,50 @@ class SearchEvent:
 
     def _dense_rerank(self, scores, docids):
         """M7 second stage: add dense cosine similarity into the sparse
-        cardinal scores on device (ops/dense.dense_boost_topk). One score
-        domain throughout — the boost has a FIXED scale, so fusion with
-        remote results never depends on the local batch's score range."""
+        cardinal scores on device. One score domain throughout — the
+        boost has a FIXED scale, so fusion with remote results never
+        depends on the local batch's score range.
+
+        Steady state rides the devstore's batched forward-index kernel
+        (rerank_boost): candidates gather their doc vectors ON DEVICE
+        and concurrent hybrid queries coalesce into one MXU dispatch
+        through the pipelined batcher — the per-query get_block gather
+        + solo dense_boost_topk hop only survives as the fallback for
+        stores without a device path (mesh store, over-budget forward
+        index). Both paths order ties by (score DESC, docid ASC) — the
+        pinned discipline that keeps solo/batched/packed/cached rerank
+        answers identical (arxiv 1807.05798)."""
+        q = self.query
+        qtext = " ".join(self.query.include_words())
+        qvec = self.segment.encoder.encode(qtext)
+        docids = np.asarray(docids)
+        sparse = np.asarray(scores, dtype=np.int64)
+        ds = self.segment.devstore
+        rb = getattr(ds, "rerank_boost", None) if ds is not None else None
+        if rb is not None:
+            got = rb(qvec, sparse.astype(np.int32),
+                     docids.astype(np.int32), q.hybrid_alpha)
+            if got is not None:
+                s, d = got
+                return np.asarray(s, dtype=np.int64), np.asarray(d)
+        # host-gather legacy path (no device store / no device-resident
+        # forward index): per-query block upload + solo kernel
         import jax.numpy as jnp
 
         from ..ops.dense import dense_boost_topk
 
-        q = self.query
-        qtext = " ".join(self.query.include_words())
-        qvec = self.segment.encoder.encode(qtext)
-        doc_vecs = self.segment.dense.get_block(np.asarray(docids))
+        doc_vecs = self.segment.dense.get_block(docids)
         k = int(len(docids))
         final, order = dense_boost_topk(
             jnp.asarray(qvec), jnp.asarray(doc_vecs),
-            jnp.asarray(np.asarray(scores, dtype=np.int32)),
+            jnp.asarray(sparse.astype(np.int32)),
             jnp.ones(k, dtype=bool), jnp.float32(q.hybrid_alpha), k)
-        return (np.asarray(final, dtype=np.int64),
-                np.asarray(docids)[np.asarray(order)])
+        final = np.asarray(final, dtype=np.int64)
+        dd = docids[np.asarray(order)]
+        # re-assert the tie discipline (lax.top_k orders ties by input
+        # position, i.e. sparse rank): score DESC, then docid ASC
+        tie = np.lexsort((dd, -final))
+        return final[tie], dd[tie]
 
     def _constraint_mask(self, plist) -> np.ndarray:
         """Vector filters replacing the reference's per-row checks in

@@ -476,6 +476,11 @@ def prometheus_text(sb, include_buckets: bool = True,
                 # versioned top-k result cache (hits serve with zero
                 # device work; stale = correct epoch invalidations)
                 "rank_cache_hits", "rank_cache_stale",
+                # batched hybrid rerank: queries/dispatches = mean
+                # coalescing factor; cache hits = full hybrid answers
+                # served without touching the device
+                "rerank_dispatches", "rerank_queries",
+                "rerank_cache_hits", "rerank_fallbacks",
                 "device_round_trips"):
         p.sample("yacy_device_serving_total", c.get(key, 0),
                  {"counter": key})

@@ -134,7 +134,14 @@ class Switchboard:
                         pipeline=self.config.get_bool(
                             "index.device.pipeline", True),
                         completer_depth=self.config.get_int(
-                            "index.device.completerDepth", 2))
+                            "index.device.completerDepth", 2),
+                        # batch hybrid dense reranks through the same
+                        # pipeline (on by default — the last solo
+                        # kernel; bench --rerank-overhead pins the
+                        # gate); off = solo dispatches of the same
+                        # packed kernel, the parity-test A/B switch
+                        rerank_batching=self.config.get_bool(
+                            "index.device.rerankBatching", True))
             except ValueError:
                 raise
             except Exception:  # no usable jax backend: host path serves
