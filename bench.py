@@ -229,7 +229,8 @@ def _config5_hybrid(k=100, ndocs=100_000, iters=20):
 
 
 def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
-                              mesh: str = "auto", batch_size: int | None = None):
+                              mesh: str = "auto", batch_size: int | None = None,
+                              config_extra: dict | None = None):
     """A Switchboard whose index holds `n_terms` hot terms with `n`
     postings each, plus real metadata rows for every doc — the served-path
     workload (distinct query strings so the event cache never aliases).
@@ -248,6 +249,8 @@ def _build_served_switchboard(n: int, n_terms: int = 8, hosts: int = 4096,
     cfg.set("index.device.mesh", mesh)
     if batch_size is not None:
         cfg.set("index.device.batchSize", str(batch_size))
+    for _k, _v in (config_extra or {}).items():
+        cfg.set(_k, _v)
     # the PRODUCT store topology: disk-backed metadata (mmap segments).
     # A RAM-only tail at 10M docs means 30M+ live Python strings, and a
     # major-GC pass over that heap holds the GIL for SECONDS — the last
@@ -1073,6 +1076,35 @@ def _roofline_mode(n: int, k: int = 16):
               f16, fl, dd, dead, pmax, sb1, cnt1, tst1, tct1, cmin,
               cmax, tmin, tmax, shift, lang_term, *consts, k=k, b=b_esc),
           queries=bs, b=b_esc, tile=TILE, bs=bs, k=k)
+    # bit-packed (compressed-residency) fused-decode twins: the SAME
+    # rows bit-packed (ops/packed.py), scored straight from the words
+    from yacy_search_server_tpu.ops import packed as PK
+    pb = PK.pack_block(f16_np[:rows], fl_np[:rows],
+                       np.arange(rows, dtype=np.int32))
+    pwords = put(pb.words)
+    pw_cap = int(pb.words.shape[0])
+    metas = np.tile(pb.meta_vector(), (bs, 1)).astype(np.int32)
+    qiq_bp, _nbs = DS._pack_batch1_bp(sb1, cnt1, tst1, tct1, metas,
+                                      cmin, cmax, tmin, tmax, shift,
+                                      lang_term)
+    timed("_rank_pruned_batch1_bp_kernel",
+          lambda: DS._rank_pruned_batch1_bp_kernel(
+              pwords, dead, pmax, qiq_bp, *consts, k=k, maxt=maxt,
+              bs=nbs),
+          queries=bs, bs=bs, tile=TILE, maxt=maxt, k=k,
+          row_bits=pb.row_bits, pw_cap=pw_cap, doc_cap=doc_cap,
+          tcap=tcap)
+    qi_sbp = np.zeros((bs, 6 + PK.META_LEN), np.int32)
+    qi_sbp[:, 1] = rows
+    qi_sbp[:, 2:2 + PK.META_LEN] = pb.meta_vector()
+    qi_sbp[:, 3 + PK.META_LEN] = DS.NO_FLAG
+    qi_sbp[:, 4 + PK.META_LEN] = DS.DAYS_NONE_LO
+    qi_sbp[:, 5 + PK.META_LEN] = DS.DAYS_NONE_HI
+    timed("_rank_scan_batch_bp_kernel",
+          lambda: DS._rank_scan_batch_bp_kernel(
+              pwords, dead, qi_sbp, *consts, k=k, bs=bs),
+          queries=bs, rows=bs * rows, k=k, bs=bs, row_bits=pb.row_bits,
+          pw_cap=pw_cap, doc_cap=doc_cap)
     r_join = min(rows, DS.DeviceSegmentStore.MAX_JOIN_ROWS)
     m_join = min(r_join, 1 << 16)
     qargs = np.zeros((4, 9), np.int32)
@@ -1636,6 +1668,264 @@ def _rerank_overhead_mode(n: int, threads: int = 32, per_thread: int = 10,
         f"(budget {budget}%, tunnel_rt {ds.tunnel_rt_ms} ms)")
 
 
+def _capacity_feats(rng, n: int) -> "np.ndarray":
+    """Posting attributes with REALISTIC column ranges (the semantics of
+    index/postings.py: counts, clipped positions, day stamps, small
+    bitfields). The classic bench corpus draws uniform 0..1000 in every
+    column — a 10-bit-entropy-everywhere adversary no crawl produces —
+    so the capacity corpus states the compression claim on honest
+    ranges. All values stay inside the int16 compact-block domain, so
+    the int16 and packed paths score identical inputs."""
+    from yacy_search_server_tpu.index import postings as P
+    feats = np.zeros((n, P.NF), np.int32)
+    feats[:, P.F_LASTMOD] = rng.integers(18000, 20000, n)  # ~5y window
+    feats[:, P.F_WORDS_IN_TITLE] = rng.integers(0, 24, n)
+    feats[:, P.F_WORDS_IN_TEXT] = rng.integers(0, 2000, n)
+    feats[:, P.F_PHRASES_IN_TEXT] = rng.integers(0, 200, n)
+    feats[:, P.F_DOCTYPE] = rng.integers(0, 8, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    feats[:, P.F_LLOCAL] = rng.integers(0, 100, n)
+    feats[:, P.F_LOTHER] = rng.integers(0, 100, n)
+    feats[:, P.F_URL_LENGTH] = rng.integers(10, 200, n)
+    feats[:, P.F_URL_COMPS] = rng.integers(1, 16, n)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+    feats[:, P.F_HITCOUNT] = rng.integers(1, 255, n)
+    feats[:, P.F_POSINTEXT] = rng.integers(1, 4096, n)
+    feats[:, P.F_POSINPHRASE] = rng.integers(0, 128, n)
+    feats[:, P.F_POSOFPHRASE] = rng.integers(0, 128, n)
+    feats[:, P.F_WORDDISTANCE] = rng.integers(0, 64, n)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    return feats
+
+
+def _capacity_row(total: int, threads: int, soak_s: float, k: int,
+                  batch_size: int, budget_bytes: int,
+                  per_term: int = 5_000_000) -> dict:
+    """One --capacity measurement row: a `total`-posting packed-residency
+    devstore under the shared 2 GiB arena budget, soaked with `threads`
+    rank_term searchers (top-k cache disabled: every query dispatches).
+    Returns p50/p95/qps + the compression + roofline + tier surfaces."""
+    import threading as _th
+
+    from yacy_search_server_tpu.index import postings as P
+    from yacy_search_server_tpu.index.devstore import DeviceSegmentStore
+    from yacy_search_server_tpu.index.postings import PostingsList
+    from yacy_search_server_tpu.index.rwi import RWIIndex
+    from yacy_search_server_tpu.ops.ranking import RankingProfile
+    from yacy_search_server_tpu.utils.hashes import word2hash
+    from yacy_search_server_tpu.utils.profiler import PROFILER
+
+    rng = np.random.default_rng(41)
+    rwi = RWIIndex()
+    terms = []
+    left = total
+    ti = 0
+    while left > 0:
+        n = min(per_term, left)
+        th = word2hash(f"capterm{ti}")
+        docids = np.arange(n, dtype=np.int32)
+        rwi.ingest_run({th: PostingsList(docids, _capacity_feats(rng, n))})
+        terms.append(th)
+        left -= n
+        ti += 1
+    t_pack = time.perf_counter()
+    ds = DeviceSegmentStore(rwi, budget_bytes=budget_bytes,
+                            packed_residency=True)
+    pack_s = time.perf_counter() - t_pack
+    ds.enable_batching(max_batch=batch_size, dispatchers=4, prewarm=False)
+    ds._topk_cache.enabled = False
+    prof = RankingProfile()
+    hot = sum(1 for e in ds._pblocks.values() if e["hot"])
+    print(json.dumps({"metric": "capacity_pack", "postings": total,
+                      "terms": len(terms), "hot_terms": hot,
+                      "pack_seconds": round(pack_s, 1)}),
+          file=sys.stderr)
+    # warm every term's compile shapes + promote any warm overflow
+    # (bounded: a term the budget cannot hold hot stays warm and its
+    # queries fall back — counted, never crashed on)
+    for th in terms:
+        warm_deadline = time.monotonic() + 30.0
+        while time.monotonic() < warm_deadline:
+            if ds.rank_term(th, prof, "en", k=k) is not None:
+                break
+            time.sleep(0.2)
+    PROFILER.clear()
+    lats: list = []
+    misses = [0]
+    lk = _th.Lock()
+    served0 = ds.queries_served
+    rt0 = ds.device_round_trips
+    deadline = time.perf_counter() + soak_s
+
+    def worker(t):
+        i = 0
+        while time.perf_counter() < deadline:
+            th = terms[(t + i) % len(terms)]
+            q0 = time.perf_counter()
+            r = ds.rank_term(th, prof, "en", k=k)
+            with lk:
+                if r is None:
+                    # warm/cold term: the product's host path would
+                    # serve it — here it counts as a paging miss and
+                    # stays in the latency record as the tier ladder's
+                    # cost, not a crash
+                    misses[0] += 1
+                else:
+                    assert len(r[0]) == k
+                lats.append(time.perf_counter() - q0)
+            i += 1
+
+    ts = [_th.Thread(target=worker, args=(t,)) for t in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.perf_counter() - t0
+    lats.sort()
+    served = ds.queries_served - served0
+    # roofline: the packed pruned kernel's achieved GB/s vs peak
+    pt = next((p for p in PROFILER.snapshot()
+               if p.kernel == "_rank_pruned_batch1_bp_kernel"), None)
+    with ds._lock:
+        packed_bytes = sum(e["block"].packed_bytes
+                           for e in ds._pblocks.values())
+        int16_bytes = sum(e["block"].int16_bytes
+                          for e in ds._pblocks.values())
+        row_bits = [e["block"].row_bits for e in ds._pblocks.values()]
+    c = ds.counters()
+    row = {
+        "postings": total,
+        "terms": len(terms),
+        "qps": round(served / dt, 3),
+        "p50_ms": round(lats[len(lats) // 2] * 1000, 2) if lats else 0.0,
+        "p95_ms": round(lats[int(len(lats) * 0.95)] * 1000, 2)
+        if lats else 0.0,
+        "queries": served,
+        "soak_seconds": round(dt, 1),
+        "pack_seconds": round(pack_s, 1),
+        "compression_ratio": c["packed_compression_ratio"],
+        "bytes_per_posting_packed": round(packed_bytes / total, 2),
+        "bytes_per_posting_int16": round(int16_bytes / total, 2),
+        "row_bits_mean": round(sum(row_bits) / max(len(row_bits), 1), 1),
+        "achieved_gbps": round(pt.achieved_bytes_per_s / 1e9, 4)
+        if pt else 0.0,
+        "util_pct": pt.util_pct if pt else 0.0,
+        "bound": pt.bound if pt else "",
+        "rt_per_query": round((ds.device_round_trips - rt0)
+                              / max(served, 1), 4),
+        "host_fallbacks": misses[0],
+        "tier_counters": {kk: c[kk] for kk in c
+                          if kk.startswith("tier_")},
+    }
+    ds.close()
+    return row
+
+
+def _capacity_mode(n_max: int, threads: int, soak_s: float, k: int,
+                   batch_size: int):
+    """--capacity (ISSUE 8): the compressed-residency capacity soak.
+    Measures the 10M reference row and the >=50M capacity row on the
+    same silicon, same budget — corpus size as a tiering decision, not
+    an HBM ceiling. Gates: p95(50M) <= 2x p95(10M); measured HBM
+    bytes/posting reduced >= 2x vs the int16 block format; the artifact
+    always carries the compression ratio and per-tier counters
+    (tests/test_code_hygiene.py validates the committed file)."""
+    import jax
+
+    budget = 2 << 30
+    n_max = max(n_max, 50_000_000)
+    rows = [_capacity_row(10_000_000, threads, soak_s, k, batch_size,
+                          budget),
+            _capacity_row(n_max, threads, soak_s, k, batch_size, budget)]
+    p95_ratio = rows[1]["p95_ms"] / max(rows[0]["p95_ms"], 1e-9)
+    # int16 residency at the capacity point, modeled the way the arena
+    # actually admits rows (doubling growth from the 4*TILE initial
+    # capacity, one spare tile): raw bytes/posting alone understates the
+    # footprint the budget check sees
+    from yacy_search_server_tpu.index.devstore import DeviceArena
+    cap_rows = 4 * 32_768
+    while cap_rows < n_max + 32_768:
+        cap_rows *= 2
+    int16_need = cap_rows * DeviceArena.row_bytes()
+    out = {
+        "metric": "capacity",
+        "device": jax.devices()[0].platform,
+        "threads": threads,
+        "budget_bytes": budget,
+        "rows": rows,
+        "p95_ratio_vs_10m": round(p95_ratio, 3),
+        "gate_p95_2x": bool(p95_ratio <= 2.0),
+        # the point of the exercise, stated in the artifact: the int16
+        # format could not hold the capacity row under this budget
+        "int16_bytes_at_max": int16_need,
+        "int16_fits_budget": bool(int16_need <= budget),
+        "bytes_reduction_vs_int16": round(
+            rows[1]["bytes_per_posting_int16"]
+            / max(rows[1]["bytes_per_posting_packed"], 1e-9), 3),
+    }
+    print(json.dumps(out))
+    assert out["gate_p95_2x"], (
+        f"capacity p95 {rows[1]['p95_ms']} ms is "
+        f"{p95_ratio:.2f}x the 10M row (budget 2x)")
+    assert out["bytes_reduction_vs_int16"] >= 2.0, (
+        f"packed bytes/posting only {out['bytes_reduction_vs_int16']}x "
+        f"below int16 (claim needs >= 2x)")
+    return out
+
+
+def _tier_overhead_mode(n: int, threads: int = 8, per_thread: int = 12,
+                        windows: int = 5,
+                        noise_budget_pct: float = 15.0):
+    """--tier-overhead (ISSUE 8): serving p50 with the tier ladder's
+    BOOKKEEPING (per-query LRU touch, miss-path tier lookups, promotion
+    triggers) on vs off, on the shared interleaved-window harness
+    (_ab_soak), with a fully hot-tier working set — the idle-path gate:
+    when nothing needs paging, tiering must cost < 2% p50 (strict where
+    round trips dominate; a noise budget on CPU/local backends, same
+    discipline as --rerank-overhead). Thread count stays below the
+    other modes' 16: the bookkeeping under test is nanoseconds per
+    query, and a 1-core box's 16-thread dispatch convoy swamps it with
+    multi-second scheduling variance (median-of-5 windows at 8 threads
+    keeps the A/B honest)."""
+    cfg_extra = {"index.device.packedResidency": "true"}
+    sb = _build_served_switchboard(n, n_terms=2, mesh="off",
+                                   config_extra=cfg_extra)
+    ds = sb.index.devstore
+    assert ds is not None and ds.packed_residency
+    assert all(e["hot"] for e in ds._pblocks.values()), \
+        "tier-overhead gate needs a fully hot working set"
+    ds._topk_cache.enabled = False
+
+    def set_mode(mode):
+        ds._tiering_enabled = mode
+
+    r = _ab_soak(sb, set_mode, threads=threads, per_thread=per_thread,
+                 windows=windows)
+    c = ds.counters()
+    print(json.dumps({
+        "metric": "tier_overhead",
+        "n_postings": n,
+        "threads": threads,
+        "queries_per_mode": r["queries_per_mode"],
+        "p50_ms_off": round(r["p50_off"], 3),
+        "p50_ms_on": round(r["p50_on"], 3),
+        "p95_ms_off": round(r["p95_off"], 3),
+        "p95_ms_on": round(r["p95_on"], 3),
+        "overhead_pct": round(r["overhead_pct"], 3),
+        "tier_hot_hits": c["tier_hot_hits"],
+        "tier_promotions_warm_hot": c["tier_promotions_warm_hot"],
+        "compression_ratio": c["packed_compression_ratio"],
+        "tunnel_rt_ms": ds.tunnel_rt_ms,
+    }))
+    assert c["tier_promotions_warm_hot"] == 0, \
+        "hot-only working set must not promote"
+    budget = 2.0 if ds.tunnel_rt_ms >= 5.0 else noise_budget_pct
+    assert r["overhead_pct"] <= budget, (
+        f"tier bookkeeping p50 overhead {r['overhead_pct']:.2f}% "
+        f"(budget {budget}%, tunnel_rt {ds.tunnel_rt_ms} ms)")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=10_000_000,
@@ -1688,6 +1978,18 @@ def main():
                          "windows); asserts batched p50 is no worse and "
                          "that the batched windows coalesce >1 mean "
                          "queries per rerank dispatch (ISSUE 6)")
+    ap.add_argument("--capacity", action="store_true",
+                    help="compressed-residency capacity soak (ISSUE 8): "
+                         "bit-packed residency at 10M and >=--n postings "
+                         "under one 2 GiB budget; gates p95 <= 2x the "
+                         "10M row and packed bytes/posting <= half the "
+                         "int16 format; emits compression ratio, "
+                         "achieved GB/s, util%% and per-tier counters")
+    ap.add_argument("--tier-overhead", action="store_true",
+                    help="tier-ladder bookkeeping p50 on vs off with a "
+                         "fully hot working set (interleaved windows); "
+                         "asserts the idle-path overhead stays < 2%% "
+                         "(noise budget on CPU backends)")
     ap.add_argument("--health-overhead", action="store_true",
                     help="serving p50/p95 with the histogram recording "
                          "+ health-rule tick on vs off, interleaved "
@@ -1698,6 +2000,15 @@ def main():
 
     if args.roofline:
         _roofline_mode(args.n, k=16)
+        return
+    if args.capacity:
+        _capacity_mode(args.n if args.n != 10_000_000 else 50_000_000,
+                       threads=min(args.threads, 16),
+                       soak_s=args.soak_seconds, k=10,
+                       batch_size=args.batch_size)
+        return
+    if args.tier_overhead:
+        _tier_overhead_mode(args.n if args.n != 10_000_000 else 200_000)
         return
     if args.trace_overhead:
         _trace_overhead_mode(args.n if args.n != 10_000_000 else 200_000)

@@ -186,6 +186,65 @@ def test_packed_kernel_variants_have_registered_cost_models():
         "for serving kernels):\n  " + "\n  ".join(missing))
 
 
+# -- compressed residency hygiene (ISSUE 8) ----------------------------------
+# Every bit-packed fused-decode kernel (`*_bp_kernel`) must carry BOTH a
+# roofline cost model registered BY NAME (counting the packed bytes —
+# EXEMPT is not acceptable for a serving kernel) and a NumPy oracle in
+# ops/packed.BP_ORACLES (the parity anchor the bit-identity contract
+# rests on). The scanner walks devstore's jitted kernels, so a new *_bp
+# variant cannot land unregistered.
+
+def test_bp_kernels_have_cost_models_and_numpy_oracles():
+    from yacy_search_server_tpu.ops import packed as PK
+    from yacy_search_server_tpu.ops import roofline
+
+    bp = [name for name in _named_kernels(PKG / "index" / "devstore.py")
+          if name.endswith("_bp_kernel")]
+    assert bp, "no *_bp kernels found (renamed? widen scanner)"
+    missing_cost = [k for k in bp if k not in roofline.KERNELS]
+    assert not missing_cost, (
+        "*_bp kernels without a roofline cost model (must count PACKED "
+        "bytes; register in ops/roofline.KERNELS):\n  "
+        + "\n  ".join(missing_cost))
+    missing_oracle = [k for k in bp if k not in PK.BP_ORACLES]
+    assert not missing_oracle, (
+        "*_bp kernels without a NumPy oracle (register in "
+        "ops/packed.BP_ORACLES with the parity contract):\n  "
+        + "\n  ".join(missing_oracle))
+
+
+# a --capacity artifact that omits these is not reviewable: the
+# compression claim and the paging behavior must be in the record
+CAPACITY_ROW_KEYS = (
+    "postings", "p50_ms", "p95_ms", "qps", "compression_ratio",
+    "bytes_per_posting_packed", "bytes_per_posting_int16",
+    "achieved_gbps", "util_pct", "tier_counters",
+)
+
+
+def test_committed_capacity_artifact_carries_required_fields():
+    """The committed BENCH_r07.json capacity block must carry the
+    compression ratio and per-tier counters on every row (ISSUE 8
+    hygiene satellite: --capacity artifacts are gated on completeness)."""
+    import json
+    art = PKG.parent / "BENCH_r07.json"
+    assert art.exists(), "BENCH_r07.json missing (run bench.py --capacity)"
+    obj = json.loads(art.read_text())
+    cap = obj.get("capacity")
+    assert cap, "BENCH_r07.json has no capacity block"
+    rows = cap.get("rows")
+    assert rows and len(rows) >= 2, "capacity needs a 10M and a >=50M row"
+    for row in rows:
+        missing = [k for k in CAPACITY_ROW_KEYS if k not in row]
+        assert not missing, f"capacity row missing {missing}"
+        tc = row["tier_counters"]
+        for k in ("tier_hot_hits", "tier_warm_hits", "tier_cold_hits",
+                  "tier_promotions_warm_hot", "tier_promotions_cold_hot"):
+            assert k in tc, k
+    assert max(r["postings"] for r in rows) >= 50_000_000
+    assert "p95_ratio_vs_10m" in cap and "gate_p95_2x" in cap
+
+
 def test_wall_measuring_servlets_open_spans():
     offenders = []
     for p in sorted((PKG / "server" / "servlets").glob("*.py")):

@@ -1,0 +1,352 @@
+"""Compressed residency + tiered paging (ISSUE 8).
+
+Bit-parity contract: the packed-decode scorer path must return
+BIT-IDENTICAL top-k (scores AND docids, the pinned score-DESC /
+pack-order tie discipline) to the int16 path over the same corpus —
+across the solo pruned path, the batched pipeline, the exact filtered
+scan, and the versioned top-k cache. Plus the tier ladder itself:
+hot/warm/cold attribution, async promotion riding the batcher pipeline,
+LRU demotion with compaction, warm-budget eviction to cold, epoch bumps
+on every promotion swap, and the /metrics + fleet surfaces.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.devstore import DeviceSegmentStore
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.index.rwi import RWIIndex
+from yacy_search_server_tpu.ops.ranking import RankingProfile
+
+TERMS = [f"term{t}0000000".encode()[:12] for t in range(3)]
+N = 50_000
+
+
+def _fill(rwi, seed=7, n=N, n_terms=3):
+    rng = np.random.default_rng(seed)
+    for t in range(n_terms):
+        docids = np.arange(n, dtype=np.int32)
+        feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+        feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+        feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+        feats[:, P.F_LANGUAGE] = P.pack_language("en")
+        rwi.ingest_run({TERMS[t]: PostingsList(docids, feats)})
+    return rwi
+
+
+def _pair(**bp_kwargs):
+    """(int16 store, packed store) over identical corpora."""
+    a = DeviceSegmentStore(_fill(RWIIndex()))
+    b = DeviceSegmentStore(_fill(RWIIndex()), packed_residency=True,
+                           **bp_kwargs)
+    return a, b
+
+
+def _same(ra, rb):
+    assert (ra is None) == (rb is None)
+    if ra is None:
+        return
+    assert (np.asarray(ra[0]) == np.asarray(rb[0])).all(), "scores"
+    assert (np.asarray(ra[1]) == np.asarray(rb[1])).all(), "docids"
+    assert ra[2] == rb[2]
+
+
+# -- bit-parity across every packed serving path -----------------------------
+
+def test_parity_solo_pruned_path():
+    a, b = _pair()
+    try:
+        prof = RankingProfile()
+        for k in (5, 10, 100):
+            _same(a.rank_term(TERMS[0], prof, "en", k=k),
+                  b.rank_term(TERMS[0], prof, "en", k=k))
+        assert b.tier_hot_hits > 0
+        assert b.pruned_tiles > 0, "packed path must actually prune"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parity_filtered_exact_scan():
+    a, b = _pair()
+    try:
+        prof = RankingProfile()
+        en = P.pack_language("en")
+        _same(a.rank_term(TERMS[1], prof, "en", k=20, lang_filter=en),
+              b.rank_term(TERMS[1], prof, "en", k=20, lang_filter=en))
+        _same(a.rank_term(TERMS[1], prof, "en", k=20, from_days=100,
+                          to_days=800),
+              b.rank_term(TERMS[1], prof, "en", k=20, from_days=100,
+                          to_days=800))
+        assert b.stream_scans > 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parity_batched_pipeline_under_threads():
+    a, b = _pair()
+    try:
+        for ds in (a, b):
+            ds.enable_batching(max_batch=8, dispatchers=2, prewarm=False)
+            ds._topk_cache.enabled = False
+        prof = RankingProfile()
+        results: dict = {}
+
+        def run(store, tag):
+            out = []
+
+            def worker(i):
+                r = store.rank_term(TERMS[i % 3], prof, "en", k=10)
+                out.append((i % 3, r))
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(12)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            results[tag] = {t: r for t, r in out}
+
+        run(a, "a")
+        run(b, "b")
+        for t in range(3):
+            _same(results["a"][t], results["b"][t])
+        assert b.queries_served >= 12
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parity_cached_path_and_epoch_invalidation():
+    a, b = _pair()
+    try:
+        prof = RankingProfile()
+        r1 = b.rank_term(TERMS[2], prof, "en", k=10)
+        hits0 = b._topk_cache.hits
+        r2 = b.rank_term(TERMS[2], prof, "en", k=10)
+        assert b._topk_cache.hits == hits0 + 1
+        _same(r1, r2)
+        _same(a.rank_term(TERMS[2], prof, "en", k=10), r2)
+        # any epoch move invalidates packed-path entries too
+        b._bump_epoch()
+        r3 = b.rank_term(TERMS[2], prof, "en", k=10)
+        assert b._topk_cache.stale >= 1
+        _same(r2, r3)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parity_against_numpy_oracle():
+    """The device packed path vs the registered NumPy oracle (hygiene
+    contract: every *_bp kernel has a parity anchor off-device)."""
+    from yacy_search_server_tpu.ops import packed as PK
+    b = DeviceSegmentStore(_fill(RWIIndex()), packed_residency=True)
+    try:
+        prof = RankingProfile()
+        s, d, _ = b.rank_term(TERMS[0], prof, "en", k=10)
+        (rid, th), ent = next(
+            (k, e) for k, e in b._pblocks.items() if k[1] == TERMS[0])
+        os_, od = PK.bp_topk_oracle(ent["block"], prof, "en", 10,
+                                    stats=ent["stats"])
+        assert (np.asarray(d) == od[:len(d)]).all()
+        assert (np.asarray(s) == os_[:len(s)].astype(np.int64)).all()
+    finally:
+        b.close()
+
+
+# -- the tier ladder ---------------------------------------------------------
+
+def _tiered_store(budget=7_500_000, **kw):
+    """A packed store whose budget fits ~2 of the 3 terms hot."""
+    rwi = RWIIndex()
+    rng = np.random.default_rng(2)
+    n = 60_000
+    for t in range(3):
+        docids = np.arange(n, dtype=np.int32)
+        feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+        feats[:, P.F_LANGUAGE] = P.pack_language("en")
+        rwi.ingest_run({TERMS[t]: PostingsList(docids, feats)})
+    return DeviceSegmentStore(rwi, packed_residency=True,
+                              budget_bytes=budget, **kw)
+
+
+def test_warm_promotion_with_lru_demotion_and_epoch_bump():
+    ds = _tiered_store()
+    try:
+        prof = RankingProfile()
+        warm = [th for (rid, th), e in ds._pblocks.items()
+                if not e["hot"]]
+        hot = [th for (rid, th), e in ds._pblocks.items() if e["hot"]]
+        assert warm and hot, "budget must split the residency"
+        wth = warm[0]
+        epoch0 = ds.arena_epoch
+        # first access: host fallback + warm hit + inline promotion
+        assert ds.rank_term(wth, prof, "en", k=10) is None
+        assert ds.tier_warm_hits == 1
+        assert ds.tier_promotions_warm_hot == 1
+        assert ds.tier_demotions_hot_warm >= 1
+        assert ds.arena_epoch > epoch0, \
+            "promotion swap must bump the epoch (top-k cache safety)"
+        # promoted: the packed path now serves it
+        r = ds.rank_term(wth, prof, "en", k=10)
+        assert r is not None and len(r[0]) == 10
+        # the demoted victim round-trips back the same way
+        demoted = [th for (rid, th), e in ds._pblocks.items()
+                   if not e["hot"]][0]
+        assert ds.rank_term(demoted, prof, "en", k=10) is None
+        assert ds.rank_term(demoted, prof, "en", k=10) is not None
+    finally:
+        ds.close()
+
+
+def test_cold_promotion_after_warm_eviction():
+    ds = _tiered_store(warm_budget_bytes=0)   # warm tier evicts instantly
+    try:
+        prof = RankingProfile()
+        assert ds.tier_evictions_warm_cold >= 1
+        cold = [th for th in TERMS
+                if not any(k[1] == th for k in ds._pblocks)]
+        assert cold, "zero warm budget must push overflow to cold"
+        cth = cold[0]
+        assert ds.rank_term(cth, prof, "en", k=10) is None
+        assert ds.tier_cold_hits == 1
+        assert ds.tier_promotions_cold_hot == 1
+        assert ds.rank_term(cth, prof, "en", k=10) is not None
+    finally:
+        ds.close()
+
+
+def test_async_promotion_rides_the_batcher_pipeline():
+    """With a batcher attached the promotion is its own `promote` part:
+    the triggering query returns immediately (host path) and the
+    promotion lands asynchronously, overlapping serving — observed via
+    the tier.promote histogram family and the async counter."""
+    from yacy_search_server_tpu.utils import histogram
+    ds = _tiered_store()
+    try:
+        ds.enable_batching(max_batch=8, dispatchers=2, prewarm=False)
+        prof = RankingProfile()
+        warm = [th for (rid, th), e in ds._pblocks.items()
+                if not e["hot"]]
+        wth = warm[0]
+        h0 = histogram.get("tier.promote")
+        c0 = h0.count if h0 is not None else 0
+        assert ds.rank_term(wth, prof, "en", k=10) is None
+        assert ds.tier_promote_async == 1
+        deadline = time.monotonic() + 30.0
+        r = None
+        while time.monotonic() < deadline:
+            # keep serving a hot term while the promotion is in flight
+            assert ds.rank_term(TERMS[0] if TERMS[0] != wth else TERMS[1],
+                                prof, "en", k=5) is not None
+            r = ds.rank_term(wth, prof, "en", k=10)
+            if r is not None:
+                break
+            time.sleep(0.05)
+        assert r is not None, "async promotion never landed"
+        assert ds.tier_promotions_warm_hot == 1
+        h = histogram.get("tier.promote")
+        assert h is not None and h.count > c0, \
+            "promotion must record its span/histogram observation"
+    finally:
+        ds.close()
+
+
+def test_tiering_toggle_exists_and_defaults_on():
+    ds = _tiered_store()
+    try:
+        assert ds._tiering_enabled is True
+        ds._tiering_enabled = False
+        warm = [th for (rid, th), e in ds._pblocks.items()
+                if not e["hot"]]
+        assert ds.rank_term(warm[0], RankingProfile(), "en", k=5) is None
+        # bookkeeping off: no hit attribution, no promotion kicked
+        assert ds.tier_warm_hits == 0
+        assert ds.tier_promotions_warm_hot == 0
+    finally:
+        ds.close()
+
+
+def test_counters_and_compression_surface():
+    ds = DeviceSegmentStore(_fill(RWIIndex()), packed_residency=True)
+    try:
+        ds.rank_term(TERMS[0], RankingProfile(), "en", k=10)
+        c = ds.counters()
+        for key in ("tier_hot_hits", "tier_warm_hits", "tier_cold_hits",
+                    "tier_promotions_warm_hot", "tier_promotions_cold_hot",
+                    "tier_demotions_hot_warm", "tier_evictions_warm_cold",
+                    "tier_hot_bytes", "tier_warm_bytes", "tier_cold_bytes",
+                    "packed_compression_ratio", "term_cache_hits",
+                    "term_cache_misses", "term_cache_evictions"):
+            assert key in c, key
+        assert c["packed_compression_ratio"] > 1.0
+        assert c["tier_hot_bytes"] > 0
+    finally:
+        ds.close()
+
+
+def test_metrics_exposition_tier_families(tmp_path):
+    from yacy_search_server_tpu.server.servlets.monitoring import (
+        prometheus_text)
+    from yacy_search_server_tpu.switchboard import Switchboard
+    from yacy_search_server_tpu.utils.health import parse_exposition
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        samples = parse_exposition(prometheus_text(sb))
+        for tier in ("hot", "warm", "cold"):
+            assert f'yacy_device_hbm_bytes{{tier="{tier}"}}' in samples
+        for src, dst in (("warm", "hot"), ("cold", "hot"),
+                         ("hot", "warm"), ("warm", "cold")):
+            assert (f'yacy_tier_promotions_total{{src="{src}",'
+                    f'dst="{dst}"}}') in samples
+        for ev in ("hits", "misses", "evictions"):
+            assert f'yacy_term_cache_total{{event="{ev}"}}' in samples
+        assert "yacy_term_cache_bytes" in samples
+        assert "yacy_device_compression_ratio" in samples
+        # the fleet digest's tier fields resolve against these series
+        sb.fleet.render_ttl_s = 0.0
+        d = sb.fleet.render()
+        assert "tiers" in d
+        from yacy_search_server_tpu.utils import fleet as F
+        mapping = F.digest_series(d)
+        for field in ("tiers.h", "tiers.w", "tiers.c", "tiers.p"):
+            assert field in mapping
+            assert mapping[field] in samples
+    finally:
+        sb.close()
+
+
+def test_switchboard_config_enables_packed_residency(tmp_path):
+    from yacy_search_server_tpu.switchboard import Switchboard
+    from yacy_search_server_tpu.utils.config import Config
+    cfg = Config()
+    cfg.set("index.device.mesh", "off")
+    cfg.set("index.device.packedResidency", "true")
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), config=cfg)
+    try:
+        assert sb.index.devstore.packed_residency is True
+    finally:
+        sb.close()
+
+
+def test_scan_batching_never_sees_packed_spans():
+    """A packed span must be ineligible for the int16 scan-batch
+    descriptor (its start is -1) — it answers ineligible and the packed
+    solo scan serves it instead."""
+    ds = DeviceSegmentStore(_fill(RWIIndex()), packed_residency=True)
+    try:
+        ds.enable_batching(max_batch=4, dispatchers=1, prewarm=False,
+                           scan_batching=True)
+        prof = RankingProfile()
+        en = P.pack_language("en")
+        r = ds.rank_term(TERMS[0], prof, "en", k=10, lang_filter=en)
+        assert r is not None and len(r[0]) == 10
+        assert ds.stream_scans >= 1
+    finally:
+        ds.close()

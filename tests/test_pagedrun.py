@@ -170,3 +170,31 @@ def test_rwi_legacy_npz_migration(tmp_path):
     for th, p in terms.items():
         np.testing.assert_array_equal(idx2.get(th).docids, p.docids)
     idx2.close()
+
+
+def test_term_cache_observability_counters():
+    """ISSUE 8 satellite: the byte-budget LRU's behavior must be
+    attributable — hits/misses/evictions/puts count exactly, and the
+    devstore counters + /metrics read them (cold-tier paging storms
+    were previously invisible)."""
+    rng = np.random.default_rng(9)
+    cache = TermCache(budget_bytes=10_000)
+    a, b = _plist(rng, 50), _plist(rng, 50)       # ~3.6 KB each
+    assert cache.get(("r", b"t1")) is None
+    assert cache.misses == 1 and cache.hits == 0
+    cache.put(("r", b"t1"), a)
+    assert cache.puts == 1
+    assert cache.get(("r", b"t1")) is a
+    assert cache.hits == 1
+    # force evictions past the budget
+    cache.put(("r", b"t2"), b)
+    cache.put(("r", b"t3"), _plist(rng, 50))
+    assert cache.evictions >= 1
+    # eviction means the oldest key misses again
+    assert cache.get(("r", b"t1")) is None
+    assert cache.misses == 2
+    # an over-budget value serves uncached and counts nothing
+    huge = _plist(rng, 1000)
+    puts0 = cache.puts
+    cache.put(("r", b"huge"), huge)
+    assert cache.puts == puts0

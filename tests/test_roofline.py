@@ -238,6 +238,48 @@ def test_xla_rank_pruned_batch1(arena, bs, maxt):
     _close(c.xla_bytes, by, f"pruned_batch1[{bs},{maxt}] bytes")
 
 
+@pytest.mark.parametrize("bs,pw_cap", ((4, 1 << 18), (16, 1 << 18),
+                                       (16, 1 << 20)))
+def test_xla_rank_pruned_batch1_bp(arena, bs, pw_cap):
+    """The bit-packed fused-decode pruned kernel: the XLA byte model
+    carries a per-pw-word multi-gather slope (each decode gather
+    charges the packed-words operand)."""
+    from yacy_search_server_tpu.index import devstore as DS
+    from yacy_search_server_tpu.ops import packed as PK
+    z = np.zeros(bs, np.int32)
+    zc = np.zeros((bs, P.NF), np.int32)
+    zf = np.zeros(bs, np.float32)
+    zm = np.zeros((bs, PK.META_LEN), np.int32)
+    qiq, nbs = DS._pack_batch1_bp(z, z, z, z, zm, zc, zc, zf, zf,
+                                  np.int32(0), np.int32(0))
+    flops, by = _xla(DS._rank_pruned_batch1_bp_kernel,
+                     jnp.zeros(pw_cap, jnp.int32), arena["dead"],
+                     arena["pmax"], qiq, *_consts(), k=16, maxt=64,
+                     bs=nbs)
+    c = RF.cost("_rank_pruned_batch1_bp_kernel", bs=bs,
+                tile=arena["TILE"], maxt=64, k=16, pw_cap=pw_cap,
+                doc_cap=1 << 16, tcap=1 << 12)
+    _close(c.flops, flops, f"pruned_bp[{bs},{pw_cap}] flops")
+    _close(c.xla_bytes, by, f"pruned_bp[{bs},{pw_cap}] bytes")
+
+
+@pytest.mark.parametrize("bs,pw_cap", ((1, 1 << 18), (4, 1 << 20)))
+def test_xla_rank_scan_bp_unit_trip(arena, bs, pw_cap):
+    """The bit-packed exact scan at its unit-trip shape (count = one
+    TILE per slot; fori bodies count once in the XLA model)."""
+    from yacy_search_server_tpu.index import devstore as DS
+    from yacy_search_server_tpu.ops import packed as PK
+    qi = np.zeros((bs, 6 + PK.META_LEN), np.int32)
+    qi[:, 1] = arena["TILE"]
+    flops, by = _xla(DS._rank_scan_batch_bp_kernel,
+                     jnp.zeros(pw_cap, jnp.int32), arena["dead"], qi,
+                     *_consts(), k=16, bs=bs)
+    c = RF.cost("_rank_scan_batch_bp_kernel", rows=bs * arena["TILE"],
+                k=16, bs=bs, pw_cap=pw_cap, doc_cap=1 << 16)
+    _close(c.flops, flops, f"scan_bp[{bs},{pw_cap}] flops")
+    _close(c.xla_bytes, by, f"scan_bp[{bs},{pw_cap}] bytes")
+
+
 def test_xla_rank_pruned_unit_trip(arena):
     """lax.map + fori bodies count once: the comparable model shape is
     one slot × one tile (the unit trip)."""

@@ -48,6 +48,16 @@ class TermCache:
         self._bytes = 0
         self._map: OrderedDict[tuple, PostingsList] = OrderedDict()
         self._lock = threading.Lock()
+        # observability (ISSUE 8 satellite): the cold tier's paging
+        # behavior was invisible — a paging storm (mass evictions, a
+        # collapsed hit ratio) could only be inferred from latency.
+        # Exact under the cache lock; surfaced in devstore.counters()
+        # and /metrics (yacy_term_cache_total) so traces and the health
+        # rules can attribute cold-tier cost.
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.puts = 0
 
     @staticmethod
     def _cost(p: PostingsList) -> int:
@@ -58,6 +68,9 @@ class TermCache:
             p = self._map.get(key)
             if p is not None:
                 self._map.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
             return p
 
     def put(self, key: tuple, p: PostingsList) -> None:
@@ -65,6 +78,7 @@ class TermCache:
         if cost > self.budget_bytes:
             return  # larger than the whole budget: serve uncached
         with self._lock:
+            self.puts += 1
             old = self._map.pop(key, None)
             if old is not None:
                 self._bytes -= self._cost(old)
@@ -73,6 +87,7 @@ class TermCache:
             while self._bytes > self.budget_bytes and self._map:
                 _, ev = self._map.popitem(last=False)
                 self._bytes -= self._cost(ev)
+                self.evictions += 1
 
     def invalidate(self, key: tuple) -> None:
         with self._lock:

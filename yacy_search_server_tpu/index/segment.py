@@ -88,15 +88,22 @@ class Segment:
         self._lock = threading.RLock()
 
     def enable_device_serving(self, budget_bytes: int = 2 << 30,
-                              device=None):
+                              device=None, packed_residency: bool = False,
+                              warm_budget_bytes: int = 1 << 30):
         """Pack frozen runs onto the device and serve eligible queries
         from placed blocks (VERDICT r1 #1: the product path must be the
         benchmark path — reference IndexCell ram/array split,
-        kelondro/rwi/IndexCell.java:65-283)."""
+        kelondro/rwi/IndexCell.java:65-283). `packed_residency` packs
+        runs as BIT-PACKED blocks with fused on-device decode and a
+        hot/warm/cold tier ladder (index.device.packedResidency) —
+        an order of magnitude more corpus per chip at the measured
+        compression ratio."""
         from .devstore import DeviceSegmentStore
         if self.devstore is None:
             self.devstore = DeviceSegmentStore(
-                self.rwi, device=device, budget_bytes=budget_bytes)
+                self.rwi, device=device, budget_bytes=budget_bytes,
+                packed_residency=packed_residency,
+                warm_budget_bytes=warm_budget_bytes)
             # hybrid rerank serves from the device-resident forward
             # index of this segment's doc vectors (batched second stage)
             self.devstore.attach_dense(self.dense)

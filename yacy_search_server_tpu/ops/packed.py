@@ -1,0 +1,307 @@
+"""Bit-packed posting blocks — per-column minimal widths, decoded on device.
+
+The roofline layer (ops/roofline.py) classifies every posting scorer
+HBM-bandwidth-bound, so on-device compression is straight throughput: a
+block that streams half the bytes scores in half the wall. The int16
+block compaction (M18, ops/ranking.compact_feats) already rode that curve
+once — int32 -> int16 halved the scorer bytes and halved the measured
+wall. This module continues it to the floor the data itself sets
+(arXiv:1406.3170's compact-index stance, applied to the device arena):
+
+- at pack time, every column of a block (the NF compact feature columns,
+  the int32 flags bitfield, the docids) gets the MINIMAL bit width that
+  spans its min..max range (``bits(max - min)``, floor 1) and is stored
+  min-offset ("delta from block min"): value_packed = value - col_min.
+  Docids pack the same way — the delta-from-min form of delta packing
+  that stays order-free (arena rows are proxy-score ordered, not
+  docid-sorted, so consecutive-delta coding would need a permutation on
+  every read).
+- packed values are laid down MSB-agnostic little-endian into one int32
+  word stream, each column's sub-stream starting word-aligned, values
+  allowed to straddle a word boundary (arbitrary widths beat
+  power-of-two-only widths by ~30% on realistic column ranges; the
+  straddle costs one extra word gather per value on decode).
+- the device decode is pure shifts/masks/gathers (``unpack_rows_dev``)
+  and FUSES into the scorer kernels (index/devstore.py ``*_bp``
+  variants): the packed words stream from HBM, rows widen to int32 in
+  registers, and the scoring math downstream is bit-identical to the
+  int16 path — same values in, same cardinal out, same tie order.
+
+Host twins ``pack_block`` / ``unpack_block`` are exact inverses (the
+property tests pin round trips over adversarial ranges: all-equal
+columns, full int16 range, negatives, 30-bit flags). ``BP_ORACLES`` maps
+every ``*_bp`` device kernel to its NumPy oracle — the hygiene gate
+(tests/test_code_hygiene.py) fails any ``*_bp`` kernel without both a
+roofline cost model and an oracle entry here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..index import postings as P
+
+# packed column order: the NF compact feature columns, then the int32
+# flags bitfield, then the docids — NCOLS sub-streams per block
+NCOLS = P.NF + 2
+C_FLAGS = P.NF
+C_DOCIDS = P.NF + 1
+
+# meta vector layout (int32 [3 * NCOLS]): per-column word offsets within
+# the block, then per-column bit widths, then per-column minima
+META_LEN = 3 * NCOLS
+
+
+def col_width(vmin: int, vmax: int) -> int:
+    """Minimal bits spanning vmin..vmax (floor 1 — a constant column
+    still packs one zero bit per row, keeping the decode uniform)."""
+    return max(1, int(int(vmax) - int(vmin)).bit_length())
+
+
+@dataclass
+class PackedBlock:
+    """One bit-packed postings block (host form).
+
+    words: the int32 word stream (all columns, each word-aligned)
+    count: rows in the block
+    word_offs/widths/mins: int32 [NCOLS] per-column geometry
+    """
+
+    words: np.ndarray
+    count: int
+    word_offs: np.ndarray
+    widths: np.ndarray
+    mins: np.ndarray
+
+    def meta_vector(self) -> np.ndarray:
+        """The decode descriptor the device kernels ship per span."""
+        return np.concatenate([self.word_offs, self.widths,
+                               self.mins]).astype(np.int32)
+
+    @property
+    def row_bits(self) -> int:
+        """Payload bits per row (the compression headline; word-align
+        padding is amortized away at block sizes)."""
+        return int(self.widths.sum())
+
+    @property
+    def packed_bytes(self) -> int:
+        return int(self.words.nbytes)
+
+    @property
+    def int16_bytes(self) -> int:
+        """The same rows in the int16 block format (feats16 + flags +
+        docids) — the compression denominator."""
+        return self.count * (P.NF * 2 + 4 + 4)
+
+    @property
+    def compression_ratio(self) -> float:
+        return self.int16_bytes / max(self.packed_bytes, 1)
+
+
+def _pack_column(vals: np.ndarray, w: int, nwords: int) -> np.ndarray:
+    """Pack non-negative uint64 values of width `w` bits into `nwords`
+    int32 words (little-endian bit order, straddling allowed).
+
+    Vectorized via the same unique+reduceat OR-fold the join bitmaps use
+    (np.bitwise_or.at is ~50x slower at block sizes)."""
+    n = len(vals)
+    out = np.zeros(nwords, np.uint32)
+    if n == 0:
+        return out.view(np.int32)
+    bit = np.arange(n, dtype=np.uint64) * np.uint64(w)
+    wi = (bit >> np.uint64(5)).astype(np.int64)
+    s = bit & np.uint64(31)
+    shifted = vals << s                       # < 2^63: w<=32, s<=31
+    lo = (shifted & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (shifted >> np.uint64(32)).astype(np.uint32)
+    idx = np.concatenate([wi, wi + 1])
+    contrib = np.concatenate([lo, hi])
+    nz = contrib != 0
+    idx, contrib = idx[nz], contrib[nz]
+    if len(idx):
+        order = np.argsort(idx, kind="stable")
+        idx, contrib = idx[order], contrib[order]
+        uw, starts = np.unique(idx, return_index=True)
+        out[uw] = np.bitwise_or.reduceat(contrib, starts)
+    return out.view(np.int32)
+
+
+def pack_block(feats16: np.ndarray, flags: np.ndarray,
+               docids: np.ndarray) -> PackedBlock:
+    """Bit-pack one compact block (the SAME (feats16, flags, docids)
+    triple the int16 arena stores, in the same row order — parity with
+    the int16 scorer path is by construction: identical values, identical
+    tie-breaking row order)."""
+    n = len(docids)
+    assert feats16.shape == (n, P.NF) and len(flags) == n
+    cols: list[np.ndarray] = [feats16[:, c].astype(np.int64)
+                              for c in range(P.NF)]
+    cols.append(flags.astype(np.int64))
+    cols.append(docids.astype(np.int64))
+    mins = np.zeros(NCOLS, np.int32)
+    widths = np.zeros(NCOLS, np.int32)
+    word_offs = np.zeros(NCOLS, np.int32)
+    parts: list[np.ndarray] = []
+    off = 0
+    for c in range(NCOLS):
+        v = cols[c]
+        vmin = int(v.min()) if n else 0
+        vmax = int(v.max()) if n else 0
+        w = col_width(vmin, vmax)
+        mins[c] = vmin
+        widths[c] = w
+        word_offs[c] = off
+        nwords = (n * w + 31) // 32
+        parts.append(_pack_column((v - vmin).astype(np.uint64), w, nwords))
+        off += nwords
+    words = (np.concatenate(parts) if parts
+             else np.empty(0, np.int32))
+    return PackedBlock(words=words, count=n, word_offs=word_offs,
+                       widths=widths, mins=mins)
+
+
+def _unpack_column(words: np.ndarray, off: int, w: int, vmin: int,
+                   n: int) -> np.ndarray:
+    """Exact inverse of _pack_column (int64 values)."""
+    wu = words.view(np.uint32).astype(np.uint64)
+    bit = np.arange(n, dtype=np.uint64) * np.uint64(w)
+    wi = off + (bit >> np.uint64(5)).astype(np.int64)
+    s = bit & np.uint64(31)
+    lo = wu[wi]
+    hi = wu[np.minimum(wi + 1, len(wu) - 1)]
+    mask = (np.uint64(1) << np.uint64(w)) - np.uint64(1)
+    val = ((lo | (hi << np.uint64(32))) >> s) & mask
+    return val.astype(np.int64) + vmin
+
+
+def unpack_block(pb: PackedBlock) -> tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]:
+    """(feats16, flags, docids) — bit-exact inverse of pack_block, and
+    the NumPy half of every *_bp kernel oracle."""
+    n = pb.count
+    f16 = np.zeros((n, P.NF), np.int16)
+    for c in range(P.NF):
+        f16[:, c] = _unpack_column(pb.words, int(pb.word_offs[c]),
+                                   int(pb.widths[c]), int(pb.mins[c]),
+                                   n).astype(np.int16)
+    fl = _unpack_column(pb.words, int(pb.word_offs[C_FLAGS]),
+                        int(pb.widths[C_FLAGS]), int(pb.mins[C_FLAGS]),
+                        n).astype(np.int32)
+    dd = _unpack_column(pb.words, int(pb.word_offs[C_DOCIDS]),
+                        int(pb.widths[C_DOCIDS]), int(pb.mins[C_DOCIDS]),
+                        n).astype(np.int32)
+    return f16, fl, dd
+
+
+# ---------------------------------------------------------------------------
+# Device decode — the traced helper the *_bp kernels fuse
+# ---------------------------------------------------------------------------
+
+def unpack_rows_dev(uwords, wbase, meta, row0, rows: int):
+    """Decode `rows` rows starting at (traced) `row0` of the packed
+    block at word base `wbase`; returns (feats int32 [rows, NF],
+    flags int32 [rows], docids int32 [rows]).
+
+    `uwords` is the whole packed-words arena bit-cast to uint32 (cast
+    once per kernel, free); `meta` the block's int32 [META_LEN] decode
+    descriptor. All arithmetic is shifts/masks over two gathered words
+    per value (straddle-capable); out-of-range gathers clip — rows past
+    the block's true count decode garbage that the caller's in-span
+    predicate masks before any use, exactly like the int16 kernels'
+    overrun tiles. Fusing this into the scorer is the whole point: the
+    packed words are the ONLY HBM stream, and XLA widens in registers."""
+    offs = meta[:NCOLS]
+    widths = meta[NCOLS:2 * NCOLS]
+    mins = meta[2 * NCOLS:]
+    i = row0 + jnp.arange(rows, dtype=jnp.int32)
+    nw = uwords.shape[0]
+    cols = []
+    for c in range(NCOLS):
+        w = widths[c]
+        bit = i * w
+        wi = wbase + offs[c] + (bit >> 5)
+        s = (bit & 31).astype(jnp.uint32)
+        lo = uwords[jnp.clip(wi, 0, nw - 1)]
+        hi = uwords[jnp.clip(wi + 1, 0, nw - 1)]
+        # mask: w==32 would overflow the 1<<w form; both `where` arms
+        # evaluate, so the shift amount is clamped to stay defined
+        wq = jnp.minimum(w, 31).astype(jnp.uint32)
+        mask = jnp.where(w >= 32, jnp.uint32(0xFFFFFFFF),
+                         (jnp.uint32(1) << wq) - jnp.uint32(1))
+        # s==0 means the value sits entirely in `lo`; the hi<<32 arm is
+        # undefined-shift territory, guarded by the where select
+        hipart = jnp.where(s == jnp.uint32(0), jnp.uint32(0),
+                           hi << (jnp.uint32(32) - s))
+        val = ((lo >> s) | hipart) & mask
+        cols.append(val.astype(jnp.int32) + mins[c])
+    f = jnp.stack(cols[:P.NF], axis=1)
+    return f, cols[C_FLAGS], cols[C_DOCIDS]
+
+
+def bitcast_words(pwords):
+    """The once-per-kernel uint32 view of the packed-words arena."""
+    return lax.bitcast_convert_type(pwords, jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# NumPy oracles — one per *_bp device kernel (hygiene-gated)
+# ---------------------------------------------------------------------------
+
+def bp_topk_oracle(pb: PackedBlock, profile, language: str, k: int,
+                   stats: dict | None = None,
+                   lang_filter: int | None = None,
+                   flag_bit: int | None = None,
+                   from_days: int | None = None,
+                   to_days: int | None = None):
+    """Reference answer for the packed-decode scorers: unpack the block
+    host-side, score with the canonical host twin
+    (ops/ranking.cardinal_from_stats_host — bit-exact integer parts vs
+    the device kernel), apply the same constraint mask, and take the
+    top-k under the pinned tie order (score DESC, then block row order —
+    lax.top_k's lowest-index tie-break over the same rows).
+
+    `stats=None` recomputes normalization over the (masked) block like
+    the exact scan; passing the frozen pack stats reproduces the pruned
+    path's score domain."""
+    from .ranking import cardinal_from_stats_host, pack_stats_host
+    f16, fl, dd = unpack_block(pb)
+    n = pb.count
+    keep = np.ones(n, bool)
+    if lang_filter is not None and lang_filter != 0:
+        keep &= f16[:, P.F_LANGUAGE].astype(np.int32) == lang_filter
+    if flag_bit is not None and flag_bit >= 0:
+        keep &= ((fl >> flag_bit) & 1) == 1
+    if from_days is not None:
+        keep &= f16[:, P.F_LASTMOD].astype(np.int32) >= from_days
+    if to_days is not None:
+        keep &= f16[:, P.F_LASTMOD].astype(np.int32) <= to_days
+    if stats is None:
+        if not keep.any():
+            return (np.empty(0, np.int64), np.empty(0, np.int32))
+        stats = pack_stats_host(f16[keep], fl[keep])
+    s = cardinal_from_stats_host(f16, fl, stats, profile,
+                                 P.pack_language(language))
+    s = np.where(keep, s, np.int64(-(2 ** 63 - 1)))
+    order = np.argsort(-s, kind="stable")[:k]
+    order = order[keep[order]]
+    return s[order], dd[order]
+
+
+# kernel name -> (oracle callable, one-line contract). The hygiene gate
+# demands an entry for EVERY jitted *_bp kernel in index/devstore.py —
+# a packed-decode kernel without a NumPy oracle has no parity anchor.
+BP_ORACLES: dict[str, tuple] = {
+    "_rank_pruned_batch1_bp_kernel": (
+        bp_topk_oracle,
+        "frozen pack stats + first-tile prefix; the tail bound walk is "
+        "verified by the int16 twin's proof (same pmax side-table)"),
+    "_rank_scan_batch_bp_kernel": (
+        bp_topk_oracle,
+        "exact two-pass scan semantics: stats over the constraint-masked "
+        "rows, then score + top-k, identical to _rank_scan_batch_kernel"),
+}

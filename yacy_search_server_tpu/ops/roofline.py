@@ -383,6 +383,53 @@ def _c_rerank_fwd_batch(bs: int = 16, nb: int = 128, dim: int = 256,
                 + _RERANK_XBYTES_SLOT * bs)
 
 
+# bit-packed (*_bp) fused-decode scorers: the compulsory HBM stream is
+# the PACKED bytes (row_bits/8 per row — the whole point of the format)
+# plus the tombstone gather and outputs; decode adds ~6 int ops per
+# value (two word reads folded by shifts/masks) on top of the scoring
+# flops. XLA model: per-row slope + per-pw-word slope (each decode
+# gather charges the packed-words operand in HloCostAnalysis, so the
+# arena capacity enters with a multi-gather coefficient) + the dead/
+# pmax operands. Fits exact to <0.5% over bs in {1..16} × pw_cap in
+# {2^18, 2^20} (jax 0.4.x CPU); pinned by tests/test_roofline.py.
+_PRUNED1_BP_FLOPS_ROW = 890.0
+_PRUNED1_BP_FLOPS_PW = 5.0
+_PRUNED1_BP_XBYTES_ROW = 56.5
+_PRUNED1_BP_XBYTES_PW = 28.0
+_SCAN_BP_FLOPS_ROW = 1775.0
+_SCAN_BP_XBYTES_ROW = 847.0
+_SCAN_BP_XBYTES_PW = 88.0
+
+
+def _c_rank_pruned_batch1_bp(bs: int, tile: int = 32_768, maxt: int = 64,
+                             k: int = 16, row_bits: float = 160.0,
+                             pw_cap: int = 0, doc_cap: int = 0,
+                             tcap: int = 0) -> Cost:
+    """The b=1 pruned kernel over bit-packed spans: each slot decodes +
+    scores ONE tile straight from the packed words. Compulsory bytes =
+    packed payload (row_bits/8 per row) — compression is throughput on
+    a memory-bound roofline."""
+    rows = bs * tile
+    return Cost(flops=_PRUNED1_BP_FLOPS_ROW * rows
+                + _PRUNED1_BP_FLOPS_PW * pw_cap,
+                bytes=(row_bits / 8.0 + 1) * rows + 4 * bs * maxt
+                + 8 * bs * k,
+                xla_bytes=_PRUNED1_BP_XBYTES_ROW * rows
+                + _PRUNED1_BP_XBYTES_PW * pw_cap + doc_cap + 4 * tcap)
+
+
+def _c_rank_scan_batch_bp(rows: int, k: int = 16, bs: int = 1,
+                          row_bits: float = 160.0, pw_cap: int = 0,
+                          doc_cap: int = 0) -> Cost:
+    """Exact two-pass scan over bit-packed spans (stats, then score):
+    the packed payload streams twice, like the int16 scan's two passes
+    over ROW_BYTES."""
+    return Cost(flops=_SCAN_BP_FLOPS_ROW * rows + pw_cap,
+                bytes=2 * (row_bits / 8.0 + 1) * rows + 8 * k,
+                xla_bytes=_SCAN_BP_XBYTES_ROW * rows
+                + _SCAN_BP_XBYTES_PW * pw_cap + 2 * doc_cap)
+
+
 def _c_power_iterate(n: int, edges: int, iters: int = 1) -> Cost:
     """BlockRank power iteration (ops/blockrank._power_iterate_sparse):
     per-iteration segment-sum over the edge list, × the trip count (the
@@ -425,6 +472,11 @@ KERNELS: dict[str, object] = {
     "_rank_scan_batch_packed_kernel": _c_rank_spans,
     "_rank_join_batch_packed_kernel": _c_rank_join,
     "_rank_join_bm_batch_packed_kernel": _c_rank_join_bm,
+    # bit-packed fused-decode variants (compressed residency): cost
+    # models count the PACKED bytes — the compression ratio is the
+    # roofline-visible win
+    "_rank_pruned_batch1_bp_kernel": _c_rank_pruned_batch1_bp,
+    "_rank_scan_batch_bp_kernel": _c_rank_scan_batch_bp,
 }
 
 # jit-compiled functions that are NOT serving kernels: maintenance

@@ -481,9 +481,45 @@ def prometheus_text(sb, include_buckets: bool = True,
                 # served without touching the device
                 "rerank_dispatches", "rerank_queries",
                 "rerank_cache_hits", "rerank_fallbacks",
+                # tier ladder hit attribution (compressed residency)
+                "tier_hot_hits", "tier_warm_hits", "tier_cold_hits",
                 "device_round_trips"):
         p.sample("yacy_device_serving_total", c.get(key, 0),
                  {"counter": key})
+    # HBM accounting for the fleet (ISSUE 8 satellite): per-tier byte
+    # occupancy and the promotion/demotion flow — always emitted (zeros
+    # without a devstore) so the fleet digest's tier fields and any
+    # future health rule resolve on every node configuration
+    p.family("yacy_device_hbm_bytes", "gauge",
+             "postings bytes resident per tier (hot=device packed/int16, "
+             "warm=host-RAM packed blocks, cold=paged-run mmap)")
+    for tier in ("hot", "warm", "cold"):
+        p.sample("yacy_device_hbm_bytes", c.get(f"tier_{tier}_bytes", 0),
+                 {"tier": tier})
+    p.family("yacy_tier_promotions_total", "counter",
+             "tier ladder transitions (src->dst; demotions/evictions "
+             "ride the same family)")
+    for src, dst, key in (("warm", "hot", "tier_promotions_warm_hot"),
+                          ("cold", "hot", "tier_promotions_cold_hot"),
+                          ("hot", "warm", "tier_demotions_hot_warm"),
+                          ("warm", "cold", "tier_evictions_warm_cold")):
+        p.sample("yacy_tier_promotions_total", c.get(key, 0),
+                 {"src": src, "dst": dst})
+    p.family("yacy_device_compression_ratio", "gauge",
+             "measured int16-bytes/packed-bytes over resident packed "
+             "blocks (1.0 = int16 residency)")
+    p.sample("yacy_device_compression_ratio",
+             c.get("packed_compression_ratio", 1.0))
+    # cold-tier paging cache (index/pagedrun.TermCache): byte-budget LRU
+    # behavior must be attributable when paging storms hit the host path
+    p.family("yacy_term_cache_total", "counter",
+             "paged-run term cache events (the cold tier's LRU)")
+    for ev in ("hits", "misses", "evictions"):
+        p.sample("yacy_term_cache_total", c.get(f"term_cache_{ev}", 0),
+                 {"event": ev})
+    p.family("yacy_term_cache_bytes", "gauge",
+             "resident bytes in the paged-run term cache")
+    p.sample("yacy_term_cache_bytes", c.get("term_cache_bytes", 0))
     p.family("yacy_device_arena_epoch", "gauge",
              "arena epoch (bumps on flush/merge/repack/delete; the "
              "stale-spike health rule reads its churn)")

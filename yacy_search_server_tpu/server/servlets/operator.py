@@ -644,6 +644,21 @@ def device_store(header, post, sb):
             ("util_pct_p50", c["util_pct_p50"]),
             ("util_pct_p95", c["util_pct_p95"]),
             ("bound", c["bound"]),
+            # compressed residency + tier ladder (ISSUE 8): per-tier
+            # occupancy, hit attribution and the promotion flow
+            ("packed_residency", 1 if ds.packed_residency else 0),
+            ("compression_ratio", c["packed_compression_ratio"]),
+            ("tier_hot_bytes", c["tier_hot_bytes"]),
+            ("tier_warm_bytes", c["tier_warm_bytes"]),
+            ("tier_cold_bytes", c["tier_cold_bytes"]),
+            ("tier_hits_hot_warm_cold",
+             f"{c['tier_hot_hits']}/{c['tier_warm_hits']}"
+             f"/{c['tier_cold_hits']}"),
+            ("tier_promotions_warm_hot", c["tier_promotions_warm_hot"]),
+            ("tier_promotions_cold_hot", c["tier_promotions_cold_hot"]),
+            ("tier_demotions_hot_warm", c["tier_demotions_hot_warm"]),
+            ("term_cache_hits", c["term_cache_hits"]),
+            ("term_cache_evictions", c["term_cache_evictions"]),
         ]
     elif kind == "MeshSegmentStore":
         rows += [

@@ -112,7 +112,17 @@ class Switchboard:
                     self.index.enable_mesh_serving(
                         n_term=n_term, budget_bytes=budget)
                 else:
-                    self.index.enable_device_serving(budget_bytes=budget)
+                    self.index.enable_device_serving(
+                        budget_bytes=budget,
+                        # compressed residency + tier ladder: bit-packed
+                        # blocks with fused on-device decode; corpus
+                        # size becomes a tiering decision instead of an
+                        # HBM ceiling (off by default — the capacity
+                        # bench and parity tests drive it)
+                        packed_residency=self.config.get_bool(
+                            "index.device.packedResidency", False),
+                        warm_budget_bytes=self.config.get_int(
+                            "index.device.warmBudgetBytes", 1 << 30))
                 if self.config.get_bool("index.device.batching", True):
                     self.index.devstore.enable_batching(
                         max_batch=self.config.get_int(

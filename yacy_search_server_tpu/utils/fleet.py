@@ -101,6 +101,14 @@ def digest_series(digest: dict) -> dict:
         out["queues.inflight"] = 'yacy_batcher_queue_depth{queue="inflight"}'
     if "epoch" in digest:
         out["epoch"] = "yacy_device_arena_epoch"
+    if "tiers" in digest:
+        # compact tier occupancy (ISSUE 8): KiB per residency tier +
+        # total promotions — the mesh view of who is paging
+        out["tiers.h"] = 'yacy_device_hbm_bytes{tier="hot"}'
+        out["tiers.w"] = 'yacy_device_hbm_bytes{tier="warm"}'
+        out["tiers.c"] = 'yacy_device_hbm_bytes{tier="cold"}'
+        out["tiers.p"] = \
+            'yacy_tier_promotions_total{src="warm",dst="hot"}'
     return out
 
 
@@ -202,6 +210,15 @@ class FleetTable:
                        "inflight": b._inflight.qsize()
                        if b is not None else 0},
             "epoch": int(c.get("arena_epoch", 0)),
+            # tier occupancy in KiB (compact: ~30 B inside the 2 KiB
+            # budget) + warm->hot promotions — a peer whose w/c grow
+            # while p churns is paging, visible mesh-wide
+            "tiers": {
+                "h": int(c.get("tier_hot_bytes", 0)) >> 10,
+                "w": int(c.get("tier_warm_bytes", 0)) >> 10,
+                "c": int(c.get("tier_cold_bytes", 0)) >> 10,
+                "p": int(c.get("tier_promotions_warm_hot", 0)),
+            },
         }
         # wire budget: a digest must never bloat the exchanges it rides.
         # Dropping the largest family degrades the mesh view gracefully
