@@ -669,7 +669,8 @@ def _config12_multiproc(ndocs=1_000_000, queries=4000, client_procs=8):
     sb.index.metadata.snapshot()
     sb.index.devstore.enable_batching()
     sock = f"{tmp}/rank.sock"
-    server = RankServiceServer(sb.index.devstore, sock)
+    server = RankServiceServer(sb.index.devstore, sock,
+                               state_fn=sb.actuators.serving_state)
     ctx = multiprocessing.get_context("spawn")
 
     def measure(n_workers: int) -> float:
@@ -1473,6 +1474,83 @@ def _health_overhead_mode(n: int, threads: int = 16, per_thread: int = 10,
             f"the two percentile paths is broken")
 
 
+def _actuator_overhead_mode(n: int, threads: int = 16,
+                            per_thread: int = 10, windows: int = 3,
+                            budget_pct: float = 2.0):
+    """--actuator-overhead (ISSUE 9): serving p50/p95 with the actuator
+    engine ENABLED-BUT-IDLE vs disabled, interleaved windows on the
+    shared `_ab_soak` harness.  The ON mode runs the full health+
+    actuator tick at 1 Hz (5x the deployed health.tickS=5 cadence, so
+    the measured regression bounds the deployed overhead a fortiori)
+    plus the per-query admission/ladder reads on the serving path.  Two
+    gates: p50 regression < `budget_pct`%, and ZERO actuator
+    transitions across the healthy soak — an actuator that moves
+    without a real signal is a bug, not adaptation.  The emitted JSON
+    carries the degrade_level histogram and the per-actuator transition
+    counters the headline artifact also gains."""
+    import threading as _threading
+    from contextlib import contextmanager
+
+    sb = _build_served_switchboard(n, n_terms=2, mesh="off")
+    assert sb.index.devstore is not None, "device serving must be on"
+    sb.index.devstore._topk_cache.enabled = False
+    act = sb.actuators
+
+    def set_mode(mode):
+        act.enabled = mode
+
+    # ON windows drive the REAL sensing->decision loop at 1 Hz: the
+    # health tick evaluates every rule and ticks every actuator
+    @contextmanager
+    def driver(mode):
+        if not mode:
+            yield
+            return
+        stop = _threading.Event()
+
+        def ticker():
+            while not stop.wait(1.0):
+                sb.health.tick()
+        th = _threading.Thread(target=ticker, daemon=True)
+        th.start()
+        try:
+            yield
+        finally:
+            stop.set()
+            th.join()
+
+    r = _ab_soak(sb, set_mode, threads=threads, per_thread=per_thread,
+                 windows=windows, window_driver=driver)
+    transitions = act.transition_counts()
+    total_transitions = act.transitions_total()
+    levels = {str(i): v for i, v in enumerate(act.degraded_queries)}
+    print(json.dumps({
+        "metric": "actuator_overhead",
+        "n_postings": n,
+        "threads": threads,
+        "queries_per_mode": r["queries_per_mode"],
+        "p50_ms_actuators_off": round(r["p50_off"], 3),
+        "p50_ms_actuators_on": round(r["p50_on"], 3),
+        "p95_ms_actuators_off": round(r["p95_off"], 3),
+        "p95_ms_actuators_on": round(r["p95_on"], 3),
+        "overhead_pct": round(r["overhead_pct"], 3),
+        "budget_pct": budget_pct,
+        "degrade_level_queries": levels,
+        "actuator_transitions": {f"{a}:{d}": v for (a, d), v
+                                 in sorted(transitions.items())},
+        "actuator_transitions_total": total_transitions,
+        "degrade_level": act.level,
+    }))
+    assert r["overhead_pct"] < budget_pct, (
+        f"actuator-layer overhead {r['overhead_pct']:.2f}% exceeds the "
+        f"{budget_pct}% stay-on-by-default budget")
+    assert total_transitions == 0, (
+        f"{total_transitions} actuator transition(s) during a HEALTHY "
+        f"soak: {transitions} — actuators must hold still without a "
+        f"real signal")
+    assert act.level == 0, "ladder moved during a healthy soak"
+
+
 def _federation_overhead_mode(n: int, threads: int = 16,
                               per_thread: int = 10, windows: int = 3,
                               budget_pct: float = 2.0):
@@ -1990,6 +2068,14 @@ def main():
                          "fully hot working set (interleaved windows); "
                          "asserts the idle-path overhead stays < 2%% "
                          "(noise budget on CPU backends)")
+    ap.add_argument("--actuator-overhead", action="store_true",
+                    help="serving p50/p95 with the actuator engine "
+                         "(admission buckets, degradation ladder, "
+                         "batcher auto-tune, peer guard) enabled-but-"
+                         "idle vs disabled, interleaved windows; "
+                         "asserts < 2%% p50 regression AND zero "
+                         "transitions across the healthy soak "
+                         "(ISSUE 9)")
     ap.add_argument("--health-overhead", action="store_true",
                     help="serving p50/p95 with the histogram recording "
                          "+ health-rule tick on vs off, interleaved "
@@ -2015,6 +2101,10 @@ def main():
         return
     if args.health_overhead:
         _health_overhead_mode(args.n if args.n != 10_000_000 else 200_000)
+        return
+    if args.actuator_overhead:
+        _actuator_overhead_mode(
+            args.n if args.n != 10_000_000 else 200_000)
         return
     if args.federation_overhead:
         _federation_overhead_mode(
@@ -2189,6 +2279,16 @@ def main():
         # wire size of the metric digest this node would gossip to the
         # fleet after this soak (<= 2048 by the federation discipline)
         "fleet_digest_bytes": fleet_digest_bytes,
+        # self-defending serving (ISSUE 9): the per-rung served-query
+        # histogram and the actuator transition counters — BOTH must
+        # read as a healthy soak (every query at level 0, zero
+        # transitions); a degraded headline is not a headline
+        "degrade_level_queries": {
+            str(i): v
+            for i, v in enumerate(sb.actuators.degraded_queries)},
+        "actuator_transitions": {
+            f"{a}:{d}": v for (a, d), v
+            in sorted(sb.actuators.transition_counts().items())},
         # the hybrid-mode soak (batched dense rerank through the
         # pipelined batcher; cache disabled so every query reranks)
         "hybrid": hybrid_soak,

@@ -2,6 +2,7 @@
 control surface — display probing, browser popup, shutdown wiring."""
 
 import threading
+import time
 
 from yacy_search_server_tpu import gui
 
@@ -63,4 +64,12 @@ def test_gui_shutdown_event_closes_tray(monkeypatch):
     t.start()
     ev.set()
     t.join(timeout=10)
-    assert not t.is_alive() and closed
+    assert not t.is_alive()
+    # close() runs on the DAEMON watcher thread, which run_gui does not
+    # join — under scheduler load it can land after run_gui returns, so
+    # poll instead of asserting the instant (observed flaking when the
+    # whole suite shares a 1-core box)
+    deadline = time.monotonic() + 5.0
+    while not closed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert closed

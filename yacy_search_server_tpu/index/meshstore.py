@@ -312,6 +312,33 @@ class _MeshQueryBatcher:
         if completer is not None:
             completer.join(timeout=10.0)
 
+    # -- runtime tuning (ISSUE 9: batcher auto-tune, devstore parity) --------
+
+    def tuning(self) -> dict:
+        """The mesh runs ONE SPMD program at a time, so the dispatcher
+        count is structurally 1; completer depth IS the in-flight bound
+        here."""
+        with self._ctr_lock:
+            dispatches = self.dispatches
+        return {"dispatchers": 1,
+                "completer_depth": self._inflight.maxsize,
+                "queue_incoming": self._q.qsize(),
+                "queue_inflight": self._inflight.qsize(),
+                "dispatches": dispatches}
+
+    def set_tuning(self, dispatchers: int | None = None,
+                   completer_depth: int | None = None) -> dict:
+        """Adjust the in-flight bound (the only tunable axis of a
+        single-program mesh — `dispatchers` is accepted for surface
+        parity and ignored).  Floor 1: the minimal still-flowing
+        configuration, never a wedge."""
+        if completer_depth is not None:
+            new_max = max(1, int(completer_depth))
+            with self._inflight.mutex:
+                self._inflight.maxsize = new_max
+                self._inflight.not_full.notify_all()
+        return self.tuning()
+
     @staticmethod
     def _bucket(n: int) -> int:
         return 1 if n <= 1 else (4 if n <= 4 else _MeshQueryBatcher

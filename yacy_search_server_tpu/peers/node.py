@@ -255,9 +255,14 @@ class P2PNode:
         if timeout_s is None:
             timeout_s = self.network_unit.remotesearch_maxtime_ms / 1000.0
         per_peer = max(count, self.network_unit.remotesearch_maxcount)
+        # fleet-aware avoidance (ISSUE 9): the remote_peer_guard
+        # actuator maintains the avoided-peer set from gossiped digests
+        act = getattr(self.sb, "actuators", None)
+        avoid = set(act.avoided_peers()) if act is not None else None
         rs = RemoteSearch(event, self.seeddb, self.dist, self.protocol,
                           redundancy=self.redundancy,
-                          per_peer_count=per_peer, timeout_s=timeout_s)
+                          per_peer_count=per_peer, timeout_s=timeout_s,
+                          avoid_hashes=avoid)
         if self.cluster_peers:
             allowed = {n.lower() for n in self.cluster_peers}
             targets = [s for s in self.seeddb.active_seeds()

@@ -60,6 +60,18 @@ class Protocol:
 
     def _call(self, target: Seed, endpoint: str, payload: dict
               ) -> tuple[bool, dict]:
+        # env-gated failpoint (utils/faultinject): a blackholed peer is
+        # unreachable — fail after the configured delay, exactly like a
+        # dead network path, so peer-avoidance tests drive the real
+        # skip/timeout machinery deterministically
+        from ..utils import faultinject
+        if faultinject.blackholed(target.hash):
+            delay = faultinject.blackhole_delay_s(target.hash)
+            if delay > 0.0:
+                import time as _time
+                _time.sleep(delay)
+            self.seeddb.disconnected(target.hash)
+            return False, {}
         # trace propagation: the active trace id rides every outgoing
         # RPC in-band (tracing.PAYLOAD_KEY); HttpTransport promotes it
         # to the X-YaCy-Trace header on the real wire, and the remote

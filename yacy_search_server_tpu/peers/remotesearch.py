@@ -20,6 +20,7 @@ from collections import defaultdict
 from ..parallel.distribution import Distribution
 from ..search.searchevent import ResultEntry, SearchEvent
 from ..utils import tracing
+from ..utils.fleet import peer_key
 from .dht import select_search_targets
 from .protocol import Protocol
 from .seed import Seed, SeedDB
@@ -50,10 +51,18 @@ def _entries_from_links(links: list[dict], source: str) -> list[ResultEntry]:
 class RemoteSearch:
     """Fan-out controller for one SearchEvent."""
 
+    # adaptive per-peer timeout envelope (ISSUE 9 satellite): a derived
+    # timeout is p95 x headroom, clamped into [floor, static] — the
+    # static timeout_s stays both the digest-less fallback and the hard
+    # ceiling (a sick peer must never get MORE budget than before)
+    TIMEOUT_FLOOR_S = 0.5
+    TIMEOUT_HEADROOM = 3.0
+
     def __init__(self, event: SearchEvent, seeddb: SeedDB,
                  dist: Distribution, protocol: Protocol,
                  redundancy: int = 3, per_peer_count: int = 10,
-                 timeout_s: float = 3.0):
+                 timeout_s: float = 3.0,
+                 avoid_hashes: set | None = None):
         self.event = event
         self.seeddb = seeddb
         self.dist = dist
@@ -61,6 +70,11 @@ class RemoteSearch:
         self.redundancy = redundancy
         self.per_peer_count = per_peer_count
         self.timeout_s = timeout_s
+        # peers the actuator layer (utils/actuator.remote_peer_guard)
+        # marked sick: digest-reported critical / wedged kernel /
+        # outlier p95 — skipped by the scatter, counted per skip
+        self.avoid_hashes: set[str] = set(avoid_hashes or ())
+        self.peers_skipped_sick = 0
         self._threads: list[threading.Thread] = []
         # per-word abstracts harvested for the secondary round:
         # wordhash -> {urlhash -> set of peer hashes that hold it}
@@ -88,10 +102,18 @@ class RemoteSearch:
             return 0
         targets = select_search_targets(
             self.seeddb, self.dist, include, self.redundancy)
+        # avoided DHT holders are replaced, not just dropped: the extras
+        # budget grows by the number of sick targets (and never offers
+        # an avoided peer), so redundancy survives a sick holder set
+        # instead of silently shrinking toward zero
+        sick = sum(1 for t in targets
+                   if peer_key(t.hash) in self.avoid_hashes)
         have = {t.hash for t in targets}
-        extras = sorted((s for s in self.seeddb.active_seeds()
-                         if s.is_senior() and s.hash not in have),
-                        key=lambda s: s.hash)[:extra_peers]
+        extras = sorted(
+            (s for s in self.seeddb.active_seeds()
+             if s.is_senior() and s.hash not in have
+             and peer_key(s.hash) not in self.avoid_hashes),
+            key=lambda s: s.hash)[:extra_peers + sick]
         return self.start_fixed(targets + extras, with_abstracts)
 
     def start_fixed(self, targets: list[Seed],
@@ -104,15 +126,51 @@ class RemoteSearch:
             return 0
         if with_abstracts is None:
             with_abstracts = len(include) > 1
+        # fleet-aware peer avoidance (ISSUE 9): peers whose gossiped
+        # digests report critical health / a wedged kernel / an outlier
+        # serving p95 are skipped — one sick peer must not drag every
+        # global query for the full static timeout.  Every skip is
+        # counted and attributable (/metrics yacy_remotesearch_peers).
+        live = []
         for t in targets:
+            if peer_key(t.hash) in self.avoid_hashes:
+                self.peers_skipped_sick += 1
+                continue
+            live.append(t)
+        fl = self.protocol.fleet
+        if fl is not None:
+            if self.peers_skipped_sick:
+                fl.note_remote("skipped_sick", self.peers_skipped_sick)
+            fl.note_remote("asked", len(live))
+        for t in live:
             th = threading.Thread(
                 target=self._one_peer, args=(t, with_abstracts),
                 name=f"remotesearch-{t.name}", daemon=True)
             th.start()
             self._threads.append(th)
-        self.event.remote_peers_asked += len(targets)
-        self.event.asked_peers.extend(targets)
-        return len(targets)
+        self.event.remote_peers_asked += len(live)
+        self.event.asked_peers.extend(live)
+        return len(live)
+
+    def _peer_timeout_s(self, target: Seed) -> float:
+        """Per-peer adaptive timeout from the digest-reported RPC-wall
+        p95 (with a sane floor/ceiling); the static `timeout_s` serves
+        digest-less peers unchanged (ISSUE 9 satellite — was a fixed
+        3.0 s for every peer regardless of its observed behavior)."""
+        fl = self.protocol.fleet
+        if fl is None:
+            return self.timeout_s
+        p95_ms = fl.peer_rpc_p95_ms(target.hash)
+        if p95_ms is None:
+            return self.timeout_s
+        t = min(max(self.TIMEOUT_HEADROOM * p95_ms / 1000.0,
+                    self.TIMEOUT_FLOOR_S), self.timeout_s)
+        if t < self.timeout_s:
+            # only a budget that actually DIFFERS counts as an adaptive
+            # decision (a slow peer clamped back to the static ceiling
+            # received nothing different)
+            fl.note_remote("adaptive_timeout")
+        return t
 
     def _one_peer(self, target: Seed, with_abstracts: bool,
                   wordhashes: list[bytes] | None = None,
@@ -135,7 +193,7 @@ class RemoteSearch:
             ok, reply = self.protocol.search(
                 target, include, q.goal.exclude_hashes,
                 count=self.per_peer_count,
-                timeout_ms=int(self.timeout_s * 1000),
+                timeout_ms=int(self._peer_timeout_s(target) * 1000),
                 lang=q.lang, contentdom=q.contentdom,
                 with_abstracts=with_abstracts, urls=urls)
             # the fleet peer table shows each peer's last observed RPC
@@ -219,6 +277,14 @@ class RemoteSearch:
             #                         slots, or repeat rounds starve
             if ph in self._checked_secondary:
                 continue            # never ask a peer twice
+            # the sick-peer guard covers the secondary round too: a
+            # digest-flagged peer listed as an abstract holder would
+            # otherwise drag the join round for its full timeout
+            if peer_key(ph) in self.avoid_hashes:
+                self.peers_skipped_sick += 1
+                if self.protocol.fleet is not None:
+                    self.protocol.fleet.note_remote("skipped_sick")
+                continue
             seed = self.seeddb.get(ph)
             if seed is None:
                 continue

@@ -113,6 +113,29 @@ class AccessTracker:
         for host in dead:
             del self._host_access[host]
 
+    def retry_after_s(self, client_host: str, limit: int,
+                      window_s: float = 600.0) -> float:
+        """Seconds until a retry from this host would PASS the windowed
+        limit — the honest Retry-After for a WINDOW denial (ISSUE 9,
+        replacing the hard-coded 600).  The retry appends itself before
+        the `hits > limit` check, so `over + 1` oldest entries must age
+        out, not `over` — an off-by-one here 429s the very client that
+        honored the header exactly."""
+        now = time.time()
+        with self._lock:
+            times = self._host_access.get(client_host)
+            if not times:
+                return 0.0
+            over = len(times) - limit
+            if over <= 0:
+                return 0.0
+            i = min(over, len(times) - 1)
+            # +1 ms past the boundary: the window prune is STRICT
+            # (`times[0] < cutoff`), so at the exact expiry instant the
+            # entry still counts — the advertised wait must land
+            # strictly after it
+            return max(0.0, times[i] + window_s - now + 0.001)
+
     def access_hosts(self, window_s: float = 600.0) -> list[tuple[str, int]]:
         with self._lock:
             self._prune_hosts_locked(time.time() - window_s)

@@ -273,6 +273,11 @@ class QueryParams:
     # optional result URL veto (ContentControl filter; reference consults
     # it in the SearchEvent drain) — callable(url) -> True when blocked
     url_filter: object = None
+    # degradation ladder rung this query serves under (ISSUE 9,
+    # utils/actuator.LEVEL_*): 0 full, 1 skip live snippets, 2 skip
+    # dense rerank, 3 rank-cache/stale-ok only.  Part of query_id so a
+    # degraded event never masquerades as (or pages against) a full one
+    degrade_level: int = 0
 
     @staticmethod
     def parse(querystring: str, **kw) -> "QueryParams":
@@ -304,6 +309,7 @@ class QueryParams:
             self.profile.to_external_string() if self.profile else "",
             f"h{int(self.hybrid)}a{self.hybrid_alpha}" if self.hybrid else "",
             "cc" if self.url_filter is not None else "",
+            f"d{self.degrade_level}" if self.degrade_level else "",
         ))
         return hashlib.md5(key.encode()).hexdigest()  # nosec: cache key only
 

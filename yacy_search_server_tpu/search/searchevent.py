@@ -56,6 +56,23 @@ _CD_FLAG = {CD_IMAGE: FLAG_CAT_HASIMAGE, CD_AUDIO: FLAG_CAT_HASAUDIO,
             CD_VIDEO: FLAG_CAT_HASVIDEO, CD_APP: FLAG_CAT_HASAPP}
 
 
+def _unconstrained_single_term(q) -> bool:
+    """THE predicate for "plain single term, no constraints of any
+    kind" — the cacheable query shape.  One implementation shared by
+    the device-path eligibility gate and the rung-3 cache-only path: a
+    constraint gate added to one but not the other would serve a
+    cached UNCONSTRAINED answer for a constrained query (wrong, not
+    stale)."""
+    m = q.modifier
+    inc, exc = q.goal.include_hashes, q.goal.exclude_hashes
+    return (len(inc) == 1 and not exc and not m.date_sort
+            and not (m.sitehost or m.tld or m.filetype or m.protocol)
+            and not m.language
+            and _CD_FLAG.get(q.contentdom) is None
+            and m.from_days is None and m.to_days is None
+            and q.profile.authority <= 12)
+
+
 @dataclass
 class ResultEntry:
     """One search result row (URIMetadataNode-equivalent surface)."""
@@ -154,13 +171,38 @@ class SearchEvent:
         # late-merging producers parent their spans here (the contextvar
         # does not cross the fan-out thread boundary)
         self.trace_ctx = tracing.current()
+        # degradation ladder rung (ISSUE 9, utils/actuator.LEVEL_*):
+        # each rung serves a PREFIX of the full pipeline, so degraded
+        # answers stay bit-identical in ordering to the corresponding
+        # non-degraded stage outputs (tie discipline per stage)
+        self.degrade_level = getattr(query, "degrade_level", 0)
         self._run_local()
+
+    def _note_degraded(self, stage: str, n: int = 1) -> None:
+        """Every downgraded stage is counted (eventtracker ->
+        yacy_stage_events_total) and traced (a zero-length marker span
+        when a trace is active)."""
+        track(EClass.SEARCH, f"DEGRADED_{stage}", n)
+        tracing.emit("search.degraded", 0.0, stage=stage,
+                     level=self.degrade_level)
 
     # -- local batched path --------------------------------------------------
 
     def _run_local(self) -> None:
         q = self.query
         k_need = max(q.item_count + q.offset, 10) * TOPK_OVERSAMPLE
+
+        # ladder rung 3 (cache-only / stale-ok): answer from the
+        # versioned top-k cache with ZERO ranking work; a miss returns
+        # an empty page instead of paying device/host ranking — the
+        # last line of defense before shedding outright
+        if self.degrade_level >= 3:
+            got = self._cache_only(k_need)
+            if got is not None:
+                scores, docids, self.local_rwi_considered = got
+                if len(docids):
+                    self._fill_results(scores, docids)
+            return
 
         # hybrid-cache plumbing: _device_local may serve a FULL cached
         # hybrid answer (rerank included, zero device work) or hand back
@@ -176,14 +218,22 @@ class SearchEvent:
             if len(docids) == 0:
                 return
             if q.hybrid and not self._rerank_done:
-                with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
-                    scores, docids = self._dense_rerank(scores, docids)
-                if self._hybrid_put is not None:
-                    ds, th, epoch0, dv0 = self._hybrid_put
-                    ds.hybrid_cache_put(
-                        th, q.profile, q.lang, k_need, q.hybrid_alpha,
-                        epoch0, scores, docids,
-                        self.local_rwi_considered, dv0=dv0)
+                # ladder rung 2: skip the dense rerank stage — the
+                # sparse stage's pinned (score DESC, docid ASC) order
+                # serves as-is, bit-identical to the first-stage output
+                if self.degrade_level >= 2:
+                    self._note_degraded("RERANK", len(docids))
+                else:
+                    with StageTimer(EClass.SEARCH, "DENSERERANK",
+                                    len(docids)):
+                        scores, docids = self._dense_rerank(scores,
+                                                            docids)
+                    if self._hybrid_put is not None:
+                        ds, th, epoch0, dv0 = self._hybrid_put
+                        ds.hybrid_cache_put(
+                            th, q.profile, q.lang, k_need, q.hybrid_alpha,
+                            epoch0, scores, docids,
+                            self.local_rwi_considered, dv0=dv0)
             self._fill_results(scores, docids)
             return
 
@@ -220,10 +270,41 @@ class SearchEvent:
                 scores, docids = self._ranker.rank(cand, hosthashes, k=k)
 
         if q.hybrid and len(docids) and not q.modifier.date_sort:
-            with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
-                scores, docids = self._dense_rerank(scores, docids)
+            if self.degrade_level >= 2:
+                self._note_degraded("RERANK", len(docids))
+            else:
+                with StageTimer(EClass.SEARCH, "DENSERERANK",
+                                len(docids)):
+                    scores, docids = self._dense_rerank(scores, docids)
 
         self._fill_results(scores, docids)
+
+    def _cache_only(self, k: int):
+        """Ladder rung 3 (ISSUE 9): the versioned top-k cache is the
+        ONLY serving source — stale-ok, because at this rung an answer
+        computed against a slightly older arena epoch beats paying any
+        ranking work (and beats shedding).  Only the unconstrained
+        single-term shape can answer from the cache (the cache key
+        carries no constraints — serving a cached unconstrained answer
+        for a constrained query would be wrong, not stale); everything
+        else misses and returns empty, counted."""
+        q = self.query
+        ds = self.segment.devstore
+        inc = q.goal.include_hashes
+        peek = getattr(ds, "rank_cache_get", None) if ds is not None \
+            else None
+        if peek is not None and _unconstrained_single_term(q):
+            try:
+                got = peek(inc[0], q.profile, q.lang, k, stale_ok=True)
+            except TypeError:
+                # store without the stale_ok surface (mesh store, rank-
+                # service client): the strict peek still serves hits
+                got = peek(inc[0], q.profile, q.lang, k)
+            if got is not None:
+                self._note_degraded("CACHE_ONLY_HIT", len(got[1]))
+                return got
+        self._note_degraded("CACHE_ONLY_MISS")
+        return None
 
     def _fill_results(self, scores, docids) -> None:
         """Queue the ranked candidates and materialize lazily: the page
@@ -291,16 +372,11 @@ class SearchEvent:
         flag_bit = NO_FLAG if flag is None else flag
         facet_mods = bool(m.sitehost or m.tld or m.filetype or m.protocol)
         # ONE predicate for "plain single term, no constraints of any
-        # kind" — the cacheable shape. Derived from the SAME variables
-        # the routing gates below test, so a new gate added there that
-        # constrains results must extend this conjunction too (and gets
-        # reviewed against it), not drift past a hand-copied list.
-        unconstrained = (len(inc) == 1 and not exc and not m.date_sort
-                         and not facet_mods
-                         and lang_filter == NO_LANG
-                         and flag_bit == NO_FLAG
-                         and m.from_days is None and m.to_days is None
-                         and q.profile.authority <= 12)
+        # kind" — the cacheable shape, shared with the rung-3 cache-only
+        # path (module-level _unconstrained_single_term). A new routing
+        # gate below that constrains results must extend that ONE
+        # conjunction, not drift past a hand-copied list.
+        unconstrained = _unconstrained_single_term(q)
         # cache-aware eligibility: a repeated hot term answers from the
         # store's versioned top-k result cache with ZERO device work, so
         # none of the cost-based gates below apply to it — in particular
@@ -314,7 +390,10 @@ class SearchEvent:
             # never survive an encoder swap or a vector write. A miss
             # remembers the put context — the epoch BEFORE the sparse
             # stage runs, so a racing flush leaves the entry born-stale
-            if q.hybrid:
+            # (rung 2 skips the hybrid peek entirely: a cached HYBRID
+            # answer would disagree with the rerank-skipped order every
+            # computed answer serves while degraded)
+            if q.hybrid and self.degrade_level < 2:
                 hpeek = getattr(ds, "hybrid_cache_get", None)
                 if hpeek is not None:
                     q0 = time.perf_counter()
@@ -775,6 +854,17 @@ class SearchEvent:
             # stored text gone (blanked row / imported metadata) or a
             # remote result without a peer snippet: live path
             live_jobs.append(e)
+        # ladder rung 1 (ISSUE 9): skip LIVE snippet fetches — cache-
+        # local extraction above already served what it could; the
+        # network fetches (the expensive, latency-tailed part) are the
+        # first thing the ladder sheds.  No eviction either: under
+        # degradation a missing snippet proves nothing.
+        if live_jobs and self.loader is not None \
+                and self.degrade_level >= 1:
+            self._note_degraded("SNIPPETS", len(live_jobs))
+            for e in live_jobs:
+                e.snippet_done = True
+            return 0
         if not live_jobs or self.loader is None:
             for e in live_jobs:
                 e.snippet_done = True

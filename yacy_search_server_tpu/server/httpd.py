@@ -21,16 +21,27 @@ from __future__ import annotations
 
 import base64
 import json
+import math
 import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, unquote, urlsplit
 
-from ..utils import histogram, tracing
+from ..utils import faultinject, histogram, tracing
 from .objects import ServerObjects
 from .templates import TemplateEngine
 from . import servlets
+
+# the servlets the degradation ladder's shed rung refuses with a
+# computed Retry-After: the query-serving surface — the load the ladder
+# exists to defend.  The live rung is read from the actuator engine
+# (act.effective_level(); the serving.degradeLevel config key is its
+# write-only operator-visible mirror).  Observability and admin pages
+# stay reachable: an operator must be able to SEE a shedding node
+# (utils/actuator.py).
+SHED_SERVLETS = frozenset({"yacysearch", "gsasearch", "yacysearchitem",
+                           "suggest"})
 
 _CONTENT_TYPES = {
     "html": "text/html; charset=utf-8",
@@ -241,6 +252,7 @@ class YaCyHttpServer:
             # serverClient parity; the reference's Jetty chain puts the
             # monitor/security handlers ahead of the proxy handler)
             tracker = getattr(self.sb, "access_tracker", None)
+            act = getattr(self.sb, "actuators", None)
             client_ip = handler.client_address[0]
             if not self.security.client_allowed(client_ip):
                 self._send(handler, 403, "text/plain",
@@ -250,10 +262,29 @@ class YaCyHttpServer:
                 hits = tracker.track_access(client_ip)
                 limit = self.sb.config.get_int(
                     "httpd.maxAccessPerHost.600s", 6000)
-                if hits > limit and client_ip not in ("127.0.0.1", "::1"):
+                # admission control (ISSUE 9): the per-client token
+                # bucket decides alongside the windowed host count, and
+                # the hard-coded Retry-After 600 becomes the honest
+                # wait of WHICHEVER policy denied — the window's own
+                # drain time (when the oldest over-limit hit ages out)
+                # or the bucket's refill ETA; both tripping takes the
+                # longer wait
+                over, retry_s = hits > limit, 0.0
+                if over:
+                    retry_s = max(1.0, tracker.retry_after_s(
+                        client_ip, limit))
+                if act is not None:
+                    admitted, bucket_retry = act.admit(client_ip)
+                    if not admitted:
+                        over = True
+                        retry_s = max(retry_s, bucket_retry)
+                if over and client_ip not in ("127.0.0.1", "::1"):
+                    # ceil, never truncate: a client honoring the
+                    # header exactly must be admitted on its retry
                     self._send(handler, 429, "text/plain",
                                b"too many requests",
-                               extra={"Retry-After": "600"})
+                               extra={"Retry-After":
+                                      str(max(1, math.ceil(retry_s)))})
                     return
 
             # forward-proxy request line (GET http://host/path) — the
@@ -296,10 +327,28 @@ class YaCyHttpServer:
                 self._serve_static(handler, path.lstrip("/"))
                 return
 
+            # degradation ladder (ISSUE 9): the shed rung refuses the
+            # query-serving servlets outright with the recovery-derived
+            # Retry-After; lower rungs thread the level through to the
+            # search path and stamp every downgraded answer
+            lvl = act.effective_level() if act is not None else 0
+            if lvl >= 4 and name in SHED_SERVLETS:
+                act.note_shed()
+                self._send(handler, 429, "text/plain",
+                           b"shedding load: serving degraded",
+                           extra={"Retry-After": str(max(1, math.ceil(
+                               act.shed_retry_after_s()))),
+                               "X-YaCy-Degraded": str(lvl)})
+                return
+
             post = ServerObjects(params)
             header = {"ext": ext, "path": path,
                       "client_ip": handler.client_address[0],
                       "method": handler.command,
+                      # the ladder rung this request serves under
+                      # (searchevent reads it off QueryParams; servlets
+                      # may inspect it here)
+                      "degrade": lvl,
                       # servlets mounted both public and _p can tighten
                       # behavior for non-admin callers (getpageinfo SSRF
                       # classes, RegexTest limits)
@@ -319,6 +368,10 @@ class YaCyHttpServer:
             tracing.clear_last_trace_id()
             t_sv = time.perf_counter()
             try:
+                # env-gated failpoint INSIDE the measured wall: injected
+                # latency lands in the very SLO histogram the burn-rate
+                # rules read, so ladder tests drive real burns
+                faultinject.sleep("servlet.serving")
                 prop = fn(header, post, self.sb)
                 if isinstance(prop.raw_body, bytes):  # binary (PNG etc.)
                     body = prop.raw_body
@@ -331,7 +384,12 @@ class YaCyHttpServer:
                 histogram.observe("servlet.serving",
                                   (time.perf_counter() - t_sv) * 1000.0,
                                   tracing.last_trace_id())
-            self._send(handler, 200, ctype, body)
+            # any downgraded answer is stamped (ISSUE 9 satellite): a
+            # client/load balancer can tell a degraded 200 from a full
+            # one without parsing the body
+            self._send(handler, 200, ctype, body,
+                       extra={"X-YaCy-Degraded": str(lvl)} if lvl > 0
+                       else None)
         except BrokenPipeError:
             pass
         except Exception as e:  # CrashProtectionHandler parity

@@ -45,8 +45,11 @@ _SPAWN_LOCK = threading.Lock()
 
 # the owner dispatches ONLY these store methods — conn.recv() is pickle
 # underneath, so the dispatch surface must be a closed set, never getattr
-# over attacker-chosen names
-_METHODS = frozenset({"rank_term", "rank_join", "count_upper"})
+# over attacker-chosen names.  serving_state is the degradation-ladder
+# propagation channel (ISSUE 9): workers ask the owner's actuator rung
+# so the whole process group degrades together
+_METHODS = frozenset({"rank_term", "rank_join", "count_upper",
+                      "serving_state"})
 
 
 def _key_path(socket_path: str) -> str:
@@ -68,8 +71,12 @@ class RankServiceServer:
     pass and, with it, arbitrary unpickling in the owner process —
     ADVICE r3). The socket itself is also chmod 0600."""
 
-    def __init__(self, store, socket_path: str):
+    def __init__(self, store, socket_path: str, state_fn=None):
         self.store = store
+        # owner-side serving state for workers (ISSUE 9): usually
+        # sb.actuators.serving_state — the ladder rung + Retry-After the
+        # whole process group serves under.  None answers level 0.
+        self.state_fn = state_fn
         self.socket_path = socket_path
         if os.path.exists(socket_path):
             os.unlink(socket_path)
@@ -130,7 +137,10 @@ class RankServiceServer:
             try:
                 if method not in _METHODS:
                     raise ValueError(f"method not allowed: {method!r}")
-                if method == "count_upper":
+                if method == "serving_state":
+                    out = self.state_fn() if self.state_fn is not None \
+                        else {"level": 0, "retry_after_s": 0.0}
+                elif method == "count_upper":
                     out = store.rwi.count_upper(*args)
                 else:
                     out = getattr(store, method)(*args, **kwargs)
@@ -218,6 +228,20 @@ class RankServiceClient:
     def count_upper(self, termhash: bytes) -> int:
         out = self._call("count_upper", termhash)
         return out if out is not None else 0
+
+    def serving_state(self) -> dict:
+        """The OWNER's degradation-ladder state (ISSUE 9): workers fold
+        this into their own effective level so the whole process group
+        sheds/degrades together.  TTL-cached — the actuator asks at
+        most ~1/s and a socket hop per search would be pure tax."""
+        now = time.monotonic()
+        cached = getattr(self._local, "state_cache", None)
+        if cached is not None and now - cached[0] < 1.0:
+            return cached[1]
+        out = self._call("serving_state")
+        state = out if isinstance(out, dict) else {"level": 0}
+        self._local.state_cache = (now, state)
+        return state
 
     def enable_batching(self, **_kw) -> None:
         """Owner-side batching already coalesces concurrent workers."""

@@ -112,6 +112,36 @@ def respond_health(header: dict, post: ServerObjects,
         prop.put(pre + "time", int(inc["ts"]))
         prop.put(pre + "rules", escape_json(",".join(inc["rules"])))
         prop.put(pre + "file", escape_json(inc["path"] or ""))
+
+    # actuator layer (ISSUE 9): the ladder rung, each actuator's knob
+    # and transition counts, and the recent breadcrumb trail — the
+    # operator reads the node's DEFENSE next to its diagnosis
+    act = getattr(sb, "actuators", None)
+    if act is None:
+        prop.put("actuators", 0)
+        return prop
+    from ...utils.actuator import LEVEL_NAMES
+    prop.put("degrade_level", act.level)
+    prop.put("degrade_name", LEVEL_NAMES[act.level])
+    prop.put("actuator_ticks", act.tick_count)
+    prop.put("shed_requests", act.shed_count)
+    counts = act.transition_counts()
+    prop.put("actuators", len(act.actuators))
+    for i, a in enumerate(act.actuators):
+        pre = f"actuators_{i}_"
+        prop.put(pre + "name", escape_json(a.name))
+        prop.put(pre + "description", escape_json(a.description))
+        prop.put(pre + "knob", escape_json(a.knob))
+        prop.put(pre + "down", counts.get((a.name, "down"), 0))
+        prop.put(pre + "up", counts.get((a.name, "up"), 0))
+    crumbs = act.recent_breadcrumbs(16)
+    prop.put("breadcrumbs", len(crumbs))
+    for i, c in enumerate(reversed(crumbs)):
+        pre = f"breadcrumbs_{i}_"
+        prop.put(pre + "time", int(c.get("ts", 0)))
+        prop.put(pre + "actuator", escape_json(c.get("actuator", "")))
+        prop.put(pre + "dir", escape_json(c.get("dir", "")))
+        prop.put(pre + "cause", escape_json(c.get("cause", "")))
     return prop
 
 

@@ -685,6 +685,55 @@ def prometheus_text(sb, include_buckets: bool = True,
         p.family("yacy_health_incidents_total", "counter",
                  "flight-recorder incident dumps since start")
         p.sample("yacy_health_incidents_total", eng.incident_count)
+
+    # -- actuator layer (ISSUE 9): every closed-loop state change is a
+    # counted transition, the current ladder rung is a gauge, and the
+    # per-level served-query histogram attributes degradation coverage.
+    # Zero-filled per (actuator, dir) so alert expressions always
+    # resolve (the no-dead-actuators gate mirrors the rules').
+    act = getattr(sb, "actuators", None)
+    p.family("yacy_actuator_transitions_total", "counter",
+             "actuator state changes by direction along each "
+             "actuator's own axis (serving_ladder: down=degrade/"
+             "up=recover; batcher_autotune: up=grow pool/down=shrink; "
+             "remote_peer_guard: down=peers newly avoided/up=healed); "
+             "zero during healthy serving")
+    if act is not None:
+        for (aname, d), v in sorted(act.transition_counts().items()):
+            p.sample("yacy_actuator_transitions_total", v,
+                     {"actuator": aname, "dir": d})
+    p.family("yacy_degrade_level", "gauge",
+             "current degradation-ladder rung this node SERVES under "
+             "(0 full .. 4 shed; a rank-service worker reports the "
+             "owner-propagated rung it actually applies)")
+    p.sample("yacy_degrade_level",
+             act.effective_level() if act is not None else 0)
+    p.family("yacy_degraded_queries_total", "counter",
+             "queries served per degradation-ladder rung")
+    for lvl in range(5):
+        p.sample("yacy_degraded_queries_total",
+                 act.degraded_queries[lvl] if act is not None else 0,
+                 {"level": str(lvl)})
+    p.family("yacy_shed_requests_total", "counter",
+             "requests refused by the ladder's shed rung")
+    p.sample("yacy_shed_requests_total",
+             act.shed_count if act is not None else 0)
+    bt = getattr(ds, "_batcher", None) if ds is not None else None
+    tun = bt.tuning() if bt is not None and hasattr(bt, "tuning") \
+        else {"dispatchers": 0, "completer_depth": 0}
+    p.family("yacy_batcher_tuning", "gauge",
+             "live batcher pool geometry (the auto-tuner's actuation "
+             "surface)")
+    for param in ("dispatchers", "completer_depth"):
+        p.sample("yacy_batcher_tuning", tun.get(param, 0),
+                 {"param": param})
+    p.family("yacy_remotesearch_peers_total", "counter",
+             "remote-search peer decisions (asked / skipped_sick / "
+             "adaptive_timeout) — attributes every fleet-driven skip")
+    rc = fl.remote_counter_snapshot() if fl is not None else {}
+    for outcome in ("asked", "skipped_sick", "adaptive_timeout"):
+        p.sample("yacy_remotesearch_peers_total", rc.get(outcome, 0),
+                 {"outcome": outcome})
     return p.text() + ("# EOF\n" if openmetrics else "")
 
 

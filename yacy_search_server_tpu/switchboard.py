@@ -273,6 +273,14 @@ class Switchboard:
         self.health = HealthEngine(
             self, incidents_dir=sub("HEALTH") if data_dir else None)
 
+        # actuator layer (ISSUE 9): the rules above only OBSERVE — this
+        # closes the loop.  Admission token buckets, the serving
+        # degradation ladder, batcher auto-tuning and the remote-search
+        # peer guard, all ticked by the health engine right after rule
+        # evaluation (one cadence for sensing and actuation)
+        from .utils.actuator import ActuatorEngine
+        self.actuators = ActuatorEngine(self)
+
         # data-store migrations: rows written by an older release are
         # upgraded in place once, tracked by the STORE_VERSION marker in
         # the data dir (reference: migration.java version-gated rewrites,
@@ -553,6 +561,14 @@ class Switchboard:
                 "network.unit.name", "") == "intranet" else "cacheonly")
         q.snippet_delete_on_fail = self.config.get_bool(
             "search.verify.delete", True)
+        # degradation ladder (ISSUE 9): the actuator's current rung
+        # rides the query explicitly — every downstream stage decision
+        # (snippets, rerank, cache-only) reads THIS value, and the
+        # per-level histogram in the headline artifact counts it
+        act = getattr(self, "actuators", None)
+        if act is not None:
+            q.degrade_level = act.effective_level()
+            act.note_query(q.degrade_level)
         t0 = time.time()
         if use_cache:
             event = self.search_cache.get_event(q, self.index,

@@ -1,0 +1,140 @@
+"""Env-gated failpoints — deterministic fault injection for robustness
+tests (ISSUE 9 satellite).
+
+The self-defending serving loop (utils/actuator.py) only transitions on
+REAL signals: a burn-rate rule firing, a batcher queue growing, a peer
+digest reporting critical.  Testing those transitions organically means
+sleeping until enough slow requests accumulate in 30 s histogram
+windows — minutes per test.  Failpoints let a test drive the exact same
+product code paths deterministically:
+
+- ``servlet.serving`` latency injection: the httpd dispatch sleeps the
+  configured milliseconds INSIDE the measured serving wall, so the SLO
+  histogram fills with genuinely slow requests and the burn-rate rules
+  fire on real data.
+- ``batcher.dispatch`` forced worker_stall: a dispatcher sleeps inside
+  its dispatch, so the watchdog's stall attribution and the
+  worker_stall health rule see a real wedge.
+- ``peer.blackhole``: RPCs to the listed peer hashes fail after an
+  optional delay — the sick-peer avoidance path sees a genuinely
+  unresponsive peer without a real network.
+
+Two gates keep this production-inert: the module is OFF unless
+``YACY_FAULTS`` is set in the environment (parsed once at import) or a
+test calls :func:`set_fault` explicitly, and every injection site
+checks a single module flag before doing any work — the disabled cost
+is one attribute read.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+_lock = threading.Lock()
+_faults: dict[str, object] = {}
+# fast-path gate: injection sites bail on this before touching the dict
+_active = False
+
+
+def _parse_env() -> None:
+    """``YACY_FAULTS="servlet.serving=250,peer.blackhole=abc:1.5"`` —
+    comma-separated ``point=value`` pairs; blackhole values are
+    ``hash[:delay_s]`` and may repeat."""
+    spec = os.environ.get("YACY_FAULTS", "")
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        if name == "peer.blackhole":
+            h, _, delay = val.partition(":")
+            blackhole_peer(h, float(delay) if delay else 0.0)
+        else:
+            try:
+                set_fault(name, float(val))
+            except ValueError:
+                set_fault(name, val)
+
+
+def set_fault(name: str, value) -> None:
+    """Arm one failpoint (tests; the env var feeds through here too)."""
+    global _active
+    with _lock:
+        _faults[name] = value
+        _active = True
+
+
+def clear(name: str | None = None) -> None:
+    """Disarm one failpoint, or all of them (test teardown)."""
+    global _active
+    with _lock:
+        if name is None:
+            _faults.clear()
+        else:
+            _faults.pop(name, None)
+        _active = bool(_faults)
+
+
+def get(name: str, default=None):
+    if not _active:
+        return default
+    with _lock:
+        return _faults.get(name, default)
+
+
+def latency_ms(point: str) -> float:
+    """Configured injected latency for a point (0.0 when unarmed)."""
+    if not _active:
+        return 0.0
+    v = get(point, 0.0)
+    try:
+        return max(0.0, float(v))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def sleep(point: str) -> float:
+    """Injection site entry: sleep the configured latency (no-op when
+    the point is unarmed); returns the ms slept."""
+    if not _active:          # the production-path cost: one flag read
+        return 0.0
+    ms = latency_ms(point)
+    if ms > 0.0:
+        time.sleep(ms / 1000.0)
+    return ms
+
+
+# -- peer RPC blackhole ------------------------------------------------------
+
+def blackhole_peer(peer_hash, delay_s: float = 0.0) -> None:
+    """Arm the blackhole for one peer: RPCs to it fail after `delay_s`
+    (0 = fail fast — the deterministic default for tests that assert
+    the peer is SKIPPED, so an accidental call is loud, not slow)."""
+    from .fleet import peer_key
+    key = peer_key(peer_hash)
+    holes = dict(get("peer.blackhole", {}) or {})
+    holes[key] = float(delay_s)
+    set_fault("peer.blackhole", holes)
+
+
+def blackholed(peer_hash) -> bool:
+    if not _active:
+        return False
+    from .fleet import peer_key
+    key = peer_key(peer_hash)
+    holes = get("peer.blackhole")
+    return isinstance(holes, dict) and key in holes
+
+
+def blackhole_delay_s(peer_hash) -> float:
+    from .fleet import peer_key
+    key = peer_key(peer_hash)
+    holes = get("peer.blackhole")
+    if not isinstance(holes, dict):
+        return 0.0
+    return float(holes.get(key, 0.0))
+
+
+_parse_env()

@@ -68,6 +68,17 @@ MAX_TS_SKEW_S = 600.0               # inbound ts clamp (anti-lockout)
 STATE_NAMES = ("ok", "warn", "critical")
 
 
+def peer_key(peer_hash) -> str:
+    """THE canonical str form of a peer hash.  Seed hashes are bytes,
+    digest/table keys are str; avoid-set membership, blackhole lookups
+    and RTT notes all compare through this one normalization — a
+    second hand-rolled copy drifting (different errors= mode, raw
+    str()) would silently break peer matching across the avoidance
+    path."""
+    return peer_hash.decode("ascii", "replace") \
+        if isinstance(peer_hash, bytes) else str(peer_hash)
+
+
 def encode_digest(digest: dict) -> str:
     """Compact JSON — the one wire encoding all three transports share
     (the JSON transports embed the dict itself; the Java wire carries
@@ -151,6 +162,11 @@ class FleetTable:
         # all digest the SAME vectors; production single-node processes
         # never set this.
         self._local_counts_fn = None
+        # remote-search actuation counters (ISSUE 9): every skip /
+        # adaptive-timeout decision the fleet view drives must be
+        # attributable — exported as yacy_remotesearch_peers_total
+        self.remote_counters = {"asked": 0, "skipped_sick": 0,
+                                "adaptive_timeout": 0}
 
     # -- local side ----------------------------------------------------------
 
@@ -248,8 +264,7 @@ class FleetTable:
         rate limit that keeps gossip amortized over existing traffic)."""
         if not self.enabled:
             return None
-        key = peer_hash.decode("ascii", "replace") \
-            if isinstance(peer_hash, bytes) else str(peer_hash)
+        key = peer_key(peer_hash)
         now = time.monotonic()
         with self._lock:
             if now - self._sent.get(key, -1e9) < self.send_interval_s:
@@ -262,8 +277,7 @@ class FleetTable:
         RPC that then failed: the digest never arrived, so the next
         successful exchange with that peer should carry one instead of
         waiting out `fleet.sendIntervalS` on a phantom delivery."""
-        key = peer_hash.decode("ascii", "replace") \
-            if isinstance(peer_hash, bytes) else str(peer_hash)
+        key = peer_key(peer_hash)
         with self._lock:
             self._sent.pop(key, None)
 
@@ -350,8 +364,7 @@ class FleetTable:
     def note_rtt(self, peer_hash, ms: float) -> None:
         """Last observed RPC wall against this peer (remote searches,
         DHT transfers) — the peer table's liveness column."""
-        key = peer_hash.decode("ascii", "replace") \
-            if isinstance(peer_hash, bytes) else str(peer_hash)
+        key = peer_key(peer_hash)
         with self._lock:
             self._rtt_ms[key] = (float(ms), time.monotonic())
 
@@ -414,6 +427,77 @@ class FleetTable:
 
     def critical_peers(self) -> list:
         return [e["peer"] for e in self.fresh() if e.get("health") == 2]
+
+    # -- remote-search actuation surface (ISSUE 9) ---------------------------
+
+    def note_remote(self, event: str, n: int = 1) -> None:
+        """Count one remote-search actuation decision (asked /
+        skipped_sick / adaptive_timeout) — the counters that attribute
+        every peer skip in `/metrics`."""
+        with self._lock:
+            if event in self.remote_counters:
+                self.remote_counters[event] += n
+
+    def remote_counter_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.remote_counters)
+
+    def sick_peers(self, outlier_factor: float = 3.0,
+                   min_mesh: int = 50, min_peer: int = 20) -> list:
+        """Peer hashes the remote scatter should avoid: digests
+        reporting critical health or a wedged kernel, plus serving-p95
+        outliers judged leave-one-out against the rest of the mesh (the
+        fleet_peer_outlier rule's discipline — a high-traffic outlier
+        must not mask itself inside the merged tail).  `min_mesh`/
+        `min_peer` are the SAME statistical gates the rule applies
+        (health.fleetOutlierMinSamples / MinPeerSamples — callers pass
+        the configured values so the actuation never judges data the
+        diagnostic layer would refuse to judge); the digest-reported
+        critical/stall verdicts are explicit, not statistical, and
+        stay ungated."""
+        fresh = self.fresh()
+        if not fresh:
+            return []
+        sick: set[str] = set()
+        for e in fresh:
+            if e.get("health") == 2 or \
+                    e.get("rules", {}).get("worker_stall") == 2:
+                sick.add(e["peer"])
+        merged = self.merged_counts("servlet.serving")
+        if sum(merged) < min_mesh:
+            return sorted(sick)     # insufficient mesh traffic for the
+            #                         outlier verdict (rule parity)
+        for e in fresh:
+            counts = e["hist"].get("servlet.serving")
+            if e["peer"] in sick or not counts \
+                    or sum(counts) < min_peer:
+                continue        # thin family: no verdict
+            rest = [max(0, m - c) for m, c in zip(merged, counts)]
+            if sum(rest) < min_peer:
+                continue        # no baseline to judge against
+            p95 = histogram.percentile_from_counts(counts, 0.95)
+            rest_p95 = histogram.percentile_from_counts(rest, 0.95)
+            if p95 > outlier_factor * rest_p95:
+                sick.add(e["peer"])
+        return sorted(sick)
+
+    def peer_rpc_p95_ms(self, peer_hash,
+                        min_samples: int = 20) -> float | None:
+        """This peer's digest-reported RPC wall p95 (`dht.transfer`
+        family); None for digest-less peers or digests with fewer than
+        `min_samples` observations — the caller keeps its static
+        timeout for those.  Same statistical discipline as sick_peers:
+        actuation never judges data thinner than the diagnostic layer
+        would accept (one fast RPC must not collapse a healthy peer's
+        search timeout)."""
+        key = peer_key(peer_hash)
+        with self._lock:
+            entry = self._peers.get(key)
+        if entry is not None:
+            counts = entry["hist"].get("dht.transfer")
+            if counts and sum(counts) >= min_samples:
+                return histogram.percentile_from_counts(counts, 0.95)
+        return None
 
     def peer_rows(self) -> list:
         """Per-peer table rows for `Network_Health_p`: state, windowed

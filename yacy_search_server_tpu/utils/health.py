@@ -473,8 +473,9 @@ class HealthEngine:
 
     def tick(self, now: float | None = None) -> str:
         """One evaluation pass: snapshot `/metrics`, evaluate every
-        rule, dump an incident on an ok/warn->critical edge (rate
-        limited).  Returns the overall state."""
+        rule, drive the actuator engine on the fresh rule states, dump
+        an incident on an ok/warn->critical edge (rate limited).
+        Returns the overall state."""
         now = time.time() if now is None else now
         # idle histogram families must not freeze their windows (a
         # sticky SLO verdict after traffic stops): the tick drives
@@ -501,9 +502,24 @@ class HealthEngine:
                 st.state, st.cause, st.evidence = state, cause, ev
             self.tick_count += 1
             self.last_tick = now
-            if entered_critical and \
-                    now - self._last_incident_ts >= self.cooldown_s:
+            do_dump = entered_critical and \
+                now - self._last_incident_ts >= self.cooldown_s
+            if do_dump:
                 self._last_incident_ts = now
+        # actuators run on the JUST-evaluated rule states, outside the
+        # engine lock (they take config/batcher locks of their own) and
+        # BEFORE the incident dump — the incident that pages on a burn
+        # must already name the ladder step the burn triggered (ISSUE 9)
+        act = getattr(self.sb, "actuators", None)
+        if act is not None:
+            try:
+                act.tick(now)
+            except Exception:
+                import logging
+                logging.getLogger("health").warning(
+                    "actuator tick failed", exc_info=True)
+        if do_dump:
+            with self._lock:
                 self._dump_incident(now, entered_critical)
         return self.overall()
 
@@ -558,6 +574,14 @@ class HealthEngine:
             lines.append(json.dumps({
                 "kind": "snapshot", "ts": round(ts, 3),
                 "series": samples}))
+        # actuator breadcrumbs (ISSUE 9): the incident names every
+        # actuation around the edge — which ladder rung, which tuning
+        # step, which peers were avoided — so a postmortem reads the
+        # defense next to the burn that triggered it
+        act = getattr(self.sb, "actuators", None)
+        if act is not None:
+            for crumb in act.recent_breadcrumbs():
+                lines.append(json.dumps({"kind": "actuator", **crumb}))
         for h in histogram.all_histograms():
             for ex in h.snapshot()["exemplars"]:
                 if ex is not None:
