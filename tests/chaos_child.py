@@ -1,0 +1,149 @@
+"""Subprocess body for the kill−9 chaos harness (ISSUE 10 tentpole b).
+
+Three modes, driven by tests/test_crash_consistency.py:
+
+- ``write <dir> <n_batches> [crashpoint]`` — index `n_batches` batches
+  of deterministic documents into an RWIIndex + MetadataStore under
+  `dir`.  A batch is ACKED (its index appended to ``acked.txt``,
+  fsync'd) only after the durability point the stores claim: the
+  metadata put journaled AND the RWI flush covering it returned.  When
+  a `crashpoint` is given, it is armed AFTER the first n-1 batches are
+  acked, so the final batch's flush — and then an explicit merge and a
+  metadata snapshot — walk into the named SIGKILL barrier with real
+  acked state on disk.  If the armed barrier is never reached the child
+  prints NOCRASH and exits 3 (a dead crashpoint must fail the test,
+  not pass silently).
+- ``verify <dir>`` — reopen the stores (the recovery path under test),
+  assert every acked document is present (zero acked-doc loss), and
+  print a content digest over (a) every term's full merged postings
+  and (b) every acked document's metadata row.  Postings equality is
+  strictly stronger than ranked-search equality: the ranking code is a
+  deterministic function of postings + metadata.
+- (the twin is just ``write`` with no crashpoint + ``verify`` in a
+  fresh dir — the never-crashed baseline the recovered digest must
+  equal bit-for-bit.)
+
+Deliberately jax-free: only the storage layer is under test, and the
+harness spawns ~21 interpreters.
+"""
+
+import hashlib
+import os
+import sys
+
+import numpy as np
+
+# the deterministic corpus: every doc carries both common terms plus a
+# per-batch term, so postings span batches and merges actually fold
+TERMS = ("alpha", "beta", "gamma", "delta")
+DOCS_PER_BATCH = 5
+
+
+def _stores(data_dir):
+    from yacy_search_server_tpu.index.metadata import MetadataStore
+    from yacy_search_server_tpu.index.rwi import RWIIndex
+    rwi = RWIIndex(data_dir=os.path.join(data_dir, "rwi"))
+    meta = MetadataStore(data_dir=os.path.join(data_dir, "meta"))
+    return rwi, meta
+
+
+def _doc(batch, j):
+    from yacy_search_server_tpu.utils.hashes import url2hash
+    url = f"http://site{batch}.example/page{j}"
+    return (url2hash(url), url, f"title {batch}-{j}",
+            [TERMS[0], TERMS[1], TERMS[2 + (batch + j) % 2]])
+
+
+def _feats(batch, j, t):
+    from yacy_search_server_tpu.index.postings import NF
+    rng = np.random.default_rng(batch * 1000 + j * 10 + t)
+    return rng.integers(1, 50, size=(NF,)).astype(np.int32)
+
+
+def _ack(data_dir, batch):
+    with open(os.path.join(data_dir, "acked.txt"), "a",
+              encoding="ascii") as f:
+        f.write(f"{batch}\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _acked(data_dir):
+    p = os.path.join(data_dir, "acked.txt")
+    if not os.path.exists(p):
+        return []
+    with open(p, encoding="ascii") as f:
+        return [int(x) for x in f.read().split()]
+
+
+def write(data_dir, n_batches, crashpoint_name=None):
+    from yacy_search_server_tpu.index.metadata import metadata_from_parsed
+    from yacy_search_server_tpu.utils import faultinject
+    from yacy_search_server_tpu.utils.hashes import word2hash
+    rwi, meta = _stores(data_dir)
+    for batch in range(n_batches):
+        if crashpoint_name and batch == n_batches - 1:
+            # arm LAST: the first n-1 batches must be real acked state
+            # the recovery is obligated to preserve
+            faultinject.set_fault("proc.crashpoint", crashpoint_name)
+        for j in range(DOCS_PER_BATCH):
+            urlhash, url, title, terms = _doc(batch, j)
+            meta.put(metadata_from_parsed(urlhash, url, title,
+                                          " ".join(terms)))
+            docid = meta.docid(urlhash)
+            for t, term in enumerate(terms):
+                rwi.add(word2hash(term), docid, _feats(batch, j, t))
+        rwi.flush()                     # the durability point
+        _ack(data_dir, batch)           # ack ONLY after flush returned
+    # walk the remaining barriers with everything acked: a merge (its
+    # crash must never lose folded state) and a metadata snapshot
+    rwi.merge_runs(max_runs=2)
+    meta.snapshot()
+    if crashpoint_name:
+        print("NOCRASH")                # armed barrier never reached
+        sys.exit(3)
+    print("DONE")
+
+
+def verify(data_dir):
+    from yacy_search_server_tpu.utils.hashes import word2hash
+    rwi, meta = _stores(data_dir)
+    acked = _acked(data_dir)
+    h = hashlib.sha256()
+    # (a) full merged postings per term — identical run organizations
+    # are NOT required, identical merged content is
+    for term in TERMS:
+        p = rwi.get(word2hash(term))
+        h.update(term.encode())
+        h.update(np.ascontiguousarray(p.docids, "<i4").tobytes())
+        h.update(np.ascontiguousarray(p.feats, "<i4").tobytes())
+    # (b) every acked doc present with its row intact (zero acked loss)
+    for batch in acked:
+        for j in range(DOCS_PER_BATCH):
+            urlhash, url, title, _terms = _doc(batch, j)
+            docid = meta.docid(urlhash)
+            if docid is None:
+                print(f"LOST acked doc {url} (batch {batch})")
+                sys.exit(4)
+            row = meta.get(docid)
+            h.update(f"{docid}|{row.get('title', '')}|"
+                     f"{row.get('sku', '')}".encode())
+    print(f"ACKED {len(acked)}")
+    print(f"DIGEST {h.hexdigest()}")
+
+
+def main():
+    mode = sys.argv[1]
+    data_dir = sys.argv[2]
+    os.makedirs(data_dir, exist_ok=True)
+    if mode == "write":
+        write(data_dir, int(sys.argv[3]),
+              sys.argv[4] if len(sys.argv) > 4 else None)
+    elif mode == "verify":
+        verify(data_dir)
+    else:
+        sys.exit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

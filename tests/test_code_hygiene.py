@@ -245,6 +245,58 @@ def test_committed_capacity_artifact_carries_required_fields():
     assert "p95_ratio_vs_10m" in cap and "gate_p95_2x" in cap
 
 
+# -- no dead faultpoints (ISSUE 10 satellite) --------------------------------
+# Every faultpoint name registered in utils/faultinject.py must have (a)
+# a REACHABLE injection site in package source and (b) at least one test
+# exercising it — mirroring the no-dead-rules / no-dead-actuators gates.
+# A registered name no site reaches (or no test arms) is a hole in the
+# chaos harness's coverage claim.
+
+def _all_source(root: pathlib.Path) -> str:
+    return "\n".join(p.read_text(encoding="utf-8")
+                     for p in sorted(root.rglob("*.py")))
+
+
+def test_no_dead_faultpoints():
+    from yacy_search_server_tpu.utils import faultinject as FI
+
+    pkg_src = _all_source(PKG)
+    tests_dir = pathlib.Path(__file__).resolve().parent
+    test_src = _all_source(tests_dir)
+
+    # (a) every registered crashpoint has its named barrier in product
+    # code, and the kill−9 harness iterates the FULL registry (so a new
+    # crashpoint is automatically killed-at and verified)
+    for name in FI.CRASHPOINTS:
+        assert f'crashpoint("{name}")' in pkg_src, (
+            f"crashpoint {name!r} registered but no "
+            f"faultinject.crashpoint() site reaches it")
+    assert "faultinject.CRASHPOINTS" in test_src, (
+        "the chaos harness must parametrize over the crashpoint "
+        "registry")
+
+    # (b) every other faultpoint: a live injection site + a test
+    sites = {
+        "servlet.serving": 'faultinject.sleep("servlet.serving")',
+        "batcher.dispatch": 'faultinject.sleep("batcher.dispatch")',
+        "peer.blackhole": "faultinject.blackholed(",
+        "io.torn_write": "faultinject.torn_write_bytes(",
+        "io.error": "faultinject.io_error(",
+        "device.transfer_fail":
+            'faultinject.take("device.transfer_fail")',
+        "proc.crashpoint": "faultinject.crashpoint(",
+    }
+    assert set(sites) == set(FI.REGISTERED_FAULTPOINTS), (
+        "faultpoint registry drifted from the hygiene gate's site map — "
+        "update both together")
+    for name, site in sites.items():
+        assert site in pkg_src, (
+            f"faultpoint {name!r} has no injection site in package "
+            f"source")
+        assert name in test_src, (
+            f"faultpoint {name!r} is not exercised by any test")
+
+
 def test_wall_measuring_servlets_open_spans():
     offenders = []
     for p in sorted((PKG / "server" / "servlets").glob("*.py")):

@@ -1,0 +1,281 @@
+"""Device-loss recovery (ISSUE 10 tentpole c).
+
+The `device.transfer_fail` faultpoint drives the whole classifier
+deterministically: transient failures retry inside the bounded ladder
+(bit-identical answers, counted retries); a retry-exhausted streak
+declares the device LOST — epoch bumped, every rank entry point serves
+the counted host-fallback answer instead of crashing — and the
+background rebuild re-uploads the arena from the host copies until a
+probe round-trips, after which serving resumes with BIT-IDENTICAL
+rankings (the arxiv 1807.05798 (score DESC, docid ASC) invariant must
+survive a loss/rebuild cycle, or the versioned top-k cache and mesh
+parity silently break).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.devstore import DeviceSegmentStore
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.index.rwi import RWIIndex
+from yacy_search_server_tpu.ops.ranking import CardinalRanker, RankingProfile
+from yacy_search_server_tpu.utils import faultinject
+
+TH = b"losttermAAAA"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+def _plist(rng, n, base=0):
+    docids = np.arange(base, base + n, dtype=np.int32)
+    feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    return PostingsList(docids, feats)
+
+
+def _built_store(n=3000):
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(np.random.default_rng(0), n))
+    idx.flush()
+    ds = DeviceSegmentStore(idx)
+    ds._topk_cache.enabled = False     # every query must hit the device
+    return idx, ds
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_transient_failure_retries_and_stays_bit_identical():
+    """One injected failure inside the retry budget: the query still
+    answers, bit-identical, with the retry counted and NO loss."""
+    idx, ds = _built_store()
+    want = ds.rank_term(TH, RankingProfile(), k=10)
+    assert want is not None
+    faultinject.set_fault("device.transfer_fail", 1)
+    got = ds.rank_term(TH, RankingProfile(), k=10)
+    assert got is not None
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]),
+                                  np.asarray(want[1]))
+    c = ds.counters()
+    assert c["transfer_retries"] >= 1
+    assert c["device_lost"] == 0
+    assert c["device_losses"] == 0
+
+
+def test_streak_declares_loss_then_host_fallback_counted():
+    """Retry-exhausted failures in a streak declare the loss: epoch
+    bumps (cached answers die), rank_term answers None (the caller's
+    host path serves) and every such query is counted."""
+    idx, ds = _built_store()
+    ds.transfer_retry_limit = 0
+    ds.loss_streak = 2
+    ds.rebuild_backoff_s = 3600.0      # hold the rebuild off
+    assert ds.rank_term(TH, RankingProfile(), k=10) is not None
+    epoch0 = ds.arena_epoch
+    faultinject.set_fault("device.transfer_fail", 500)
+    # each query fails its (retry-free) fetch; the second failure is
+    # the declaring streak — afterwards queries short-circuit
+    for _ in range(4):
+        out = ds.rank_term(TH, RankingProfile(), k=10)
+    assert out is None
+    c = ds.counters()
+    assert c["device_lost"] == 1
+    assert c["device_losses"] == 1
+    assert c["transfer_failures"] >= 2
+    assert c["device_lost_queries"] >= 2
+    assert ds.arena_epoch > epoch0, "loss must bump the arena epoch"
+    # join + rerank entry points honor the gate too (counted, no crash)
+    assert ds.rank_join([TH, b"notactuallyX"], [], RankingProfile()) \
+        is None
+    assert c["fallbacks"] >= 2
+    faultinject.clear()
+
+
+def test_injected_loss_soak_answers_every_query_and_recovers():
+    """The acceptance shape: under an injected device loss, 100% of a
+    concurrent query soak completes (host fallback, counted), the
+    background rebuild restores device serving automatically, and the
+    post-recovery ranking is BIT-IDENTICAL to pre-loss."""
+    idx, ds = _built_store()
+    ds.transfer_retry_limit = 0
+    ds.loss_streak = 1
+    ds.rebuild_backoff_s = 0.05
+    prof = RankingProfile()
+    want = ds.rank_term(TH, prof, k=10)
+    assert want is not None
+    host_s, _ = CardinalRanker(prof, "en").rank(idx.get(TH), None, k=10)
+
+    # hold the device down across the soak: the declaring query burns
+    # one charge; once LOST, queries short-circuit (no device work), so
+    # only the rebuild's probes drain the rest — a handful keeps the
+    # exponential probe backoff inside the test's wait budget
+    faultinject.set_fault("device.transfer_fail", 6)
+    assert ds.rank_term(TH, prof, k=10) is None     # declares the loss
+    assert ds.device_lost
+
+    answered = []
+    def worker():
+        for _ in range(5):
+            got = ds.rank_term(TH, prof, k=10)
+            if got is None:
+                # the caller's host path — what SearchEvent does on None
+                s, d = CardinalRanker(prof, "en").rank(
+                    idx.get(TH), None, k=10)
+            else:
+                s = np.asarray(got[0])
+            answered.append(s)
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(answered) == 20, "every query must be answered"
+    for s in answered:
+        np.testing.assert_array_equal(s, host_s)
+
+    # the rebuild drains the remaining charges and recovers on its own
+    assert _wait(lambda: not ds.device_lost), \
+        "background rebuild never restored device serving"
+    c = ds.counters()
+    assert c["device_loss_recoveries"] == 1
+    got = ds.rank_term(TH, prof, k=10)
+    assert got is not None, "post-recovery query must serve on device"
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]),
+                                  np.asarray(want[1]))
+
+
+def test_batched_pipeline_loss_does_not_crash_waiters():
+    """A transfer dying inside the completer's fetch must answer every
+    batched waiter (ineligible -> solo -> host fallback), never hang or
+    crash them."""
+    idx, ds = _built_store()
+    try:
+        ds.enable_batching(max_batch=4, dispatchers=1, prewarm=False)
+        ds._topk_cache.enabled = False
+        ds.transfer_retry_limit = 0
+        ds.loss_streak = 1
+        ds.rebuild_backoff_s = 3600.0
+        assert ds.rank_term(TH, RankingProfile(), k=10) is not None
+        faultinject.set_fault("device.transfer_fail", 200)
+        t0 = time.monotonic()
+        outs = []
+        def q():
+            outs.append(ds.rank_term(TH, RankingProfile(), k=10))
+        threads = [threading.Thread(target=q) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(outs) == 6
+        assert all(o is None for o in outs)
+        assert time.monotonic() - t0 < 30
+        assert ds.device_lost
+        faultinject.clear()
+    finally:
+        faultinject.clear()
+        ds.close()
+
+
+def test_http_answers_are_header_marked_while_lost(tmp_path):
+    """Acceptance surface: while the device is lost, search answers
+    still serve (host fallback) and every 200 carries
+    ``X-YaCy-Degraded: device-loss``; `/metrics` shows the loss gauge
+    and the device_loss rule reads it."""
+    import urllib.request
+    from yacy_search_server_tpu.server import YaCyHttpServer
+    from yacy_search_server_tpu.switchboard import Switchboard
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    srv = YaCyHttpServer(sb, port=0).start()
+
+    def get(path):
+        r = urllib.request.urlopen(srv.base_url + path, timeout=10)
+        return r.status, dict(r.headers), r.read()
+
+    try:
+        from yacy_search_server_tpu.document.document import Document
+        sb.index.store_document(Document(
+            url="http://a.example.org/x", title="apple pie",
+            text="apple pie recipe", mime_type="text/html",
+            language="en"))
+        sb.index.rwi.flush()
+        ds = sb.index.devstore
+        if ds is None:
+            pytest.skip("no device store in this configuration")
+        status, headers, _ = get("/yacysearch.json?query=apple")
+        assert status == 200
+        assert "X-YaCy-Degraded" not in headers
+        # declare the loss directly (the classifier path is covered by
+        # the store-level tests; this pins the serving surface)
+        ds.rebuild_backoff_s = 3600.0
+        ds._declare_device_loss(RuntimeError("test"))
+        assert ds.device_lost
+        status, headers, body = get("/yacysearch.json?query=apple")
+        assert status == 200, "queries must still answer while lost"
+        assert headers.get("X-YaCy-Degraded") == "device-loss"
+        assert b"apple" in body.lower()
+        status, _h, body = get("/metrics")
+        assert status == 200
+        assert b"yacy_device_lost 1" in body
+        assert b'yacy_device_loss_total{event="losses"} 1' in body
+        assert b'yacy_storage_corruption_total{kind="run",' \
+               b'action="quarantined"} 0' in body
+        # the health rule + actuator see it on the next tick
+        sb.health.tick()
+        assert sb.health.states["device_loss"].state == "critical"
+        crumbs = [c for c in sb.actuators.recent_breadcrumbs()
+                  if c.get("actuator") == "device_rebuild"]
+        assert crumbs and crumbs[-1]["dir"] == "down"
+    finally:
+        srv.close()
+        sb.close()
+
+
+def test_mesh_store_mirrors_loss_and_recovery():
+    """MeshSegmentStore parity: same classifier, host mirrors are the
+    rebuild source, recovered answers bit-identical."""
+    from yacy_search_server_tpu.index.meshstore import MeshSegmentStore
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(np.random.default_rng(1), 2000))
+    idx.flush()
+    ms = MeshSegmentStore(idx, n_term=1)
+    ms._topk_cache.enabled = False
+    ms.transfer_retry_limit = 0
+    ms.loss_streak = 1
+    ms.rebuild_backoff_s = 0.05
+    prof = RankingProfile()
+    want = ms.rank_term(TH, prof, k=10)
+    assert want is not None
+    faultinject.set_fault("device.transfer_fail", 3)
+    assert ms.rank_term(TH, prof, k=10) is None
+    assert ms.device_lost
+    assert ms.counters()["device_losses"] == 1
+    assert _wait(lambda: not ms.device_lost), \
+        "mesh rebuild never restored serving"
+    got = ms.rank_term(TH, prof, k=10)
+    assert got is not None
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]),
+                                  np.asarray(want[1]))
+    assert ms.counters()["device_loss_recoveries"] == 1

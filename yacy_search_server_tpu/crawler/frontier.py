@@ -23,6 +23,8 @@ from urllib.parse import urlsplit
 
 from urllib.parse import quote, unquote
 
+from ..index import integrity
+from ..index.colstore import journal_append
 from .latency import Latency
 from .request import Request
 
@@ -58,17 +60,16 @@ class HostQueue:
         if not os.path.exists(self._journal_path):
             return
         alive: dict[str, Request] = {}
-        with open(self._journal_path, encoding="utf-8") as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if rec.get("op") == "push":
-                    r = Request.from_dict(rec["req"])
-                    alive[r.url] = r
-                elif rec.get("op") == "pop":
-                    alive.pop(rec.get("url", ""), None)
+        # shared scaffold (integrity.journal_records): torn-tail repair
+        # before the append-mode reopen, crc + decode classification.
+        # A dropped op re-crawls a URL at worst — never fatal.
+        for rec in integrity.journal_records(self._journal_path,
+                                             "frontier"):
+            if rec.get("op") == "push":
+                r = Request.from_dict(rec["req"])
+                alive[r.url] = r
+            elif rec.get("op") == "pop":
+                alive.pop(rec.get("url", ""), None)
         for r in alive.values():
             self._push_mem(r)
 
@@ -86,10 +87,16 @@ class HostQueue:
             if not self._push_mem(req):
                 return False
             if self._journal:
-                self._journal.write(json.dumps(
-                    {"op": "push", "req": req.to_dict()}) + "\n")
-                self._journal.flush()
+                # shared append+fsync helper (ISSUE 10 satellite): the
+                # old bare flush() left acked pushes in the page cache
+                journal_append(self._journal, json.dumps(
+                    {"op": "push", "req": req.to_dict()}))
             return True
+
+    # pop records skip the fsync barrier (sync=False): losing one on
+    # power loss REPLAYS the pop's URL — a re-crawl, the safe
+    # direction — while a per-pop disk barrier would cap the whole
+    # crawler at the disk's fsync rate
 
     def pop(self) -> Request | None:
         with self._lock:
@@ -102,9 +109,9 @@ class HostQueue:
                     if not q:
                         del self._depths[depth]
                     if self._journal:
-                        self._journal.write(json.dumps(
-                            {"op": "pop", "url": req.url}) + "\n")
-                        self._journal.flush()
+                        journal_append(self._journal, json.dumps(
+                            {"op": "pop", "url": req.url}),
+                            sync=False)
                     return req
             return None
 
@@ -120,8 +127,8 @@ class HostQueue:
                         for r in self._depths[d]]
                 with open(self._journal_path, "w", encoding="utf-8") as f:
                     for r in reqs:
-                        f.write(json.dumps(
-                            {"op": "push", "req": r.to_dict()}) + "\n")
+                        f.write(integrity.crc_line(json.dumps(
+                            {"op": "push", "req": r.to_dict()})) + "\n")
                 self._journal = None
 
 

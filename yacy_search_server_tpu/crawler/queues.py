@@ -38,17 +38,20 @@ class ErrorCache:
         self._journal = None
         self._journal_lines = 0
         if data_dir:
+            from ..index import integrity
             os.makedirs(data_dir, exist_ok=True)
             path = os.path.join(data_dir, "errors.jsonl")
             if os.path.exists(path):
-                with open(path, encoding="utf-8") as f:
-                    for line in f:
-                        try:
-                            rec = json.loads(line)
-                            self._entries[rec["h"].encode()] = (
-                                rec["u"], rec["r"], float(rec["t"]))
-                        except (ValueError, KeyError):
-                            continue
+                # shared scaffold (integrity.journal_records): torn-
+                # tail repair + crc/decode classification; the
+                # compaction below rewrites the file anyway, but the
+                # damage must be COUNTED
+                for rec in integrity.journal_records(path, "errors"):
+                    try:
+                        self._entries[rec["h"].encode()] = (
+                            rec["u"], rec["r"], float(rec["t"]))
+                    except (ValueError, KeyError, TypeError):
+                        continue
                 while len(self._entries) > max_entries:
                     self._entries.pop(next(iter(self._entries)))
             self._path = path
@@ -59,13 +62,15 @@ class ErrorCache:
         lock or is the constructor)."""
         import json
         import os
+        from ..index import integrity
         if self._journal:
             self._journal.close()
         tmp = self._path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             for h, (u, r, t) in self._entries.items():
-                f.write(json.dumps({"h": h.decode("ascii", "replace"),
-                                    "u": u, "r": r, "t": t}) + "\n")
+                f.write(integrity.crc_line(
+                    json.dumps({"h": h.decode("ascii", "replace"),
+                                "u": u, "r": r, "t": t})) + "\n")
         os.replace(tmp, self._path)
         self._journal = open(self._path, "a", encoding="utf-8")
         self._journal_lines = len(self._entries)
@@ -78,10 +83,14 @@ class ErrorCache:
             while len(self._entries) > self.max_entries:
                 self._entries.pop(next(iter(self._entries)))
             if self._journal:
-                self._journal.write(json.dumps(
+                from ..index.colstore import journal_append
+                # shared append helper; sync=False — the error cache is
+                # advisory (bounded, compacted at load): a lost row just
+                # re-fetches a failing URL, while a per-error fsync
+                # would turn a failure flood into a disk-barrier flood
+                journal_append(self._journal, json.dumps(
                     {"h": urlhash.decode("ascii", "replace"),
-                     "u": url, "r": reason, "t": now}) + "\n")
-                self._journal.flush()
+                     "u": url, "r": reason, "t": now}), sync=False)
                 self._journal_lines += 1
                 # in-run compaction: a flood of failures must not grow
                 # the journal past a small multiple of the retained set

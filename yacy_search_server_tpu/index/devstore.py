@@ -74,6 +74,7 @@ from ..ops.streaming import merge_stats
 from ..utils.eventtracker import EClass, update as track
 from ..utils.profiler import PROFILER
 from ..utils import faultinject, histogram, tracing
+from . import integrity
 from . import postings as P
 from .pagedrun import PagedRun
 
@@ -105,6 +106,22 @@ _PMAX_INITIAL_ROWS = 1 << 12
 # runs in float32 and may differ from the numpy pack-time computation by
 # one unit, worth up to 1 << tf_coeff score points
 _PMAX_MARGIN_EXTRA = 64
+
+
+class DeviceTransferError(RuntimeError):
+    """A device dispatch/transfer failed (real tunnel/PCIe error or the
+    ``device.transfer_fail`` faultpoint).  Typed so the loss classifier
+    and the host-fallback paths can treat injected and organic failures
+    identically (ISSUE 10 tentpole c)."""
+
+
+# transfer-failure classification (ISSUE 10 tentpole c): a fetch retries
+# TRANSFER_RETRIES times with exponential backoff before counting as a
+# FAILED transfer; LOSS_STREAK consecutive failed transfers declare the
+# device lost (epoch bump, host fallback, background rebuild)
+TRANSFER_RETRIES = 2
+TRANSFER_BACKOFF_S = 0.05
+LOSS_STREAK = 2
 
 
 class Span:
@@ -2288,7 +2305,7 @@ class _QueryBatcher:
             it["fetch_t0"] = tf0
             it["stage"] = "fetch"
         try:
-            host = jax.device_get(rec["out"])   # ONE packed transfer
+            host = self.store.device_fetch(rec["out"])  # ONE packed transfer
         except Exception:
             with self._ms_lock:
                 self.exceptions += 1
@@ -2890,6 +2907,25 @@ class DeviceSegmentStore:
         self._garbage_rows = 0
         self.queries_served = 0
         self.fallbacks = 0
+        # -- device-loss recovery (ISSUE 10 tentpole c) -----------------
+        # device_fetch classifies every transfer: a fetch that fails
+        # through its whole retry ladder is a FAILED transfer; a streak
+        # of those declares the device LOST — epoch bumped (no cached
+        # answer built on the dead device survives), every query
+        # completes via the counted host-fallback path, and a background
+        # rebuild re-uploads the hot tier from the warm host copies
+        # until a probe round-trips and serving resumes with parity.
+        self.device_lost = False
+        self.device_losses = 0            # declared losses
+        self.device_loss_recoveries = 0   # rebuilds back to device serving
+        self.device_lost_queries = 0      # host-fallback answers while lost
+        self.transfer_failures = 0        # retry-exhausted transfers
+        self.transfer_retries = 0         # bounded in-ladder retries
+        self._transfer_fail_streak = 0
+        self.loss_streak = LOSS_STREAK    # tests tighten/relax per store
+        self.transfer_retry_limit = TRANSFER_RETRIES
+        self.rebuild_backoff_s = 0.5      # rebuild probe cadence
+        self._rebuild_thread: threading.Thread | None = None
         # arena epoch: bumps on EVERY event that can change a query's
         # answer (flush pack, merge retirement, run swap, repack, doc
         # delete, term drop) — the version the top-k result cache keys
@@ -2979,6 +3015,173 @@ class DeviceSegmentStore:
         with self._lock:
             self.device_round_trips += 1
 
+    # -- device-loss recovery (ISSUE 10 tentpole c) --------------------------
+
+    def device_fetch(self, out):
+        """``jax.device_get`` with transfer-failure classification: a
+        transient error retries with bounded exponential backoff
+        (counted); a fetch that exhausts its ladder counts as a FAILED
+        transfer and raises :class:`DeviceTransferError` — a streak of
+        `loss_streak` of those declares the device lost.  The
+        ``device.transfer_fail`` faultpoint (one charge per transfer)
+        drives the whole classifier deterministically in tests.
+
+        Classification is deliberately broad: ANY repeated device_get
+        failure (tunnel drop, PCIe error, but also a deterministic
+        deferred kernel error like device OOM) reads as device-health
+        failure.  Misclassifying a per-query OOM costs a loss/rebuild
+        cycle per streak (epoch bumps, host-fallback serving) — the
+        node keeps answering either way, which is the degraded mode we
+        want; distinguishing error classes across JAX backends reliably
+        is not possible from the exception alone."""
+        delay = TRANSFER_BACKOFF_S
+        last: Exception | None = None
+        for attempt in range(self.transfer_retry_limit + 1):
+            try:
+                if faultinject.take("device.transfer_fail"):
+                    raise DeviceTransferError(
+                        "injected device.transfer_fail")
+                host = jax.device_get(out)
+            except Exception as e:
+                last = e
+                if attempt < self.transfer_retry_limit:
+                    with self._lock:
+                        self.transfer_retries += 1
+                    time.sleep(delay)
+                    delay *= 2
+                    continue
+                self._note_transfer_failure(e)
+                raise DeviceTransferError(
+                    f"device transfer failed after "
+                    f"{self.transfer_retry_limit + 1} attempts: "
+                    f"{e!r}") from e
+            with self._lock:
+                self._transfer_fail_streak = 0
+            return host
+        raise DeviceTransferError(f"unreachable: {last!r}")
+
+    def _note_transfer_failure(self, err) -> None:
+        declare = False
+        with self._lock:
+            self.transfer_failures += 1
+            self._transfer_fail_streak += 1
+            if (not self.device_lost
+                    and self._transfer_fail_streak >= self.loss_streak):
+                declare = True
+        if declare:
+            self._declare_device_loss(err)
+
+    def _declare_device_loss(self, err) -> None:
+        """A sustained transfer-failure streak: stop dispatching to the
+        device (every rank entry point short-circuits to the counted
+        host-fallback path), invalidate every device-derived cached
+        answer (epoch bump), and start the background rebuild."""
+        with self._lock:
+            if self.device_lost:
+                return
+            self.device_lost = True
+            self.device_losses += 1
+            self._transfer_fail_streak = 0
+        self._bump_epoch()
+        log.error("DEVICE LOST after %d consecutive failed transfers "
+                  "(%r): serving host-fallback; background rebuild "
+                  "started", self.loss_streak, err)
+        track(EClass.INDEX, "device_loss", 1)
+        self.start_rebuild()
+
+    def start_rebuild(self) -> None:
+        """Ensure the background rebuild loop is running (idempotent —
+        called at declaration and by the device_rebuild actuator as a
+        watchdog for a died thread)."""
+        with self._lock:
+            if not self.device_lost:
+                return
+            t = self._rebuild_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=self._rebuild_loop,
+                                 name="devstore-rebuild", daemon=True)
+            self._rebuild_thread = t
+        t.start()
+
+    def _rebuild_loop(self) -> None:
+        """Probe the device with backoff; when a trivial upload+fetch
+        round-trips again, rebuild the arena from the host copies and
+        resume device serving."""
+        delay = self.rebuild_backoff_s
+        while True:
+            with self._lock:
+                if not self.device_lost:
+                    return
+            time.sleep(delay)
+            delay = min(delay * 2, 30.0)
+            try:
+                if faultinject.take("device.transfer_fail"):
+                    raise DeviceTransferError(
+                        "injected device.transfer_fail")
+                probe = self.arena._dev(np.zeros(1, np.int32))
+                jax.device_get(probe)
+            except Exception as e:
+                log.warning("device rebuild probe failed: %r", e)
+                continue
+            try:
+                self._rebuild_device()
+            except Exception:
+                log.exception("device rebuild failed; will retry")
+                continue
+            with self._lock:
+                self.device_lost = False
+                self.device_loss_recoveries += 1
+                self._transfer_fail_streak = 0
+            self._bump_epoch()
+            log.warning("device serving RESUMED after rebuild "
+                        "(recovery #%d)", self.device_loss_recoveries)
+            track(EClass.INDEX, "device_recovery", 1)
+            return
+
+    def _rebuild_device(self) -> None:
+        """Re-create the arena and re-upload the hot tier from the host
+        copies: int16 runs re-pack off their PagedRun mmaps; packed
+        (compressed-residency) blocks re-promote from the warm host
+        copies via the existing `promote` part kind, riding the batcher
+        pipeline so the re-upload overlaps resumed query waves.  Answers
+        are bit-identical afterwards by the same argument as repack():
+        span registration is rebuilt from the same immutable rows."""
+        with self._lock:
+            old = self.arena
+            self._packed.clear()
+            self._garbage_rows = 0
+            self._promote_inflight.clear()
+            self.arena = DeviceArena(
+                device=old.device, budget_bytes=old.budget_bytes,
+                initial_rows=(TILE if self.packed_residency
+                              else 4 * TILE))
+            promote: list[tuple] = []
+            if self.packed_residency:
+                # every hot block just lost its device residency; its
+                # host copy IS the warm medium — demote all, re-promote
+                for key, ent in self._pblocks.items():
+                    if ent["hot"]:
+                        ent["hot"] = False
+                        self._warm_bytes += ent["block"].packed_bytes
+                run_by_id = {id(r): r for r in self.rwi._runs}
+                for key in list(self._pblocks):
+                    run = run_by_id.get(key[0])
+                    if run is not None and \
+                            key not in self._promote_inflight:
+                        self._promote_inflight.add(key)
+                        promote.append((key, run))
+        if self.packed_residency:
+            for key, run in promote:
+                self._submit_promote(key, run)
+        else:
+            for run in list(self.rwi._runs):
+                self.on_run_added(run)
+        # seed tombstones survive in rwi; fresh arena re-marks them
+        for docid in self.rwi._tombstones:
+            self.arena.mark_dead(docid)
+        self._maybe_prewarm()
+
     def on_run_added(self, run) -> None:
         """Pack a frozen run into one contiguous arena block, each term's
         rows reordered by the pack-time proxy score (descending) with its
@@ -2994,6 +3197,14 @@ class DeviceSegmentStore:
         (served wrong)."""
         try:
             self._on_run_added_inner(run)
+        except integrity.CorruptRunError as e:
+            # a span failed its checksum while packing (cold startup /
+            # post-flush read off the mmap): quarantine the run instead
+            # of crashing the flush thread or refusing to start — the
+            # RWI pulls it from serving and calls back on_run_removed,
+            # which retires whatever partial pack state this run left
+            log.error("corrupt run during device pack: %s", e)
+            self.rwi._quarantine_run(run, e)
         finally:
             self._bump_epoch()
         # packing may have grown the arena: compiled shapes re-key
@@ -3317,7 +3528,14 @@ class DeviceSegmentStore:
                 ent = self._pblocks.get(key)
                 src = "warm" if ent is not None else "cold"
             if ent is None:
-                p = run.get(th)
+                try:
+                    p = run.get(th)
+                except integrity.CorruptRunError as e:
+                    # cold-tier corruption found by the promotion read:
+                    # quarantine (the host query path that triggered
+                    # this miss already served); never crash a promote
+                    self.rwi._quarantine_run(run, e)
+                    return None
                 if p is None or len(p) == 0:
                     return None
                 ent = self._build_packed_entry(p)
@@ -3799,6 +4017,21 @@ class DeviceSegmentStore:
             "kernel_ms_p95": self._pctl(kseries, 0.95),
             "queries_served": self.queries_served,
             "fallbacks": self.fallbacks,
+            # device-loss recovery (ISSUE 10c): 0/1 lost flag, declared
+            # losses, completed rebuilds, host-fallback answers while
+            # lost, and the transfer classifier's failure/retry counts
+            "device_lost": 1 if self.device_lost else 0,
+            "device_losses": self.device_losses,
+            "device_loss_recoveries": self.device_loss_recoveries,
+            "device_lost_queries": self.device_lost_queries,
+            "transfer_failures": self.transfer_failures,
+            "transfer_retries": self.transfer_retries,
+            # read-side integrity (ISSUE 10a): corruption detections and
+            # torn-tail recoveries ride the headline artifact through
+            # these totals (asserted zero on a healthy soak)
+            "storage_corruptions": integrity.corruption_total(),
+            "journal_torn_tails": sum(
+                integrity.torn_tail_counts().values()),
             # versioned top-k result cache: hits serve with ZERO device
             # work; stale counts entries correctly invalidated by an
             # arena-epoch move (flush/merge/repack/delete)
@@ -3949,7 +4182,7 @@ class DeviceSegmentStore:
                     *consts, k=kk, maxt=_pmax_window(self._max_tcount),
                     bs=nbs)
                 t1 = time.perf_counter()
-                host = jax.device_get(out)
+                host = self.device_fetch(out)
                 self.count_round_trip()
                 _emit_rt_spans((t1 - t0) * 1e3,
                                (time.perf_counter() - t1) * 1e3)
@@ -3961,7 +4194,7 @@ class DeviceSegmentStore:
                 cmins, cmaxs, tmins, tmaxs,
                 shift, lang_term, *consts, k=kk, b=b)
             t1 = time.perf_counter()
-            s, d, ok = jax.device_get(out)
+            s, d, ok = self.device_fetch(out)
             self.count_round_trip()
             _emit_rt_spans((t1 - t0) * 1e3,
                            (time.perf_counter() - t1) * 1e3)
@@ -3974,7 +4207,7 @@ class DeviceSegmentStore:
             st["col_min"], st["col_max"], st["tf_min"],
             st["tf_max"], shift, lang_term, *consts, k=kk, b=b)
         t1 = time.perf_counter()
-        s, d, ok = jax.device_get(out)  # one combined fetch
+        s, d, ok = self.device_fetch(out)  # one combined fetch
         self.count_round_trip()
         _emit_rt_spans((t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3)
         return s, d, bool(ok)
@@ -3999,9 +4232,23 @@ class DeviceSegmentStore:
         eligible-shaped query lands in join_served, join_fallbacks, or
         join_degraded_plain (the mixed-load coverage surface bench
         config 8 reports)."""
-        out = self._rank_join_impl(
-            include_hashes, exclude_hashes, profile, language, k,
-            lang_filter, flag_bit, from_days, to_days)
+        if self.device_lost:
+            # device lost (ISSUE 10c): host conjunction serves, counted
+            with self._lock:
+                self.device_lost_queries += 1
+                self.join_fallbacks += 1
+            return None
+        try:
+            out = self._rank_join_impl(
+                include_hashes, exclude_hashes, profile, language, k,
+                lang_filter, flag_bit, from_days, to_days)
+        except DeviceTransferError:
+            # transfer died mid-join (classification already counted it
+            # and may have declared the loss): host fallback, no crash
+            with self._lock:
+                self.device_lost_queries += 1
+                self.join_fallbacks += 1
+            return None
         if out == "declined":            # eligible shape, device declined
             with self._lock:
                 self.join_fallbacks += 1
@@ -4194,7 +4441,7 @@ class DeviceSegmentStore:
                     n_exc=len(exc_spans), r=r, inc_ms=inc_ms,
                     exc_ms=exc_ms)
             t1j = time.perf_counter()
-            host = jax.device_get(out)
+            host = self.device_fetch(out)
             self.count_round_trip()
             _emit_rt_spans((t1j - t0j) * 1e3,
                            (time.perf_counter() - t1j) * 1e3)
@@ -4386,6 +4633,14 @@ class DeviceSegmentStore:
         from ..ops.dense import (RERANK_MAX_N,
                                  _rerank_fwd_batch_packed_kernel,
                                  pack_rerank_row, rerank_bucket)
+        if self.device_lost:
+            # device lost (ISSUE 10c): the caller serves the sparse
+            # order.  Counted in rerank_fallbacks only —
+            # device_lost_queries is a PER-QUERY count and this query's
+            # sparse stage already counted it in rank_term/rank_join
+            with self._lock:
+                self.rerank_fallbacks += 1
+            return None
         dense = self._dense
         if dense is None:
             return None
@@ -4418,7 +4673,7 @@ class DeviceSegmentStore:
         t0 = time.perf_counter()
         out = _rerank_fwd_batch_packed_kernel(fwd, qi, nb=nb, bs=bs)
         t1 = time.perf_counter()
-        host = jax.device_get(out)
+        host = self.device_fetch(out)
         self.count_round_trip()
         _emit_rt_spans((t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3)
         PROFILER.record(
@@ -4529,7 +4784,7 @@ class DeviceSegmentStore:
         out = _rank_pruned_batch1_bp_kernel(
             pwords, dead, pmax, qiq, *consts, k=kk, maxt=maxt, bs=nbs)
         t1 = time.perf_counter()
-        host = jax.device_get(out)
+        host = self.device_fetch(out)
         self.count_round_trip()
         _emit_rt_spans((t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3)
         PROFILER.record(
@@ -4563,7 +4818,7 @@ class DeviceSegmentStore:
         out = _rank_scan_batch_bp_kernel(pwords, dead, qi, *consts,
                                          k=kk, bs=bs)
         t1 = time.perf_counter()
-        host = jax.device_get(out)
+        host = self.device_fetch(out)
         self.count_round_trip()
         _emit_rt_spans((t1 - t0) * 1e3, (time.perf_counter() - t1) * 1e3)
         rows = ((sp.count + TILE - 1) // TILE) * TILE
@@ -4709,7 +4964,36 @@ class DeviceSegmentStore:
         `allow_bitmap` (from filter_bitmap) restricts candidates to a
         metadata-facet docid set — such queries take the exact streaming
         scan (pruning's tail bound is stated over the UNfiltered span,
-        so a filtered theta would almost never verify)."""
+        so a filtered theta would almost never verify).
+
+        Device-loss contract (ISSUE 10c): while the device is declared
+        lost — or if a transfer dies under this very query — the answer
+        is None (the caller's host path serves), counted in
+        `device_lost_queries` + `fallbacks`.  NEVER an exception."""
+        if self.device_lost:
+            with self._lock:
+                self.device_lost_queries += 1
+                self.fallbacks += 1
+            return None
+        try:
+            return self._rank_term_impl(
+                termhash, profile, language, k, lang_filter, flag_bit,
+                from_days, to_days, allow_bitmap)
+        except DeviceTransferError:
+            # classification (and possibly the loss declaration) already
+            # happened inside device_fetch — the query host-serves
+            with self._lock:
+                self.device_lost_queries += 1
+                self.fallbacks += 1
+            return None
+
+    def _rank_term_impl(self, termhash: bytes, profile,
+                        language: str = "en", k: int = 100,
+                        lang_filter: int = NO_LANG,
+                        flag_bit: int = NO_FLAG,
+                        from_days: int | None = None,
+                        to_days: int | None = None,
+                        allow_bitmap=None):
         cacheable = (lang_filter == NO_LANG and flag_bit == NO_FLAG
                      and from_days is None and to_days is None
                      and allow_bitmap is None)
@@ -4919,7 +5203,7 @@ class DeviceSegmentStore:
                 with_filter=allow_bitmap is not None,
                 with_ext_stats=cached is not None)
             t1k = time.perf_counter()
-            host = jax.device_get(out)   # ONE packed fetch (was six)
+            host = self.device_fetch(out)   # ONE packed fetch (was six)
             self.count_round_trip()
             _emit_rt_spans((t1k - t0k) * 1e3,
                            (time.perf_counter() - t1k) * 1e3)

@@ -13,9 +13,20 @@ shared byte-budget LRU (`TermCache`) keeps hot terms materialized.
 File format (one run = two files, written atomically via os.replace):
 
     run-XXXXXX.dat   int32 little-endian: docids[total] then feats[total, NF]
-    run-XXXXXX.tix   text: "PR1 <total>" header, then one line per term:
-                     "<termhash> <start> <count>"   (rows into .dat, sorted
-                     by termhash for deterministic files)
+    run-XXXXXX.tix   text: "PR2 <total> <dead_seq>" header, then one line
+                     per term: "<termhash> <start> <count> <crc8hex>"
+                     (rows into .dat, sorted by termhash for deterministic
+                     files; crc32 over the term's docid+feat row bytes),
+                     then a "#CRC <crc8hex>" footer over every preceding
+                     byte.  PR1 files (no checksums) stay readable.
+
+Read-side integrity (ISSUE 10): `open` scrubs the .tix (footer crc,
+parseable lines) and the .dat size against the header — truncation or
+garbage raises a typed `integrity.CorruptRunError` instead of an
+unhandled struct/mmap crash; a span materializing off the mmap verifies
+its per-term crc lazily (VERIFY_ON_READ), so cold-tier page corruption
+is detected at read and the owning RWIIndex QUARANTINES the run (term
+answered from surviving generations/RAM, never a query crash).
 
 Postings of one term are contiguous rows ``[start, start+count)`` in both
 sections, docid-sorted — which is also exactly the span shape the device
@@ -31,9 +42,13 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..utils import faultinject
+from . import integrity
+from .integrity import CorruptRunError
 from .postings import NF, PostingsList
 
-_MAGIC = "PR1"
+_MAGIC = "PR2"
+_LEGACY_MAGICS = ("PR1",)   # round-2 format: no per-term checksums
 
 
 class TermCache:
@@ -111,11 +126,15 @@ class PagedRun:
 
     def __init__(self, path: str, index: dict[bytes, tuple[int, int]],
                  total: int, cache: TermCache | None = None,
-                 dead_seq: int = -1):
+                 dead_seq: int = -1,
+                 crcs: dict[bytes, int] | None = None):
         self.path = path
         self._index = index                  # termhash -> (start, count)
         self._total = total
         self._cache = cache
+        # per-term span checksums (crc32 over docid+feat row bytes);
+        # empty for legacy PR1 files — no claim, no verification
+        self._crcs = crcs or {}
         self._mm_docids: np.ndarray | None = None
         self._mm_feats: np.ndarray | None = None
         self.n_postings = sum(c for _, c in index.values())
@@ -135,46 +154,106 @@ class PagedRun:
         order = sorted(terms.keys())
         total = sum(len(terms[th]) for th in order)
         index: dict[bytes, tuple[int, int]] = {}
+        crcs: dict[bytes, int] = {}
         tmp_dat, tmp_tix = path + ".tmp", _tix_path(path) + ".tmp"
+        faultinject.io_error(path)
         with open(tmp_dat, "wb") as f:
             start = 0
             for th in order:
                 index[th] = (start, len(terms[th]))
-                f.write(np.ascontiguousarray(
-                    terms[th].docids, dtype="<i4").tobytes())
+                dbytes = np.ascontiguousarray(
+                    terms[th].docids, dtype="<i4").tobytes()
+                f.write(dbytes)
+                # span checksum: docid row bytes then feat row bytes —
+                # exactly what get() re-reads off the mmap
+                crcs[th] = integrity.crc32(
+                    np.ascontiguousarray(
+                        terms[th].feats, dtype="<i4").tobytes(),
+                    integrity.crc32(dbytes))
                 start += len(terms[th])
             for th in order:
                 f.write(np.ascontiguousarray(
                     terms[th].feats, dtype="<i4").tobytes())
             f.flush()
             os.fsync(f.fileno())
+        body = [f"{_MAGIC} {total} {dead_seq}"]
+        for th in order:
+            s, c = index[th]
+            body.append(f"{th.decode('ascii')} {s} {c} {crcs[th]:08x}")
+        text = "\n".join(body) + "\n"
+        text += f"#CRC {integrity.crc32(text.encode('ascii')):08x}\n"
         with open(tmp_tix, "w", encoding="ascii") as f:
-            f.write(f"{_MAGIC} {total} {dead_seq}\n")
-            for th in order:
-                s, c = index[th]
-                f.write(f"{th.decode('ascii')} {s} {c}\n")
+            f.write(text)
             f.flush()
             os.fsync(f.fileno())
         # data file lands before the index that references it; the dir
         # fsync makes both renames durable (colstore.fsync_dir)
         os.replace(tmp_dat, path)
+        # chaos barrier: .dat visible under its final name, .tix still
+        # .tmp — the restart must treat the run as absent (the manifest
+        # never referenced it) instead of crashing on the missing .tix
+        faultinject.crashpoint("pagedrun.write.dat_renamed")
         os.replace(tmp_tix, _tix_path(path))
         from .colstore import fsync_dir
         fsync_dir(os.path.dirname(path) or ".")
-        return PagedRun(path, index, total, cache, dead_seq)
+        return PagedRun(path, index, total, cache, dead_seq, crcs)
 
     @staticmethod
     def open(path: str, cache: TermCache | None = None) -> "PagedRun":
+        """Open + scrub: footer crc over the .tix, parseable span lines,
+        and a .dat sized to the header's row count.  Truncation or
+        garbage raises a typed CorruptRunError (counted kind=run,
+        action=error) — callers quarantine; nothing struct/mmap-crashes
+        a query later."""
         index: dict[bytes, tuple[int, int]] = {}
-        with open(_tix_path(path), "r", encoding="ascii") as f:
-            header = f.readline().split()
-            assert header[0] == _MAGIC, f"bad run header in {path}: {header}"
+        crcs: dict[bytes, int] = {}
+        try:
+            with open(_tix_path(path), "r", encoding="ascii") as f:
+                raw = f.read()
+            lines = raw.splitlines()
+            if not lines:
+                raise CorruptRunError(f"empty run index {path}")
+            header = lines[0].split()
+            if not header or header[0] not in (_MAGIC,) + _LEGACY_MAGICS:
+                raise CorruptRunError(
+                    f"bad run header in {path}: {header[:3]}")
             total = int(header[1])
             dead_seq = int(header[2]) if len(header) > 2 else -1
-            for line in f:
-                th, s, c = line.split()
+            span_lines = lines[1:]
+            if span_lines and span_lines[-1].startswith("#CRC "):
+                footer = span_lines.pop()
+                if integrity.VERIFY_ON_READ:
+                    want = int(footer.split()[1], 16)
+                    upto = raw.rindex("#CRC ")
+                    if integrity.crc32(raw[:upto].encode("ascii")) \
+                            != want:
+                        raise CorruptRunError(
+                            f"run index checksum mismatch in {path}")
+                    integrity.note_verified()
+            for line in span_lines:
+                fields = line.split()
+                th, s, c = fields[0], fields[1], fields[2]
                 index[th.encode("ascii")] = (int(s), int(c))
-        return PagedRun(path, index, total, cache, dead_seq)
+                if len(fields) > 3:
+                    crcs[th.encode("ascii")] = int(fields[3], 16)
+            want_bytes = total * 4 + total * NF * 4
+            have = os.path.getsize(path)
+            if have < want_bytes:
+                raise CorruptRunError(
+                    f"run data {path} truncated: {have} bytes < "
+                    f"{want_bytes} expected for {total} rows")
+            for s, c in index.values():
+                if s < 0 or c < 0 or s + c > total:
+                    raise CorruptRunError(
+                        f"run index {path}: span ({s},{c}) outside "
+                        f"{total} rows")
+        except CorruptRunError:
+            integrity.note_corruption("run", "error")
+            raise
+        except (OSError, ValueError, IndexError, UnicodeDecodeError) as e:
+            integrity.note_corruption("run", "error")
+            raise CorruptRunError(f"corrupt run {path}: {e!r}") from e
+        return PagedRun(path, index, total, cache, dead_seq, crcs)
 
     def _maps(self) -> tuple[np.ndarray, np.ndarray]:
         if self._mm_docids is None:
@@ -200,6 +279,24 @@ class PagedRun:
         docids, feats = self._maps()
         p = PostingsList(np.array(docids[start:start + count]),
                          np.array(feats[start:start + count]))
+        # lazy verify-on-read (ISSUE 10): the span's bytes just paged in
+        # off the cold tier — verify them ONCE per materialization (a
+        # TermCache hit re-serves verified rows with zero recompute).
+        # Mismatch raises typed; the owning RWIIndex quarantines the run
+        # and answers the term from surviving generations/RAM.
+        want = self._crcs.get(termhash)
+        if want is not None and integrity.VERIFY_ON_READ:
+            got = integrity.crc32(
+                np.ascontiguousarray(p.feats, dtype="<i4").tobytes(),
+                integrity.crc32(np.ascontiguousarray(
+                    p.docids, dtype="<i4").tobytes()))
+            if got != want:
+                integrity.note_corruption("run", "error")
+                raise CorruptRunError(
+                    f"span checksum mismatch for term "
+                    f"{termhash.decode('ascii', 'replace')} in "
+                    f"{self.path}")
+            integrity.note_verified()
         if self._cache is not None:
             self._cache.put(key, p)
         return p

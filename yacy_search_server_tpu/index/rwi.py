@@ -25,14 +25,21 @@ BusyThread, matching IndexCell.FlushThread); readers merge RAM + runs.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 
 import numpy as np
 
+from . import integrity
+from .colstore import journal_append
+from .integrity import CorruptRunError
 from .pagedrun import PagedRun, TermCache
 from .postings import NF, PostingsList, merge, remove_docids, sort_dedupe
+from ..utils import faultinject
 from ..utils.eventtracker import EClass, update as track
+
+log = logging.getLogger("yacy.rwi")
 
 # flush threshold, postings count — reference default `wordCacheMaxCount`
 # (defaults/yacy.init:793)
@@ -167,10 +174,25 @@ class RWIIndex:
             for fn in names:
                 p = os.path.join(data_dir, fn)
                 if os.path.exists(p):
-                    if fn.endswith(".npz"):   # round-1 format: full load
-                        self._runs.append(FrozenRun.load(p))
-                    else:                     # paged: index only, mmap data
-                        self._runs.append(PagedRun.open(p, self.term_cache))
+                    # a corrupt/truncated run QUARANTINES at open (ISSUE
+                    # 10): the node comes up serving the surviving
+                    # generations instead of refusing to start — the
+                    # files stay on disk for forensics/repair
+                    try:
+                        if fn.endswith(".npz"):   # round-1: full load
+                            self._runs.append(FrozenRun.load(p))
+                        else:          # paged: index only, mmap data
+                            self._runs.append(
+                                PagedRun.open(p, self.term_cache))
+                    except CorruptRunError as e:
+                        integrity.note_corruption("run", "quarantined")
+                        log.error("quarantined corrupt run %s: %s",
+                                  fn, e)
+                    except Exception as e:   # legacy npz zip damage
+                        integrity.note_corruption("run", "error")
+                        integrity.note_corruption("run", "quarantined")
+                        log.error("quarantined unreadable run %s: %r",
+                                  fn, e)
                     self._run_seq = max(self._run_seq, int(fn[4:-4]) + 1)
             dp = os.path.join(data_dir, "deletions.log")
             if os.path.exists(dp):
@@ -186,33 +208,85 @@ class RWIIndex:
             for r in self._runs:
                 if r.path:
                     f.write(os.path.basename(r.path) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        # chaos barrier: manifest .tmp durable but not renamed — restart
+        # must serve the OLD manifest's run set, bit-identically
+        faultinject.crashpoint("rwi.manifest.mid_write")
         os.replace(tmp, mp)
+        from .colstore import fsync_dir
+        fsync_dir(self.data_dir)
 
     def _replay_deletions(self, path: str) -> None:
         def run_seq_of(run) -> int:
             return int(os.path.basename(run.path)[4:-4]) if run.path else -1
 
-        with open(path, "r", encoding="ascii") as f:
-            for line in f:
-                fields = line.strip().split(" ")
-                if not fields or not fields[0]:
-                    continue
-                if fields[0] == "D":
+        # shared scaffold (integrity.journal_lines): torn-tail repair
+        # before the append-mode reopen, crc verification, and the
+        # final-line-torn vs mid-file-corruption classification (a lost
+        # delete re-surfaces rows; it cannot desync docids the way a
+        # lost metadata put would)
+        for payload, is_last in integrity.journal_lines(path, "rwi"):
+            fields = payload.strip().split(" ")
+            if not fields or not fields[0]:
+                continue
+            if fields[0] == "D":
+                try:
                     self._tombstones.add(int(fields[1]))
-                elif fields[0] == "T":
+                except (ValueError, IndexError):
+                    if is_last:
+                        integrity.note_torn_tail("rwi")
+                    else:
+                        integrity.note_corruption("journal", "error")
+            elif fields[0] == "T":
+                try:
                     th = fields[1].encode("ascii")
                     # horizon: only runs frozen before the removal are
                     # affected — the term may have been re-added since
-                    horizon = int(fields[2]) if len(fields) > 2 else 1 << 30
-                    for run in self._runs:
-                        if run_seq_of(run) >= horizon:
-                            continue
-                        run.drop_term(th)
+                    horizon = int(fields[2]) if len(fields) > 2 \
+                        else 1 << 30
+                except (ValueError, IndexError,
+                        UnicodeEncodeError):
+                    # damaged legacy (crc-less) record: classified like
+                    # the D branch, never a refused startup
+                    if is_last:
+                        integrity.note_torn_tail("rwi")
+                    else:
+                        integrity.note_corruption("journal", "error")
+                    continue
+                for run in self._runs:
+                    if run_seq_of(run) >= horizon:
+                        continue
+                    run.drop_term(th)
 
     def _journal_deletion(self, line: str) -> None:
         if self._dels:
-            self._dels.write(line + "\n")
-            self._dels.flush()
+            # shared append+fsync helper (ISSUE 10 satellite): a
+            # returned delete is on the platter, crc-prefixed
+            journal_append(self._dels, line)
+
+    def _quarantine_run(self, run, err) -> None:
+        """Pull a corrupt run from serving (ISSUE 10 tentpole a): the
+        term that tripped the checksum — and every other term of the
+        run — is answered from the surviving generations + RAM from now
+        on; a query NEVER crashes on disk corruption.  The files stay
+        on disk (and in the manifest) for forensics/repair — a restart
+        re-opens them and re-quarantines on the next bad read.  close()
+        invalidates the run's TermCache entries; the listener hook
+        drops its arena spans and bumps the epoch, so no cached or
+        device answer built on the corrupt bytes survives."""
+        with self._lock:
+            if run not in self._runs:
+                return          # raced: another reader already pulled it
+            self._runs = [r for r in self._runs if r is not run]
+            integrity.note_corruption("run", "quarantined")
+        log.error("quarantined corrupt run %s: %s",
+                  os.path.basename(run.path) if run.path else "<ram>",
+                  err)
+        run.close()             # drops the run's TermCache entries
+        if self.listener is not None:
+            self.listener.on_run_removed(run)
+        track(EClass.INDEX, "run_quarantine", 1)
 
     # -- write path ----------------------------------------------------------
 
@@ -325,6 +399,11 @@ class RWIIndex:
                         pass
                 return ram_run
             self._runs[i] = paged
+            # chaos barrier: run file pair durable, manifest not yet
+            # rewritten to reference it — restart serves the pre-flush
+            # state (the orphan pair is invisible; acked docs were only
+            # acked AFTER a completed flush)
+            faultinject.crashpoint("rwi.flush.before_manifest")
             self._write_manifest()
             if self.listener is not None:
                 self.listener.on_run_swapped(ram_run, paged)
@@ -350,12 +429,28 @@ class RWIIndex:
             # transient RAM spike proportional to the victims' size — a
             # merge is a rewrite; steady-state residency stays paged
             merged: dict[bytes, PostingsList] = {}
+            corrupt = None
             for th in all_terms:
-                parts = [p for p in (r.get(th) for r in victims)
-                         if p is not None]
+                parts = []
+                for r in victims:
+                    try:
+                        p = r.get(th)
+                    except CorruptRunError as e:
+                        corrupt = (r, e)
+                        break
+                    if p is not None:
+                        parts.append(p)
+                if corrupt is not None:
+                    break
                 m = remove_docids(merge(parts), dead)
                 if len(m):
                     merged[th] = m
+            if corrupt is not None:
+                # a victim failed its span checksum mid-merge: abort
+                # this merge (no state was swapped yet), quarantine the
+                # corrupt run, let the next merge pass fold survivors
+                self._quarantine_run(*corrupt)
+                return False
             new_run = FrozenRun(merged, dead_seq=len(self._tombstones))
             snapshot = dict(merged)  # outside-lock write vs remove_term race
             save_path = None
@@ -387,6 +482,10 @@ class RWIIndex:
                 self._write_manifest()
         for r in victims:
             r.close()
+        # chaos barrier: merged run live in the manifest, victims not
+        # yet unlinked — restart serves the merged run; the stale files
+        # are unreferenced disk garbage, not resurrected state
+        faultinject.crashpoint("rwi.merge.before_unlink")
         for p in victim_paths:
             for path in (p, p[:-4] + ".tix" if p.endswith(".dat") else None):
                 if path:
@@ -427,8 +526,14 @@ class RWIIndex:
                 d = np.fromiter((r[0] for r in rows), dtype=np.int32, count=len(rows))
                 f = np.stack([r[1] for r in rows]).astype(np.int32)
                 parts.append(sort_dedupe(d, f))
-            for run in self._runs:
-                p = run.get(termhash)
+            for run in list(self._runs):
+                try:
+                    p = run.get(termhash)
+                except CorruptRunError as e:
+                    # the handoff loses this run's share of the term
+                    # (counted); the run leaves serving entirely
+                    self._quarantine_run(run, e)
+                    continue
                 if p is not None:
                     run.drop_term(termhash)
                     if self.listener is not None:
@@ -475,7 +580,13 @@ class RWIIndex:
             dead = self._dead_sorted() if self._tombstones else None
         parts: list[PostingsList] = []
         for run in runs:
-            p = run.get(termhash)
+            try:
+                p = run.get(termhash)
+            except CorruptRunError as e:
+                # NEVER a query crash (ISSUE 10): quarantine the run,
+                # serve the term from the surviving generations + RAM
+                self._quarantine_run(run, e)
+                continue
             if p is not None:
                 parts.append(p)
         if ram is not None:
@@ -499,12 +610,16 @@ class RWIIndex:
             ram = self._ram.get(termhash)
             if ram is not None:
                 total += len(ram)
-            for run in self._runs:
+            for run in list(self._runs):
                 sp = run.span(termhash)
                 if sp is not None:
                     total += sp[1]
                 elif run.has(termhash):
-                    p = run.get(termhash)
+                    try:
+                        p = run.get(termhash)
+                    except CorruptRunError as e:
+                        self._quarantine_run(run, e)
+                        continue
                     total += len(p) if p is not None else 0
             return total
 

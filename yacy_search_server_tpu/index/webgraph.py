@@ -41,7 +41,8 @@ from collections import defaultdict
 import numpy as np
 
 from ..utils.hashes import _split, safe_host, url2hash, url_file_ext
-from .colstore import (SegmentReader, purge_stale_journals,
+from .colstore import (SegmentReader, journal_append,
+                       journal_append_many, purge_stale_journals,
                        write_segment)
 
 # rel attribute coding (reference: WebgraphConfiguration.relEval:291 —
@@ -353,11 +354,14 @@ class WebgraphStore:
         with self._lock:
             for row in rows:
                 self._append(row)
-                if journal and self._journal:
-                    self._journal.write(
-                        json.dumps(row, ensure_ascii=False) + "\n")
             if journal and self._journal:
-                self._journal.flush()
+                # shared append+fsync helper (ISSUE 10 satellite): one
+                # barrier per edge batch — the old bare flush() left
+                # acked edges in the page cache on power loss
+                journal_append_many(
+                    self._journal,
+                    (json.dumps(row, ensure_ascii=False)
+                     for row in rows))
             if self._journal and journal \
                     and len(self._text["source_id_s"]) >= self.snapshot_rows:
                 self.snapshot()
@@ -384,9 +388,8 @@ class WebgraphStore:
             self._dead.update(fresh)
             self._by_source_docid.pop(source_docid, None)
             if fresh and journal and self._journal:
-                self._journal.write(
-                    json.dumps({"_del_source": source_docid}) + "\n")
-                self._journal.flush()
+                journal_append(self._journal,
+                               json.dumps({"_del_source": source_docid}))
             # dead-majority auto-compaction: memory and replay time stay
             # proportional to LIVE edges over unbounded recrawl cycles
             if (journal and len(self._dead) >= self.COMPACT_MIN_DEAD
@@ -558,19 +561,16 @@ class WebgraphStore:
     # -- persistence ---------------------------------------------------------
 
     def _replay(self, path: str) -> None:
-        with open(path, encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if "_del_source" in rec:
-                    self.remove_source(int(rec["_del_source"]), journal=False)
-                elif "source_id_s" in rec:
-                    self._append(rec)
+        from . import integrity
+        # shared scaffold: torn-tail repair + \n-only splitting (edge
+        # rows are ensure_ascii=False — a U+2028 in anchor text must
+        # not shatter a record) + damage classification.  A lost edge
+        # cannot desynchronize anything (edges allocate no docids).
+        for rec in integrity.journal_records(path, "webgraph"):
+            if "_del_source" in rec:
+                self.remove_source(int(rec["_del_source"]), journal=False)
+            elif "source_id_s" in rec:
+                self._append(rec)
 
     def snapshot(self) -> None:
         """Freeze the RAM tail into an immutable segment with its index

@@ -545,6 +545,14 @@ class SearchEvent:
             if got is not None:
                 s, d = got
                 return np.asarray(s, dtype=np.int64), np.asarray(d)
+        # device lost (ISSUE 10c): the legacy path below still runs a
+        # device kernel — on a REAL dead device it would crash the
+        # query.  Serve the sparse order instead (the ladder's rung-2
+        # prefix: deterministic, tie discipline already applied) and
+        # count it as a degraded rerank
+        if ds is not None and getattr(ds, "device_lost", False):
+            self._note_degraded("RERANK", len(docids))
+            return sparse, docids
         # host-gather legacy path (no device store / no device-resident
         # forward index): per-query block upload + solo kernel
         import jax.numpy as jnp

@@ -386,10 +386,18 @@ class YaCyHttpServer:
                                   tracing.last_trace_id())
             # any downgraded answer is stamped (ISSUE 9 satellite): a
             # client/load balancer can tell a degraded 200 from a full
-            # one without parsing the body
+            # one without parsing the body.  A lost device (ISSUE 10c)
+            # marks too: results are host-fallback-served until the
+            # background rebuild restores device parity.
+            ds = getattr(self.sb.index, "devstore", None)
+            dlost = ds is not None and getattr(ds, "device_lost", False)
+            degr = None
+            if lvl > 0:
+                degr = (f"{lvl}+device-loss" if dlost else str(lvl))
+            elif dlost:
+                degr = "device-loss"
             self._send(handler, 200, ctype, body,
-                       extra={"X-YaCy-Degraded": str(lvl)} if lvl > 0
-                       else None)
+                       extra={"X-YaCy-Degraded": degr} if degr else None)
         except BrokenPipeError:
             pass
         except Exception as e:  # CrashProtectionHandler parity

@@ -524,6 +524,46 @@ def prometheus_text(sb, include_buckets: bool = True,
              "arena epoch (bumps on flush/merge/repack/delete; the "
              "stale-spike health rule reads its churn)")
     p.sample("yacy_device_arena_epoch", c.get("arena_epoch", 0))
+    # -- device-loss recovery (ISSUE 10c): always emitted (zeros
+    # without a devstore) — the device_loss health rule and the
+    # device_rebuild actuator reference these series by exact key
+    p.family("yacy_device_lost", "gauge",
+             "1 while the device is declared lost (queries host-"
+             "fallback, background rebuild running), else 0")
+    p.sample("yacy_device_lost", c.get("device_lost", 0))
+    p.family("yacy_device_loss_total", "counter",
+             "device-loss lifecycle counters: declared losses, "
+             "completed rebuilds back to device serving, host-fallback "
+             "answers while lost, retry-exhausted transfer failures, "
+             "bounded in-ladder transfer retries")
+    for key in ("losses", "recoveries", "lost_queries",
+                "transfer_failures", "transfer_retries"):
+        ck = {"losses": "device_losses",
+              "recoveries": "device_loss_recoveries",
+              "lost_queries": "device_lost_queries"}.get(key, key)
+        p.sample("yacy_device_loss_total", c.get(ck, 0),
+                 {"event": key})
+    # -- read-side integrity (ISSUE 10a): corruption detections by
+    # (kind, action) and journal torn-tail recoveries per store —
+    # zero-filled over the canonical sets so alert expressions and the
+    # storage_corruption rule always resolve
+    from ...index import integrity as _integ
+    p.family("yacy_storage_corruption_total", "counter",
+             "storage corruption events: kind=run/segment/journal, "
+             "action=error (detection) / quarantined (run pulled from "
+             "serving, terms answered from surviving generations)")
+    for (kind, action), v in sorted(_integ.corruption_counts().items()):
+        p.sample("yacy_storage_corruption_total", v,
+                 {"kind": kind, "action": action})
+    p.family("yacy_journal_torn_tail_total", "counter",
+             "journal replays that dropped a torn tail line (the "
+             "expected kill-9 artifact: recovered, counted)")
+    for store, v in sorted(_integ.torn_tail_counts().items()):
+        p.sample("yacy_journal_torn_tail_total", v, {"store": store})
+    p.family("yacy_integrity_verified_total", "counter",
+             "checksum verifications performed on the read path "
+             "(spans, segment columns, run indexes)")
+    p.sample("yacy_integrity_verified_total", _integ.verified_total())
     p.family("yacy_batcher_queue_depth", "gauge",
              "batcher incoming / in-flight queue depths (the backlog "
              "health rule watches the growth trend)")

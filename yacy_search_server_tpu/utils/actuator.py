@@ -321,6 +321,39 @@ def build_actuators(cfg) -> list:
                          "healed": healed},
         }
 
+    def device_rebuild(eng: "ActuatorEngine"):
+        """Device-loss watchdog (ISSUE 10c): while the device is lost,
+        ensure the store's background rebuild loop is actually alive
+        (declaration starts it; a died thread restarts here), and emit
+        one breadcrumb per loss/recovery EDGE — the incident that pages
+        on the loss names the recovery machinery next to it."""
+        ds = getattr(eng.sb.index, "devstore", None)
+        lost = bool(getattr(ds, "device_lost", False)) \
+            if ds is not None else False
+        if lost and ds is not None:
+            fn = getattr(ds, "start_rebuild", None)
+            if fn is not None:
+                fn()            # idempotent: no-op while alive
+        was = eng._device_lost_seen
+        if lost == was:
+            return None
+        eng._device_lost_seen = lost
+        # operator-visible mirror (the live path reads the store flag)
+        eng.sb.config.set("index.device.lost", 1 if lost else 0)
+        recoveries = getattr(ds, "device_loss_recoveries", 0) \
+            if ds is not None else 0
+        losses = getattr(ds, "device_losses", 0) if ds is not None else 0
+        return {
+            "dir": "down" if lost else "up",
+            "from": "serving" if lost else "lost",
+            "to": "lost" if lost else "serving",
+            "cause": ("device lost: host fallback + background rebuild"
+                      if lost else
+                      f"device serving resumed (recovery "
+                      f"#{recoveries})"),
+            "evidence": {"losses": losses, "recoveries": recoveries},
+        }
+
     return [
         Actuator("serving_ladder",
                  "degradation ladder driven by the slo_serving_p95 "
@@ -342,6 +375,13 @@ def build_actuators(cfg) -> list:
                  ("yacy_fleet_peers",
                   "yacy_fleet_peer_reported_critical"),
                  "remotesearch.avoidPeers", remote_peer_guard),
+        Actuator("device_rebuild",
+                 "device-loss watchdog: keeps the background rebuild "
+                 "alive while the device is lost; breadcrumbs every "
+                 "loss/recovery edge (down=lost, up=serving resumed)",
+                 ("yacy_device_lost",
+                  'yacy_device_loss_total{event="recoveries"}'),
+                 "index.device.lost", device_rebuild),
     ]
 
 
@@ -379,6 +419,7 @@ class ActuatorEngine:
         self._idle_streak = 0
         self._last_dispatches = 0
         self._avoid_peers: frozenset = frozenset()
+        self._device_lost_seen = False    # device_rebuild edge memory
         self.tick_count = 0
         self.shed_count = 0
         self.degraded_queries = [0] * N_LEVELS

@@ -364,6 +364,61 @@ def build_rules(cfg) -> list:
                           f"{ev['names']}"), ev
         return OK, "fleet peers healthy", ev
 
+    # -- crash-consistency / device-loss rules (ISSUE 10) --------------------
+
+    _corr_keys = tuple(
+        f'yacy_storage_corruption_total{{kind="{k}",action="{a}"}}'
+        for k, a in (("run", "quarantined"), ("run", "error"),
+                     ("segment", "error"),
+                     ("segment", "served_degraded"),
+                     ("journal", "error")))
+    _lost = "yacy_device_lost"
+    _recov = 'yacy_device_loss_total{event="recoveries"}'
+    _losses = 'yacy_device_loss_total{event="losses"}'
+
+    def storage_corruption(ctx: RuleCtx):
+        total = sum(ctx.value(k) for k in _corr_keys)
+        # counters are process-local: on the FIRST tick everything on
+        # record happened since start — a delta would read 0 and the
+        # critical edge (and its incident) would never fire for
+        # corruption detected before the engine's first evaluation
+        new = total if ctx.ticks() <= 1 \
+            else sum(ctx.delta(k) for k in _corr_keys)
+        ev = {"new_in_window": int(new), "total": int(total),
+              "by_kind": {k.split('kind="')[1].split('"')[0]
+                          + "/" + k.split('action="')[1].split('"')[0]:
+                          int(ctx.value(k)) for k in _corr_keys
+                          if ctx.value(k)}}
+        if new > 0:
+            # the critical EDGE dumps a flight-recorder incident — the
+            # corruption's evidence (which kind, which action) is in the
+            # record even if the operator looks hours later
+            return CRITICAL, (
+                f"{int(new)} storage corruption event(s) detected in "
+                f"the window (checksum mismatch / quarantine)"), ev
+        if total > 0:
+            return OK, (f"no new corruption ({int(total)} historical "
+                        f"event(s) on record)"), ev
+        return OK, "no storage corruption detected", ev
+
+    def device_loss(ctx: RuleCtx):
+        lost = ctx.value(_lost)
+        recovered = ctx.delta(_recov)
+        ev = {"device_lost": int(lost),
+              "losses_total": int(ctx.value(_losses)),
+              "recoveries_total": int(ctx.value(_recov)),
+              "recovered_in_window": int(recovered)}
+        if lost >= 1:
+            return CRITICAL, (
+                "device LOST: queries served via counted host fallback "
+                "(X-YaCy-Degraded: device-loss); background rebuild "
+                "re-uploading the hot tier"), ev
+        if recovered > 0:
+            return WARN, (f"device serving resumed after rebuild "
+                          f"({int(recovered)} recovery(ies) in the "
+                          f"window)"), ev
+        return OK, "device serving", ev
+
     def frontier_starvation(ctx: RuleCtx):
         def starving(i: int) -> bool:
             # at tick `i` ago: frontier empty while that tick still
@@ -406,6 +461,15 @@ def build_rules(cfg) -> list:
         Rule("crawler_frontier_starvation",
              "active crawl with an empty local frontier",
              (_frontier, _fetches), frontier_starvation),
+        Rule("storage_corruption",
+             "checksum-detected storage corruption (runs / segments / "
+             "journals) — critical on any new event; the edge dumps a "
+             "flight-recorder incident",
+             _corr_keys, storage_corruption),
+        Rule("device_loss",
+             "device declared lost after a transfer-failure streak "
+             "(host fallback serving, background rebuild)",
+             (_lost, _recov, _losses), device_loss),
         Rule("fleet_slo_serving",
              f"mesh-wide serving SLO burn rate over MERGED peer digests "
              f"(p95 objective {slo_ms}ms; coordinator-free federation)",
