@@ -1,0 +1,495 @@
+"""Dense-first retrieval: device-resident IVF ANN candidate generation
+(ISSUE 11 tentpole).
+
+Pins the new kernel family and retrieval mode end to end:
+
+- deterministic builds; centroid-set version bumps on rebuild;
+- assignment + probe/fuse kernels against their NumPy oracles
+  (ops/ann.ANN_ORACLES — the exact-scoring parity anchor), including
+  the (score DESC, docid ASC) tie discipline on constructed ties;
+- candidate recall vs the exact host oracle at a fixed nprobe;
+- solo / batched / cached dense-first answers bit-identical through
+  the serving path (the tie-discipline invariant extended across
+  dense-first, per the M81 contract);
+- cache invalidation on centroid rebuild, encoder swap, vector write
+  and arena-epoch bump — each through the key/epoch, never served
+  stale;
+- the hot/warm/cold vector tier ladder: greedy hot fill, host scoring
+  of warm/cold probes, promotion riding the batcher's `promote` part
+  kind, probe-lane budget drops counted;
+- `device.transfer_fail` chaos: dense-first queries host-fallback and
+  ANSWER during device loss (the M84 survival contract).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.annstore import AnnVectorIndex
+from yacy_search_server_tpu.index.devstore import DeviceSegmentStore
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.index.rwi import RWIIndex
+from yacy_search_server_tpu.ops import ann as A
+from yacy_search_server_tpu.ops import dense as DN
+from yacy_search_server_tpu.ops.ranking import RankingProfile
+from yacy_search_server_tpu.utils import faultinject
+
+TH = b"denseterm0AB"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.clear()
+    yield
+    faultinject.clear()
+
+
+def _clustered(rng, n, dim, n_clusters, noise=0.15):
+    centers = rng.standard_normal((n_clusters, dim)).astype(np.float32)
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    lab = rng.integers(0, n_clusters, n)
+    v = centers[lab] + noise * rng.standard_normal((n, dim)) \
+        .astype(np.float32)
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    return v.astype(np.float32), centers
+
+
+def _index(vecs, n_clusters, budget=1 << 22, **kw):
+    ix = AnnVectorIndex(vecs.shape[1], device_budget_bytes=budget, **kw)
+    ix.build(lambda a, b: vecs[a:b], len(vecs), n_clusters=n_clusters,
+             sample_n=4096, iters=2, seed=7)
+    return ix
+
+
+def _plist(rng, n):
+    docids = np.arange(n, dtype=np.int32)
+    feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+    feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+    feats[:, P.F_DOMLENGTH] = rng.integers(0, 256, n)
+    feats[:, P.F_LANGUAGE] = P.pack_language("en")
+    return PostingsList(docids, feats)
+
+
+def _served_store(n=3000, dim=64, C=16, budget=1 << 22, max_batch=4):
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(np.random.default_rng(0), n))
+    idx.flush()
+    ds = DeviceSegmentStore(idx)
+    ds.enable_batching(max_batch=max_batch, dispatchers=2,
+                       prewarm=False)
+    rng = np.random.default_rng(1)
+    vecs, _ = _clustered(rng, n, dim, C)
+    ann = AnnVectorIndex(dim, device_budget_bytes=budget)
+    ann.build(lambda a, b: vecs[a:b], n, n_clusters=C, sample_n=2048,
+              iters=2, seed=3)
+    ds.attach_ann(ann)
+    return ds, ann, vecs
+
+
+# -- build -------------------------------------------------------------------
+
+def test_build_deterministic_and_version_bumps():
+    rng = np.random.default_rng(0)
+    vecs, _ = _clustered(rng, 4000, 32, 8)
+    a = _index(vecs, 8)
+    b = _index(vecs, 8)
+    np.testing.assert_array_equal(np.asarray(a._slab),
+                                  np.asarray(b._slab))
+    np.testing.assert_array_equal(a._sdocids, b._sdocids)
+    assert a.centroid_version == 1
+    a.build(lambda i, j: vecs[i:j], len(vecs), n_clusters=8,
+            sample_n=4096, iters=2, seed=7)
+    assert a.centroid_version == 2     # rebuild re-keys every answer
+
+
+def test_docid_row_mapping_roundtrips():
+    rng = np.random.default_rng(2)
+    vecs, _ = _clustered(rng, 1000, 32, 4)
+    ix = _index(vecs, 4)
+    for d in (0, 17, 999):
+        r = int(ix._row_of[d])
+        assert int(ix._sdocids[r]) == d
+
+
+# -- kernel/oracle parity ----------------------------------------------------
+
+def test_assign_kernel_matches_oracle():
+    rng = np.random.default_rng(3)
+    vecs, _ = _clustered(rng, 6000, 64, 16)
+    ix = _index(vecs, 16)
+    dev = jax.devices()[0]
+    cent = ix.centroid_block(dev)
+    qv = np.zeros((4, 64), np.float32)
+    qv[0], qv[1] = vecs[5], vecs[4321]
+    ids = np.asarray(A._ann_assign_batch_kernel(
+        cent, jax.device_put(qv, dev), np_=4,
+        c_real=ix.n_clusters()))
+    want = A.ann_assign_np(np.asarray(ix.centroids), qv, 4)
+    for i in range(2):
+        real = [c for c in ids[i].tolist() if c < ix.n_clusters()]
+        assert real == want[i][want[i] < ix.n_clusters()].tolist()
+
+
+def test_fuse_kernel_matches_oracle():
+    rng = np.random.default_rng(4)
+    vecs, _ = _clustered(rng, 6000, 64, 16)
+    ix = _index(vecs, 16)
+    dev = jax.devices()[0]
+    hb, _used = ix.hot_block(dev)
+    q = vecs[123]
+    cids = ix.assign_host(q, 4)[0]
+    plan = ix.plan(cids, [5, 7], [100, 200], lanes_budget=8192)
+    rows = np.concatenate([plan["hot_rows"], plan["sp_hot"][0]])
+    dd = np.concatenate([np.full(len(plan["hot_rows"]), -1, np.int32),
+                         plan["sp_hot"][1]])
+    sp = np.concatenate([np.zeros(len(plan["hot_rows"]), np.int32),
+                         plan["sp_hot"][2]])
+    nb = A.ann_lane_bucket(len(rows), 1 << 15)
+    k = A.ann_topk_bucket(16, nb)
+    qrow = A.pack_ann_fuse_row(q, rows, dd, sp, 0.5, nb)
+    qi = np.zeros((4, len(qrow)), np.int32)
+    qi[0] = qrow
+    out = np.asarray(A._ann_fuse_batch_packed_kernel(
+        *hb, jax.device_put(qi, dev), nb=nb, bs=4, k=k))
+    es, ed = A.ann_fuse_np(ix._hot_slab, ix._hot_scales,
+                           ix._hot_docids, rows, dd, sp, q, 0.5, k)
+    # same candidate set; per-docid fused scores within the bf16
+    # accumulation-order budget (a few rounded-boost units)
+    kd = out[0, k:2 * k]
+    assert set(kd.tolist()) == set(ed.tolist())
+    kmap = dict(zip(kd.tolist(), out[0, :k].tolist()))
+    for d, s in zip(ed.tolist(), es.tolist()):
+        assert abs(kmap[d] - s) <= 64
+
+
+def test_fuse_tie_discipline_docid_asc():
+    """Identical vectors + equal sparse scores = equal fused scores:
+    the kernel must order them docid ASC (the pinned discipline), on
+    pad-free and pad-carrying slots alike."""
+    dim = 32
+    v = np.zeros((8, dim), np.float32)
+    v[:, 0] = 1.0                       # all identical -> all sims equal
+    vecs = v
+    ix = AnnVectorIndex(dim, device_budget_bytes=1 << 20)
+    ix.build(lambda a, b: vecs[a:b], len(vecs), n_clusters=1,
+             sample_n=8, iters=1, seed=0)
+    dev = jax.devices()[0]
+    hb, _used = ix.hot_block(dev)
+    rows = np.arange(8, dtype=np.int32)
+    dd = np.full(8, -1, np.int32)
+    sp = np.zeros(8, np.int32)
+    q = v[0]
+    nb = A.ann_lane_bucket(8, 1 << 15)
+    k = 8
+    qrow = A.pack_ann_fuse_row(q, rows, dd, sp, 1.0, nb)
+    qi = np.zeros((2, len(qrow)), np.int32)
+    qi[0] = qrow
+    out = np.asarray(A._ann_fuse_batch_packed_kernel(
+        *hb, jax.device_put(qi, dev), nb=nb, bs=2, k=k))
+    got = out[0, k:2 * k].tolist()
+    scores = out[0, :k].tolist()
+    assert len(set(scores)) == 1        # a genuine tie
+    assert got == sorted(got)           # docid ASC
+    # oracle agrees bit-for-bit on the tie order
+    es, ed = A.ann_fuse_np(ix._hot_slab, ix._hot_scales,
+                           ix._hot_docids, rows, dd, sp, q, 1.0, k)
+    assert ed.tolist() == got
+
+
+# -- recall vs the exact oracle ----------------------------------------------
+
+def test_recall_at_10_vs_exact_oracle():
+    """ANN candidates vs the exact (full-scan, same quantized domain)
+    oracle top-10 at a FIXED nprobe on a clustered corpus — the
+    acceptance gate's small-scale twin."""
+    rng = np.random.default_rng(5)
+    vecs, _ = _clustered(rng, 20000, 64, 32)
+    ix = _index(vecs, 32)
+    hits = tot = 0
+    for _ in range(20):
+        q = vecs[rng.integers(0, len(vecs))]
+        _s, d = ix.search_host(q, [], [], alpha=1.0, k=10, nprobe=4)
+        _es, ed = ix.exact_topk(q, 10)
+        hits += len(set(d.tolist()) & set(ed.tolist()))
+        tot += 10
+    assert hits / tot >= 0.9, f"recall@10 {hits / tot:.2f} < 0.9"
+
+
+# -- serving-path bit-identity (solo / batched / cached) ---------------------
+
+def test_dense_first_solo_batched_bit_identical():
+    ds, ann, vecs = _served_store()
+    q = vecs[77]
+    sd = np.array([5, 9, 2999], np.int32)
+    ss = np.array([900000, 800000, 700000], np.int32)
+    want = ds.dense_first_topk(q, ss, sd, 0.7, 25)
+    assert want is not None
+    # batched: concurrent submitters coalesce through the `ann` part
+    res = [None] * 4
+    def w(i):
+        res[i] = ds.dense_first_topk(q, ss, sd, 0.7, 25)
+    ts = [threading.Thread(target=w, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for r in res:
+        np.testing.assert_array_equal(np.asarray(r[0]),
+                                      np.asarray(want[0]))
+        np.testing.assert_array_equal(np.asarray(r[1]),
+                                      np.asarray(want[1]))
+    # solo path with batching off: same kernels, same compile shape
+    ds._ann_batching = False
+    solo = ds.dense_first_topk(q, ss, sd, 0.7, 25)
+    np.testing.assert_array_equal(np.asarray(solo[0]),
+                                  np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(solo[1]),
+                                  np.asarray(want[1]))
+    c = ds.counters()
+    assert c["ann_queries"] >= 6
+    assert c["ann_dispatches"] >= 1
+    ds.close()
+
+
+def test_sparse_candidates_never_dropped_by_missing_vector():
+    """A sparse candidate whose docid has NO slab row still rides the
+    fused list with its sparse score (vector absence must never drop a
+    sparse result)."""
+    ds, ann, vecs = _served_store(n=500, C=4)
+    q = vecs[10]
+    # docid far outside the vector space, huge sparse score
+    sd = np.array([499, 1 << 20], np.int32)
+    ss = np.array([5, 2 ** 27], np.int32)
+    s, d = ds.dense_first_topk(q, ss, sd, 0.5, 10)
+    assert (1 << 20) in d.tolist()
+    i = d.tolist().index(1 << 20)
+    assert s[i] == 2 ** 27              # sparse + zero boost
+    ds.close()
+
+
+# -- end-to-end dense-first search + cache -----------------------------------
+
+def _hybrid_segment():
+    from yacy_search_server_tpu.document.document import Document
+    from yacy_search_server_tpu.index.segment import Segment
+    seg = Segment()
+    # a cluster of on-topic docs carrying the query term, plus one
+    # SEMANTICALLY similar doc that does NOT contain the term (sparse
+    # can never retrieve it; dense-first must)
+    for i in range(24):
+        seg.store_document(Document(
+            url=f"http://on{i}.test/", title=f"fast kernels {i}",
+            text="fast kernels device ranking " * 6 + f"doc {i}"))
+    # shares word/trigram features with the query ("kernel" singular,
+    # "kernelized") but NOT the term "kernels" itself — the hashing
+    # encoder's cosine sees it, the sparse term index cannot
+    recovered = seg.store_document(Document(
+        url="http://recover.test/", title="rapid kernel device ranking",
+        text="rapid kernel compute kernelized device ranking " * 6))
+    for i in range(24):
+        seg.store_document(Document(
+            url=f"http://off{i}.test/", title=f"gardening {i}",
+            text="tomato gardening spring weather soil " * 6 + str(i)))
+    seg.rwi.flush()
+    seg.enable_device_serving()
+    seg.devstore.enable_batching(max_batch=4, dispatchers=2,
+                                 prewarm=False)
+    seg.devstore.small_rank_n = 0
+    seg.build_ann_index(n_clusters=4, sample_n=1024, iters=2)
+    return seg, recovered
+
+
+def test_dense_first_recovers_sparse_miss_end_to_end():
+    from yacy_search_server_tpu.search.query import QueryParams
+    from yacy_search_server_tpu.search.searchevent import SearchEvent
+    seg, recovered = _hybrid_segment()
+    q = QueryParams.parse("kernels")
+    q.hybrid = True
+    q.hybrid_alpha = 0.9
+    plain = SearchEvent(q, seg).results(count=30)
+    assert all(r.url != "http://recover.test/" for r in plain), \
+        "the recovery doc must not be sparse-reachable"
+    qd = QueryParams.parse("kernels")
+    qd.hybrid = True
+    qd.dense_first = True
+    qd.hybrid_alpha = 0.9
+    got = SearchEvent(qd, seg).results(count=30)
+    assert any(r.url == "http://recover.test/" for r in got), \
+        "dense-first failed to recover the semantically-near doc"
+    c = seg.devstore.counters()
+    assert c["ann_queries"] >= 1
+    seg.close()
+
+
+def test_dense_first_cached_bit_identical_and_invalidation():
+    """The versioned top-k cache serves dense-first answers
+    bit-identically with ZERO extra probe work — and a centroid
+    rebuild, an encoder swap, a vector write and an epoch bump each
+    invalidate (re-probe, never served stale)."""
+    from yacy_search_server_tpu.search.query import QueryParams
+    from yacy_search_server_tpu.search.searchevent import SearchEvent
+    seg, _ = _hybrid_segment()
+    ds = seg.devstore
+
+    def run():
+        q = QueryParams.parse("kernels")
+        q.hybrid = True
+        q.dense_first = True
+        ev = SearchEvent(q, seg)
+        return [(r.urlhash, r.score) for r in ev.results(count=20)]
+
+    first = run()
+    q0 = ds.counters()["ann_queries"]
+    again = run()
+    assert again == first                       # bit-identical
+    assert ds.counters()["ann_queries"] == q0   # zero probe work
+    assert ds.counters()["rerank_cache_hits"] >= 1
+
+    # (a) centroid rebuild re-keys
+    seg.build_ann_index(n_clusters=4, sample_n=1024, iters=2)
+    run()
+    assert ds.counters()["ann_queries"] == q0 + 1
+    # (b) vector write re-keys
+    q1 = ds.counters()["ann_queries"]
+    seg.dense.put(0, np.asarray(seg.dense.get_block(
+        np.asarray([0]))[0], np.float32))
+    run()
+    assert ds.counters()["ann_queries"] == q1 + 1
+    # (c) arena-epoch bump leaves the entry born-stale
+    q2 = ds.counters()["ann_queries"]
+    ds._bump_epoch()
+    run()
+    assert ds.counters()["ann_queries"] == q2 + 1
+    # (d) encoder swap re-keys (the key reads the live version)
+    q3 = ds.counters()["ann_queries"]
+    import yacy_search_server_tpu.ops.dense as dense_mod
+    old = dense_mod.ENCODER_VERSION
+    try:
+        dense_mod.ENCODER_VERSION = old + 1
+        run()
+        assert ds.counters()["ann_queries"] == q3 + 1
+    finally:
+        dense_mod.ENCODER_VERSION = old
+    seg.close()
+
+
+def test_dense_first_sheds_at_rung_one():
+    """The ladder: rung 1 sheds dense-first (one rung before the
+    rerank) — the answer equals the plain-hybrid answer and no probe
+    runs; rung 2 sheds the rerank too."""
+    from yacy_search_server_tpu.search.query import QueryParams
+    from yacy_search_server_tpu.search.searchevent import SearchEvent
+    seg, _ = _hybrid_segment()
+    ds = seg.devstore
+
+    def run(level, df=True):
+        q = QueryParams.parse("kernels")
+        q.hybrid = True
+        q.dense_first = df
+        q.degrade_level = level
+        ev = SearchEvent(q, seg)
+        return [(r.urlhash, r.score) for r in ev.results(count=20)]
+
+    q0 = ds.counters()["ann_queries"]
+    degraded = run(1)
+    assert ds.counters()["ann_queries"] == q0   # probe shed
+    plain = run(1, df=False)
+    assert degraded == plain                    # = the hybrid prefix
+    run(0)
+    assert ds.counters()["ann_queries"] == q0 + 1   # full pipeline
+    seg.close()
+
+
+# -- tier ladder + promotion -------------------------------------------------
+
+def test_warm_clusters_promote_through_the_batcher():
+    """With a hot arena too small for every cluster, warm probes score
+    host-side, and a repeatedly-probed cluster promotes through the
+    `promote` part kind — later probes hit it on device."""
+    rng = np.random.default_rng(8)
+    n, dim, C = 4000, 64, 16
+    vecs, centers = _clustered(rng, n, dim, C)
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(np.random.default_rng(0), n))
+    idx.flush()
+    ds = DeviceSegmentStore(idx)
+    ds.enable_batching(max_batch=4, dispatchers=2, prewarm=False)
+    # budget for roughly half the corpus
+    ann = AnnVectorIndex(dim,
+                         device_budget_bytes=(n // 2) * (dim + 6))
+    ann.build(lambda a, b: vecs[a:b], n, n_clusters=C, sample_n=2048,
+              iters=2, seed=3)
+    ds.attach_ann(ann)
+    assert len(ann._hot_map) < C        # some clusters are NOT hot
+    cold_cid = max(ann._hot_map, default=-1) + 1
+    q = np.asarray(ann.centroids[cold_cid], np.float32)  # probe a warm cluster
+    for _ in range(4):
+        got = ds.dense_first_topk(q, [], [], 1.0, 10, nprobe=2)
+        assert got is not None and len(got[1])
+        time.sleep(0.1)                 # async promote may be in flight
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and ann.promotions == 0:
+        time.sleep(0.05)
+    c = ds.counters()
+    assert c["ann_tier_warm_hits"] > 0
+    assert c["ann_promotions"] >= 1, \
+        "repeated warm probes never promoted through the batcher"
+    # the promoted cluster now serves on device
+    before_hot = c["ann_tier_hot_hits"]
+    ds.dense_first_topk(q, [], [], 1.0, 10, nprobe=2)
+    assert ds.counters()["ann_tier_hot_hits"] > before_hot
+    ds.close()
+
+
+def test_probe_lane_budget_drops_whole_clusters_counted():
+    ds, ann, vecs = _served_store(n=3000, C=4)
+    ds.ann_probe_lanes = 16             # absurdly small budget
+    got = ds.dense_first_topk(vecs[0], [1000], [7], 0.5, 10)
+    assert got is not None              # still answers (sparse lanes)
+    assert ds.counters()["ann_lane_drops"] >= 1
+    assert 7 in got[1].tolist()
+    ds.close()
+
+
+# -- chaos: device loss ------------------------------------------------------
+
+def test_dense_first_answers_through_device_loss():
+    """`device.transfer_fail` chaos (ISSUE 11 satellite): with every
+    transfer failing, dense-first queries classify the loss, fall back
+    to the host oracle path and STILL answer — and the answers match
+    the host oracle exactly."""
+    ds, ann, vecs = _served_store()
+    ds.transfer_retry_limit = 0
+    ds.loss_streak = 1
+    q = vecs[50]
+    want_s, want_d = ann.search_host(q, [], [], 0.8, 10,
+                                     nprobe=ds.ann_nprobe,
+                                     lanes_budget=ds.ann_probe_lanes)
+    faultinject.set_fault("device.transfer_fail", 500)
+    got = ds.dense_first_topk(q, [], [], 0.8, 10)
+    assert got is not None, "dense-first query failed to answer"
+    np.testing.assert_array_equal(np.asarray(got[1]), want_d)
+    c = ds.counters()
+    assert c["ann_host_queries"] >= 1
+    # still answering while lost (short-circuits straight to host)
+    assert ds.device_lost or c["transfer_failures"] >= 1
+    got2 = ds.dense_first_topk(q, [], [], 0.8, 10)
+    np.testing.assert_array_equal(np.asarray(got2[1]), want_d)
+    faultinject.clear()
+    ds.close()
+
+
+def test_no_ann_index_falls_back_to_plain_rerank():
+    idx = RWIIndex()
+    idx.add_many(TH, _plist(np.random.default_rng(0), 500))
+    idx.flush()
+    ds = DeviceSegmentStore(idx)
+    assert ds.dense_first_topk(np.zeros(DN.DIM, np.float32),
+                               [1], [1], 0.5, 10) is None
+    assert ds.counters()["ann_fallbacks"] == 1
+    ds.close()

@@ -213,6 +213,70 @@ def test_bp_kernels_have_cost_models_and_numpy_oracles():
         + "\n  ".join(missing_oracle))
 
 
+# -- dense-first ANN hygiene (ISSUE 11) --------------------------------------
+# Every `_ann_*` jit kernel must carry BOTH a roofline cost model
+# registered BY NAME (EXEMPT is not acceptable for a serving kernel)
+# and a NumPy oracle in ops/ann.ANN_ORACLES — the oracle doubles as the
+# warm/cold host-scoring path and the device-loss fallback, so a kernel
+# without one has no exact-scoring parity anchor AND no survival story.
+
+def test_ann_kernels_have_cost_models_and_numpy_oracles():
+    from yacy_search_server_tpu.ops import ann as AN
+    from yacy_search_server_tpu.ops import roofline
+
+    kernels = [name for name in _named_kernels(PKG / "ops" / "ann.py")
+               if name.startswith("_ann_")]
+    assert kernels, "no _ann_* kernels found (renamed? widen scanner)"
+    missing_cost = [k for k in kernels if k not in roofline.KERNELS]
+    assert not missing_cost, (
+        "_ann_* kernels without a roofline cost model (register in "
+        "ops/roofline.KERNELS):\n  " + "\n  ".join(missing_cost))
+    missing_oracle = [k for k in kernels if k not in AN.ANN_ORACLES]
+    assert not missing_oracle, (
+        "_ann_* kernels without a NumPy oracle (register in "
+        "ops/ann.ANN_ORACLES):\n  " + "\n  ".join(missing_oracle))
+    # and nothing rots in the registry: every oracle entry names a live
+    # kernel (a renamed kernel must not leave a dead oracle behind)
+    dead = [k for k in AN.ANN_ORACLES if k not in kernels]
+    assert not dead, f"ANN_ORACLES entries without a kernel: {dead}"
+
+
+def test_ann_metric_series_resolve(tmp_path):
+    """No dead series (ISSUE 11 satellite): every yacy_ann_* series the
+    ANN counters pin — and the vector-side yacy_device_hbm_bytes tiers
+    — must resolve on a rendered /metrics exposition of a plain store
+    (zero-filled without an index), so fleet digest fields, dashboards
+    and future health rules can reference them on every node."""
+    from yacy_search_server_tpu.index.devstore import ANN_ZERO_COUNTERS
+    from yacy_search_server_tpu.server.servlets.monitoring import \
+        prometheus_text
+    from yacy_search_server_tpu.switchboard import Switchboard
+    from yacy_search_server_tpu.utils.fleet import digest_series
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        text = prometheus_text(sb, include_buckets=False)
+    finally:
+        sb.close()
+    for key in ANN_ZERO_COUNTERS:
+        if key in ("ann_vectors", "ann_clusters",
+                   "ann_centroid_version") or key.endswith("_bytes"):
+            continue    # gauges (hbm tiers / version), not counters
+        assert f'counter="{key[4:]}"' in text, \
+            f"yacy_ann_total{{counter={key[4:]}}} missing from /metrics"
+    assert "yacy_ann_centroid_version" in text
+    assert "yacy_ann_resident_vectors" in text
+    for tier in ("dense", "ann_hot", "ann_warm", "ann_cold"):
+        assert f'yacy_device_hbm_bytes{{tier="{tier}"}}' in text, \
+            f"vector-side hbm tier {tier} missing from /metrics"
+    # the fleet digest's tier shortcuts must point at series that exist
+    series = digest_series({"tiers": {}})
+    for k, v in series.items():
+        if k.startswith("tiers."):
+            name = v.split("{")[0]
+            assert name in text, f"fleet digest series {v} unresolved"
+
+
 # a --capacity artifact that omits these is not reviewable: the
 # compression claim and the paging behavior must be in the record
 CAPACITY_ROW_KEYS = (

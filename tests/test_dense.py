@@ -240,15 +240,179 @@ def test_device_block_patch_matches_full_upload(tmp_path):
     assert v2 == v1 and fwd2 is fwd1
 
 
-def test_device_block_over_budget_releases_block(tmp_path, monkeypatch):
+def test_device_block_over_budget_releases_block(tmp_path):
     import jax
     dev = jax.devices()[0]
     st = DenseVectorStore(str(tmp_path / "dense"), dim=16)
     st.put(0, np.ones(16, np.float32))
     assert st.device_block(dev) is not None
     assert st._fwd is not None
-    # the index grows past the residency budget: the block can never be
-    # served again and must not stay pinned on device
-    monkeypatch.setattr(DenseVectorStore, "DEVICE_BUDGET_BYTES", 1)
+    # the index grows past the residency budget (now the
+    # index.dense.deviceBudgetBytes knob, ISSUE 11 satellite): the
+    # block can never be served again and must not stay pinned
+    st.device_budget_bytes = 1
     assert st.device_block(dev) is None
     assert st._fwd is None and st._fwd_device is None
+
+
+def test_device_budget_knob_flows_from_config(tmp_path):
+    from yacy_search_server_tpu.switchboard import Switchboard
+    from yacy_search_server_tpu.utils.config import Config
+    cfg = Config()
+    cfg.set("index.dense.deviceBudgetBytes", str(1 << 20))
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"), config=cfg)
+    try:
+        assert sb.index.dense.device_budget_bytes == 1 << 20
+    finally:
+        sb.close()
+
+
+# -- encoder vectorization parity (ISSUE 11 satellite) -----------------------
+
+def _reference_encode(text: str, dim: int) -> np.ndarray:
+    """The pre-vectorization per-feature accumulate loop, verbatim —
+    the bit-parity anchor for the np.add.at rewrite."""
+    from zlib import crc32
+    v = np.zeros(dim, dtype=np.float32)
+    words = [w for w in text.lower().split() if w]
+    for w in words[:512]:
+        feats = [("w:" + w, 1.0)]
+        padded = f"^{w}$"
+        for i in range(len(padded) - 2):
+            feats.append(("t:" + padded[i:i + 3], 0.5))
+        for feat, weight in feats:
+            h = crc32(feat.encode("utf-8"))
+            v[(h >> 1) % dim] += (1.0 if (h & 1) else -1.0) * weight
+    n = float(np.linalg.norm(v))
+    return v / n if n > 0 else v
+
+
+MULTILINGUAL = [
+    "the quick brown fox jumps over the lazy dog",
+    "schnelle braune Füchse springen über faule Hunde im Wald",
+    "los rápidos zorros marrones saltan sobre perros perezosos",
+    "快速的棕色狐狸跳过懒狗 分布式 搜索 引擎 排名",
+    "быстрые коричневые лисы прыгают через ленивых собак",
+    "الثعلب البني السريع يقفز فوق الكلب الكسول",
+    "तेज़ भूरी लोमड़ी आलसी कुत्ते के ऊपर कूदती है",
+    "素早い茶色の狐が怠け者の犬を飛び越える 検索",
+    "", "   ", "a", "ein",
+    "repeated repeated repeated word word word",
+    "word " * 600,          # the 512-word truncation boundary
+]
+
+
+def test_vectorized_encoder_bit_parity_with_reference():
+    """The np.add.at/word-cache encoder is BIT-identical to the legacy
+    per-feature loop on a multilingual sample (same buckets, same signs,
+    same f32 accumulation order — np.add.at applies in index order)."""
+    e = HashingEncoder()
+    for t in MULTILINGUAL:
+        got = e.encode(t)
+        want = _reference_encode(t, e.dim)
+        assert np.array_equal(got, want), t[:40]
+    # and again with a warm word cache (hits must not change anything)
+    for t in MULTILINGUAL:
+        assert np.array_equal(e.encode(t), _reference_encode(t, e.dim))
+
+
+def test_encode_batch_bit_identical_to_encode():
+    e = HashingEncoder()
+    batch = e.encode_batch(MULTILINGUAL)
+    assert batch.shape == (len(MULTILINGUAL), e.dim)
+    for i, t in enumerate(MULTILINGUAL):
+        assert np.array_equal(batch[i], e.encode(t)), i
+    assert e.encode_batch([]).shape == (0, e.dim)
+
+
+def test_encoder_word_cache_bounded():
+    e = HashingEncoder()
+    e._CACHE_MAX = 8
+    e.encode_batch([f"word{i} unique{i}" for i in range(64)])
+    assert len(e._cache) <= 8 + 2       # cleared wholesale at the cap
+    # correctness never depends on a hit
+    assert np.array_equal(e.encode("word3 unique3"),
+                          _reference_encode("word3 unique3", e.dim))
+
+
+# -- dense snapshot integrity (ISSUE 11 satellite, M84 discipline) -----------
+
+def test_dense_snapshot_crc_footer_roundtrip(tmp_path):
+    d = str(tmp_path / "dense")
+    st = DenseVectorStore(d, dim=16)
+    rng = np.random.default_rng(0)
+    for i in range(5):
+        st.put(i, rng.standard_normal(16).astype(np.float32))
+    st.close()
+    st2 = DenseVectorStore(d, dim=16)
+    assert len(st2) == 5
+    np.testing.assert_array_equal(
+        st2.get_block(np.arange(5)), st.get_block(np.arange(5)))
+
+
+def test_dense_snapshot_corruption_quarantined(tmp_path):
+    """A flipped byte in the snapshot: typed detection, the file
+    quarantined, the counter bumped, the store opens EMPTY (sparse-only
+    serving) — never a crash."""
+    import os
+
+    from yacy_search_server_tpu.index import integrity
+    d = str(tmp_path / "dense")
+    st = DenseVectorStore(d, dim=16)
+    st.put(0, np.ones(16, np.float32))
+    st.close()
+    p = os.path.join(d, "vectors.npy")
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(integrity.CorruptDenseError):
+        DenseVectorStore._read_checked(p)
+    before = integrity.corruption_counts().get(("dense", "quarantined"),
+                                               0)
+    st2 = DenseVectorStore(d, dim=16)      # quarantines, never raises
+    assert len(st2) == 0
+    assert integrity.corruption_counts()[("dense", "quarantined")] \
+        == before + 1
+    assert os.path.exists(p + ".corrupt")
+    assert not os.path.exists(p)
+    # the store keeps serving (and re-persists) after quarantine
+    st2.put(0, np.ones(16, np.float32))
+    st2.close()
+    assert len(DenseVectorStore(d, dim=16)) == 1
+
+
+def test_dense_snapshot_legacy_footer_free_loads(tmp_path):
+    """A pre-footer vectors.npy (no YDV1 tail) stays readable — no
+    claim is made, nothing quarantined."""
+    import os
+    d = str(tmp_path / "dense")
+    os.makedirs(d)
+    arr = np.ones((3, 16), np.float16)
+    with open(os.path.join(d, "vectors.npy"), "wb") as f:
+        np.save(f, arr)                    # legacy writer: no footer
+    st = DenseVectorStore(d, dim=16)
+    assert len(st) == 3
+    np.testing.assert_array_equal(
+        np.asarray(st.get_block(np.arange(3)), np.float16), arr)
+
+
+def test_dense_snapshot_verify_switch_respected(tmp_path):
+    """VERIFY_ON_READ off: a corrupt-crc file still loads (the A/B
+    bench switch) — detection is read-side only, writers always stamp."""
+    import os
+
+    from yacy_search_server_tpu.index import integrity
+    d = str(tmp_path / "dense")
+    st = DenseVectorStore(d, dim=16)
+    st.put(0, np.ones(16, np.float32))
+    st.close()
+    p = os.path.join(d, "vectors.npy")
+    raw = bytearray(open(p, "rb").read())
+    raw[-2] ^= 0xFF                        # corrupt the stored crc
+    open(p, "wb").write(bytes(raw))
+    integrity.set_verify_on_read(False)
+    try:
+        assert len(DenseVectorStore(d, dim=16)) == 1
+    finally:
+        integrity.set_verify_on_read(True)
+    assert len(DenseVectorStore(d, dim=16)) == 0   # verified: quarantined

@@ -190,6 +190,40 @@ def test_xla_rerank_fwd_batch_packed(nb, bs, cap):
     _close(c.xla_bytes, by, f"rerank_fwd[{nb},{bs},{cap}] bytes")
 
 
+@pytest.mark.parametrize("bs,C", ((4, 256), (16, 1024), (16, 4096)))
+def test_xla_ann_assign(bs, C):
+    """Dense-first centroid assignment (ISSUE 11): the (B,dim)×(dim,C)
+    bf16 wave matmul."""
+    from yacy_search_server_tpu.ops import ann as AN
+    cent = jnp.zeros((C, 256), jnp.float16)
+    qv = jnp.zeros((bs, 256), jnp.float32)
+    flops, by = _xla(AN._ann_assign_batch_kernel, cent, qv, np_=8,
+                     c_real=C)
+    c = RF.cost("_ann_assign_batch_kernel", bs=bs, dim=256, C=C, np_=8)
+    _close(c.flops, flops, f"ann_assign[{bs},{C}] flops")
+    _close(c.xla_bytes, by, f"ann_assign[{bs},{C}] bytes")
+
+
+@pytest.mark.parametrize("bs,nb,cap,k", ((4, 1024, 65536, 64),
+                                         (16, 4096, 65536, 64),
+                                         (8, 16384, 1 << 20, 256)))
+def test_xla_ann_fuse(bs, nb, cap, k):
+    """Dense-first probe/fuse (ISSUE 11): bs packed descriptors
+    gathering int8 lanes from a [cap, dim] hot slab, dequant fused into
+    the scoring matmul, two-key tie sort."""
+    from yacy_search_server_tpu.ops import ann as AN
+    slab = jnp.zeros((cap, 256), jnp.int8)
+    scales = jnp.zeros(cap, jnp.float16)
+    sdocids = jnp.zeros(cap, jnp.int32)
+    qi = jnp.zeros((bs, 2 + 3 * nb + 256), jnp.int32)
+    flops, by = _xla(AN._ann_fuse_batch_packed_kernel, slab, scales,
+                     sdocids, qi, nb=nb, bs=bs, k=k)
+    c = RF.cost("_ann_fuse_batch_packed_kernel", bs=bs, nb=nb, dim=256,
+                cap=cap, k=k)
+    _close(c.flops, flops, f"ann_fuse[{bs},{nb},{cap},{k}] flops")
+    _close(c.xla_bytes, by, f"ann_fuse[{bs},{nb},{cap},{k}] bytes")
+
+
 @pytest.mark.parametrize("n,e", ((1024, 8192), (1024, 16384), (2048, 8192)))
 def test_xla_power_iterate_unit_step(n, e):
     from yacy_search_server_tpu.ops import blockrank as B

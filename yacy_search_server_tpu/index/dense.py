@@ -11,27 +11,42 @@ loses nothing irrecoverable.
 from __future__ import annotations
 
 import os
+import struct
 import threading
 
 import numpy as np
 
 from ..ops.dense import DIM, ENCODER_VERSION
+from . import integrity
+
+# crc footer on the vectors.npy snapshot (M84 discipline, ISSUE 11
+# satellite): magic + little-endian u32 crc32 over the npy payload,
+# appended AFTER the array (np.load reads exactly the header-declared
+# bytes, so footer-free legacy files and footered files both load)
+_FOOTER_MAGIC = b"YDV1"
+_FOOTER_LEN = len(_FOOTER_MAGIC) + 4
 
 
 class DenseVectorStore:
     # device-residency cap for the forward index: beyond it the rerank
     # path falls back to the host gather (a 1 GiB f16 block is ~2M docs
     # at dim 256 — past that the block belongs in the tiered-residency
-    # work of ROADMAP item 4, not in one monolithic upload)
+    # work of ROADMAP item 4, not in one monolithic upload).  The
+    # class attribute is the default; the serving knob is
+    # index.dense.deviceBudgetBytes (instance device_budget_bytes).
     DEVICE_BUDGET_BYTES = 1 << 30
     # dirty-row bookkeeping cap for the device-block patch path (see
     # device_block): a set bigger than this costs more than the full
     # re-upload it would save
     _DIRTY_CAP = 1 << 16
 
-    def __init__(self, data_dir: str | None = None, dim: int = DIM):
+    def __init__(self, data_dir: str | None = None, dim: int = DIM,
+                 device_budget_bytes: int | None = None):
         self.dim = dim
         self.data_dir = data_dir
+        self.device_budget_bytes = (self.DEVICE_BUDGET_BYTES
+                                    if device_budget_bytes is None
+                                    else int(device_budget_bytes))
         self._vecs = np.zeros((256, dim), dtype=np.float16)
         self._n = 0
         self._lock = threading.Lock()
@@ -63,8 +78,8 @@ class DenseVectorStore:
             os.makedirs(data_dir, exist_ok=True)
             p = self._path()
             if os.path.isfile(p):
-                loaded = np.load(p)
-                if loaded.shape[1] == dim:
+                loaded = self._load_verified(p)
+                if loaded is not None and loaded.shape[1] == dim:
                     self._vecs = loaded.copy()
                     self._n = loaded.shape[0]
                 # vectors hashed by an older encoder cannot be compared
@@ -75,6 +90,70 @@ class DenseVectorStore:
 
     def _path(self) -> str:
         return os.path.join(self.data_dir, "vectors.npy")
+
+    def _load_verified(self, p: str) -> np.ndarray | None:
+        """Load the vector snapshot under the M84 read-side integrity
+        discipline: a ``YDV1`` crc32 footer (written by _save_locked)
+        is verified over the npy payload; a mismatch — or a snapshot
+        torn/garbled beyond np.load — QUARANTINES the file (renamed
+        ``.corrupt``) and returns None, so dense serving degrades to
+        sparse-only boosts (zero vectors) instead of crashing the open.
+        Footer-free legacy files load as before (no claim made).
+        Counted in yacy_storage_corruption_total{kind="dense"}; the
+        typed error (integrity.CorruptDenseError) is raised and caught
+        here so callers that want the error surface can use
+        _read_checked directly."""
+        try:
+            return self._read_checked(p)
+        except (integrity.CorruptDenseError, OSError):
+            integrity.note_corruption("dense", "quarantined")
+            try:
+                os.replace(p, p + ".corrupt")
+            except OSError:
+                pass
+            return None
+
+    @staticmethod
+    def _read_checked(p: str) -> np.ndarray:
+        """np.load + footer crc verification (streamed — no staging
+        copy of the up-to-1-GiB snapshot); raises
+        integrity.CorruptDenseError on a checksum mismatch or an
+        unreadable snapshot."""
+        try:
+            arr = np.load(p, allow_pickle=False)
+        except Exception as e:
+            raise integrity.CorruptDenseError(
+                f"dense snapshot does not parse as npy: {e!r}") from e
+        try:
+            with open(p, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < _FOOTER_LEN:
+                    return arr                       # legacy: no claim
+                f.seek(size - _FOOTER_LEN)
+                tail = f.read(_FOOTER_LEN)
+                if tail[:len(_FOOTER_MAGIC)] != _FOOTER_MAGIC:
+                    return arr                       # legacy: no claim
+                if not integrity.verify_on_read():
+                    return arr
+                (want,) = struct.unpack("<I", tail[-4:])
+                f.seek(0)
+                crc = 0
+                left = size - _FOOTER_LEN
+                while left > 0:
+                    chunk = f.read(min(1 << 22, left))
+                    if not chunk:
+                        break
+                    left -= len(chunk)
+                    crc = integrity.crc32(chunk, crc)
+        except OSError as e:
+            raise integrity.CorruptDenseError(
+                f"dense snapshot unreadable: {e!r}") from e
+        if crc != want:
+            raise integrity.CorruptDenseError(
+                f"dense snapshot crc mismatch: stored {want:#x}, "
+                f"computed {crc:#x}")
+        return arr
 
     def _version_path(self) -> str:
         return os.path.join(self.data_dir, "ENCODER_VERSION")
@@ -151,7 +230,7 @@ class DenseVectorStore:
         with self._fwd_lock:
             with self._lock:
                 rows = self._rows_locked()
-                if rows * self.dim * 2 > self.DEVICE_BUDGET_BYTES:
+                if rows * self.dim * 2 > self.device_budget_bytes:
                     # release the last in-budget block: it can never be
                     # served again, and up to 1 GiB of pinned device
                     # memory would otherwise shadow the postings arena
@@ -221,8 +300,24 @@ class DenseVectorStore:
 
     def _save_locked(self) -> None:
         tmp = self._path() + ".tmp"
-        with open(tmp, "wb") as f:
+        with open(tmp, "wb+") as f:
             np.save(f, self._vecs[:max(self._n, 1)])
+            # crc32 footer over the npy payload, streamed back off the
+            # just-written file (a BytesIO staging copy would double
+            # peak RAM at the 1 GiB budget); verified at open
+            # (_load_verified). Writers always emit the footer, only
+            # read-side verification toggles (the M84 discipline).
+            f.flush()
+            f.seek(0)
+            crc = 0
+            while True:
+                chunk = f.read(1 << 22)
+                if not chunk:
+                    break
+                crc = integrity.crc32(chunk, crc)
+            f.seek(0, os.SEEK_END)
+            f.write(_FOOTER_MAGIC)
+            f.write(struct.pack("<I", crc))
         os.replace(tmp, self._path())
         # while the store is stale (migration in flight) the version
         # marker must NOT advance: a crash mid-re-encode would otherwise

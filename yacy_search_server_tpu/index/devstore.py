@@ -90,6 +90,16 @@ _DELTA_BUCKETS = (256, 1024, 4096, 16_384, 65_536, 262_144)
 
 NO_LANG = 0          # language filter sentinel (pack_language('') == 0)
 NO_FLAG = -1         # contentdom flag sentinel
+
+# zero-filled ANN counter surface for stores without an attached index
+# (the no-dead-series discipline: yacy_ann_* must resolve everywhere)
+ANN_ZERO_COUNTERS = {
+    "ann_vectors": 0, "ann_clusters": 0, "ann_centroid_version": 0,
+    "ann_hot_bytes": 0, "ann_warm_bytes": 0, "ann_cold_bytes": 0,
+    "ann_tier_hot_hits": 0, "ann_tier_warm_hits": 0,
+    "ann_tier_cold_hits": 0, "ann_promotions": 0,
+    "ann_promote_failures": 0, "ann_lane_drops": 0,
+}
 DAYS_NONE_LO = -(2 ** 30)
 DAYS_NONE_HI = 2 ** 30
 NEG_INF32 = -(2 ** 31 - 1)
@@ -1961,6 +1971,19 @@ class _QueryBatcher:
                 "taken": False}
         return self._submit_wait(item)
 
+    def submit_ann(self, qvec: np.ndarray, ss: np.ndarray,
+                   sd: np.ndarray, alpha: float, k: int, nprobe: int):
+        """Blocking batched dense-first dispatch (the `ann` part kind);
+        returns ("ok", scores, docids) | ("ineligible",) | ("timeout",).
+        The wave's centroid assignments ride ONE (B,dim)×(dim,C) bf16
+        matmul, its probes one gather/fuse dispatch per (nb, k) compile
+        group — see store._ann_prepare_wave."""
+        item = {"kind": "ann", "qvec": qvec, "ss": ss, "sd": sd,
+                "alpha": alpha, "k": k, "nprobe": nprobe,
+                "ev": threading.Event(), "res": ("ineligible",),
+                "lk": threading.Lock(), "taken": False}
+        return self._submit_wait(item)
+
     def submit_join(self, arrays, join_arrays, dead, qargs,
                     statics: tuple, profile, language: str):
         """Blocking batched conjunction; returns ("ok", scores, docids) |
@@ -2100,7 +2123,7 @@ class _QueryBatcher:
         anyway — keeping them in one batch just ran them back to back in
         one dispatcher while the rest of the pool idled."""
         plain = [it for it in batch if it.get("kind") not in
-                 ("join", "scan", "rerank", "promote")]
+                 ("join", "scan", "rerank", "promote", "ann")]
         fams: dict[tuple, list[dict]] = {}
         for it in batch:
             if it.get("kind") == "join":
@@ -2126,6 +2149,13 @@ class _QueryBatcher:
             if it.get("kind") == "rerank":
                 reranks.setdefault(it["nb"], []).append(it)
         parts.extend(reranks.values())
+        # dense-first ANN waves ride their own dispatcher: ONE batched
+        # centroid assignment + per-shape fuse dispatches per wave
+        # (_dispatch_anns); serializing them behind the pruned kernel
+        # would idle the pool like the scan/rerank cases
+        anns = [it for it in batch if it.get("kind") == "ann"]
+        if anns:
+            parts.append(anns)
         # tier promotions ride their own part: the upload must overlap
         # the query waves, never serialize behind them in one dispatcher
         promotes = [it for it in batch if it.get("kind") == "promote"]
@@ -2350,16 +2380,19 @@ class _QueryBatcher:
         joins = [it for it in batch if it.get("kind") == "join"]
         scans = [it for it in batch if it.get("kind") == "scan"]
         reranks = [it for it in batch if it.get("kind") == "rerank"]
+        anns = [it for it in batch if it.get("kind") == "ann"]
         promotes = [it for it in batch if it.get("kind") == "promote"]
         batch = [it for it in batch
                  if it.get("kind") not in ("join", "scan", "rerank",
-                                           "promote")]
+                                           "promote", "ann")]
         if joins:
             self._dispatch_joins(joins)
         if scans:
             self._dispatch_scans(scans)
         if reranks:
             self._dispatch_reranks(reranks)
+        if anns:
+            self._dispatch_anns(anns)
         if promotes:
             self._dispatch_promotes(promotes)
         if not batch:
@@ -2555,11 +2588,18 @@ class _QueryBatcher:
         for it in items:
             t0k = time.perf_counter()
             try:
-                out = store._promote_now(it["key"], it["run"])
+                if "ann_cluster" in it:
+                    # ANN cluster promotion rides the same part kind
+                    # (ISSUE 11): warm/cold vector clusters upload into
+                    # the hot arena off the query path
+                    out = store._ann_promote_now(it["ann_cluster"])
+                else:
+                    out = store._promote_now(it["key"], it["run"])
             except Exception:
                 with self._ms_lock:
                     self.exceptions += 1
-                log.exception("tier promotion failed for %r", it["key"])
+                log.exception("tier promotion failed for %r",
+                              it.get("key", it.get("ann_cluster")))
                 it["ev"].set()
                 continue
             issue_ms = (time.perf_counter() - t0k) * 1000.0
@@ -2723,6 +2763,82 @@ class _QueryBatcher:
 
                 self._submit_completion(
                     out, finish, chunk, "_rerank_fwd_batch_packed_kernel",
+                    t0k, issue_ms)
+
+    def _dispatch_anns(self, items: list[dict]) -> None:
+        """Batched dense-first waves: ONE centroid-assignment matmul
+        for the wave (store._ann_prepare_wave — its fetch is the wave's
+        first round trip), then one fused probe dispatch per (nb, kk)
+        compile group through the issue→completer pipeline. Slots whose
+        probes land entirely warm/cold (no device lanes) score host-
+        side here; warm/cold shares of kernel slots score in the
+        completer's finish, overlapping the device round trip."""
+        store = self.store
+        try:
+            groups, host_slots, promote = store._ann_prepare_wave(
+                items, self.max_batch)
+        except Exception:
+            with self._ms_lock:
+                self.exceptions += 1
+            log.exception("ann wave preparation failed (%d queries "
+                          "retry solo)", len(items))
+            for it in items:
+                with it["lk"]:
+                    if not it.get("abandoned"):
+                        it["ev"].set()   # ("ineligible",): solo retry
+            return
+        for cid in promote:
+            store._submit_ann_promote(cid)
+
+        def deliver(chunk, results, n_disp):
+            with store._lock:
+                store.ann_dispatches += n_disp
+                for it, res in zip(chunk, results):
+                    with it["lk"]:
+                        if it.get("abandoned"):
+                            continue
+                        store.ann_queries += 1
+                        it["res"] = res
+                        it["ev"].set()
+
+        from ..ops.ann import ann_topk_bucket
+        if host_slots:
+            results = [("ok",) + store._ann_finish_slot(
+                it, None, ann_topk_bucket(it["k"], 1 << 30))
+                for it in host_slots]
+            deliver(host_slots, results, 0)
+        bs = self.max_batch
+        for (nb, kk), its in groups.items():
+            for pos in range(0, len(its), bs):
+                chunk = its[pos:pos + bs]
+                t0k = time.perf_counter()
+                out = store._ann_fuse_issue(chunk, nb, kk, bs)
+                issue_ms = (time.perf_counter() - t0k) * 1000.0
+
+                def finish(host, chunk=chunk, nb=nb, kk=kk, t0k=t0k,
+                           bs=bs):
+                    wall = time.perf_counter() - t0k
+                    with self._ms_lock:
+                        self.query_kernel_ms.extend([wall * 1000.0]
+                                                    * len(chunk))
+                    for it in chunk:
+                        it["kernel_ms"] = wall * 1000.0
+                        it["kernel_name"] = \
+                            "_ann_fuse_batch_packed_kernel"
+                        it["batch_n"] = len(chunk)
+                    PROFILER.record(
+                        "_ann_fuse_batch_packed_kernel",
+                        max(wall - store.tunnel_rt_ms / 1e3, 1e-6),
+                        queries=len(chunk), bs=bs, nb=nb,
+                        dim=store._ann.dim,
+                        cap=int(store._ann._hot_cap), k=kk)
+                    results = [("ok",) + store._ann_finish_slot(
+                        it, (host[i, :kk], host[i, kk:2 * kk]), kk)
+                        for i, it in enumerate(chunk)]
+                    deliver(chunk, results, 1)
+
+                self._submit_completion(
+                    out, finish, chunk, "_ann_fuse_batch_packed_kernel",
                     t0k, issue_ms)
 
     # SORT-MERGE join batches cap at 4: the body vmaps (r5 — chained
@@ -2963,6 +3079,18 @@ class DeviceSegmentStore:
         # device-resident forward index the rerank kernels gather from
         self._dense = None
         self._rerank_batching = False   # set by enable_batching
+        # IVF ANN index (attach_ann): the dense-first candidate
+        # generator (ISSUE 11) — assignment + probe/fuse ride the
+        # batcher as the `ann` part kind; knobs from index.ann.*
+        self._ann = None
+        self._ann_batching = False      # set by enable_batching
+        from ..ops.ann import ANN_DEFAULT_NPROBE, ANN_DEFAULT_PROBE_LANES
+        self.ann_nprobe = ANN_DEFAULT_NPROBE
+        self.ann_probe_lanes = ANN_DEFAULT_PROBE_LANES
+        self.ann_dispatches = 0     # fuse-kernel dispatches
+        self.ann_queries = 0        # dense-first queries answered
+        self.ann_fallbacks = 0      # no index / error: plain rerank
+        self.ann_host_queries = 0   # answered fully host-side (loss)
         # (term, filters, snapshot ids) -> filtered normalization stats;
         # lets a repeated modifier query skip the stream scan's stats
         # pass (bounded; cleared wholesale when full — snapshot churn
@@ -3716,6 +3844,9 @@ class DeviceSegmentStore:
         switch)."""
         self._scan_batching = bool(scan_batching)
         self._rerank_batching = bool(rerank_batching)
+        # dense-first ANN dispatches batch under the same switch as the
+        # rerank family (both are the hybrid second-stage pipeline)
+        self._ann_batching = bool(rerank_batching)
         if self._batcher is None:
             self._batcher = _QueryBatcher(self, max_batch=max_batch,
                                           dispatchers=dispatchers,
@@ -3968,6 +4099,20 @@ class DeviceSegmentStore:
                        if isinstance(r, PagedRun))
         return {"hot": hot, "warm": warm, "cold": cold}
 
+    def _dense_fwd_bytes(self) -> int:
+        """Device-resident bytes of the f16 forward-index block the
+        rerank family gathers from (0 when none is uploaded) — emitted
+        as yacy_device_hbm_bytes{tier="dense"} so fleet digests and
+        DeviceStore_p account every resident byte (ISSUE 11
+        satellite)."""
+        dense = self._dense
+        if dense is None:
+            return 0
+        with dense._lock:
+            fwd = dense._fwd
+            return int(fwd.shape[0] * fwd.shape[1] * 2) \
+                if fwd is not None else 0
+
     def packed_compression_ratio(self) -> float:
         """Measured compression of the DEVICE-resident (hot) packed
         blocks: int16 block bytes the same rows would occupy / packed
@@ -4060,6 +4205,22 @@ class DeviceSegmentStore:
             "rerank_queries": self.rerank_queries,
             "rerank_cache_hits": self.rerank_cache_hits,
             "rerank_fallbacks": self.rerank_fallbacks,
+            # dense-first IVF ANN (ISSUE 11): candidate-generation
+            # coverage (queries/dispatches = coalescing factor like the
+            # rerank pair), host-path answers during device loss, and
+            # the vector tier ladder's traffic + residency — zeros
+            # without an attached index so every series always resolves
+            "ann_dispatches": self.ann_dispatches,
+            "ann_queries": self.ann_queries,
+            "ann_fallbacks": self.ann_fallbacks,
+            "ann_host_queries": self.ann_host_queries,
+            **(self._ann.counters() if self._ann is not None
+               else ANN_ZERO_COUNTERS),
+            # device-resident dense bytes: the f16 forward-index block
+            # (rerank gathers) — with the ANN tiers above, every
+            # vector-side resident byte is accounted in
+            # yacy_device_hbm_bytes
+            "dense_fwd_bytes": self._dense_fwd_bytes(),
             # compressed residency + tier ladder (ISSUE 8): per-tier
             # hit/promotion/eviction counters and byte occupancy — the
             # paging behavior must be attributable in every artifact
@@ -4695,35 +4856,50 @@ class DeviceSegmentStore:
         return dense.version if dense is not None else -1
 
     def _hybrid_cache_key(self, termhash: bytes, profile, language: str,
-                          k: int, alpha, dv: int | None = None) -> tuple:
+                          k: int, alpha, dv: int | None = None,
+                          dense_first: bool = False,
+                          cv: int | None = None) -> tuple:
         """Hybrid entries extend the sparse cache key with the blend
         alpha, the ENCODER version and the vector-content version: an
         encoder swap or any vector write re-keys every hybrid entry
         (the arena epoch the entry carries only covers postings
         mutations). Keyed on the EXACT k, not the kk bucket — the
         rerank input is the sparse stage's [:k] trim, so entries from
-        different k are different answers."""
+        different k are different answers.  Dense-first entries
+        (ISSUE 11) additionally carry the ANN centroid-set version: a
+        centroid rebuild changes the candidate set, so it must re-key
+        every dense-first answer — and a dense-first entry can never
+        alias a plain hybrid one (different candidate streams)."""
         from ..ops.dense import ENCODER_VERSION
         if dv is None:
             dv = self.hybrid_vector_version()
-        return (termhash, profile.to_external_string(), language, k,
+        base = (termhash, profile.to_external_string(), language, k,
                 "hybrid", round(float(alpha), 6), ENCODER_VERSION, dv)
+        if not dense_first:
+            return base
+        if cv is None:
+            cv = self.ann_centroid_version()
+        return base + ("df", cv)
 
     def hybrid_cache_get(self, termhash: bytes, profile,
                          language: str = "en", k: int = 100,
-                         alpha: float = 0.5):
+                         alpha: float = 0.5,
+                         dense_first: bool = False):
         """Versioned top-k cache lookup for a FULL hybrid answer
-        (sparse rank + dense rerank) — ZERO device work on a hit,
-        bit-identical to the cold two-stage path. Same freshness gates
-        as rank_cache_get: live arena epoch, no unflushed RAM delta;
-        encoder/vector changes invalidate through the key itself."""
+        (sparse rank + dense rerank — or the fused dense-first list
+        when `dense_first`) — ZERO device work on a hit, bit-identical
+        to the cold two-stage path. Same freshness gates as
+        rank_cache_get: live arena epoch, no unflushed RAM delta;
+        encoder/vector/centroid changes invalidate through the key
+        itself."""
         with self.rwi._lock:
             if self.rwi._ram.get(termhash):
                 return None
         with self._lock:
             epoch = self.arena_epoch
         got = self._topk_cache.get(
-            self._hybrid_cache_key(termhash, profile, language, k, alpha),
+            self._hybrid_cache_key(termhash, profile, language, k, alpha,
+                                   dense_first=dense_first),
             epoch)
         if got is None:
             return None
@@ -4735,7 +4911,9 @@ class DeviceSegmentStore:
 
     def hybrid_cache_put(self, termhash: bytes, profile, language: str,
                          k: int, alpha: float, epoch0: int, s, d,
-                         considered: int, dv0: int | None = None) -> None:
+                         considered: int, dv0: int | None = None,
+                         dense_first: bool = False,
+                         cv0: int | None = None) -> None:
         """Insert a computed hybrid answer under the epoch captured
         BEFORE its sparse stage ran: any postings mutation since leaves
         the entry born-stale (recomputed next lookup), never served.
@@ -4747,11 +4925,255 @@ class DeviceSegmentStore:
         serve it as fresh. Under the snapshot key a raced entry is
         simply unreachable (lookups key on the live version, which has
         moved past it). None keys on the live version — only for
-        callers that know no write can race (tests)."""
+        callers that know no write can race (tests). cv0 is the ANN
+        centroid-set version snapshotted the same way for dense-first
+        answers (a rebuild racing the probe leaves the entry
+        unreachable)."""
         self._topk_cache.put(
             self._hybrid_cache_key(termhash, profile, language, k, alpha,
-                                   dv=dv0),
+                                   dv=dv0, dense_first=dense_first,
+                                   cv=cv0),
             epoch0, np.asarray(s), np.asarray(d), considered)
+
+    # -- dense-first IVF ANN candidate generation (ISSUE 11) -----------------
+
+    def attach_ann(self, ann) -> None:
+        """Wire the segment's AnnVectorIndex: dense-first queries probe
+        its device-resident hot slab; its centroid version keys the
+        dense-first top-k cache."""
+        self._ann = ann
+
+    def ann_centroid_version(self) -> int:
+        """The attached ANN index's centroid-set version (-1 without
+        one) — snapshotted with the arena epoch and vector version
+        before a dense-first answer is computed, so a centroid rebuild
+        racing the query leaves the cached entry unreachable."""
+        ann = self._ann
+        return ann.centroid_version if ann is not None else -1
+
+    def dense_first_topk(self, qvec, sparse_scores, docids, alpha,
+                         k: int, nprobe: int | None = None):
+        """The fused dense-first answer for one query: IVF probe
+        candidates ∪ sparse candidates, scored in ONE cardinal domain
+        (sparse + fixed-scale dense boost) and ordered by the pinned
+        (score DESC, docid ASC) tie discipline.
+
+        Routed through the _QueryBatcher (`ann` part kind) when
+        batching is on — a wave's centroid assignments ride ONE
+        (B,dim)×(dim,C) bf16 matmul and its probes one gather/fuse
+        dispatch per lane bucket; otherwise (or on timeout) the SAME
+        kernels dispatch solo at the shared compile shape, so batched
+        and solo answers are bit-identical. Warm/cold clusters score
+        host-side with the NumPy oracle (same quantized math) and merge
+        under the same discipline; device loss degrades to the full
+        host path — a dense-first query ALWAYS answers. Returns None
+        only when no built ANN index is attached (callers keep the
+        plain rerank path)."""
+        ann = self._ann
+        if ann is None or not ann.built:
+            with self._lock:
+                self.ann_fallbacks += 1
+            return None
+        nprobe = nprobe or self.ann_nprobe
+        sd = np.asarray(docids, np.int32)
+        ss = np.asarray(sparse_scores, np.int32)
+        qv = np.asarray(qvec, np.float32)
+        if self.device_lost:
+            with self._lock:
+                self.ann_host_queries += 1
+                self.ann_queries += 1
+            return ann.search_host(qv, sd, ss, float(alpha), k, nprobe,
+                                   self.ann_probe_lanes)
+        try:
+            if (self._ann_batching and self._batcher is not None
+                    and threading.current_thread()
+                    not in self._batcher._threads):
+                res = self._batcher.submit_ann(qv, ss, sd, float(alpha),
+                                               k, nprobe)
+                if res[0] == "ok":
+                    return res[1], res[2]
+                # "timeout"/"ineligible": solo below, same compile shape
+            return self._ann_solo(qv, ss, sd, float(alpha), k, nprobe)
+        except DeviceTransferError:
+            # the loss classifier already counted the failed transfer;
+            # the query still answers, host-side
+            with self._lock:
+                self.ann_host_queries += 1
+                self.ann_queries += 1
+            return ann.search_host(qv, sd, ss, float(alpha), k, nprobe,
+                                   self.ann_probe_lanes)
+
+    def _ann_prepare_wave(self, slots: list[dict], bs: int):
+        """Centroid assignment + probe planning for one wave of
+        dense-first slots: ONE bf16 matmul per distinct nprobe (its
+        fetch is the wave's first round trip), then per-slot lane plans
+        against the hot/warm/cold ladder. Returns (kernel_groups,
+        host_slots, promote_cids): kernel groups keyed by the (nb, kk)
+        compile shape with packed descriptors ready to dispatch;
+        host_slots have no device lanes at all (everything warm/cold).
+        Raises DeviceTransferError upward — callers own the fallback."""
+        from ..ops.ann import (_ann_assign_batch_kernel, ann_lane_bucket,
+                               ann_topk_bucket, pack_ann_fuse_row)
+        ann = self._ann
+        device = self.arena.device
+        cent = ann.centroid_block(device)
+        # ONE hot-arena snapshot serves the whole wave: descriptors'
+        # hot rows and the fuse gathers must reference the SAME arrays
+        # (a promotion patching the arena mid-wave would otherwise mix
+        # generations inside one kernel call); hot_limit bounds the
+        # plans to the rows this snapshot actually covers
+        got_hot = ann.hot_block(device)
+        hb, hot_limit = got_hot if got_hot is not None else (None, 0)
+        dim = ann.dim
+        promote: list[int] = []
+        by_np: dict[int, list[dict]] = {}
+        for it in slots:
+            by_np.setdefault(int(it["nprobe"]), []).append(it)
+        n_clusters = ann.n_clusters()
+        for nprobe, its in by_np.items():
+            qv = np.zeros((bs, dim), np.float32)
+            for i, it in enumerate(its):
+                qv[i] = it["qvec"]
+            np_ = min(nprobe, n_clusters)
+            t0 = time.perf_counter()
+            out = _ann_assign_batch_kernel(
+                cent, jax.device_put(qv, device), np_=np_,
+                c_real=n_clusters)
+            ids = self.device_fetch(out)
+            self.count_round_trip()
+            PROFILER.record(
+                "_ann_assign_batch_kernel",
+                max(time.perf_counter() - t0 - self.tunnel_rt_ms / 1e3,
+                    1e-6),
+                queries=len(its), bs=bs, dim=dim,
+                C=int(cent.shape[0]), np_=np_)
+            for i, it in enumerate(its):
+                it["cids"] = ids[i]
+        kernel_groups: dict[tuple, list[dict]] = {}
+        host_slots: list[dict] = []
+        for it in slots:
+            plan = ann.plan(it["cids"], it["sd"], it["ss"],
+                            self.ann_probe_lanes,
+                            hot_limit=hot_limit)
+            promote.extend(plan["promote"])
+            it["plan"] = plan
+            hot_rows = plan["hot_rows"]
+            spr, spd, sps = plan["sp_hot"]
+            lanes = len(hot_rows) + len(spr)
+            if lanes == 0:
+                host_slots.append(it)
+                continue
+            # sparse candidates ride FIRST (they must never be cut) and
+            # nb covers the ACTUAL lane count — the probe share is
+            # already budget-bounded by plan(), so the bucket stays
+            # bounded without a truncating cap
+            rows = np.concatenate([spr, hot_rows])
+            dd = np.concatenate(
+                [spd, np.full(len(hot_rows), -1, np.int32)])
+            sp = np.concatenate(
+                [sps, np.zeros(len(hot_rows), np.int32)])
+            nb = ann_lane_bucket(lanes, lanes)
+            kk = ann_topk_bucket(it["k"], nb)
+            it["qrow"] = pack_ann_fuse_row(it["qvec"], rows, dd, sp,
+                                           it["alpha"], nb)
+            it["hb"] = hb
+            kernel_groups.setdefault((nb, kk), []).append(it)
+        return kernel_groups, host_slots, promote
+
+    def _ann_fuse_issue(self, its: list[dict], nb: int, kk: int,
+                        bs: int):
+        """ISSUE one fuse dispatch for a (nb, kk) compile group (async;
+        the completer/solo caller fetches) against the hot-arena
+        snapshot the wave's descriptors were planned on."""
+        from ..ops.ann import _ann_fuse_batch_packed_kernel
+        hb = its[0]["hb"]
+        rowlen = len(its[0]["qrow"])
+        qi = np.zeros((bs, rowlen), np.int32)
+        for i, it in enumerate(its):
+            qi[i] = it["qrow"]
+        return _ann_fuse_batch_packed_kernel(
+            hb[0], hb[1], hb[2],
+            jax.device_put(qi, self.arena.device), nb=nb, bs=bs, k=kk)
+
+    def _ann_finish_slot(self, it: dict, dev_part, kk: int):
+        """Merge one slot's device lanes (already fused+ordered by the
+        kernel; pad entries carry docid INT32_MAX) with its host-scored
+        warm/cold parts under the pinned tie discipline, dedup
+        best-first (a docid reachable both as probe lane and sparse
+        lane keeps its sparse+boost entry), trim to k."""
+        from ..ops.ann import merge_fused
+        ann = self._ann
+        parts = []
+        if dev_part is not None:
+            s, d = dev_part
+            ok = d != 2 ** 31 - 1
+            parts.append((np.asarray(s)[ok].astype(np.int64),
+                          np.asarray(d)[ok]))
+        parts.extend(ann.host_score_parts(it["plan"], it["qvec"],
+                                          it["alpha"], kk))
+        return merge_fused(parts, it["k"])
+
+    def _ann_solo(self, qvec, ss, sd, alpha, k: int, nprobe: int):
+        """One dense-first query outside a batch: the SAME kernels at
+        the shared compile shape (bs=max_batch, pad slots), so solo and
+        batched answers are bit-identical."""
+        bs = self._batcher.max_batch if self._batcher is not None else 1
+        slot = {"qvec": qvec, "ss": ss, "sd": sd, "alpha": alpha,
+                "k": k, "nprobe": nprobe}
+        groups, host_slots, promote = self._ann_prepare_wave([slot], bs)
+        for cid in promote:
+            self._submit_ann_promote(cid)
+        if groups:
+            ((nb, kk), its), = groups.items()
+            t0 = time.perf_counter()
+            out = self._ann_fuse_issue(its, nb, kk, bs)
+            t1 = time.perf_counter()
+            host = self.device_fetch(out)
+            self.count_round_trip()
+            _emit_rt_spans((t1 - t0) * 1e3,
+                           (time.perf_counter() - t1) * 1e3)
+            PROFILER.record(
+                "_ann_fuse_batch_packed_kernel",
+                max(time.perf_counter() - t0 - self.tunnel_rt_ms / 1e3,
+                    1e-6),
+                queries=1, bs=bs, nb=nb, dim=self._ann.dim,
+                cap=int(self._ann._hot_cap), k=kk)
+            res = self._ann_finish_slot(slot, (host[0, :kk],
+                                               host[0, kk:2 * kk]), kk)
+            with self._lock:
+                self.ann_dispatches += 1
+                self.ann_queries += 1
+            return res
+        from ..ops.ann import ann_topk_bucket
+        res = self._ann_finish_slot(slot, None,
+                                    ann_topk_bucket(k, 1 << 30))
+        with self._lock:
+            self.ann_queries += 1
+        return res
+
+    def _submit_ann_promote(self, cid: int) -> None:
+        """Queue one ANN cluster promotion on the batcher's existing
+        `promote` part kind (async, off the query path); without a
+        batcher it runs inline."""
+        b = self._batcher
+        if b is not None and not b._stop:
+            item = {"kind": "promote", "ann_cluster": cid,
+                    "ev": threading.Event(), "res": ("ineligible",),
+                    "lk": threading.Lock(), "taken": False}
+            with self._lock:
+                self.tier_promote_async += 1
+            b._q.put(item)
+        else:
+            self._ann_promote_now(cid)
+
+    def _ann_promote_now(self, cid: int):
+        """Upload one warm/cold ANN cluster into the hot arena (the
+        `promote` dispatch branch for ann_cluster items). Returns the
+        annstore's confirmation token (fetchable) or None."""
+        ann = self._ann
+        if ann is None:
+            return None
+        return ann.promote_cluster(cid, self.arena.device)
 
     # -- bit-packed (compressed-residency) serving ---------------------------
 

@@ -71,6 +71,13 @@ class CorruptSegmentError(CorruptionError):
     """A colstore segment failed open-scrub or a column checksum."""
 
 
+class CorruptDenseError(CorruptionError):
+    """The dense vector snapshot (vectors.npy) failed its crc32 footer
+    or does not parse — quarantine material (dense serving degrades to
+    sparse-only boosts; embeddings are re-encodable from text_t, so
+    nothing irrecoverable is lost)."""
+
+
 class CorruptJournalError(CorruptionError, ValueError):
     """A journal record failed its line checksum / decode mid-file (a
     torn FINAL line is recovered and counted, never raised).  Also a
@@ -134,6 +141,8 @@ CANONICAL_EVENTS = (
     #                                  served anyway (no redundant
     #                                  generation exists), loudly counted
     ("journal", "error"),        # mid-file journal record checksum mismatch
+    ("dense", "quarantined"),    # dense vector snapshot crc mismatch:
+    #                              file quarantined, sparse-only serving
 )
 JOURNAL_STORES = ("metadata", "webgraph", "rwi", "frontier", "errors")
 

@@ -49,6 +49,7 @@ CATCHALL_WORD = "yacyall"
 class Segment:
     def __init__(self, data_dir: str | None = None,
                  max_ram_postings: int | None = None):
+        self.data_dir = data_dir
         rwi_dir = f"{data_dir}/rwi" if data_dir else None
         meta_dir = f"{data_dir}/meta" if data_dir else None
         kwargs = {}
@@ -85,6 +86,10 @@ class Segment:
         # device-resident serving (index/devstore.py): opt-in via
         # enable_device_serving; Switchboard turns it on by default
         self.devstore = None
+        # dense-first IVF ANN index (index/annstore.py, ISSUE 11):
+        # built on demand via build_ann_index — embeddings are
+        # derivable data, so the index rebuilds rather than persists
+        self.ann = None
         self._lock = threading.RLock()
 
     def enable_device_serving(self, budget_bytes: int = 2 << 30,
@@ -108,6 +113,36 @@ class Segment:
             # index of this segment's doc vectors (batched second stage)
             self.devstore.attach_dense(self.dense)
         return self.devstore
+
+    def build_ann_index(self, n_clusters: int | None = None,
+                        device_budget_bytes: int = 1 << 30,
+                        warm_budget_bytes: int = 1 << 28,
+                        **kw):
+        """(Re)build the dense-first IVF ANN index over this segment's
+        doc embeddings and attach it to the serving store (ISSUE 11).
+        Rebuilding bumps the centroid-set version, which invalidates
+        every cached dense-first answer through the hybrid cache key.
+        Embeddings written AFTER the build have no slab row until the
+        next rebuild (they still rank sparse + rerank; the dense-first
+        stream just cannot generate them as candidates yet)."""
+        from .annstore import AnnVectorIndex
+        if len(self.dense) == 0:
+            raise ValueError(
+                "no dense vectors to index — store documents (or "
+                "dense.put vectors) before build_ann_index")
+        if self.ann is None:
+            self.ann = AnnVectorIndex(
+                self.encoder.dim,
+                data_dir=f"{self.data_dir}/ann" if self.data_dir
+                else None,
+                device_budget_bytes=device_budget_bytes,
+                warm_budget_bytes=warm_budget_bytes)
+        self.ann.build_from_dense(self.dense, n_clusters=n_clusters,
+                                  **kw)
+        if self.devstore is not None \
+                and hasattr(self.devstore, "attach_ann"):
+            self.devstore.attach_ann(self.ann)
+        return self.ann
 
     def enable_mesh_serving(self, devices=None, n_term: int = 1,
                             budget_bytes: int = 2 << 30):

@@ -43,10 +43,25 @@ class HashingEncoder:
     """Signed feature-hashing of word + char-trigram features into `dim`
     buckets, L2-normalized — deterministic across processes/peers (doc
     vectors computed at index time on one node must match query vectors
-    computed on another)."""
+    computed on another).
+
+    Vectorized (ISSUE 11 satellite): the per-feature python accumulate
+    loop is now ONE ``np.add.at`` scatter per text — and one per BATCH
+    in ``encode_batch`` — with a bounded (feature -> bucket, sign)
+    cache in front of the crc32, since a corpus's word/trigram
+    vocabulary repeats massively across documents.  Bit-deterministic
+    with the loop it replaces: ``np.add.at`` is unbuffered and applies
+    updates in index order, which IS the old accumulation order, and
+    ``_stable_hash`` still decides every bucket/sign."""
+
+    # bounded word cache: a corpus's vocabulary repeats massively, but
+    # a crawl's long tail must not grow an unbounded dict (cleared
+    # wholesale at the cap — correctness never depends on a hit)
+    _CACHE_MAX = 1 << 18
 
     def __init__(self, dim: int = DIM):
         self.dim = dim
+        self._cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
 
     def _features(self, text: str):
         words = [w for w in text.lower().split() if w]
@@ -56,20 +71,77 @@ class HashingEncoder:
             for i in range(len(padded) - 2):
                 yield "t:" + padded[i:i + 3], 0.5
 
+    def _word_arrays(self, w: str):
+        """One word's (buckets, signed weights) — the word feature then
+        its char trigrams, exactly the _features order — cached: the
+        crc32 + modulo per trigram runs once per distinct word, not
+        once per occurrence."""
+        got = self._cache.get(w)
+        if got is not None:
+            return got
+        feats = ["w:" + w]
+        wts = [1.0]
+        padded = f"^{w}$"
+        for i in range(len(padded) - 2):
+            feats.append("t:" + padded[i:i + 3])
+            wts.append(0.5)
+        dim = self.dim
+        bs = np.empty(len(feats), dtype=np.int64)
+        sg = np.empty(len(feats), dtype=np.float32)
+        for j, f in enumerate(feats):
+            h = _stable_hash(f)
+            bs[j] = (h >> 1) % dim
+            sg[j] = (1.0 if (h & 1) else -1.0) * wts[j]
+        if len(self._cache) > self._CACHE_MAX:
+            self._cache.clear()
+        got = (bs, sg)
+        self._cache[w] = got
+        return got
+
+    def _feature_arrays(self, text: str):
+        """(buckets, signed weights) for one text, in feature order —
+        the scatter input whose in-order application matches the legacy
+        accumulate loop bit for bit."""
+        words = [w for w in text.lower().split() if w][:512]
+        if not words:
+            return (np.empty(0, dtype=np.int64),
+                    np.empty(0, dtype=np.float32))
+        parts = [self._word_arrays(w) for w in words]
+        return (np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]))
+
     def encode(self, text: str) -> np.ndarray:
         v = np.zeros(self.dim, dtype=np.float32)
-        for feat, weight in self._features(text):
-            h = _stable_hash(feat)
-            bucket = (h >> 1) % self.dim
-            sign = 1.0 if (h & 1) else -1.0
-            v[bucket] += sign * weight
+        b, w = self._feature_arrays(text)
+        if len(b):
+            np.add.at(v, b, w)
         n = float(np.linalg.norm(v))
         return v / n if n > 0 else v
 
     def encode_batch(self, texts: list[str]) -> np.ndarray:
+        """Batched encode: ONE 2-d np.add.at scatter for the whole
+        batch (the flattened per-text feature runs keep each row's
+        update order, so every row is bit-identical to encode())."""
         if not texts:
             return np.zeros((0, self.dim), dtype=np.float32)
-        return np.stack([self.encode(t) for t in texts])
+        v = np.zeros((len(texts), self.dim), dtype=np.float32)
+        rows: list[np.ndarray] = []
+        cols: list[np.ndarray] = []
+        wts: list[np.ndarray] = []
+        for i, t in enumerate(texts):
+            b, w = self._feature_arrays(t)
+            if len(b):
+                rows.append(np.full(len(b), i, dtype=np.int64))
+                cols.append(b)
+                wts.append(w)
+        if rows:
+            np.add.at(v, (np.concatenate(rows), np.concatenate(cols)),
+                      np.concatenate(wts))
+        for i in range(len(texts)):
+            n = float(np.linalg.norm(v[i]))
+            if n > 0:
+                v[i] /= n
+        return v
 
 
 # -- fused rerank kernel -----------------------------------------------------

@@ -430,6 +430,43 @@ def _c_rank_scan_batch_bp(rows: int, k: int = 16, bs: int = 1,
                 + _SCAN_BP_XBYTES_PW * pw_cap + 2 * doc_cap)
 
 
+# dense-first IVF ANN family (ops/ann.py, ISSUE 11).  Assignment is
+# the (B,dim)×(dim,C) bf16 matmul (+ per-element top-k overhead XLA
+# counts as 2·dim·(C+bs)); fuse is per-lane work (int8 gather + dequant
+# matmul + fused boost + two-key sort — the per-lane constants fit jax
+# 0.4.x CPU to <0.5% at dim 256 over bs in {4..16} × nb in {1k..16k} ×
+# cap in {2^16, 2^20}; pinned by tests/test_roofline.py) plus the slab
+# operands (cap·(dim+6): int8 rows + f16 scale + int32 docid — the
+# quantized residency IS the byte win, arxiv 1406.3170 applied to
+# vectors).
+_ANN_FUSE_FLOPS_LANE = 1078.0
+_ANN_FUSE_XBYTES_LANE = 2120.0
+
+
+def _c_ann_assign(bs: int, dim: int = 256, C: int = 1024,
+                  np_: int = 8) -> Cost:
+    """Centroid assignment: ONE (B,dim)×(dim,C) bf16 matmul per wave."""
+    return Cost(flops=2.0 * dim * (bs * C + C + bs),
+                bytes=2 * C * dim + 4 * bs * dim + 4 * bs * np_,
+                xla_bytes=10.0 * C * dim + 4.0 * bs * C
+                + 12.0 * bs * dim)
+
+
+def _c_ann_fuse(bs: int, nb: int, dim: int = 256, cap: int = 0,
+                k: int = 16) -> Cost:
+    """IVF probe + dense/sparse fusion: batched int8 gathers over the
+    hot slab with dequant fused into the scoring matmul. Compulsory
+    bytes = the gathered quantized lanes + packed descriptors + fused
+    top-k out; the XLA model charges the whole slab operand set per
+    dispatch (gather semantics in HloCostAnalysis)."""
+    lanes = bs * nb
+    desc = 4.0 * (2 + 3 * nb + dim) * bs
+    return Cost(flops=_ANN_FUSE_FLOPS_LANE * lanes,
+                bytes=(dim + 6.0) * lanes + desc + 8.0 * bs * k,
+                xla_bytes=_ANN_FUSE_XBYTES_LANE * lanes
+                + (dim + 6.0) * cap)
+
+
 def _c_power_iterate(n: int, edges: int, iters: int = 1) -> Cost:
     """BlockRank power iteration (ops/blockrank._power_iterate_sparse):
     per-iteration segment-sum over the edge list, × the trip count (the
@@ -477,6 +514,11 @@ KERNELS: dict[str, object] = {
     # roofline-visible win
     "_rank_pruned_batch1_bp_kernel": _c_rank_pruned_batch1_bp,
     "_rank_scan_batch_bp_kernel": _c_rank_scan_batch_bp,
+    # dense-first IVF ANN family (ISSUE 11): assignment matmul + the
+    # probe/fuse gather kernel — the hygiene gate additionally demands
+    # a NumPy oracle in ops/ann.ANN_ORACLES for every _ann_* kernel
+    "_ann_assign_batch_kernel": _c_ann_assign,
+    "_ann_fuse_batch_packed_kernel": _c_ann_fuse,
 }
 
 # jit-compiled functions that are NOT serving kernels: maintenance

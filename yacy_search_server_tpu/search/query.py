@@ -270,6 +270,10 @@ class QueryParams:
     # (ops/dense.py; new capability beyond the reference)
     hybrid: bool = False
     hybrid_alpha: float = 0.5
+    # dense-first retrieval (ISSUE 11): the IVF ANN index generates a
+    # dense candidate stream fused with the sparse one — implies
+    # hybrid; sheds one ladder rung before the rerank
+    dense_first: bool = False
     # optional result URL veto (ContentControl filter; reference consults
     # it in the SearchEvent drain) — callable(url) -> True when blocked
     url_filter: object = None
@@ -307,7 +311,8 @@ class QueryParams:
             ",".join(sorted(self.goal.phrases)),
             self.modifier.to_string(), str(self.contentdom), self.lang,
             self.profile.to_external_string() if self.profile else "",
-            f"h{int(self.hybrid)}a{self.hybrid_alpha}" if self.hybrid else "",
+            (f"h{int(self.hybrid)}a{self.hybrid_alpha}"
+             + ("df" if self.dense_first else "")) if self.hybrid else "",
             "cc" if self.url_filter is not None else "",
             f"d{self.degrade_level}" if self.degrade_level else "",
         ))

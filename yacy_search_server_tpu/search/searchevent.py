@@ -218,22 +218,9 @@ class SearchEvent:
             if len(docids) == 0:
                 return
             if q.hybrid and not self._rerank_done:
-                # ladder rung 2: skip the dense rerank stage — the
-                # sparse stage's pinned (score DESC, docid ASC) order
-                # serves as-is, bit-identical to the first-stage output
-                if self.degrade_level >= 2:
-                    self._note_degraded("RERANK", len(docids))
-                else:
-                    with StageTimer(EClass.SEARCH, "DENSERERANK",
-                                    len(docids)):
-                        scores, docids = self._dense_rerank(scores,
-                                                            docids)
-                    if self._hybrid_put is not None:
-                        ds, th, epoch0, dv0 = self._hybrid_put
-                        ds.hybrid_cache_put(
-                            th, q.profile, q.lang, k_need, q.hybrid_alpha,
-                            epoch0, scores, docids,
-                            self.local_rwi_considered, dv0=dv0)
+                scores, docids = self._second_stage(scores, docids,
+                                                    k_need,
+                                                    allow_put=True)
             self._fill_results(scores, docids)
             return
 
@@ -270,12 +257,11 @@ class SearchEvent:
                 scores, docids = self._ranker.rank(cand, hosthashes, k=k)
 
         if q.hybrid and len(docids) and not q.modifier.date_sort:
-            if self.degrade_level >= 2:
-                self._note_degraded("RERANK", len(docids))
-            else:
-                with StageTimer(EClass.SEARCH, "DENSERERANK",
-                                len(docids)):
-                    scores, docids = self._dense_rerank(scores, docids)
+            # host-computed answers never enter the hybrid cache: they
+            # are not bit-identical to device-path answers, and a
+            # cached one would flap the versioned top-k contract
+            scores, docids = self._second_stage(scores, docids, k_need,
+                                                allow_put=False)
 
         self._fill_results(scores, docids)
 
@@ -396,9 +382,15 @@ class SearchEvent:
             if q.hybrid and self.degrade_level < 2:
                 hpeek = getattr(ds, "hybrid_cache_get", None)
                 if hpeek is not None:
+                    # dense-first answers live under their own key
+                    # (candidate stream differs); a dense-first query
+                    # that will SHED its probe (rung 1) serves the
+                    # plain-hybrid key its computed answer will match
+                    df = bool(getattr(q, "dense_first", False)) \
+                        and self.degrade_level < 1
                     q0 = time.perf_counter()
                     got = hpeek(inc[0], q.profile, q.lang, k,
-                                q.hybrid_alpha)
+                                q.hybrid_alpha, dense_first=df)
                     if got is not None:
                         wall_ms = (time.perf_counter() - q0) * 1000.0
                         track(EClass.SEARCH, "DEVRANK", len(got[1]),
@@ -410,9 +402,11 @@ class SearchEvent:
                     # the vector-content version is snapshotted HERE,
                     # with the epoch: a vector write racing the rerank
                     # below must leave the entry unreachable, not filed
-                    # under the post-write key as if fresh
+                    # under the post-write key as if fresh (the ANN
+                    # centroid version likewise, for dense-first)
                     self._hybrid_put = (ds, inc[0], ds.arena_epoch,
-                                        ds.hybrid_vector_version())
+                                        ds.hybrid_vector_version(),
+                                        ds.ann_centroid_version())
             # the sparse peek still serves hybrid queries' FIRST stage
             # (a hybrid-cache miss can ride a sparse hit into rerank)
             peek = getattr(ds, "rank_cache_get", None)
@@ -516,6 +510,82 @@ class SearchEvent:
             return allowed if allowed is not None else np.empty(0, np.int64)
 
         return ds.filter_bitmap(key, docids_fn)
+
+    def _second_stage(self, scores, docids, k_need: int,
+                      allow_put: bool):
+        """The hybrid second stage behind the degradation ladder
+        (ISSUE 11): dense-first candidate generation + fusion (sheds at
+        rung 1 — ONE rung before the rerank, utils/actuator
+        .LEVEL_NO_DENSE_FIRST), the dense rerank (sheds at rung 2), or
+        the sparse order as-is. Every rung's output keeps the pinned
+        (score DESC, docid ASC) tie discipline, so degraded answers are
+        bit-identical to the corresponding non-degraded stage prefix.
+        With `allow_put`, files the computed answer in the hybrid top-k
+        cache under the context _device_local snapshotted (device-path
+        answers only — host-computed orders are not bit-identical)."""
+        q = self.query
+        if self.degrade_level >= 2:
+            # ladder rung 2: skip the whole dense stage — the sparse
+            # stage's pinned order serves as-is
+            self._note_degraded("RERANK", len(docids))
+            return scores, docids
+        df_served = False
+        if q.dense_first:
+            if self.degrade_level >= 1:
+                # dense-first sheds one rung BEFORE the rerank: the
+                # candidate-generation probe is the more expensive
+                # stage, and shedding it still leaves a full hybrid
+                # (sparse + rerank) answer
+                self._note_degraded("DENSEFIRST", len(docids))
+            else:
+                with StageTimer(EClass.SEARCH, "DENSEFIRST",
+                                len(docids)):
+                    got = self._dense_first(scores, docids, k_need)
+                if got is not None:
+                    scores, docids = got
+                    df_served = True
+                # None: no ANN index — the plain rerank below serves
+                # (counted ann_fallbacks by the store)
+        if not df_served:
+            with StageTimer(EClass.SEARCH, "DENSERERANK", len(docids)):
+                scores, docids = self._dense_rerank(scores, docids)
+        if allow_put and self._hybrid_put is not None:
+            ds, th, epoch0, dv0, cv0 = self._hybrid_put
+            ds.hybrid_cache_put(
+                th, q.profile, q.lang, k_need, q.hybrid_alpha,
+                epoch0, scores, docids, self.local_rwi_considered,
+                dv0=dv0, dense_first=df_served, cv0=cv0)
+        return scores, docids
+
+    def _dense_first(self, scores, docids, k: int):
+        """Dense-first candidate generation (ISSUE 11): the IVF ANN
+        index turns the query vector into a candidate stream that is
+        fused with the sparse candidates in ONE cardinal score domain
+        (sparse + fixed-scale dense boost) under the pinned (score
+        DESC, docid ASC) tie discipline — a document sparse retrieval
+        missed can now be recovered by the dense path. Steady state
+        rides the devstore's batched `ann` kernel family
+        (dense_first_topk); an event without a devstore probes the
+        segment's index host-side. Returns None when no ANN index is
+        attached (the caller keeps the plain rerank)."""
+        q = self.query
+        qtext = " ".join(q.include_words())
+        qvec = self.segment.encoder.encode(qtext)
+        sparse = np.asarray(scores, dtype=np.int64).astype(np.int32)
+        dd = np.asarray(docids).astype(np.int32)
+        ds = self.segment.devstore
+        fn = getattr(ds, "dense_first_topk", None) \
+            if ds is not None else None
+        if fn is not None:
+            got = fn(qvec, sparse, dd, q.hybrid_alpha, k)
+            if got is not None:
+                s, d = got
+                return np.asarray(s, dtype=np.int64), np.asarray(d)
+        ann = getattr(self.segment, "ann", None)
+        if ann is not None and getattr(ann, "built", False):
+            s, d = ann.search_host(qvec, dd, sparse, q.hybrid_alpha, k)
+            return np.asarray(s, dtype=np.int64), np.asarray(d)
+        return None
 
     def _dense_rerank(self, scores, docids):
         """M7 second stage: add dense cosine similarity into the sparse

@@ -492,10 +492,44 @@ def prometheus_text(sb, include_buckets: bool = True,
     # future health rule resolve on every node configuration
     p.family("yacy_device_hbm_bytes", "gauge",
              "postings bytes resident per tier (hot=device packed/int16, "
-             "warm=host-RAM packed blocks, cold=paged-run mmap)")
+             "warm=host-RAM packed blocks, cold=paged-run mmap), plus "
+             "the vector side (ISSUE 11): dense=f16 forward-index "
+             "block, ann_hot/warm/cold=the IVF slab ladder — every "
+             "resident byte accounted")
     for tier in ("hot", "warm", "cold"):
         p.sample("yacy_device_hbm_bytes", c.get(f"tier_{tier}_bytes", 0),
                  {"tier": tier})
+    p.sample("yacy_device_hbm_bytes", c.get("dense_fwd_bytes", 0),
+             {"tier": "dense"})
+    for tier in ("hot", "warm", "cold"):
+        p.sample("yacy_device_hbm_bytes",
+                 c.get(f"ann_{tier}_bytes", 0), {"tier": f"ann_{tier}"})
+    # dense-first IVF ANN (ISSUE 11): candidate-generation coverage +
+    # the vector tier ladder's traffic — always emitted (zeros without
+    # an index) so fleet digests and health rules resolve everywhere
+    p.family("yacy_ann_total", "counter",
+             "dense-first ANN counters: queries/dispatches = mean "
+             "coalescing factor, host_queries = device-loss host path, "
+             "fallbacks = no index (plain rerank served), tier hits = "
+             "probe traffic per residency tier, promotions = clusters "
+             "uploaded into the hot arena, lane_drops = whole-cluster "
+             "probe-budget drops")
+    for key in ("ann_dispatches", "ann_queries", "ann_fallbacks",
+                "ann_host_queries", "ann_tier_hot_hits",
+                "ann_tier_warm_hits", "ann_tier_cold_hits",
+                "ann_promotions", "ann_promote_failures",
+                "ann_lane_drops"):
+        p.sample("yacy_ann_total", c.get(key, 0),
+                 {"counter": key[4:]})
+    p.family("yacy_ann_centroid_version", "gauge",
+             "ANN centroid-set version (bumps on rebuild AND on hot "
+             "promotion — scoring-venue moves re-key cached fused "
+             "lists; keys the dense-first top-k cache)")
+    p.sample("yacy_ann_centroid_version",
+             c.get("ann_centroid_version", 0))
+    p.family("yacy_ann_resident_vectors", "gauge",
+             "vectors resident in the IVF slab ladder")
+    p.sample("yacy_ann_resident_vectors", c.get("ann_vectors", 0))
     p.family("yacy_tier_promotions_total", "counter",
              "tier ladder transitions (src->dst; demotions/evictions "
              "ride the same family)")

@@ -659,6 +659,22 @@ def device_store(header, post, sb):
             ("tier_demotions_hot_warm", c["tier_demotions_hot_warm"]),
             ("term_cache_hits", c["term_cache_hits"]),
             ("term_cache_evictions", c["term_cache_evictions"]),
+            # dense-first ANN (ISSUE 11): candidate-generation coverage
+            # + the vector side of the residency ledger — with
+            # dense_fwd_bytes, every resident byte is on this dashboard
+            ("ann_vectors", c["ann_vectors"]),
+            ("ann_clusters", c["ann_clusters"]),
+            ("ann_queries", c["ann_queries"]),
+            ("ann_dispatches", c["ann_dispatches"]),
+            ("ann_host_queries", c["ann_host_queries"]),
+            ("ann_bytes_hot_warm_cold",
+             f"{c['ann_hot_bytes']}/{c['ann_warm_bytes']}"
+             f"/{c['ann_cold_bytes']}"),
+            ("ann_hits_hot_warm_cold",
+             f"{c['ann_tier_hot_hits']}/{c['ann_tier_warm_hits']}"
+             f"/{c['ann_tier_cold_hits']}"),
+            ("ann_promotions", c["ann_promotions"]),
+            ("dense_fwd_bytes", c["dense_fwd_bytes"]),
         ]
     elif kind == "MeshSegmentStore":
         rows += [

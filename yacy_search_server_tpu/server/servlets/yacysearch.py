@@ -163,7 +163,8 @@ def _respond_search(header: dict, post: ServerObjects, sb) -> ServerObjects:
     event = sb.search(query, count=count, offset=offset,
                       hybrid=post.get_bool("hybrid", False),
                       contentdom=contentdom,
-                      use_cache=not post.get_bool("nocache", False))
+                      use_cache=not post.get_bool("nocache", False),
+                      dense_first=post.get_bool("densefirst", False))
     if post.get("resource", "") == "global":
         _remote_fanout(sb, event, count)
     if image_mode:
@@ -193,6 +194,10 @@ def _respond_search(header: dict, post: ServerObjects, sb) -> ServerObjects:
     suffix = f"&maximumRecords={count}"
     if post.get_bool("hybrid", False):
         suffix += "&hybrid=true"
+    if post.get_bool("densefirst", False):
+        # dense-first must survive paging like the hybrid flag — page 2
+        # under a different retrieval mode would repeat/skip results
+        suffix += "&densefirst=true"
     if contentdom:
         suffix += f"&contentdom={quote(contentdom)}"
     _fill_navigation(prop, event, esc, base_query=query, url_suffix=suffix)
@@ -201,6 +206,8 @@ def _respond_search(header: dict, post: ServerObjects, sb) -> ServerObjects:
     # content-domain tabs (the reference's Text/Images/... search tabs);
     # the hybrid flag must survive a tab switch like it survives paging
     hybrid_part = "&hybrid=true" if post.get_bool("hybrid", False) else ""
+    if post.get_bool("densefirst", False):
+        hybrid_part += "&densefirst=true"
     for name in ("text", "image", "audio", "video", "app"):
         prop.put(f"tab_{name}_url",
                  f"yacysearch.html?query={qq}&maximumRecords={count}"

@@ -152,11 +152,24 @@ class Switchboard:
                         # packed kernel, the parity-test A/B switch
                         rerank_batching=self.config.get_bool(
                             "index.device.rerankBatching", True))
+                # dense-first serving knobs (ISSUE 11): probe width and
+                # per-query lane budget ride the store; the forward
+                # index's device budget replaces the old hard-coded
+                # 1 GiB class constant
+                ds = self.index.devstore
+                if hasattr(ds, "ann_nprobe"):   # mesh store: no ANN yet
+                    ds.ann_nprobe = self.config.get_int(
+                        "index.ann.nprobe", ds.ann_nprobe)
+                    ds.ann_probe_lanes = self.config.get_int(
+                        "index.ann.probeLanes", ds.ann_probe_lanes)
             except ValueError:
                 raise
             except Exception:  # no usable jax backend: host path serves
                 self.index.devstore = None
                 self.index.rwi.listener = None
+        self.index.dense.device_budget_bytes = self.config.get_int(
+            "index.dense.deviceBudgetBytes",
+            self.index.dense.device_budget_bytes)
         self.latency = Latency()
         self.htcache = HTCache(sub("HTCACHE"))
         self.loader = LoaderDispatcher(self.htcache, self.latency,
@@ -512,7 +525,8 @@ class Switchboard:
     def search(self, query_string: str, count: int = 10,
                offset: int = 0, hybrid: bool = False,
                client: str = "", contentdom: str = "",
-               use_cache: bool = True) -> SearchEvent:
+               use_cache: bool = True,
+               dense_first: bool = False) -> SearchEvent:
         # root trace for direct callers (node.search, benchmarks, the
         # federation connectors); under a servlet's trace this degrades
         # to a child span — one request stays one trace
@@ -520,15 +534,19 @@ class Switchboard:
                            count=count, offset=offset):
             return self._search_traced(query_string, count, offset,
                                        hybrid, client, contentdom,
-                                       use_cache)
+                                       use_cache, dense_first)
 
     def _search_traced(self, query_string: str, count: int,
                        offset: int, hybrid: bool, client: str,
-                       contentdom: str, use_cache: bool) -> SearchEvent:
+                       contentdom: str, use_cache: bool,
+                       dense_first: bool = False) -> SearchEvent:
         q = QueryParams.parse(query_string)
         q.item_count = count
         q.offset = offset
-        q.hybrid = hybrid
+        # dense-first IS a hybrid mode (the fused list blends the dense
+        # boost into the sparse cardinal domain)
+        q.hybrid = hybrid or dense_first
+        q.dense_first = dense_first
         if contentdom:
             # contentdom selects the media type AND its ranking preset
             # (reference: yacysearch.java contentdom parameter)

@@ -70,6 +70,13 @@ LEVEL_NO_RERANK = 2             # skip the dense rerank stage (sparse order)
 LEVEL_CACHE_ONLY = 3            # serve the rank cache (stale-ok); miss = empty
 LEVEL_SHED = 4                  # shed search requests with Retry-After
 
+# dense-first candidate generation (ISSUE 11) sheds at rung 1 — ONE
+# rung BEFORE the rerank: the ANN probe is the more expensive dense
+# stage, and shedding it still serves a full hybrid (sparse + rerank)
+# answer.  An alias of the snippet rung, not a new rung: the ladder's
+# metric/name surface (LEVEL_NAMES, zero-filled series) is unchanged.
+LEVEL_NO_DENSE_FIRST = LEVEL_NO_LIVE_SNIPPETS
+
 LEVEL_NAMES = ("full", "no_live_snippets", "no_rerank", "cache_only",
                "shed")
 N_LEVELS = len(LEVEL_NAMES)

@@ -120,6 +120,10 @@ def digest_series(digest: dict) -> dict:
         out["tiers.c"] = 'yacy_device_hbm_bytes{tier="cold"}'
         out["tiers.p"] = \
             'yacy_tier_promotions_total{src="warm",dst="hot"}'
+        out["tiers.d"] = 'yacy_device_hbm_bytes{tier="dense"}'
+        out["tiers.ah"] = 'yacy_device_hbm_bytes{tier="ann_hot"}'
+        out["tiers.aw"] = 'yacy_device_hbm_bytes{tier="ann_warm"}'
+        out["tiers.ac"] = 'yacy_device_hbm_bytes{tier="ann_cold"}'
     return out
 
 
@@ -234,6 +238,12 @@ class FleetTable:
                 "w": int(c.get("tier_warm_bytes", 0)) >> 10,
                 "c": int(c.get("tier_cold_bytes", 0)) >> 10,
                 "p": int(c.get("tier_promotions_warm_hot", 0)),
+                # vector-side residency (ISSUE 11): dense f16 forward
+                # block + the ANN slab ladder, KiB like the postings
+                "d": int(c.get("dense_fwd_bytes", 0)) >> 10,
+                "ah": int(c.get("ann_hot_bytes", 0)) >> 10,
+                "aw": int(c.get("ann_warm_bytes", 0)) >> 10,
+                "ac": int(c.get("ann_cold_bytes", 0)) >> 10,
             },
         }
         # wire budget: a digest must never bloat the exchanges it rides.
