@@ -98,3 +98,98 @@ def test_empty_postings():
     mesh = make_mesh(n_doc=8, devices=devs)
     s, d = MeshRanker(mesh).rank(PostingsList.empty(), None, k=10)
     assert len(s) == 0 and len(d) == 0
+
+
+# -- fused all-gather+top-k collective (ISSUE 12b) ---------------------------
+
+def _gather_fns(mesh, k):
+    """(legacy gather, fused collective) as jitted shard_map programs
+    over the SAME local inputs."""
+    import jax.numpy as jnp
+    from functools import partial
+    from jax import lax
+    from jax.sharding import PartitionSpec as PS
+
+    from yacy_search_server_tpu.parallel.mesh import (all_gather_topk,
+                                                      shard_map, tie_topk)
+
+    def legacy(s, d):
+        ls, li = lax.top_k(s, min(k, s.shape[0]))
+        gs = lax.all_gather(ls, "doc", tiled=True)
+        gd = lax.all_gather(d[li], "doc", tiled=True)
+        ts, ti = lax.top_k(gs, min(k, gs.shape[0]))
+        return ts, gd[ti]
+
+    def fused(s, d):
+        ls, ld = tie_topk(s, d, min(k, s.shape[0]))
+        return all_gather_topk(ls, ld, "doc", k)
+
+    mk = lambda body: jax.jit(shard_map(     # noqa: E731
+        body, mesh=mesh, in_specs=(PS("doc"), PS("doc")),
+        out_specs=(PS(), PS()), check_vma=False))
+    return mk(legacy), mk(fused)
+
+
+def test_fused_collective_bit_identical_to_legacy_gather():
+    """Satellite: local-top-k-then-gather replaces gather-then-top-k;
+    on distinct scores the two fusions must be bit-identical (the tie
+    cases, where the legacy path was layout-dependent, are pinned
+    separately below)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PS
+    devs = _cpu8()
+    mesh = make_mesh(n_doc=8, devices=devs)
+    rng = np.random.default_rng(5)
+    n, k = 8 * 128, 10
+    scores = rng.permutation(n).astype(np.int32)     # all distinct
+    docids = np.arange(n, dtype=np.int32)
+    sh1 = NamedSharding(mesh, PS("doc"))
+    sa = jax.device_put(scores, sh1)
+    da = jax.device_put(docids, sh1)
+    legacy, fused = _gather_fns(mesh, k)
+    ls, ld = legacy(sa, da)
+    fs, fd = fused(sa, da)
+    np.testing.assert_array_equal(np.asarray(ls), np.asarray(fs))
+    np.testing.assert_array_equal(np.asarray(ld), np.asarray(fd))
+
+
+def test_fused_collective_pins_cross_shard_tie_discipline():
+    """Equal scores on DIFFERENT shards fuse as (score DESC, docid ASC)
+    — checked against the numpy lexsort oracle; gather-position order
+    (what the legacy merge produced) must not leak through."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PS
+    devs = _cpu8()
+    mesh = make_mesh(n_doc=8, devices=devs)
+    rng = np.random.default_rng(6)
+    n, k = 8 * 128, 16
+    # few distinct score values → ties everywhere, within and across
+    # shards; docids SHUFFLED so positional order ≠ docid order
+    scores = rng.integers(0, 5, n).astype(np.int32) * 1000
+    docids = rng.permutation(n).astype(np.int32)
+    sh1 = NamedSharding(mesh, PS("doc"))
+    _legacy, fused = _gather_fns(mesh, k)
+    fs, fd = fused(jax.device_put(scores, sh1),
+                   jax.device_put(docids, sh1))
+    fs, fd = np.asarray(fs), np.asarray(fd)
+    # oracle: global exact two-key order over ALL rows.  The fused
+    # collective only sees each shard's local top-k, but local
+    # selection is tie-exact too, so the global top-k set matches.
+    order = np.lexsort((docids, -scores))[:k]
+    np.testing.assert_array_equal(fs, scores[order])
+    np.testing.assert_array_equal(fd, docids[order])
+    # the returned order itself satisfies the discipline
+    assert all(fs[i] > fs[i + 1] or (fs[i] == fs[i + 1]
+               and fd[i] < fd[i + 1]) for i in range(k - 1))
+
+
+def test_tie_topk_matches_lexsort_oracle():
+    from yacy_search_server_tpu.parallel.mesh import tie_topk
+    rng = np.random.default_rng(8)
+    for dtype in (np.int32, np.float32):
+        s = rng.integers(0, 7, 100).astype(dtype)
+        d = rng.permutation(100).astype(np.int32)
+        ts, td = jax.jit(lambda a, b: tie_topk(a, b, 20))(s, d)
+        order = np.lexsort((d, -s))[:20]
+        np.testing.assert_array_equal(np.asarray(ts), s[order])
+        np.testing.assert_array_equal(np.asarray(td), d[order])

@@ -1,0 +1,327 @@
+"""ISSUE 12 — true multi-process SPMD mesh serving.
+
+The one structural gap that survived every re-anchor: every multi-chip
+number used to come from ONE interpreter.  These tests launch a REAL
+2-process CPU mesh via ``jax.distributed`` (2 procs x 2 virtual CPU
+devices = 4 global mesh cells), serve queries over the real HTTP wire
+(``/yacy/meshsearch.html`` → two-phase scatter → cross-process
+collective → fused ranking), and pin:
+
+* rankings bit-identical to the single-process mesh store over the same
+  4-cell layout (the acceptance criterion);
+* the ≥2-distinct-PIDs hygiene gate — the fleet must really span OS
+  processes, asserted from pids reported over the wire;
+* (score DESC, docid ASC) for constructed equal-score candidates whose
+  postings live on DIFFERENT processes;
+* device-loss injected into ONE member mid-soak: every query still
+  answers (degraded + counted), a flight-recorder incident names the
+  member, recovery brings collectives back bit-identically;
+* the supervisor's reaper: killing a member leaves the rest answering,
+  and close() leaves no orphaned child processes.
+
+Tier-1 by construction: no slow marker, one module-scoped fleet, and an
+explicit wall budget on the serving phase.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.ops.ranking import RankingProfile
+from yacy_search_server_tpu.parallel import distributed as D
+from yacy_search_server_tpu.parallel.launcher import MeshFleet
+from yacy_search_server_tpu.utils.hashes import word2hash
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NDOCS = 256
+SEED = 3
+QUERY_TERMS = list(D.CORPUS_TERMS) + [D.TIE_TERM]
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    run_dir = str(tmp_path_factory.mktemp("meshfleet"))
+    with MeshFleet(procs=2, local_devices=2, ndocs=NDOCS, seed=SEED,
+                   run_dir=run_dir) as fl:
+        yield fl
+    # the any-failure-path reaper must leave no child running
+    for c in fl.children:
+        assert c.poll() is not None, "unreaped mesh child"
+
+
+@pytest.fixture(scope="module")
+def reference(fleet):
+    """The single-process mesh store over the SAME 4-cell layout —
+    rankings must be bit-identical across the process-count axis."""
+    import jax
+
+    from yacy_search_server_tpu.switchboard import Switchboard
+    from yacy_search_server_tpu.utils.config import Config
+    cfg = Config()
+    cfg.set("index.device.serving", "false")
+    sb = Switchboard(data_dir=None, config=cfg)
+    D.build_corpus(sb, NDOCS, SEED, n_doc=4)
+    ms = sb.index.enable_mesh_serving(devices=jax.devices("cpu")[:4],
+                                      n_term=1)
+    ms.small_rank_n = 0
+    ref = {}
+    for w in QUERY_TERMS:
+        out = ms.rank_term(word2hash(w), RankingProfile(), k=10)
+        assert out is not None
+        ref[w] = (np.asarray(out[0]).tolist(),
+                  np.asarray(out[1]).tolist())
+    yield ref
+    sb.close()
+
+
+def test_fleet_spans_processes_and_partition_math_agrees(fleet):
+    """Bring-up contract: every member reports ready over the wire,
+    the partition fingerprints agree across processes AND match the
+    locally computed one (same math, different interpreter)."""
+    infos = [fleet.info(i) for i in range(2)]
+    assert all(i["ready"] for i in infos)
+    fps = {i["fp"] for i in infos}
+    assert len(fps) == 1
+    assert fps == {D.partition_fingerprint(1, 4)}
+    assert infos[0]["proc"] == 0 and infos[1]["proc"] == 1
+    # the fleet really spans OS processes — and none of them is us
+    pids = {i["pid"] for i in infos}
+    assert len(pids) == 2
+    assert os.getpid() not in pids
+
+
+def test_scatter_fuse_respond_bit_identical_over_http(fleet, reference):
+    """THE acceptance criterion: a 2-process CPU mesh serves queries
+    over the real HTTP wire as cross-process SPMD collectives, with
+    rankings bit-identical to the single-process mesh store.  The
+    serving phase itself carries an explicit wall budget (satellite:
+    slow-marker-free tier-1 runtime)."""
+    t0 = time.monotonic()
+    for w in QUERY_TERMS:
+        rep = fleet.search(w, k=10)
+        assert rep["mode"] == "collective", rep
+        assert rep["scores"] == reference[w][0], w
+        assert rep["docids"] == reference[w][1], w
+        # the PID hygiene gate: the answer names every participating
+        # process; they must be ≥2 DISTINCT OS pids, reported over the
+        # wire by the processes themselves
+        pids = set(rep["pids"].values())
+        assert len(pids) >= 2, rep["pids"]
+        # queries ride a distributed trace (the wire carries the id)
+        assert rep.get("trace")
+    assert time.monotonic() - t0 < 60.0, \
+        "multi-process serving phase exceeded its tier-1 budget"
+
+
+def test_cross_process_tie_discipline(fleet):
+    """Satellite: constructed equal-score candidates arriving from
+    different processes fuse under the pinned (score DESC, docid ASC)
+    discipline — the tie corpus term packs one identical feature row
+    per (doc column x 2), so every process contributes tied rows."""
+    rep = fleet.search(D.TIE_TERM, k=10)
+    s, d = rep["scores"], rep["docids"]
+    assert len(s) == 8 and len(set(s)) == 1, (s, d)
+    assert d == sorted(d), f"equal scores must order docid ASC: {d}"
+
+
+def test_fleet_digests_carry_process_identity(fleet):
+    """The coordinator's fleet table holds the member's gossiped digest
+    (it rode the scatter RPCs for free) with the member's REAL pid —
+    Network_Health_p renders a real multi-process mesh from these."""
+    info0 = fleet.info(0)
+    assert info0["fleet_peers"] >= 1
+    assert info0["digest_bytes"] > 0
+    peer_procs = info0.get("peers_proc", [])
+    member1_pid = fleet.info(1)["pid"]
+    assert any(p.get("pid") == member1_pid and p.get("id") == 1
+               for p in peer_procs), peer_procs
+    # arena-epoch bumps are visible cross-process (per-process pack
+    # machinery re-proven through the digest)
+    assert any(e > 0 for e in info0.get("peers_epoch", [])) or \
+        info0["counters"]["arena_epoch"] > 0
+
+
+def test_one_member_device_loss_survival_and_recovery(fleet, reference):
+    """Acceptance: device loss injected into ONE mesh process mid-soak
+    leaves the fleet answering 100% of queries (degraded + counted,
+    never a hang), dumps a flight-recorder incident naming the member,
+    and the member's background rebuild brings collectives back with
+    bit-identical rankings."""
+    ref = reference["meshterm"]
+    # arm an effectively-unbounded failure count in member 1 ONLY: its
+    # fetches and rebuild probes fail until we clear the fault
+    assert fleet.fault(1, "device.transfer_fail", 100000)["result"] == "ok"
+    asked = 0
+    degraded = 0
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        rep = fleet.search("meshterm", k=10)
+        asked += 1
+        # EVERY query answers, bit-identically, in either mode
+        assert rep["scores"] == ref[0] and rep["docids"] == ref[1]
+        if rep["mode"] == "host":
+            degraded += 1
+            if degraded >= 3:
+                break
+    assert degraded >= 3, "fleet never degraded to committed host mode"
+    inf1 = fleet.info(1)
+    assert inf1["lost"], "member 1 should have declared device loss"
+    assert inf1["counters"]["device_losses"] >= 1
+    # the flight recorder names the member (coordinator side)
+    incs = fleet.info(0)["incidents"]
+    assert any(i["name"] == "mesh_member_lost"
+               and i["member"] == "mesh1" for i in incs), incs
+    # the incident is durably dumped (JSONL flight-recorder file)
+    mdir = os.path.join(fleet.run_dir, "member0", "DATA", "HEALTH")
+    assert any(f.startswith("mesh-incident-")
+               for f in os.listdir(mdir)), os.listdir(mdir)
+    # recovery: clear the fault; the member's rebuild probe succeeds
+    # and the coordinator resumes committing collectives
+    assert fleet.fault(1, "device.transfer_fail", None,
+                       clear=True)["result"] == "ok"
+    deadline = time.monotonic() + 45.0
+    recovered = False
+    while time.monotonic() < deadline:
+        if not fleet.info(1)["lost"]:
+            recovered = True
+            break
+        time.sleep(0.5)
+    assert recovered, "member 1 never recovered after the fault cleared"
+    assert fleet.info(1)["counters"]["device_loss_recoveries"] >= 1
+    deadline = time.monotonic() + 45.0
+    back = False
+    while time.monotonic() < deadline:
+        rep = fleet.search("meshterm", k=10)
+        asked += 1
+        assert rep["scores"] == ref[0] and rep["docids"] == ref[1]
+        if rep["mode"] == "collective":
+            back = True
+            break
+        time.sleep(0.5)
+    assert back, "collectives never resumed after recovery"
+    incs = fleet.info(0)["incidents"]
+    assert any(i["name"] == "mesh_member_recovered"
+               and i["member"] == "mesh1" for i in incs), incs
+    # the 100%-answered contract, per process: every member executed
+    # and answered every step it saw (collective + host + error == total;
+    # an error step still answers — with a counted empty result)
+    for i in range(2):
+        rt = fleet.info(i)["runtime"]
+        assert rt["queries_total"] == \
+            rt["answered_collective"] + rt["answered_host"] \
+            + rt["step_errors"]
+        assert rt["step_errors"] == 0        # healthy steps only
+        assert rt["answered_host"] >= 1      # the degraded window
+
+
+def test_kill_one_member_fleet_still_answers_then_reaps(fleet,
+                                                        reference):
+    """LAST (destructive): hard-kill member 1 mid-fleet.  The next
+    scatter marks it down, the coordinator serves the committed host
+    answer (degraded + counted, bit-identical), the incident names the
+    member — and the supervisor's close() reaps every child with no
+    orphans (asserted in the fixture finalizer and here)."""
+    victim = fleet.children[1].pid
+    fleet.kill_member(1, signal.SIGKILL)
+    deadline = time.monotonic() + 10.0
+    while fleet.children[1].poll() is None and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert 1 in fleet.poll()
+    rep = fleet.search("meshterm", k=10)
+    assert rep["mode"] == "host"
+    assert rep["scores"] == reference["meshterm"][0]
+    assert rep["docids"] == reference["meshterm"][1]
+    incs = fleet.info(0)["incidents"]
+    assert any(i["name"] == "mesh_member_down"
+               and i["member"] == "mesh1" for i in incs), incs
+    # the killed child is really gone (no orphan holding the port)
+    with pytest.raises(OSError):
+        os.kill(victim, 0)
+
+
+# -- committed artifact (satellite: --capacity validation pattern) -----------
+
+MESH_PROCS_KEYS = (
+    "procs", "cells", "queries", "answered", "qps",
+    "bit_identical_vs_single_process", "distinct_pids",
+    "fusion_collective_ms", "digest_bytes", "worker_stall",
+    "per_process", "ok",
+)
+
+
+def test_committed_multichip_r06_artifact():
+    """MULTICHIP_r06.json must come from a real multi-process soak
+    (bench.py --mesh-procs N): per-process counters, the fusion-
+    collective histogram, distinct pids, zero worker_stall — a soak
+    that failed any gate must not have committed a green artifact."""
+    import json
+    art = os.path.join(REPO, "MULTICHIP_r06.json")
+    assert os.path.exists(art), \
+        "MULTICHIP_r06.json missing (run bench.py --mesh-procs 3)"
+    obj = json.loads(open(art, encoding="utf-8").read())
+    missing = [k for k in MESH_PROCS_KEYS if k not in obj]
+    assert not missing, f"artifact missing {missing}"
+    assert obj["ok"] is True
+    assert obj["procs"] >= 2
+    assert obj["answered"] == obj["queries"] > 0
+    assert obj["distinct_pids"] == obj["procs"]
+    assert obj["worker_stall"] == 0
+    assert obj["bit_identical_vs_single_process"] is True
+    assert obj["fusion_collective_ms"]["count"] > 0
+    assert len(obj["per_process"]) == obj["procs"]
+    for row in obj["per_process"]:
+        # .get: the r06 artifact predates the step_errors counter
+        assert row["queries_total"] == \
+            row["answered_collective"] + row["answered_host"] \
+            + row.get("step_errors", 0)
+        assert "qps" in row and "collective_hist" in row
+
+
+# -- partition-math determinism (satellite) ----------------------------------
+
+def test_term_shard_properties_over_random_hashes_and_shapes():
+    """Same (termhash, mesh shape) → same (term, doc) cell, every time:
+    bounds, determinism, and the ring-scaling consistency property
+    (halving the axis halves the shard index) over random hashes."""
+    from yacy_search_server_tpu.index.meshstore import term_shard
+    from yacy_search_server_tpu.utils.base64order import ALPHA_ENHANCED
+    rng = np.random.default_rng(7)
+    hashes = [word2hash(f"w{rng.integers(1 << 30)}") for _ in range(200)]
+    hashes += [bytes(ALPHA_ENHANCED[rng.integers(0, 64)]
+                     for _ in range(12)) for _ in range(50)]
+    for th in hashes:
+        prev = None
+        for n_term in (1, 2, 4, 8, 16):
+            t = term_shard(th, n_term)
+            assert 0 <= t < n_term
+            assert t == term_shard(th, n_term)       # deterministic
+            if prev is not None:
+                assert t // 2 == prev                # ring scaling
+            prev = t
+    # doc placement: docid % n_doc is trivially stable; the pair
+    # fingerprint digests both axes together
+    assert D.partition_fingerprint(2, 4) == D.partition_fingerprint(2, 4)
+    assert D.partition_fingerprint(2, 4) != D.partition_fingerprint(1, 8)
+
+
+def test_partition_fingerprint_stable_across_interpreter_restart():
+    """Across-restart determinism: a FRESH interpreter computes the
+    same placement digest (no per-process hash seeds anywhere in the
+    ring math)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from yacy_search_server_tpu.parallel.distributed import "
+         "partition_fingerprint as fp; print(fp(2, 4), fp(1, 4))"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONHASHSEED": "random"})
+    assert out.returncode == 0, out.stderr[-1500:]
+    got = out.stdout.split()
+    assert got == [D.partition_fingerprint(2, 4),
+                   D.partition_fingerprint(1, 4)]

@@ -79,6 +79,47 @@ def test_registry_covers_the_named_kernels():
 
 # -- cost model vs XLA (3 shapes per kernel) ---------------------------------
 
+@pytest.mark.parametrize("ndev,k,rows", ((4, 16, 256), (8, 16, 1024),
+                                         (8, 128, 256), (4, 64, 4096)))
+def test_xla_all_gather_topk(ndev, k, rows):
+    """The fused fusion collective's cost model vs XLA (ISSUE 12
+    acceptance: XLA-cross-checked, gathered bytes scale with k not
+    corpus rows) — the whole shard_map program: local tie-exact top-k
+    + k-row gather + tie-pinned merge."""
+
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as PS
+
+    from yacy_search_server_tpu.parallel.mesh import (all_gather_topk,
+                                                      shard_map,
+                                                      tie_topk)
+    devs = jax.devices("cpu")
+    if len(devs) < ndev:
+        pytest.skip(f"needs {ndev} virtual CPU devices")
+    mesh = Mesh(np.asarray(devs[:ndev]), ("doc",))
+
+    def body(s, d):
+        ls, ld = tie_topk(s, d, k)
+        return all_gather_topk(ls, ld, "doc", k)
+    fn = jax.jit(shard_map(body, mesh=mesh,
+                           in_specs=(PS("doc"), PS("doc")),
+                           out_specs=(PS(), PS()), check_vma=False))
+    n = ndev * rows
+    sa = jax.device_put(jnp.arange(n, dtype=jnp.int32),
+                        NamedSharding(mesh, PS("doc")))
+    da = jax.device_put(jnp.arange(n, dtype=jnp.int32),
+                        NamedSharding(mesh, PS("doc")))
+    flops, by = _xla(fn, sa, da)
+    c = RF.cost("all_gather_topk", k=k, ndev=ndev, rows=rows)
+    _close(c.flops, flops, f"all_gather_topk[{ndev},{k},{rows}] flops")
+    _close(c.xla_bytes, by, f"all_gather_topk[{ndev},{k},{rows}] bytes")
+    # the k-scaling contract: quadrupling corpus rows grows the model's
+    # gathered wire payload not at all (compulsory bytes: 8·G + local)
+    big = RF.cost("all_gather_topk", k=k, ndev=ndev, rows=rows * 4)
+    gathered = lambda c_, r: c_.bytes - 8.0 * r   # noqa: E731
+    assert gathered(c, rows) == gathered(big, rows * 4)
+
+
 @pytest.mark.parametrize("n", (4096, 32768, 131072))
 def test_xla_cardinal_scores16(n):
     f16, fl, dd, v, hh = _block(n)

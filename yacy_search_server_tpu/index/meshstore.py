@@ -68,7 +68,8 @@ from ..ops.ranking import (RankingProfile, cardinal_from_stats,
                            compact_feats, local_stats)
 from ..ops.streaming import merge_stats
 from ..parallel.distribution import horizontal_dht_position
-from ..parallel.mesh import shard_map
+from ..parallel.mesh import (all_gather_topk, all_gather_topk_full,
+                             shard_map, tie_topk)
 from ..utils.eventtracker import EClass, update as track
 from ..utils import histogram, tracing
 from . import postings as P
@@ -83,6 +84,13 @@ from .devstore import (_PRUNE_B, DAYS_NONE_HI, DAYS_NONE_LO,
                        pmax_table, prune_bound_consts)
 
 INT32_MAX = 2 ** 31 - 1
+
+
+def _my_process_index() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
 
 
 def term_shard(termhash: bytes, n_term: int) -> int:
@@ -563,6 +571,21 @@ class MeshSegmentStore:
         self.n_cells = len(devs)
         self.mesh = Mesh(np.asarray(devs).reshape(self.n_term, self.n_doc),
                          axis_names=("term", "doc"))
+        # TRUE multi-process SPMD mode (ISSUE 12): the mesh spans devices
+        # owned by OTHER OS processes (jax.distributed).  Every process
+        # runs this same store over identical host mirrors; collectives
+        # cross process boundaries.  Two local conveniences must then be
+        # OFF, because they make collective-entry decisions from
+        # process-local state (thread timing, cache residency) and a
+        # process skipping — or adding — one SPMD program while its
+        # peers run it deadlocks the whole mesh:
+        #   * the cross-query batcher (enable_batching becomes a no-op);
+        #   * the versioned top-k result cache (get/put are skipped).
+        # Step ordering is owned by parallel/distributed.py's two-phase
+        # scatter/commit protocol instead.
+        self.multiprocess = any(
+            getattr(d, "process_index", 0) != _my_process_index()
+            for d in devs)
         self.rwi = rwi
         self.budget_bytes = budget_bytes
         self._cells = [_CellBuf() for _ in range(self.n_cells)]
@@ -779,7 +802,14 @@ class MeshSegmentStore:
         asynchronously and fetched by a completer (devstore parity).
         Extra devstore kwargs (dispatchers, completer_depth) are
         accepted and ignored — the mesh runs one program, so one
-        dispatcher + one completer drain the queue."""
+        dispatcher + one completer drain the queue.
+
+        Multi-process mode: NO-OP.  Batch grouping is thread-timing
+        dependent, so two processes would batch different query sets and
+        enter different SPMD programs — a deadlock, not a perf bug.  The
+        distributed runtime serializes steps instead (ISSUE 12)."""
+        if self.multiprocess:
+            return
         if self._batcher is None:
             self._batcher = _MeshQueryBatcher(
                 self, max_batch=min(max_batch,
@@ -790,7 +820,12 @@ class MeshSegmentStore:
                        language: str = "en", k: int = 100):
         """Versioned top-k cache lookup (devstore parity): the full
         final answer of a previous identical query, valid only while the
-        arena epoch is unchanged and the term carries no RAM delta."""
+        arena epoch is unchanged and the term carries no RAM delta.
+
+        Multi-process mode: always a miss — a cache hit would skip the
+        committed collective this process's peers are entering."""
+        if self.multiprocess:
+            return None
         kk = max(16, 1 << (max(k, 1) - 1).bit_length())
         key = (termhash, profile.to_external_string(), language, kk)
         with self.rwi._lock:
@@ -885,8 +920,20 @@ class MeshSegmentStore:
                 if faultinject.take("device.transfer_fail"):
                     raise DeviceTransferError(
                         "injected device.transfer_fail")
-                probe = jax.device_put(np.zeros(1, np.int32),
-                                       NamedSharding(self.mesh, PS()))
+                # multi-process: probe THIS process's own devices only —
+                # a mesh-wide device_put from one process alone would
+                # strand it in a collective its peers never enter (the
+                # peers keep serving; only OUR shard's health is ours
+                # to probe)
+                if self.multiprocess:
+                    mine = [d for d in self.mesh.devices.flat
+                            if getattr(d, "process_index", 0)
+                            == _my_process_index()]
+                    probe = jax.device_put(np.zeros(1, np.int32),
+                                           mine[0])
+                else:
+                    probe = jax.device_put(np.zeros(1, np.int32),
+                                           NamedSharding(self.mesh, PS()))
                 jax.device_get(probe)
             except Exception as e:
                 log.warning("mesh rebuild probe failed: %r", e)
@@ -952,6 +999,26 @@ class MeshSegmentStore:
 
     # -- device sync ---------------------------------------------------------
 
+    def _put(self, arr, spec):
+        """Upload a host array under `spec` over the store's mesh.
+
+        Single-process: plain ``jax.device_put``.  Multi-process:
+        ``jax.make_array_from_callback`` — each process materializes
+        ONLY its addressable shards, with NO cross-process transfer.
+        This is load-bearing, not an optimization: ``device_put`` onto
+        a multi-process sharding issues an implicit collective, so any
+        upload one process runs alone (the post-recovery re-upload, the
+        rebuild probe) would strand that process inside a gloo
+        all-reduce its peers never enter.  The host mirrors are
+        identical on every process by the SPMD corpus contract, so the
+        callback's local reads reconstruct the same global array."""
+        sh = NamedSharding(self.mesh, spec)
+        if not self.multiprocess:
+            return jax.device_put(arr, sh)
+        arr = np.asarray(arr)
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+
     def _sync_device(self):
         """Rebuild the sharded global arrays from the host mirrors.
 
@@ -985,14 +1052,14 @@ class MeshSegmentStore:
         pmax = np.full((self.n_cells, TC), INT32_MAX, np.int32)
         for i, c in enumerate(self._cells):
             pmax[i, :c.tused] = c.pmax
-        sh3 = NamedSharding(self.mesh, PS(("term", "doc"), None, None))
-        sh2 = NamedSharding(self.mesh, PS(("term", "doc"), None))
-        self._dev_arrays = (jax.device_put(feats, sh3),
-                            jax.device_put(flags, sh2),
-                            jax.device_put(docids, sh2))
-        self._dev_join = (jax.device_put(jdocids, sh2),
-                          jax.device_put(jpos, sh2))
-        self._dev_pmax = jax.device_put(pmax, sh2)
+        sp3 = PS(("term", "doc"), None, None)
+        sp2 = PS(("term", "doc"), None)
+        self._dev_arrays = (self._put(feats, sp3),
+                            self._put(flags, sp2),
+                            self._put(docids, sp2))
+        self._dev_join = (self._put(jdocids, sp2),
+                          self._put(jpos, sp2))
+        self._dev_pmax = self._put(pmax, sp2)
         self._dirty = False
 
     def _device_arrays(self):
@@ -1002,8 +1069,7 @@ class MeshSegmentStore:
 
     def _dead_array(self):
         if self._dirty_dead or self._dev_dead is None:
-            self._dev_dead = jax.device_put(
-                self._dead_host, NamedSharding(self.mesh, PS()))
+            self._dev_dead = self._put(self._dead_host, PS())
             self._dirty_dead = False
         return self._dev_dead
 
@@ -1011,8 +1077,7 @@ class MeshSegmentStore:
         key = (profile.to_external_string(), language)
         with self._lock:
             if self._profile_key != key:
-                rep = NamedSharding(self.mesh, PS())
-                put = lambda a: jax.device_put(np.asarray(a), rep)  # noqa: E731
+                put = lambda a: self._put(np.asarray(a), PS())  # noqa: E731
                 bits, shifts = profile.flag_coeffs()
                 self._consts = (put(profile.norm_coeffs()), put(bits),
                                 put(shifts),
@@ -1136,6 +1201,38 @@ class MeshSegmentStore:
                 self.fallbacks += 1
             return None
 
+    def rank_term_mp(self, termhash: bytes, profile,
+                     language: str = "en", k: int = 100):
+        """Committed-entry rank for the multi-process runtime
+        (parallel/distributed.py).  The two-phase scatter/commit
+        protocol has decided that EVERY process enters this step's
+        collective, so the local ``device_lost`` early-return of
+        ``rank_term`` must NOT apply here — a process that skips a
+        committed SPMD program strands its peers inside the collective
+        (the hang the protocol exists to prevent).  A process whose
+        device is genuinely failing still participates in the dispatch;
+        only its own fetch fails, which degrades THIS process to the
+        host answer (counted) while the others complete normally.
+        Returns None for host fallback; NEVER raises, NEVER hangs
+        beyond the collective's own bounded timeout."""
+        try:
+            return self._rank_term_impl(termhash, profile, language, k)
+        except DeviceTransferError:
+            with self._lock:
+                self.device_lost_queries += 1
+                self.fallbacks += 1
+            return None
+        except Exception:
+            # a mid-collective failure (a peer process died underneath
+            # the gather) surfaces as a runtime error after the
+            # collective's timeout: degrade to host, never crash the
+            # serving loop (the coordinator will mark the member down
+            # on its next scatter and stop committing collectives)
+            log.exception("multi-process mesh rank failed; host fallback")
+            with self._lock:
+                self.fallbacks += 1
+            return None
+
     def _rank_term_impl(self, termhash: bytes, profile,
                         language: str = "en", k: int = 100,
                         lang_filter: int = NO_LANG,
@@ -1169,7 +1266,7 @@ class MeshSegmentStore:
         def cache_put(s, d):
             """Insert the FINAL (post keep/dedup) answer under the
             snapshot's epoch (a concurrent flush leaves it born-stale)."""
-            if cacheable and not with_delta:
+            if cacheable and not with_delta and not self.multiprocess:
                 self._topk_cache.put(
                     (termhash, profile.to_external_string(), language,
                      kk0), epoch0, np.asarray(s), np.asarray(d),
@@ -1557,11 +1654,11 @@ def _join_score_gather(f, pos_min, pos_max, hit_min, flags_or, v, dd,
         norm_coeffs, flag_bits, flag_shifts, domlength_coeff,
         tf_coeff, language_coeff, authority_coeff, language_pref,
         flags=flags_or)
-    top_s, idx = lax.top_k(sc, min(k, r))
-    gs = lax.all_gather(top_s, axes, tiled=True)
-    gd = lax.all_gather(dd[idx], axes, tiled=True)
-    out_s, out_i = lax.top_k(gs, min(k, gs.shape[0]))
-    return out_s, gd[out_i]
+    # local exact top-k under the pinned (score DESC, docid ASC) tie
+    # discipline, fused by the shared all-gather+top-k collective —
+    # k rows per cell cross the interconnect (parallel/mesh.py)
+    top_s, top_d = tie_topk(sc, dd, min(k, r))
+    return all_gather_topk(top_s, top_d, axes, k)
 
 
 def _mesh_xjoin_shard(feats16, flags, docids, jdocids, jpos, dead, qargs,
@@ -1682,11 +1779,9 @@ def _mesh_pruned_shard(feats16, flags, docids, dead, pmax, qargs,
         col_min, col_max, tf_min, tf_max, bound_shift, lang_term,
         norm_coeffs, flag_bits, flag_shifts, domlength_coeff, tf_coeff,
         language_coeff, authority_coeff, language_pref, k=k, b=b)
-    gs = lax.all_gather(run_s, axes, tiled=True)
-    gd = lax.all_gather(run_d, axes, tiled=True)
-    top_s, idx = lax.top_k(gs, min(k, gs.shape[0]))
+    top_s, top_d = all_gather_topk(run_s, run_d, axes, k)
     all_ok = lax.pmin(ok.astype(jnp.int32), axes) > 0
-    return top_s, gd[idx], all_ok
+    return top_s, top_d, all_ok
 
 
 def _mesh_pruned_batch_shard(feats16, flags, docids, dead, pmax, qargs,
@@ -1726,8 +1821,10 @@ def _mesh_pruned_batch_shard(feats16, flags, docids, dead, pmax, qargs,
     gd = lax.all_gather(run_d, axes)
     gs = jnp.moveaxis(gs, 0, 1).reshape(run_s.shape[0], -1)  # [bs, n_dev*k]
     gd = jnp.moveaxis(gd, 0, 1).reshape(run_d.shape[0], -1)
-    top_s, idx = jax.vmap(lambda s: lax.top_k(s, min(k, s.shape[0])))(gs)
-    top_d = jnp.take_along_axis(gd, idx, axis=1)
+    # per-slot tie-pinned merge (the batched form of all_gather_topk):
+    # batched and solo fusion must rank ties identically
+    top_s, top_d = jax.vmap(
+        lambda s, d: tie_topk(s, d, min(k, s.shape[0])))(gs, gd)
     all_ok = lax.pmin(ok.astype(jnp.int32), axes) > 0        # [bs]
     return top_s, top_d, all_ok
 
@@ -1810,11 +1907,14 @@ def _mesh_rank_shard(feats16, flags, docids, starts, counts, dead,
                                    language_pref, fast_div=True, flags=fl)
 
     def merge_topk(run, tile_s, tile_d):
+        # tie-pinned running merge: the per-tile winners fold in under
+        # (score DESC, docid ASC), so the local top-k is EXACT under
+        # ties and the fused gather below can never rank equal-score
+        # candidates by tile-arrival order
         run_s, run_d = run
         s = jnp.concatenate([run_s, tile_s])
         d = jnp.concatenate([run_d, tile_d])
-        top_s, idx = lax.top_k(s, k)
-        return top_s, d[idx]
+        return tie_topk(s, d, k)
 
     init = (jnp.full((k,), NEG_INF32, jnp.int32),
             jnp.full((k,), -1, jnp.int32))
@@ -1826,8 +1926,8 @@ def _mesh_rank_shard(feats16, flags, docids, starts, counts, dead,
         def body(i, run):
             f, fl, dd, v = tile_of(start, count, i)
             sc = score_rows(f, fl, v)
-            tile_s, tile_i = lax.top_k(sc, min(k, tile))
-            return merge_topk(run, tile_s, dd[tile_i])
+            tile_s, tile_d = tie_topk(sc, dd, min(k, tile))
+            return merge_topk(run, tile_s, tile_d)
         return lax.fori_loop(0, n_tiles, body, carry)
 
     run = init
@@ -1835,17 +1935,17 @@ def _mesh_rank_shard(feats16, flags, docids, starts, counts, dead,
         run = span_score(run, s)
     if with_delta:
         sc = score_rows(d_feats16, d_flags, d_v)
-        tile_s, tile_i = lax.top_k(sc, min(k, sc.shape[0]))
-        run = merge_topk(run, tile_s, d_docids[tile_i])
+        tile_s, tile_d = tie_topk(sc, d_docids, min(k, sc.shape[0]))
+        run = merge_topk(run, tile_s, tile_d)
 
-    # candidate fusion across the whole mesh — the TPU replacement of the
-    # reference's per-peer heap-insert merge (SearchEvent.java:444-497).
+    # candidate fusion across the whole mesh — the fused
+    # all-gather+top-k collective (parallel/mesh.py), the TPU
+    # replacement of the reference's per-peer heap-insert merge
+    # (SearchEvent.java:444-497), k rows per device on the wire.
     # With a delta the gathered set holds up to n_devices copies of each
     # delta row (replicated upload); return the WHOLE sorted gather so
     # the host-side dedup still has k unique docids left (the gather is
     # only n_devices*k rows).
-    gs = lax.all_gather(run[0], axes, tiled=True)
-    gd = lax.all_gather(run[1], axes, tiled=True)
-    k_out = gs.shape[0] if with_delta else min(k, gs.shape[0])
-    top_s, idx = lax.top_k(gs, k_out)
-    return top_s, gd[idx]
+    if with_delta:
+        return all_gather_topk_full(run[0], run[1], axes)
+    return all_gather_topk(run[0], run[1], axes, k)

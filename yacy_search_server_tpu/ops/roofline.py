@@ -155,8 +155,10 @@ def roofline_point(kernel: str, cost: Cost, wall_s: float,
         util = 100.0 * ab / peak.bytes_per_s
     else:
         util = 100.0 * af / peak.flops_per_s
+    # 6 decimals: the fusion collectives move a few KiB behind a
+    # multi-device dispatch wall — 3 digits rounds their util to 0.0.
     return RooflinePoint(kernel, cost.flops, cost.bytes, wall_s,
-                         af, ab, cost.intensity, bound, round(util, 3))
+                         af, ab, cost.intensity, bound, round(util, 6))
 
 
 # ---------------------------------------------------------------------------
@@ -467,6 +469,41 @@ def _c_ann_fuse(bs: int, nb: int, dim: int = 256, cap: int = 0,
                 + (dim + 6.0) * cap)
 
 
+# fused all-gather+top-k fusion collective (parallel/mesh.py, ISSUE 12b):
+# each shard ships its exact local top-k — the wire payload is
+# 8 B x k x n_shards (score+docid), never full score rows — and the
+# tie-pinned two-key merge sorts the G = n_shards*k gathered rows.  The
+# XLA model is the empirical CPU fit (exact over k in {16..128} x ndev
+# in {4,8} x rows in {256..4096}; pinned by tests/test_roofline.py):
+# local two-key sort streams ~24 B/row, the gathered merge ~32 B/row,
+# both with the n*log2(n) comparison count a sort costs.
+
+
+def _log2(n: float) -> float:
+    import math
+    return math.log2(max(n, 2.0))
+
+
+def _c_all_gather_topk(k: int, ndev: int, rows: int = 256) -> Cost:
+    g = ndev * k
+    return Cost(flops=1.08 * rows * _log2(rows) + 1.1 * g * _log2(g)
+                + 120.0,
+                bytes=8.0 * rows + 8.0 * g + 8.0 * k,
+                xla_bytes=24.0 * rows + 32.0 * g + 40.0 * k + 80.0)
+
+
+def _c_all_gather_topk_pallas(k: int, ndev: int, rows: int = 256) -> Cost:
+    """Ring remote-DMA variant: per device the ring moves (ndev-1)
+    hops x 8 B x k — same k-scaling payload, expressed as ICI traffic
+    instead of a gather buffer; the merge epilogue is shared with the
+    lax variant so its sort terms are identical."""
+    g = ndev * k
+    return Cost(flops=1.08 * rows * _log2(rows) + 1.1 * g * _log2(g)
+                + 120.0,
+                bytes=8.0 * rows + 8.0 * k * (ndev - 1) + 8.0 * k,
+                xla_bytes=24.0 * rows + 32.0 * g + 40.0 * k + 80.0)
+
+
 def _c_power_iterate(n: int, edges: int, iters: int = 1) -> Cost:
     """BlockRank power iteration (ops/blockrank._power_iterate_sparse):
     per-iteration segment-sum over the edge list, × the trip count (the
@@ -519,6 +556,12 @@ KERNELS: dict[str, object] = {
     # a NumPy oracle in ops/ann.ANN_ORACLES for every _ann_* kernel
     "_ann_assign_batch_kernel": _c_ann_assign,
     "_ann_fuse_batch_packed_kernel": _c_ann_fuse,
+    # fused all-gather+top-k fusion collective (ISSUE 12b): the lax
+    # implementation every mesh fusion site shares, and the Pallas
+    # remote-DMA ring variant for TPU ICI — gathered bytes scale with
+    # k, not corpus rows (the r5 motivation: full score rows shipped)
+    "all_gather_topk": _c_all_gather_topk,
+    "_all_gather_topk_pallas": _c_all_gather_topk_pallas,
 }
 
 # jit-compiled functions that are NOT serving kernels: maintenance

@@ -96,13 +96,154 @@ def pad_to_shards(n: int, shards: int, tile: int = 128) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Fused all-gather + top-k — the candidate-fusion collective (ISSUE 12b)
+# ---------------------------------------------------------------------------
+# The TPU replacement of the reference's per-peer heap-insert merge
+# (SearchEvent.java:444-497), factored out of the shard bodies so every
+# fusion site shares ONE implementation — and ONE tie discipline.  Each
+# shard contributes only its exact local top-k (the meshstore docstring's
+# exactness argument: an exact local top-k per shard makes the gathered
+# merge exact), so the collective moves k rows per shard, never full
+# score rows.  The merge is pinned to (score DESC, docid ASC) — the
+# two-key lax.sort idiom the rerank/ANN family pinned node-locally
+# (arxiv 1807.05798) — so equal-score candidates arriving from
+# DIFFERENT shards (or, through parallel/distributed.py, different OS
+# processes) fuse in one deterministic order instead of gather-position
+# order, which would flap with the mesh layout.
+
+
+def tie_topk(scores, docids, k: int):
+    """Exact top-k of (scores, docids) under (score DESC, docid ASC).
+
+    Two-key ascending sort on (-score, docid); works for int32 cardinal
+    scores and float32 BM25 scores alike (pad rows carry -inf/NEG_INF
+    scores, so they sort last regardless of their docid)."""
+    _sk, _tk, s, d = lax.sort((-scores, docids, scores, docids),
+                              num_keys=2)
+    kk = min(k, s.shape[0])
+    return s[:kk], d[:kk]
+
+
+def all_gather_topk(local_s, local_d, axes, k: int):
+    """Fused candidate-fusion collective, `lax` implementation: gather
+    each shard's (already exact, already tie-ordered) local top-k along
+    `axes` and merge under the pinned tie discipline.  Gathered bytes
+    scale with k·n_shards (8 B per candidate), not with corpus rows —
+    the cost model in ops/roofline.KERNELS counts exactly that."""
+    gs = lax.all_gather(local_s, axes, tiled=True)
+    gd = lax.all_gather(local_d, axes, tiled=True)
+    return tie_topk(gs, gd, k)
+
+
+def all_gather_topk_full(local_s, local_d, axes):
+    """Variant returning the WHOLE tie-ordered gather (no trim): the
+    delta-carrying meshstore path needs every gathered row so host-side
+    dedup still has k unique docids left."""
+    gs = lax.all_gather(local_s, axes, tiled=True)
+    gd = lax.all_gather(local_d, axes, tiled=True)
+    return tie_topk(gs, gd, gs.shape[0])
+
+
+def _all_gather_topk_pallas(local_s, local_d, axis, k: int, ndev: int,
+                            axis_names: tuple = ()):
+    """Pallas remote-DMA variant of the fusion collective for TPU ICI
+    (SNIPPETS [1] / pallas guide "Ring All-Gather"): each device's
+    (k, 2) candidate block rides `make_async_remote_copy` around the
+    ring — double-buffered send/recv slots, DMA semaphores in scratch —
+    and the merge reuses the SAME tie_topk epilogue, so the two
+    implementations cannot diverge on discipline.  Only reachable when
+    the mesh devices are TPU (gate in fused_gather_topk); elsewhere the
+    lax path above is the product path."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def ring_kernel(block_ref, out_ref, comm_ref, send_sem, recv_sem,
+                    *, ndev: int):
+        my_id = lax.axis_index(axis)
+        out_ref[pl.ds(my_id * block_ref.shape[0], block_ref.shape[0])] \
+            = block_ref[:]
+        comm_ref[0] = block_ref[:]
+        for step in range(ndev - 1):
+            src_device = (my_id - step - 1) % ndev
+            dst_device = (my_id + 1) % ndev
+            send_slot = step % 2
+            recv_slot = (step + 1) % 2
+            # full logical mesh coordinates: the fusion axis carries the
+            # ring neighbor, every other axis is size 1 (the dispatch
+            # gate guarantees it), so its coordinate is 0
+            coords = tuple(dst_device if n == axis else 0
+                           for n in (axis_names or (axis,)))
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_ref.at[send_slot],
+                dst_ref=comm_ref.at[recv_slot],
+                send_sem=send_sem.at[send_slot],
+                recv_sem=recv_sem.at[recv_slot],
+                device_id=coords,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()
+            out_ref[pl.ds(src_device * block_ref.shape[0],
+                          block_ref.shape[0])] = comm_ref[recv_slot]
+
+    kk = local_s.shape[0]
+    # scores bit-cast next to docids: ONE (k, 2) int32 block per hop
+    block = jnp.stack(
+        [lax.bitcast_convert_type(local_s.astype(jnp.float32), jnp.int32)
+         if local_s.dtype != jnp.int32 else local_s,
+         local_d], axis=1)
+    gathered = pl.pallas_call(
+        functools.partial(ring_kernel, ndev=ndev),
+        out_shape=jax.ShapeDtypeStruct((ndev * kk, 2), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, kk, 2), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(block)
+    gs = gathered[:, 0] if local_s.dtype == jnp.int32 else \
+        lax.bitcast_convert_type(gathered[:, 0], jnp.float32)
+    return tie_topk(gs, gathered[:, 1], k)
+
+
+def fused_gather_topk(local_s, local_d, axes, k: int,
+                      mesh: Mesh | None = None):
+    """Dispatch the fusion collective — the PRODUCT entry point of the
+    single-axis shard bodies (`_cardinal_shard`, `_bm25_shard`): the
+    Pallas remote-DMA ring when the fusion axis spans a TPU ICI mesh
+    (every other axis size 1, so the ring IS the device ring), the
+    `lax` all-gather everywhere else — CPU meshes, multi-process
+    DCN-backed meshes, and the meshstore's two-axis ('term','doc')
+    fusions, which are lax-by-design (a ring is a one-axis
+    collective)."""
+    use_pallas = (mesh is not None and isinstance(axes, str)
+                  and all(d.platform == "tpu"
+                          for d in mesh.devices.flat)
+                  and mesh.shape[axes] == mesh.devices.size)
+    if use_pallas:
+        try:
+            return _all_gather_topk_pallas(local_s, local_d, axes, k,
+                                           mesh.shape[axes],
+                                           tuple(mesh.axis_names))
+        except Exception:   # pragma: no cover - TPU-only path
+            import logging
+            logging.getLogger("parallel.mesh").exception(
+                "pallas fusion collective failed; lax fallback")
+    return all_gather_topk(local_s, local_d, axes, k)
+
+
+# ---------------------------------------------------------------------------
 # Sharded cardinal ranking (ReferenceOrder.cardinal over the doc axis)
 # ---------------------------------------------------------------------------
 
 def _cardinal_shard(feats, docids, valid, hostids, norm_coeffs, flag_bits,
                     flag_shifts, domlength_coeff, tf_coeff, language_coeff,
                     authority_coeff, language_pref, *, k: int,
-                    num_hosts: int):
+                    num_hosts: int, mesh: Mesh | None = None):
     st = R.local_stats(feats, valid, hostids, num_hosts=num_hosts)
     st = {
         "col_min": lax.pmin(st["col_min"], "doc"),
@@ -115,21 +256,18 @@ def _cardinal_shard(feats, docids, valid, hostids, norm_coeffs, flag_bits,
         feats, valid, hostids, st, norm_coeffs, flag_bits, flag_shifts,
         domlength_coeff, tf_coeff, language_coeff, authority_coeff,
         language_pref)
-    kk = min(k, scores.shape[0])
-    local_s, local_i = lax.top_k(scores, kk)
-    local_d = docids[local_i]
-    # fuse candidates across the doc axis — this all_gather + top_k is the
-    # TPU replacement of the reference's per-peer heap-insert merge
-    gs = lax.all_gather(local_s, "doc", tiled=True)
-    gd = lax.all_gather(local_d, "doc", tiled=True)
-    top_s, top_i = lax.top_k(gs, min(k, gs.shape[0]))
-    return top_s, gd[top_i]
+    # local EXACT top-k under the pinned tie discipline, then the fused
+    # all-gather+top-k collective — k rows per shard cross the
+    # interconnect, the TPU replacement of the reference's per-peer
+    # heap-insert merge (heap semantics: only each peer's best k travel)
+    local_s, local_d = tie_topk(scores, docids, min(k, scores.shape[0]))
+    return fused_gather_topk(local_s, local_d, "doc", k, mesh=mesh)
 
 
 def build_sharded_cardinal(mesh: Mesh, k: int, num_hosts: int):
     """jit-compiled sharded cardinal+top-k over `mesh` ('doc' axis)."""
     fn = shard_map(
-        partial(_cardinal_shard, k=k, num_hosts=num_hosts),
+        partial(_cardinal_shard, k=k, num_hosts=num_hosts, mesh=mesh),
         mesh=mesh,
         in_specs=(PS("doc"), PS("doc"), PS("doc"), PS("doc"),
                   PS(), PS(), PS(), PS(), PS(), PS(), PS(), PS()),
@@ -144,7 +282,7 @@ def build_sharded_cardinal(mesh: Mesh, k: int, num_hosts: int):
 # ---------------------------------------------------------------------------
 
 def _bm25_shard(tf, doclen, df, ndocs, valid, docids, *, k: int,
-                k1: float, b: float):
+                k1: float, b: float, mesh: Mesh | None = None):
     tf = tf.astype(jnp.float32)
     dl = doclen.astype(jnp.float32)
     sum_dl = lax.psum(jnp.sum(jnp.where(valid, dl, 0.0)), "doc")
@@ -156,19 +294,14 @@ def _bm25_shard(tf, doclen, df, ndocs, valid, docids, *, k: int,
         idf[None, :] * tf * (k1 + 1.0) / jnp.maximum(denom, 1e-9), axis=1)
     score = lax.psum(partial_score, "term")
     score = jnp.where(valid, score, -jnp.inf)
-    kk = min(k, score.shape[0])
-    local_s, local_i = lax.top_k(score, kk)
-    local_d = docids[local_i]
-    gs = lax.all_gather(local_s, "doc", tiled=True)
-    gd = lax.all_gather(local_d, "doc", tiled=True)
-    top_s, top_i = lax.top_k(gs, min(k, gs.shape[0]))
-    return top_s, gd[top_i]
+    local_s, local_d = tie_topk(score, docids, min(k, score.shape[0]))
+    return fused_gather_topk(local_s, local_d, "doc", k, mesh=mesh)
 
 
 def build_sharded_bm25(mesh: Mesh, k: int, k1: float = 1.2, b: float = 0.75):
     """jit-compiled sharded BM25+top-k over the ('term','doc') mesh."""
     fn = shard_map(
-        partial(_bm25_shard, k=k, k1=k1, b=b),
+        partial(_bm25_shard, k=k, k1=k1, b=b, mesh=mesh),
         mesh=mesh,
         in_specs=(PS("doc", "term"), PS("doc"), PS("term"), PS(),
                   PS("doc"), PS("doc")),

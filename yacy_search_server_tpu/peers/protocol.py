@@ -255,6 +255,17 @@ class Protocol:
             reply = {**reply, **reply2}
         return True, reply
 
+    # -- multi-process mesh runtime (ISSUE 12) -------------------------------
+
+    def mesh_rpc(self, target: Seed, endpoint: str,
+                 payload: dict) -> tuple[bool, dict]:
+        """One mesh-runtime RPC (meshstep/meshcommit/meshinfo/...):
+        plain `_call` plumbing, so the fleet digest and the active trace
+        id ride the same exchange — the scatter that keeps the SPMD
+        fleet in lockstep IS the gossip the mesh view feeds on."""
+        assert endpoint.startswith("mesh"), endpoint
+        return self._call(target, endpoint, payload)
+
     def fetch_trace(self, target: Seed, trace_id: str) -> tuple[bool, dict]:
         """Cross-peer trace assembly (ISSUE 5): pull the peer's retained
         segment of a trace out of its ring by trace id (server side:

@@ -226,6 +226,89 @@ class PeerServer:
             reply["abstracts"] = abstracts
         return reply
 
+    # -- multi-process mesh runtime (ISSUE 12) -------------------------------
+    # The SPMD fleet's control plane rides the SAME wire servlets as
+    # every other peer RPC (/yacy/meshstep.html etc. over HTTP): the
+    # coordinator's scatter, the go/no-go commit, health/introspection
+    # and — in test fleets only — fault arming.  Handlers delegate to
+    # the process's MeshMember (parallel/distributed.py); a node that
+    # is not a mesh member answers with an error table, never a crash.
+
+    def _mesh_member(self):
+        return getattr(self.sb, "mesh_member", None)
+
+    def do_meshstep(self, payload: dict) -> dict:
+        m = self._mesh_member()
+        if m is None:
+            return {"error": "not a mesh member"}
+        return m.enqueue_step(payload)
+
+    def do_meshcommit(self, payload: dict) -> dict:
+        m = self._mesh_member()
+        if m is None:
+            return {"error": "not a mesh member"}
+        return m.commit_step(payload.get("seq", -1),
+                             bool(payload.get("go", False)))
+
+    def do_meshinfo(self, payload: dict) -> dict:
+        m = self._mesh_member()
+        if m is None:
+            return {"error": "not a mesh member"}
+        return m.info()
+
+    def do_meshsearch(self, payload: dict) -> dict:
+        """External query entry on the coordinator: scatter → collective
+        (or committed host fallback) → fused ranking + the pid of every
+        process that took part (the CI hygiene gate asserts the set
+        spans ≥ 2 OS processes)."""
+        m = self._mesh_member()
+        if m is None:
+            return {"error": "not a mesh member"}
+        if m.process_id != 0:
+            return {"error": "not the coordinator"}
+        term = payload.get("term", "")
+        if not term:
+            from ..utils.hashes import word2hash
+            term = word2hash(str(payload.get("word", ""))).hex()
+        from ..ops.ranking import RankingProfile
+        # validate BEFORE the scatter: a malformed term/profile must be
+        # one rejected request, not a step every member chokes on
+        try:
+            th = bytes.fromhex(term)
+            prof = payload.get("profile") or \
+                RankingProfile().to_external_string()
+            RankingProfile.from_external_string(prof)
+            # per-RPC work clamp (the reference caps every wire request)
+            k = min(max(int(payload.get("k", 10)), 1), 100)
+        except Exception as e:
+            return {"error": f"bad mesh query: {e!r}"}
+        if len(th) != 12:
+            return {"error": f"term hash must be 12 bytes, got {len(th)}"}
+        return m.serve_query(term, prof,
+                             lang=str(payload.get("lang", "en")), k=k)
+
+    def do_meshfault(self, payload: dict) -> dict:
+        """Arm a faultinject point INSIDE this mesh member (the chaos
+        harness's reach into one OS process of the fleet — how the
+        device-loss survival test fails exactly ONE member's transfers).
+        Gated on the YACY_MESH_TESTING env of the MEMBER process: a
+        production fleet never exposes fault arming on the wire."""
+        import os as _os
+
+        from ..utils import faultinject
+        if self._mesh_member() is None or \
+                not _os.environ.get("YACY_MESH_TESTING"):
+            return {"error": "fault arming not enabled"}
+        point = str(payload.get("point", ""))
+        try:
+            if payload.get("clear"):
+                faultinject.clear(point or None)
+            else:
+                faultinject.set_fault(point, payload.get("value"))
+        except KeyError as e:
+            return {"error": str(e)}
+        return {"result": "ok", "pid": _os.getpid()}
+
     # -- cross-peer trace assembly (ISSUE 5) ---------------------------------
 
     def do_tracefetch(self, payload: dict) -> dict:

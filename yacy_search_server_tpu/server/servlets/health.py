@@ -169,6 +169,17 @@ def respond_network_health(header: dict, post: ServerObjects,
     prop.put("digests_received", fl.received_count)
     prop.put("digests_ignored", fl.ignored_count)
 
+    # multi-process mesh identity (ISSUE 12): when this node is a
+    # jax.distributed mesh member, the page heads with the REAL process
+    # grid — its own (process id, pid) plus every peer's from the
+    # gossiped digests below (the peers_N_proc_* columns)
+    mm = getattr(sb, "mesh_member", None)
+    import os as _os
+    prop.put("mesh_member", 1 if mm is not None else 0)
+    prop.put("mesh_process_id", mm.process_id if mm is not None else 0)
+    prop.put("mesh_processes", mm.num_processes if mm is not None else 1)
+    prop.put("mesh_pid", _os.getpid())
+
     rows = fl.peer_rows()
     prop.put("peers", len(rows))
     for i, r in enumerate(rows):
@@ -178,6 +189,10 @@ def respond_network_health(header: dict, post: ServerObjects,
         prop.put(pre + "age_s", r["age_s"])
         prop.put(pre + "seq", r["seq"])
         prop.put(pre + "bytes", r["bytes"])
+        proc = r.get("proc") or {}
+        prop.put(pre + "proc_pid", proc.get("pid", 0))
+        prop.put(pre + "proc_id", proc.get("id", 0))
+        prop.put(pre + "proc_lost", proc.get("lost", 0))
         prop.put(pre + "rtt_ms",
                  round(r["rtt_ms"], 1) if r["rtt_ms"] is not None else "-")
         for fam in fleetmod.DIGEST_FAMILIES:

@@ -10,6 +10,8 @@ EventTracker time-series rendered as a PNG via ProfilingGraph)."""
 
 from __future__ import annotations
 
+import os
+
 from ...utils import histogram, tracing
 from ...utils.eventtracker import EClass, events
 from ...utils.memory import MemoryControl
@@ -558,6 +560,23 @@ def prometheus_text(sb, include_buckets: bool = True,
              "arena epoch (bumps on flush/merge/repack/delete; the "
              "stale-spike health rule reads its churn)")
     p.sample("yacy_device_arena_epoch", c.get("arena_epoch", 0))
+    # -- multi-process mesh identity (ISSUE 12): which OS process this
+    # node is.  Always emitted (pid everywhere; process_id/num_processes
+    # zero-filled off-mesh) so the fleet digest's proc fields resolve on
+    # every node configuration — the coordinator's Network_Health_p
+    # renders the REAL process grid from its peers' digests.
+    mm = getattr(sb, "mesh_member", None)
+    p.family("yacy_mesh_process", "gauge",
+             "multi-process mesh identity: this node's OS pid, its "
+             "jax.distributed process id and the mesh process count "
+             "(0/1 when not a mesh member)")
+    p.sample("yacy_mesh_process", os.getpid(), {"field": "pid"})
+    p.sample("yacy_mesh_process",
+             mm.process_id if mm is not None else 0,
+             {"field": "process_id"})
+    p.sample("yacy_mesh_process",
+             mm.num_processes if mm is not None else 1,
+             {"field": "num_processes"})
     # -- device-loss recovery (ISSUE 10c): always emitted (zeros
     # without a devstore) — the device_loss health rule and the
     # device_rebuild actuator reference these series by exact key

@@ -41,7 +41,11 @@ fsync comments):
   guarded fetch/upload consumes one charge and raises; at zero the
   device "comes back" — which is how the device-loss tests hold the
   tunnel down across the retry ladder and then let the background
-  rebuild succeed (index/devstore.py).
+  rebuild succeed (index/devstore.py).  In a multi-process mesh
+  (ISSUE 12) the same point armed INSIDE one member process — via the
+  ``YACY_FAULTS`` env at spawn or the test-fleet-gated ``meshfault``
+  wire endpoint — fails exactly that member's transfers, driving the
+  one-member-down survival contract (tests/test_mesh_multiproc.py).
 
 Every faultpoint name is declared in :data:`REGISTERED_FAULTPOINTS`;
 the no-dead-faultpoints hygiene gate (tests/test_code_hygiene.py)

@@ -112,6 +112,13 @@ def digest_series(digest: dict) -> dict:
         out["queues.inflight"] = 'yacy_batcher_queue_depth{queue="inflight"}'
     if "epoch" in digest:
         out["epoch"] = "yacy_device_arena_epoch"
+    if "proc" in digest:
+        # multi-process mesh identity (ISSUE 12); zero-filled defaults
+        # on single-process nodes so the series resolve everywhere
+        out["proc.pid"] = 'yacy_mesh_process{field="pid"}'
+        out["proc.id"] = 'yacy_mesh_process{field="process_id"}'
+        out["proc.n"] = 'yacy_mesh_process{field="num_processes"}'
+        out["proc.lost"] = "yacy_device_lost"
     if "tiers" in digest:
         # compact tier occupancy (ISSUE 8): KiB per residency tier +
         # total promotions — the mesh view of who is paging
@@ -216,6 +223,18 @@ class FleetTable:
         ds = getattr(self.sb.index, "devstore", None)
         c = ds.counters() if ds is not None else {}
         b = getattr(ds, "_batcher", None) if ds is not None else None
+        # multi-process mesh identity (ISSUE 12): the digest names the OS
+        # process behind this node — pid always (the CI hygiene gate
+        # asserts distinct pids over the wire), mesh process id / fleet
+        # size when this node is a jax.distributed mesh member, and its
+        # device-lost flag so the coordinator's Network_Health_p renders
+        # a REAL multi-process mesh, not a simulated one
+        mm = getattr(self.sb, "mesh_member", None)
+        import os as _os
+        proc = {"pid": _os.getpid(),
+                "id": mm.process_id if mm is not None else 0,
+                "n": mm.num_processes if mm is not None else 1,
+                "lost": (1 if getattr(ds, "device_lost", False) else 0)}
         digest = {
             "v": DIGEST_VERSION,
             "peer": self.my_hash,
@@ -229,6 +248,7 @@ class FleetTable:
             "queues": {"incoming": b._q.qsize() if b is not None else 0,
                        "inflight": b._inflight.qsize()
                        if b is not None else 0},
+            "proc": proc,
             "epoch": int(c.get("arena_epoch", 0)),
             # tier occupancy in KiB (compact: ~30 B inside the 2 KiB
             # budget) + warm->hot promotions — a peer whose w/c grow
@@ -353,6 +373,8 @@ class FleetTable:
             if isinstance(digest.get("queues"), dict) else {},
             "epoch": digest.get("epoch")
             if isinstance(digest.get("epoch"), int) else 0,
+            "proc": digest.get("proc")
+            if isinstance(digest.get("proc"), dict) else {},
             "recv_mono": time.monotonic(),
             "recv_ts": time.time(),
             "bytes": digest_bytes(digest),
@@ -542,5 +564,6 @@ class FleetTable:
                 "quantiles": quantiles,
                 "queues": e.get("queues", {}),
                 "epoch": e.get("epoch", 0),
+                "proc": e.get("proc", {}),
             })
         return rows
