@@ -23,6 +23,20 @@ Three modes, driven by tests/test_crash_consistency.py:
   fresh dir — the never-crashed baseline the recovered digest must
   equal bit-for-bit.)
 
+ISSUE 13 adds the CONCURRENT-SERVING variants the streaming-ingest
+subsystem is held to:
+
+- ``write_serving`` — ``write`` with a live query thread hammering the
+  read path (term postings + metadata rows) the whole time, so the
+  armed SIGKILL barrier fires under real concurrent serving load, not
+  in a quiet writer-only process.  Prints ``QUERIES n ERRORS m`` when
+  not crashed.
+- ``verify_serving`` — ``verify`` with query threads live WHILE the
+  recovery-time maintenance (the catch-up run merge + a flush) runs:
+  zero acked-doc loss AND zero query errors through the recovery
+  window (a query error here is what the servlet layer would surface
+  as a 500).
+
 Deliberately jax-free: only the storage layer is under test, and the
 harness spawns ~21 interpreters.
 """
@@ -30,6 +44,7 @@ harness spawns ~21 interpreters.
 import hashlib
 import os
 import sys
+import threading
 
 import numpy as np
 
@@ -77,10 +92,18 @@ def _acked(data_dir):
 
 
 def write(data_dir, n_batches, crashpoint_name=None):
+    rwi, meta = _stores(data_dir)
+    _write_batches(rwi, meta, data_dir, n_batches, crashpoint_name)
+    if crashpoint_name:
+        print("NOCRASH")                # armed barrier never reached
+        sys.exit(3)
+    print("DONE")
+
+
+def _write_batches(rwi, meta, data_dir, n_batches, crashpoint_name=None):
     from yacy_search_server_tpu.index.metadata import metadata_from_parsed
     from yacy_search_server_tpu.utils import faultinject
     from yacy_search_server_tpu.utils.hashes import word2hash
-    rwi, meta = _stores(data_dir)
     for batch in range(n_batches):
         if crashpoint_name and batch == n_batches - 1:
             # arm LAST: the first n-1 batches must be real acked state
@@ -99,15 +122,94 @@ def write(data_dir, n_batches, crashpoint_name=None):
     # crash must never lose folded state) and a metadata snapshot
     rwi.merge_runs(max_runs=2)
     meta.snapshot()
+
+
+class _QueryLoop:
+    """A serving read loop over the store under test: every iteration
+    reads one term's full merged postings and one acked doc's metadata
+    row — the exact read path a query servlet drives.  Any exception is
+    counted (and would be a 500 at the servlet layer); the loop itself
+    never dies."""
+
+    def __init__(self, rwi, meta, data_dir):
+        from yacy_search_server_tpu.utils.hashes import word2hash
+        self._rwi, self._meta = rwi, meta
+        self._data_dir = data_dir
+        self._ths = [word2hash(t) for t in TERMS]
+        self._stop = threading.Event()
+        self.queries = 0
+        self.errors = 0
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        i = 0
+        while not self._stop.is_set():
+            try:
+                p = self._rwi.get(self._ths[i % len(self._ths)])
+                assert p is not None
+                acked = _acked(self._data_dir)
+                if acked:
+                    urlhash, _u, _t, _terms = _doc(acked[0], 0)
+                    docid = self._meta.docid(urlhash)
+                    if docid is not None:
+                        self._meta.get(docid)
+            except Exception:
+                self.errors += 1
+            else:
+                self.queries += 1
+            i += 1
+
+    def start(self):
+        self._t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=30)
+
+
+def write_serving(data_dir, n_batches, crashpoint_name=None):
+    """``write`` under live concurrent serving load (ISSUE 13): a query
+    thread reads term postings + metadata the whole time, so the armed
+    SIGKILL barrier fires against a store that is actively answering."""
+    rwi, meta = _stores(data_dir)
+    q = _QueryLoop(rwi, meta, data_dir).start()
+    _write_batches(rwi, meta, data_dir, n_batches, crashpoint_name)
+    q.stop()
     if crashpoint_name:
-        print("NOCRASH")                # armed barrier never reached
+        print("NOCRASH")
         sys.exit(3)
+    print(f"QUERIES {q.queries}")
+    print(f"ERRORS {q.errors}")
+    assert q.errors == 0, "query errors during concurrent write"
     print("DONE")
 
 
+def verify_serving(data_dir):
+    """``verify`` with query threads live through the recovery window
+    (reopen + catch-up merge + flush): zero acked loss AND zero query
+    errors — the 'no query 500s during recovery' contract."""
+    rwi, meta = _stores(data_dir)      # reopen IS the recovery path
+    loops = [_QueryLoop(rwi, meta, data_dir).start() for _ in range(2)]
+    # recovery-time maintenance under the live readers: the catch-up
+    # compaction (what the merge scheduler resubmits after a crash or
+    # a deferral) plus a flush of the (empty) RAM buffer
+    rwi.merge_runs(max_runs=2)
+    rwi.flush()
+    _verify_digest(rwi, meta, data_dir)
+    for q in loops:
+        q.stop()
+    print(f"QUERIES {sum(q.queries for q in loops)}")
+    print(f"ERRORS {sum(q.errors for q in loops)}")
+
+
 def verify(data_dir):
-    from yacy_search_server_tpu.utils.hashes import word2hash
     rwi, meta = _stores(data_dir)
+    _verify_digest(rwi, meta, data_dir)
+
+
+def _verify_digest(rwi, meta, data_dir):
+    from yacy_search_server_tpu.utils.hashes import word2hash
     acked = _acked(data_dir)
     h = hashlib.sha256()
     # (a) full merged postings per term — identical run organizations
@@ -139,8 +241,13 @@ def main():
     if mode == "write":
         write(data_dir, int(sys.argv[3]),
               sys.argv[4] if len(sys.argv) > 4 else None)
+    elif mode == "write_serving":
+        write_serving(data_dir, int(sys.argv[3]),
+                      sys.argv[4] if len(sys.argv) > 4 else None)
     elif mode == "verify":
         verify(data_dir)
+    elif mode == "verify_serving":
+        verify_serving(data_dir)
     else:
         sys.exit(f"unknown mode {mode}")
 

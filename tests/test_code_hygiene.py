@@ -82,6 +82,9 @@ def test_every_device_kernel_has_a_cost_model():
 
     sources = sorted((PKG / "ops").glob("*.py"))
     sources.append(PKG / "index" / "devstore.py")
+    # the streaming-ingest write path (ISSUE 13): any ingest/ jit
+    # kernel without a cost model (or reasoned exemption) fails CI
+    sources.extend(sorted((PKG / "ingest").glob("*.py")))
     missing = []
     for p in sources:
         for name in _named_kernels(p):
@@ -307,6 +310,47 @@ def test_committed_capacity_artifact_carries_required_fields():
             assert k in tc, k
     assert max(r["postings"] for r in rows) >= 50_000_000
     assert "p95_ratio_vs_10m" in cap and "gate_p95_2x" in cap
+
+
+# -- streaming-ingest hygiene (ISSUE 13) -------------------------------------
+# The write path's device kernels are held to the same silicon
+# accounting as the serving kernels: registered BY NAME in
+# roofline.KERNELS (EXEMPT is not acceptable — the device index build
+# is a throughput claim, and an unaccounted kernel cannot state it
+# against the silicon), and the jax import boundary stays inside
+# devbuild so the kill−9 chaos children (dozens of short-lived
+# jax-free interpreters) keep importing the RWI write path cheaply.
+
+INGEST_KERNELS = ("_pack_block_batch_kernel",)
+
+
+def test_ingest_kernels_have_registered_cost_models():
+    from yacy_search_server_tpu.ops import roofline
+
+    found = [name for name in _named_kernels(PKG / "ingest"
+                                             / "devbuild.py")]
+    assert set(INGEST_KERNELS) <= set(found), \
+        "ingest kernels renamed? update INGEST_KERNELS"
+    missing = [k for k in found if k not in roofline.KERNELS
+               and k not in roofline.EXEMPT]
+    assert not missing, (
+        "ingest/ jit kernels without a roofline cost model:\n  "
+        + "\n  ".join(missing))
+    for k in INGEST_KERNELS:
+        assert k in roofline.KERNELS, (
+            f"{k} must be REGISTERED (EXEMPT is not acceptable for "
+            f"the device index build)")
+
+
+def test_ingest_package_stays_jax_free_outside_devbuild():
+    """slo/scheduler (and the package root) must not import jax: the
+    chaos harness imports the RWI write path — and with it ingest.slo —
+    in dozens of short-lived subprocesses."""
+    for rel in ("__init__.py", "slo.py", "scheduler.py"):
+        src = (PKG / "ingest" / rel).read_text(encoding="utf-8")
+        assert not re.search(r"^\s*(import jax|from jax)", src,
+                             re.MULTILINE), \
+            f"ingest/{rel} imports jax (breaks the jax-free contract)"
 
 
 # -- no dead faultpoints (ISSUE 10 satellite) --------------------------------

@@ -99,3 +99,48 @@ def test_every_crashpoint_is_reachable_in_the_harness():
     crashpoint that the harness cannot reach would silently shrink
     coverage; this pins the count instead."""
     assert len(faultinject.CRASHPOINTS) == 7
+
+
+# -- kill−9 under live serving load (ISSUE 13 satellite) ----------------------
+# The write-path crashpoints, re-proven with a query thread live through
+# the kill AND through recovery: the streaming-ingest subsystem's
+# durability contract is "zero acked-doc loss and no query 500s" while
+# the node keeps serving, not in a quiet writer-only process.
+
+SERVING_CRASHPOINTS = ("rwi.flush.before_manifest",
+                       "rwi.manifest.mid_write",
+                       "rwi.merge.before_unlink")
+
+
+def _serving_stats(out: str) -> tuple[int, int]:
+    queries = errors = None
+    for line in out.splitlines():
+        if line.startswith("QUERIES "):
+            queries = int(line.split()[1])
+        elif line.startswith("ERRORS "):
+            errors = int(line.split()[1])
+    assert queries is not None and errors is not None, out
+    return queries, errors
+
+
+@pytest.mark.parametrize("crashpoint", SERVING_CRASHPOINTS)
+def test_kill9_under_live_query_load_no_loss_no_query_errors(
+        crashpoint, tmp_path):
+    crashed = str(tmp_path / "crashed")
+
+    # 1. index under a live query thread + kill at the armed barrier
+    _run(["write_serving", crashed, str(N_BATCHES), crashpoint],
+         expect_kill=True)
+    with open(os.path.join(crashed, "acked.txt")) as f:
+        acked_batches = len(f.read().split())
+    assert acked_batches >= N_BATCHES - 1
+
+    # 2. recover WITH query threads live through the recovery window
+    # (reopen + catch-up merge + flush): zero acked loss, zero query
+    # errors — an error here is what the servlet layer serves as a 500
+    out = _run(["verify_serving", crashed])
+    rec_acked, _d = _digest(out)
+    queries, errors = _serving_stats(out)
+    assert rec_acked == acked_batches, "acked docs lost"
+    assert errors == 0, f"{errors} query error(s) during recovery"
+    assert queries > 0, "query threads never ran during recovery"

@@ -265,6 +265,24 @@ def test_xla_ann_fuse(bs, nb, cap, k):
     _close(c.xla_bytes, by, f"ann_fuse[{bs},{nb},{cap},{k}] bytes")
 
 
+@pytest.mark.parametrize("bs,rows", ((2, 256), (8, 1024), (16, 4096)))
+def test_xla_pack_block_batch(bs, rows):
+    """Device-side index build (ISSUE 13b): the write path's vmapped
+    bit-pack — bs lanes laying rows-row blocks down as scatter-adds
+    over the int32 word stream."""
+    from yacy_search_server_tpu.ingest import devbuild as IB
+    rng = np.random.default_rng(bs * 100 + rows)
+    f16 = rng.integers(-100, 100, (bs, rows, P.NF)).astype(np.int16)
+    fl = rng.integers(0, 1 << 20, (bs, rows)).astype(np.int32)
+    dd = rng.integers(0, 1 << 20, (bs, rows)).astype(np.int32)
+    nv = np.full(bs, rows, np.int32)
+    flops, by = _xla(IB._pack_block_batch_kernel, f16, fl, dd, nv,
+                     rows=rows)
+    c = RF.cost("_pack_block_batch_kernel", bs=bs, rows=rows)
+    _close(c.flops, flops, f"pack_block_batch[{bs},{rows}] flops")
+    _close(c.xla_bytes, by, f"pack_block_batch[{bs},{rows}] bytes")
+
+
 @pytest.mark.parametrize("n,e", ((1024, 8192), (1024, 16384), (2048, 8192)))
 def test_xla_power_iterate_unit_step(n, e):
     from yacy_search_server_tpu.ops import blockrank as B

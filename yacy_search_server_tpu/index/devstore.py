@@ -66,6 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..ingest import slo as ingest_slo
 from ..ops import packed as PK
 from ..ops.ranking import (_ACTIVE_COLS, RankingProfile,
                            cardinal_from_stats, cardinal_from_stats_host,
@@ -3015,6 +3016,18 @@ class DeviceSegmentStore:
         self.tier_evictions_warm_cold = 0
         self.tier_promote_async = 0         # rode the batcher pipeline
         self.tier_promote_failures = 0      # no capacity even after LRU
+        # -- streaming-ingest write path (ISSUE 13) ---------------------
+        # merge/promotion scheduler (ingest/scheduler.py, set by the
+        # switchboard): while the serving SLO burns, promotions PARK in
+        # _deferred_promotes (counted) instead of riding the batcher;
+        # the catch-up resubmits them.  ingest_device_build routes the
+        # packed-run build through the vmapped _pack_block_batch_kernel
+        # (ingest/devbuild.py — bit-identical to the host pack).
+        self.ingest_scheduler = None
+        self.ingest_device_build = False
+        self._deferred_promotes: dict[tuple, object] = {}
+        self.tier_promote_deferred = 0
+        self.ingest_device_builds = 0       # blocks packed on device
         # run path/id -> {termhash: (start, count)}
         self._packed: dict[int, dict[bytes, tuple[int, int]]] = {}
         self._lock = threading.RLock()
@@ -3415,6 +3428,10 @@ class DeviceSegmentStore:
                 if nt > self._max_tcount:
                     self._max_tcount = nt
             track(EClass.INDEX, "devstore_pack", rows)
+        # crawl-to-searchable `ingest.device` tier (ISSUE 13a): the run
+        # is arena-resident — its fresh docs now serve from the device
+        # (no-op for runs without stamps: merges, startup re-packs)
+        ingest_slo.TRACKER.device_packed(run)
 
     # -- compressed residency: pack + tier ladder ----------------------------
 
@@ -3450,6 +3467,49 @@ class DeviceSegmentStore:
         if ntiles > self._max_tcount:
             self._max_tcount = ntiles
 
+    def _build_packed_entries(self, plist: list) -> list:
+        """``[(th, postings)] -> [(th, ent)]`` — the run-granular pack.
+        With ``ingest_device_build`` on (ISSUE 13b) the bit-pack itself
+        is ONE vmapped ``_pack_block_batch_kernel`` dispatch per pow2
+        row bucket (ingest/devbuild.py — bit-identical to the host
+        packer, parity-pinned); otherwise (or on any device failure)
+        the host per-term loop.  Pack-time stats/proxy order stay on
+        host either way: they are cheap column passes, and sharing
+        them keeps the prune layout identical across both builds."""
+        if not plist:
+            return []
+        if not self.ingest_device_build:
+            return [(th, self._build_packed_entry(p)) for th, p in plist]
+        prep = []
+        for th, p in plist:
+            f16, fl = compact_feats(p.feats)
+            stats, proxy = pack_prune_stats(f16, fl)
+            order = np.argsort(-proxy, kind="stable")
+            prep.append((th, p, f16[order], fl[order],
+                         p.docids[order].astype(np.int32), stats,
+                         pmax_table(proxy[order])))
+        try:
+            from ..ingest import devbuild
+            blocks = devbuild.pack_block_batch(
+                [(f, g, d) for _t, _p, f, g, d, _s, _m in prep])
+        except Exception:
+            # a sick device must never fail a flush: host pack stands
+            log.warning("device index build failed; packing on host",
+                        exc_info=True)
+            return [(th, self._build_packed_entry(p)) for th, p in plist]
+        out = []
+        now = time.monotonic()
+        for (th, p, _f, _g, _d, stats, pmax), block in zip(prep, blocks):
+            out.append((th, {"block": block, "stats": stats,
+                             "pmax": pmax, "count": len(p),
+                             "hot": False, "touched": now}))
+            # long-tail stubs under MIN_DEV_ROWS took the host packer
+            # inside pack_block_batch: the counter claims only blocks
+            # the kernel actually laid down
+            if devbuild.MIN_DEV_ROWS <= len(p) <= devbuild.MAX_DEV_ROWS:
+                self.ingest_device_builds += 1
+        return out
+
     def _pack_run_packed(self, run) -> None:
         """Pack a frozen run as bit-packed blocks: device-resident (hot)
         while the shared arena budget holds, host-RAM warm past it —
@@ -3457,7 +3517,13 @@ class DeviceSegmentStore:
         join side-tables are built for packed runs (conjunctions on
         packed terms fall back to the host join and are counted in
         join_fallbacks; the residency policy keeps join-hot deployments
-        on the int16 tier)."""
+        on the int16 tier).
+
+        The block build happens OUTSIDE the store lock (ISSUE 13b):
+        bit-packing a whole run is exactly the flush-path stall the
+        ingest subsystem exists to shrink — serving queries keep
+        ranking while the run packs (its terms host-serve for that
+        window, as they already did before the pack started)."""
         with self._lock:
             rid = id(run)
             if rid in self._packed:
@@ -3467,22 +3533,44 @@ class DeviceSegmentStore:
             if rows == 0:
                 return
             dseq = getattr(run, "dead_seq", -1)
+        plist = []
+        for th in list(run.term_hashes()):
+            p = run.get(th)          # CorruptRunError -> on_run_added
+            if p is None or len(p) == 0:
+                continue
+            plist.append((th, p))
+        ents = self._build_packed_entries(plist)
+        with self._lock:
+            # the run may have been merged away / quarantined while the
+            # blocks were building: never resurrect a retired rid
+            if rid not in self._packed \
+                    or not any(id(r) == rid for r in self.rwi._runs):
+                return
             ent_rows = 0
-            for th in list(run.term_hashes()):
-                p = run.get(th)
-                if p is None or len(p) == 0:
+            for th, ent in ents:
+                if not run.has(th):     # dropped while packing
                     continue
-                ent = self._build_packed_entry(p)
                 ent["dead_seq"] = dseq
                 key = (rid, th)
+                # a cold-tier promotion may have raced the unlocked
+                # build and already placed this term (hot span + block
+                # entry, or a queued promote about to): overwriting it
+                # would orphan the promoted span's arena words with no
+                # garbage accounting — the placed/queued entry wins,
+                # and it is bit-identical by the parity contract
+                if key in self._pblocks or key in self._promote_inflight:
+                    continue
                 if self.arena.packed_would_fit(len(ent["block"].words)):
                     self._place_hot_locked(key, ent, dseq)
                 else:
                     self._warm_bytes += ent["block"].packed_bytes
                 self._pblocks[key] = ent
-                ent_rows += len(p)
+                ent_rows += ent["count"]
             self._enforce_warm_budget_locked()
             track(EClass.INDEX, "devstore_pack_bp", ent_rows)
+        # `ingest.device` tier observation (ISSUE 13a): the run's blocks
+        # are placed (hot or warm) — fresh docs serve from packed blocks
+        ingest_slo.TRACKER.device_packed(run)
 
     def _enforce_warm_budget_locked(self) -> None:
         """Evict the oldest-touched warm blocks past the host-RAM budget
@@ -3625,7 +3713,21 @@ class DeviceSegmentStore:
         """Queue one promotion. With a batcher attached it rides the
         issue→completer pipeline as its own `promote` part kind —
         the device upload overlaps the query waves' tunnel round trips
-        like every other transfer; without one it runs inline."""
+        like every other transfer; without one it runs inline.
+
+        While the merge scheduler defers (ISSUE 13c — the serving SLO
+        is burning), the promotion PARKS instead: the key stays in
+        _promote_inflight (no duplicate submits from later misses),
+        the triggering queries keep host-serving exactly as they
+        already were, and the actuator's catch-up resubmits the parked
+        set when the node recovers."""
+        sched = self.ingest_scheduler
+        if sched is not None and sched.defer_promotions():
+            with self._lock:
+                self._deferred_promotes[key] = run
+                self.tier_promote_deferred += 1
+            sched.note_promote_deferred()
+            return
         b = self._batcher
         if b is not None and not b._stop:
             item = {"kind": "promote", "key": key, "run": run,
@@ -3636,6 +3738,17 @@ class DeviceSegmentStore:
             b._q.put(item)
         else:
             self._promote_now(key, run)
+
+    def resume_promotions(self) -> int:
+        """Catch-up half of the promotion deferral (called by the merge
+        scheduler on the actuator's recovery edge): resubmit every
+        parked promotion; returns how many were resubmitted."""
+        with self._lock:
+            items = list(self._deferred_promotes.items())
+            self._deferred_promotes.clear()
+        for key, run in items:
+            self._submit_promote(key, run)
+        return len(items)
 
     def _promote_now(self, key, run) -> tuple | None:
         """Synchronous promotion body: build/fetch the packed block,

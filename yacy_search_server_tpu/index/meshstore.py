@@ -64,6 +64,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as PS
 
+from ..ingest import slo as ingest_slo
 from ..ops.ranking import (RankingProfile, cardinal_from_stats,
                            compact_feats, local_stats)
 from ..ops.streaming import merge_stats
@@ -734,6 +735,12 @@ class MeshSegmentStore:
             self._packed[rid] = spans
             self._dirty = True
             track(EClass.INDEX, "meshstore_pack", rows)
+        # crawl-to-searchable `ingest.device` tier (ISSUE 13a): the
+        # run's rows are packed into the mesh cells — on a mesh node
+        # this IS the device tier (rwi.flush attaches stamps to every
+        # run; without this pop the bounded run-stamp FIFO would age
+        # every entry out through stamps_dropped on healthy nodes)
+        ingest_slo.TRACKER.device_packed(run)
 
     def on_run_removed(self, run) -> None:
         with self._lock:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -36,6 +37,7 @@ from .colstore import journal_append
 from .integrity import CorruptRunError
 from .pagedrun import PagedRun, TermCache
 from .postings import NF, PostingsList, merge, remove_docids, sort_dedupe
+from ..ingest import slo as ingest_slo
 from ..utils import faultinject
 from ..utils.eventtracker import EClass, update as track
 
@@ -44,6 +46,11 @@ log = logging.getLogger("yacy.rwi")
 # flush threshold, postings count — reference default `wordCacheMaxCount`
 # (defaults/yacy.init:793)
 DEFAULT_MAX_RAM_POSTINGS = 50_000
+
+# bounded-buffer hard cap = factor × the flush threshold (ISSUE 13
+# satellite): past it writers BLOCK (counted) instead of growing the
+# RAM buffer unboundedly between needs_flush() checks
+DEFAULT_BACKPRESSURE_FACTOR = 2.0
 
 # resident-postings budget for the shared paged-run term cache
 DEFAULT_TERM_CACHE_BYTES = 256 << 20
@@ -157,6 +164,13 @@ class RWIIndex:
         self._tombstones: set[int] = set()
         self._dead_arr: np.ndarray | None = None  # cached sorted tombstones
         self._lock = threading.RLock()
+        # bounded-buffer backpressure (ISSUE 13 satellite): hard cap =
+        # backpressure_factor × max_ram_postings; wait_capacity blocks
+        # (counted) past it, _flush_lock makes the flush single-flight
+        # (concurrent writers skip or wait instead of stacking flushes)
+        self.backpressure_factor = DEFAULT_BACKPRESSURE_FACTOR
+        self._flush_lock = threading.Lock()
+        self._capacity = threading.Condition(self._lock)
         self._run_seq = 0
         self._dels = None  # deletion journal: "D <docid>" / "T <termhash> <seq>"
         if data_dir:
@@ -337,6 +351,64 @@ class RWIIndex:
     def needs_flush(self) -> bool:
         return self._ram_count >= self.max_ram_postings
 
+    def hard_max_ram_postings(self) -> int:
+        """The bounded buffer's blocking cap (ISSUE 13 satellite)."""
+        return int(self.max_ram_postings * self.backpressure_factor)
+
+    def wait_capacity(self, timeout_s: float = 30.0) -> float:
+        """Bounded-buffer backpressure: block the calling writer while
+        the RAM buffer sits at/over the hard cap.  The first writer to
+        arrive becomes the flusher (single-flight via _flush_lock);
+        the rest wait on the capacity condition the flush notifies.
+        Every blocked entry is COUNTED and its wall observed into the
+        ``ingest.backpressure`` histogram — the SLO sees backpressure
+        instead of reading a stalled write path as "no traffic".
+        Returns the blocked milliseconds (0.0 on the fast path)."""
+        hard = self.hard_max_ram_postings()
+        if self._ram_count < hard:
+            return 0.0
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
+        while self._ram_count >= hard:
+            if self._flush_lock.acquire(blocking=False):
+                try:
+                    if self._ram_count >= hard:
+                        self.flush()
+                finally:
+                    self._flush_lock.release()
+                break
+            with self._capacity:
+                if self._ram_count < hard:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    # never wedge a writer forever on a stuck flusher:
+                    # the overflow is bounded by what fit before the cap
+                    log.warning("backpressure wait timed out at %d "
+                                "buffered postings", self._ram_count)
+                    break
+                self._capacity.wait(min(remaining, 0.5))
+        blocked_ms = (time.monotonic() - t0) * 1000.0
+        ingest_slo.TRACKER.note_backpressure(blocked_ms)
+        return blocked_ms
+
+    def maybe_flush(self):
+        """Single-flight flush trigger (the write path's call): at most
+        one writer freezes the buffer; concurrent writers return
+        immediately instead of stacking duplicate flushes behind the
+        segment facade (the pre-ISSUE-13 needs_flush()/flush() pair
+        outside the segment lock let every writer start one)."""
+        if not self.needs_flush():
+            return None
+        if not self._flush_lock.acquire(blocking=False):
+            return None          # a flush is already in flight
+        try:
+            if not self.needs_flush():
+                return None
+            return self.flush()
+        finally:
+            self._flush_lock.release()
+
     def flush(self):
         """Freeze the RAM buffer into an immutable run (and persist it).
 
@@ -357,7 +429,16 @@ class RWIIndex:
             n = self._ram_count
             self._ram = {}
             self._ram_count = 0
+            # crawl-to-searchable stamps (ISSUE 13a): claim the entry
+            # stamps whose docs this flush freezes, and wake writers
+            # blocked on the bounded buffer — the buffer just drained
+            stamps = ingest_slo.TRACKER.flush_begin(self)
+            self._capacity.notify_all()
             if not terms:  # only emptied buckets: nothing to persist
+                # every covered doc was deleted before the freeze: the
+                # claimed stamps can never reach the flushed tier —
+                # counted drops, never a silent discard
+                ingest_slo.TRACKER.discard(stamps)
                 return None
             run = FrozenRun(terms, dead_seq=len(self._tombstones))
             # snapshot for the outside-lock write: a concurrent remove_term
@@ -369,12 +450,18 @@ class RWIIndex:
             self._run_seq += 1
             self._runs.append(run)
         out = run
+        # attach the stamps BEFORE the device listener packs the run:
+        # the pack completion observes the ingest.device tier from them
+        ingest_slo.TRACKER.run_pending(run, stamps)
         if self.listener is not None:
             self.listener.on_run_added(run)
         if path:
             paged = PagedRun.write(path, snapshot, self.term_cache,
                                    dead_seq=run.dead_seq)
             out = self._swap_run(run, paged)
+        # the flush covering these docs has returned (durable with a
+        # data dir): the ingest.flushed tier observation
+        ingest_slo.TRACKER.flush_done(stamps)
         track(EClass.WORDCACHE, "flush", n)
         return out
 
@@ -667,6 +754,10 @@ class RWIIndex:
 
     def close(self) -> None:
         self.flush()
+        # drop any stamp state keyed by this instance's id: the tracker
+        # is process-global, and a later RWIIndex allocated at the
+        # freed address must not inherit a dead store's pending stamps
+        ingest_slo.TRACKER.forget(self)
         if self._dels:
             self._dels.close()
             self._dels = None

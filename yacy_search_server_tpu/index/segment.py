@@ -168,9 +168,26 @@ class Segment:
                        collection: str = "user",
                        referrer_urlhash: bytes | None = None,
                        responsetime_ms: int = 0,
-                       httpstatus: int = 200) -> int:
-        """Index one parsed document; returns its docid."""
+                       httpstatus: int = 200,
+                       ingest_stamp: float | None = None) -> int:
+        """Index one parsed document; returns its docid.
+
+        `ingest_stamp` is the crawl-to-searchable SLO's pipeline-entry
+        time (ISSUE 13a): Switchboard.to_indexer stamps it when the
+        crawler hands the response over, and it rides here through the
+        4-stage pipeline.  Direct callers (surrogate importers, tests)
+        get a store-time stamp — the searchable latency they report is
+        their own write wall, honestly small."""
+        from ..ingest import slo as ingest_slo
+        if ingest_stamp is None:
+            ingest_stamp = ingest_slo.TRACKER.stamp()
         with StageTimer(EClass.INDEX, "storeDocument", 1):
+            # bounded-buffer backpressure (ISSUE 13 satellite): a writer
+            # may not outrun the flusher — at the hard cap this blocks
+            # (counted, SLO-visible) until a flush drains the buffer.
+            # BEFORE the segment lock: a blocked writer must not stall
+            # the facade's other writers or the flush thread itself
+            self.rwi.wait_capacity()
             urlhash = url2hash(doc.url)
             # language vote (Segment.java:492): metadata vs statistical
             # detection vs TLD hint — every doc gets its best-known lang
@@ -277,10 +294,21 @@ class Segment:
                 self.dense.put(docid, self.encoder.encode(
                     f"{doc.title}\n{doc.text[:4096]}"))
 
+            # the document is searchable from the RAM buffer: the first
+            # crawl-to-searchable tier observation; the stamp queues for
+            # the flush (-> ingest.flushed) and device pack (-> .device).
+            # A flush racing the microseconds between the last rwi.add
+            # and this registration claims the buffer WITHOUT this
+            # stamp, which then rides the NEXT flush — deliberately
+            # conservative: the flushed/device tiers may overstate by
+            # one flush period in that window, never report a doc
+            # flushed before all its postings froze
+            ingest_slo.TRACKER.note_stored(self.rwi, ingest_stamp)
             # flush outside the segment lock: the compressed run write must
-            # not stall concurrent readers/other writers on this facade
-            if self.rwi.needs_flush():
-                self.rwi.flush()
+            # not stall concurrent readers/other writers on this facade.
+            # Single-flight (ISSUE 13): concurrent writers skip instead
+            # of stacking duplicate flushes
+            self.rwi.maybe_flush()
             return docid
 
     MAX_ANCHOR_TEXTS = 50

@@ -1,0 +1,177 @@
+"""Device-side index build — the bit-pack as a vmapped kernel (ISSUE 13b).
+
+``ops/packed.pack_block`` runs on host NumPy, one term at a time, on the
+flush/merge path — with compressed residency on, packing a fresh run is
+a SERVING STALL: the flush thread grinds per-column min/max + bit-lay
+loops while query dispatches queue behind the store lock.  This module
+moves the lay-down onto the device as ONE vmapped dispatch per pow2 row
+bucket (``_pack_block_batch_kernel``), so fresh runs land pre-packed
+and the pack stall becomes overlappable device work:
+
+- per block (vmap lane): per-column min/max over the valid rows, the
+  minimal bit width via ``lax.clz`` (exact — no float log2), and the
+  little-endian straddle-capable lay-down as a scatter-ADD over the
+  int32 word stream.  Contributions of distinct values occupy disjoint
+  bit ranges within a word, so integer add IS the host packer's OR
+  fold — the output words are bit-identical to ``pack_block``'s, column
+  offsets, widths and minima included (pinned by tests/test_ingest.py
+  over adversarial ranges: all-equal, full int16, negatives, 30-bit
+  flags, ragged counts).
+- 32-bit only: x64 stays disabled.  ``vmax - vmin`` and ``v - vmin``
+  are computed in wrapping int32 and bitcast to uint32 — the true
+  difference mod 2^32, exact because the spread of int32 values fits
+  uint32.  The hi-word shift guards ``s == 0`` exactly like
+  ``ops/packed.unpack_rows_dev`` guards its decode shifts.
+- static shapes: rows bucket to pow2 (>= 256) and the batch pads to
+  pow2 with ``n=0`` lanes, so a steady ingest soak compiles a handful
+  of shapes, not one per flush.
+
+The kernel carries a roofline cost model (``_pack_block_batch_kernel``
+in ops/roofline.KERNELS, XLA-cross-checked by tests/test_roofline.py)
+and the ingest hygiene gate (tests/test_code_hygiene.py scans this
+package) fails any future ingest/ jit kernel without one.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..index import postings as P
+from ..ops import packed as PK
+
+_INT32_MAX = np.int32(2 ** 31 - 1)
+_INT32_MIN = np.int32(-(2 ** 31))
+
+# blocks above this row count pack on host: a transient padded device
+# buffer that big has no business on the wave path (the per-term packs
+# a real flush produces sit far below it; ops/packed handles the tail)
+MAX_DEV_ROWS = 1 << 18
+
+# ... and blocks BELOW this row count pack on host too: the device
+# lay-down pads every lane to >= 256 rows, so a 3-row fresh-term block
+# would ship ~85x padding — more silicon than the host packer's
+# microseconds cost anywhere, and on a CPU backend the waste lands on
+# the very core that is serving.  The device build is for RUN-SCALE
+# blocks (seed ingests, merges, hot fresh terms), not long-tail stubs.
+MIN_DEV_ROWS = 64
+
+
+def rows_bucket(n: int) -> int:
+    """Static pow2 row bucket (>= 256) for one block — bounded compile
+    shapes, like ops/dense.rerank_bucket / ops/ann.ann_lane_bucket."""
+    return 1 << max(8, (max(n, 1) - 1).bit_length())
+
+
+@functools.partial(jax.jit, static_argnames=("rows",))
+def _pack_block_batch_kernel(f16, fl, dd, n, *, rows: int):
+    """Bit-pack ``B`` posting blocks in one dispatch.
+
+    f16: int16 [B, rows, NF]   feats (proxy-ordered, like pack_block's)
+    fl:  int32 [B, rows]       flags
+    dd:  int32 [B, rows]       docids
+    n:   int32 [B]             valid rows per block (rest is padding)
+
+    Returns (words int32 [B, rows * NCOLS], meta int32 [B, META_LEN],
+    total_words int32 [B]) — ``words[b, :total_words[b]]`` plus the
+    meta (offs ++ widths ++ mins) reconstruct a PackedBlock
+    bit-identical to ``ops/packed.pack_block`` on the same rows.
+    """
+
+    def one(f16b, flb, ddb, nb):
+        i = jnp.arange(rows, dtype=jnp.int32)
+        valid = i < nb
+        cols = [f16b[:, c].astype(jnp.int32) for c in range(P.NF)]
+        cols.append(flb)
+        cols.append(ddb)
+        words = jnp.zeros(rows * PK.NCOLS, jnp.uint32)
+        off = jnp.int32(0)
+        offs, widths, mins = [], [], []
+        for c in range(PK.NCOLS):
+            v = cols[c]
+            vmin = jnp.min(jnp.where(valid, v, _INT32_MAX))
+            vmax = jnp.max(jnp.where(valid, v, _INT32_MIN))
+            # empty lane (batch padding): the host packer's n=0 shape
+            vmin = jnp.where(nb > 0, vmin, jnp.int32(0))
+            vmax = jnp.where(nb > 0, vmax, jnp.int32(0))
+            # true spread mod 2^32 (wrapping int32 subtract, bitcast):
+            # exact — an int32 column's spread always fits uint32
+            d = lax.bitcast_convert_type(vmax - vmin, jnp.uint32)
+            w = jnp.maximum(jnp.int32(1),
+                            jnp.int32(32) - lax.clz(d).astype(jnp.int32))
+            voff = lax.bitcast_convert_type(v - vmin, jnp.uint32)
+            voff = jnp.where(valid, voff, jnp.uint32(0))
+            wu = w.astype(jnp.uint32)
+            bit = i.astype(jnp.uint32) * wu
+            wi = (bit >> 5).astype(jnp.int32) + off
+            s = bit & jnp.uint32(31)
+            lo = voff << s                 # uint32 wrap = the lo word
+            # s == 0: value sits entirely in lo; the >> (32-s) arm is
+            # undefined-shift territory, guarded like unpack_rows_dev
+            sh = jnp.where(s == jnp.uint32(0), jnp.uint32(1),
+                           jnp.uint32(32) - s)
+            hi = jnp.where(s == jnp.uint32(0), jnp.uint32(0),
+                           voff >> sh)
+            # disjoint bit ranges per value => add == the host OR fold;
+            # padded lanes contribute zeros, mode="drop" guards the
+            # one-past-the-end straddle of the final word
+            words = words.at[wi].add(lo, mode="drop")
+            words = words.at[wi + 1].add(hi, mode="drop")
+            offs.append(off)
+            widths.append(w)
+            mins.append(vmin)
+            off = off + ((nb * w + 31) >> 5)   # word-aligned next column
+        meta = jnp.concatenate(
+            [jnp.stack(offs), jnp.stack(widths), jnp.stack(mins)])
+        return lax.bitcast_convert_type(words, jnp.int32), meta, off
+
+    return jax.vmap(one)(f16, fl, dd, n)
+
+
+def pack_block_batch(parts) -> list:
+    """Pack ``[(feats16, flags, docids), ...]`` into PackedBlocks via
+    the device kernel — one dispatch per pow2 row bucket, batch padded
+    to pow2 with empty lanes (bounded compile shapes).  Output order
+    matches input order; every block is bit-identical to
+    ``ops/packed.pack_block`` on the same rows (the parity contract).
+    Blocks outside [``MIN_DEV_ROWS``, ``MAX_DEV_ROWS``] take the host
+    packer (empty, long-tail stubs, and oversize runs)."""
+    out: list = [None] * len(parts)
+    groups: dict[int, list] = {}
+    for idx, (f16, fl, dd) in enumerate(parts):
+        nrows = len(dd)
+        if not MIN_DEV_ROWS <= nrows <= MAX_DEV_ROWS:
+            out[idx] = PK.pack_block(f16, fl, dd)
+        else:
+            groups.setdefault(rows_bucket(nrows), []).append(idx)
+    for rows, idxs in sorted(groups.items()):
+        bpad = 1 << max(0, (len(idxs) - 1).bit_length())
+        f16 = np.zeros((bpad, rows, P.NF), np.int16)
+        fl = np.zeros((bpad, rows), np.int32)
+        dd = np.zeros((bpad, rows), np.int32)
+        n = np.zeros(bpad, np.int32)
+        for j, idx in enumerate(idxs):
+            bf, bl, bd = parts[idx]
+            m = len(bd)
+            f16[j, :m] = bf
+            fl[j, :m] = bl
+            dd[j, :m] = bd
+            n[j] = m
+        words, meta, totals = _pack_block_batch_kernel(f16, fl, dd, n,
+                                                       rows=rows)
+        words = np.asarray(words)
+        meta = np.asarray(meta)
+        totals = np.asarray(totals)
+        for j, idx in enumerate(idxs):
+            m = meta[j]
+            out[idx] = PK.PackedBlock(
+                words=words[j, :int(totals[j])].copy(),
+                count=int(n[j]),
+                word_offs=m[:PK.NCOLS].copy(),
+                widths=m[PK.NCOLS:2 * PK.NCOLS].copy(),
+                mins=m[2 * PK.NCOLS:].copy())
+    return out

@@ -1,0 +1,210 @@
+"""Crawl-to-searchable SLO — the write path's latency contract (ISSUE 13a).
+
+A crawler-indexer's freshness promise is a LATENCY, not a throughput:
+how long after the crawler hands a document to the pipeline can a query
+actually find it?  Until now nothing measured that wall — flush and
+merge timing were ad hoc side effects of buffer thresholds, invisible
+to the health engine.  This module stamps every document at pipeline
+entry and propagates the stamp through the write path's tiers:
+
+- ``ingest.searchable`` — entry → ``Segment.store_document`` returned:
+  the document answers queries from the RWI RAM buffer (first serve).
+- ``ingest.flushed``    — entry → the RWI flush covering it returned:
+  the postings are an immutable (and, with a data dir, durable) run.
+- ``ingest.device``     — entry → the devstore packed the run's blocks:
+  the document serves from the device tier, not the host path.
+- ``ingest.backpressure`` — wall a writer spent blocked in the bounded
+  RAM buffer (``RWIIndex.wait_capacity``, ISSUE 13 satellite): the SLO
+  must SEE backpressure, or a stalled write path reads as "no traffic".
+
+All four are windowed histogram families (utils/histogram.py CANONICAL,
+so ``/metrics`` exports them on every node and the
+``ingest_slo_searchable`` health rule's series always resolve).  The
+tracker is process-global like the histogram registry it feeds; stamps
+are monotonic-clock floats carried by value (IndexingEntry field /
+``store_document(ingest_stamp=...)``), so the pipeline's decoupled
+worker threads need no contextvar plumbing.
+
+Bounds: pending-stamp lists are capped (an ingest burst past the cap
+drops stamps with a counter, never memory), and per-run stamp
+attachments live in a bounded FIFO — a run that never reaches the
+device tier ages out instead of leaking.
+
+Jax-free by contract (see the package docstring): the kill−9 chaos
+children import the RWI write path, and with it this module, in
+dozens of short-lived interpreters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+from ..utils import histogram
+
+# one family per write-path tier (+ the backpressure wall); registered
+# in histogram.CANONICAL so the exposition and the health rule always
+# resolve them, and prefixed "ingest." so they never decide a SERVING
+# latency verdict (histogram.BACKGROUND_PREFIXES)
+FAMILIES = {
+    "ingest.searchable": "crawl-to-searchable: pipeline entry -> doc "
+                         "servable from the RWI RAM buffer",
+    "ingest.flushed": "pipeline entry -> RWI flush covering the doc "
+                      "returned (immutable/durable run)",
+    "ingest.device": "pipeline entry -> run bit-packed onto the device "
+                     "tier (serves from placed blocks)",
+    "ingest.backpressure": "writer wall blocked in the bounded RWI RAM "
+                           "buffer (counted backpressure)",
+}
+
+# bounds: stamps a burst may queue per RWI before drops are counted,
+# how many flushed runs may await their device pack concurrently, and
+# how many distinct RWI instances may hold pending stamps at once (a
+# process owns a handful of segments; churny short-lived stores — test
+# suites, rebuilds — age out oldest-first instead of leaking)
+MAX_PENDING_STAMPS = 500_000
+MAX_PENDING_RUNS = 128
+MAX_PENDING_RWIS = 64
+
+
+class IngestTracker:
+    """Process-global stamp registry: pipeline entry times keyed by the
+    RWI (pre-flush) and by the frozen run (pre-device-pack)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # id(rwi) -> [entry stamps whose docs sit in the RAM buffer]
+        self._pending: dict[int, list] = {}
+        # id(frozen run) -> [entry stamps], bounded FIFO
+        self._run_stamps: "OrderedDict[int, list]" = OrderedDict()
+        self.docs_stamped = 0
+        self.docs_searchable = 0
+        self.docs_flushed = 0
+        self.docs_device = 0
+        self.stamps_dropped = 0
+        self.backpressure_waits = 0
+        self.backpressure_wait_ms = 0.0
+
+    # -- stamping ------------------------------------------------------------
+
+    @staticmethod
+    def stamp() -> float:
+        """A pipeline-entry stamp (monotonic seconds; carried by value
+        on the IndexingEntry / store_document call)."""
+        return time.monotonic()
+
+    def note_stored(self, rwi, t_entry: float) -> None:
+        """The document is searchable (RAM-buffer tier): observe
+        entry→now and queue the stamp for the flush covering it."""
+        now = time.monotonic()
+        histogram.observe("ingest.searchable",
+                          max(0.0, (now - t_entry) * 1000.0))
+        with self._lock:
+            self.docs_stamped += 1
+            self.docs_searchable += 1
+            pend = self._pending.setdefault(id(rwi), [])
+            if len(pend) >= MAX_PENDING_STAMPS:
+                self.stamps_dropped += 1
+            else:
+                pend.append(t_entry)
+            while len(self._pending) > MAX_PENDING_RWIS:
+                # a discarded-without-close store must not leak its
+                # stamp list forever (dicts iterate insertion-first =
+                # oldest RWI first; the evicted stamps are counted;
+                # never evict the live writer's own list)
+                old = next(k for k in self._pending if k != id(rwi))
+                self.stamps_dropped += len(self._pending.pop(old))
+
+    def forget(self, rwi) -> None:
+        """Drop all stamp state keyed by this RWI (its close() hook):
+        CPython reuses addresses, and a successor allocated at the
+        freed id must not inherit a dead store's pending stamps."""
+        with self._lock:
+            self._pending.pop(id(rwi), None)
+
+    def discard(self, stamps: list) -> None:
+        """Claimed stamps whose flush will never complete (e.g. every
+        covered doc was deleted before the freeze): counted drops, per
+        the never-silent contract."""
+        if not stamps:
+            return
+        with self._lock:
+            self.stamps_dropped += len(stamps)
+
+    # -- flush propagation ---------------------------------------------------
+
+    def flush_begin(self, rwi) -> list:
+        """Atomically claim the stamps whose docs the flush is freezing
+        (called under the RWI lock, where the RAM buffer is swapped)."""
+        with self._lock:
+            return self._pending.pop(id(rwi), [])
+
+    def run_pending(self, run, stamps: list) -> None:
+        """Attach claimed stamps to the frozen run BEFORE the device
+        listener packs it, so the pack completion can observe the
+        device tier (bounded: oldest attachments age out)."""
+        if not stamps:
+            return
+        with self._lock:
+            self._run_stamps[id(run)] = stamps
+            while len(self._run_stamps) > MAX_PENDING_RUNS:
+                _, old = self._run_stamps.popitem(last=False)
+                self.stamps_dropped += len(old)
+
+    def flush_done(self, stamps: list) -> None:
+        """The flush covering these stamps returned: the postings are
+        an immutable (durable, with a data dir) run."""
+        if not stamps:
+            return
+        now = time.monotonic()
+        for t in stamps:
+            histogram.observe("ingest.flushed",
+                              max(0.0, (now - t) * 1000.0))
+        with self._lock:
+            self.docs_flushed += len(stamps)
+
+    def device_packed(self, run) -> None:
+        """The devstore packed this run's blocks: its documents serve
+        from the device tier (no-op for runs without stamps — merges,
+        surrogate bulk ingests, startup re-packs)."""
+        with self._lock:
+            stamps = self._run_stamps.pop(id(run), None)
+        if not stamps:
+            return
+        now = time.monotonic()
+        for t in stamps:
+            histogram.observe("ingest.device",
+                              max(0.0, (now - t) * 1000.0))
+        with self._lock:
+            self.docs_device += len(stamps)
+
+    # -- backpressure (ISSUE 13 satellite) -----------------------------------
+
+    def note_backpressure(self, blocked_ms: float) -> None:
+        """One counted blocking wait in the bounded RAM buffer — the
+        stamp the SLO sees (the blocked wall also lands inside the
+        doc's own crawl-to-searchable latency, by construction)."""
+        histogram.observe("ingest.backpressure", max(0.0, blocked_ms))
+        with self._lock:
+            self.backpressure_waits += 1
+            self.backpressure_wait_ms += blocked_ms
+
+    # -- observability -------------------------------------------------------
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "docs_stamped": self.docs_stamped,
+                "docs_searchable": self.docs_searchable,
+                "docs_flushed": self.docs_flushed,
+                "docs_device": self.docs_device,
+                "stamps_dropped": self.stamps_dropped,
+                "backpressure_waits": self.backpressure_waits,
+                "backpressure_wait_ms": round(self.backpressure_wait_ms,
+                                              3),
+            }
+
+
+# THE tracker (process-global, like the histogram registry it feeds)
+TRACKER = IngestTracker()

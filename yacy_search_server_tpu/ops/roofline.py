@@ -432,6 +432,40 @@ def _c_rank_scan_batch_bp(rows: int, k: int = 16, bs: int = 1,
                 + _SCAN_BP_XBYTES_PW * pw_cap + 2 * doc_cap)
 
 
+# device-side index build (ingest/devbuild.py, ISSUE 13b): the vmapped
+# bit-pack of B posting blocks.  Per value: min/max reduce share, width
+# derivation, offset/shift math and the two scatter-add lanes — ~43.5
+# flops/value × NCOLS values/row ≈ 826 flops/row, plus per-ROW reduce
+# setup XLA amortizes across lanes (76/row) and per-LANE meta/clz work
+# (5277/lane).  XLA bytes: the int16+int32 operand reads and the uint32
+# word-stream carried through 2·NCOLS scatter fusions (1165.5 B/row)
+# plus the per-lane meta build (4718 B/lane).  Both fits <1% over bs in
+# {2..16} × rows in {256..4096} (jax 0.4.x CPU); pinned by
+# tests/test_roofline.py.  Compulsory traffic: the block rows once in
+# (ROW_BYTES + 8) and the PACKED payload out (row_bits/8 per row) —
+# the same accounting the *_bp scorers state their reads in.
+_PACK_FLOPS_ROW = 826.0
+_PACK_FLOPS_ROWS = 76.0
+_PACK_FLOPS_LANE = 5277.0
+_PACK_FLOPS_CONST = 418.0
+_PACK_XBYTES_ROW = 1165.5
+_PACK_XBYTES_LANE = 4718.0
+_PACK_XBYTES_CONST = 6474.0
+
+
+def _c_pack_block_batch(bs: int, rows: int,
+                        row_bits: float = 160.0) -> Cost:
+    """_pack_block_batch_kernel: bs vmap lanes bit-packing rows-row
+    blocks (ingest device build)."""
+    n = bs * rows
+    return Cost(flops=_PACK_FLOPS_ROW * n + _PACK_FLOPS_ROWS * rows
+                + _PACK_FLOPS_LANE * bs + _PACK_FLOPS_CONST,
+                bytes=(ROW_BYTES + 8) * n + (row_bits / 8.0) * n
+                + 4.0 * (3 * (P.NF + 2) + 1) * bs,
+                xla_bytes=_PACK_XBYTES_ROW * n + _PACK_XBYTES_LANE * bs
+                + _PACK_XBYTES_CONST)
+
+
 # dense-first IVF ANN family (ops/ann.py, ISSUE 11).  Assignment is
 # the (B,dim)×(dim,C) bf16 matmul (+ per-element top-k overhead XLA
 # counts as 2·dim·(C+bs)); fuse is per-lane work (int8 gather + dequant
@@ -556,6 +590,10 @@ KERNELS: dict[str, object] = {
     # a NumPy oracle in ops/ann.ANN_ORACLES for every _ann_* kernel
     "_ann_assign_batch_kernel": _c_ann_assign,
     "_ann_fuse_batch_packed_kernel": _c_ann_fuse,
+    # device-side index build (ISSUE 13b): the write path's vmapped
+    # bit-pack — fresh runs land pre-packed, parity-pinned bit-identical
+    # to ops/packed.pack_block (tests/test_ingest.py)
+    "_pack_block_batch_kernel": _c_pack_block_batch,
     # fused all-gather+top-k fusion collective (ISSUE 12b): the lax
     # implementation every mesh fusion site shares, and the Pallas
     # remote-DMA ring variant for TPU ICI — gathered bytes scale with

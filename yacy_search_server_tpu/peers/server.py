@@ -347,6 +347,19 @@ class PeerServer:
         meta = self.sb.index.metadata
         unknown: set[bytes] = set()
         received = 0
+        # bounded-buffer backpressure (ISSUE 13): a DHT writer is held
+        # to the same hard cap as the local indexer, but a peer handler
+        # thread is not a crawler thread — it waits only briefly
+        # (counted into the ingest.backpressure SLO) and then SHEDS
+        # with the protocol's own busy/pause reply; the sender retries
+        # after `pause`.  A full-wall wait here would pin the peer
+        # server's handler threads (search scatter, digests) behind a
+        # slow flush.  One admitted call's overflow is bounded by
+        # MAX_RWI_ENTRIES_PER_CALL.
+        if rwi.ram_postings_count >= rwi.hard_max_ram_postings():
+            rwi.wait_capacity(timeout_s=2.0)
+            if rwi.ram_postings_count >= rwi.hard_max_ram_postings():
+                return {"result": "busy", "unknownURL": [], "pause": 60}
         entries = payload.get("entries", [])[:MAX_RWI_ENTRIES_PER_CALL]
         for entry in entries:
             th = entry.get("term", "").encode("ascii")
@@ -368,8 +381,9 @@ class PeerServer:
                 rwi.add(th, docid, feats[i])
                 received += 1
         self.received_rwi_count += received
-        if rwi.needs_flush():
-            rwi.flush()
+        # single-flight (ISSUE 13): a transfer racing the indexer's
+        # flush skips instead of stacking a duplicate one
+        rwi.maybe_flush()
         return {"result": "ok", "received": received,
                 "unknownURL": [u.decode("ascii") for u in unknown],
                 "pause": 0}

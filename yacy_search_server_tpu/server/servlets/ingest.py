@@ -1,0 +1,98 @@
+"""Performance_Ingest_p — the write path's operator panel (ISSUE 13).
+
+The read path has DeviceStore_p / Performance_Health_p; until now the
+write path (crawl → parse → RWI flush → device pack → merge) had no
+surface at all — flush and merge timing were invisible side effects of
+buffer thresholds.  This panel renders the crawl-to-searchable SLO per
+tier (windowed p50/p95/p99 + sparkline for ``ingest.searchable`` /
+``.flushed`` / ``.device`` and the ``.backpressure`` wall), the ingest
+tracker's doc counters, the merge/promotion scheduler's deferral state
+(with the parked-promotion count and the pending merge ask), the
+``ingest_slo_searchable`` rule verdict, and the ``merge_scheduler``
+actuator's recent breadcrumbs — the whole defend-the-SLO loop on one
+page, next to the freshness it protects."""
+
+from __future__ import annotations
+
+import time
+
+from ...ingest import slo as ingest_slo
+from ...utils import histogram
+from ..objects import ServerObjects, escape_json
+from . import servlet
+from .health import _sparkline
+
+# panel order: the SLO tiers first, then the wall that explains them
+_FAMILIES = ("ingest.searchable", "ingest.flushed", "ingest.device",
+             "ingest.backpressure")
+
+
+@servlet("Performance_Ingest_p")
+def respond_ingest(header: dict, post: ServerObjects,
+                   sb) -> ServerObjects:
+    prop = ServerObjects()
+    eng = getattr(sb, "health", None)
+    if post.get("tick", "") == "1" and eng is not None:
+        eng.tick()
+
+    prop.put("families", len(_FAMILIES))
+    for i, fam in enumerate(_FAMILIES):
+        pre = f"families_{i}_"
+        h = histogram.get(fam)
+        counts = h.windowed_counts() if h is not None else []
+        prop.put(pre + "name", escape_json(fam))
+        prop.put(pre + "help", escape_json(h.help if h else ""))
+        prop.put(pre + "window_count", sum(counts))
+        prop.put(pre + "total_count", h.count if h is not None else 0)
+        for lbl, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            prop.put(pre + lbl + "_ms", round(
+                histogram.percentile_from_counts(counts, q)
+                if counts else 0.0, 3))
+        prop.put(pre + "spark", _sparkline(counts))
+
+    for k, v in ingest_slo.TRACKER.counters().items():
+        prop.put(f"tracker_{k}", v)
+
+    sched = getattr(sb, "ingest_scheduler", None)
+    prop.put("scheduler", 1 if sched is not None else 0)
+    if sched is not None:
+        for k, v in sched.counters().items():
+            prop.put(f"scheduler_{k}", v)
+        pend = sched.pending_merge()
+        prop.put("scheduler_pending_max_runs",
+                 pend if pend is not None else "-")
+        prop.put("scheduler_defer_age_s",
+                 round(time.monotonic() - sched.defer_since, 1)
+                 if sched.deferred and sched.defer_since else 0.0)
+
+    ds = getattr(sb.index, "devstore", None)
+    prop.put("device_builds",
+             getattr(ds, "ingest_device_builds", 0) if ds else 0)
+    prop.put("device_build_enabled",
+             1 if getattr(ds, "ingest_device_build", False) else 0)
+
+    # the freshness verdict + the actuator's trail, same rendering as
+    # Performance_Health_p so operators read one idiom everywhere
+    now = time.time()
+    st = eng.states.get("ingest_slo_searchable") if eng is not None \
+        else None
+    prop.put("rule_state", st.state if st is not None else "ok")
+    prop.put("rule_cause", escape_json(st.cause) if st is not None
+             else "")
+    prop.put("rule_since_s",
+             round(now - st.since, 1) if st is not None and st.since
+             else 0.0)
+    prop.put("rule_evidence", escape_json(" ".join(
+        f"{k}={v}" for k, v in st.evidence.items()))
+        if st is not None else "")
+
+    act = getattr(sb, "actuators", None)
+    crumbs = [c for c in (act.recent_breadcrumbs(64) if act else [])
+              if c.get("actuator") == "merge_scheduler"][-16:]
+    prop.put("breadcrumbs", len(crumbs))
+    for i, c in enumerate(reversed(crumbs)):
+        pre = f"breadcrumbs_{i}_"
+        prop.put(pre + "time", int(c.get("ts", 0)))
+        prop.put(pre + "dir", escape_json(c.get("dir", "")))
+        prop.put(pre + "cause", escape_json(c.get("cause", "")))
+    return prop

@@ -820,6 +820,37 @@ def prometheus_text(sb, include_buckets: bool = True,
     for param in ("dispatchers", "completer_depth"):
         p.sample("yacy_batcher_tuning", tun.get(param, 0),
                  {"param": param})
+    # -- streaming-ingest write path (ISSUE 13): crawl-to-searchable
+    # doc counts per tier, backpressure waits, and the merge/promotion
+    # scheduler's deferral bookkeeping.  Always emitted (the tracker is
+    # process-global; scheduler counters zero-fill without one) so the
+    # ingest_slo_searchable rule and the merge_scheduler actuator
+    # resolve on every node configuration.  The latency tiers
+    # themselves ride the ingest.* histogram families above.
+    from ...ingest import slo as ingest_slo
+    ic = dict(ingest_slo.TRACKER.counters())
+    sched = getattr(sb, "ingest_scheduler", None)
+    sc = sched.counters() if sched is not None else {}
+    p.family("yacy_ingest_total", "counter",
+             "write-path counters: docs stamped/searchable/flushed/"
+             "device per crawl-to-searchable tier, dropped stamps, "
+             "counted backpressure waits, and the merge/promotion "
+             "scheduler's deferrals + catch-ups")
+    for key in ("docs_stamped", "docs_searchable", "docs_flushed",
+                "docs_device", "stamps_dropped", "backpressure_waits"):
+        p.sample("yacy_ingest_total", ic.get(key, 0), {"counter": key})
+    for key in ("merge_deferrals", "promote_deferrals",
+                "merge_catch_ups", "catch_up_merges",
+                "catch_up_promotions"):
+        p.sample("yacy_ingest_total", sc.get(key, 0), {"counter": key})
+    p.family("yacy_ingest_deferred", "gauge",
+             "1 while the merge/promotion scheduler is deferring "
+             "(serving SLO burning), else 0")
+    p.sample("yacy_ingest_deferred", sc.get("deferred", 0))
+    p.family("yacy_ingest_deferred_promotions", "gauge",
+             "tier promotions currently parked by the deferral")
+    p.sample("yacy_ingest_deferred_promotions",
+             sc.get("deferred_promotions_parked", 0))
     p.family("yacy_remotesearch_peers_total", "counter",
              "remote-search peer decisions (asked / skipped_sick / "
              "adaptive_timeout) — attributes every fleet-driven skip")

@@ -60,6 +60,9 @@ class IndexingEntry:
     # run on decoupled worker threads, so the context travels on the
     # work item, not the contextvar
     trace: object = None
+    # crawl-to-searchable SLO stamp (ISSUE 13a): pipeline-entry time,
+    # carried by value for the same decoupled-thread reason
+    ingest_stamp: float = 0.0
 
 
 class Switchboard:
@@ -294,6 +297,24 @@ class Switchboard:
         from .utils.actuator import ActuatorEngine
         self.actuators = ActuatorEngine(self)
 
+        # streaming-ingest write path (ISSUE 13): the merge/promotion
+        # scheduler the `merge_scheduler` actuator drives — compactions
+        # and tier promotions defer while the serving SLO burns, catch
+        # up when the node is healthy again.  The devstore consults it
+        # on every promotion submit; the cleanup job's merge path routes
+        # through it.
+        from .ingest.scheduler import MergeScheduler
+        self.ingest_scheduler = MergeScheduler(self)
+        if self.index.devstore is not None:
+            self.index.devstore.ingest_scheduler = self.ingest_scheduler
+            # device-side index build (ISSUE 13b): bit-pack fresh runs
+            # as ONE vmapped dispatch per row bucket instead of the
+            # host per-term loop (bit-identical; parity-pinned).  Off
+            # by default on host-only backends — the win is moving the
+            # pack onto an accelerator, not re-buying it on the CPU.
+            self.index.devstore.ingest_device_build = \
+                self.config.get_bool("ingest.deviceBuild", False)
+
         # data-store migrations: rows written by an older release are
         # upgraded in place once, tracked by the STORE_VERSION marker in
         # the data dir (reference: migration.java version-gated rewrites,
@@ -426,6 +447,12 @@ class Switchboard:
                 response.request.urlhash(), response.url, reason)
             return
         entry = IndexingEntry(response, profile)
+        # crawl-to-searchable SLO (ISSUE 13a): the clock starts HERE,
+        # where the crawler hands the document to the pipeline — every
+        # stage wall, the store, the flush and the device pack all land
+        # inside this one latency
+        from .ingest import slo as ingest_slo
+        entry.ingest_stamp = ingest_slo.TRACKER.stamp()
         every = self.config.get_int("tracing.pipelineSampleEvery", 16)
         seq = self._pipeline_seq
         self._pipeline_seq = seq + 1
@@ -502,7 +529,8 @@ class Switchboard:
                     referrer_urlhash=req.referrer_hash or None,
                     responsetime_ms=int(
                         entry.response.fetch_time_s * 1000),
-                    httpstatus=entry.response.status)
+                    httpstatus=entry.response.status,
+                    ingest_stamp=entry.ingest_stamp or None)
                 # RDFa annotations land in the lod triple store
                 # (reference: parser/rdfa -> cora/lod)
                 for s_, p_, o_ in getattr(doc, "rdf_triples", []):
@@ -764,7 +792,11 @@ class Switchboard:
                 self._last_join_merge = now
                 ds.merge_wanted = False
                 try:
-                    self.index.rwi.merge_runs(max_runs=1)
+                    # routed through the merge scheduler (ISSUE 13c):
+                    # while the serving SLO burns the compaction is
+                    # DEFERRED (counted) and the catch-up runs it when
+                    # the merge_scheduler actuator sees recovery
+                    self.ingest_scheduler.request_merge(max_runs=1)
                 except Exception:
                     import logging
                     logging.getLogger("switchboard.jobs").warning(

@@ -361,6 +361,53 @@ def build_actuators(cfg) -> list:
             "evidence": {"losses": losses, "recoveries": recoveries},
         }
 
+    def merge_scheduler(eng: "ActuatorEngine"):
+        """Write-path deferral (ISSUE 13c): while the serving SLO
+        burns, the ingest scheduler parks compactions and tier
+        promotions (the node's two heavy background moves); after
+        `recoverTicks` consecutive healthy ticks it catches up —
+        running the most aggressive deferred merge ask and resubmitting
+        every parked promotion.  Same hysteresis discipline as the
+        serving ladder: a flapping rule must not thrash the merge
+        schedule."""
+        sched = getattr(eng.sb, "ingest_scheduler", None)
+        if sched is None:
+            return None
+        st = eng.rule_state("slo_serving_p95")
+        if not sched.deferred:
+            if st != "critical":
+                return None
+            sched.set_deferred(True)
+            eng._merge_ok_streak = 0
+            eng.sb.config.set("ingest.mergeDeferred", 1)
+            return {
+                "dir": "down", "from": "scheduling", "to": "deferred",
+                "cause": ("slo_serving_p95 critical: compactions and "
+                          "tier promotions deferred to protect "
+                          "serving"),
+                "evidence": {"rule_state": st,
+                             **sched.counters()},
+            }
+        if st == "ok":
+            eng._merge_ok_streak += 1
+        else:
+            eng._merge_ok_streak = 0
+            return None
+        if eng._merge_ok_streak < recover_ticks:
+            return None
+        eng._merge_ok_streak = 0
+        sched.set_deferred(False)
+        eng.sb.config.set("ingest.mergeDeferred", 0)
+        ev = sched.catch_up()
+        return {
+            "dir": "up", "from": "deferred", "to": "scheduling",
+            "cause": (f"serving recovered: catch-up ran "
+                      f"(merge={ev['pending_merge_ran']}, "
+                      f"{ev['promotions_resumed']} promotion(s) "
+                      f"resumed)"),
+            "evidence": {"rule_state": st, **ev},
+        }
+
     return [
         Actuator("serving_ladder",
                  "degradation ladder driven by the slo_serving_p95 "
@@ -389,6 +436,14 @@ def build_actuators(cfg) -> list:
                  ("yacy_device_lost",
                   'yacy_device_loss_total{event="recoveries"}'),
                  "index.device.lost", device_rebuild),
+        Actuator("merge_scheduler",
+                 "write-path deferral: parks RWI compactions and tier "
+                 "promotions while the serving SLO burns, catches up "
+                 f"after {recover_ticks} healthy ticks (down=deferred, "
+                 "up=catch-up ran)",
+                 ('yacy_health_rule{rule="slo_serving_p95"}',
+                  "yacy_ingest_deferred"),
+                 "ingest.mergeDeferred", merge_scheduler),
     ]
 
 
@@ -427,6 +482,7 @@ class ActuatorEngine:
         self._last_dispatches = 0
         self._avoid_peers: frozenset = frozenset()
         self._device_lost_seen = False    # device_rebuild edge memory
+        self._merge_ok_streak = 0         # merge_scheduler hysteresis
         self.tick_count = 0
         self.shed_count = 0
         self.degraded_queries = [0] * N_LEVELS

@@ -419,6 +419,48 @@ def build_rules(cfg) -> list:
                           f"window)"), ev
         return OK, "device serving", ev
 
+    # -- crawl-to-searchable SLO (ISSUE 13a) ---------------------------------
+
+    ingest_p95_ms = g("health.ingestSearchableP95Ms", 2000.0)
+    ingest_budget = max(1e-6,
+                        g("health.ingestSloBudgetPct", 5.0) / 100.0)
+    ingest_min_docs = gi("health.ingestSloMinDocs", 10)
+
+    def ingest_slo(ctx: RuleCtx):
+        """Freshness burn rate: the fraction of documents whose
+        crawl-to-searchable wall exceeded the objective, judged with
+        the same fast/slow multiwindow discipline as slo_serving_p95.
+        Backpressure needs no separate term — a writer's blocked wall
+        lands inside its documents' own searchable latency by
+        construction (rwi.wait_capacity runs before the store)."""
+        h = ctx.hist("ingest.searchable")
+        frac_fast, n_fast = h.fraction_over(ingest_p95_ms, last=2)
+        frac_slow, n_slow = h.fraction_over(ingest_p95_ms)
+        bp = ctx.hist("ingest.backpressure")
+        _bpf, bp_n = bp.fraction_over(0.0)
+        ev = {"objective_ms": ingest_p95_ms,
+              "docs_fast": n_fast, "docs_windowed": n_slow,
+              "frac_over_fast": round(frac_fast, 4),
+              "frac_over_slow": round(frac_slow, 4),
+              "backpressure_waits_windowed": bp_n}
+        if n_fast < ingest_min_docs:
+            return OK, "below ingest traffic floor", ev
+        fast_burn = frac_fast / ingest_budget
+        slow_burn = frac_slow / ingest_budget
+        ev["fast_burn"] = round(fast_burn, 2)
+        ev["slow_burn"] = round(slow_burn, 2)
+        if fast_burn >= fast_crit and slow_burn >= slow_crit:
+            return CRITICAL, (
+                f"crawl-to-searchable SLO burning {fast_burn:.1f}x "
+                f"budget (fast) / {slow_burn:.1f}x (slow): p95 "
+                f"objective {ingest_p95_ms}ms — the write path cannot "
+                f"keep the index fresh"), ev
+        if fast_burn >= 1.0 and slow_burn >= 1.0:
+            return WARN, (
+                f"crawl-to-searchable budget burning at "
+                f"{slow_burn:.1f}x sustainable rate"), ev
+        return OK, "index freshness within SLO", ev
+
     def frontier_starvation(ctx: RuleCtx):
         def starving(i: int) -> bool:
             # at tick `i` ago: frontier empty while that tick still
@@ -461,6 +503,12 @@ def build_rules(cfg) -> list:
         Rule("crawler_frontier_starvation",
              "active crawl with an empty local frontier",
              (_frontier, _fetches), frontier_starvation),
+        Rule("ingest_slo_searchable",
+             f"crawl-to-searchable p95 <= {ingest_p95_ms}ms over "
+             f">= {ingest_min_docs} docs/window (fast/slow burn-rate "
+             "windows; backpressure walls land inside the latency)",
+             ("yacy_ingest_searchable_ms_count",
+              "yacy_ingest_backpressure_ms_count"), ingest_slo),
         Rule("storage_corruption",
              "checksum-detected storage corruption (runs / segments / "
              "journals) — critical on any new event; the edge dumps a "

@@ -375,7 +375,7 @@ ROOT_PREFIXES = ("servlet.", "peer.", "pipeline.")
 # traces, and a multi-second crawl fetch would otherwise headline the
 # Performance_Trace_p stage table of a node that merely crawls
 BACKGROUND_PREFIXES = ("index.", "pipeline.", "crawler.", "crawl.",
-                       "dht.")
+                       "dht.", "ingest.")
 
 
 def stage_table(exclude_prefixes: tuple = BACKGROUND_PREFIXES) -> dict:
@@ -421,6 +421,19 @@ CANONICAL = {
     "index.condensedocument": "indexing pipeline stage 2 wall",
     "index.webstructureanalysis": "indexing pipeline stage 3 wall",
     "index.storedocumentindex": "indexing pipeline stage 4 wall",
+    # crawl-to-searchable SLO (ISSUE 13a, ingest/slo.py — its FAMILIES
+    # dict mirrors these entries and a hygiene test pins the mirror):
+    # write-path latency tiers + the bounded-buffer backpressure wall.
+    # "ingest." is a BACKGROUND prefix: freshness walls must never
+    # decide a SERVING latency verdict
+    "ingest.searchable": "crawl-to-searchable: pipeline entry -> doc "
+                         "servable from the RWI RAM buffer",
+    "ingest.flushed": "pipeline entry -> RWI flush covering the doc "
+                      "returned (immutable/durable run)",
+    "ingest.device": "pipeline entry -> run bit-packed onto the device "
+                     "tier (serves from placed blocks)",
+    "ingest.backpressure": "writer wall blocked in the bounded RWI RAM "
+                           "buffer (counted backpressure)",
 }
 
 for _name, _help in CANONICAL.items():
