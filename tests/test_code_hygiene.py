@@ -1,171 +1,81 @@
 """Repo-wide code-hygiene assertions.
 
-The reference logs every swallowed exception through ConcurrentLog
-(/root/reference/source/net/yacy/cora/util/ConcurrentLog.java:1); a bare
-``except Exception: pass`` hides index-hygiene and serving failures the
-operator needs to see (VERDICT r4 weak #6).  This test walks the package
-source and fails on any silent broad except: each handler must either log
-or narrow the exception type, with the narrow type's comment explaining
-why silence is correct.
+Round 18 (ISSUE 14): the scanners that used to live here as private
+regex/AST walks — silent broad excepts, jit-kernel cost-model/oracle
+coverage, bounded in-flight queues, wall-measuring servlet spans — are
+now registered checkers on the yacylint engine
+(yacy_search_server_tpu/utils/lint), which parses every file ONCE and
+runs the whole pipeline, with one exemption grammar
+(`# lint: <token>(reason)`) and one shrink-only baseline.  The test
+names below survive as thin wrappers over the engine so tier-1 history
+stays comparable; the non-lintable hygiene gates (runtime /metrics
+resolution, committed-artifact completeness, faultpoint liveness)
+remain as before.
 """
 import pathlib
 import re
 
-PKG = pathlib.Path(__file__).resolve().parent.parent / "yacy_search_server_tpu"
+from yacy_search_server_tpu.utils.lint import engine as lint_engine
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "yacy_search_server_tpu"
 
 
-def _silent_broad_excepts(path: pathlib.Path):
-    lines = path.read_text(encoding="utf-8").splitlines()
-    for i, line in enumerate(lines):
-        if not re.match(r"\s*except Exception\s*:\s*(#.*)?$", line):
-            continue
-        j = i + 1
-        while j < len(lines) and not lines[j].strip():
-            j += 1
-        if j < len(lines) and re.match(r"\s*pass\s*(#.*)?$", lines[j]):
-            yield i + 1
+def _lint(only: set[str]):
+    """One engine run (baseline applied) restricted to `only`."""
+    res = lint_engine.run(root=REPO, only=only)
+    return lint_engine.apply_baseline(
+        res, lint_engine.load_baseline(lint_engine.baseline_path(REPO)))
+
+
+def _assert_clean(res, hint: str):
+    assert not res.findings, (
+        hint + ":\n  " + "\n  ".join(f.render() for f in res.findings))
 
 
 def test_no_silent_broad_excepts():
-    offenders = []
-    for p in sorted(PKG.rglob("*.py")):
-        for lineno in _silent_broad_excepts(p):
-            offenders.append(f"{p.relative_to(PKG.parent)}:{lineno}")
-    assert not offenders, (
-        "silent `except Exception: pass` — log the failure or narrow the "
-        "exception type:\n  " + "\n  ".join(offenders))
+    """A bare ``except Exception: pass`` hides index-hygiene and serving
+    failures the operator needs to see (VERDICT r4 weak #6); now the
+    lint engine's broad-except checker."""
+    res = _lint({"broad-except"})
+    _assert_clean(res, "silent `except Exception: pass` — log the "
+                       "failure or narrow the exception type")
+    assert res.stats["broad-except"]["broad_handlers"] > 50, \
+        "broad-except census collapsed (checker rot?)"
 
 
-# -- silicon accounting coverage (ISSUE 1) -----------------------------------
-# Every named device kernel (jit- or pallas-compiled) in ops/ and
-# index/devstore.py must carry a cost-model entry in ops/roofline.KERNELS
-# — or an explicit, reasoned exemption in ops/roofline.EXEMPT. A kernel
-# without either is invisible to the roofline layer: its perf claims
-# cannot be stated against the silicon, which is exactly the r5 gap this
-# subsystem closes.
-
-_JIT_DECO = re.compile(r"\s*@(?:functools\.partial\(\s*)?"
-                       r"(?:partial\()?jax\.jit|\s*@jax\.jit")
-
-
-def _named_kernels(path: pathlib.Path):
-    """Function names defined directly under a jit decorator (plus any
-    function containing a pallas_call)."""
-    lines = path.read_text(encoding="utf-8").splitlines()
-    current_def = None
-    for i, line in enumerate(lines):
-        m = re.match(r"\s*def\s+(\w+)", line)
-        if m:
-            current_def = m.group(1)
-        if "pallas_call(" in line and current_def:
-            yield current_def    # pallas kernels are named by their host fn
-            continue
-        if not _JIT_DECO.match(line):
-            continue
-        # the decorator may span continuation lines (static_argnames
-        # tuples); the next `def` names the kernel — and one MUST follow,
-        # or the scanner itself has a hole (a silent miss here would
-        # green-light an unregistered kernel)
-        for j in range(i + 1, min(i + 16, len(lines))):
-            dm = re.match(r"\s*def\s+(\w+)", lines[j])
-            if dm:
-                yield dm.group(1)
-                break
-        else:
-            raise AssertionError(
-                f"{path.name}:{i + 1}: jit decorator with no `def` in "
-                f"the next 15 lines — widen the scanner window")
-
+# -- silicon accounting coverage (ISSUE 1, engine-run since ISSUE 14) --------
 
 def test_every_device_kernel_has_a_cost_model():
-    from yacy_search_server_tpu.ops import roofline
-
-    sources = sorted((PKG / "ops").glob("*.py"))
-    sources.append(PKG / "index" / "devstore.py")
-    # the streaming-ingest write path (ISSUE 13): any ingest/ jit
-    # kernel without a cost model (or reasoned exemption) fails CI
-    sources.extend(sorted((PKG / "ingest").glob("*.py")))
-    missing = []
-    for p in sources:
-        for name in _named_kernels(p):
-            if name in roofline.KERNELS:
-                continue
-            if name in roofline.EXEMPT:
-                continue   # documented decision, not a hole
-            missing.append(f"{p.relative_to(PKG.parent)}::{name}")
-    assert not missing, (
-        "device kernels without a roofline cost model (register in "
-        "ops/roofline.KERNELS or exempt WITH A REASON in "
-        "ops/roofline.EXEMPT):\n  " + "\n  ".join(missing))
-
-
-# -- tracing coverage (ISSUE 2) ----------------------------------------------
-# Every @servlet handler that measures a wall (a `t0 = time.time()` /
-# `time.perf_counter()` start it later subtracts) or touches the roofline
-# profiler must open a trace/span — or carry a reasoned exemption below.
-# A new endpoint that times itself without joining the span spine would
-# silently drop out of the waterfall Performance_Trace_p renders, which
-# is exactly the blind spot the tracing subsystem closes.
-
-TRACING_EXEMPT = {
-    # these READ profiler/tracing aggregates to render dashboards; they
-    # serve no query and measure no request wall of their own
-    "respond_roofline": "renders PROFILER aggregates, serves no query",
-    "respond_metrics": "exposition endpoint reading counters only",
-    "respond_trace": "renders the tracing ring itself",
-}
-
-_WALL_START = re.compile(
-    r"\bt0\w*\s*=\s*time\.(?:time|monotonic|perf_counter)\(\)")
-_PROFILER_USE = re.compile(r"\bPROFILER\b")
-_TRACED = re.compile(r"\btracing\.(?:trace|span|span_in|begin)\b")
-
-
-def _servlet_functions(path: pathlib.Path):
-    """(function name, body source) for every @servlet-decorated def."""
-    import ast
-    src = path.read_text(encoding="utf-8")
-    tree = ast.parse(src)
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        for deco in node.decorator_list:
-            if isinstance(deco, ast.Call) and \
-                    getattr(deco.func, "id", "") == "servlet":
-                yield node.name, ast.get_source_segment(src, node) or ""
-                break
+    """Every named device kernel (jit- or pallas-compiled) in ops/,
+    ingest/ and index/devstore.py must carry a cost-model entry in
+    ops/roofline.KERNELS — or a reasoned costmodel-ok lint exemption on
+    its def.  A kernel without either is invisible to the roofline
+    layer."""
+    res = _lint({"kernel-cost-model"})
+    _assert_clean(res, "device kernels without a roofline cost model")
+    stats = res.stats["kernel-cost-model"]
+    assert stats["kernels_seen"] >= 25, \
+        "kernel census collapsed (scanner rot?)"
+    assert stats["registry_kernels"] >= 25
 
 
 # -- pipelined dispatch hygiene (ISSUE 3) ------------------------------------
-# (a) Every completer / in-flight queue in the batchers must be BOUNDED:
-# an unbounded queue of issued-but-unfetched device buffers is unbounded
-# in-flight device memory — the backpressure of a maxsize is the cap.
-# (b) Every packed-I/O kernel variant must carry a roofline cost model
-# REGISTERED BY NAME (an EXEMPT entry is not acceptable for a serving
-# kernel): keeps PR 1's every-kernel-accounted invariant.
-
-_INFLIGHT_QUEUE = re.compile(
-    r"self\.(_inflight|_completions|_ready)\b[^=\n]*=\s*"
-    r"_?queue\.Queue\(([^)]*)\)")
-
 
 def test_completer_and_inflight_queues_are_bounded():
-    offenders = []
-    seen_inflight = 0
-    for rel in ("index/devstore.py", "index/meshstore.py"):
-        src = (PKG / rel).read_text(encoding="utf-8")
-        for m in _INFLIGHT_QUEUE.finditer(src):
-            if m.group(1) == "_inflight":
-                seen_inflight += 1
-            if "maxsize" not in m.group(2):
-                offenders.append(f"{rel}::{m.group(1)}")
-    # the scanner must actually see both batchers' in-flight queues —
-    # a rename that dodges the regex fails here instead of passing
-    assert seen_inflight >= 2, \
-        "in-flight completion queues not found (renamed? widen scanner)"
-    assert not offenders, (
-        "completer/in-flight queues without a maxsize bound (unbounded "
-        "in-flight device memory):\n  " + "\n  ".join(offenders))
+    """Every queue in the package must be bounded (or carry a reasoned
+    unbounded-ok exemption): an unbounded queue of issued-but-unfetched
+    device buffers is unbounded in-flight device memory.  The engine's
+    unbounded-queue checker generalizes the old devstore/meshstore
+    in-flight scan to the whole tree."""
+    res = _lint({"unbounded-queue"})
+    _assert_clean(res, "queues without a maxsize bound")
+    stats = res.stats["unbounded-queue"]
+    # the scanner must still SEE both batchers' in-flight queues — a
+    # rename that dodges the census fails here instead of passing
+    assert stats["inflight_bounded"] >= 2, \
+        "in-flight completion queues not found (renamed? checker rot?)"
+    assert stats["queue_sites"] >= 6
 
 
 PACKED_KERNELS = (
@@ -180,68 +90,41 @@ PACKED_KERNELS = (
 
 
 def test_packed_kernel_variants_have_registered_cost_models():
-    from yacy_search_server_tpu.ops import roofline
-
-    missing = [k for k in PACKED_KERNELS if k not in roofline.KERNELS]
+    """Serving kernels must be registered BY NAME (an exemption is not
+    acceptable) — checked statically off ops/roofline.py, the same
+    single-parse view the engine uses."""
+    repo = lint_engine.discover(REPO)
+    kernels = repo.dict_literal_keys(
+        "yacy_search_server_tpu/ops/roofline.py", "KERNELS")
+    missing = [k for k in PACKED_KERNELS if k not in kernels]
     assert not missing, (
         "packed-output kernel variants without a roofline cost model "
-        "(register in ops/roofline.KERNELS; EXEMPT is not acceptable "
-        "for serving kernels):\n  " + "\n  ".join(missing))
+        "(register in ops/roofline.KERNELS; an exemption is not "
+        "acceptable for serving kernels):\n  " + "\n  ".join(missing))
 
 
-# -- compressed residency hygiene (ISSUE 8) ----------------------------------
-# Every bit-packed fused-decode kernel (`*_bp_kernel`) must carry BOTH a
-# roofline cost model registered BY NAME (counting the packed bytes —
-# EXEMPT is not acceptable for a serving kernel) and a NumPy oracle in
-# ops/packed.BP_ORACLES (the parity anchor the bit-identity contract
-# rests on). The scanner walks devstore's jitted kernels, so a new *_bp
-# variant cannot land unregistered.
+# -- compressed residency / dense-first hygiene (ISSUES 8 + 11) --------------
 
 def test_bp_kernels_have_cost_models_and_numpy_oracles():
-    from yacy_search_server_tpu.ops import packed as PK
-    from yacy_search_server_tpu.ops import roofline
+    """Every ``*_bp_kernel`` must carry BOTH a by-name cost model and a
+    NumPy oracle in ops/packed.BP_ORACLES (the parity anchor the
+    bit-identity contract rests on) — the engine's kernel-oracle
+    checker."""
+    res = _lint({"kernel-oracle"})
+    _assert_clean(res, "serving-kernel oracle/registration violations")
+    assert res.stats["kernel-oracle"]["bp_kernels"], \
+        "no *_bp kernels found (renamed? checker rot?)"
 
-    bp = [name for name in _named_kernels(PKG / "index" / "devstore.py")
-          if name.endswith("_bp_kernel")]
-    assert bp, "no *_bp kernels found (renamed? widen scanner)"
-    missing_cost = [k for k in bp if k not in roofline.KERNELS]
-    assert not missing_cost, (
-        "*_bp kernels without a roofline cost model (must count PACKED "
-        "bytes; register in ops/roofline.KERNELS):\n  "
-        + "\n  ".join(missing_cost))
-    missing_oracle = [k for k in bp if k not in PK.BP_ORACLES]
-    assert not missing_oracle, (
-        "*_bp kernels without a NumPy oracle (register in "
-        "ops/packed.BP_ORACLES with the parity contract):\n  "
-        + "\n  ".join(missing_oracle))
-
-
-# -- dense-first ANN hygiene (ISSUE 11) --------------------------------------
-# Every `_ann_*` jit kernel must carry BOTH a roofline cost model
-# registered BY NAME (EXEMPT is not acceptable for a serving kernel)
-# and a NumPy oracle in ops/ann.ANN_ORACLES — the oracle doubles as the
-# warm/cold host-scoring path and the device-loss fallback, so a kernel
-# without one has no exact-scoring parity anchor AND no survival story.
 
 def test_ann_kernels_have_cost_models_and_numpy_oracles():
-    from yacy_search_server_tpu.ops import ann as AN
-    from yacy_search_server_tpu.ops import roofline
-
-    kernels = [name for name in _named_kernels(PKG / "ops" / "ann.py")
-               if name.startswith("_ann_")]
-    assert kernels, "no _ann_* kernels found (renamed? widen scanner)"
-    missing_cost = [k for k in kernels if k not in roofline.KERNELS]
-    assert not missing_cost, (
-        "_ann_* kernels without a roofline cost model (register in "
-        "ops/roofline.KERNELS):\n  " + "\n  ".join(missing_cost))
-    missing_oracle = [k for k in kernels if k not in AN.ANN_ORACLES]
-    assert not missing_oracle, (
-        "_ann_* kernels without a NumPy oracle (register in "
-        "ops/ann.ANN_ORACLES):\n  " + "\n  ".join(missing_oracle))
-    # and nothing rots in the registry: every oracle entry names a live
-    # kernel (a renamed kernel must not leave a dead oracle behind)
-    dead = [k for k in AN.ANN_ORACLES if k not in kernels]
-    assert not dead, f"ANN_ORACLES entries without a kernel: {dead}"
+    """Every ``_ann_*`` kernel needs its ANN_ORACLES entry (host
+    fallback + parity anchor) and by-name registration; dead oracle
+    entries flag too — same kernel-oracle checker, asserted through the
+    ann census."""
+    res = _lint({"kernel-oracle"})
+    _assert_clean(res, "ann kernel oracle/registration violations")
+    assert res.stats["kernel-oracle"]["ann_kernels"], \
+        "no _ann_* kernels found (renamed? checker rot?)"
 
 
 def test_ann_metric_series_resolve(tmp_path):
@@ -313,33 +196,26 @@ def test_committed_capacity_artifact_carries_required_fields():
 
 
 # -- streaming-ingest hygiene (ISSUE 13) -------------------------------------
-# The write path's device kernels are held to the same silicon
-# accounting as the serving kernels: registered BY NAME in
-# roofline.KERNELS (EXEMPT is not acceptable — the device index build
-# is a throughput claim, and an unaccounted kernel cannot state it
-# against the silicon), and the jax import boundary stays inside
-# devbuild so the kill−9 chaos children (dozens of short-lived
-# jax-free interpreters) keep importing the RWI write path cheaply.
 
 INGEST_KERNELS = ("_pack_block_batch_kernel",)
 
 
 def test_ingest_kernels_have_registered_cost_models():
-    from yacy_search_server_tpu.ops import roofline
-
-    found = [name for name in _named_kernels(PKG / "ingest"
-                                             / "devbuild.py")]
+    """The write path's device kernels are held to the same silicon
+    accounting as the serving kernels: registered BY NAME (the device
+    index build is a throughput claim)."""
+    from yacy_search_server_tpu.utils.lint import named_kernels
+    repo = lint_engine.discover(REPO)
+    ctx = repo.get("yacy_search_server_tpu/ingest/devbuild.py")
+    found = [name for name, _fn in named_kernels(ctx)]
     assert set(INGEST_KERNELS) <= set(found), \
         "ingest kernels renamed? update INGEST_KERNELS"
-    missing = [k for k in found if k not in roofline.KERNELS
-               and k not in roofline.EXEMPT]
-    assert not missing, (
-        "ingest/ jit kernels without a roofline cost model:\n  "
-        + "\n  ".join(missing))
+    kernels = repo.dict_literal_keys(
+        "yacy_search_server_tpu/ops/roofline.py", "KERNELS")
     for k in INGEST_KERNELS:
-        assert k in roofline.KERNELS, (
-            f"{k} must be REGISTERED (EXEMPT is not acceptable for "
-            f"the device index build)")
+        assert k in kernels, (
+            f"{k} must be REGISTERED by name (an exemption is not "
+            f"acceptable for the device index build)")
 
 
 def test_ingest_package_stays_jax_free_outside_devbuild():
@@ -405,21 +281,15 @@ def test_no_dead_faultpoints():
             f"faultpoint {name!r} is not exercised by any test")
 
 
+# -- tracing coverage (ISSUE 2, engine-run since ISSUE 14) -------------------
+
 def test_wall_measuring_servlets_open_spans():
-    offenders = []
-    for p in sorted((PKG / "server" / "servlets").glob("*.py")):
-        for name, body in _servlet_functions(p):
-            measures = bool(_WALL_START.search(body)
-                            or _PROFILER_USE.search(body))
-            if not measures:
-                continue
-            if name in TRACING_EXEMPT:
-                continue
-            if _TRACED.search(body):
-                continue
-            offenders.append(f"{p.name}::{name}")
-    assert not offenders, (
-        "servlet handlers that measure a wall (or use the profiler) "
-        "without opening a tracing span — wrap the handler in "
-        "tracing.trace(...) or add a reasoned TRACING_EXEMPT entry:\n  "
-        + "\n  ".join(offenders))
+    """Every @servlet handler that measures a wall or touches the
+    roofline PROFILER must open a trace span — or carry a reasoned
+    trace-ok lint exemption on its def (the old TRACING_EXEMPT dict is
+    gone; exemptions audit with one grep now)."""
+    res = _lint({"servlet-trace"})
+    _assert_clean(res, "servlet handlers that measure a wall without "
+                       "opening a tracing span")
+    assert res.stats["servlet-trace"]["servlet_handlers"] > 80, \
+        "servlet census collapsed (checker rot?)"

@@ -700,7 +700,7 @@ def test_incident_directory_keeps_newest_n_files(tmp_path):
         # a real dump triggers the prune
         eng._last_incident_ts = 0.0
         with eng._lock:
-            eng._dump_incident(_time.time(), ["worker_stall"])
+            eng._dump_incident_locked(_time.time(), ["worker_stall"])
         files = sorted(f.name for f in inc_dir.glob("incident-*.jsonl"))
         assert len(files) == 5
         # the newest survive: the 4 youngest old files + the new dump
@@ -711,7 +711,7 @@ def test_incident_directory_keeps_newest_n_files(tmp_path):
         keep = inc_dir / "operator-notes.txt"
         keep.write_text("mine")
         with eng._lock:
-            eng._dump_incident(_time.time() + 1, ["worker_stall"])
+            eng._dump_incident_locked(_time.time() + 1, ["worker_stall"])
         assert keep.exists()
     finally:
         sb.close()

@@ -1,0 +1,653 @@
+"""yacylint engine tests (ISSUE 14).
+
+Three layers:
+
+1. **Fixture snippets per checker** — a known violation produces the
+   exact finding, the exempted twin is clean, and the escape hatch
+   (`# lint: <token>(reason)`) is honored.  Each fixture doubles as the
+   NON-VACUITY gate: a checker that stops firing on its own fixture
+   fails here, so a refactor cannot silently lobotomize a rule.
+2. **Engine mechanics** — exemption grammar policing (unknown token /
+   missing reason), multi-line reasons, baseline round-trip and the
+   shrink-only stale-entry rule.
+3. **The tier-1 gate** — the real package tree runs clean against the
+   committed LINT_BASELINE.json, and utils/lint itself stays jax-free
+   so the gate runs in any interpreter (CI sandboxes, chaos children).
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+from yacy_search_server_tpu.utils import lint
+from yacy_search_server_tpu.utils.lint import engine
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = "yacy_search_server_tpu"
+
+
+def run_fixture(tmp_path, files: dict, only=None):
+    """Write {relpath: source} under a fake package root and lint it."""
+    for rel, src in files.items():
+        p = tmp_path / PKG / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src, encoding="utf-8")
+    return engine.run(root=tmp_path, only=only)
+
+
+def findings_of(res, checker):
+    return [f for f in res.findings if f.checker == checker]
+
+
+# -- 1. lockset race detector -------------------------------------------------
+
+LOCKSET_BAD = '''
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = {}
+
+    def a(self):
+        with self._lock:
+            self.rows["a"] = 1
+
+    def b(self):
+        with self._lock:
+            self.rows["b"] = 2
+
+    def c(self):
+        with self._lock:
+            del self.rows["c"]
+
+    def d(self):
+        with self._lock:
+            return len(self.rows)
+
+    def racy(self):
+        return self.rows.get("a")
+'''
+
+
+def test_lockset_fires_on_majority_guarded_attr(tmp_path):
+    res = run_fixture(tmp_path, {"m.py": LOCKSET_BAD}, only={"lockset"})
+    hits = findings_of(res, "lockset")
+    assert len(hits) == 1 and hits[0].line == 26   # the racy read
+    assert "self.rows" in hits[0].message
+    assert "self._lock" in hits[0].message
+
+
+def test_lockset_escape_hatch_honored(tmp_path):
+    fixed = LOCKSET_BAD.replace(
+        "    def racy(self):",
+        "    # lint: unlocked-ok(read-only probe, torn value acceptable)\n"
+        "    def racy(self):")
+    res = run_fixture(tmp_path, {"m.py": fixed}, only={"lockset"})
+    assert not findings_of(res, "lockset")
+
+
+def test_lockset_locked_suffix_means_caller_holds(tmp_path):
+    fixed = LOCKSET_BAD.replace("def racy(self):", "def racy_locked(self):")
+    res = run_fixture(tmp_path, {"m.py": fixed}, only={"lockset"})
+    assert not findings_of(res, "lockset")
+
+
+def test_lockset_init_is_not_a_race(tmp_path):
+    src = LOCKSET_BAD.replace("        self.rows = {}",
+                              "        self.rows = {}\n"
+                              "        self.rows['seed'] = 0")
+    res = run_fixture(tmp_path, {"m.py": src}, only={"lockset"})
+    assert len(findings_of(res, "lockset")) == 1   # still only `racy`
+
+
+# -- 2. blocking call under lock ----------------------------------------------
+
+BLOCKING_BAD = '''
+import threading
+import time
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def slow(self):
+        with self._lock:
+            time.sleep(1.0)
+'''
+
+
+def test_lock_blocking_fires(tmp_path):
+    res = run_fixture(tmp_path, {"m.py": BLOCKING_BAD},
+                      only={"lock-blocking"})
+    hits = findings_of(res, "lock-blocking")
+    assert len(hits) == 1 and "time.sleep" in hits[0].message
+
+
+def test_lock_blocking_exempt_on_with_line(tmp_path):
+    fixed = BLOCKING_BAD.replace(
+        "        with self._lock:",
+        "        # lint: blocking-ok(deliberate: lock IS the pacing)\n"
+        "        with self._lock:")
+    res = run_fixture(tmp_path, {"m.py": fixed}, only={"lock-blocking"})
+    assert not findings_of(res, "lock-blocking")
+
+
+def test_lock_blocking_skips_deferred_bodies(tmp_path):
+    src = BLOCKING_BAD.replace(
+        "            time.sleep(1.0)",
+        "            def later():\n"
+        "                time.sleep(1.0)\n"
+        "            self.cb = later")
+    res = run_fixture(tmp_path, {"m.py": src}, only={"lock-blocking"})
+    assert not findings_of(res, "lock-blocking")
+
+
+def test_lock_blocking_catches_device_and_http(tmp_path):
+    src = '''
+import threading, jax
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def up(self, buf, seed):
+        with self._lock:
+            x = jax.device_put(buf)
+            ok, rep = self.node.protocol.mesh_rpc(seed, "step", {})
+        return x, ok
+'''
+    res = run_fixture(tmp_path, {"m.py": src}, only={"lock-blocking"})
+    msgs = " ".join(f.message for f in findings_of(res, "lock-blocking"))
+    assert "device_put" in msgs and "mesh_rpc" in msgs
+
+
+# -- 3. tie discipline --------------------------------------------------------
+
+def test_tie_discipline_fires_in_fusion_scope(tmp_path):
+    src = '''
+import numpy as np
+def fuse(s):
+    return np.argsort(-s)[:10]
+'''
+    in_scope = tmp_path / "a"
+    out_scope = tmp_path / "b"
+    in_scope.mkdir()
+    out_scope.mkdir()
+    res = run_fixture(in_scope, {"ops/f.py": src},
+                      only={"tie-discipline"})
+    assert len(findings_of(res, "tie-discipline")) == 1
+    # the same call outside ops//parallel//search/ is out of scope
+    res2 = run_fixture(out_scope, {"crawler/f.py": src},
+                       only={"tie-discipline"})
+    assert not findings_of(res2, "tie-discipline")
+
+
+def test_tie_discipline_accepts_two_key_forms(tmp_path):
+    src = '''
+import numpy as np
+from jax import lax
+def stable(s):
+    return np.argsort(-s, kind="stable")[:10]
+def lex(s, d):
+    return np.lexsort((d, -s))[:10]
+def twokey(a, b):
+    return lax.sort((a, b), num_keys=2)
+def prefilter_then_pin(s, d):
+    ts, ti = lax.top_k(s, 16)
+    return lax.sort((-ts, d[ti]), num_keys=2)
+'''
+    res = run_fixture(tmp_path, {"ops/f.py": src},
+                      only={"tie-discipline"})
+    assert not findings_of(res, "tie-discipline")
+
+
+def test_tie_discipline_flags_bare_topk_and_single_key_sort(tmp_path):
+    src = '''
+from jax import lax
+def bare(s):
+    return lax.top_k(s, 10)
+def onekey(a, b):
+    return lax.sort((a, b), num_keys=1)
+'''
+    res = run_fixture(tmp_path, {"search/f.py": src},
+                      only={"tie-discipline"})
+    assert len(findings_of(res, "tie-discipline")) == 2
+
+
+# -- 4a. unbounded queue ------------------------------------------------------
+
+def test_unbounded_queue_fires(tmp_path):
+    src = '''
+import queue
+class W:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._ok = queue.Queue(maxsize=4)
+        self._ok2 = queue.Queue(8)
+'''
+    res = run_fixture(tmp_path, {"m.py": src}, only={"unbounded-queue"})
+    hits = findings_of(res, "unbounded-queue")
+    assert len(hits) == 1 and hits[0].line == 5
+
+
+def test_unbounded_queue_exemption(tmp_path):
+    src = '''
+import queue
+class W:
+    def __init__(self):
+        # lint: unbounded-ok(every item has a blocked submitter)
+        self._q = queue.Queue()
+'''
+    res = run_fixture(tmp_path, {"m.py": src}, only={"unbounded-queue"})
+    assert not findings_of(res, "unbounded-queue")
+
+
+# -- 4b. counter outside lock -------------------------------------------------
+
+COUNTER_BAD = '''
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.served = 0
+        self.errors = 0
+    def ok(self):
+        with self._lock:
+            self.served += 1
+    def fail(self):
+        self.errors += 1
+'''
+
+
+def test_counter_lock_fires_on_cohort_drift(tmp_path):
+    res = run_fixture(tmp_path, {"m.py": COUNTER_BAD},
+                      only={"counter-lock"})
+    hits = findings_of(res, "counter-lock")
+    assert len(hits) == 1 and "self.errors" in hits[0].message
+
+
+def test_counter_lock_quiet_without_guarded_sibling(tmp_path):
+    src = COUNTER_BAD.replace("        with self._lock:\n"
+                              "            self.served += 1",
+                              "        self.served += 1")
+    res = run_fixture(tmp_path, {"m.py": src}, only={"counter-lock"})
+    assert not findings_of(res, "counter-lock")
+
+
+# -- 5. jit purity ------------------------------------------------------------
+
+def test_jit_purity_fires_transitively(tmp_path):
+    src = '''
+import time
+import jax
+
+def helper(x):
+    return x * time.time()
+
+@jax.jit
+def kernel(x):
+    return helper(x)
+'''
+    res = run_fixture(tmp_path, {"ops/k.py": src}, only={"jit-purity"})
+    hits = findings_of(res, "jit-purity")
+    assert len(hits) == 1 and "time.time" in hits[0].message
+
+
+def test_jit_purity_flags_rng_and_honors_exemption(tmp_path):
+    src = '''
+import jax
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    # lint: impure-ok(trace-time constant is intended here)
+    return x + np.random.rand()
+
+@jax.jit
+def kernel2(x):
+    return x + np.random.rand()
+'''
+    res = run_fixture(tmp_path, {"ops/k.py": src}, only={"jit-purity"})
+    hits = findings_of(res, "jit-purity")
+    assert len(hits) == 1 and "kernel2" in hits[0].message
+
+
+# -- 6. broad except ----------------------------------------------------------
+
+def test_broad_except_fires(tmp_path):
+    src = '''
+def f():
+    try:
+        g()
+    except Exception:
+        pass
+'''
+    res = run_fixture(tmp_path, {"m.py": src}, only={"broad-except"})
+    assert len(findings_of(res, "broad-except")) == 1
+
+
+def test_broad_except_logging_is_fine(tmp_path):
+    src = '''
+import logging
+def f():
+    try:
+        g()
+    except Exception:
+        logging.warning("g failed", exc_info=True)
+'''
+    res = run_fixture(tmp_path, {"m.py": src}, only={"broad-except"})
+    assert not findings_of(res, "broad-except")
+
+
+# -- 7/8. kernel cost models + oracles ---------------------------------------
+
+def test_kernel_cost_model_fires_and_registry_clears(tmp_path):
+    kernel = '''
+import jax
+
+@jax.jit
+def my_kernel(x):
+    return x
+'''
+    roof = "KERNELS: dict = {}\nEXEMPT: dict = {}\n"
+    res = run_fixture(tmp_path, {"ops/k.py": kernel,
+                                 "ops/roofline.py": roof},
+                      only={"kernel-cost-model"})
+    hits = findings_of(res, "kernel-cost-model")
+    assert len(hits) == 1 and "my_kernel" in hits[0].message
+    roof2 = 'KERNELS: dict = {"my_kernel": None}\nEXEMPT: dict = {}\n'
+    res2 = run_fixture(tmp_path, {"ops/k.py": kernel,
+                                  "ops/roofline.py": roof2},
+                       only={"kernel-cost-model"})
+    assert not findings_of(res2, "kernel-cost-model")
+
+
+def test_kernel_cost_model_comment_exemption(tmp_path):
+    kernel = '''
+import jax
+
+# lint: costmodel-ok(maintenance copy, not a serving kernel)
+@jax.jit
+def my_kernel(x):
+    return x
+'''
+    res = run_fixture(tmp_path, {"ops/k.py": kernel,
+                                 "ops/roofline.py": "KERNELS: dict = {}\n"},
+                      only={"kernel-cost-model"})
+    assert not findings_of(res, "kernel-cost-model")
+
+
+def test_kernel_oracle_demands_by_name_registration(tmp_path):
+    dev = '''
+import jax
+
+@jax.jit
+def _rank_x_bp_kernel(x):
+    return x
+'''
+    files = {"index/devstore.py": dev,
+             "ops/roofline.py": "KERNELS: dict = {}\nEXEMPT: dict = "
+                                '{"_rank_x_bp_kernel": "nope"}\n',
+             "ops/packed.py": "BP_ORACLES: dict = {}\n",
+             "ops/ann.py": "ANN_ORACLES: dict = {}\n"}
+    res = run_fixture(tmp_path, files, only={"kernel-oracle"})
+    msgs = " ".join(f.message for f in findings_of(res, "kernel-oracle"))
+    assert "no NumPy oracle" in msgs and "BY NAME" in msgs
+
+
+def test_kernel_oracle_flags_dead_entries(tmp_path):
+    files = {"index/devstore.py": "",
+             "ops/roofline.py": "KERNELS: dict = {}\n",
+             "ops/packed.py": 'BP_ORACLES: dict = {"ghost_bp_kernel": 1}\n',
+             "ops/ann.py": "ANN_ORACLES: dict = {}\n"}
+    res = run_fixture(tmp_path, files, only={"kernel-oracle"})
+    msgs = " ".join(f.message for f in findings_of(res, "kernel-oracle"))
+    assert "dead oracle" in msgs
+
+
+# -- 9. servlet tracing -------------------------------------------------------
+
+SERVLET_BAD = '''
+import time
+
+@servlet("Thing_p")
+def respond_thing(header, post, sb):
+    t0 = time.time()
+    return time.time() - t0
+'''
+
+
+def test_servlet_trace_fires(tmp_path):
+    res = run_fixture(tmp_path, {"server/servlets/x.py": SERVLET_BAD},
+                      only={"servlet-trace"})
+    assert len(findings_of(res, "servlet-trace")) == 1
+
+
+def test_servlet_trace_span_or_exemption_clears(tmp_path):
+    spanned = SERVLET_BAD.replace(
+        "    t0 = time.time()",
+        "    t0 = time.time()\n    with tracing.trace('thing'):\n"
+        "        pass")
+    res = run_fixture(tmp_path, {"server/servlets/x.py": spanned},
+                      only={"servlet-trace"})
+    assert not findings_of(res, "servlet-trace")
+    exempt = SERVLET_BAD.replace(
+        '@servlet("Thing_p")',
+        "# lint: trace-ok(renders aggregates, serves no query)\n"
+        '@servlet("Thing_p")')
+    res2 = run_fixture(tmp_path, {"server/servlets/x.py": exempt},
+                       only={"servlet-trace"})
+    assert not findings_of(res2, "servlet-trace")
+
+
+# -- non-vacuity gate: every registered checker fires on its fixture ---------
+
+CHECKER_FIXTURES = {
+    "lockset": ({"m.py": LOCKSET_BAD}, None),
+    "lock-blocking": ({"m.py": BLOCKING_BAD}, None),
+    "tie-discipline": ({"ops/f.py": "import numpy as np\n"
+                        "def f(s):\n    return np.argsort(-s)\n"}, None),
+    "unbounded-queue": ({"m.py": "import queue\nq = queue.Queue()\n"},
+                        None),
+    "counter-lock": ({"m.py": COUNTER_BAD}, None),
+    "jit-purity": ({"ops/k.py": "import jax, time\n@jax.jit\n"
+                    "def k(x):\n    return x * time.time()\n"}, None),
+    "broad-except": ({"m.py": "try:\n    f()\nexcept Exception:\n"
+                      "    pass\n"}, None),
+    "kernel-cost-model": ({"ops/k.py": "import jax\n@jax.jit\n"
+                           "def k(x):\n    return x\n"}, None),
+    "kernel-oracle": ({"index/devstore.py": "import jax\n@jax.jit\n"
+                       "def _a_bp_kernel(x):\n    return x\n"}, None),
+    "servlet-trace": ({"server/servlets/x.py": SERVLET_BAD}, None),
+}
+
+
+def test_every_registered_checker_is_non_vacuous(tmp_path):
+    engine.run(rel_paths=["LINT_BASELINE.json"])  # ensure registration
+    assert len(engine.CHECKERS) >= 5, "ISSUE 14 demands >= 5 checkers"
+    missing_fixture = set(engine.CHECKERS) - set(CHECKER_FIXTURES)
+    assert not missing_fixture, \
+        f"checkers without a non-vacuity fixture: {missing_fixture}"
+    for i, (cid, (files, _)) in enumerate(CHECKER_FIXTURES.items()):
+        root = tmp_path / f"fx{i}"
+        root.mkdir()
+        res = run_fixture(root, files, only={cid})
+        assert findings_of(res, cid), \
+            f"checker {cid!r} no longer fires on its own fixture"
+
+
+# -- engine mechanics ---------------------------------------------------------
+
+def test_exemption_grammar_polices_itself(tmp_path):
+    src = '''
+# lint: made-up-token(some reason)
+x = 1
+# lint: unlocked-ok()
+y = 2
+'''
+    res = run_fixture(tmp_path, {"m.py": src})
+    msgs = [f.message for f in findings_of(res, "exemption")]
+    assert any("unknown exemption token" in m for m in msgs)
+    assert any("no reason" in m for m in msgs)
+
+
+def test_multiline_exemption_reason(tmp_path):
+    src = '''
+import queue
+class W:
+    def __init__(self):
+        # lint: unbounded-ok(a reason that runs on and on across
+        # several comment lines before finally closing)
+        self._q = queue.Queue()
+'''
+    res = run_fixture(tmp_path, {"m.py": src})
+    assert not findings_of(res, "unbounded-queue")
+    assert not findings_of(res, "exemption")
+
+
+def test_inline_exemption_covers_only_its_own_statement(tmp_path):
+    """A trailing `# lint: ...` comment anchors to ITS statement; the
+    next line's identical violation must still flag (the counter-drift
+    bug class must not be silenceable by adjacency)."""
+    src = '''
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+    def ok(self):
+        with self._lock:
+            self.hits += 1
+    def racy(self):
+        self.hits += 1  # lint: counter-ok(benign probe)
+        self.misses += 1
+'''
+    res = run_fixture(tmp_path, {"m.py": src}, only={"counter-lock"})
+    hits = findings_of(res, "counter-lock")
+    assert len(hits) == 1 and "self.misses" in hits[0].message
+
+
+def test_unbounded_queue_negative_maxsize_is_unbounded(tmp_path):
+    """queue semantics: maxsize <= 0 means infinite — Queue(-1) must
+    flag exactly like Queue()."""
+    src = '''
+import queue
+class W:
+    def __init__(self):
+        self._a = queue.Queue(-1)
+        self._b = queue.Queue(maxsize=-1)
+        self._c = queue.Queue(maxsize=0)
+'''
+    res = run_fixture(tmp_path, {"m.py": src}, only={"unbounded-queue"})
+    assert len(findings_of(res, "unbounded-queue")) == 3
+
+
+def test_exemption_inside_string_literal_is_ignored(tmp_path):
+    src = 'MSG = "annotate `# lint: unlocked-ok(reason)` to silence"\n'
+    res = run_fixture(tmp_path, {"m.py": src})
+    assert not res.findings
+
+
+def test_baseline_round_trip_and_shrink_only(tmp_path):
+    files = {"m.py": "import queue\nq = queue.Queue()\n"}
+    res = run_fixture(tmp_path, files, only={"unbounded-queue"})
+    assert len(res.findings) == 1
+    bl = tmp_path / "LINT_BASELINE.json"
+    engine.write_baseline(bl, res)
+    entries = engine.load_baseline(bl)
+    assert len(entries) == 1
+
+    # same tree again: the finding is suppressed by the baseline
+    res2 = run_fixture(tmp_path, files, only={"unbounded-queue"})
+    res2 = engine.apply_baseline(res2, entries)
+    assert not res2.findings and len(res2.suppressed) == 1
+    assert not res2.stale_baseline
+
+    # fixed tree: the entry is STALE and must be deleted (shrink-only)
+    files_fixed = {"m.py": "import queue\nq = queue.Queue(maxsize=4)\n"}
+    res3 = run_fixture(tmp_path, files_fixed, only={"unbounded-queue"})
+    res3 = engine.apply_baseline(res3, entries)
+    assert not res3.findings
+    assert len(res3.stale_baseline) == 1
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    res = run_fixture(tmp_path, {"m.py": "def broken(:\n"})
+    assert any(f.checker == "parse-error" for f in res.findings)
+
+
+# -- the tier-1 gate ----------------------------------------------------------
+
+def test_repo_lint_clean():
+    """THE gate: the package tree runs clean against the committed
+    baseline, and the baseline carries no stale entries (shrink-only).
+    A finding here means: fix it or exempt it with a reasoned
+    `# lint: <token>(reason)` — never grow LINT_BASELINE.json."""
+    res = engine.run(root=REPO)
+    res = engine.apply_baseline(
+        res, engine.load_baseline(engine.baseline_path(REPO)))
+    assert not res.findings, (
+        "yacylint findings (fix or add a reasoned inline exemption):\n  "
+        + "\n  ".join(f.render() for f in res.findings))
+    assert not res.stale_baseline, (
+        "stale LINT_BASELINE.json entries (the debt was paid — delete "
+        "them; baselines only shrink):\n  "
+        + "\n  ".join(str(e) for e in res.stale_baseline))
+
+
+def test_repo_gate_sees_the_whole_tree():
+    """Anti-rot for the gate itself: the run must cover the package
+    (file count) and the census must keep seeing the structures the
+    checkers exist for."""
+    res = engine.run(root=REPO)
+    assert res.stats["files"] > 120
+    assert res.stats["lockset"]["classes_with_locks"] > 30
+    assert res.stats["lock-blocking"]["lock_regions"] > 300
+    assert res.stats["tie-discipline"]["sort_sites"] > 15
+    assert res.stats["kernel-cost-model"]["kernels_seen"] > 20
+
+
+def test_cli_gate_exits_zero_and_reports():
+    out = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.utils.lint", "--json"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    obj = json.loads(out.stdout)
+    assert obj["findings"] == []
+    assert obj["stats"]["files"] > 120
+
+
+def test_lint_package_stays_jax_free():
+    """The lint engine must run in ANY interpreter — CI sandboxes, the
+    kill-9 chaos children, a laptop without the jax_graft toolchain —
+    so utils/lint imports only the stdlib (not even numpy)."""
+    import ast
+    banned = {"jax", "jaxlib", "numpy", "np", "requests"}
+    lint_dir = REPO / PKG / "utils" / "lint"
+    for p in sorted(lint_dir.glob("*.py")):
+        tree = ast.parse(p.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                mods = [(node.module or "").split(".")[0]]
+            hit = banned & set(mods)
+            assert not hit, f"{p.name} imports {hit} — lint must stay " \
+                            f"stdlib-only"
+
+
+def test_lint_runs_without_jax_importable(tmp_path):
+    """Belt and braces: the CLI actually executes with jax masked out
+    of the import machinery."""
+    mask = tmp_path / "mask"
+    (mask / "jax").mkdir(parents=True)
+    (mask / "jax" / "__init__.py").write_text(
+        "raise ImportError('jax must not be imported by the linter')")
+    out = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.utils.lint"],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+        env={"PATH": "/usr/bin:/bin",
+             "PYTHONPATH": f"{mask}:{REPO}"})
+    assert out.returncode == 0, out.stdout + out.stderr

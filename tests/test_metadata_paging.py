@@ -163,8 +163,8 @@ def test_merge_blanks_deleted_payload(tmp_path):
     st.snapshot()
     st.delete(st.urlhash_of(a))
     # force a merge of the two 1-row segments
-    st._merge_smallest()
-    st._persist_state()
+    st._merge_smallest_locked()
+    st._persist_state_locked()
     seg = st._segs[0]
     assert seg.n == 2
     assert seg.text("text_t", 0) == ""          # deleted payload blanked
@@ -281,8 +281,8 @@ def test_override_survives_merge_and_reopen_in_facets(tmp_path):
     st.put(_mkdoc(1, host="c.example"))
     st.snapshot()
     st.set_fields(a, host_s="b.example")
-    st._merge_smallest()                       # folds the override
-    st._persist_state()
+    st._merge_smallest_locked()                       # folds the override
+    st._persist_state_locked()
     assert st.facet_docids("host_s", "b.example").tolist() == [a]
     assert st.facet_docids("host_s", "a.example").tolist() == []
     st.snapshot()                              # rebuilds live maps

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Render yacylint findings-by-checker counts for a PR description.
+
+Runs the whole engine once (single parse pass, jax-free) and prints a
+markdown-ready table: per-checker findings (new vs baselined), the
+census stats that prove each checker is looking at something, and the
+exemption audit (every `# lint:` token in the tree with its count) —
+so a PR can state "N findings fixed, M exempted with reasons, baseline
+shrunk by K" with receipts.
+
+Usage:  python tools/lint_report.py [--json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from yacy_search_server_tpu.utils.lint import engine  # noqa: E402
+
+
+def main() -> int:
+    res = engine.run()
+    baseline = engine.load_baseline(engine.baseline_path())
+    res = engine.apply_baseline(res, baseline)
+    exemptions: dict[str, int] = res.stats.get("exemptions", {})
+
+    by_new = res.by_checker()
+    by_base: dict[str, int] = {}
+    for f in res.suppressed:
+        by_base[f.checker] = by_base.get(f.checker, 0) + 1
+
+    if "--json" in sys.argv[1:]:
+        print(json.dumps({
+            "new_findings": by_new,
+            "baselined": by_base,
+            "baseline_entries": len(baseline),
+            "stale_baseline": len(res.stale_baseline),
+            "exemptions": dict(sorted(exemptions.items())),
+            "stats": res.stats,
+        }, indent=2))
+        return 0
+
+    print("## yacylint report\n")
+    print("| checker | new findings | baselined |")
+    print("|---|---:|---:|")
+    for cid in sorted(engine.CHECKERS):
+        print(f"| {cid} | {by_new.get(cid, 0)} | {by_base.get(cid, 0)} |")
+    print(f"\nfiles scanned: {res.stats.get('files', 0)} · "
+          f"baseline entries: {len(baseline)} "
+          f"(stale: {len(res.stale_baseline)})")
+    print("\n### exemption audit (`grep -rn '# lint:' "
+          "yacy_search_server_tpu/`)\n")
+    print("| token | count |")
+    print("|---|---:|")
+    for token, n in sorted(exemptions.items()):
+        print(f"| {token} | {n} |")
+    print("\n### checker census\n```")
+    for cid, st in res.stats.items():
+        if cid == "exemptions":
+            continue         # already rendered as its own table
+        if isinstance(st, dict):
+            short = {k: (len(v) if isinstance(v, list) else v)
+                     for k, v in st.items()}
+            print(f"{cid}: {short}")
+    print("```")
+    return 0 if not (res.findings or res.stale_baseline) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -96,11 +96,13 @@ class HTCache:
                 if os.path.exists(p[1]):
                     with open(p[1], encoding="utf-8") as f:
                         headers = json.load(f)
-                self.hits += 1
+                with self._lock:
+                    self.hits += 1
                 return content, headers
             except (OSError, json.JSONDecodeError):
                 pass
-        self.misses += 1
+        with self._lock:
+            self.misses += 1
         return None
 
     def age_s(self, url: str) -> float | None:
@@ -122,7 +124,8 @@ class HTCache:
                         removed += 1
                     except OSError:
                         pass
-        self._ram.clear()
+        with self._lock:
+            self._ram.clear()
         return removed
 
     def delete(self, url: str) -> None:

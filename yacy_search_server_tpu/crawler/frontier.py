@@ -71,9 +71,9 @@ class HostQueue:
             elif rec.get("op") == "pop":
                 alive.pop(rec.get("url", ""), None)
         for r in alive.values():
-            self._push_mem(r)
+            self._push_mem_locked(r)
 
-    def _push_mem(self, req: Request) -> bool:
+    def _push_mem_locked(self, req: Request) -> bool:
         h = req.urlhash()
         if h in self._known:
             return False
@@ -84,7 +84,7 @@ class HostQueue:
 
     def push(self, req: Request) -> bool:
         with self._lock:
-            if not self._push_mem(req):
+            if not self._push_mem_locked(req):
                 return False
             if self._journal:
                 # shared append+fsync helper (ISSUE 10 satellite): the

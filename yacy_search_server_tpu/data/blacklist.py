@@ -63,6 +63,8 @@ class Blacklist:
     def _list_path(self, name: str) -> str:
         return os.path.join(self.data_dir, f"{name}.black")
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the blacklist is shared with any other thread)
     def _load(self) -> None:
         for fn in os.listdir(self.data_dir):
             if not fn.endswith(".black"):
@@ -86,13 +88,19 @@ class Blacklist:
     def _save_list(self, name: str) -> None:
         if not self.data_dir:
             return
+        # snapshot under the (reentrant) lock — callers already hold it,
+        # but the explicit take keeps the read guarded on every path
+        with self._lock:
+            entries = list(self._lists.get(name, []))
+            active = {n: sorted(types)
+                      for n, types in sorted(self._active.items())}
         with open(self._list_path(name), "w", encoding="utf-8") as f:
-            for e in self._lists.get(name, []):
+            for e in entries:
                 f.write(e.raw + "\n")
         with open(os.path.join(self.data_dir, "active.conf"), "w",
                   encoding="utf-8") as f:
-            for n, types in sorted(self._active.items()):
-                f.write(f"{n}={','.join(sorted(types))}\n")
+            for n, types in active.items():
+                f.write(f"{n}={','.join(types)}\n")
 
     # -- management ----------------------------------------------------------
 

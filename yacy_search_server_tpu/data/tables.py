@@ -38,6 +38,8 @@ class Tables:
             return None
         return os.path.join(self.data_dir, table + ".jsonl")
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the table store is shared with any other thread)
     def _load(self, table: str) -> None:
         path = self._path(table)
         rows: dict[str, dict] = {}
@@ -68,10 +70,13 @@ class Tables:
         path = self._path(table)
         if not path:
             return
+        # snapshot under the (reentrant) lock, write outside it
+        with self._lock:
+            rows = list(self._tables.get(table, {}).values())
         tmp = path + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:
-                for row in self._tables.get(table, {}).values():
+                for row in rows:
                     f.write(json.dumps(row) + "\n")
             os.replace(tmp, path)
         except OSError:

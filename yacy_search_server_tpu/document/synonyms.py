@@ -55,7 +55,8 @@ class SynonymLibrary:
                 self._groups[w] = merged
 
     def has_entries(self) -> bool:
-        return bool(self._groups)
+        with self._lock:
+            return bool(self._groups)
 
     def synonyms_of(self, word: str) -> set[str]:
         """Other members of the word's group ('' set when unknown)."""

@@ -167,4 +167,5 @@ class TripleStore:
             return len(victims)
 
     def __len__(self) -> int:
-        return len(self._triples)
+        with self._lock:
+            return len(self._triples)

@@ -139,7 +139,8 @@ class AnnVectorIndex:
         return 0 if self._sdocids is None else len(self._sdocids)
 
     def n_clusters(self) -> int:
-        return 0 if self._ccount is None else len(self._ccount)
+        with self._lock:
+            return 0 if self._ccount is None else len(self._ccount)
 
     def build_from_dense(self, dense, n_clusters: int | None = None,
                          **kw) -> None:
@@ -296,19 +297,31 @@ class AnnVectorIndex:
         rows are zero vectors — their sims tie at 0 and the dispatcher
         drops ids >= n_clusters)."""
         import jax
-        with self._lock:
-            if (self._cent_dev is not None
-                    and self._cent_dev_device is device
-                    and self._cent_dev_version == self.centroid_version):
+        # found by the lint lock-blocking pass: the upload used to run
+        # under the index lock, stalling plan()/cluster_rows behind the
+        # transfer — snapshot under the lock, upload under the
+        # dedicated upload lock, publish under the lock (hot_block's
+        # discipline)
+        # lint: blocking-ok(serializing uploads is _upload_lock's sole
+        # purpose; the index lock is released for the transfer)
+        with self._upload_lock:
+            with self._lock:
+                if (self._cent_dev is not None
+                        and self._cent_dev_device is device
+                        and self._cent_dev_version
+                        == self.centroid_version):
+                    return self._cent_dev
+                C = len(self.centroids)
+                cp = 1 << max(4, (C - 1).bit_length())
+                buf = np.zeros((cp, self.dim), np.float16)
+                buf[:C] = self.centroids.astype(np.float16)
+                ver = self.centroid_version
+            dev = jax.device_put(buf, device)
+            with self._lock:
+                self._cent_dev = dev
+                self._cent_dev_device = device
+                self._cent_dev_version = ver
                 return self._cent_dev
-            C = len(self.centroids)
-            cp = 1 << max(4, (C - 1).bit_length())
-            buf = np.zeros((cp, self.dim), np.float16)
-            buf[:C] = self.centroids.astype(np.float16)
-            self._cent_dev = jax.device_put(buf, device)
-            self._cent_dev_device = device
-            self._cent_dev_version = self.centroid_version
-            return self._cent_dev
 
     def hot_block(self, device):
         """The device-resident hot arena, as an atomic snapshot:
@@ -329,6 +342,8 @@ class AnnVectorIndex:
         lock first, so a concurrent promotion appending to the host
         mirror can never tear a patch."""
         import jax
+        # lint: blocking-ok(serializing uploads is _upload_lock's sole
+        # purpose; the index lock is released for the transfer)
         with self._upload_lock:
             with self._lock:
                 if self._hot_cap == 0:
@@ -401,8 +416,9 @@ class AnnVectorIndex:
     def assign_host(self, qvecs: np.ndarray, nprobe: int) -> np.ndarray:
         """Host centroid assignment (the device-loss fallback and the
         tiny-index path): same bf16-rounded math as the kernel."""
-        return ann_assign_np(self.centroids,
-                             np.atleast_2d(qvecs), nprobe)
+        with self._lock:     # centroid ref snapshot (replaced by build)
+            cents = self.centroids
+        return ann_assign_np(cents, np.atleast_2d(qvecs), nprobe)
 
     def _snapshot_locked(self) -> dict:
         """One consistent view of the slab-layout arrays (replaced
@@ -624,14 +640,19 @@ class AnnVectorIndex:
         --dense-first and the recall tests. Same quantized score
         domain as the probe path; (score DESC, docid ASC) ties."""
         q = np.asarray(qvec, np.float32)
-        n = self.n_vectors()
+        # one consistent ref snapshot: build() replaces these arrays
+        # wholesale, so the chunk loop must not mix generations
+        with self._lock:
+            slab, scales, sdocids = self._slab, self._scales, \
+                self._sdocids
+            n = 0 if sdocids is None else len(sdocids)
         best_s = np.empty(0, np.float64)
         best_d = np.empty(0, np.int64)
         for i0 in range(0, n, chunk):
             i1 = min(i0 + chunk, n)
-            sims = (np.asarray(self._slab[i0:i1], np.float32) @ q) \
-                * np.asarray(self._scales[i0:i1], np.float32)
-            dd = self._sdocids[i0:i1].astype(np.int64)
+            sims = (np.asarray(slab[i0:i1], np.float32) @ q) \
+                * np.asarray(scales[i0:i1], np.float32)
+            dd = sdocids[i0:i1].astype(np.int64)
             s = np.concatenate([best_s, sims])
             d = np.concatenate([best_d, dd])
             order = np.lexsort((d, -s))[:k]

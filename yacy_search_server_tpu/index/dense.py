@@ -227,6 +227,9 @@ class DenseVectorStore:
         set of compile shapes; docids past the bucket simply have no
         vector yet and the kernel scores them with zero boost."""
         import jax
+        # lint: blocking-ok(serializing uploads is _fwd_lock's sole
+        # purpose; the write lock is released for the transfer, so
+        # indexers keep putting vectors while an upload is in flight)
         with self._fwd_lock:
             with self._lock:
                 rows = self._rows_locked()
@@ -296,7 +299,8 @@ class DenseVectorStore:
             return fwd, ver
 
     def __len__(self) -> int:
-        return self._n
+        with self._lock:
+            return self._n
 
     def _save_locked(self) -> None:
         tmp = self._path() + ".tmp"

@@ -1314,16 +1314,25 @@ def _bucket_rows_join(n: int) -> int:
 # may hold the previous buffer mid-dispatch, and donation would invalidate
 # it under that thread — the copy-on-write costs one device-side arena copy
 # per flush (rare), readers keep a consistent old or new buffer either way.
+# lint: costmodel-ok(arena maintenance write — a device-side
+# copy, not a query-path kernel; its cost is the copy XLA
+# itself reports)
 @jax.jit
 def _write_rows2(buf, chunk, off):
     return lax.dynamic_update_slice(buf, chunk, (off, 0))
 
 
+# lint: costmodel-ok(arena maintenance write — a device-side
+# copy, not a query-path kernel; its cost is the copy XLA
+# itself reports)
 @jax.jit
 def _write_rows1(buf, chunk, off):
     return lax.dynamic_update_slice(buf, chunk, (off,))
 
 
+# lint: costmodel-ok(arena maintenance write — a device-side
+# copy, not a query-path kernel; its cost is the copy XLA
+# itself reports)
 @jax.jit
 def _write_rows3(buf, chunk, off):
     return lax.dynamic_update_slice(buf, chunk, (off, 0, 0))
@@ -1731,6 +1740,10 @@ class _QueryBatcher:
         import queue as _queue
         self.store = store
         self.max_batch = max_batch
+        # lint: unbounded-ok(every queued item is a submitter thread
+        # blocked awaiting its reply, so depth is capped by the server
+        # thread pool + admission control — a maxsize would only add a
+        # second blocking point in front of the same cap)
         self._q: "_queue.Queue" = _queue.Queue()
         # ONE-slot handoff: the former blocks here while every
         # dispatcher is busy, and keeps GROWING its batch meanwhile —
@@ -2039,9 +2052,14 @@ class _QueryBatcher:
             if item is None:
                 # one sentinel per DISPATCHER (not per thread: this
                 # former is in _threads too, and an extra put on the
-                # 1-slot queue would block forever)
-                for _ in range(self._dispatchers):
-                    self._ready.put(None)
+                # 1-slot queue would block forever). The tune lock is
+                # held ACROSS the puts: a resize between the count and
+                # the fan-out would under- or over-sentinel the pool
+                # (dispatchers consume _ready without the tune lock, so
+                # the puts drain; set_tuning just waits its turn)
+                with self._tune_lock:
+                    for _ in range(self._dispatchers):
+                        self._ready.put(None)
                 return
             if not self._claim(item, stage="form"):
                 continue  # withdrawn by its submitter while queued
@@ -2249,6 +2267,9 @@ class _QueryBatcher:
         (the same gauges /metrics exports as yacy_batcher_queue_depth)."""
         with self._ms_lock:
             dispatches = self.dispatches
+        # lint: unlocked-ok(gauge read: _dispatchers is an int replaced
+        # atomically under _tune_lock; set_tuning calls tuning() while
+        # HOLDING _tune_lock, so taking it here would deadlock)
         return {"dispatchers": self._dispatchers,
                 "completer_depth": self._completer_depth,
                 "queue_incoming": self._q.qsize(),
@@ -3507,7 +3528,8 @@ class DeviceSegmentStore:
             # inside pack_block_batch: the counter claims only blocks
             # the kernel actually laid down
             if devbuild.MIN_DEV_ROWS <= len(p) <= devbuild.MAX_DEV_ROWS:
-                self.ingest_device_builds += 1
+                with self._lock:     # reentrant counter-cohort lock
+                    self.ingest_device_builds += 1
         return out
 
     def _pack_run_packed(self, run) -> None:
@@ -3658,6 +3680,10 @@ class DeviceSegmentStore:
         """LRU timestamp for a hot packed span (the demotion order)."""
         if not self._tiering_enabled or sp.tkey is None:
             return
+        # lint: unlocked-ok(hot-path LRU stamp only: dict.get is atomic
+        # under the GIL and a racing demotion at worst evicts a span
+        # touched this instant — taking the store lock here would put
+        # every ranked query behind arena mutations)
         ent = self._pblocks.get(sp.tkey)
         if ent is not None:
             ent["touched"] = time.monotonic()
@@ -4264,6 +4290,13 @@ class DeviceSegmentStore:
         # verdict — the hardware-relative numbers every perf claim rides
         util = PROFILER.query_util()
         tb = self.tier_bytes()
+        self._lock.acquire()     # reentrant: one consistent counter view
+        try:
+            return self._counters_locked(b, util, tb, dseries, kseries)
+        finally:
+            self._lock.release()
+
+    def _counters_locked(self, b, util, tb, dseries, kseries) -> dict:
         return {
             "tunnel_rt_ms": self.tunnel_rt_ms,
             "util_pct_p50": util["util_pct_p50"],
@@ -4605,12 +4638,17 @@ class DeviceSegmentStore:
             jdocids, jpos = self.arena.join_arrays()
             bmtab = self.arena.bitmap_array()
             dead = self.arena.dead_array()
-        # RAM deltas are not joinable on device (unsorted, host-side)
+        # RAM deltas are not joinable on device (unsorted, host-side);
+        # the counter bump happens OUTSIDE the rwi lock — taking the
+        # store lock nested under it would invert the store->rwi order
+        # the rank paths establish
         with self.rwi._lock:
-            for th in include_hashes + exclude_hashes:
-                if self.rwi._ram.get(th):
-                    self.fallbacks += 1
-                    return "declined"
+            ram_delta = any(self.rwi._ram.get(th)
+                            for th in include_hashes + exclude_hashes)
+        if ram_delta:
+            with self._lock:
+                self.fallbacks += 1
+            return "declined"
 
         if len(inc_spans) == 1 and not exc_spans:
             return "plain"   # all excludes were nonexistent terms
@@ -4628,7 +4666,8 @@ class DeviceSegmentStore:
         r = min(_bucket_rows_join(rare.count),
                 int(feats16.shape[0]) - rare.start)
         if r < rare.count or rare.count > self.MAX_JOIN_ROWS:
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             return "declined"
 
         # membership mode per partner (static): bitmap slots captured
@@ -4653,7 +4692,8 @@ class DeviceSegmentStore:
         inc_ms = tuple(m for _, m in inc_modes)
         exc_ms = tuple(m for _, m in exc_modes)
         if any(m is None for m in inc_ms + exc_ms):
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             return "declined"
 
         consts = self._profile_consts(profile, language)
@@ -4694,7 +4734,8 @@ class DeviceSegmentStore:
             if res[0] == "ok":
                 s, d = res[1], res[2]
             elif res[0] == "ineligible":
-                self.batch_ineligible += 1
+                with self._lock:
+                    self.batch_ineligible += 1
         if s is None:
             # the bs=1 PACKED batch kernel, not _rank_join_kernel:
             # batcher remainders compile that shape in normal serving,
@@ -5423,7 +5464,8 @@ class DeviceSegmentStore:
                 # straight to the exact packed scan
                 skip_prune = True
             elif res[0] == "ineligible":
-                self.batch_ineligible += 1
+                with self._lock:
+                    self.batch_ineligible += 1
         if (s is None and no_filters and not skip_prune and sp.tcount > 0
                 and sp.dead_seq == len(self.rwi._tombstones)):
             ss, dd, ok = self._pruned_solo_bp(pwords, dead, pmax, sp,
@@ -5610,7 +5652,8 @@ class DeviceSegmentStore:
                 # solo escalation must not repeat that round trip
                 prune_from = 1
             elif res[0] == "ineligible":
-                self.batch_ineligible += 1
+                with self._lock:
+                    self.batch_ineligible += 1
             # "ineligible"/"timeout": fall through to the solo paths
 
         # pruned fast path: one merged span, no delta, no constraint
@@ -5670,7 +5713,8 @@ class DeviceSegmentStore:
             if res[0] == "ok":
                 s, d = res[1], res[2]
             elif res[0] == "ineligible":
-                self.batch_ineligible += 1
+                with self._lock:
+                    self.batch_ineligible += 1
             # timeout/ineligible: the solo scan below serves the query
 
         if s is None:
@@ -5717,6 +5761,10 @@ class DeviceSegmentStore:
                 id(allow_bitmap) if allow_bitmap is not None else 0)
             cached = None
             if skey is not None:
+                # lint: unlocked-ok(GIL-atomic dict read on the hot
+                # path; the weakref identity check below validates
+                # whatever snapshot generation it sees, and writers
+                # hold the store lock)
                 got = self._span_stats_cache.get(skey)
                 if got is not None:
                     fref, dref, aref, stats4 = got

@@ -20,7 +20,9 @@ from .segment import Segment
 class DocumentIndex:
     def __init__(self, segment: Segment | None = None, workers: int = 2):
         self.segment = segment or Segment()
-        self._q: queue.Queue = queue.Queue()
+        # bounded: add_tree can enqueue a whole filesystem walk — the
+        # blocking put is the backpressure that caps queued paths
+        self._q: queue.Queue = queue.Queue(maxsize=4096)
         self._errors: list[tuple[str, str]] = []
         self._done = threading.Event()
         self._threads = [

@@ -203,6 +203,10 @@ class _MeshQueryBatcher:
         import queue as _queue
         self.store = store
         self.max_batch = max_batch
+        # lint: unbounded-ok(every queued item is a submitter thread
+        # blocked awaiting its reply, so depth is capped by the server
+        # thread pool + admission control — devstore._QueryBatcher
+        # parity)
         self._q: "_queue.Queue" = _queue.Queue()
         self._stop = False
         # counters mutate UNDER _ctr_lock (devstore parity: the bare
@@ -972,30 +976,32 @@ class MeshSegmentStore:
     def counters(self) -> dict:
         """Serving-health counters (devstore interface parity)."""
         b = self._batcher
-        return {
-            "queries_served": self.queries_served,
-            "fallbacks": self.fallbacks,
-            "device_lost": 1 if self.device_lost else 0,
-            "device_losses": self.device_losses,
-            "device_loss_recoveries": self.device_loss_recoveries,
-            "device_lost_queries": self.device_lost_queries,
-            "transfer_failures": self.transfer_failures,
-            "transfer_retries": self.transfer_retries,
-            "rank_cache_hits": self._topk_cache.hits,
-            "rank_cache_stale": self._topk_cache.stale,
-            "arena_epoch": self.arena_epoch,
-            "device_round_trips": self.device_round_trips,
-            "prune_rounds": self.prune_rounds,
-            "pruned_tiles": self.pruned_tiles,
-            "batch_dispatches": b.dispatches if b else 0,
-            "batch_timeouts": b.timeouts if b else 0,
-            "batch_timeout_queue_full": b.timeout_queue_full if b else 0,
-            "batch_timeout_flush_deadline":
-                b.timeout_flush_deadline if b else 0,
-            "batch_timeout_worker_stall":
-                b.timeout_worker_stall if b else 0,
-            "batch_exceptions": b.exceptions if b else 0,
-        }
+        with self._lock:     # reentrant: one consistent counter view
+            return {
+                "queries_served": self.queries_served,
+                "fallbacks": self.fallbacks,
+                "device_lost": 1 if self.device_lost else 0,
+                "device_losses": self.device_losses,
+                "device_loss_recoveries": self.device_loss_recoveries,
+                "device_lost_queries": self.device_lost_queries,
+                "transfer_failures": self.transfer_failures,
+                "transfer_retries": self.transfer_retries,
+                "rank_cache_hits": self._topk_cache.hits,
+                "rank_cache_stale": self._topk_cache.stale,
+                "arena_epoch": self.arena_epoch,
+                "device_round_trips": self.device_round_trips,
+                "prune_rounds": self.prune_rounds,
+                "pruned_tiles": self.pruned_tiles,
+                "batch_dispatches": b.dispatches if b else 0,
+                "batch_timeouts": b.timeouts if b else 0,
+                "batch_timeout_queue_full":
+                    b.timeout_queue_full if b else 0,
+                "batch_timeout_flush_deadline":
+                    b.timeout_flush_deadline if b else 0,
+                "batch_timeout_worker_stall":
+                    b.timeout_worker_stall if b else 0,
+                "batch_exceptions": b.exceptions if b else 0,
+            }
 
     def close(self) -> None:
         if self._batcher is not None:
@@ -1075,10 +1081,11 @@ class MeshSegmentStore:
         return self._dev_arrays
 
     def _dead_array(self):
-        if self._dirty_dead or self._dev_dead is None:
-            self._dev_dead = self._put(self._dead_host, PS())
-            self._dirty_dead = False
-        return self._dev_dead
+        with self._lock:     # reentrant: rank paths already hold it
+            if self._dirty_dead or self._dev_dead is None:
+                self._dev_dead = self._put(self._dead_host, PS())
+                self._dirty_dead = False
+            return self._dev_dead
 
     def _profile_consts(self, profile, language: str):
         key = (profile.to_external_string(), language)
@@ -1193,6 +1200,9 @@ class MeshSegmentStore:
         (scores, docids, considered) or None for host fallback — and
         None (counted) while the mesh is declared lost or a transfer
         dies under this query (ISSUE 10c): NEVER an exception."""
+        # lint: unlocked-ok(racy bool read by design: a stale False
+        # costs one failed transfer that re-classifies; locking here
+        # would serialize every rank entry behind store mutations)
         if self.device_lost:
             with self._lock:
                 self.device_lost_queries += 1
@@ -1448,6 +1458,9 @@ class MeshSegmentStore:
         HTTP round trip (VERDICT r3 #3). Host fallback remains only for
         multi-span terms, unflushed RAM deltas — and a lost mesh
         (ISSUE 10c: counted, never an exception)."""
+        # lint: unlocked-ok(racy bool read by design: a stale False
+        # costs one failed transfer that re-classifies; locking here
+        # would serialize every rank entry behind store mutations)
         if self.device_lost:
             with self._lock:
                 self.device_lost_queries += 1
@@ -1506,11 +1519,14 @@ class MeshSegmentStore:
             dead = self._dead_array()
             JC = int(jdocids.shape[1])
             C = int(arrays[0].shape[1])
+        # counter bump outside the rwi lock (store->rwi lock order)
         with self.rwi._lock:
-            for th in include_hashes + exclude_hashes:
-                if self.rwi._ram.get(th):
-                    self.fallbacks += 1
-                    return None
+            ram_delta = any(self.rwi._ram.get(th)
+                            for th in include_hashes + exclude_hashes)
+        if ram_delta:
+            with self._lock:
+                self.fallbacks += 1
+            return None
 
         rare_i = min(range(len(inc_spans)),
                      key=lambda i: inc_spans[i].total)
@@ -1520,7 +1536,8 @@ class MeshSegmentStore:
 
         r = _bucket_rows(max(int(rare.counts.max()), 1))
         if int((rare.starts + r).max()) > C:
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             return None
 
         def window(sp):
@@ -1530,7 +1547,8 @@ class MeshSegmentStore:
         inc_ms = tuple(window(sp) for sp in partners)
         exc_ms = tuple(window(sp) for sp in exc_spans)
         if any(m is None for m in inc_ms + exc_ms):
-            self.fallbacks += 1
+            with self._lock:
+                self.fallbacks += 1
             return None
 
         n_inc, n_exc = len(partners), len(exc_spans)

@@ -406,6 +406,8 @@ class MetadataStore:
     def _path(self, name: str) -> str:
         return os.path.join(self.data_dir, name)
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the store is shared with any other thread)
     def _open_disk(self) -> None:
         manifest = self._path("metadata.manifest.json")
         jp = self._path("metadata.jsonl")
@@ -453,15 +455,16 @@ class MetadataStore:
     def _rebuild_override_facets(self) -> None:
         """Overrides of facet fields must shadow the frozen facet tables
         (rare — migrations backfill; rebuilt at open from the overrides)."""
-        for f in FACET_FIELDS:
-            ov = self._overrides.get(f)
-            if not ov:
-                continue
-            for docid, value in ov.items():
-                self._facet_removed[f].add(docid)
-                v = str(value or "").lower()
-                if v:
-                    self._facets[f].setdefault(v, []).append(docid)
+        with self._lock:     # reentrant: snapshot() already holds it
+            for f in FACET_FIELDS:
+                ov = self._overrides.get(f)
+                if not ov:
+                    continue
+                for docid, value in ov.items():
+                    self._facet_removed[f].add(docid)
+                    v = str(value or "").lower()
+                    if v:
+                        self._facets[f].setdefault(v, []).append(docid)
 
     # -- write ---------------------------------------------------------------
 
@@ -567,7 +570,7 @@ class MetadataStore:
                 if old == value:
                     continue
                 if field in FACET_FIELDS:
-                    self._facet_update(field, docid, old, value)
+                    self._facet_update_locked(field, docid, old, value)
                 if docid >= self._frozen_n:
                     t = docid - self._frozen_n
                     if field in INT_FIELDS:
@@ -584,7 +587,7 @@ class MetadataStore:
                 rec.update(changed)
                 journal_append(self._journal, json.dumps(rec))
 
-    def _facet_update(self, field: str, docid: int, old, new) -> None:
+    def _facet_update_locked(self, field: str, docid: int, old, new) -> None:
         old_v = str(old or "").lower()
         new_v = str(new or "").lower()
         if docid >= self._frozen_n:
@@ -611,38 +614,41 @@ class MetadataStore:
 
     # -- low-level reads -----------------------------------------------------
 
-    def _seg_for(self, docid: int) -> tuple[SegmentReader, int]:
+    def _seg_for_locked(self, docid: int) -> tuple[SegmentReader, int]:
         """(segment, base) owning a frozen docid (bisect on bases)."""
         import bisect
         i = bisect.bisect_right(self._seg_bases, docid) - 1
         return self._segs[i], self._seg_bases[i]
 
     def _get_text(self, docid: int, field: str) -> str:
-        ov = self._overrides.get(field)
-        if ov is not None and docid in ov:
-            return ov[docid]
-        if docid >= self._frozen_n:
-            return self._text[field][docid - self._frozen_n]
-        seg, base = self._seg_for(docid)
+        with self._lock:     # reentrant: row renderers may hold it
+            ov = self._overrides.get(field)
+            if ov is not None and docid in ov:
+                return ov[docid]
+            if docid >= self._frozen_n:
+                return self._text[field][docid - self._frozen_n]
+            seg, base = self._seg_for_locked(docid)
         return seg.text(field, docid - base) if seg.has_text(field) else ""
 
     def _get_int(self, docid: int, field: str) -> int:
-        ov = self._overrides.get(field)
-        if ov is not None and docid in ov:
-            return ov[docid]
-        if docid >= self._frozen_n:
-            return self._ints[field][docid - self._frozen_n]
-        seg, base = self._seg_for(docid)
+        with self._lock:
+            ov = self._overrides.get(field)
+            if ov is not None and docid in ov:
+                return ov[docid]
+            if docid >= self._frozen_n:
+                return self._ints[field][docid - self._frozen_n]
+            seg, base = self._seg_for_locked(docid)
         return int(seg.array(field)[docid - base]) \
             if seg.has_array(field) else 0
 
     def _get_double(self, docid: int, field: str) -> float:
-        ov = self._overrides.get(field)
-        if ov is not None and docid in ov:
-            return ov[docid]
-        if docid >= self._frozen_n:
-            return self._doubles[field][docid - self._frozen_n]
-        seg, base = self._seg_for(docid)
+        with self._lock:
+            ov = self._overrides.get(field)
+            if ov is not None and docid in ov:
+                return ov[docid]
+            if docid >= self._frozen_n:
+                return self._doubles[field][docid - self._frozen_n]
+            seg, base = self._seg_for_locked(docid)
         return float(seg.array(field)[docid - base]) \
             if seg.has_array(field) else 0.0
 
@@ -661,18 +667,23 @@ class MetadataStore:
         return self._get_text(docid, field)
 
     def _group_by_segment(self, docids):
-        """(out_template, tail/override positions resolved, seg->positions)
-        shared by the batched column readers."""
+        """(direct positions, {(seg, base) group: positions}) shared by
+        the batched column readers — the (seg, base) pairs are captured
+        under the lock, so a concurrent merge shrinking the segment
+        lists cannot misalign (or IndexError) the readers."""
         import bisect
         seg_groups: dict[int, list[int]] = {}
         direct: list[int] = []          # positions answered per-row
-        for pos, d in enumerate(docids):
-            if d >= self._frozen_n:
-                direct.append(pos)
-            else:
-                i = bisect.bisect_right(self._seg_bases, d) - 1
-                seg_groups.setdefault(i, []).append(pos)
-        return direct, seg_groups
+        with self._lock:     # reentrant: one frozen/segment-base view
+            for pos, d in enumerate(docids):
+                if d >= self._frozen_n:
+                    direct.append(pos)
+                else:
+                    i = bisect.bisect_right(self._seg_bases, d) - 1
+                    seg_groups.setdefault(i, []).append(pos)
+            resolved = [(self._segs[i], self._seg_bases[i], poss)
+                        for i, poss in seg_groups.items()]
+        return direct, resolved
 
     def text_values(self, docids, field: str) -> list[str]:
         """Batched text reads for the drain/navigator hot path: one
@@ -680,12 +691,12 @@ class MetadataStore:
         (~7 fields x 80 candidates per query on the serving path)."""
         docids = list(docids)
         out = [""] * len(docids)
-        ov = self._overrides.get(field)
+        with self._lock:
+            ov = self._overrides.get(field)
         direct, seg_groups = self._group_by_segment(docids)
         for pos in direct:
             out[pos] = self._get_text(docids[pos], field)
-        for i, poss in seg_groups.items():
-            seg, base = self._segs[i], self._seg_bases[i]
+        for seg, base, poss in seg_groups:
             if seg.has_text(field):
                 rows = np.asarray([docids[p] - base for p in poss])
                 for p, v in zip(poss, seg.texts_at(field, rows)):
@@ -700,12 +711,12 @@ class MetadataStore:
         """Batched int reads (see text_values)."""
         docids = list(docids)
         out = [0] * len(docids)
-        ov = self._overrides.get(field)
+        with self._lock:
+            ov = self._overrides.get(field)
         direct, seg_groups = self._group_by_segment(docids)
         for pos in direct:
             out[pos] = self._get_int(docids[pos], field)
-        for i, poss in seg_groups.items():
-            seg, base = self._segs[i], self._seg_bases[i]
+        for seg, base, poss in seg_groups:
             if seg.has_array(field):
                 col = seg.array(field)
                 rows = np.asarray([docids[p] - base for p in poss])
@@ -719,10 +730,10 @@ class MetadataStore:
 
     def docid(self, urlhash: bytes) -> int | None:
         with self._lock:
-            d = self._lookup(urlhash)
+            d = self._lookup_locked(urlhash)
             return None if d is None or d in self._deleted else d
 
-    def _lookup(self, urlhash: bytes) -> int | None:
+    def _lookup_locked(self, urlhash: bytes) -> int | None:
         d = self._tail_map.get(urlhash)
         if d is not None:
             return d
@@ -738,9 +749,10 @@ class MetadataStore:
         return None
 
     def urlhash_of(self, docid: int) -> bytes:
-        if docid >= self._frozen_n:
-            return self._tail_hashes[docid - self._frozen_n]
-        seg, base = self._seg_for(docid)
+        with self._lock:
+            if docid >= self._frozen_n:
+                return self._tail_hashes[docid - self._frozen_n]
+            seg, base = self._seg_for_locked(docid)
         return bytes(seg.array("urlhashes")[docid - base])
 
     def exists(self, urlhash: bytes) -> bool:
@@ -782,7 +794,8 @@ class MetadataStore:
 
     def capacity(self) -> int:
         """Highest docid + 1 (dense device columns size to this)."""
-        return self._frozen_n + len(self._tail_hashes)
+        with self._lock:
+            return self._frozen_n + len(self._tail_hashes)
 
     # -- device columns ------------------------------------------------------
 
@@ -897,7 +910,7 @@ class MetadataStore:
             if n:
                 segname = f"metadata.{self._seg_seq:06d}.seg"
                 self._seg_seq += 1
-                self._write_tail_segment(self._path(segname), n)
+                self._write_tail_segment_locked(self._path(segname), n)
                 seg = SegmentReader(self._path(segname))
                 self._seg_bases.append(self._frozen_n)
                 self._segs.append(seg)
@@ -914,10 +927,10 @@ class MetadataStore:
                     self._facets[f] = {}
                 self._rebuild_override_facets()
             if len(self._segs) > MAX_SEGMENTS:
-                self._merge_smallest()
-            self._persist_state()
+                self._merge_smallest_locked()
+            self._persist_state_locked()
 
-    def _write_tail_segment(self, path: str, n: int) -> None:
+    def _write_tail_segment_locked(self, path: str, n: int) -> None:
         hashes = np.asarray(self._tail_hashes, dtype="S12")
         order = np.argsort(hashes, kind="stable")
         arrays: dict[str, np.ndarray] = {
@@ -964,7 +977,7 @@ class MetadataStore:
                 texts[f] = col
         write_segment(path, n, arrays, texts, meta={"facets": facets_meta})
 
-    def _merge_smallest(self) -> None:
+    def _merge_smallest_locked(self) -> None:
         """Merge the two smallest ADJACENT segments into one (bounded
         memory: the two victims' size). Deleted rows keep their docid
         slot but their payload is blanked; overrides covering merged rows
@@ -1067,7 +1080,7 @@ class MetadataStore:
         # manifest whose every segment file still exists
         self._pending_remove += [old_a, old_b]
 
-    def _persist_state(self) -> None:
+    def _persist_state_locked(self) -> None:
         import io
 
         from .colstore import write_durable

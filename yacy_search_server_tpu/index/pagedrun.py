@@ -118,7 +118,8 @@ class TermCache:
 
     @property
     def resident_bytes(self) -> int:
-        return self._bytes
+        with self._lock:
+            return self._bytes
 
 
 class PagedRun:

@@ -218,10 +218,14 @@ class RWIIndex:
             return
         mp = os.path.join(self.data_dir, "runs.txt")
         tmp = mp + ".tmp"
+        # snapshot the run list under the (reentrant) lock; the write
+        # itself needs only the frozen name list
+        with self._lock:
+            names = [os.path.basename(r.path) for r in self._runs
+                     if r.path]
         with open(tmp, "w", encoding="ascii") as f:
-            for r in self._runs:
-                if r.path:
-                    f.write(os.path.basename(r.path) + "\n")
+            for name in names:
+                f.write(name + "\n")
             f.flush()
             os.fsync(f.fileno())
         # chaos barrier: manifest .tmp durable but not renamed — restart
@@ -231,6 +235,8 @@ class RWIIndex:
         from .colstore import fsync_dir
         fsync_dir(self.data_dir)
 
+    # lint: unlocked-ok(construction-time: only the __init__ open path
+    # calls this, before the index is shared with any other thread)
     def _replay_deletions(self, path: str) -> None:
         def run_seq_of(run) -> int:
             return int(os.path.basename(run.path)[4:-4]) if run.path else -1
@@ -632,7 +638,8 @@ class RWIIndex:
     # -- read path -----------------------------------------------------------
 
     def _ram_postings(self, termhash: bytes) -> PostingsList | None:
-        rows = self._ram.get(termhash)
+        with self._lock:     # reentrant: get() already holds it
+            rows = list(self._ram.get(termhash) or ())
         if not rows:
             return None
         d = np.fromiter((r[0] for r in rows), dtype=np.int32, count=len(rows))

@@ -184,6 +184,8 @@ class WebgraphStore:
     def _path(self, name: str) -> str:
         return os.path.join(self.data_dir, name)
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the store is shared with any other thread)
     def _open_disk(self) -> None:
         manifest = self._path("webgraph.manifest.json")
         jp = self._path("webgraph.jsonl")
@@ -629,15 +631,15 @@ class WebgraphStore:
                 self._by_target_id = defaultdict(list)
                 self._by_source_host = defaultdict(list)
             while len(self._segs) > MAX_SEGMENTS:
-                self._merge_smallest()
-            self._persist_state()
+                self._merge_smallest_locked()
+            self._persist_state_locked()
 
-    def _merge_smallest(self) -> None:
+    def _merge_smallest_locked(self) -> None:
         sizes = [s.n for s in self._segs]
         i = min(range(len(sizes) - 1), key=lambda j: sizes[j] + sizes[j + 1])
-        self._rewrite_range(i, 2)
+        self._rewrite_range_locked(i, 2)
 
-    def _rewrite_range(self, i: int, count: int) -> None:
+    def _rewrite_range_locked(self, i: int, count: int) -> None:
         """Rewrite `count` adjacent segments starting at `i` into one,
         DROPPING dead rows — edge ids are internal, so renumbering is
         safe; the global dead set and later bases shift accordingly."""
@@ -712,7 +714,7 @@ class WebgraphStore:
         # deleted only after the manifest stops referencing them
         self._pending_remove += old_paths
 
-    def _persist_state(self) -> None:
+    def _persist_state_locked(self) -> None:
         import io
 
         from .colstore import write_durable
@@ -757,10 +759,10 @@ class WebgraphStore:
             if self.data_dir:
                 self.snapshot()
                 while len(self._segs) > 1:
-                    self._merge_smallest()
+                    self._merge_smallest_locked()
                 if self._segs and self._dead:
-                    self._rewrite_range(0, 1)
-                self._persist_state()
+                    self._rewrite_range_locked(0, 1)
+                self._persist_state_locked()
             else:
                 self._compact_tail()
 

@@ -94,6 +94,9 @@ def _ann_assign_batch_kernel(cent, qv, np_: int, c_real: int):
                    preferred_element_type=jnp.float32)    # (B, C)
     sims = jnp.where(jnp.arange(cent.shape[0])[None, :] < c_real,
                      sims, -jnp.inf)
+    # lint: tie-ok(ties resolve by centroid id ASC: top_k orders
+    # ties by input position, which IS the centroid id — see the
+    # docstring)
     _s, ids = lax.top_k(sims, np_)
     return ids.astype(jnp.int32)
 

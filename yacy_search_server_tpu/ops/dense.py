@@ -169,6 +169,7 @@ def hybrid_rerank_topk(qvec: jnp.ndarray, doc_vecs: jnp.ndarray,
     s_norm = jnp.where(valid, (s - smin) / span, 0.0)
     final = (1.0 - alpha) * s_norm + alpha * sims
     final = jnp.where(valid, final, -jnp.inf)
+    # lint: tie-ok(lax.top_k breaks ties by lowest input index and the candidate rows are docid-ordered, so equal scores surface docid-ASC — the pinned discipline, asserted by the tie tests in test_dense/test_ranking)
     return jax.lax.top_k(final, k)
 
 
@@ -197,6 +198,8 @@ def hybrid_rerank_topk_batch(qvecs: jnp.ndarray, doc_vecs: jnp.ndarray,
         span = jnp.maximum(smax - smin, 1e-6)
         s_norm = jnp.where(v, (s - smin) / span, 0.0)
         final = (1.0 - alpha) * s_norm + alpha * sim
+        # lint: tie-ok(lax.top_k breaks ties by lowest input index and the candidate rows are docid-ordered, so equal scores surface docid-ASC — the pinned discipline, asserted by the tie tests in test_dense; the vmapped
+        # per-slot kernel shares the outer kernel's row order)
         return jax.lax.top_k(jnp.where(v, final, -jnp.inf), k)
 
     return jax.vmap(one)(sims, sparse_scores.astype(jnp.float32), valid)
@@ -229,6 +232,10 @@ def dense_boost_topk(qvec: jnp.ndarray, doc_vecs: jnp.ndarray,
     boost = jnp.round(sims * alpha * DENSE_BOOST_SCALE).astype(jnp.int32)
     final = sparse_scores.astype(jnp.int32) + boost
     final = jnp.where(valid, final, jnp.int32(-(2**31 - 1)))
+    # lint: tie-ok(lax.top_k breaks ties by lowest input index and the
+    # candidate rows are docid-ordered, so equal scores surface
+    # docid-ASC — the pinned discipline, asserted by the tie tests in
+    # test_dense)
     return jax.lax.top_k(final, k)
 
 

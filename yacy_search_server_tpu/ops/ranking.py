@@ -406,6 +406,7 @@ def score_topk16(feats16: jnp.ndarray, flags: jnp.ndarray,
                                domlength_coeff, tf_coeff, language_coeff,
                                authority_coeff, language_pref,
                                with_authority=with_authority)
+    # lint: tie-ok(lax.top_k breaks ties by lowest input index and the candidate rows are docid-ordered, so equal scores surface docid-ASC — the pinned discipline, asserted by the tie tests in test_ranking)
     top_scores, top_idx = jax.lax.top_k(scores, k)
     return top_scores, docids[top_idx], top_idx
 
@@ -445,6 +446,7 @@ def score_topk(feats: jnp.ndarray, docids: jnp.ndarray, valid: jnp.ndarray,
     scores = cardinal_scores(feats, valid, hostids, norm_coeffs, flag_bits,
                              flag_shifts, domlength_coeff, tf_coeff,
                              language_coeff, authority_coeff, language_pref)
+    # lint: tie-ok(lax.top_k breaks ties by lowest input index and the candidate rows are docid-ordered, so equal scores surface docid-ASC — the pinned discipline, asserted by the tie tests in test_ranking)
     top_scores, top_idx = jax.lax.top_k(scores, k)
     return top_scores, docids[top_idx], top_idx
 
@@ -667,6 +669,7 @@ def bm25_topk(tf: jnp.ndarray, doclen: jnp.ndarray, df: jnp.ndarray,
     score = jnp.sum(idf[None, :] * tf * (k1 + 1.0) / jnp.maximum(denom, 1e-9),
                     axis=1)
     score = jnp.where(valid, score, -jnp.inf)
+    # lint: tie-ok(lax.top_k breaks ties by lowest input index and the candidate rows are docid-ordered, so equal scores surface docid-ASC — the pinned discipline, asserted by the tie tests in test_ranking)
     top_scores, top_idx = jax.lax.top_k(score, k)
     return top_scores, docids[top_idx]
 

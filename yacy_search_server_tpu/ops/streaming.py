@@ -53,6 +53,10 @@ def merge_stats(a: dict | None, b: dict) -> dict:
 def _merge_topk(run_s, run_d, new_s, new_d, k: int):
     s = jnp.concatenate([run_s, new_s])
     d = jnp.concatenate([run_d, new_d])
+    # lint: tie-ok(the running top-k precedes the new tile in the
+    # concat and earlier tiles hold lower docids, so top_k's
+    # lowest-index tie-break keeps equal scores docid-ASC across
+    # the whole stream)
     top_s, idx = jax.lax.top_k(s, k)
     return top_s, d[idx]
 
@@ -98,6 +102,9 @@ def scan_score_topk(feats16: jnp.ndarray, flags: jnp.ndarray,
                                 flag_bits, flag_shifts, domlength_coeff,
                                 tf_coeff, language_coeff, authority_coeff,
                                 language_pref, fast_div=True, flags=tfl)
+        # lint: tie-ok(per-tile prefilter: rows are docid-ordered so
+        # lowest-index ties are docid-ASC, and _merge_topk preserves
+        # that order across tiles)
         tile_s, tile_i = jax.lax.top_k(s, min(k, tile))
         return _merge_topk(run_s, run_d, tile_s, tdd[tile_i], k), None
 
@@ -144,6 +151,9 @@ def stream_score_topk(feats: np.ndarray, flags: np.ndarray,
             language_pref, fast_div=feats.dtype == np.int16,
             flags=jnp.asarray(flags[lo:hi]))
         kk = min(k, hi - lo)
+        # lint: tie-ok(per-chunk prefilter: rows are docid-ordered so
+        # lowest-index ties are docid-ASC, and _merge_topk preserves
+        # that order across chunks)
         tile_s, tile_i = jax.lax.top_k(s, kk)
         run_s, run_d = _merge_topk(
             run_s, run_d, tile_s,

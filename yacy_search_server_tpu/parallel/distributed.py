@@ -204,7 +204,11 @@ class MeshMember:
         self.http_ports = list(http_ports)
         self.name = f"mesh{process_id}"
         self._stop = threading.Event()
-        self._steps: "_queue.Queue" = _queue.Queue()
+        # bounded: a flooding (or buggy) peer scattering steps faster
+        # than the runloop executes them must hit backpressure at the
+        # wire, not grow an unbounded step backlog (the coordinator
+        # serializes on _serve_lock, so a handful is the healthy depth)
+        self._steps: "_queue.Queue" = _queue.Queue(maxsize=512)
         self._pending: dict[int, dict] = {}
         self._plock = threading.Lock()
         self._serve_lock = threading.Lock()
@@ -303,7 +307,8 @@ class MeshMember:
                 # phases): decide LOCALLY for host mode — bounded, and
                 # a peer that entered the collective without us errors
                 # out of it on the fabric timeout (rank_term_mp catches)
-                self.commit_timeouts += 1
+                with self._plock:
+                    self.commit_timeouts += 1
                 rec["go"] = False
             try:
                 self._execute(rec)
@@ -365,6 +370,9 @@ class MeshMember:
         cross-process SPMD collective when committed, the host answer
         when degraded.  100% of queries answer either way."""
         from ..utils import tracing
+        # lint: blocking-ok(SPMD lockstep: the coordinator scatter is
+        # deliberately serialized — _serve_lock IS the fleet-wide step
+        # ordering, so the RPCs and the step wait belong inside it)
         with self._serve_lock, tracing.trace("mesh.serve"):
             seq = self._seq
             self._seq += 1
@@ -446,15 +454,17 @@ class MeshMember:
                 "p95_ms": round(h.percentile(0.95), 3) if h else 0.0}
         fl = getattr(self.sb, "fleet", None)
         rows = fl.peer_rows() if fl is not None else []
+        with self._plock:
+            runtime = {
+                "queries_total": self.queries_total,
+                "answered_collective": self.answered_collective,
+                "answered_host": self.answered_host,
+                "step_errors": self.step_errors,
+                "member_down_steps": self.member_down_steps,
+                "commit_timeouts": self.commit_timeouts}
         return {**self._health(),
                 "counters": self.store.counters(),
-                "runtime": {
-                    "queries_total": self.queries_total,
-                    "answered_collective": self.answered_collective,
-                    "answered_host": self.answered_host,
-                    "step_errors": self.step_errors,
-                    "member_down_steps": self.member_down_steps,
-                    "commit_timeouts": self.commit_timeouts},
+                "runtime": runtime,
                 "collective_hist": hist,
                 "digest_bytes": fl.last_digest_bytes if fl else 0,
                 "fleet_peers": len(rows),

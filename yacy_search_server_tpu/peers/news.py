@@ -183,6 +183,8 @@ class NewsPool:
         except OSError:
             pass
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the pool is shared with any other thread)
     def _load(self) -> None:
         if not self._path or not os.path.exists(self._path):
             return
@@ -210,6 +212,8 @@ class NewsPool:
             pass
         self._compact()
 
+    # lint: unlocked-ok(construction-time: only _load calls this,
+    # still inside __init__ before the pool is shared)
     def _compact(self) -> None:
         """Rewrite the append-only journal with only live state — expired,
         superseded and processed-and-forgotten lines drop out, bounding the

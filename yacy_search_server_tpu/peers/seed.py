@@ -188,6 +188,8 @@ class SeedDB:
                 for s in seeds.values():
                     f.write(json.dumps({"t": table, "dna": s.dna()}) + "\n")
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the seed DB is shared with any other thread)
     def _load(self) -> None:
         with open(self._path, encoding="utf-8") as f:
             for line in f:

@@ -818,8 +818,9 @@ class SearchEvent:
         with tracing.span_in(self.trace_ctx, "search.fusion_remote",
                              n=len(entries), peer=src0):
             added = self._add_remote_locked(entries)
-        self.remote_results += added
-        self.touched = time.time()
+        with self._lock:
+            self.remote_results += added
+            self.touched = time.time()
         return added
 
     def _add_remote_locked(self, entries: list[ResultEntry]) -> int:
@@ -963,7 +964,7 @@ class SearchEvent:
                 continue
             with self._lock:
                 self._snippet_evicted.add(e.urlhash)
-            self.snippet_evictions += 1
+                self.snippet_evictions += 1
             evicted += 1
             if outcome == SNIPPET_DEAD and e.source == "local":
                 # the fetch proved the document gone: purge it from the
@@ -1114,4 +1115,5 @@ class SearchEventCache:
             self._events.clear()
 
     def __len__(self) -> int:
-        return len(self._events)
+        with self._lock:
+            return len(self._events)

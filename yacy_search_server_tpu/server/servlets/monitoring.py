@@ -100,6 +100,8 @@ def respond_viewfile(header: dict, post: ServerObjects, sb) -> ServerObjects:
     return prop
 
 
+# lint: trace-ok(renders PROFILER aggregates to a dashboard; serves no
+# query and measures no request wall of its own)
 @servlet("Performance_Roofline_p")
 def respond_roofline(header: dict, post: ServerObjects,
                      sb) -> ServerObjects:

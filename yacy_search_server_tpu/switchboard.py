@@ -330,6 +330,8 @@ class Switchboard:
         resp = self.loader.load(Request(url), CacheStrategy.IFFRESH)
         return resp.content if resp.status == 200 else None
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the switchboard is shared with any other thread)
     def _load_profiles(self) -> None:
         import json
         if not self._profiles_path or not os.path.exists(self._profiles_path):

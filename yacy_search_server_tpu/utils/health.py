@@ -632,7 +632,7 @@ class HealthEngine:
                     "actuator tick failed", exc_info=True)
         if do_dump:
             with self._lock:
-                self._dump_incident(now, entered_critical)
+                self._dump_incident_locked(now, entered_critical)
         return self.overall()
 
     def tick_job(self) -> bool:
@@ -669,7 +669,7 @@ class HealthEngine:
 
     # -- flight recorder -----------------------------------------------------
 
-    def _dump_incident(self, now: float, entered: list) -> None:
+    def _dump_incident_locked(self, now: float, entered: list) -> None:
         """Serialize the ring + firing rules + exemplars + recent traces
         as one JSONL incident (called under `_lock`, edge-triggered and
         rate-limited by the caller)."""

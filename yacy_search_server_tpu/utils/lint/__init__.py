@@ -1,0 +1,37 @@
+"""yacylint — the whole-repo static-analysis engine (ISSUE 14).
+
+One ``ast.parse`` per file feeds a registered checker pipeline: a
+lockset race detector, a blocking-call-under-lock pass, the tie
+discipline lint, unbounded-queue / counter-outside-lock lints, a jit
+purity lint, and the migrated hygiene scanners (cost models, oracles,
+broad excepts, servlet spans).  Findings are ``file:line [checker]
+message`` records; pre-existing debt is pinned in LINT_BASELINE.json
+(shrink-only); every suppression is one grammar —
+``# lint: <token>(reason)`` — so an exemption audit is a single grep.
+
+Run it::
+
+    python -m yacy_search_server_tpu.utils.lint            # gate (CI)
+    python -m yacy_search_server_tpu.utils.lint --json     # machine form
+    python tools/lint_report.py                            # PR summary
+
+Jax-free by contract: stdlib only, so the gate runs in any interpreter
+(tests/test_lint.py pins this).
+"""
+
+from .engine import (  # noqa: F401
+    BASELINE_NAME,
+    CHECKERS,
+    Finding,
+    LintResult,
+    Repo,
+    apply_baseline,
+    baseline_path,
+    checker,
+    discover,
+    known_tokens,
+    load_baseline,
+    run,
+    write_baseline,
+)
+from .checkers import named_kernels, roofline_registry  # noqa: F401

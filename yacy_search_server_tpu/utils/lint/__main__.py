@@ -1,0 +1,87 @@
+"""CLI: ``python -m yacy_search_server_tpu.utils.lint``.
+
+Exit 0 when the tree is clean against the committed baseline (and the
+baseline has no stale entries); exit 1 otherwise.  ``--write-baseline``
+pins the CURRENT findings as debt — for bootstrapping only; the merge
+rule is that LINT_BASELINE.json may only shrink (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import engine
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m yacy_search_server_tpu.utils.lint",
+        description="yacylint: single-parse multi-checker static "
+                    "analysis over the package tree")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files to lint (default: the "
+                         "whole package)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings + stats")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings, ignoring "
+                         "LINT_BASELINE.json")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="pin current findings as the new baseline "
+                         "(bootstrap only — baselines may only shrink)")
+    ap.add_argument("--checker", action="append", default=None,
+                    help="run only this checker id (repeatable)")
+    args = ap.parse_args(argv)
+    if args.write_baseline and (args.paths or args.checker):
+        ap.error("--write-baseline requires a FULL run: a subset "
+                 "baseline would silently delete every pinned entry "
+                 "outside the subset")
+
+    result = engine.run(rel_paths=args.paths or None,
+                        only=set(args.checker) if args.checker else None)
+    bl_path = engine.baseline_path()
+    if args.write_baseline:
+        engine.write_baseline(bl_path, result)
+        print(f"wrote {len(result.findings)} finding(s) to {bl_path}")
+        return 0
+    if not args.no_baseline:
+        result = engine.apply_baseline(result,
+                                       engine.load_baseline(bl_path))
+        if args.paths or args.checker:
+            # a subset run never generates the findings behind the
+            # out-of-scope baseline entries — only a FULL run can
+            # judge staleness (the shrink-only rule)
+            result.stale_baseline = []
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in result.findings],
+            "suppressed_by_baseline": len(result.suppressed),
+            "stale_baseline": result.stale_baseline,
+            "by_checker": result.by_checker(),
+            "stats": result.stats,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.render())
+        if result.stale_baseline:
+            print(f"-- {len(result.stale_baseline)} stale baseline "
+                  f"entr{'y' if len(result.stale_baseline) == 1 else 'ies'} "
+                  f"(fixed findings still pinned): delete them from "
+                  f"{engine.BASELINE_NAME} — baselines only shrink")
+            for e in result.stale_baseline:
+                print(f"   stale: {e['checker']}::{e['path']}:"
+                      f"{e['line']}")
+        n = len(result.findings)
+        sup = len(result.suppressed)
+        print(f"yacylint: {n} finding(s)"
+              + (f", {sup} baselined" if sup else "")
+              + f", {result.stats.get('files', 0)} files, "
+              f"{len(engine.CHECKERS)} checkers")
+    return 1 if (result.findings or result.stale_baseline) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,375 @@
+"""yacylint core — one ``ast.parse`` per file feeding a checker pipeline.
+
+The engine owns everything that is NOT a rule: file discovery, the
+single-parse file contexts, the shared exemption grammar, the committed
+baseline, and the runner that hands every registered checker the whole
+parsed repo at once.  Checkers (utils/lint/checkers.py) are pure
+functions over :class:`Repo` — they never re-read or re-parse a file,
+so a full run is one parse pass over the package (~150 files, well
+under a second; tier-1 cheap).
+
+**Exemption grammar** (one grammar for every checker, so an exemption
+audit is a single grep for ``# lint:``):
+
+    # lint: <token>(reason)
+
+where ``<token>`` is the checker's suppression token (``unlocked-ok``,
+``blocking-ok``, ``tie-ok``, ``unbounded-ok``, ``counter-ok``,
+``impure-ok``, ``broad-except-ok``, ``costmodel-ok``, ``oracle-ok``,
+``trace-ok``) and ``reason`` is MANDATORY prose — an empty reason or an
+unknown token is itself a finding.  The comment exempts the statement
+it sits on (any line of a multi-line statement); checkers additionally
+honor it on the enclosing ``def`` or ``with`` line where that is the
+natural scope (e.g. one ``blocking-ok`` on a ``with`` covers the block).
+
+**Baseline** (LINT_BASELINE.json at the repo root): pre-existing debt
+is PINNED, never silently grown.  A finding matching a baseline entry
+is suppressed; a baseline entry matching no finding is STALE and fails
+the run (the "baseline may only shrink" merge rule — see BASELINE.md).
+
+Jax-free by contract: this package imports only the stdlib, so the lint
+run works in any interpreter — CI sandboxes, the kill−9 chaos children,
+a laptop without the jax_graft toolchain (tests/test_lint.py pins it).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import pathlib
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+# repo root = three parents up from utils/lint/engine.py's package dir
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[3]
+PACKAGE = "yacy_search_server_tpu"
+BASELINE_NAME = "LINT_BASELINE.json"
+
+# the one exemption grammar (satellite: a single grep audits them all);
+# matched against real COMMENT tokens only (never string literals, so
+# checker messages can quote the grammar), and the reason may continue
+# across following comment lines until one ENDS with the closing paren
+EXEMPT_START = re.compile(r"#\s*lint:\s*([a-z][a-z0-9-]*)\((.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit: file:line, checker id, message."""
+
+    checker: str
+    path: str       # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Stable identity for baseline matching."""
+        return f"{self.checker}::{self.path}::{self.line}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+class FileContext:
+    """One parsed source file: tree + lines + its lint exemptions."""
+
+    def __init__(self, path: pathlib.Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        # line -> [(token, reason)]; blocks = (start_line, token, reason)
+        self.exemptions: dict[int, list[tuple[str, str]]] = {}
+        self.exemption_blocks: list[tuple[int, str, str]] = []
+        # line -> (comment text, True when the line holds ONLY the
+        # comment — an inline trailing comment anchors to its own
+        # statement and must not bleed onto the next one)
+        comments: dict[int, tuple[str, bool]] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    line, col = tok.start
+                    alone = not self.lines[line - 1][:col].strip()
+                    comments[line] = (tok.string, alone)
+        except tokenize.TokenError:      # the file parsed; best effort
+            pass
+        done: set[int] = set()
+        for ln in sorted(comments):
+            if ln in done:
+                continue
+            text, alone = comments[ln]
+            m = EXEMPT_START.search(text)
+            if m is None:
+                continue
+            token, rest = m.group(1), m.group(2)
+            start, spans, i = ln, [ln], ln
+            # the reason runs until a comment line ENDING with the
+            # closing paren (reasons may mention call() sites inside);
+            # continuation lines must be comment-only
+            while not rest.rstrip().endswith(")") and \
+                    comments.get(i + 1, ("", False))[1]:
+                i += 1
+                spans.append(i)
+                done.add(i)
+                rest += " " + comments[i][0].lstrip("#").strip()
+            rest = rest.rstrip()
+            reason = rest[:-1].strip() if rest.endswith(")") \
+                else ""      # unterminated: empty reason -> flagged
+            # a comment-ONLY block also covers the next code line, so
+            # a comment above a def/with/call anchors to it naturally;
+            # an inline trailing comment covers only its own statement
+            if alone:
+                j = i        # 0-based scan from the line after the block
+                while j < len(self.lines) and (
+                        not self.lines[j].strip()
+                        or self.lines[j].lstrip().startswith("#")):
+                    j += 1
+                if j < len(self.lines):
+                    spans.append(j + 1)
+            for s_ln in spans:
+                self.exemptions.setdefault(s_ln, []).append(
+                    (token, reason))
+            self.exemption_blocks.append((start, token, reason))
+
+    def exempt(self, tokens, lines) -> str | None:
+        """The reason of the first exemption carrying one of `tokens`
+        on any of `lines` (a finding line, the comment line just above
+        it, or an enclosing def/with line — the checker decides which
+        lines form the natural scope), else None."""
+        if isinstance(tokens, str):
+            tokens = (tokens,)
+        for ln in lines:
+            for tok, reason in self.exemptions.get(ln, ()):
+                if tok in tokens and reason:
+                    return reason
+        return None
+
+    def node_lines(self, node: ast.AST) -> list[int]:
+        """Every source line a (possibly multi-line) statement spans.
+        Comment-only exemption blocks above a statement are anchored by
+        the parser's next-code-line extension, so the span itself is
+        the whole scope — never the preceding line (an inline comment
+        there belongs to the PREVIOUS statement)."""
+        lo = getattr(node, "lineno", 0)
+        hi = getattr(node, "end_lineno", lo) or lo
+        return list(range(lo, hi + 1))
+
+
+class Repo:
+    """The parsed tree of every scanned file — the single-parse pass
+    all checkers share."""
+
+    def __init__(self, root: pathlib.Path, files: dict[str, FileContext],
+                 parse_errors: list[Finding]):
+        self.root = root
+        self.files = files
+        self.parse_errors = parse_errors
+
+    def get(self, rel: str) -> FileContext | None:
+        return self.files.get(rel)
+
+    def under(self, *prefixes: str) -> list[FileContext]:
+        """File contexts whose repo-relative path starts with any of
+        the given posix prefixes, in sorted path order."""
+        return [self.files[r] for r in sorted(self.files)
+                if any(r.startswith(p) for p in prefixes)]
+
+    def dict_literal_keys(self, rel: str, name: str) -> set[str]:
+        """String keys of the module-level dict literal assigned to
+        `name` in `rel` — the static (jax-free) view of registries like
+        ops/roofline.KERNELS.  Missing file/assignment -> empty set."""
+        ctx = self.get(rel)
+        if ctx is None:
+            return set()
+        keys: set[str] = set()
+        for node in ctx.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == name and \
+                        isinstance(value, ast.Dict):
+                    for k in value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            keys.add(k.value)
+        return keys
+
+
+# -- checker registry ---------------------------------------------------------
+
+# id -> (tokens, fn(repo, stats) -> iterable[Finding], doc)
+CHECKERS: dict[str, tuple[tuple[str, ...], object, str]] = {}
+
+
+def checker(cid: str, *tokens: str):
+    """Register a checker under `cid` with its exemption token(s); the
+    first token is the canonical one shown in messages."""
+    def deco(fn):
+        CHECKERS[cid] = (tokens, fn, (fn.__doc__ or "").strip())
+        return fn
+    return deco
+
+
+def known_tokens() -> set[str]:
+    return {t for toks, _fn, _doc in CHECKERS.values() for t in toks}
+
+
+# -- discovery + run ----------------------------------------------------------
+
+def discover(root: pathlib.Path | None = None,
+             rel_paths=None) -> Repo:
+    """Parse the package tree (or an explicit rel-path subset) once."""
+    root = pathlib.Path(root) if root else REPO_ROOT
+    files: dict[str, FileContext] = {}
+    errors: list[Finding] = []
+    if rel_paths:
+        paths = []
+        for r in rel_paths:
+            p = root / r
+            if p.is_dir():
+                paths.extend(sorted(p.rglob("*.py")))
+            elif p.is_file():
+                paths.append(p)
+            else:
+                # a typo'd CI path must not yield a false-clean exit 0
+                errors.append(Finding(
+                    "parse-error", pathlib.PurePosixPath(r).as_posix(),
+                    1, "path does not exist (nothing was linted)"))
+    else:
+        paths = sorted((root / PACKAGE).rglob("*.py"))
+    for p in paths:
+        if "__pycache__" in p.parts or not p.is_file():
+            continue
+        rel = p.relative_to(root).as_posix()
+        try:
+            src = p.read_text(encoding="utf-8")
+            files[rel] = FileContext(p, rel, src)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            errors.append(Finding("parse-error", rel, line,
+                                  f"file does not parse: {e}"))
+    return Repo(root, files, errors)
+
+
+def _exemption_findings(repo: Repo) -> list[Finding]:
+    """The grammar polices itself: unknown tokens and empty reasons are
+    findings (a typo'd token must not silently disable a checker)."""
+    out = []
+    tokens = known_tokens()
+    for rel in sorted(repo.files):
+        ctx = repo.files[rel]
+        for ln, tok, reason in ctx.exemption_blocks:
+            if tok not in tokens:
+                out.append(Finding(
+                    "exemption", rel, ln,
+                    f"unknown exemption token {tok!r} (known: "
+                    f"{', '.join(sorted(tokens))})"))
+            elif not reason:
+                out.append(Finding(
+                    "exemption", rel, ln,
+                    f"exemption {tok!r} carries no reason — the "
+                    f"reason is the point"))
+    return out
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding]
+    stats: dict = field(default_factory=dict)
+    # baseline bookkeeping (filled by apply_baseline)
+    suppressed: list[Finding] = field(default_factory=list)
+    stale_baseline: list[dict] = field(default_factory=list)
+
+    def by_checker(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.checker] = out.get(f.checker, 0) + 1
+        return dict(sorted(out.items()))
+
+
+def run(root: pathlib.Path | None = None, rel_paths=None,
+        only: set[str] | None = None) -> LintResult:
+    """The whole pipeline: discover → parse once → every checker."""
+    # import for side effect: registers the checker pipeline
+    from . import checkers as _checkers  # noqa: F401
+    repo = discover(root, rel_paths)
+    findings: list[Finding] = list(repo.parse_errors)
+    stats: dict = {"files": len(repo.files)}
+    # exemption tally rides the same single parse pass (lint_report
+    # renders it; a second discover() for it would double the work)
+    tally: dict[str, int] = {}
+    for ctx in repo.files.values():
+        for _ln, tok, _reason in ctx.exemption_blocks:
+            tally[tok] = tally.get(tok, 0) + 1
+    stats["exemptions"] = dict(sorted(tally.items()))
+    findings.extend(_exemption_findings(repo))
+    for cid, (_tokens, fn, _doc) in CHECKERS.items():
+        if only is not None and cid not in only:
+            continue
+        cstats: dict = {}
+        findings.extend(fn(repo, cstats))
+        if cstats:
+            stats[cid] = cstats
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.message))
+    return LintResult(findings, stats)
+
+
+# -- baseline -----------------------------------------------------------------
+
+def baseline_path(root: pathlib.Path | None = None) -> pathlib.Path:
+    return (pathlib.Path(root) if root else REPO_ROOT) / BASELINE_NAME
+
+
+def load_baseline(path: pathlib.Path) -> list[dict]:
+    if not path.is_file():
+        return []
+    obj = json.loads(path.read_text(encoding="utf-8"))
+    return list(obj.get("findings", []))
+
+
+def apply_baseline(result: LintResult, entries: list[dict]) -> LintResult:
+    """Split findings into (new, suppressed) against the baseline and
+    record stale entries — an entry matching nothing MUST be deleted
+    (the shrink-only rule), so it is surfaced, not ignored."""
+    keys = {f"{e['checker']}::{e['path']}::{e['line']}::{e['message']}": e
+            for e in entries}
+    matched: set[str] = set()
+    fresh, suppressed = [], []
+    for f in result.findings:
+        if f.key in keys:
+            matched.add(f.key)
+            suppressed.append(f)
+        else:
+            fresh.append(f)
+    result.findings = fresh
+    result.suppressed = suppressed
+    result.stale_baseline = [e for k, e in keys.items()
+                             if k not in matched]
+    return result
+
+
+def write_baseline(path: pathlib.Path, result: LintResult) -> None:
+    entries = [{"checker": f.checker, "path": f.path, "line": f.line,
+                "message": f.message}
+               for f in result.findings + result.suppressed]
+    obj = {
+        "_policy": "Pinned pre-existing lint debt. This file may only "
+                   "SHRINK: new findings are fixed or exempted inline "
+                   "with a reasoned `# lint: <token>(reason)` comment, "
+                   "never added here. A stale entry fails the run until "
+                   "it is deleted.",
+        "findings": entries,
+    }
+    path.write_text(json.dumps(obj, indent=2, sort_keys=False) + "\n",
+                    encoding="utf-8")

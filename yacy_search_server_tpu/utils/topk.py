@@ -53,12 +53,12 @@ class WeakPriorityQueue(Generic[T]):
 
     # -- internal helpers (hold lock) ---------------------------------------
 
-    def _prune(self, heap: list[tuple[int, int]]) -> None:
+    def _prune_locked(self, heap: list[tuple[int, int]]) -> None:
         while heap and heap[0][1] not in self._alive:
             heapq.heappop(heap)
 
-    def _evict_worst(self) -> None:
-        self._prune(self._worst)
+    def _evict_worst_locked(self) -> None:
+        self._prune_locked(self._worst)
         if self._worst:
             _, seq = heapq.heappop(self._worst)
             del self._alive[seq]
@@ -69,11 +69,11 @@ class WeakPriorityQueue(Generic[T]):
         """Insert; returns False if the element was rejected (too weak)."""
         with self._not_empty:
             if len(self._alive) >= self.maxsize:
-                self._prune(self._worst)
+                self._prune_locked(self._worst)
                 if self._worst and self._worst[0][0] >= weight:
                     self._misses += 1
                     return False
-                self._evict_worst()
+                self._evict_worst_locked()
                 self._misses += 1
             seq = next(self._seq)
             self._alive[seq] = (weight, payload)
@@ -85,7 +85,7 @@ class WeakPriorityQueue(Generic[T]):
     # -- consumers -----------------------------------------------------------
 
     def _poll_locked(self) -> Optional[Element[T]]:
-        self._prune(self._best)
+        self._prune_locked(self._best)
         if not self._best:
             return None
         _, seq = heapq.heappop(self._best)
@@ -130,7 +130,7 @@ class WeakPriorityQueue(Generic[T]):
 
     def peek_weight(self) -> Optional[int]:
         with self._lock:
-            self._prune(self._best)
+            self._prune_locked(self._best)
             return -self._best[0][0] if self._best else None
 
     def size_queue(self) -> int:

@@ -36,6 +36,8 @@ class WebStructureGraph:
             self._path = os.path.join(data_dir, "webstructure.jsonl")
             self._load()
 
+    # lint: unlocked-ok(construction-time: only __init__ calls this,
+    # before the graph is shared with any other thread)
     def _load(self) -> None:
         if not (self._path and os.path.exists(self._path)):
             return
