@@ -1640,6 +1640,210 @@ def _actuator_overhead_mode(n: int, threads: int = 16,
     assert act.level == 0, "ladder moved during a healthy soak"
 
 
+def _tail_overhead_mode(n: int, threads: int = 8, per_thread: int = 10,
+                        windows: int = 3, budget_pct: float = 2.0,
+                        emit: bool = True) -> dict:
+    """--tail-overhead (ISSUE 15): serving p50/p95 with the tail-
+    attribution engine (classifier + per-wave stamping) ON vs OFF on
+    the shared `_ab_soak` harness.  The engine ships enabled by
+    default, so the budget is a pinned contract: p50 regression under
+    `budget_pct`%.  After the A/B windows a FAULT-INJECTED window
+    (batcher.dispatch stall through the real faultinject registry)
+    asserts the engine's non-vacuity the way the ISSUE demands: at
+    least one classified verdict, and ZERO `unattributed` among them —
+    an injected stall the classifier cannot name would make every
+    production verdict suspect."""
+    import threading as _threading
+
+    from yacy_search_server_tpu.utils import faultinject, tailattr
+
+    sb = _build_served_switchboard(n, n_terms=2, mesh="off")
+    assert sb.index.devstore is not None, "device serving must be on"
+    sb.index.devstore._topk_cache.enabled = False
+
+    r = _ab_soak(sb, tailattr.set_enabled, threads=threads,
+                 per_thread=per_thread, windows=windows)
+
+    # the fault-injected verdict window: a real dispatcher stall makes
+    # every riding query's batch wall queue residue — the classifier
+    # must name it queue_wait, never shrug unattributed.  The soak's
+    # own contended tail cached a fat window p95 (the gate working as
+    # designed: only exemplar-worthy queries classify); expire the
+    # soak's windows first so the stall is judged against a quiet node.
+    from yacy_search_server_tpu.utils import histogram as _hg
+    for _ in range(_hg.WINDOWS + 1):
+        _hg.rotate_all()
+    tailattr.reset()
+    tailattr.set_enabled(True)
+    faultinject.set_fault("batcher.dispatch", 300)
+    try:
+        def worker(t):
+            for _ in range(2):
+                sb.search_cache.clear()
+                ev = sb.search(f"benchterm{t % 2}", count=10,
+                               use_cache=False)
+                assert len(ev.results()) == 10
+        ts = [_threading.Thread(target=worker, args=(t,))
+              for t in range(4)]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+    finally:
+        faultinject.clear()
+    verdicts = [v.to_json() for v in tailattr.verdicts(100)]
+    causes: dict = {}
+    for v in verdicts:
+        causes[v["cause"]] = causes.get(v["cause"], 0) + 1
+    art = {
+        "metric": "tail_overhead",
+        "n_postings": n,
+        "threads": threads,
+        "queries_per_mode": r["queries_per_mode"],
+        "p50_ms_tail_off": round(r["p50_off"], 3),
+        "p50_ms_tail_on": round(r["p50_on"], 3),
+        "p95_ms_tail_off": round(r["p95_off"], 3),
+        "p95_ms_tail_on": round(r["p95_on"], 3),
+        "overhead_pct": round(r["overhead_pct"], 3),
+        "budget_pct": budget_pct,
+        "injected_verdicts": len(verdicts),
+        "injected_causes": causes,
+        "injected_unattributed": causes.get("unattributed", 0),
+    }
+    if emit:
+        print(json.dumps(art))
+    assert r["overhead_pct"] < budget_pct, (
+        f"tail-attribution overhead {r['overhead_pct']:.2f}% exceeds "
+        f"the {budget_pct}% stay-on-by-default budget")
+    assert len(verdicts) >= 1, (
+        "no classified verdict under an injected dispatcher stall — "
+        "the engine is vacuous")
+    assert causes.get("unattributed", 0) == 0, (
+        f"unattributed verdicts under injection: {causes} — the "
+        f"classifier failed to name a KNOWN fault")
+    sb.close()
+    return art
+
+
+def _tail_forensics_mode(nprocs: int = 3, ndocs: int = 256,
+                         straggle_ms: float = 350.0,
+                         soak_queries: int = 80,
+                         n: int = 200_000) -> None:
+    """--tail-forensics (ISSUE 15 acceptance): a `nprocs`-process mesh
+    soak with ONE member slowed via the wire-level do_meshfault
+    (mesh.step latency) must produce, in one committed artifact
+    (TAIL_r01.json):
+
+    1. an assembled cross-process waterfall for an over-threshold query
+       (per-member queue/commit/local-entry/exec segments, zero extra
+       RPCs — they ride the scatter replies);
+    2. `yacy_tail_cause_total{cause="collective_straggler"}` DOMINANT,
+       with the straggler scoreboard naming the slowed member;
+    3. a flight-recorder incident (slo_serving_p95 burning on the
+       coordinator's real serving histogram) EMBEDDING the windowed
+       cause histogram + scoreboard;
+    4. the --tail-overhead gate (<2% p50, zero unattributed under
+       injection) measured on the same tree.
+    """
+    import tempfile
+
+    from yacy_search_server_tpu.parallel import distributed as D
+    from yacy_search_server_tpu.parallel.launcher import MeshFleet
+
+    run_dir = tempfile.mkdtemp(prefix="tailforensics-")
+    terms = list(D.CORPUS_TERMS)
+    slowed = 1
+    with MeshFleet(procs=nprocs, local_devices=2, ndocs=ndocs,
+                   run_dir=run_dir) as fleet:
+        for w in terms:                     # compile-warm every shape
+            fleet.search(w)
+        for w in terms:                     # flush warm-step segments
+            fleet.search(w)
+        fleet.fault(slowed, "mesh.step", straggle_ms)
+        t0 = time.perf_counter()
+        answered = 0
+        for i in range(soak_queries):
+            rep = fleet.search(terms[i % len(terms)])
+            if rep["scores"]:
+                answered += 1
+            # drive the coordinator's health evaluation alongside the
+            # soak (mesh runtimes run no busy threads): the burn-rate
+            # rule sees the straggled serving walls as they land
+            if i % 5 == 4:
+                fleet.info(0, tick_health=True)
+        soak_s = time.perf_counter() - t0
+        fleet.fault(slowed, "mesh.step", 0, clear=True)
+        for w in terms[:2]:                 # flush the last segments
+            fleet.search(w)
+        info = fleet.info(0, tick_health=True)
+    tail = info["tail"]
+    causes = tail["cause_totals"]
+    straggler_n = causes.get("collective_straggler", 0)
+    others = sum(v for c, v in causes.items()
+                 if c != "collective_straggler")
+    board_row = next((r for r in tail["scoreboard"]
+                      if r["member"] == f"mesh{slowed}"), None)
+    # the waterfall OF an over-threshold straggled query (acceptance
+    # exhibit 1); the newest healthy step's as fallback context
+    wf = tail.get("straggled_waterfall") or tail["waterfall"]
+    inc_tail = info.get("incident_tail") or {}
+
+    overhead = _tail_overhead_mode(n, emit=False)
+
+    art = {
+        "metric": "tail_forensics",
+        "procs": nprocs, "ndocs": ndocs,
+        "straggled_member": f"mesh{slowed}",
+        "straggle_ms": straggle_ms,
+        "soak_queries": soak_queries, "answered": answered,
+        "soak_s": round(soak_s, 3),
+        "qps": round(soak_queries / soak_s, 3),
+        "cause_totals": causes,
+        "straggler_verdicts": straggler_n,
+        "straggler_counts_by_member": tail["stragglers"],
+        "scoreboard": tail["scoreboard"],
+        "waterfall": wf,
+        "segments_merged": tail["segments_merged"],
+        "verdicts_sample": tail["verdicts"][:5],
+        "health_incidents": info.get("health_incidents", []),
+        "incident_tail_causes": inc_tail.get("tail_causes"),
+        "incident_scoreboard": inc_tail.get("straggler_scoreboard"),
+        "tail_overhead": overhead,
+        "ok": bool(
+            answered == soak_queries
+            and straggler_n > others
+            and board_row is not None
+            and board_row["slowest_count"] >= 1
+            and wf is not None and len(wf["members"]) == nprocs
+            and inc_tail.get("tail_causes") is not None),
+    }
+    print(json.dumps(art, indent=1))
+    # validation gates (the committed-artifact discipline)
+    assert answered == soak_queries, "availability: every query answers"
+    assert straggler_n > others, (
+        f"collective_straggler must DOMINATE the cause histogram under "
+        f"injection: {causes}")
+    assert board_row is not None and board_row["slowest_count"] >= 1, (
+        f"scoreboard must name mesh{slowed}: {tail['scoreboard']}")
+    assert board_row["slowest_frac"] >= 0.5, (
+        f"slowed member must be the slowest leg of most steps: "
+        f"{board_row}")
+    assert wf is not None and len(wf["members"]) == nprocs, (
+        "assembled cross-process waterfall incomplete")
+    assert inc_tail.get("tail_causes") is not None, (
+        "flight-recorder incident must embed the cause histogram "
+        f"(incidents: {info.get('health_incidents')})")
+    emb = inc_tail["tail_causes"]["window"]
+    assert emb.get("collective_straggler", 0) > 0, (
+        f"the embedded cause histogram must carry the straggler: {emb}")
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "TAIL_r01.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(art, f, indent=1)
+        f.write("\n")
+    print(f"committed {out}", file=sys.stderr)
+
+
 def _integrity_overhead_mode(n: int, threads: int = 16,
                              per_thread: int = 10, windows: int = 3,
                              budget_pct: float = 2.0):
@@ -3132,6 +3336,21 @@ def main():
                          "verification ON vs OFF (interleaved windows; "
                          "gate <2%% p50, zero corruption/loss counters "
                          "on the healthy soak)")
+    ap.add_argument("--tail-overhead", action="store_true",
+                    help="serving p50/p95 with the tail-attribution "
+                         "engine (classifier + wave stamping) on vs "
+                         "off (_ab_soak), gate <2%% p50, plus a "
+                         "fault-injected window asserting >=1 "
+                         "classified verdict and zero unattributed "
+                         "(ISSUE 15)")
+    ap.add_argument("--tail-forensics", action="store_true",
+                    help="3-process mesh soak with one member slowed "
+                         "via do_meshfault: assembled cross-process "
+                         "waterfall, collective_straggler dominant + "
+                         "scoreboard naming the member, incident "
+                         "embedding the cause histogram, and the "
+                         "--tail-overhead gate; commits TAIL_r01.json "
+                         "(ISSUE 15 acceptance)")
     ap.add_argument("--health-overhead", action="store_true",
                     help="serving p50/p95 with the histogram recording "
                          "+ health-rule tick on vs off, interleaved "
@@ -3182,6 +3401,14 @@ def main():
         return
     if args.trace_overhead:
         _trace_overhead_mode(args.n if args.n != 10_000_000 else 200_000)
+        return
+    if args.tail_overhead:
+        _tail_overhead_mode(args.n if args.n != 10_000_000 else 200_000)
+        return
+    if args.tail_forensics:
+        _tail_forensics_mode(
+            nprocs=args.mesh_procs or 3,
+            n=args.n if args.n != 10_000_000 else 200_000)
         return
     if args.health_overhead:
         _health_overhead_mode(args.n if args.n != 10_000_000 else 200_000)

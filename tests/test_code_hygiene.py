@@ -263,6 +263,7 @@ def test_no_dead_faultpoints():
     sites = {
         "servlet.serving": 'faultinject.sleep("servlet.serving")',
         "batcher.dispatch": 'faultinject.sleep("batcher.dispatch")',
+        "mesh.step": 'faultinject.sleep("mesh.step")',
         "peer.blackhole": "faultinject.blackholed(",
         "io.torn_write": "faultinject.torn_write_bytes(",
         "io.error": "faultinject.io_error(",
@@ -293,3 +294,41 @@ def test_wall_measuring_servlets_open_spans():
                        "opening a tracing span")
     assert res.stats["servlet-trace"]["servlet_handlers"] > 80, \
         "servlet census collapsed (checker rot?)"
+
+
+# -- tail forensics (ISSUE 15) ------------------------------------------------
+
+def test_no_dead_tail_causes():
+    """Every cause label the tail-attribution engine can emit must have
+    (a) an emitting branch in the classifier source and (b) a dedicated
+    non-vacuity test (`test_cause_<label>` in tests/test_tailattr.py)
+    driving the REAL code path via the faultinject registry — a label
+    nothing can produce, or nothing proves producible, is a dead
+    diagnosis an operator would wait on forever."""
+    from yacy_search_server_tpu.utils import tailattr
+
+    src = pathlib.Path(tailattr.__file__).read_text(encoding="utf-8")
+    tests_src = (pathlib.Path(__file__).resolve().parent
+                 / "test_tailattr.py").read_text(encoding="utf-8")
+    for cause in tailattr.CAUSES:
+        # >= 2 quoted occurrences: ONE is the CAUSES canon literal
+        # itself, so at least one EMITTING site must exist elsewhere in
+        # the module (deleting a classifier branch fails here — a
+        # single-occurrence check would be vacuous against the canon)
+        assert src.count(f'"{cause}"') >= 2, (
+            f"cause {cause!r} is in the canon but the classifier "
+            f"source never emits it (no second quoted occurrence)")
+        assert f"def test_cause_{cause}" in tests_src, (
+            f"cause {cause!r} has no exercising test_cause_{cause} in "
+            f"tests/test_tailattr.py — every emitted label needs a "
+            f"non-vacuity test")
+
+
+def test_tail_reach_gate():
+    """Servlet-observed histogram families stay classifier-reachable
+    (engine checker; see utils/lint/checkers.check_tail_reach)."""
+    res = _lint({"tail-reach"})
+    _assert_clean(res, "servlet walls observing families the tail "
+                       "classifier cannot reach")
+    assert res.stats["tail-reach"]["servlet_observed_families"] >= 2, \
+        "servlet observe census collapsed (checker rot?)"

@@ -440,6 +440,47 @@ def test_servlet_trace_span_or_exemption_clears(tmp_path):
     assert not findings_of(res2, "servlet-trace")
 
 
+# -- 10. tail-classifier reachability (ISSUE 15) ------------------------------
+
+TAIL_BAD_SERVER = '''
+from ...utils import histogram
+
+def handle(self):
+    histogram.observe("servlet.mystery_wall", 12.0)
+'''
+TAIL_FIXTURE_ATTR = '''
+MARKER_X = "tail.x"
+CLASSIFIER_FAMILIES = frozenset({"servlet.serving", MARKER_X})
+'''
+
+
+def test_tail_reach_fires_on_unreachable_family(tmp_path):
+    res = run_fixture(tmp_path,
+                      {"server/httpd.py": TAIL_BAD_SERVER,
+                       "utils/tailattr.py": TAIL_FIXTURE_ATTR},
+                      only={"tail-reach"})
+    hits = findings_of(res, "tail-reach")
+    assert len(hits) == 1 and "servlet.mystery_wall" in hits[0].message
+
+
+def test_tail_reach_resolves_marker_names_and_exemption(tmp_path):
+    ok_src = TAIL_BAD_SERVER.replace("servlet.mystery_wall", "tail.x")
+    res = run_fixture(tmp_path,
+                      {"server/httpd.py": ok_src,
+                       "utils/tailattr.py": TAIL_FIXTURE_ATTR},
+                      only={"tail-reach"})
+    assert not findings_of(res, "tail-reach")
+    exempt = TAIL_BAD_SERVER.replace(
+        '    histogram.observe("servlet.mystery_wall", 12.0)',
+        '    # lint: tail-ok(render-only wall, never a query verdict)\n'
+        '    histogram.observe("servlet.mystery_wall", 12.0)')
+    res2 = run_fixture(tmp_path,
+                       {"server/httpd.py": exempt,
+                        "utils/tailattr.py": TAIL_FIXTURE_ATTR},
+                       only={"tail-reach"})
+    assert not findings_of(res2, "tail-reach")
+
+
 # -- non-vacuity gate: every registered checker fires on its fixture ---------
 
 CHECKER_FIXTURES = {
@@ -459,6 +500,8 @@ CHECKER_FIXTURES = {
     "kernel-oracle": ({"index/devstore.py": "import jax\n@jax.jit\n"
                        "def _a_bp_kernel(x):\n    return x\n"}, None),
     "servlet-trace": ({"server/servlets/x.py": SERVLET_BAD}, None),
+    "tail-reach": ({"server/httpd.py": TAIL_BAD_SERVER,
+                    "utils/tailattr.py": TAIL_FIXTURE_ATTR}, None),
 }
 
 

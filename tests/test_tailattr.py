@@ -1,0 +1,503 @@
+"""Tail forensics — the p99 cause-attribution engine (ISSUE 15).
+
+Non-vacuity contract: every label in ``tailattr.CAUSES`` has a
+``test_cause_<label>`` here driving the REAL product code path —
+via the faultinject registry where a fault is the trigger
+(``batcher.dispatch`` stall → queue_wait, ``device.transfer_fail`` →
+host_fallback, ``mesh.step`` latency armed through the wire-level
+``do_meshfault`` → collective_straggler naming that member), via the
+real tier ladder / lock / ladder-rung machinery elsewhere.  The
+no-dead-causes hygiene gate (tests/test_code_hygiene.py) cross-checks
+this file against the canon.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from yacy_search_server_tpu.index import postings as P
+from yacy_search_server_tpu.index.devstore import DeviceSegmentStore
+from yacy_search_server_tpu.index.postings import PostingsList
+from yacy_search_server_tpu.index.rwi import RWIIndex
+from yacy_search_server_tpu.ops.ranking import RankingProfile
+from yacy_search_server_tpu.utils import faultinject, histogram, \
+    tailattr, tracing
+
+TERMS = [f"tail{t}00000000".encode()[:12] for t in range(3)]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """Deterministic slate: faults cleared, verdict/wave/mesh rings
+    empty, the classification gate floored at 0 (the gate POLICY is
+    histogram's cached window p95 — tested separately — while these
+    tests pin the cause walk)."""
+    min0 = tailattr.MIN_MS
+    faultinject.clear()
+    tailattr.reset()
+    tailattr.set_enabled(True)
+    tailattr.MIN_MS = 0.0
+    tracing.clear()
+    yield
+    tailattr.MIN_MS = min0
+    faultinject.clear()
+    tailattr.reset()
+
+
+def _fill(rwi, n=30_000, n_terms=1, seed=5):
+    rng = np.random.default_rng(seed)
+    for t in range(n_terms):
+        docids = np.arange(n, dtype=np.int32)
+        feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+        feats[:, P.F_FLAGS] = rng.integers(0, 2 ** 20, n)
+        feats[:, P.F_LANGUAGE] = P.pack_language("en")
+        rwi.ingest_run({TERMS[t]: PostingsList(docids, feats)})
+    return rwi
+
+
+def _verdict_for(trace_sub=None, cause=None):
+    for v in tailattr.verdicts(50):
+        if trace_sub is not None and trace_sub not in v.trace_id:
+            continue
+        if cause is not None and v.cause != cause:
+            continue
+        return v
+    return None
+
+
+# -- the classification gate (cached-window-p95 reuse) -----------------------
+
+def test_gate_reuses_cached_window_p95_floored_at_min_ms():
+    """Sub-threshold roots never classify; the gate is
+    max(MIN_MS, family p95 cache) — the same election the histogram's
+    exemplars use."""
+    tailattr.MIN_MS = 50.0
+    with tracing.trace("servlet.fastroot"):
+        pass                                   # ~0 ms — under the floor
+    assert not tailattr.verdicts(5)
+    # raise the family's cached p95 above MIN_MS: still gated out
+    h = histogram.histogram("servlet.slowgate")
+    for _ in range(100):
+        h.record(400.0)
+    h.rotate()
+    assert h.p95_cache > 50.0
+    tailattr.MIN_MS = 0.0
+    with tracing.trace("servlet.slowgate"):
+        time.sleep(0.01)                       # 10ms < cached p95
+    assert _verdict_for() is None
+    # background roots never classify regardless of wall
+    with tracing.trace("pipeline.index"):
+        time.sleep(0.005)
+    assert _verdict_for() is None
+
+
+# -- one test per cause label (the no-dead-causes contract) ------------------
+
+def test_cause_queue_wait():
+    """batcher.dispatch stall (faultinject): the query's batch wall is
+    queue residue, not kernel time — queue_wait."""
+    ds = DeviceSegmentStore(_fill(RWIIndex()))
+    ds._topk_cache.enabled = False
+    ds.enable_batching(dispatchers=1, prewarm=False)
+    try:
+        with tracing.trace("servlet.warm"):    # compile outside the test
+            assert ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        tailattr.reset()
+        faultinject.set_fault("batcher.dispatch", 300)
+        with tracing.trace("servlet.queued"):
+            assert ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        v = _verdict_for()
+        assert v is not None and v.cause == "queue_wait", v
+        assert v.evidence["queue_ms"] >= 200.0
+    finally:
+        faultinject.clear()
+        ds.close()
+
+
+def test_cause_compile():
+    """First dispatch of a kernel by a fresh batcher carries the
+    compile charge: the wave stamp's compile-vs-reuse bit names it."""
+    ds = DeviceSegmentStore(_fill(RWIIndex()))
+    ds._topk_cache.enabled = False
+    ds.enable_batching(dispatchers=1, prewarm=False)
+    try:
+        with tracing.trace("servlet.firstuse"):
+            assert ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        v = _verdict_for()
+        assert v is not None and v.cause == "compile", v
+        # ...and the reuse dispatch does NOT classify compile
+        tailattr.reset()
+        with tracing.trace("servlet.reuse"):
+            assert ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        v2 = _verdict_for()
+        assert v2 is None or v2.cause != "compile", v2
+        waves = tailattr.ATTR.wave_log(5)
+        assert waves and waves[0]["compile"] is False
+    finally:
+        ds.close()
+
+
+def _tiered_store(**kw):
+    """A packed store whose budget fits ~2 of the 3 terms hot (the
+    test_packed_residency ladder shape)."""
+    rwi = RWIIndex()
+    rng = np.random.default_rng(2)
+    n = 60_000
+    for t in range(3):
+        docids = np.arange(n, dtype=np.int32)
+        feats = rng.integers(0, 1000, (n, P.NF)).astype(np.int32)
+        feats[:, P.F_LANGUAGE] = P.pack_language("en")
+        rwi.ingest_run({TERMS[t]: PostingsList(docids, feats)})
+    return DeviceSegmentStore(rwi, packed_residency=True,
+                              budget_bytes=7_500_000, **kw)
+
+
+def test_cause_tier_cold():
+    """A warm/cold tier miss host-serves the query and emits the
+    cold-miss marker — tier_cold, with the tier in the evidence."""
+    ds = _tiered_store()
+    try:
+        warm = [th for (rid, th), e in ds._pblocks.items()
+                if not e["hot"]]
+        assert warm
+        with tracing.trace("servlet.coldq"):
+            time.sleep(0.002)
+            assert ds.rank_term(warm[0], RankingProfile(), "en",
+                                k=10) is None     # miss: host path serves
+        v = _verdict_for()
+        assert v is not None and v.cause == "tier_cold", v
+        assert v.evidence.get("tier") in ("warm", "cold")
+    finally:
+        ds.close()
+
+
+def test_cause_merge_deferral():
+    """The same miss while the merge/promotion scheduler defers parks
+    the promotion — the marker carries deferred=True and the verdict
+    names the deferral, not the tier."""
+    from yacy_search_server_tpu.ingest.scheduler import MergeScheduler
+
+    ds = _tiered_store()
+    try:
+        sched = MergeScheduler(sb=None)
+        sched.set_deferred(True)
+        ds.ingest_scheduler = sched
+        warm = [th for (rid, th), e in ds._pblocks.items()
+                if not e["hot"]]
+        with tracing.trace("servlet.deferq"):
+            time.sleep(0.002)
+            assert ds.rank_term(warm[0], RankingProfile(), "en",
+                                k=10) is None
+        v = _verdict_for()
+        assert v is not None and v.cause == "merge_deferral", v
+        assert sched.promote_deferrals >= 1
+        assert ds._deferred_promotes, "promotion must actually park"
+    finally:
+        ds.close()
+
+
+def test_cause_lock_wait():
+    """A query stalled behind a held store lock gets a measured
+    lock-wait marker span — lock_wait when it dominates."""
+    ds = DeviceSegmentStore(_fill(RWIIndex()))
+    ds._topk_cache.enabled = False
+    try:
+        assert ds.rank_term(TERMS[0], RankingProfile(), k=10)  # warm
+        tailattr.reset()
+        release = threading.Event()
+
+        def holder():
+            with ds._lock:
+                release.wait(timeout=5.0)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        time.sleep(0.05)          # holder owns the lock
+        timer = threading.Timer(0.25, release.set)
+        timer.start()
+        with tracing.trace("servlet.locked"):
+            assert ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        t.join(timeout=5.0)
+        v = _verdict_for()
+        assert v is not None and v.cause == "lock_wait", v
+        assert v.evidence["lock_ms"] >= 100.0
+    finally:
+        ds.close()
+
+
+def test_cause_degraded_rung(tmp_path):
+    """A query served under a degradation rung emits the
+    search.degraded marker (M83) — degraded_rung when nothing heavier
+    explains the wall."""
+    from yacy_search_server_tpu.switchboard import Switchboard
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        tailattr.MIN_MS = 0.0
+        # a rung-3 (cache-only) query is FAST — expire the suite's
+        # accumulated switchboard.search windows so the cached-p95
+        # gate is quiet and the fast degraded query still classifies
+        h = histogram.histogram("switchboard.search")
+        for _ in range(histogram.WINDOWS + 1):
+            h.rotate()
+        assert h.p95_cache == 0.0
+        sb.actuators.level = 3            # cache-only / stale-ok rung
+        ev = sb.search("degradedterm", use_cache=False)
+        assert ev.degrade_level == 3
+        v = _verdict_for(cause="degraded_rung")
+        assert v is not None, [x.to_json() for x in tailattr.verdicts()]
+        assert v.evidence.get("level") == 3
+    finally:
+        sb.close()
+
+
+def test_cause_host_fallback():
+    """device.transfer_fail (faultinject) declares the device lost;
+    every ranked query host-serves with the host-fallback marker."""
+    ds = DeviceSegmentStore(_fill(RWIIndex()))
+    ds._topk_cache.enabled = False
+    ds.transfer_retry_limit = 0
+    ds.loss_streak = 1
+    ds.rebuild_backoff_s = 3600.0
+    try:
+        assert ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        faultinject.set_fault("device.transfer_fail", 50)
+        ds.rank_term(TERMS[0], RankingProfile(), k=10)   # declares loss
+        assert ds.device_lost
+        tailattr.reset()
+        with tracing.trace("servlet.lostq"):
+            time.sleep(0.002)
+            assert ds.rank_term(TERMS[0], RankingProfile(),
+                                k=10) is None
+        v = _verdict_for()
+        assert v is not None and v.cause == "host_fallback", v
+    finally:
+        faultinject.clear()
+        ds.close()
+
+
+def test_cause_unattributed():
+    """Over-threshold with no detector evidence: the honest verdict is
+    unattributed (never a guessed cause)."""
+    with tracing.trace("servlet.mystery"):
+        time.sleep(0.005)
+    v = _verdict_for()
+    assert v is not None and v.cause == "unattributed", v
+
+
+def test_cause_collective_straggler(tmp_path):
+    """The wire-level drive (ISSUE 15 acceptance shape, shrunk to 2
+    processes): mesh.step latency armed in ONE member via do_meshfault
+    slows exactly that member's step; the coordinator assembles the
+    per-member timeline from segments riding the next scatter reply and
+    the verdict NAMES the member.  Also proves the scoreboard and the
+    cross-process waterfall."""
+    from yacy_search_server_tpu.parallel.launcher import MeshFleet
+
+    with MeshFleet(procs=2, local_devices=2, ndocs=128,
+                   run_dir=str(tmp_path)) as fleet:
+        fleet.search("meshterm")               # compile warm
+        fleet.search("papaya")
+        fleet.fault(1, "mesh.step", 400)
+        slow = fleet.search("banana")
+        assert slow["scores"]
+        fleet.fault(1, "mesh.step", 0, clear=True)
+        # the straggled step's segments ride the NEXT scatter replies
+        fleet.search("meshterm")
+        fleet.search("papaya")
+        info = fleet.info(0)
+        tail = info["tail"]
+        v = next((v for v in tail["verdicts"]
+                  if v["cause"] == "collective_straggler"), None)
+        assert v is not None, tail["verdicts"]
+        assert v["member"] == "mesh1"
+        assert v["evidence"]["late_ms_by_member"]["mesh1"] >= 300.0
+        # straggler scoreboard: mesh1 was the slowest leg with a fat
+        # margin at least once
+        row = next((r for r in tail["scoreboard"]
+                    if r["member"] == "mesh1"), None)
+        assert row is not None and row["slowest_count"] >= 1
+        assert row["max_margin_ms"] >= 300.0
+        # assembled cross-process waterfall exists with both members
+        wf = tail["waterfall"]
+        assert wf is not None and len(wf["members"]) == 2
+        assert tail["segments_merged"] >= 2
+        # counters surface on the canon
+        assert tail["cause_totals"]["collective_straggler"] >= 1
+        assert tail["stragglers"].get("mesh1", 0) >= 1
+
+
+# -- wave stamping -----------------------------------------------------------
+
+def test_wave_stamp_rides_batch_span_and_wave_log():
+    ds = DeviceSegmentStore(_fill(RWIIndex()))
+    ds._topk_cache.enabled = False
+    ds.enable_batching(dispatchers=1, prewarm=False)
+    try:
+        with tracing.trace("servlet.wave"):
+            assert ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        rec = tracing.traces(1)[0]
+        batch = [s for s in rec.spans if s.name == "devstore.batch"]
+        assert batch, [s.name for s in rec.spans]
+        a = batch[0].attrs
+        assert {"wave_n", "wave_occ", "wave_qdepth", "wave_compile",
+                "wave_kernel"} <= set(a)
+        waves = tailattr.ATTR.wave_log(5)
+        assert waves and waves[0]["kernel"] == a["wave_kernel"]
+        assert "merge_deferred" in waves[0]
+        # disabled engine stamps nothing (the --tail-overhead OFF mode)
+        tailattr.set_enabled(False)
+        n0 = len(tailattr.ATTR.wave_log(100))
+        ds.rank_term(TERMS[0], RankingProfile(), k=10)
+        assert len(tailattr.ATTR.wave_log(100)) == n0
+    finally:
+        tailattr.set_enabled(True)
+        ds.close()
+
+
+# -- incident embedding (the payoff surface) ---------------------------------
+
+def test_incident_embeds_cause_histogram_and_scoreboard(tmp_path):
+    """A slo_serving_p95 critical edge dumps an incident whose body
+    carries the windowed cause histogram and the straggler scoreboard —
+    'p95 burn, 71% collective_straggler mesh1' instead of 'p95 burn'."""
+    from yacy_search_server_tpu.switchboard import Switchboard
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        rec = tracing.TraceRecord("t" * 8, "servlet.x", time.time())
+        for _ in range(5):
+            tailattr.ATTR.record(tailattr.ATTR.classify(
+                rec, 500.0, mesh_info={
+                    "straggler": "mesh1",
+                    "evidence": {"seq": 1, "mode": "collective",
+                                 "exec_ms_by_member": {}}}))
+        eng = sb.health
+        with eng._lock:
+            eng._dump_incident_locked(time.time(), ["slo_serving_p95"])
+        inc = eng.incidents[-1]
+        kinds = {}
+        for line in inc["body"].splitlines():
+            obj = json.loads(line)
+            kinds[obj.get("kind")] = obj
+        assert "tail_causes" in kinds
+        assert kinds["tail_causes"]["window"][
+            "collective_straggler"] == 5
+        assert "straggler_scoreboard" in kinds
+        # a NON-serving rule's incident does not embed
+        with eng._lock:
+            eng._dump_incident_locked(time.time(), ["worker_stall"])
+        assert "tail_causes" not in {
+            json.loads(ln).get("kind")
+            for ln in eng.incidents[-1]["body"].splitlines()}
+    finally:
+        sb.close()
+
+
+# -- fleet digest satellite --------------------------------------------------
+
+def test_digest_carries_rung_and_top_cause_and_series_resolve(tmp_path):
+    from yacy_search_server_tpu.server.servlets.monitoring import \
+        prometheus_text
+    from yacy_search_server_tpu.switchboard import Switchboard
+    from yacy_search_server_tpu.utils import fleet as F
+    from yacy_search_server_tpu.utils.health import parse_exposition
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        rec = tracing.TraceRecord("u" * 8, "servlet.x", time.time())
+        v = tailattr.ATTR.classify(rec, 300.0)
+        tailattr.ATTR.record(v)
+        sb.actuators.level = 2
+        sb.fleet._cached = None           # re-render past the TTL cache
+        d = sb.fleet.render()
+        assert d["act"]["l"] == 2
+        assert F.decode_act_cause(d["act"]) == v.cause
+        keys = set(parse_exposition(prometheus_text(sb)))
+        series = F.digest_series(d)
+        assert series["act.l"] == "yacy_degrade_level"
+        assert series["act.c"] in keys, series["act.c"]
+        # peer_rows decodes the act block for Network_Health_p
+        d2 = dict(d, peer="PEERHASHxxx", seq=99)
+        assert sb.fleet.ingest(d2)
+        row = next(r for r in sb.fleet.peer_rows()
+                   if r["hash"] == "PEERHASHxxx")
+        assert row["act"] == {"lvl": 2, "cause": v.cause}
+        # version skew: an out-of-range cause index reads unattributed
+        assert F.decode_act_cause({"c": 999}) == "unattributed"
+    finally:
+        sb.close()
+
+
+# -- DHT rwi receipts land in the ingest SLO (satellite) ---------------------
+
+def test_transfer_rwi_stamps_ingest_slo(tmp_path):
+    """Peer-pushed postings get crawl-to-searchable stamps at wire
+    entry: ingest.searchable observes one wall per received DOC, the
+    sender's payload stamp back-dates the entry, and absent-stamp
+    peers are tolerated."""
+    from yacy_search_server_tpu.ingest import slo as ingest_slo
+    from yacy_search_server_tpu.peers.node import P2PNode
+    from yacy_search_server_tpu.peers.protocol import encode_postings
+    from yacy_search_server_tpu.peers.transport import LoopbackNetwork
+
+    # in-memory node: bare stub-row metadata (docid reserved, sku
+    # filled by a later transferURL) cannot be snapshotted durably —
+    # a pre-existing metadata bound outside this test's scope
+    node = P2PNode("stampnode", LoopbackNetwork(), data_dir=None)
+    try:
+        tracker = ingest_slo.TRACKER
+        h = histogram.histogram("ingest.searchable")
+        n0 = h.count
+        counts0 = list(h.snapshot()["counts"])
+        s0 = tracker.docs_searchable
+        rng = np.random.default_rng(0)
+        feats = rng.integers(0, 1000, (2, P.NF)).astype(np.int32)
+        plist = PostingsList(np.arange(2, dtype=np.int32), feats)
+        uhs = [b"docAAAAAAAA1", b"docAAAAAAAA2"]
+        entry = {"term": "stampterm000",
+                 "postings": encode_postings(plist, uhs)}
+        # sender stamp 2s in the past: the observed wall includes it
+        rep = node.server.do_transferRWI(
+            {"entries": [entry], "stamp": time.time() - 2.0})
+        assert rep["result"] == "ok" and rep["received"] == 2
+        assert tracker.docs_searchable - s0 == 2
+        assert h.count - n0 == 2
+        # the back-dated entry stamps land BOTH docs in >=1.5s buckets
+        # (cumulative-count delta: robust against whatever the suite
+        # already observed into this process-global family)
+        idx = histogram.bucket_index(1500.0)
+        counts1 = h.snapshot()["counts"]
+        assert sum(counts1[idx:]) - sum(counts0[idx:]) == 2, \
+            "sender stamp must back-date the searchable wall"
+        # absent stamp: tolerated, anchored at wire entry
+        rep2 = node.server.do_transferRWI({"entries": [entry]})
+        assert rep2["result"] == "ok"
+        assert tracker.docs_searchable - s0 == 4
+    finally:
+        node.close()
+
+
+# -- Performance_Tail_p ------------------------------------------------------
+
+def test_performance_tail_servlet_renders_and_exports_json(tmp_path):
+    from yacy_search_server_tpu.server.objects import ServerObjects
+    from yacy_search_server_tpu.server.servlets.tail import respond_tail
+    from yacy_search_server_tpu.switchboard import Switchboard
+
+    sb = Switchboard(data_dir=str(tmp_path / "DATA"))
+    try:
+        rec = tracing.TraceRecord("w" * 8, "servlet.x", time.time())
+        tailattr.ATTR.record(tailattr.ATTR.classify(rec, 123.0))
+        prop = respond_tail({}, ServerObjects(), sb)
+        assert prop.get_int("verdicts") >= 1
+        assert prop.get_int("causes") == len(tailattr.CAUSES)
+        raw = respond_tail({}, ServerObjects({"format": "json"}), sb)
+        view = json.loads(raw.raw_body)
+        assert view["classified_total"] >= 1
+        assert set(view["causes_windowed"]) == set(tailattr.CAUSES)
+    finally:
+        sb.close()

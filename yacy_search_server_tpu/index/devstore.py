@@ -74,7 +74,7 @@ from ..ops.ranking import (_ACTIVE_COLS, RankingProfile,
 from ..ops.streaming import merge_stats
 from ..utils.eventtracker import EClass, update as track
 from ..utils.profiler import PROFILER
-from ..utils import faultinject, histogram, tracing
+from ..utils import faultinject, histogram, tailattr, tracing
 from . import integrity
 from . import postings as P
 from .pagedrun import PagedRun
@@ -1804,6 +1804,10 @@ class _QueryBatcher:
         self.timeout_queue_full = 0
         self.timeout_flush_deadline = 0
         self.timeout_worker_stall = 0
+        # kernel names this batcher has dispatched at least once — the
+        # compile-vs-reuse bit of the per-wave stamp (ISSUE 15b):
+        # first use of a jitted kernel pays its compile in issue_ms
+        self._seen_kernels: set[str] = set()
         # per-QUERY time series (bounded): the wall of the dispatch a
         # query rode in, and the kernel-call+fetch wall of its group —
         # the decomposition that makes the local-attach p50 claim
@@ -1897,6 +1901,18 @@ class _QueryBatcher:
                         else:
                             tracing.emit(f"kernel.{stage}", ms)
             sp.set(outcome=res[0])
+            wave = item.get("wave")
+            if wave is not None and not untraced:
+                # the wave stamp (ISSUE 15b) on the batch span: the
+                # tail classifier reads these to attribute the query's
+                # slowness to its wave (queue depth / occupancy /
+                # compile / tier+deferral state)
+                sp.set(wave_n=wave["n"], wave_occ=wave["occ"],
+                       wave_qdepth=wave["qdepth"],
+                       wave_compile=wave["compile"],
+                       wave_kernel=wave["kernel"],
+                       wave_queue_ms=round(
+                           item.get("queue_wait_ms", 0.0), 3))
         if untraced:
             histogram.observe("devstore.batch",
                               (time.perf_counter() - t_sub) * 1000.0)
@@ -1904,6 +1920,13 @@ class _QueryBatcher:
 
     def _submit_wait_inner(self, item: dict):
         ev = item["ev"]
+        if tailattr.enabled():
+            # queue depth AT ENQUEUE + the submit stamp the wave uses
+            # to MEASURE this query's pre-issue wait (ISSUE 15b): the
+            # classifier must never infer queue time by subtracting
+            # overlapping kernel spans
+            item["q_depth"] = self._q.qsize()
+            item["t_submit"] = time.perf_counter()
         self._q.put(item)
         if ev.wait(timeout=self.WATCHDOG_S):
             return item["res"]
@@ -2230,6 +2253,23 @@ class _QueryBatcher:
             with self._ms_lock:
                 self.dispatches += 1
 
+    def _stamp_wave(self, items: list[dict], kernel_name: str,
+                    issue_ms: float) -> None:
+        """Per-wave device timeline stamp (ISSUE 15b): queue depth at
+        enqueue, wave occupancy, compile-vs-reuse (first dispatch of a
+        kernel by this batcher = the compile charge; prewarm dispatches
+        consume the flag before serving traffic) and the store's tier/
+        deferral state — so a query's slowness is attributable to ITS
+        WAVE, not just its own spans.  The record rides every item and
+        lands as attrs on the submitter's devstore.batch span + in the
+        bounded tail wave log."""
+        with self._ms_lock:
+            first_use = kernel_name not in self._seen_kernels
+            self._seen_kernels.add(kernel_name)
+        tailattr.stamp_wave(items, kernel_name, self.max_batch,
+                            first_use, issue_ms,
+                            extra=self.store.wave_state())
+
     # -- completer pool (the blocking half of the pipelined dispatch) -------
 
     def _submit_completion(self, out, finish, items: list[dict],
@@ -2238,6 +2278,8 @@ class _QueryBatcher:
         """Hand an ISSUED (in-flight) kernel call to the completer pool;
         with pipelining off (bench A/B windows) the fetch runs inline —
         the pre-pipeline behavior, bit-identical results either way."""
+        if tailattr.enabled():
+            self._stamp_wave(items, kernel_name, issue_ms)
         for it in items:
             it["issue_ms"] = issue_ms
             it["stage"] = "inflight"    # issued, awaiting a completer
@@ -3676,6 +3718,22 @@ class DeviceSegmentStore:
                              pmeta=old.pmeta, row_bits=old.row_bits,
                              tkey=old.tkey)
 
+    def wave_state(self) -> dict:
+        """Tier/deferral snapshot a dispatch wave is stamped with
+        (ISSUE 15b): the classifier and the Performance_Tail_p wave log
+        read these to tell a paging wave from a clean one.  One short
+        lock acquisition per WAVE (not per query)."""
+        sched = self.ingest_scheduler
+        with self._lock:
+            return {
+                "tier_warm_hits": self.tier_warm_hits,
+                "tier_cold_hits": self.tier_cold_hits,
+                "promote_inflight": len(self._promote_inflight),
+                "deferred_promotes": len(self._deferred_promotes),
+                "merge_deferred": bool(
+                    sched is not None and sched.defer_promotions()),
+            }
+
     def _touch_packed(self, sp) -> None:
         """LRU timestamp for a hot packed span (the demotion order)."""
         if not self._tiering_enabled or sp.tkey is None:
@@ -3732,6 +3790,15 @@ class DeviceSegmentStore:
                 for key, _run in promote:
                     self._promote_inflight.discard(key)
                 promote = []
+        if hit_tier is not None and tailattr.enabled():
+            # tail-cause marker (ISSUE 15c): the classifier attributes
+            # this query's host-serve to the tier miss — or to the
+            # scheduler's deferral when the promotion is being parked
+            sched = self.ingest_scheduler
+            deferred = bool(sched is not None
+                            and sched.defer_promotions())
+            tracing.emit(tailattr.MARKER_COLD_MISS, 0.0,
+                         tier=hit_tier, deferred=deferred)
         for key, run in promote:
             self._submit_promote(key, run)
 
@@ -4544,6 +4611,8 @@ class DeviceSegmentStore:
             with self._lock:
                 self.device_lost_queries += 1
                 self.join_fallbacks += 1
+            tracing.emit(tailattr.MARKER_HOST_FALLBACK, 0.0,
+                         why="device_lost")
             return None
         try:
             out = self._rank_join_impl(
@@ -4555,6 +4624,8 @@ class DeviceSegmentStore:
             with self._lock:
                 self.device_lost_queries += 1
                 self.join_fallbacks += 1
+            tracing.emit(tailattr.MARKER_HOST_FALLBACK, 0.0,
+                         why="transfer_fail")
             return None
         if out == "declined":            # eligible shape, device declined
             with self._lock:
@@ -5517,7 +5588,12 @@ class DeviceSegmentStore:
             with self.rwi._lock:
                 if self.rwi._ram.get(termhash):
                     return None
+        # the cache peek is the FIRST store-lock acquisition on the
+        # query path: a query stalled behind a long arena mutation
+        # blocks here, so the wait is measured here too (ISSUE 15c)
+        _t_lk = time.perf_counter()
         with self._lock:
+            tailattr.note_lock_wait("devstore", _t_lk)
             epoch = self.arena_epoch
         got = self._topk_cache.get(key, epoch, stale_ok=stale_ok)
         if got is None:
@@ -5551,6 +5627,10 @@ class DeviceSegmentStore:
             with self._lock:
                 self.device_lost_queries += 1
                 self.fallbacks += 1
+            # tail-cause marker (ISSUE 15c): the host answer this query
+            # gets is attributable to the lost device, not anonymous
+            tracing.emit(tailattr.MARKER_HOST_FALLBACK, 0.0,
+                         why="device_lost")
             return None
         try:
             return self._rank_term_impl(
@@ -5562,6 +5642,8 @@ class DeviceSegmentStore:
             with self._lock:
                 self.device_lost_queries += 1
                 self.fallbacks += 1
+            tracing.emit(tailattr.MARKER_HOST_FALLBACK, 0.0,
+                         why="transfer_fail")
             return None
 
     def _rank_term_impl(self, termhash: bytes, profile,
@@ -5584,8 +5666,13 @@ class DeviceSegmentStore:
         # repack() swaps the arena and remaps every extent, so the spans
         # must be read against the same buffers the kernel will scan
         # (ONE lock round also decides residency: packed spans divert to
-        # the *_bp paths, non-resident terms attribute their tier miss)
+        # the *_bp paths, non-resident terms attribute their tier miss).
+        # The acquisition wait is measured (ISSUE 15c): a query stalled
+        # behind a long arena mutation gets a lock-wait marker span the
+        # tail classifier can name, instead of an anonymous gap.
+        _t_lk = time.perf_counter()
         with self._lock:
+            tailattr.note_lock_wait("devstore", _t_lk)
             spans = self.spans_for(termhash)
             ineligible = spans is None or len(spans) > self.MAX_SPANS
             is_packed = (not ineligible

@@ -72,7 +72,7 @@ from ..parallel.distribution import horizontal_dht_position
 from ..parallel.mesh import (all_gather_topk, all_gather_topk_full,
                              shard_map, tie_topk)
 from ..utils.eventtracker import EClass, update as track
-from ..utils import histogram, tracing
+from ..utils import histogram, tailattr, tracing
 from . import postings as P
 from ..utils import faultinject
 from .integrity import CorruptRunError
@@ -226,6 +226,10 @@ class _MeshQueryBatcher:
         self.timeout_flush_deadline = 0
         self.timeout_worker_stall = 0
         self.exceptions = 0
+        # compile-vs-reuse bit of the per-wave stamp (ISSUE 15b,
+        # devstore parity): first dispatch of a (kernel, bucket) shape
+        # by this batcher pays its jit compile in issue_ms
+        self._seen_kernels: set[tuple] = set()
         # pipelined dispatch (devstore parity, shrunk to one completer:
         # the mesh runs ONE SPMD program at a time): the dispatcher
         # ISSUES the first-bucket kernel and hands the in-flight buffer
@@ -283,12 +287,25 @@ class _MeshQueryBatcher:
                         else:
                             tracing.emit(f"kernel.{stage}", ms)
             sp.set(outcome=res[0])
+            wave = item.get("wave")
+            if wave is not None and not untraced:
+                # per-wave stamp on the batch span (ISSUE 15b,
+                # devstore parity): the tail classifier's evidence
+                sp.set(wave_n=wave["n"], wave_occ=wave["occ"],
+                       wave_qdepth=wave["qdepth"],
+                       wave_compile=wave["compile"],
+                       wave_kernel=wave["kernel"],
+                       wave_queue_ms=round(
+                           item.get("queue_wait_ms", 0.0), 3))
         if untraced:
             histogram.observe("mesh.batch",
                               (time.perf_counter() - t_sub) * 1000.0)
         return res
 
     def _submit_wait(self, item: dict):
+        if tailattr.enabled():
+            item["q_depth"] = self._q.qsize()
+            item["t_submit"] = time.perf_counter()
         self._q.put(item)
         if item["ev"].wait(timeout=self.WATCHDOG_S):
             return item["res"]
@@ -456,6 +473,14 @@ class _MeshQueryBatcher:
                    "arrays": arrays, "dead": dead, "pmax": pmax,
                    "t0k": t0k,
                    "issue_ms": (time.perf_counter() - t0k) * 1000.0}
+            if tailattr.enabled():
+                kkey = ("_mesh_pruned_kernel", kk, bs)
+                with self._ctr_lock:
+                    first_use = kkey not in self._seen_kernels
+                    self._seen_kernels.add(kkey)
+                tailattr.stamp_wave(items, "_mesh_pruned_kernel",
+                                    self.max_batch, first_use,
+                                    rec["issue_ms"])
             for it in items:
                 it["stage"] = "inflight"   # issued, awaiting the completer
                 it["issued"] = True        # the completer owns the answer
@@ -1207,6 +1232,8 @@ class MeshSegmentStore:
             with self._lock:
                 self.device_lost_queries += 1
                 self.fallbacks += 1
+            tracing.emit(tailattr.MARKER_HOST_FALLBACK, 0.0,
+                         why="device_lost")
             return None
         try:
             return self._rank_term_impl(termhash, profile, language, k,
@@ -1216,6 +1243,8 @@ class MeshSegmentStore:
             with self._lock:
                 self.device_lost_queries += 1
                 self.fallbacks += 1
+            tracing.emit(tailattr.MARKER_HOST_FALLBACK, 0.0,
+                         why="transfer_fail")
             return None
 
     def rank_term_mp(self, termhash: bytes, profile,
@@ -1238,6 +1267,8 @@ class MeshSegmentStore:
             with self._lock:
                 self.device_lost_queries += 1
                 self.fallbacks += 1
+            tracing.emit(tailattr.MARKER_HOST_FALLBACK, 0.0,
+                         why="transfer_fail")
             return None
         except Exception:
             # a mid-collective failure (a peer process died underneath

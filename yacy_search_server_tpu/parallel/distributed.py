@@ -51,8 +51,11 @@ import os
 import queue as _queue
 import threading
 import time
+from collections import deque
 
 import numpy as np
+
+from ..utils import faultinject, tailattr, tracing
 
 log = logging.getLogger("parallel.distributed")
 
@@ -223,6 +226,14 @@ class MeshMember:
         self.commit_timeouts = 0
         self.incidents: list[dict] = []
         self._member_state: dict[int, str] = {}     # id -> ok|lost|down
+        # tail forensics (ISSUE 15a): every executed step produces a
+        # span segment (queue wait / commit [collective-entry] wait /
+        # local execution wall).  The coordinator feeds its own
+        # segments straight into the process-global MeshTimeline;
+        # members park theirs here and ship them INLINE on the next
+        # meshstep/meshcommit reply — zero extra RPCs.
+        self.timeline = tailattr.MESH if process_id == 0 else None
+        self._segs_out: deque = deque(maxlen=128)
 
         t = HttpTransport(timeout_s=10.0)
         self.node = P2PNode(self.name, t, data_dir=data_dir,
@@ -273,17 +284,44 @@ class MeshMember:
         rec = {"payload": dict(payload),
                "commit": threading.Event(), "go": False,
                "done": threading.Event(), "result": None,
-               "mode": "host"}
+               "mode": "host",
+               "t_enq": time.perf_counter(), "ts0": time.time()}
         with self._plock:
             self._pending[int(payload["seq"])] = rec
         self._steps.put(rec)
         return rec
 
+    def _drain_segments(self) -> list[dict]:
+        with self._plock:
+            segs = list(self._segs_out)
+            self._segs_out.clear()
+        return segs
+
+    def _note_segment(self, rec: dict) -> None:
+        """One executed step's span segment: the coordinator assembles
+        it immediately; members park it for the next scatter reply."""
+        if not tailattr.enabled():
+            return
+        seg = {"seq": int(rec["payload"].get("seq", -1)),
+               "m": self.process_id,
+               "q_ms": round(rec.get("q_ms", 0.0), 3),
+               "commit_ms": round(rec.get("commit_ms", 0.0), 3),
+               "entry_ms": round(rec.get("entry_ms", 0.0), 3),
+               "exec_ms": round(rec.get("exec_ms", 0.0), 3),
+               "mode": rec.get("mode", "?"),
+               "ts0": round(rec.get("ts0", 0.0), 6)}
+        if self.timeline is not None:
+            self.timeline.add_segment(seg)
+        else:
+            with self._plock:
+                self._segs_out.append(seg)
+
     def enqueue_step(self, payload: dict) -> dict:
-        """Phase 1 (wire): enqueue, ack with health.  The runloop
-        executes in arrival order once phase 2 commits."""
+        """Phase 1 (wire): enqueue, ack with health + any pending step
+        segments (ISSUE 15a — completed steps' timelines ride the
+        scatter the coordinator already pays for)."""
         self._enqueue_local(payload)
-        return self._health()
+        return {**self._health(), "segs": self._drain_segments()}
 
     def commit_step(self, seq: int, go: bool) -> dict:
         with self._plock:
@@ -292,7 +330,7 @@ class MeshMember:
             return {"error": f"unknown seq {seq}", **self._health()}
         rec["go"] = bool(go)
         rec["commit"].set()
-        return self._health()
+        return {**self._health(), "segs": self._drain_segments()}
 
     def _runloop(self) -> None:
         while not self._stop.is_set():
@@ -302,6 +340,13 @@ class MeshMember:
                 continue
             if rec is None:
                 return
+            # segment timing (ISSUE 15a): queue wait = enqueue ->
+            # runloop pickup (steps serialized behind earlier ones);
+            # commit wait = pickup -> go/no-go decided (the collective-
+            # entry wait: no process enters the SPMD program before the
+            # fleet-wide verdict lands)
+            t_deq = time.perf_counter()
+            rec["q_ms"] = (t_deq - rec.get("t_enq", t_deq)) * 1000.0
             if not rec["commit"].wait(timeout=COMMIT_TIMEOUT_S):
                 # the commit never arrived (coordinator died between
                 # phases): decide LOCALLY for host mode — bounded, and
@@ -310,6 +355,7 @@ class MeshMember:
                 with self._plock:
                     self.commit_timeouts += 1
                 rec["go"] = False
+            rec["commit_ms"] = (time.perf_counter() - t_deq) * 1000.0
             try:
                 self._execute(rec)
             except Exception:
@@ -329,6 +375,7 @@ class MeshMember:
                     self._pending.pop(int(rec["payload"].get("seq", -1)),
                                       None)
             finally:
+                self._note_segment(rec)
                 rec["done"].set()
 
     def _execute(self, rec: dict) -> None:
@@ -338,6 +385,20 @@ class MeshMember:
         profile = RankingProfile.from_external_string(p["profile"])
         lang = p.get("lang", "en")
         k = int(p.get("k", 10))
+        t_ex = time.perf_counter()
+        # env-gated straggler injection (ISSUE 15): a latency armed in
+        # ONE member (via do_meshfault) slows exactly that member's
+        # step execution — the deterministic driver for the
+        # collective_straggler verdict and the scoreboard tests
+        faultinject.sleep("mesh.step")
+        # segment split (ISSUE 15a): `entry_ms` is this member's LOCAL
+        # pre-dispatch wall — a late member shows its lateness HERE,
+        # while the others' stalls land in their exec wall as they
+        # block at the collective entry.  In an SPMD collective every
+        # member's exec wall inflates identically when one straggles,
+        # so entry lateness is the signal that NAMES the straggler.
+        t_disp = time.perf_counter()
+        rec["entry_ms"] = (t_disp - t_ex) * 1000.0
         out = None
         if rec["go"]:
             out = self.store.rank_term_mp(termhash, profile, lang, k)
@@ -352,6 +413,7 @@ class MeshMember:
             rec["mode"] = "host"
             with self._plock:
                 self.answered_host += 1
+        rec["exec_ms"] = (time.perf_counter() - t_disp) * 1000.0
         with self._plock:
             self.queries_total += 1
             self._pending.pop(int(p["seq"]), None)
@@ -369,11 +431,11 @@ class MeshMember:
         then every process — this one included — executes the step: a
         cross-process SPMD collective when committed, the host answer
         when degraded.  100% of queries answer either way."""
-        from ..utils import tracing
         # lint: blocking-ok(SPMD lockstep: the coordinator scatter is
         # deliberately serialized — _serve_lock IS the fleet-wide step
         # ordering, so the RPCs and the step wait belong inside it)
         with self._serve_lock, tracing.trace("mesh.serve"):
+            t_q0 = time.perf_counter()
             seq = self._seq
             self._seq += 1
             step = {"seq": seq, "kind": "rank_term", "term": term_hex,
@@ -388,6 +450,7 @@ class MeshMember:
                     self.member_down_steps += 1
                     go = False
                     continue
+                self._ingest_segments(rep)
                 pids[j] = int(rep.get("pid", -1))
                 if rep.get("fp") != self.fingerprint:
                     # divergent partition math would return WRONG
@@ -401,12 +464,25 @@ class MeshMember:
                     go = False
                 else:
                     self._note_member(j, "ok", rep.get("pid"))
+            # cross-process scatter assembly (ISSUE 15a): register the
+            # step's timeline record over EXACTLY the processes that
+            # acked phase 1 (+ self) — a down member must not hold the
+            # waterfall/verdict incomplete forever
+            if self.timeline is not None:
+                self.timeline.note_step(
+                    seq, tracing.current_trace_id() or "",
+                    pids.keys(), "collective" if go else "host")
             for j, seed in sorted(self.peers.items()):
-                self.node.protocol.mesh_rpc(
+                ok, rep = self.node.protocol.mesh_rpc(
                     seed, "meshcommit", {"seq": seq, "go": go})
+                if ok:
+                    self._ingest_segments(rep)
             lrec = self._enqueue_local(step)
             self.commit_step(seq, go)
             lrec["done"].wait(timeout=COMMIT_TIMEOUT_S + 40.0)
+            if self.timeline is not None:
+                self.timeline.finish_step(
+                    seq, (time.perf_counter() - t_q0) * 1000.0)
             s, d, considered = lrec["result"] or \
                 (np.empty(0, np.int32), np.empty(0, np.int32), 0)
             return {"seq": seq, "mode": lrec["mode"], "go": bool(go),
@@ -415,6 +491,16 @@ class MeshMember:
                     "considered": int(considered),
                     "pids": {str(j): p for j, p in pids.items()},
                     "trace": tracing.current_trace_id()}
+
+    def _ingest_segments(self, rep: dict) -> None:
+        """Feed step segments a member shipped inline on a scatter
+        reply into the coordinator's timeline (members: no-op)."""
+        if self.timeline is None or not isinstance(rep, dict):
+            return
+        segs = rep.get("segs")
+        if isinstance(segs, list):
+            for seg in segs:
+                self.timeline.add_segment(seg)
 
     def _note_member(self, j: int, state: str, pid,
                      cause: str | None = None) -> None:
@@ -445,8 +531,15 @@ class MeshMember:
 
     # -- info / lifecycle -----------------------------------------------------
 
-    def info(self) -> dict:
+    def info(self, tick_health: bool = False) -> dict:
         from ..utils import histogram
+        eng = getattr(self.sb, "health", None)
+        if tick_health and eng is not None:
+            # node switchboards under the mesh runtime do not run the
+            # 15_health busy thread; the wire caller (bench/test) drives
+            # evaluation explicitly so burn-rate rules and the flight
+            # recorder fire on the member's real histograms
+            eng.tick()
         h = histogram.get("mesh.collective")
         hist = {"count": h.count if h else 0,
                 "sum_ms": round(h.sum_ms, 3) if h else 0.0,
@@ -462,6 +555,59 @@ class MeshMember:
                 "step_errors": self.step_errors,
                 "member_down_steps": self.member_down_steps,
                 "commit_timeouts": self.commit_timeouts}
+        # tail forensics (ISSUE 15): the coordinator's assembled view —
+        # windowed cause histogram, verdict ring, straggler scoreboard
+        # and the newest complete cross-process waterfall; members
+        # report their local verdicts too
+        if self.timeline is not None:
+            # an owed verdict whose segments never fully arrived (lull
+            # after a burst) finalizes from partial segments now — the
+            # info caller is exactly who must not see a silent drop
+            self.timeline.flush_pending()
+        verdicts = tailattr.verdicts(8)
+        strag_wf = None
+        if self.timeline is not None:
+            # the assembled waterfall OF an over-threshold straggled
+            # query (the ISSUE 15 acceptance artifact's exhibit), not
+            # just the newest complete step
+            for v in verdicts:
+                if v.cause == "collective_straggler":
+                    strag_wf = self.timeline.waterfall(
+                        v.evidence.get("seq"))
+                    break
+        tail = {
+            "causes": tailattr.windowed_causes(),
+            "cause_totals": tailattr.cause_totals(),
+            "stragglers": tailattr.straggler_totals(),
+            "verdicts": [v.to_json() for v in verdicts],
+            "scoreboard": tailattr.scoreboard(),
+            "waterfall": (self.timeline.waterfall()
+                          if self.timeline is not None else None),
+            "straggled_waterfall": strag_wf,
+            "segments_merged": (self.timeline.segments_merged
+                                if self.timeline is not None else 0),
+            "pending_partial": (self.timeline.pending_partial
+                                if self.timeline is not None else 0),
+        }
+        health_incs = []
+        incident_tail = None
+        if eng is not None:
+            for inc in eng.incidents:
+                health_incs.append({"name": inc["name"],
+                                    "rules": list(inc["rules"])})
+            if eng.incidents:
+                # the newest incident's embedded tail evidence (the
+                # ISSUE 15 acceptance surface: incidents carry causes)
+                body = eng.incidents[-1]["body"]
+                incident_tail = {}
+                for line in body.splitlines():
+                    try:
+                        obj = json.loads(line)
+                    except ValueError:
+                        continue
+                    if obj.get("kind") in ("tail_causes",
+                                           "straggler_scoreboard"):
+                        incident_tail[obj["kind"]] = obj
         return {**self._health(),
                 "counters": self.store.counters(),
                 "runtime": runtime,
@@ -472,7 +618,10 @@ class MeshMember:
                 # OTHER mesh members (Network_Health_p's mesh columns)
                 "peers_proc": [r.get("proc", {}) for r in rows],
                 "peers_epoch": [r.get("epoch", 0) for r in rows],
-                "incidents": list(self.incidents)}
+                "incidents": list(self.incidents),
+                "tail": tail,
+                "health_incidents": health_incs,
+                "incident_tail": incident_tail}
 
     def close(self) -> None:
         self._stop.set()

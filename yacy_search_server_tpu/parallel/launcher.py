@@ -224,8 +224,14 @@ class MeshFleet:
         return _post(self._url(0, "meshsearch"),
                      {"word": word, "k": k}, timeout_s=timeout_s)
 
-    def info(self, i: int, timeout_s: float = 30.0) -> dict:
-        return _post(self._url(i, "meshinfo"), {}, timeout_s=timeout_s)
+    def info(self, i: int, timeout_s: float = 30.0,
+             tick_health: bool = False) -> dict:
+        """Member introspection; `tick_health=True` additionally drives
+        one health-engine evaluation on the member (the tail-forensics
+        harness's incident driver — mesh members run no busy threads)."""
+        payload = {"tick_health": 1} if tick_health else {}
+        return _post(self._url(i, "meshinfo"), payload,
+                     timeout_s=timeout_s)
 
     def fault(self, i: int, point: str, value,
               clear: bool = False) -> dict:

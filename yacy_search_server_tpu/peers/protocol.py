@@ -15,6 +15,8 @@ on failed RPCs).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from ..index.postings import NF, PostingsList
@@ -235,8 +237,15 @@ class Protocol:
         for entries in batches:
             if not entries:
                 continue
+            # wire-entry stamp (ISSUE 15 satellite / ROADMAP 3b first
+            # slice): the receiver anchors its crawl-to-searchable SLO
+            # stamps at this send time, so peer-pushed postings land in
+            # the ingest tiers + burn rule.  Wall-clock seconds because
+            # monotonic stamps do not cross hosts; absent-stamp peers
+            # are tolerated (the receiver anchors at its wire entry).
             ok, reply = self._call(target, "transferRWI",
-                                   {"entries": entries})
+                                   {"entries": entries,
+                                    "stamp": round(time.time(), 3)})
             if not ok:
                 return False, {}
             if reply.get("result") not in ("ok", None):

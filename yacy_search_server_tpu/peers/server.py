@@ -254,7 +254,11 @@ class PeerServer:
         m = self._mesh_member()
         if m is None:
             return {"error": "not a mesh member"}
-        return m.info()
+        # tick_health (ISSUE 15): the caller drives one health-engine
+        # evaluation on this member — mesh runtimes run no busy
+        # threads, and the tail-forensics acceptance needs the burn
+        # rules + flight recorder evaluated against live histograms
+        return m.info(tick_health=bool(payload.get("tick_health")))
 
     def do_meshsearch(self, payload: dict) -> dict:
         """External query entry on the coordinator: scatter → collective
@@ -339,6 +343,22 @@ class PeerServer:
         flag, load shedding, blacklist, storeRWI, unknownURL, pause)."""
         if not self.accept_remote_index:
             return {"result": "not granted", "unknownURL": [], "pause": 60}
+        # ingest SLO stamp at WIRE ENTRY (ISSUE 15 satellite / ROADMAP
+        # 3b first slice): peer-pushed postings land in the
+        # ingest.searchable/.flushed/.device tiers + the burn rule like
+        # locally-crawled documents.  The sender's wall-clock `stamp`
+        # (riding the existing payload) back-dates the entry by the
+        # wire+queue delay, clamped against clock skew; absent-stamp
+        # peers anchor at this node's wire entry — tolerated, never
+        # rejected.
+        from ..ingest import slo as ingest_slo
+        t_entry = ingest_slo.TRACKER.stamp()
+        try:
+            sent = float(payload.get("stamp", 0.0))
+        except (TypeError, ValueError):
+            sent = 0.0
+        if sent > 0.0:
+            t_entry -= max(0.0, min(time.time() - sent, 600.0))
         rwi = self.sb.index.rwi
         if rwi.ram_postings_count > \
                 rwi.max_ram_postings * RWI_BUFFER_SHED_FACTOR:
@@ -361,6 +381,7 @@ class PeerServer:
             if rwi.ram_postings_count >= rwi.hard_max_ram_postings():
                 return {"result": "busy", "unknownURL": [], "pause": 60}
         entries = payload.get("entries", [])[:MAX_RWI_ENTRIES_PER_CALL]
+        stamped_docs: set[bytes] = set()
         for entry in entries:
             th = entry.get("term", "").encode("ascii")
             if len(th) != 12:
@@ -380,6 +401,12 @@ class PeerServer:
                     unknown.add(uh)   # stub from an earlier call, still bare
                 rwi.add(th, docid, feats[i])
                 received += 1
+                stamped_docs.add(uh)
+        # one SLO stamp per received DOCUMENT (not posting): the doc is
+        # searchable from the RAM buffer now, and its stamp rides the
+        # pending set into the flush/device tiers like a crawled doc's
+        for _uh in stamped_docs:
+            ingest_slo.TRACKER.note_stored(rwi, t_entry)
         self.received_rwi_count += received
         # single-flight (ISSUE 13): a transfer racing the indexer's
         # flush skips instead of stacking a duplicate one

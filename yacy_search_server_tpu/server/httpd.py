@@ -672,7 +672,24 @@ class YaCyHttpServer:
                 me, extra, handler.client_address[0], client_seed)
             self._send(handler, 200, "text/plain; charset=utf-8", body)
             return
-        result = self.peer_server.handle(endpoint, params)
+        if endpoint == "meshsearch":
+            # the mesh coordinator's external query entry IS a serving
+            # surface (ISSUE 15): its wall lands in the same SLO
+            # histogram the burn-rate rules read, with the mesh.serve
+            # trace id as the exemplar — so a straggling member burns
+            # slo_serving_p95 and the incident can name the cause.
+            # Other wire RPCs (DHT shipping, digests, scatter internals)
+            # stay out: they are not query serving.
+            tracing.clear_last_trace_id()
+            t_sv = time.perf_counter()
+            try:
+                result = self.peer_server.handle(endpoint, params)
+            finally:
+                histogram.observe("servlet.serving",
+                                  (time.perf_counter() - t_sv) * 1000.0,
+                                  tracing.last_trace_id())
+        else:
+            result = self.peer_server.handle(endpoint, params)
         body = json.dumps(result, default=_wire_default).encode("utf-8")
         self._send(handler, 200, "application/json", body)
 

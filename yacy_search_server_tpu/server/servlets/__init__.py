@@ -46,4 +46,4 @@ def _ensure_loaded() -> None:
     _loaded = True
     from . import (yacysearch, status, admin, api, boards,  # noqa: F401
                    breadth, federate, graphics, health, ingest, operator,
-                   proxy, monitoring)
+                   proxy, monitoring, tail)

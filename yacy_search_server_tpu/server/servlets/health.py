@@ -193,6 +193,16 @@ def respond_network_health(header: dict, post: ServerObjects,
         prop.put(pre + "proc_pid", proc.get("pid", 0))
         prop.put(pre + "proc_id", proc.get("id", 0))
         prop.put(pre + "proc_lost", proc.get("lost", 0))
+        # per-member serving rung + dominant tail cause (ISSUE 15
+        # satellite): a degraded member is visible here BEFORE it
+        # becomes a straggler verdict.  '-' for digest-less peers
+        # (version skew), never a fake healthy 0.
+        a = r.get("act") or {}
+        prop.put(pre + "degrade_level",
+                 a.get("lvl") if "lvl" in a else "-")
+        prop.put(pre + "tail_cause",
+                 escape_json(str(a.get("cause"))) if "cause" in a
+                 else "-")
         prop.put(pre + "rtt_ms",
                  round(r["rtt_ms"], 1) if r["rtt_ms"] is not None else "-")
         for fam in fleetmod.DIGEST_FAMILIES:

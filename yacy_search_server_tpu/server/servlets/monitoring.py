@@ -730,6 +730,30 @@ def prometheus_text(sb, include_buckets: bool = True,
     p.sample("yacy_fleet_peer_reported_critical",
              len([e for e in peers_fresh if e.get("health") == 2]))
 
+    # -- tail forensics (ISSUE 15): the cause-attribution canon.  Every
+    # over-threshold serving query gets exactly one classified verdict;
+    # the cause counters are ZERO-FILLED over the canon so alert
+    # expressions and the fleet digest's top-1 mapping always resolve.
+    from ...utils import tailattr
+    p.family("yacy_tail_cause_total", "counter",
+             "classified p99 verdicts by dominant cause (one verdict "
+             "per over-threshold serving query; collective_straggler "
+             "verdicts additionally name the member in "
+             "yacy_tail_straggler_total)")
+    tc = tailattr.cause_totals()
+    for cause in tailattr.CAUSES:
+        p.sample("yacy_tail_cause_total", tc.get(cause, 0),
+                 {"cause": cause})
+    p.family("yacy_tail_straggler_total", "counter",
+             "collective_straggler verdicts by the named mesh member")
+    for member, v in sorted(tailattr.straggler_totals().items()):
+        p.sample("yacy_tail_straggler_total", v, {"member": member})
+    p.family("yacy_tail_verdicts_total", "counter",
+             "over-threshold serving queries classified by the "
+             "tail-attribution engine")
+    p.sample("yacy_tail_verdicts_total",
+             tailattr.ATTR.counters()["classified_total"])
+
     p.family("yacy_traces_retained", "gauge",
              "completed traces in the tracing ring")
     p.sample("yacy_traces_retained", len(tracing.traces(tracing.MAX_TRACES)))

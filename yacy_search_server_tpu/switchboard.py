@@ -289,6 +289,12 @@ class Switchboard:
         self.health = HealthEngine(
             self, incidents_dir=sub("HEALTH") if data_dir else None)
 
+        # tail-attribution engine (ISSUE 15): process-global like the
+        # histogram registry it gates on; configured here so tail.* is
+        # read once per switchboard like every performance knob
+        from .utils import tailattr
+        tailattr.configure(self.config)
+
         # actuator layer (ISSUE 9): the rules above only OBSERVE — this
         # closes the loop.  Admission token buckets, the serving
         # degradation ladder, batcher auto-tuning and the remote-search

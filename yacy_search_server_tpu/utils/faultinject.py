@@ -79,6 +79,8 @@ _active = False
 REGISTERED_FAULTPOINTS = {
     "servlet.serving": "httpd dispatch latency inside the SLO wall",
     "batcher.dispatch": "forced dispatcher stall (worker_stall path)",
+    "mesh.step": "mesh member step-execution latency (straggler "
+                 "injection for the collective_straggler verdict)",
     "peer.blackhole": "RPCs to listed peer hashes fail",
     "proc.crashpoint": "named SIGKILL barrier (see CRASHPOINTS)",
     "io.torn_write": "durable write truncated at byte N, then raises",

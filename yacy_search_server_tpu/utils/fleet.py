@@ -45,7 +45,7 @@ import json
 import threading
 import time
 
-from . import histogram
+from . import histogram, tailattr
 
 # payload key carrying the digest on every in-band transport (the
 # fleet-table analogue of tracing.PAYLOAD_KEY); the Java wire carries it
@@ -90,6 +90,17 @@ def digest_bytes(digest: dict) -> int:
     return len(encode_digest(digest))
 
 
+def decode_act_cause(act: dict) -> str:
+    """Tolerant decode of the digest's cause index back to its canon
+    label; out-of-range/absent (version skew) reads as unattributed."""
+    try:
+        i = int(act.get("c", -1))
+    except (TypeError, ValueError):
+        i = -1
+    return tailattr.CAUSES[i] if 0 <= i < len(tailattr.CAUSES) \
+        else "unattributed"
+
+
 def digest_series(digest: dict) -> dict:
     """Map every field a digest emits to the `/metrics` sample key it
     summarizes.  THE hygiene contract (ISSUE 5 satellite, mirroring the
@@ -119,6 +130,15 @@ def digest_series(digest: dict) -> dict:
         out["proc.id"] = 'yacy_mesh_process{field="process_id"}'
         out["proc.n"] = 'yacy_mesh_process{field="num_processes"}'
         out["proc.lost"] = "yacy_device_lost"
+    if "act" in digest:
+        # per-member serving rung + tail-cause top-1 (ISSUE 15
+        # satellite): a degraded member is visible in Network_Health_p
+        # BEFORE it becomes a straggler verdict.  The cause travels as
+        # an index into the zero-filled tailattr.CAUSES canon, so its
+        # labeled series resolves on every node's exposition.
+        out["act.l"] = "yacy_degrade_level"
+        out["act.c"] = ('yacy_tail_cause_total{cause="'
+                        + decode_act_cause(digest["act"]) + '"}')
     if "tiers" in digest:
         # compact tier occupancy (ISSUE 8): KiB per residency tier +
         # total promotions — the mesh view of who is paging
@@ -235,6 +255,7 @@ class FleetTable:
                 "id": mm.process_id if mm is not None else 0,
                 "n": mm.num_processes if mm is not None else 1,
                 "lost": (1 if getattr(ds, "device_lost", False) else 0)}
+        act = getattr(self.sb, "actuators", None)
         digest = {
             "v": DIGEST_VERSION,
             "peer": self.my_hash,
@@ -249,6 +270,16 @@ class FleetTable:
                        "inflight": b._inflight.qsize()
                        if b is not None else 0},
             "proc": proc,
+            # serving rung + windowed dominant tail cause (ISSUE 15):
+            # the fleet sees WHO is degraded and WHY its tail is fat.
+            # The cause travels as its INDEX into the tailattr.CAUSES
+            # canon (~6 bytes vs ~30 for the label — the digest's
+            # byte budget is a wire contract)
+            "act": {
+                "l": int(act.effective_level())
+                if act is not None else 0,
+                "c": tailattr.CAUSES.index(tailattr.top_cause()),
+            },
             "epoch": int(c.get("arena_epoch", 0)),
             # tier occupancy in KiB (compact: ~30 B inside the 2 KiB
             # budget) + warm->hot promotions — a peer whose w/c grow
@@ -375,6 +406,8 @@ class FleetTable:
             if isinstance(digest.get("epoch"), int) else 0,
             "proc": digest.get("proc")
             if isinstance(digest.get("proc"), dict) else {},
+            "act": digest.get("act")
+            if isinstance(digest.get("act"), dict) else {},
             "recv_mono": time.monotonic(),
             "recv_ts": time.time(),
             "bytes": digest_bytes(digest),
@@ -565,5 +598,10 @@ class FleetTable:
                 "queues": e.get("queues", {}),
                 "epoch": e.get("epoch", 0),
                 "proc": e.get("proc", {}),
+                # serving rung + tail-cause top-1 (ISSUE 15 satellite),
+                # decoded for Network_Health_p's degraded-member columns
+                "act": ({"lvl": e["act"].get("l", 0),
+                         "cause": decode_act_cause(e["act"])}
+                        if e.get("act") else {}),
             })
         return rows

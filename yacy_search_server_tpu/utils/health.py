@@ -686,6 +686,21 @@ class HealthEngine:
             lines.append(json.dumps({
                 "kind": "snapshot", "ts": round(ts, 3),
                 "series": samples}))
+        # tail forensics (ISSUE 15c): when a SERVING SLO rule is what
+        # went critical, the incident embeds the windowed cause
+        # histogram and the straggler scoreboard — so it reads "p95
+        # burn, 71% collective_straggler mesh1" instead of "p95 burn"
+        if any(r in ("slo_serving_p95", "fleet_slo_serving")
+               for r in entered):
+            from . import tailattr
+            lines.append(json.dumps({
+                "kind": "tail_causes",
+                "window": tailattr.windowed_causes(),
+                "verdicts": [v.to_json()
+                             for v in tailattr.verdicts(10)]}))
+            lines.append(json.dumps({
+                "kind": "straggler_scoreboard",
+                "rows": tailattr.scoreboard()}))
         # actuator breadcrumbs (ISSUE 9): the incident names every
         # actuation around the edge — which ladder rung, which tuning
         # step, which peers were avoided — so a postmortem reads the

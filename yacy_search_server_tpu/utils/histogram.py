@@ -237,6 +237,18 @@ class Histogram:
 
     # -- reading -------------------------------------------------------------
 
+    @property
+    def p95_cache(self) -> float:
+        """The window p95 cached at the last rotation — the exemplar
+        election threshold, reused by the tail-attribution gate
+        (ISSUE 15): a value at/above it is exemplar-worthy, so it gets
+        classified.  Unlocked read of an atomically-replaced float (the
+        same discipline record() uses for its compare)."""
+        # lint: unlocked-ok(float replaced atomically under _lock at
+        # rotation; a stale read only shifts one gating decision by a
+        # rotation interval)
+        return self._p95_cache
+
     def windowed_counts(self, last: int | None = None) -> list:
         """Merged bucket counts over the newest `last` windows (default:
         all retained)."""

@@ -926,3 +926,80 @@ def check_servlet_trace(repo: Repo, stats: dict):
                 f"or annotate `# lint: trace-ok(reason)`"))
     stats["servlet_handlers"] = handlers
     return findings
+
+
+# -- 11. tail-classifier reachability (ISSUE 15) ------------------------------
+
+TAILATTR_REL = "yacy_search_server_tpu/utils/tailattr.py"
+
+
+def tail_classifier_families(repo: Repo) -> set[str]:
+    """The histogram families the tail classifier consumes or gates on,
+    read statically off utils/tailattr.CLASSIFIER_FAMILIES (a
+    frozenset literal whose elements may be the module's own MARKER_*
+    string constants) — no import, same single-parse pass as the
+    roofline registry reads."""
+    ctx = repo.get(TAILATTR_REL)
+    if ctx is None:
+        return set()
+    consts: dict[str, str] = {}
+    fams: set[str] = set()
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                consts[t.id] = node.value.value
+            elif t.id == "CLASSIFIER_FAMILIES" and \
+                    isinstance(node.value, ast.Call) and \
+                    node.value.args and \
+                    isinstance(node.value.args[0], ast.Set):
+                for el in node.value.args[0].elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        fams.add(el.value)
+                    elif isinstance(el, ast.Name):
+                        fams.add(("__name__", el.id))
+    return {consts.get(f[1], "") if isinstance(f, tuple) else f
+            for f in fams} - {""}
+
+
+@checker("tail-reach", "tail-ok")
+def check_tail_reach(repo: Repo, stats: dict):
+    """Every histogram family a servlet wall observes directly
+    (``histogram.observe("<family>", ...)`` anywhere under server/)
+    must be reachable by the tail classifier — listed in
+    utils/tailattr.CLASSIFIER_FAMILIES — or carry a reasoned
+    ``# lint: tail-ok(reason)``.  A serving wall the classifier cannot
+    see is a p99 bucket nothing can ever explain: it fills the SLO
+    histogram but every over-threshold query it measures would
+    classify blind."""
+    findings = []
+    fams = tail_classifier_families(repo)
+    observed = 0
+    for ctx in repo.under("yacy_search_server_tpu/server/"):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and dotted(node.func) == "histogram.observe"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            observed += 1
+            fam = node.args[0].value
+            if fam in fams:
+                continue
+            if ctx.exempt(("tail-ok",), [node.lineno]):
+                continue
+            findings.append(Finding(
+                "tail-reach", ctx.rel, node.lineno,
+                f"servlet wall observes histogram family {fam!r} the "
+                f"tail classifier cannot reach — add it to "
+                f"utils/tailattr.CLASSIFIER_FAMILIES (and teach the "
+                f"classifier) or annotate `# lint: tail-ok(reason)`"))
+    stats["servlet_observed_families"] = observed
+    stats["classifier_families"] = len(fams)
+    return findings

@@ -1,0 +1,698 @@
+"""Tail forensics — the p99 cause-attribution engine (ISSUE 15).
+
+The observability spine built in rounds 6–10 *measures* a slow query
+(windowed histograms, exemplars, burn-rate rules) but never *explains*
+it: a cold-tier page-in, a deferred merge, a straggling mesh member all
+land in the same anonymous fat p99 bucket, and a flight-recorder
+incident names the symptom (``slo_serving_p95 critical``), not the
+cause.  This module promotes the per-stage attribution discipline of
+the trace spine into a CAUSAL layer: every over-threshold query gets
+exactly one classified verdict.
+
+Three parts:
+
+- :class:`TailAttributor` — the classifier.  Hooked to root-span
+  completion (``tracing.add_root_hook``), it reuses the cached-window-
+  p95 gating from :mod:`utils.histogram` (the same gate that elects
+  exemplars): a serving root at/above its family's window p95 (floored
+  at ``MIN_MS``) is exemplar-worthy, so it gets classified.  The walk
+  reads the trace's spans — cause markers emitted by the product paths
+  (``tail.host_fallback`` / ``tail.cold_miss`` / ``tail.lock_wait`` /
+  ``search.degraded``), the per-wave stamps the batchers attach to
+  ``devstore.batch`` / ``mesh.batch`` spans, and the kernel span
+  decomposition — and emits ONE dominant cause from :data:`CAUSES`
+  into a zero-filled counter canon (``yacy_tail_cause_total{cause}``)
+  plus a bounded verdict ring served by ``Performance_Tail_p``.
+- :class:`MeshTimeline` — cross-process scatter assembly.  Mesh members
+  return their step's span segment (queue wait, commit/collective-entry
+  wait, local execution wall) inline on the next scatter reply (zero
+  extra RPCs); the coordinator assembles a complete per-member timeline
+  for every collective query, merges it into the trace ring (the
+  ``assemble=1`` waterfall shows the whole mesh), finalizes verdicts
+  that had to wait for segments (``collective_straggler`` NAMES the
+  slowest member) and maintains the windowed straggler scoreboard (how
+  often each member was the slowest leg, by how much).
+- The wave log — a bounded ring of the batchers' dispatch-wave stamps
+  (queue depth at enqueue, wave occupancy, compile-vs-reuse, tier/
+  deferral state) so a query's slowness is attributable to *its wave*,
+  not just its own spans.
+
+Jax-free by contract (imported by the wire layer and the chaos
+children); zero-alloc when disabled — every product hook bails on one
+module-flag read, the ``bench.py --tail-overhead`` A/B switch.
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+
+from . import histogram, tracing
+
+log = logging.getLogger("tailattr")
+
+# the cause canon (zero-filled on /metrics so alert expressions and the
+# fleet digest's top-1 field always resolve).  collective_straggler
+# verdicts additionally NAME the member (verdict ring + scoreboard +
+# yacy_tail_straggler_total{member}).
+CAUSES = (
+    "queue_wait",            # batcher wait dominated (pool saturated /
+    #                          dispatcher wedged / backlog)
+    "compile",               # the wave paid a first-use kernel compile
+    "collective_straggler",  # one mesh member's step straggled the fleet
+    "tier_cold",             # cold/warm tier miss: the query host-served
+    #                          while its term's promotion was kicked
+    "merge_deferral",        # the miss was parked by the merge/promotion
+    #                          scheduler's serving-SLO deferral
+    "lock_wait",             # measured lock-acquisition wall dominated
+    "degraded_rung",         # the query served under a degradation rung
+    "host_fallback",         # device lost / transfer failure: counted
+    #                          host answer
+    "unattributed",          # over threshold, no detector claimed it
+)
+
+# cause-marker span families the product paths emit (each creates a
+# histogram family through the one span-record wiring point; the
+# markers are 0 ms except lock_wait, which is a real measured wall)
+MARKER_HOST_FALLBACK = "tail.host_fallback"
+MARKER_COLD_MISS = "tail.cold_miss"
+MARKER_LOCK_WAIT = "tail.lock_wait"
+MARKER_DEGRADED = "search.degraded"        # emitted by SearchEvent (M83)
+
+# histogram families the classifier consumes or gates on — the
+# yacylint `tail-reach` checker requires any family a servlet wall
+# observes to appear here (or carry a reasoned tail-ok lint
+# exemption): a serving wall the classifier cannot reach is a p99
+# bucket nothing can ever explain.
+CLASSIFIER_FAMILIES = frozenset({
+    "servlet.serving",
+    "switchboard.search", "mesh.serve",
+    "devstore.batch", "mesh.batch", "mesh.collective",
+    "kernel.issue", "kernel.device", "kernel.fetch",
+    MARKER_HOST_FALLBACK, MARKER_COLD_MISS, MARKER_LOCK_WAIT,
+    MARKER_DEGRADED,
+})
+
+# roots eligible for classification: query-serving walls only — a
+# pipeline/crawl root must never claim a tail verdict (the same
+# discipline as histogram.BACKGROUND_PREFIXES)
+SERVING_ROOT_PREFIXES = ("servlet.",)
+SERVING_ROOT_NAMES = frozenset({"switchboard.search", "mesh.serve"})
+
+# classification gate floor: the cached window p95 starts at 0 on a
+# fresh family, and a microsecond root crossing a 0 gate would classify
+# every healthy request
+MIN_MS = 25.0
+# a lock wait under this never emits a marker (uncontended acquires are
+# the overwhelming hot path)
+LOCK_WAIT_MIN_MS = 1.0
+# dominance thresholds (fractions of the root wall).  Queue dominance
+# judges the batcher-MEASURED pre-issue wait (submit -> wave issue),
+# which excludes the query's own kernel work by construction — 40% of
+# the wall spent purely waiting is a queue verdict.
+QUEUE_DOMINANCE = 0.4
+LOCK_DOMINANCE = 0.3
+# a member is a straggler when its exec wall exceeds the median of the
+# other members' by this factor AND carries a material share of the wall
+STRAGGLER_FACTOR = 2.0
+STRAGGLER_MIN_SHARE = 0.25
+
+VERDICT_RING = 256
+WAVE_RING = 128
+SCOREBOARD_RING = 1024
+MESH_RECORDS = 256
+
+_enabled = True
+
+
+def set_enabled(on: bool) -> None:
+    """Global gate (the bench --tail-overhead A/B switch): disables
+    classification AND the batchers' wave stamping in one flag."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(cfg) -> None:
+    """Read the tail.* knobs once at switchboard construction (the
+    health-engine model for performance knobs)."""
+    global MIN_MS
+    set_enabled(cfg.get_bool("tail.enabled", True))
+    MIN_MS = cfg.get_float("tail.minMs", MIN_MS)
+
+
+@dataclass
+class Verdict:
+    """One classified over-threshold query."""
+
+    ts: float
+    trace_id: str
+    root: str
+    dur_ms: float
+    cause: str
+    member: str = ""
+    evidence: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        out = {"ts": round(self.ts, 3), "trace_id": self.trace_id,
+               "root": self.root, "dur_ms": round(self.dur_ms, 3),
+               "cause": self.cause, "evidence": self.evidence}
+        if self.member:
+            out["member"] = self.member
+        return out
+
+
+def _p95_gate_ms(family: str) -> float:
+    """The cached-window-p95 gate for a family (the histogram's
+    exemplar election threshold), floored at MIN_MS."""
+    h = histogram.get(family)
+    return max(MIN_MS, h.p95_cache if h is not None else 0.0)
+
+
+class TailAttributor:
+    """The classifier + verdict ring + cause counters (process-global
+    like the histogram registry it gates on)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ring: deque = deque(maxlen=VERDICT_RING)
+        self.cause_totals: dict[str, int] = {c: 0 for c in CAUSES}
+        self.straggler_totals: dict[str, int] = {}
+        self.classified_total = 0
+        self.waves: deque = deque(maxlen=WAVE_RING)
+
+    # -- recording surface ---------------------------------------------------
+
+    def note_root(self, trace_id: str, name: str, dur_ms: float) -> None:
+        """Root-span completion hook (tracing.add_root_hook): classify
+        the trace when its wall clears the family's cached-window-p95
+        exemplar gate."""
+        if not _enabled:
+            return
+        if not (name in SERVING_ROOT_NAMES
+                or name.startswith(SERVING_ROOT_PREFIXES)):
+            return
+        if dur_ms < _p95_gate_ms(name):
+            return
+        rec = tracing.get_trace(trace_id)
+        if rec is None:
+            return
+        if name == "mesh.serve":
+            # mesh verdicts need the members' span segments, which
+            # arrive on the NEXT scatter reply: hand off to the
+            # timeline, which finalizes (or defers) the verdict
+            MESH.mark_pending(trace_id, dur_ms)
+            return
+        self.record(self.classify(rec, dur_ms))
+
+    def note_wave(self, wave: dict) -> None:
+        """One dispatch wave's stamp into the bounded wave log (the
+        Performance_Tail_p wave table)."""
+        if not _enabled:
+            return
+        with self._lock:
+            self.waves.append(wave)
+
+    # -- classification ------------------------------------------------------
+
+    def classify(self, rec, dur_ms: float,
+                 mesh_info: dict | None = None) -> Verdict:
+        """Walk one trace's spans (+ the optional assembled mesh
+        timeline) and emit exactly one dominant cause.  Detector order
+        is a priority ladder: explicit markers (the product path KNOWS
+        why it slowed) outrank inferred dominance shares."""
+        host_fb = False
+        cold = None                      # attrs of the first cold marker
+        lock_ms = 0.0
+        degraded_level = 0
+        batch_ms = 0.0
+        kernel_ms = 0.0
+        queue_ms = 0.0
+        wave_compile = False
+        q_depth = 0
+        wave_occ = 0.0
+        for s in rec.spans:
+            n = s.name
+            if n == MARKER_HOST_FALLBACK:
+                host_fb = True
+            elif n == MARKER_COLD_MISS and cold is None:
+                cold = s.attrs
+            elif n == MARKER_LOCK_WAIT:
+                lock_ms += s.dur_ms
+            elif n == MARKER_DEGRADED:
+                try:
+                    degraded_level = max(degraded_level,
+                                         int(s.attrs.get("level", 0)))
+                except (TypeError, ValueError):
+                    pass
+            elif n in ("devstore.batch", "mesh.batch"):
+                batch_ms += s.dur_ms
+                a = s.attrs
+                wave_compile = wave_compile or bool(a.get("wave_compile"))
+                try:
+                    q_depth = max(q_depth, int(a.get("wave_qdepth", 0)))
+                    wave_occ = max(wave_occ,
+                                   float(a.get("wave_occ", 0.0)))
+                    # MEASURED pre-issue wait stamped by the batcher
+                    # (submit -> wave issue) — never inferred by
+                    # subtracting overlapping kernel spans
+                    queue_ms += float(a.get("wave_queue_ms", 0.0))
+                except (TypeError, ValueError):
+                    pass
+            elif n.startswith("kernel."):
+                kernel_ms += s.dur_ms
+        ev = {"batch_ms": round(batch_ms, 3),
+              "kernel_ms": round(kernel_ms, 3),
+              "queue_ms": round(queue_ms, 3),
+              "lock_ms": round(lock_ms, 3),
+              "wave_qdepth": q_depth, "wave_occ": round(wave_occ, 3),
+              "gate_ms": round(_p95_gate_ms(rec.root_name), 3)}
+        cause, member = "unattributed", ""
+        if mesh_info is not None:
+            ev.update(mesh_info.get("evidence", {}))
+            if mesh_info.get("straggler"):
+                cause, member = "collective_straggler", \
+                    mesh_info["straggler"]
+        if cause == "unattributed":
+            if host_fb:
+                cause = "host_fallback"
+            elif cold is not None:
+                cause = "merge_deferral" if cold.get("deferred") \
+                    else "tier_cold"
+                ev["tier"] = str(cold.get("tier", "?"))
+            elif wave_compile:
+                cause = "compile"
+            elif queue_ms >= QUEUE_DOMINANCE * dur_ms:
+                cause = "queue_wait"
+            elif lock_ms >= LOCK_DOMINANCE * dur_ms:
+                cause = "lock_wait"
+            elif degraded_level > 0:
+                cause = "degraded_rung"
+                ev["level"] = degraded_level
+        return Verdict(time.time(), rec.trace_id, rec.root_name,
+                       dur_ms, cause, member, ev)
+
+    def record(self, v: Verdict) -> None:
+        with self._lock:
+            self.ring.append(v)
+            self.cause_totals[v.cause] = \
+                self.cause_totals.get(v.cause, 0) + 1
+            self.classified_total += 1
+            if v.member:
+                self.straggler_totals[v.member] = \
+                    self.straggler_totals.get(v.member, 0) + 1
+
+    # -- reading -------------------------------------------------------------
+
+    def verdicts(self, n: int = 50) -> list:
+        with self._lock:
+            return list(self.ring)[-max(0, n):][::-1]
+
+    def windowed_causes(self, horizon_s: float = 180.0) -> dict:
+        """Cause -> count over the last `horizon_s` (zero-filled over
+        the canon) — the histogram an incident embeds."""
+        cut = time.time() - horizon_s
+        out = {c: 0 for c in CAUSES}
+        with self._lock:
+            for v in self.ring:
+                if v.ts >= cut:
+                    out[v.cause] = out.get(v.cause, 0) + 1
+        return out
+
+    def top_cause(self, horizon_s: float = 180.0) -> str:
+        """The windowed dominant cause (the fleet digest's top-1 field);
+        'unattributed' when the window is empty — always a canon member,
+        so the digest_series mapping resolves."""
+        w = self.windowed_causes(horizon_s)
+        best = max(w, key=lambda c: w[c])
+        return best if w[best] > 0 else "unattributed"
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"classified_total": self.classified_total,
+                    "causes": dict(self.cause_totals),
+                    "stragglers": dict(self.straggler_totals)}
+
+    def wave_log(self, n: int = 50) -> list:
+        with self._lock:
+            return list(self.waves)[-max(0, n):][::-1]
+
+    def reset(self) -> None:
+        with self._lock:
+            self.ring.clear()
+            self.waves.clear()
+            self.cause_totals = {c: 0 for c in CAUSES}
+            self.straggler_totals = {}
+            self.classified_total = 0
+
+
+class MeshTimeline:
+    """Coordinator-side assembly of the per-member step segments
+    (ISSUE 15a).  One record per scattered step; segments arrive inline
+    on later scatter replies and complete the record with zero extra
+    RPCs.  Complete records feed the straggler scoreboard; records the
+    classifier marked pending finalize their verdict the moment the
+    last segment lands."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_seq: "OrderedDict[int, dict]" = OrderedDict()
+        self._by_trace: dict[str, int] = {}
+        self.segments_merged = 0
+        # pending verdicts finalized from PARTIAL segments (a lull in
+        # traffic means the missing members' segments have no later
+        # scatter reply to ride) — counted, never silently dropped
+        self.pending_partial = 0
+        # (ts, slowest_member, margin_ms, exec_by_member) per COMPLETE
+        # step — the scoreboard is windowed over this ring
+        self._board: deque = deque(maxlen=SCOREBOARD_RING)
+
+    def note_step(self, seq: int, trace_id: str, members,
+                  mode: str) -> None:
+        """Register a scattered step (called by the coordinator BEFORE
+        its mesh.serve root closes, so a pending classification can
+        find the record)."""
+        if not _enabled:
+            return
+        with self._lock:
+            self._by_seq[seq] = {
+                "seq": int(seq), "trace_id": trace_id, "ts": time.time(),
+                "members": set(int(m) for m in members), "mode": mode,
+                "segs": {}, "pending_ms": None, "dur_ms": 0.0}
+            self._by_trace[trace_id] = int(seq)
+            evicted = []
+            while len(self._by_seq) > MESH_RECORDS:
+                _, old = self._by_seq.popitem(last=False)
+                self._by_trace.pop(old.get("trace_id", ""), None)
+                evicted.append(old)
+        # an evicted record still owing a verdict finalizes from its
+        # PARTIAL segments (counted) — never a silent drop; the lull
+        # case (no later scatter to carry the missing segments at all)
+        # is flushed by flush_pending from the tail read surfaces
+        for old in evicted:
+            if old.get("pending_ms") is not None:
+                self._finalize(old)
+
+    def finish_step(self, seq: int, dur_ms: float) -> None:
+        with self._lock:
+            rec = self._by_seq.get(int(seq))
+            if rec is not None:
+                rec["dur_ms"] = float(dur_ms)
+
+    def mark_pending(self, trace_id: str, dur_ms: float) -> None:
+        """The classifier's deferred-verdict hand-off: finalize now if
+        every segment already arrived, else when the last one lands."""
+        with self._lock:
+            seq = self._by_trace.get(trace_id)
+            rec = self._by_seq.get(seq) if seq is not None else None
+            if rec is None:
+                return
+            rec["pending_ms"] = float(dur_ms)
+            complete = set(rec["segs"]) >= rec["members"]
+        if complete:
+            self._finalize(rec)
+
+    def add_segment(self, seg: dict) -> None:
+        """One member's step segment (q_ms / commit_ms / exec_ms /
+        mode), shipped inline on a scatter reply or produced locally by
+        the coordinator's own runloop."""
+        if not _enabled or not isinstance(seg, dict):
+            return
+        try:
+            seq = int(seg["seq"])
+            member = int(seg["m"])
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._lock:
+            rec = self._by_seq.get(seq)
+            if rec is None or member in rec["segs"]:
+                return
+            rec["segs"][member] = {
+                "m": member,
+                "q_ms": float(seg.get("q_ms", 0.0)),
+                "commit_ms": float(seg.get("commit_ms", 0.0)),
+                "entry_ms": float(seg.get("entry_ms", 0.0)),
+                "exec_ms": float(seg.get("exec_ms", 0.0)),
+                "mode": str(seg.get("mode", "?")),
+                "ts0": float(seg.get("ts0", 0.0))}
+            self.segments_merged += 1
+            complete = set(rec["segs"]) >= rec["members"]
+            if complete:
+                # straggler signal = LOCAL lateness (queue backlog +
+                # pre-dispatch wall): in an SPMD collective every
+                # member's exec wall inflates identically when one
+                # member is late, so exec cannot name the culprit —
+                # the member that ENTERED latest can (distributed.py
+                # stamps entry_ms exactly for this)
+                lates = {m: s["q_ms"] + s["entry_ms"]
+                         for m, s in rec["segs"].items()}
+                slowest = max(lates, key=lambda m: lates[m])
+                others = [v for m, v in lates.items() if m != slowest]
+                margin = lates[slowest] - (statistics.median(others)
+                                           if others else 0.0)
+                self._board.append((time.time(), slowest,
+                                    max(0.0, margin),
+                                    {m: s["exec_ms"]
+                                     for m, s in rec["segs"].items()}))
+        if complete:
+            self._merge_into_trace(rec)
+            if rec["pending_ms"] is not None:
+                self._finalize(rec)
+
+    def _merge_into_trace(self, rec: dict) -> None:
+        """Inject the assembled per-member timeline into the trace ring
+        so `Performance_Trace_p?trace=<id>&assemble=1` renders the mesh
+        waterfall.  Rides merge_remote_spans: idempotent dedup, and the
+        spans never re-feed the histograms (the members observed their
+        own walls)."""
+        tid = rec.get("trace_id", "")
+        if not tracing.valid_trace_id(tid):
+            return
+        for m, s in sorted(rec["segs"].items()):
+            ts0 = s["ts0"] or rec["ts"]
+            spans = []
+            t = ts0
+            for short, name in (("q_ms", "mesh.member.queue_wait"),
+                                ("commit_ms", "mesh.member.commit_wait"),
+                                ("entry_ms", "mesh.member.local_entry"),
+                                ("exec_ms", "mesh.member.exec")):
+                spans.append({"sid": f"m{m}q{rec['seq']}{short[:-3]}",
+                              "parent": "", "name": name, "ts": t,
+                              "dur_ms": round(s[short], 3),
+                              "attrs": {"member": f"mesh{m}",
+                                        "mode": s["mode"]}})
+                t += s[short] / 1000.0
+            tracing.merge_remote_spans(tid, spans, source=f"mesh{m}")
+
+    def _finalize(self, rec: dict) -> None:
+        """Classify a pending over-threshold mesh step now that its
+        timeline is complete: collective_straggler names the slowest
+        member when its exec wall dominates.  Idempotent: the pending
+        wall is claimed under the lock, so a mark_pending racing the
+        last add_segment produces exactly one verdict."""
+        with self._lock:
+            claimed = rec["pending_ms"]
+            rec["pending_ms"] = None
+        if claimed is None:
+            return
+        partial = not (set(rec["segs"]) >= rec["members"])
+        if partial:
+            with self._lock:
+                self.pending_partial += 1
+        lates = {m: s["q_ms"] + s["entry_ms"]
+                 for m, s in rec["segs"].items()}
+        slowest = max(lates, key=lambda m: lates[m]) if lates else None
+        straggler = ""
+        dur = claimed
+        if slowest is not None:
+            others = [v for m, v in lates.items() if m != slowest]
+            med = statistics.median(others) if others else 0.0
+            if lates[slowest] >= max(STRAGGLER_FACTOR * med,
+                                     STRAGGLER_MIN_SHARE * dur):
+                straggler = f"mesh{slowest}"
+        info = {"straggler": straggler,
+                "evidence": {
+                    "seq": rec["seq"], "mode": rec["mode"],
+                    "late_ms_by_member": {f"mesh{m}": round(v, 3)
+                                          for m, v in lates.items()},
+                    "exec_ms_by_member": {
+                        f"mesh{m}": round(s["exec_ms"], 3)
+                        for m, s in rec["segs"].items()}}}
+        if partial:
+            info["evidence"]["segments_partial"] = sorted(
+                rec["members"] - set(rec["segs"]))
+        trace = tracing.get_trace(rec.get("trace_id", ""))
+        if trace is None:
+            return
+        ATTR.record(ATTR.classify(trace, dur, mesh_info=info))
+
+    def flush_pending(self, max_age_s: float = 5.0) -> int:
+        """Finalize pending verdicts whose segments never fully arrived
+        — a straggled query at the END of a burst has no later scatter
+        reply to carry the missing members' segments, and the contract
+        is EVERY over-threshold query gets exactly one verdict.  After
+        `max_age_s` the record finalizes from whatever segments exist
+        (counted in `pending_partial`; with two or more the straggler
+        can still be named).  Called from the tail read surfaces
+        (MeshMember.info / Performance_Tail_p) — the operator asking is
+        exactly when an owed verdict must stop waiting."""
+        cut = time.time() - max_age_s
+        with self._lock:
+            due = [r for r in self._by_seq.values()
+                   if r["pending_ms"] is not None and r["ts"] < cut]
+        for rec in due:
+            self._finalize(rec)
+        return len(due)
+
+    # -- reading -------------------------------------------------------------
+
+    def scoreboard(self, horizon_s: float = 600.0) -> list:
+        """Windowed per-member straggler rows: how often each member
+        was the slowest leg of a complete step, and by how much."""
+        cut = time.time() - horizon_s
+        with self._lock:
+            rows = [r for r in self._board if r[0] >= cut]
+        steps = len(rows)
+        members: dict[int, dict] = {}
+        for _ts, slowest, margin, execs in rows:
+            for m, v in execs.items():
+                agg = members.setdefault(m, {
+                    "member": f"mesh{m}", "steps": 0, "slowest": 0,
+                    "margin_ms_sum": 0.0, "margin_ms_max": 0.0,
+                    "exec_ms_sum": 0.0})
+                agg["steps"] += 1
+                agg["exec_ms_sum"] += v
+            agg = members[slowest]
+            agg["slowest"] += 1
+            agg["margin_ms_sum"] += margin
+            agg["margin_ms_max"] = max(agg["margin_ms_max"], margin)
+        out = []
+        for m in sorted(members):
+            a = members[m]
+            out.append({
+                "member": a["member"], "steps": a["steps"],
+                "slowest_count": a["slowest"],
+                "slowest_frac": round(a["slowest"] / max(1, steps), 3),
+                "mean_margin_ms": round(
+                    a["margin_ms_sum"] / max(1, a["slowest"]), 3),
+                "max_margin_ms": round(a["margin_ms_max"], 3),
+                "mean_exec_ms": round(
+                    a["exec_ms_sum"] / max(1, a["steps"]), 3)})
+        return out
+
+    def waterfall(self, seq: int | None = None) -> dict | None:
+        """One assembled step's per-member timeline (newest complete
+        record when `seq` is None) — the artifact/servlet rendering."""
+        with self._lock:
+            recs = list(self._by_seq.values())
+        if seq is not None:
+            recs = [r for r in recs if r["seq"] == int(seq)]
+        for rec in reversed(recs):
+            if rec["segs"] and set(rec["segs"]) >= rec["members"]:
+                return {"seq": rec["seq"], "trace_id": rec["trace_id"],
+                        "mode": rec["mode"],
+                        "dur_ms": round(rec["dur_ms"], 3),
+                        "members": [rec["segs"][m]
+                                    for m in sorted(rec["segs"])]}
+        return None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._by_seq.clear()
+            self._by_trace.clear()
+            self._board.clear()
+            self.segments_merged = 0
+
+
+# -- process-global singletons (the histogram-registry model) ----------------
+
+ATTR = TailAttributor()
+MESH = MeshTimeline()
+
+
+def stamp_wave(items: list, kernel: str, max_batch: int,
+               first_use: bool, issue_ms: float,
+               extra: dict | None = None) -> dict:
+    """Build ONE dispatch wave's timeline stamp and attach it (plus the
+    per-item MEASURED pre-issue wait, submit -> now) to every item —
+    the shared builder both batchers call (devstore `_stamp_wave`,
+    meshstore `_dispatch`), so wave evidence cannot diverge between
+    them.  Items carry `t_submit`/`q_depth` from their submit path;
+    `extra` is the store's tier/deferral snapshot."""
+    now = time.perf_counter()
+    waits = [(now - it["t_submit"]) * 1000.0 for it in items
+             if "t_submit" in it]
+    wave = {"ts": round(time.time(), 3), "kernel": kernel,
+            "n": len(items),
+            "occ": round(len(items) / max(1, max_batch), 3),
+            "qdepth": max((it.get("q_depth", 0) for it in items),
+                          default=0),
+            "queue_wait_ms": round(max(waits, default=0.0), 3),
+            "issue_ms": round(issue_ms, 3),
+            "compile": bool(first_use),
+            **(extra or {})}
+    for it in items:
+        it["wave"] = wave
+        if "t_submit" in it:
+            it["queue_wait_ms"] = (now - it["t_submit"]) * 1000.0
+    ATTR.note_wave(wave)
+    return wave
+
+
+def note_lock_wait(name: str, t0: float) -> None:
+    """Called as the FIRST statement inside a `with lock:` body with a
+    perf_counter taken just before the `with`: the elapsed wall IS the
+    acquisition wait.  Emits the lock-wait marker span (a real measured
+    wall) when contended and a trace is active; the uncontended cost is
+    one perf_counter read."""
+    if not _enabled:
+        return
+    wait_ms = (time.perf_counter() - t0) * 1000.0
+    if wait_ms >= LOCK_WAIT_MIN_MS and tracing.current() is not None:
+        tracing.emit(MARKER_LOCK_WAIT, wait_ms, lock=name)
+
+
+def _root_hook(trace_id: str, name: str, dur_ms: float) -> None:
+    ATTR.note_root(trace_id, name, dur_ms)
+
+
+tracing.add_root_hook(_root_hook)
+
+
+# module-level conveniences (the surfaces health/monitoring import)
+
+def windowed_causes(horizon_s: float = 180.0) -> dict:
+    return ATTR.windowed_causes(horizon_s)
+
+
+def cause_totals() -> dict:
+    return dict(ATTR.counters()["causes"])
+
+
+def straggler_totals() -> dict:
+    return dict(ATTR.counters()["stragglers"])
+
+
+def top_cause(horizon_s: float = 180.0) -> str:
+    return ATTR.top_cause(horizon_s)
+
+
+def verdicts(n: int = 50) -> list:
+    return ATTR.verdicts(n)
+
+
+def scoreboard(horizon_s: float = 600.0) -> list:
+    return MESH.scoreboard(horizon_s)
+
+
+def reset() -> None:
+    """Test/bench isolation: drop verdicts, waves and mesh records."""
+    ATTR.reset()
+    MESH.reset()

@@ -182,6 +182,31 @@ def _record(trace_id: str, span: Span) -> None:
 # by the wrapper before dispatch.
 _last_root: ContextVar = ContextVar("yacy_last_root_trace", default=None)
 
+# root-completion hooks (ISSUE 15): the tail-attribution engine
+# registers here to classify every over-threshold serving root.  Kept
+# as a registration surface (not an import) so bare tracing users pay
+# nothing and there is no tracing -> tailattr import cycle.
+_root_hooks: list = []
+
+
+def add_root_hook(fn) -> None:
+    """Register fn(trace_id, root_name, dur_ms), called after every
+    ROOT span completes.  Idempotent per function object."""
+    if fn not in _root_hooks:
+        _root_hooks.append(fn)
+
+
+def _fire_root_hooks(tid: str, name: str, dur_ms: float) -> None:
+    for fn in _root_hooks:
+        try:
+            fn(tid, name, dur_ms)
+        except Exception:  # lint: broad-except-ok(a broken classifier
+            # hook must cost a log line, never the serving request
+            # whose root span just closed)
+            import logging
+            logging.getLogger("tracing").warning(
+                "root hook failed for %s", name, exc_info=True)
+
 
 def last_trace_id() -> str | None:
     """Trace id of the most recent root span completed on this context."""
@@ -257,11 +282,13 @@ class _LiveSpan:
         _ctx.reset(self._token)
         if etype is not None:
             self._attrs["error"] = etype.__name__
+        dur_ms = (time.perf_counter() - self._t0) * 1000.0
         _record(self._tid, Span(
             self._sid, self._parent, self._name, self._ts,
-            (time.perf_counter() - self._t0) * 1000.0, self._attrs))
+            dur_ms, self._attrs))
         if self._root:
             _last_root.set(self._tid)
+            _fire_root_hooks(self._tid, self._name, dur_ms)
         if self._end_trace:
             with _lock:
                 rec = _ring.get(self._tid)
