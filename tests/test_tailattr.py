@@ -501,3 +501,80 @@ def test_performance_tail_servlet_renders_and_exports_json(tmp_path):
         assert set(view["causes_windowed"]) == set(tailattr.CAUSES)
     finally:
         sb.close()
+
+
+# -- the precedence ladder under OVERLAPPING evidence (ISSUE 19) -------------
+#
+# The game day arms overlapping faults, so one trace can carry evidence
+# for SEVERAL causes at once — the classifier must resolve by the pinned
+# tailattr.PRECEDENCE ladder, deterministically.  This table builds, for
+# every rung, a trace carrying that rung's evidence PLUS every weaker
+# rung's evidence, and asserts the stronger rung wins (which covers
+# every pairwise tie-break transitively).
+
+def _precedence_emitters():
+    """cause -> emitter of exactly that rung's span evidence, calibrated
+    against a 1000 ms wall so the dominance-share rungs clear their
+    thresholds (queue >= 40%, lock >= 30%)."""
+    return {
+        "host_fallback": lambda: tracing.emit(
+            tailattr.MARKER_HOST_FALLBACK, 0.1),
+        "merge_deferral": lambda: tracing.emit(
+            tailattr.MARKER_COLD_MISS, 0.1, tier="warm", deferred=True),
+        "tier_cold": lambda: tracing.emit(
+            tailattr.MARKER_COLD_MISS, 0.1, tier="warm"),
+        "compile": lambda: tracing.emit(
+            "devstore.batch", 5.0, wave_compile=True),
+        "queue_wait": lambda: tracing.emit(
+            "devstore.batch", 5.0, wave_queue_ms=500.0),
+        "lock_wait": lambda: tracing.emit(
+            tailattr.MARKER_LOCK_WAIT, 400.0),
+        "degraded_rung": lambda: tracing.emit(
+            tailattr.MARKER_DEGRADED, 0.1, level=2),
+    }
+
+
+def test_precedence_ladder_is_the_cause_canon():
+    """PRECEDENCE is a permutation of CAUSES with the explicit markers
+    above the inferred shares and unattributed last — the documented
+    contract the game-day verdict engine leans on."""
+    assert set(tailattr.PRECEDENCE) == set(tailattr.CAUSES)
+    assert len(tailattr.PRECEDENCE) == len(tailattr.CAUSES)
+    assert tailattr.PRECEDENCE[0] == "collective_straggler"
+    assert tailattr.PRECEDENCE[-1] == "unattributed"
+
+
+def test_precedence_ladder_under_overlapping_evidence():
+    emitters = _precedence_emitters()
+    for i, expect in enumerate(tailattr.PRECEDENCE):
+        weaker = [c for c in tailattr.PRECEDENCE[i:] if c in emitters]
+        with tracing.trace(f"servlet.prec{i}") as t:
+            tid = t.ctx[0]
+            for c in weaker:          # rung under test + EVERY weaker rung
+                emitters[c]()
+        rec = tracing.get_trace(tid)
+        assert rec is not None
+        mesh_info = None
+        if expect == "collective_straggler":
+            # the assembled timeline named a straggler — outranks every
+            # marker the same trace carries
+            mesh_info = {"straggler": "mesh1", "evidence": {"seq": 7}}
+        v = tailattr.ATTR.classify(rec, 1000.0, mesh_info=mesh_info)
+        assert v.cause == expect, \
+            f"rung {expect} must beat {weaker[1:]}, got {v.cause}"
+        if expect == "collective_straggler":
+            assert v.member == "mesh1"
+
+
+def test_precedence_cold_marker_first_wins_within_rung():
+    """merge_deferral vs tier_cold share one marker family; the FIRST
+    cold marker's attrs decide (the miss that actually host-served the
+    query), deferred=True naming the deferral."""
+    with tracing.trace("servlet.coldfirst") as t:
+        tid = t.ctx[0]
+        tracing.emit(tailattr.MARKER_COLD_MISS, 0.1, tier="warm",
+                     deferred=True)
+        tracing.emit(tailattr.MARKER_COLD_MISS, 0.1, tier="cold")
+    v = tailattr.ATTR.classify(tracing.get_trace(tid), 1000.0)
+    assert v.cause == "merge_deferral", v
+    assert v.evidence["tier"] == "warm"

@@ -55,7 +55,7 @@ from collections import deque
 
 import numpy as np
 
-from ..utils import faultinject, tailattr, tracing
+from ..utils import faultinject, histogram, tailattr, tracing
 
 log = logging.getLogger("parallel.distributed")
 
@@ -225,6 +225,7 @@ class MeshMember:
         self.member_down_steps = 0
         self.commit_timeouts = 0
         self.incidents: list[dict] = []
+        self._incident_seq = 0    # monotonic per process (ISSUE 19)
         self._member_state: dict[int, str] = {}     # id -> ok|lost|down
         # tail forensics (ISSUE 15a): every executed step produces a
         # span segment (queue wait / commit [collective-entry] wait /
@@ -469,9 +470,21 @@ class MeshMember:
             # acked phase 1 (+ self) — a down member must not hold the
             # waterfall/verdict incomplete forever
             if self.timeline is not None:
+                culprit = ""
+                if not go:
+                    # name the member whose state broke the collective,
+                    # self first — the host-fallback verdict carries it
+                    if self.store.device_lost:
+                        culprit = f"mesh{self.process_id}"
+                    else:
+                        bad = sorted(j for j, st
+                                     in self._member_state.items()
+                                     if st != "ok")
+                        culprit = f"mesh{bad[0]}" if bad else ""
                 self.timeline.note_step(
                     seq, tracing.current_trace_id() or "",
-                    pids.keys(), "collective" if go else "host")
+                    pids.keys(), "collective" if go else "host",
+                    culprit=culprit)
             for j, seed in sorted(self.peers.items()):
                 ok, rep = self.node.protocol.mesh_rpc(
                     seed, "meshcommit", {"seq": seq, "go": go})
@@ -483,6 +496,14 @@ class MeshMember:
             if self.timeline is not None:
                 self.timeline.finish_step(
                     seq, (time.perf_counter() - t_q0) * 1000.0)
+            # deliberately NO mesh.serve histogram family: a scheduled
+            # mesh.step straggle slows EVERY collective step, so a
+            # cached-p95 exemplar gate would adapt to the fault within
+            # one rotation and stop classifying exactly the queries the
+            # game day must attribute.  mesh.serve roots gate on the
+            # fixed `tail.minMs` floor; deployments whose healthy
+            # collective wall exceeds the default floor raise the knob
+            # (the game-day bench does).
             s, d, considered = lrec["result"] or \
                 (np.empty(0, np.int32), np.empty(0, np.int32), 0)
             return {"seq": seq, "mode": lrec["mode"], "go": bool(go),
@@ -511,11 +532,21 @@ class MeshMember:
         self._member_state[j] = state
         if state == prev:
             return
+        # post-hoc join keys (ISSUE 19): monotonic per-process seq +
+        # the armed-fault snapshot at dump time — wall clocks skew
+        # across mesh processes, so the game-day verdict engine orders
+        # by (pid, incident_seq) and matches the incident to its
+        # scheduled fault by what was armed when it fired
+        with self._plock:
+            self._incident_seq += 1
+            seq_no = self._incident_seq
         inc = {"kind": "incident",
                "name": f"mesh_member_{state}" if state != "ok"
                else "mesh_member_recovered",
                "member": f"mesh{j}", "member_id": j, "pid": pid,
-               "cause": cause or state, "ts": round(time.time(), 3)}
+               "cause": cause or state, "ts": round(time.time(), 3),
+               "incident_seq": seq_no,
+               "armed_faults": faultinject.snapshot()}
         self.incidents.append(inc)
         log.warning("mesh member incident: %s", inc)
         if self._data_dir:
@@ -531,9 +562,18 @@ class MeshMember:
 
     # -- info / lifecycle -----------------------------------------------------
 
-    def info(self, tick_health: bool = False) -> dict:
-        from ..utils import histogram
+    def info(self, tick_health: bool = False,
+             prime_tail_gate: bool = False) -> dict:
         eng = getattr(self.sb, "health", None)
+        if prime_tail_gate:
+            # warmup/measurement boundary: drop every family's
+            # windowed samples so compile-era warmup walls (orders of
+            # magnitude above the live workload) cannot sit in the
+            # merged ring and hold the cached-p95 exemplar gate — and
+            # the SLO burn windows — above everything the workload
+            # will ever produce.  Until the first live window rotates
+            # the tail gate sits at the `tail.minMs` floor.
+            histogram.reset_windows()
         if tick_health and eng is not None:
             # node switchboards under the mesh runtime do not run the
             # 15_health busy thread; the wire caller (bench/test) drives
@@ -588,12 +628,21 @@ class MeshMember:
                                 if self.timeline is not None else 0),
             "pending_partial": (self.timeline.pending_partial
                                 if self.timeline is not None else 0),
+            # ROADMAP 1c read-only slice (ISSUE 19): conviction edges
+            # (member slowest over N consecutive windows) + zero-filled
+            # totals over every member this timeline scattered to
+            "convictions": tailattr.conviction_totals(),
+            "conviction_crumbs": tailattr.conviction_breadcrumbs(10),
         }
         health_incs = []
         incident_tail = None
         if eng is not None:
             for inc in eng.incidents:
                 health_incs.append({"name": inc["name"],
+                                    "ts": inc.get("ts"),
+                                    "seq": inc.get("seq"),
+                                    "armed_faults":
+                                        inc.get("armed_faults", {}),
                                     "rules": list(inc["rules"])})
             if eng.incidents:
                 # the newest incident's embedded tail evidence (the

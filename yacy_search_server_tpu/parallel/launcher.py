@@ -35,6 +35,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 from . import distributed as D
@@ -57,12 +58,28 @@ def _free_ports(n: int) -> list[int]:
     return ports
 
 
-def _post(url: str, payload: dict, timeout_s: float = 30.0) -> dict:
+def _post(url: str, payload: dict, timeout_s: float = 30.0,
+          headers: dict | None = None) -> dict:
     req = urllib.request.Request(
         url, data=json.dumps(payload).encode("utf-8"),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     with urllib.request.urlopen(req, timeout=timeout_s) as r:
         return json.loads(r.read().decode("utf-8"))
+
+
+def _post_ex(url: str, payload: dict, timeout_s: float = 30.0,
+             headers: dict | None = None) -> tuple[int, dict]:
+    """Status-capturing POST: an admission 429 (or any HTTP error) is a
+    RESULT the game-day workload records, not an exception — the
+    availability gate is 'degraded + counted, never 500'."""
+    try:
+        return 200, _post(url, payload, timeout_s, headers)
+    except urllib.error.HTTPError as e:
+        try:
+            body = e.read().decode("utf-8", "replace")
+        except OSError:
+            body = ""
+        return e.code, {"error": body[:200]}
 
 
 class MeshFleet:
@@ -71,7 +88,8 @@ class MeshFleet:
     def __init__(self, procs: int = 2, local_devices: int = 2,
                  ndocs: int = 512, seed: int = 3, n_term: int = 1,
                  run_dir: str | None = None, testing: bool = True,
-                 bringup_timeout_s: float = 120.0):
+                 bringup_timeout_s: float = 120.0,
+                 config: dict | None = None):
         assert procs >= 2, "a multi-process mesh needs >= 2 processes"
         self.procs = procs
         self.local_devices = local_devices
@@ -97,6 +115,12 @@ class MeshFleet:
         }
         if testing:
             env_common[D.ENV_TESTING] = "1"
+        if config:
+            # construction-time knobs for every member's Switchboard
+            # (incident cooldown, admission burst, conviction windows —
+            # things the engines read once; see Config.__init__)
+            env_common["YACY_CONFIG_OVERRIDES"] = ",".join(
+                f"{k}={v}" for k, v in sorted(config.items()))
         atexit.register(self.close)
         try:
             for i in range(procs):
@@ -224,12 +248,52 @@ class MeshFleet:
         return _post(self._url(0, "meshsearch"),
                      {"word": word, "k": k}, timeout_s=timeout_s)
 
+    def search_ex(self, word: str, k: int = 10,
+                  timeout_s: float = 90.0,
+                  client: str | None = None) -> tuple[int, dict]:
+        """Status-capturing search with an optional per-client identity
+        (X-Forwarded-For from loopback — the game-day workload realism
+        layer, so token buckets/admission key on the synthetic client
+        instead of the universally-exempt 127.0.0.1)."""
+        hdrs = {"X-Forwarded-For": client} if client else None
+        return _post_ex(self._url(0, "meshsearch"), {"word": word,
+                        "k": k}, timeout_s=timeout_s, headers=hdrs)
+
+    def get(self, i: int, page: str, timeout_s: float = 30.0,
+            client: str | None = None) -> tuple[int, float]:
+        """One regular-servlet GET against member `i` (status,
+        wall_ms): the game-day driver for the servlet.serving SLO wall
+        — the mesh wire entry bypasses the regular dispatch where that
+        failpoint lives."""
+        url = f"http://127.0.0.1:{self.http_ports[i]}/{page}"
+        req = urllib.request.Request(
+            url, headers={"X-Forwarded-For": client} if client else {})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as r:
+                r.read()
+                code = r.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            code = e.code
+        return code, (time.perf_counter() - t0) * 1000.0
+
     def info(self, i: int, timeout_s: float = 30.0,
-             tick_health: bool = False) -> dict:
+             tick_health: bool = False,
+             prime_tail_gate: bool = False) -> dict:
         """Member introspection; `tick_health=True` additionally drives
         one health-engine evaluation on the member (the tail-forensics
-        harness's incident driver — mesh members run no busy threads)."""
-        payload = {"tick_health": 1} if tick_health else {}
+        harness's incident driver — mesh members run no busy threads);
+        `prime_tail_gate=True` drops every histogram family's windowed
+        samples so compile-era warmup walls cannot hold the tail
+        classifier's cached-p95 exemplar gate (or the SLO burn
+        windows) above the live workload (the game-day
+        warmup/measurement boundary)."""
+        payload: dict = {}
+        if tick_health:
+            payload["tick_health"] = 1
+        if prime_tail_gate:
+            payload["prime_tail_gate"] = 1
         return _post(self._url(i, "meshinfo"), payload,
                      timeout_s=timeout_s)
 
@@ -237,6 +301,12 @@ class MeshFleet:
               clear: bool = False) -> dict:
         return _post(self._url(i, "meshfault"),
                      {"point": point, "value": value, "clear": clear})
+
+    def fault_list(self, i: int, n: int = 0) -> dict:
+        """Member `i`'s faultinject registry + armed snapshot + the
+        timestamped arm/clear/expire schedule (ISSUE 19: the verdict
+        engine's one source of truth)."""
+        return _post(self._url(i, "meshfault"), {"list": 1, "n": n})
 
 
 def main(argv=None) -> int:

@@ -258,7 +258,9 @@ class PeerServer:
         # evaluation on this member — mesh runtimes run no busy
         # threads, and the tail-forensics acceptance needs the burn
         # rules + flight recorder evaluated against live histograms
-        return m.info(tick_health=bool(payload.get("tick_health")))
+        return m.info(
+            tick_health=bool(payload.get("tick_health")),
+            prime_tail_gate=bool(payload.get("prime_tail_gate")))
 
     def do_meshsearch(self, payload: dict) -> dict:
         """External query entry on the coordinator: scatter → collective
@@ -303,6 +305,20 @@ class PeerServer:
         if self._mesh_member() is None or \
                 not _os.environ.get("YACY_MESH_TESTING"):
             return {"error": "fault arming not enabled"}
+        # wire enumeration (ISSUE 19): the registry + what is armed NOW
+        # + the timestamped arm/clear/expire history — the game-day
+        # conductor and verdict engine read ONE source of truth instead
+        # of keeping parallel bookkeeping of what they armed where
+        if payload.get("list"):
+            m = self._mesh_member()
+            return {"result": "ok", "pid": _os.getpid(),
+                    "member": m.process_id,
+                    "faultpoints": sorted(
+                        faultinject.REGISTERED_FAULTPOINTS),
+                    "crashpoints": list(faultinject.CRASHPOINTS),
+                    "armed": faultinject.snapshot(),
+                    "schedule": faultinject.schedule(
+                        int(payload.get("n", 0) or 0))}
         point = str(payload.get("point", ""))
         try:
             if payload.get("clear"):

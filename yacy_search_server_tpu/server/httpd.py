@@ -254,6 +254,23 @@ class YaCyHttpServer:
             tracker = getattr(self.sb, "access_tracker", None)
             act = getattr(self.sb, "actuators", None)
             client_ip = handler.client_address[0]
+            # per-client identity behind a LOCAL front (ISSUE 19): when
+            # the direct peer is loopback — a reverse proxy on the node,
+            # or the game-day workload generator — X-Forwarded-For
+            # names the real client for the access tracker and the
+            # admission token buckets, which also makes that identity
+            # subject to 429 (loopback itself stays exempt).  Never
+            # honored from a non-loopback peer, and only the LAST
+            # comma-separated entry counts: proxies APPEND the peer
+            # they saw, so the last entry is the one written by the
+            # trusted proxy on this node, while earlier entries arrive
+            # attacker-supplied and would let a remote client spoof an
+            # allowlisted identity or launder past the rate limits.
+            if client_ip in ("127.0.0.1", "::1"):
+                fwd = handler.headers.get(
+                    "X-Forwarded-For", "").split(",")[-1].strip()
+                if fwd:
+                    client_ip = fwd
             if not self.security.client_allowed(client_ip):
                 self._send(handler, 403, "text/plain",
                            b"client not allowed")

@@ -45,5 +45,5 @@ def _ensure_loaded() -> None:
         return
     _loaded = True
     from . import (yacysearch, status, admin, api, boards,  # noqa: F401
-                   breadth, federate, graphics, health, ingest, operator,
-                   proxy, monitoring, tail)
+                   breadth, federate, gameday, graphics, health, ingest,
+                   operator, proxy, monitoring, tail)

@@ -1,0 +1,103 @@
+"""Performance_GameDay_p — the game-day verdict table (ISSUE 19).
+
+Performance_Tail_p explains WHY individual queries were slow;
+Performance_Health_p shows THAT the SLO is burning.  This panel closes
+the loop on the chaos drill itself: for the most recent ``bench.py
+--game-day`` run it renders one row per SCHEDULED fault — was it
+detected, was the incident attributed to the RIGHT cause label and
+member, did the SLO recover inside the bound after the clear, was
+every request during the window answered (degraded + counted, never a
+5xx), and did the recovered fleet rank bit-identically to the pre-fault
+baseline.  The in-process view (:data:`~...utils.gameday.LAST_RUN`)
+wins; with no run this process, the committed ``CHAOS_r02.json``
+artifact at the repo root is served instead, so the panel is useful on
+a fresh operator node too.  ``format=json`` exports the full artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ...utils import gameday
+from ..objects import ServerObjects, escape_json
+from . import servlet
+
+_ARTIFACT = "CHAOS_r02.json"
+
+GATES = ("detected", "attributed", "answered", "slo_recovery",
+         "bit_identical")
+
+
+def _artifact_path() -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(here))))
+    return os.path.join(root, _ARTIFACT)
+
+
+def gameday_view() -> dict:
+    """The newest game-day result: this process's LAST_RUN if a run
+    happened here, else the committed artifact, else an empty shell."""
+    if gameday.LAST_RUN is not None:
+        return {"source": "live", **gameday.LAST_RUN}
+    path = _artifact_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            return {"source": _ARTIFACT, **json.load(f)}
+    except (OSError, ValueError):
+        return {"source": "none", "schedule": [], "overlaps": [],
+                "verdict_summary": {}, "workload": {}}
+
+
+@servlet("Performance_GameDay_p")
+def respond_gameday(header: dict, post: ServerObjects,
+                    sb) -> ServerObjects:
+    view = gameday_view()
+    if post.get("format", "") == "json":
+        prop = ServerObjects()
+        prop.raw_body = json.dumps(view, indent=1)
+        prop.raw_ctype = "application/json; charset=utf-8"
+        return prop
+    prop = ServerObjects()
+    prop.put("source", escape_json(view.get("source", "none")))
+    summary = view.get("verdict_summary", {})
+    prop.put("faults", summary.get("faults", 0))
+    prop.put("passed", summary.get("passed", 0))
+    prop.put("all_pass", 1 if summary.get("all_pass") else 0)
+    prop.put("unattributed", summary.get("unattributed_verdicts", 0))
+    prop.put("never_500", 1 if summary.get("never_500") else 0)
+    wl = view.get("workload", {})
+    prop.put("queries_total", wl.get("queries_total", 0))
+    prop.put("duration_s", wl.get("duration_s", 0))
+
+    overlaps = view.get("overlaps", [])
+    prop.put("overlaps", len(overlaps))
+    for i, pair in enumerate(overlaps):
+        prop.put(f"overlaps_{i}_pair", escape_json("+".join(pair)))
+
+    rows = view.get("schedule", [])
+    prop.put("rows", len(rows))
+    for i, r in enumerate(rows):
+        pre = f"rows_{i}_"
+        prop.put(pre + "fault_id", escape_json(r.get("fault_id", "")))
+        prop.put(pre + "point", escape_json(r.get("point", "")))
+        prop.put(pre + "target", escape_json(r.get("target", "")))
+        prop.put(pre + "value", escape_json(str(r.get("value", ""))))
+        prop.put(pre + "window",
+                 escape_json(f"[{r.get('t_arm', 0)}s, "
+                             f"{r.get('t_clear', 0)}s]"))
+        prop.put(pre + "scenario", escape_json(r.get("scenario", "")))
+        for g in GATES:
+            prop.put(pre + g, 1 if r.get(g) else 0)
+        prop.put(pre + "verdict", escape_json(r.get("verdict", "")))
+        rec = r.get("recovery", {}) or {}
+        rs = rec.get("recovered_s")
+        prop.put(pre + "recovered_s",
+                 "-" if rs is None else f"{rs:.1f}")
+        ans = r.get("answered_detail", {}) or {}
+        prop.put(pre + "answered_detail", escape_json(
+            f"{ans.get('ok_200', 0)}x200 "
+            f"{ans.get('degraded_429', 0)}x429 "
+            f"{ans.get('errors', 0)}xERR"))
+    return prop

@@ -748,6 +748,17 @@ def prometheus_text(sb, include_buckets: bool = True,
              "collective_straggler verdicts by the named mesh member")
     for member, v in sorted(tailattr.straggler_totals().items()):
         p.sample("yacy_tail_straggler_total", v, {"member": member})
+    # straggler convictions (ISSUE 19 / ROADMAP 1c, read-only): the
+    # member was the slowest leg over N consecutive scoreboard windows.
+    # ZERO-FILLED over every member the coordinator's timeline has
+    # scattered to, so alert expressions resolve before (and without)
+    # any conviction ever firing.
+    p.family("yacy_mesh_straggler_convictions_total", "counter",
+             "straggler-scoreboard convictions (member slowest over N "
+             "consecutive windows; observation only — no steering)")
+    for member, v in sorted(tailattr.conviction_totals().items()):
+        p.sample("yacy_mesh_straggler_convictions_total", v,
+                 {"member": member})
     p.family("yacy_tail_verdicts_total", "counter",
              "over-threshold serving queries classified by the "
              "tail-attribution engine")

@@ -40,6 +40,18 @@ class Config:
         if settings_path and os.path.exists(settings_path):
             with open(settings_path, "r", encoding="utf-8") as f:
                 self._settings = _parse_kv(f.read())
+        # env override layer (ISSUE 19): a spawned child (mesh member,
+        # chaos harness) has no wire yet when its Switchboard builds, so
+        # knobs the engines read once at construction — incident
+        # cooldowns, admission burst, conviction windows — are injected
+        # at spawn: YACY_CONFIG_OVERRIDES="k1=v1,k2=v2" wins over the
+        # settings file (and persists with it if the node later set()s)
+        env = os.environ.get("YACY_CONFIG_OVERRIDES", "")
+        for part in env.split(","):
+            part = part.strip()
+            if part and "=" in part:
+                k, _, v = part.partition("=")
+                self._settings[k.strip()] = v.strip()
 
     @classmethod
     def from_files(cls, defaults_path: str, settings_path: str | None = None) -> "Config":

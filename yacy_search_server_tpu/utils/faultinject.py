@@ -66,11 +66,21 @@ import os
 import signal
 import threading
 import time
+from collections import deque
 
 _lock = threading.Lock()
 _faults: dict[str, object] = {}
 # fast-path gate: injection sites bail on this before touching the dict
 _active = False
+
+# schedule metadata (ISSUE 19): every arm/clear/expire is a timestamped
+# event in a bounded ring, so the game-day conductor and the verdict
+# engine join against ONE source of truth (wire-readable via
+# do_meshfault?list=1) instead of parallel bookkeeping.  Monotonic per
+# process — cross-process joins key on (pid, seq), never wall-clock
+# ordering.
+_schedule: deque = deque(maxlen=256)
+_schedule_seq = 0
 
 # every faultpoint name a production site may reach, with the site it
 # lives at.  proc.crashpoint values (the named SIGKILL barriers) are
@@ -140,6 +150,40 @@ def _parse_env() -> None:
                 set_fault(name, val)
 
 
+def _jsonable(value):
+    return value if isinstance(value, (int, float, str, bool)) \
+        or value is None else str(value)
+
+
+def _note_event_locked(action: str, point: str, value=None) -> None:
+    """Append one schedule event (caller holds _lock)."""
+    global _schedule_seq
+    _schedule_seq += 1
+    _schedule.append({"seq": _schedule_seq,
+                      "ts": round(time.time(), 3),
+                      "action": action, "point": point,
+                      "value": _jsonable(value),
+                      "pid": os.getpid()})
+
+
+def snapshot() -> dict:
+    """The armed faults RIGHT NOW (JSON-safe values) — the flight
+    recorder stamps this into every incident header so a post-hoc join
+    reads which injections were live at dump time."""
+    if not _active:
+        return {}
+    with _lock:
+        return {k: _jsonable(v) for k, v in _faults.items()}
+
+
+def schedule(n: int = 0) -> list[dict]:
+    """The arm/clear/expire event history (newest last; `n` > 0 limits
+    to the newest n) — the verdict engine's join key."""
+    with _lock:
+        evs = list(_schedule)
+    return evs[-n:] if n > 0 else evs
+
+
 def set_fault(name: str, value) -> None:
     """Arm one failpoint (tests; the env var feeds through here too)."""
     global _active
@@ -150,6 +194,7 @@ def set_fault(name: str, value) -> None:
     with _lock:
         _faults[name] = value
         _active = True
+        _note_event_locked("arm", name, value)
 
 
 def clear(name: str | None = None) -> None:
@@ -157,8 +202,12 @@ def clear(name: str | None = None) -> None:
     global _active
     with _lock:
         if name is None:
+            for k in _faults:
+                _note_event_locked("clear", k)
             _faults.clear()
         else:
+            if name in _faults:
+                _note_event_locked("clear", name)
             _faults.pop(name, None)
         _active = bool(_faults)
 
@@ -285,6 +334,7 @@ def io_error(path: str) -> None:
             _faults["io.error"] = f"{frag}:{nth - 1}"
             return
         _faults.pop("io.error", None)
+        _note_event_locked("expired", "io.error")
     raise InjectedFault(f"injected io.error on {path}")
 
 
@@ -306,10 +356,15 @@ def take(point: str) -> bool:
         if n <= 0:
             _faults.pop(point, None)
             _active = bool(_faults)
+            _note_event_locked("expired", point)
             return False
         if n == 1:
+            # the counted point self-disarms ("the device comes back")
+            # — a schedule event, so the verdict engine can see the
+            # recovery edge even when no one ever called clear()
             _faults.pop(point, None)
             _active = bool(_faults)
+            _note_event_locked("expired", point)
         else:
             _faults[point] = n - 1
         return True

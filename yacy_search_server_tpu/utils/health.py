@@ -45,7 +45,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
-from . import histogram, tracing
+from . import faultinject, histogram, tracing
 
 OK, WARN, CRITICAL = "ok", "warn", "critical"
 _SEVERITY = {OK: 0, WARN: 1, CRITICAL: 2}
@@ -593,6 +593,11 @@ class HealthEngine:
         # sticky SLO verdict after traffic stops): the tick drives
         # rotation for whatever recording's lazy rotation missed
         histogram.rotate_due()
+        # straggler conviction pass (ISSUE 19 / ROADMAP 1c, read-only):
+        # self-limits to one evaluation per conviction window, no-op on
+        # nodes without a mesh timeline (empty scoreboard)
+        from . import tailattr
+        tailattr.CONVICTIONS.observe(now)
         # bucket-free exposition: the ring (and incident dumps) keep the
         # _sum/_count + counter/gauge granularity
         snap = parse_exposition(self._exposition())
@@ -673,8 +678,18 @@ class HealthEngine:
         """Serialize the ring + firing rules + exemplars + recent traces
         as one JSONL incident (called under `_lock`, edge-triggered and
         rate-limited by the caller)."""
+        # post-hoc join keys (ISSUE 19): a monotonic per-process
+        # incident_seq (wall clocks skew across mesh processes; the
+        # verdict engine orders by (pid, seq)) and the armed-fault
+        # snapshot AT DUMP TIME — the incident names the injections
+        # that were live when it fired, which is what lets a game-day
+        # verdict match this incident to its scheduled fault
+        seq = self.incident_count + 1
+        armed = faultinject.snapshot()
         lines = [json.dumps({
             "kind": "incident", "ts": round(now, 3),
+            "incident_seq": seq, "pid": os.getpid(),
+            "armed_faults": armed,
             "entered_critical": entered,
             "rules": [{
                 "name": name, "state": st.state, "cause": st.cause,
@@ -701,6 +716,14 @@ class HealthEngine:
             lines.append(json.dumps({
                 "kind": "straggler_scoreboard",
                 "rows": tailattr.scoreboard()}))
+        # straggler convictions (ISSUE 19 / ROADMAP 1c): every recent
+        # conviction edge rides the incident like actuator breadcrumbs
+        # — the postmortem reads "mesh1 convicted over 2 windows" next
+        # to the burn it explains
+        from . import tailattr as _ta
+        for crumb in _ta.conviction_breadcrumbs():
+            lines.append(json.dumps(
+                {"kind": "straggler_convicted", **crumb}))
         # actuator breadcrumbs (ISSUE 9): the incident names every
         # actuation around the edge — which ladder rung, which tuning
         # step, which peers were avoided — so a postmortem reads the
@@ -732,7 +755,8 @@ class HealthEngine:
             self._prune_incident_files()
         self.incident_count += 1
         self.incidents.append({
-            "name": name, "ts": now, "rules": list(entered),
+            "name": name, "ts": now, "seq": seq,
+            "armed_faults": armed, "rules": list(entered),
             "path": path, "body": body})
 
     def _prune_incident_files(self) -> None:

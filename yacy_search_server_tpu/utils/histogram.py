@@ -235,6 +235,19 @@ class Histogram:
         with self._lock:
             self._rotate_locked(time.monotonic())
 
+    def reset_window(self) -> None:
+        """Drop every retained window sample and the cached p95 (the
+        cumulative Prometheus counters stay monotonic).  A harness
+        calls this at the warmup/measurement boundary: compile-era
+        walls would otherwise sit in the merged ring for
+        WINDOWS*ROTATE_EVERY_S and hold the exemplar gate far above
+        the live workload."""
+        with self._lock:
+            self._win = [[0] * N_BUCKETS for _ in range(WINDOWS)]
+            self._wi = 0
+            self._p95_cache = 0.0
+            self._next_rot = time.monotonic() + ROTATE_EVERY_S
+
     # -- reading -------------------------------------------------------------
 
     @property
@@ -345,6 +358,13 @@ def all_histograms() -> list:
 def rotate_all() -> None:
     for h in all_histograms():
         h.rotate()
+
+
+def reset_windows() -> None:
+    """Drop the windowed samples of every family (cumulative counters
+    untouched) — the warmup/measurement boundary reset."""
+    for h in all_histograms():
+        h.reset_window()
 
 
 def rotate_due() -> None:

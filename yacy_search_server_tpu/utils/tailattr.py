@@ -37,6 +37,39 @@ Three parts:
   deferral state) so a query's slowness is attributable to *its wave*,
   not just its own spans.
 
+Cause precedence under overlapping faults (ISSUE 19): two armed faults
+can both plausibly explain one slow query — a cold-tier miss during a
+mesh straggle, a compile charge on a degraded rung.  The classifier
+emits exactly ONE cause, resolved by a fixed priority ladder
+(:data:`PRECEDENCE`, pinned by the table-driven test in
+tests/test_tailattr.py):
+
+1. ``collective_straggler`` — the assembled mesh timeline NAMES the
+   late member; cross-process evidence outranks every local marker.
+2. ``host_fallback`` — the store KNOWS the device was lost; the query
+   was answered on the host no matter what else was slow around it.
+3. ``merge_deferral`` / ``tier_cold`` — the first cold-miss marker;
+   one rung, split by the marker's ``deferred`` attr (the scheduler
+   parked the promotion vs a plain cold miss).
+4. ``compile`` — the wave stamp's compile-vs-reuse bit.
+5. ``queue_wait`` — measured pre-issue wait >= 40% of the wall.
+6. ``lock_wait`` — measured lock-acquisition wall >= 30% of the wall.
+7. ``degraded_rung`` — served under a ladder rung with nothing above
+   claiming the wall.
+8. ``unattributed`` — no detector claimed it (the zero-unattributed
+   game-day gate counts these).
+
+Explicit markers outrank inferred dominance shares because the product
+path that emitted the marker KNOWS why it slowed; dominance thresholds
+are heuristics.
+
+Straggler convictions (ISSUE 19 / ROADMAP 1c first slice, read-only):
+:class:`ConvictionTracker` watches the windowed scoreboard; a member
+that is the slowest leg of most steps for N consecutive windows is
+CONVICTED — a flight-recorder breadcrumb + the zero-filled
+``yacy_mesh_straggler_convictions_total{member}`` series.  Observation
+only: steering/shedding on a conviction stays future work.
+
 Jax-free by contract (imported by the wire layer and the chaos
 children); zero-alloc when disabled — every product hook bails on one
 module-flag read, the ``bench.py --tail-overhead`` A/B switch.
@@ -73,6 +106,22 @@ CAUSES = (
     "host_fallback",         # device lost / transfer failure: counted
     #                          host answer
     "unattributed",          # over threshold, no detector claimed it
+)
+
+# the classifier's tie-break ladder under overlapping faults, highest
+# priority first (merge_deferral and tier_cold share one rung — the
+# cold marker's `deferred` attr splits them).  classify() must consult
+# detectors in exactly this order; the table-driven precedence test
+# cross-references this tuple.
+PRECEDENCE = (
+    "collective_straggler",
+    "host_fallback",
+    "merge_deferral", "tier_cold",
+    "compile",
+    "queue_wait",
+    "lock_wait",
+    "degraded_rung",
+    "unattributed",
 )
 
 # cause-marker span families the product paths emit (each creates a
@@ -146,6 +195,7 @@ def configure(cfg) -> None:
     global MIN_MS
     set_enabled(cfg.get_bool("tail.enabled", True))
     MIN_MS = cfg.get_float("tail.minMs", MIN_MS)
+    CONVICTIONS.configure(cfg)
 
 
 @dataclass
@@ -280,6 +330,14 @@ class TailAttributor:
             if mesh_info.get("straggler"):
                 cause, member = "collective_straggler", \
                     mesh_info["straggler"]
+            elif mesh_info.get("host_fallback"):
+                # the collective could not form (a member lost/down) or
+                # declined the step: the answer came from the host
+                # mirror.  Attributed to the member whose state forced
+                # the fallback — a game-day loss window must never read
+                # `unattributed` on the coordinator
+                host_fb = True
+                member = str(mesh_info.get("culprit", ""))
         if cause == "unattributed":
             if host_fb:
                 cause = "host_fallback"
@@ -373,19 +431,27 @@ class MeshTimeline:
         # (ts, slowest_member, margin_ms, exec_by_member) per COMPLETE
         # step — the scoreboard is windowed over this ring
         self._board: deque = deque(maxlen=SCOREBOARD_RING)
+        # every member id this timeline has ever scattered to — the
+        # zero-fill domain for the conviction series (a member with no
+        # convictions must still expose a 0 sample)
+        self.known: set[int] = set()
 
     def note_step(self, seq: int, trace_id: str, members,
-                  mode: str) -> None:
+                  mode: str, culprit: str = "") -> None:
         """Register a scattered step (called by the coordinator BEFORE
         its mesh.serve root closes, so a pending classification can
-        find the record)."""
+        find the record).  `culprit` names the member whose lost/down
+        state forced a host-mode step — the later verdict attributes
+        the host fallback to it."""
         if not _enabled:
             return
         with self._lock:
             self._by_seq[seq] = {
                 "seq": int(seq), "trace_id": trace_id, "ts": time.time(),
                 "members": set(int(m) for m in members), "mode": mode,
+                "culprit": culprit,
                 "segs": {}, "pending_ms": None, "dur_ms": 0.0}
+            self.known.update(self._by_seq[seq]["members"])
             self._by_trace[trace_id] = int(seq)
             evicted = []
             while len(self._by_seq) > MESH_RECORDS:
@@ -525,6 +591,17 @@ class MeshTimeline:
                     "exec_ms_by_member": {
                         f"mesh{m}": round(s["exec_ms"], 3)
                         for m, s in rec["segs"].items()}}}
+        # a step that answered from the host mirror (collective refused
+        # or individually declined) is host_fallback, not unattributed:
+        # no member ENTERED late, so the lateness test above can't fire,
+        # but the coordinator knows exactly why the collective broke
+        host_modes = sorted(m for m, s in rec["segs"].items()
+                            if s["mode"] in ("host", "error"))
+        if not straggler and (rec.get("mode") == "host" or host_modes):
+            info["host_fallback"] = True
+            info["culprit"] = rec.get("culprit", "")
+            info["evidence"]["host_members"] = [
+                f"mesh{m}" for m in host_modes]
         if partial:
             info["evidence"]["segments_partial"] = sorted(
                 rec["members"] - set(rec["segs"]))
@@ -608,13 +685,126 @@ class MeshTimeline:
             self._by_seq.clear()
             self._by_trace.clear()
             self._board.clear()
+            self.known.clear()
             self.segments_merged = 0
+
+
+class ConvictionTracker:
+    """ROADMAP 1c first slice, read-only (ISSUE 19): a member that is
+    the slowest leg of most complete steps for N CONSECUTIVE scoreboard
+    windows is *convicted* — one edge-triggered breadcrumb into the
+    flight recorder plus the zero-filled
+    ``yacy_mesh_straggler_convictions_total{member}`` series.  A single
+    slow window (GC pause, one cold step) never convicts; a cleared
+    fault breaks the streak and re-arms the edge.  Observation only:
+    nothing reads a conviction to steer or shed — that is future work,
+    and keeping this slice read-only is what makes it safe to land
+    under the game-day soak."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.window_s = 30.0      # one evaluation window
+        self.windows_needed = 2   # consecutive guilty windows to convict
+        self.slowest_frac = 0.6   # guilty: slowest leg of >= this share
+        self.min_steps = 3        # ... over at least this many steps
+        self.min_margin_ms = 20.0  # ... by a material margin
+        self._last_eval = 0.0
+        self._streaks: dict[str, int] = {}
+        self.totals: dict[str, int] = {}
+        self.breadcrumbs: deque = deque(maxlen=64)
+
+    def configure(self, cfg) -> None:
+        self.window_s = cfg.get_float("tail.convictionWindowS",
+                                      self.window_s)
+        self.windows_needed = max(1, cfg.get_int(
+            "tail.convictionWindows", self.windows_needed))
+        self.slowest_frac = cfg.get_float("tail.convictionFrac",
+                                          self.slowest_frac)
+        self.min_steps = cfg.get_int("tail.convictionMinSteps",
+                                     self.min_steps)
+        self.min_margin_ms = cfg.get_float("tail.convictionMarginMs",
+                                           self.min_margin_ms)
+
+    def observe(self, now: float | None = None) -> list[dict]:
+        """One health-tick hook: evaluate at most once per window
+        (ticks are faster than windows), judge the last window's
+        scoreboard, advance streaks, emit conviction breadcrumbs on the
+        streak-reaches-N edge.  Members with no scoreboard rows (no
+        mesh, or a member down) contribute nothing — absence of
+        evidence never convicts, and it never ACQUITS either: a streak
+        only resets when the member shows up in a window and is judged
+        not guilty, so an idle window does not launder a straggler."""
+        now = time.time() if now is None else now
+        with self._lock:
+            if now - self._last_eval < self.window_s:
+                return []
+            self._last_eval = now
+        rows = MESH.scoreboard(self.window_s)
+        guilty = {r["member"] for r in rows
+                  if r["steps"] >= self.min_steps
+                  and r["slowest_frac"] >= self.slowest_frac
+                  and r["mean_margin_ms"] >= self.min_margin_ms}
+        seen = {r["member"] for r in rows}
+        convicted = []
+        with self._lock:
+            for member in seen | set(self._streaks):
+                if member in guilty:
+                    self._streaks[member] = \
+                        self._streaks.get(member, 0) + 1
+                    if self._streaks[member] == self.windows_needed:
+                        self.totals[member] = \
+                            self.totals.get(member, 0) + 1
+                        row = next((r for r in rows
+                                    if r["member"] == member), {})
+                        crumb = {
+                            "ts": round(now, 3), "member": member,
+                            "windows": self.windows_needed,
+                            "window_s": self.window_s,
+                            "slowest_frac": row.get("slowest_frac"),
+                            "mean_margin_ms": row.get("mean_margin_ms"),
+                            "conviction_total": self.totals[member]}
+                        self.breadcrumbs.append(crumb)
+                        convicted.append(crumb)
+                        log.warning("straggler convicted: %s", crumb)
+                elif member in seen:
+                    # present in the window but not guilty: the streak
+                    # breaks.  Absent members keep theirs — no evidence
+                    # either way.
+                    self._streaks.pop(member, None)
+        return convicted
+
+    def known_members(self) -> list[str]:
+        """The zero-fill domain: every member the timeline ever
+        scattered to, plus anyone already convicted."""
+        with self._lock:
+            out = set(self.totals)
+        out.update(f"mesh{m}" for m in sorted(MESH.known))
+        return sorted(out)
+
+    def conviction_totals(self) -> dict:
+        """member -> convictions, zero-filled over known members."""
+        out = {m: 0 for m in self.known_members()}
+        with self._lock:
+            out.update(self.totals)
+        return out
+
+    def recent(self, n: int = 20) -> list[dict]:
+        with self._lock:
+            return list(self.breadcrumbs)[-max(0, n):]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._streaks.clear()
+            self.totals.clear()
+            self.breadcrumbs.clear()
+            self._last_eval = 0.0
 
 
 # -- process-global singletons (the histogram-registry model) ----------------
 
 ATTR = TailAttributor()
 MESH = MeshTimeline()
+CONVICTIONS = ConvictionTracker()
 
 
 def stamp_wave(items: list, kernel: str, max_batch: int,
@@ -692,7 +882,16 @@ def scoreboard(horizon_s: float = 600.0) -> list:
     return MESH.scoreboard(horizon_s)
 
 
+def conviction_totals() -> dict:
+    return CONVICTIONS.conviction_totals()
+
+
+def conviction_breadcrumbs(n: int = 20) -> list:
+    return CONVICTIONS.recent(n)
+
+
 def reset() -> None:
     """Test/bench isolation: drop verdicts, waves and mesh records."""
     ATTR.reset()
     MESH.reset()
+    CONVICTIONS.reset()
