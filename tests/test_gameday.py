@@ -400,6 +400,100 @@ def test_committed_chaos_r02_artifact():
                    for e in trail), (r["point"], trail)
 
 
+# -- drill trend, run-over-run (ISSUE 20 satellite) --------------------------
+
+def _newest_schedule_artifact():
+    import glob
+    for p in sorted(glob.glob(os.path.join(REPO, "CHAOS_r*.json")),
+                    reverse=True):
+        with open(p, encoding="utf-8") as f:
+            art = json.load(f)
+        if art.get("schedule"):
+            return p, art
+    pytest.fail("no committed game-day artifact with a schedule")
+
+
+def test_drill_trend_self_diff_is_complete_and_zero():
+    """Completeness: EVERY scheduled fault of the newest committed
+    artifact appears in the trend, and a self-diff is all-zero deltas
+    with no regressions (the identity the bench-side embed relies on)."""
+    from tools import drill_trend
+    _path, art = _newest_schedule_artifact()
+    t = drill_trend.trend(art, art)
+    assert {(r["point"], r["target"]) for r in t["faults"]} == \
+        {(str(r["point"]), str(r["target"])) for r in art["schedule"]}
+    assert t["regressions"] == 0 and t["improvements"] == 0
+    assert not t["new_faults"] and not t["dropped_faults"]
+    for r in t["faults"]:
+        assert not r["regressed"] and not r["improved"]
+        assert r["recovered_s"]["delta_s"] in (0.0, None)
+        for c in drill_trend.CHECKS:
+            assert r["checks"][c]["prev"] == r["checks"][c]["cur"]
+    assert t["all_pass"]["prev"] == t["all_pass"]["cur"]
+
+
+def test_drill_trend_flags_check_flip_and_verdict_regression():
+    from tools import drill_trend
+    prev = {"round": 1, "schedule": [
+        {"point": "mesh.step", "target": "mesh1", "verdict": "pass",
+         "detected": True, "attributed": True, "answered": True,
+         "slo_recovery": True, "bit_identical": True,
+         "recovery": {"recovered_s": 4.0}}]}
+    cur = json.loads(json.dumps(prev))
+    cur["round"] = 2
+    cur["schedule"][0]["attributed"] = False
+    cur["schedule"][0]["verdict"] = "fail"
+    cur["schedule"][0]["recovery"]["recovered_s"] = 9.0
+    t = drill_trend.trend(prev, cur)
+    assert t["regressions"] == 1
+    row = t["faults"][0]
+    assert row["regressed"] and not row["improved"]
+    assert row["checks"]["attributed"] == {"prev": True, "cur": False}
+    assert row["recovered_s"]["delta_s"] == 5.0
+    # the flip back reads as an improvement, never a regression
+    t2 = drill_trend.trend(cur, prev)
+    assert t2["regressions"] == 0 and t2["improvements"] == 1
+    # fault present only on one side: reported, not crashed on
+    cur2 = json.loads(json.dumps(prev))
+    cur2["schedule"].append({"point": "device.transfer_fail",
+                             "target": "mesh2", "verdict": "pass"})
+    t3 = drill_trend.trend(prev, cur2)
+    assert t3["new_faults"] == [["device.transfer_fail", "mesh2"]]
+    assert t3["regressions"] == 0
+
+
+def test_committed_round3_embeds_trend_and_convicted_profile():
+    """The ISSUE 20 acceptance on the committed artifact: from round 3
+    every --game-day run carries (a) the run-over-run trend block with
+    zero regressions against the named prior artifact, and (b) a
+    straggler_convicted incident whose crumb embeds the convicted
+    member's WIRE-FETCHED whitebox profile — sampled in the straggler's
+    own process (distinct pid) with a member-runloop stack naming the
+    armed straggle site."""
+    path, art = _newest_schedule_artifact()
+    if art.get("round", 0) < 3:
+        pytest.skip("pre-ISSUE-20 artifact")
+    t = art["trend"]
+    assert t["regressions"] == 0, (path, t)
+    assert os.path.exists(os.path.join(REPO, t["prev_artifact"]))
+    assert t["faults"], "trend block diffed no faults"
+
+    mesh_incidents = (art.get("incidents") or {}).get("mesh", [])
+    convs = [i for i in mesh_incidents
+             if i.get("name") == "straggler_convicted"]
+    assert convs, "drill produced no conviction incident"
+    inc = convs[0]
+    assert inc["member"] == inc["crumb"]["member"]
+    prof = inc["crumb"].get("profile")
+    assert prof, "conviction crumb carries no profile"
+    assert prof["samples_total"] > 0
+    runloop = [s for s in prof["stacks"]
+               if s["role"] == "member-runloop"]
+    assert runloop, prof["stacks"][:4]
+    assert any("faultinject" in s["stack"] for s in runloop), \
+        "member-runloop stacks never caught the armed straggle site"
+
+
 # -- the servlet -------------------------------------------------------------
 
 def test_gameday_servlet_renders_artifact():

@@ -502,6 +502,12 @@ CHECKER_FIXTURES = {
     "servlet-trace": ({"server/servlets/x.py": SERVLET_BAD}, None),
     "tail-reach": ({"server/httpd.py": TAIL_BAD_SERVER,
                     "utils/tailattr.py": TAIL_FIXTURE_ATTR}, None),
+    "raw-hot-lock": ({"prof.py": 'HOT_LOCK_CENSUS = {\n'
+                      '    "yacy_search_server_tpu/m.py::Store::_lock":'
+                      ' "store",\n}\n',
+                      "m.py": "import threading\n\nclass Store:\n"
+                      "    def __init__(self):\n"
+                      "        self._lock = threading.Lock()\n"}, None),
 }
 
 
@@ -618,6 +624,70 @@ def test_baseline_round_trip_and_shrink_only(tmp_path):
 def test_parse_error_is_a_finding(tmp_path):
     res = run_fixture(tmp_path, {"m.py": "def broken(:\n"})
     assert any(f.checker == "parse-error" for f in res.findings)
+
+
+# -- raw-hot-lock (the observatory census police, ISSUE 20) -------------------
+
+RAWLOCK_CENSUS = (
+    'HOT_LOCK_CENSUS = {\n'
+    '    "yacy_search_server_tpu/m.py::Store::_lock": "store",\n'
+    '}\n'
+)
+
+RAWLOCK_BAD = '''
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+'''
+
+
+def test_raw_hot_lock_fires_on_census_member(tmp_path):
+    res = run_fixture(tmp_path, {"prof.py": RAWLOCK_CENSUS,
+                                 "m.py": RAWLOCK_BAD},
+                      only={"raw-hot-lock"})
+    fs = findings_of(res, "raw-hot-lock")
+    assert len(fs) == 1
+    assert "Store._lock" in fs[0].message
+    assert "rawlock-ok" in fs[0].message
+
+
+def test_raw_hot_lock_observed_twin_and_exemption_clean(tmp_path):
+    observed = RAWLOCK_BAD.replace(
+        "threading.Lock()", "profiling.ObservedRLock('store')")
+    res = run_fixture(tmp_path, {"prof.py": RAWLOCK_CENSUS,
+                                 "m.py": observed},
+                      only={"raw-hot-lock"})
+    assert not findings_of(res, "raw-hot-lock")
+    exempted = RAWLOCK_BAD.replace(
+        "self._lock = threading.Lock()",
+        "self._lock = threading.Lock()  "
+        "# lint: rawlock-ok(bench-only stub)")
+    res2 = run_fixture(tmp_path, {"prof.py": RAWLOCK_CENSUS,
+                                  "m.py": exempted},
+                      only={"raw-hot-lock"})
+    assert not findings_of(res2, "raw-hot-lock")
+
+
+def test_raw_hot_lock_flags_rotted_census(tmp_path):
+    # entry points at a class that does not exist: the census may not
+    # rot silently as code moves
+    res = run_fixture(tmp_path, {"prof.py": RAWLOCK_CENSUS,
+                                 "m.py": "class Other:\n    pass\n"},
+                      only={"raw-hot-lock"})
+    fs = findings_of(res, "raw-hot-lock")
+    assert len(fs) == 1 and "rotted" in fs[0].message
+
+
+def test_raw_hot_lock_real_census_is_fully_observed():
+    """Non-vacuity against the REAL tree: the census is non-empty and
+    every entry resolved to an Observed* constructor (stats say so)."""
+    res = engine.run(root=REPO, only={"raw-hot-lock"})
+    assert not res.findings, [str(f) for f in res.findings]
+    st = res.stats["raw-hot-lock"]
+    assert st.get("census_entries", 0) >= 6
+    assert st.get("observed_locks", 0) >= st.get("census_entries", 0)
 
 
 # -- the tier-1 gate ----------------------------------------------------------

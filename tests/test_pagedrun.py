@@ -44,6 +44,26 @@ def test_pagedrun_roundtrip(tmp_path):
                                   terms[th0].docids)
 
 
+def test_pagedrun_close_leaves_inflight_readers_valid(tmp_path):
+    """Merge retirement closes a run while rwi.get readers (which
+    snapshot the run list and materialize spans OUTSIDE the index lock)
+    may still be inside get() on the old snapshot.  close() must not
+    yank the memmaps from under them: a retired run keeps serving —
+    the live mmap outlives even the victim file's unlink — and the
+    term cache is what gets invalidated."""
+    rng = np.random.default_rng(7)
+    terms = {b"CCCCCCCCCCCC": _plist(rng, 11)}
+    path = str(tmp_path / "run-000000.dat")
+    run = PagedRun.write(path, terms, TermCache())
+    th = b"CCCCCCCCCCCC"
+    before = run.get(th)
+    run.close()
+    os.remove(path)                      # the retirement unlink
+    after = run.get(th)                  # in-flight reader's view
+    np.testing.assert_array_equal(after.docids, before.docids)
+    np.testing.assert_array_equal(after.feats, before.feats)
+
+
 def test_pagedrun_drop_term(tmp_path):
     rng = np.random.default_rng(1)
     terms = {b"AAAAAAAAAAAA": _plist(rng, 7), b"BBBBBBBBBBBB": _plist(rng, 9)}

@@ -1,0 +1,118 @@
+"""Game-day drill trend: diff two CHAOS_r*.json artifacts run-over-run.
+
+M90 left a committed verdict artifact (CHAOS_r02.json) behind; every
+later ``bench.py --game-day`` run produces the next round.  This tool
+answers the question a committed artifact alone cannot: did detection,
+attribution or recovery REGRESS since the last drill?  Per scheduled
+fault (keyed by ``(point, target)`` — fault ids may renumber across
+rounds) it compares the verdict, each verdict-engine check bit, and
+the measured recovery latency; the roll-up counts regressions and
+improvements and names faults that appeared/disappeared between
+rounds.
+
+Used three ways:
+
+* ``bench.py --game-day`` embeds ``trend(prev, cur)`` in the fresh
+  artifact before writing it (the run-over-run block);
+* ``python tools/drill_trend.py PREV CUR`` prints the trend JSON for
+  two artifacts on disk;
+* tests/test_gameday.py pins completeness: every fault of the current
+  committed artifact appears in the trend, and a self-diff is all-zero
+  deltas with no regressions.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# the per-fault verdict-engine check bits a trend row diffs (absent in
+# an artifact -> None, never a regression: no evidence either way)
+CHECKS = ("detected", "attributed", "answered", "slo_recovery",
+          "bit_identical")
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _key(row: dict) -> tuple:
+    return (str(row.get("point", "")), str(row.get("target", "")))
+
+
+def _recovered_s(row: dict):
+    rec = row.get("recovery") or {}
+    v = rec.get("recovered_s")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def trend(prev: dict, cur: dict) -> dict:
+    """The run-over-run diff block (prev/cur: game-day artifacts with a
+    ``schedule`` list).  Never raises on shape skew — a fault present
+    only on one side is reported, not crashed on."""
+    prev_rows = {_key(r): r for r in prev.get("schedule", [])}
+    cur_rows = {_key(r): r for r in cur.get("schedule", [])}
+    faults = []
+    regressions = improvements = 0
+    for k in sorted(cur_rows):
+        r = cur_rows[k]
+        p = prev_rows.get(k)
+        row: dict = {
+            "point": k[0], "target": k[1],
+            "fault_id": r.get("fault_id"),
+            "verdict": {"prev": p.get("verdict") if p else None,
+                        "cur": r.get("verdict")},
+            "checks": {},
+        }
+        regressed = improved = False
+        for c in CHECKS:
+            pv = p.get(c) if p else None
+            cv = r.get(c)
+            row["checks"][c] = {"prev": pv, "cur": cv}
+            if pv is True and cv is False:
+                regressed = True
+            elif pv is False and cv is True:
+                improved = True
+        pr, cr = (_recovered_s(p) if p else None), _recovered_s(r)
+        delta = round(cr - pr, 3) if pr is not None and cr is not None \
+            else None
+        row["recovered_s"] = {"prev": pr, "cur": cr, "delta_s": delta}
+        if p and p.get("verdict") == "pass" and \
+                r.get("verdict") != "pass":
+            regressed = True
+        row["regressed"] = regressed
+        row["improved"] = improved and not regressed
+        regressions += 1 if regressed else 0
+        improvements += 1 if row["improved"] else 0
+        faults.append(row)
+    return {
+        "prev_round": prev.get("round"),
+        "cur_round": cur.get("round"),
+        "faults": faults,
+        "regressions": regressions,
+        "improvements": improvements,
+        "new_faults": [list(k) for k in sorted(cur_rows)
+                       if k not in prev_rows],
+        "dropped_faults": [list(k) for k in sorted(prev_rows)
+                           if k not in cur_rows],
+        "all_pass": {
+            "prev": bool((prev.get("verdict_summary") or {})
+                         .get("all_pass")),
+            "cur": bool((cur.get("verdict_summary") or {})
+                        .get("all_pass"))},
+    }
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 3:
+        print("usage: drill_trend.py PREV.json CUR.json",
+              file=sys.stderr)
+        return 2
+    out = trend(load(argv[1]), load(argv[2]))
+    print(json.dumps(out, indent=1))
+    return 1 if out["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

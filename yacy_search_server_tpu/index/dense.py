@@ -17,6 +17,7 @@ import threading
 import numpy as np
 
 from ..ops.dense import DIM, ENCODER_VERSION
+from ..utils import profiling
 from . import integrity
 
 # crc footer on the vectors.npy snapshot (M84 discipline, ISSUE 11
@@ -68,7 +69,7 @@ class DenseVectorStore:
         # the write lock across the device transfer: indexers keep
         # putting vectors while a (possibly seconds-long, through a
         # remote tunnel) re-upload is in flight
-        self._fwd_lock = threading.Lock()
+        self._fwd_lock = profiling.ObservedLock("dense_fwd")
         # rows written since the last device upload: device_block
         # scatters ONLY these into the resident block (indexing cadence
         # must not re-ship the whole index per query wave); None =

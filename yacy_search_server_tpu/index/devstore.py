@@ -74,7 +74,7 @@ from ..ops.ranking import (_ACTIVE_COLS, RankingProfile,
 from ..ops.streaming import merge_stats
 from ..utils.eventtracker import EClass, update as track
 from ..utils.profiler import PROFILER
-from ..utils import faultinject, histogram, tailattr, tracing
+from ..utils import faultinject, histogram, profiling, tailattr, tracing
 from . import integrity
 from . import postings as P
 from .pagedrun import PagedRun
@@ -1772,7 +1772,7 @@ class _QueryBatcher:
         self._stop = False
         # runtime tuning (ISSUE 9 batcher auto-tune): set_tuning
         # grows/retires pool threads one call at a time under this lock
-        self._tune_lock = threading.Lock()
+        self._tune_lock = profiling.ObservedLock("devstore_tune")
         self._thread_seq = max(1, dispatchers)
         # completer retires deferred by a full in-flight queue, repaid
         # on later set_tuning calls (the pools must not drift apart)
@@ -3093,7 +3093,11 @@ class DeviceSegmentStore:
         self.ingest_device_builds = 0       # blocks packed on device
         # run path/id -> {termhash: (start, count)}
         self._packed: dict[int, dict[bytes, tuple[int, int]]] = {}
-        self._lock = threading.RLock()
+        # lock-wait observatory (ISSUE 20b): the store lock is THE
+        # query-path contention point, so its wait/hold walls record
+        # into lock.wait.devstore / lock.hold.devstore and contended
+        # acquires emit the tail classifier's lock-wait marker
+        self._lock = profiling.ObservedRLock("devstore")
         self._consts = None
         self._profile_key = None
         self._garbage_rows = 0
@@ -5590,10 +5594,9 @@ class DeviceSegmentStore:
                     return None
         # the cache peek is the FIRST store-lock acquisition on the
         # query path: a query stalled behind a long arena mutation
-        # blocks here, so the wait is measured here too (ISSUE 15c)
-        _t_lk = time.perf_counter()
+        # blocks here — the ObservedRLock measures the wait and emits
+        # the lock-wait marker span (ISSUE 20b, one measurement point)
         with self._lock:
-            tailattr.note_lock_wait("devstore", _t_lk)
             epoch = self.arena_epoch
         got = self._topk_cache.get(key, epoch, stale_ok=stale_ok)
         if got is None:
@@ -5667,12 +5670,10 @@ class DeviceSegmentStore:
         # must be read against the same buffers the kernel will scan
         # (ONE lock round also decides residency: packed spans divert to
         # the *_bp paths, non-resident terms attribute their tier miss).
-        # The acquisition wait is measured (ISSUE 15c): a query stalled
-        # behind a long arena mutation gets a lock-wait marker span the
-        # tail classifier can name, instead of an anonymous gap.
-        _t_lk = time.perf_counter()
+        # A query stalled behind a long arena mutation gets a lock-wait
+        # marker span the tail classifier can name — measured by the
+        # ObservedRLock itself (ISSUE 20b, one measurement point).
         with self._lock:
-            tailattr.note_lock_wait("devstore", _t_lk)
             spans = self.spans_for(termhash)
             ineligible = spans is None or len(spans) > self.MAX_SPANS
             is_packed = (not ineligible

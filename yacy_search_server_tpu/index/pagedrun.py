@@ -136,8 +136,12 @@ class PagedRun:
         # per-term span checksums (crc32 over docid+feat row bytes);
         # empty for legacy PR1 files — no claim, no verification
         self._crcs = crcs or {}
-        self._mm_docids: np.ndarray | None = None
-        self._mm_feats: np.ndarray | None = None
+        # both memmaps published through ONE attribute: readers run
+        # lock-free (rwi.get materializes spans outside the index lock),
+        # so the pair must appear atomically — publishing docids and
+        # feats as two attributes lets a concurrent reader observe
+        # (docids, None) mid-init
+        self._mm: tuple[np.ndarray, np.ndarray] | None = None
         self.n_postings = sum(c for _, c in index.values())
         # tombstone count at creation: this run's rows exclude every
         # tombstone journaled before it was written (flush purges the RAM
@@ -257,13 +261,15 @@ class PagedRun:
         return PagedRun(path, index, total, cache, dead_seq, crcs)
 
     def _maps(self) -> tuple[np.ndarray, np.ndarray]:
-        if self._mm_docids is None:
-            self._mm_docids = np.memmap(self.path, dtype="<i4", mode="r",
-                                        shape=(self._total,))
-            self._mm_feats = np.memmap(self.path, dtype="<i4", mode="r",
-                                       offset=self._total * 4,
-                                       shape=(self._total, NF))
-        return self._mm_docids, self._mm_feats
+        maps = self._mm
+        if maps is None:
+            docids = np.memmap(self.path, dtype="<i4", mode="r",
+                               shape=(self._total,))
+            feats = np.memmap(self.path, dtype="<i4", mode="r",
+                              offset=self._total * 4,
+                              shape=(self._total, NF))
+            maps = self._mm = (docids, feats)
+        return maps
 
     # -- run interface (shared with rwi.FrozenRun) ---------------------------
 
@@ -349,8 +355,12 @@ class PagedRun:
         return span[1]
 
     def close(self) -> None:
-        self._mm_docids = None
-        self._mm_feats = None
+        # do NOT null the memmaps: rwi.get snapshots the run list and
+        # materializes spans OUTSIDE the index lock, so a reader may
+        # still be inside get() when merge retirement closes this run —
+        # yanking the maps hands that reader (docids, None).  The pages
+        # stay valid even after the victim file is unlinked (live mmap);
+        # the last snapshot reference dying is what frees them.
         if self._cache is not None:
             self._cache.invalidate_run(self.path)
 

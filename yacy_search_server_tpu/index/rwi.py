@@ -38,7 +38,7 @@ from .integrity import CorruptRunError
 from .pagedrun import PagedRun, TermCache
 from .postings import NF, PostingsList, merge, remove_docids, sort_dedupe
 from ..ingest import slo as ingest_slo
-from ..utils import faultinject
+from ..utils import faultinject, profiling
 from ..utils.eventtracker import EClass, update as track
 
 log = logging.getLogger("yacy.rwi")
@@ -163,7 +163,7 @@ class RWIIndex:
         self._runs: list = []  # FrozenRun | PagedRun, oldest first
         self._tombstones: set[int] = set()
         self._dead_arr: np.ndarray | None = None  # cached sorted tombstones
-        self._lock = threading.RLock()
+        self._lock = profiling.ObservedRLock("rwi")
         # bounded-buffer backpressure (ISSUE 13 satellite): hard cap =
         # backpressure_factor × max_ram_postings; wait_capacity blocks
         # (counted) past it, _flush_lock makes the flush single-flight

@@ -55,7 +55,7 @@ from collections import deque
 
 import numpy as np
 
-from ..utils import faultinject, histogram, tailattr, tracing
+from ..utils import faultinject, histogram, profiling, tailattr, tracing
 
 log = logging.getLogger("parallel.distributed")
 
@@ -213,7 +213,7 @@ class MeshMember:
         # serializes on _serve_lock, so a handful is the healthy depth)
         self._steps: "_queue.Queue" = _queue.Queue(maxsize=512)
         self._pending: dict[int, dict] = {}
-        self._plock = threading.Lock()
+        self._plock = profiling.ObservedLock("mesh_plock")
         self._serve_lock = threading.Lock()
         self._seq = 0
         # per-process serving counters (the ISSUE 12 availability
@@ -268,6 +268,14 @@ class MeshMember:
                                         name=f"mesh-runloop-{process_id}",
                                         daemon=True)
         self._runner.start()
+        # whitebox conviction evidence (ISSUE 20d): the coordinator
+        # hooks the conviction tracker so every conviction edge fetches
+        # the convicted member's OWN profile snapshot over the wire and
+        # writes a conviction incident embedding it — the verdict stops
+        # being "mesh1 was slowest" and starts being "mesh1 was slowest
+        # and here is the stack it was burning on"
+        if self.timeline is not None:
+            tailattr.CONVICTIONS.set_conviction_hook(self._on_convicted)
         self.ready = True
         log.info("mesh member %d/%d up: pid=%d http=%d cells=%d fp=%s",
                  process_id, num_processes, os.getpid(),
@@ -671,6 +679,50 @@ class MeshMember:
                 "tail": tail,
                 "health_incidents": health_incs,
                 "incident_tail": incident_tail}
+
+    def _on_convicted(self, crumb: dict) -> None:
+        """Conviction-edge hook (ISSUE 20d, coordinator only): fetch
+        the convicted member's whitebox profile over the wire (or read
+        it locally for self-convictions), attach it to the crumb —
+        health's flight recorder embeds crumbs verbatim — and write a
+        dedicated conviction incident (the _note_member model)."""
+        member = str(crumb.get("member", ""))
+        try:
+            j = int(member[4:]) if member.startswith("mesh") else -1
+        except ValueError:
+            j = -1
+        prof = None
+        if j == self.process_id:
+            from ..utils import profiling
+            prof = profiling.snapshot()
+        elif j in self.peers:
+            ok, rep = self.node.protocol.fetch_profile(self.peers[j])
+            if ok and isinstance(rep.get("profile"), dict):
+                prof = rep["profile"]
+        if prof is not None:
+            crumb["profile"] = prof
+        with self._plock:
+            self._incident_seq += 1
+            seq_no = self._incident_seq
+        inc = {"kind": "incident", "name": "straggler_convicted",
+               "member": member, "member_id": j,
+               "ts": round(time.time(), 3), "incident_seq": seq_no,
+               "armed_faults": faultinject.snapshot(),
+               "crumb": crumb}
+        self.incidents.append(inc)
+        log.warning("straggler conviction incident: %s (profile %s)",
+                    member, "attached" if prof is not None else "absent")
+        if self._data_dir:
+            try:
+                hdir = os.path.join(self._data_dir, "HEALTH")
+                os.makedirs(hdir, exist_ok=True)
+                path = os.path.join(
+                    hdir,
+                    f"mesh-conviction-{int(inc['ts'])}-{member}.jsonl")
+                with open(path, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(inc) + "\n")
+            except OSError:
+                log.warning("conviction incident write failed")
 
     def close(self) -> None:
         self._stop.set()

@@ -282,6 +282,14 @@ class Protocol:
         peer's hash so merged spans stay attributable."""
         return self._call(target, "tracefetch", {"trace": trace_id})
 
+    def fetch_profile(self, target: Seed,
+                      n: int = 12) -> tuple[bool, dict]:
+        """Whitebox straggler forensics (ISSUE 20d): pull the peer's
+        in-process profile snapshot — folded stacks, lock table, last
+        deep capture — over the same wire the mesh already pays for
+        (server side: PeerServer.do_profsnap)."""
+        return self._call(target, "profsnap", {"n": n})
+
     def idx(self, target: Seed) -> dict:
         """Peer index statistics (htroot/yacy/idx.java server side).
         Returns {} for unreachable peers AND for peers answering with an

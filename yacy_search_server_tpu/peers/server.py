@@ -351,6 +351,24 @@ class PeerServer:
             out["truncated"] = seg["truncated"]
         return out
 
+    # -- whitebox profile fetch (ISSUE 20d) ----------------------------------
+
+    def do_profsnap(self, payload: dict) -> dict:
+        """Serve this process's whitebox profile snapshot — top folded
+        stacks, per-lock wait/hold table, last deep capture — so a
+        coordinator convicting this member as a straggler can attach
+        the member's OWN evidence to the conviction incident (client:
+        Protocol.fetch_profile, hook: MeshMember._on_convicted)."""
+        from ..utils import profiling
+        me = self.seeddb.my_seed
+        try:
+            n = max(1, min(32, int(payload.get("n", 12))))
+        except (TypeError, ValueError):
+            n = 12
+        return {"peer": me.hash.decode("ascii", "replace"),
+                "name": me.name,
+                "profile": profiling.snapshot(n)}
+
     # -- index transfer (receive) --------------------------------------------
 
     def do_transferRWI(self, payload: dict) -> dict:

@@ -40,7 +40,7 @@ from ..ops.ranking import (CD_ALL, CD_APP, CD_AUDIO, CD_IMAGE, CD_TEXT,
                            CD_VIDEO, CardinalRanker)
 from ..utils.bitfield import (FLAG_CAT_HASAPP, FLAG_CAT_HASAUDIO,
                               FLAG_CAT_HASIMAGE, FLAG_CAT_HASVIDEO)
-from ..utils import tracing
+from ..utils import profiling, tracing
 from ..utils.eventtracker import EClass, StageTimer, update as track
 from ..utils.hashes import hosthash
 from ..utils.topk import WeakPriorityQueue
@@ -1068,7 +1068,7 @@ class SearchEventCache:
         self.max_events = max_events
         self.ttl_s = ttl_s
         self._events: dict[str, SearchEvent] = {}
-        self._lock = threading.Lock()
+        self._lock = profiling.ObservedLock("search_cache")
         # most recent event id — the default subject of the search-event
         # picture (reference: SearchEventCache.lastEventID)
         self.last_event_id: str | None = None

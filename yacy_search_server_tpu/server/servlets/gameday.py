@@ -9,13 +9,14 @@ member, did the SLO recover inside the bound after the clear, was
 every request during the window answered (degraded + counted, never a
 5xx), and did the recovered fleet rank bit-identically to the pre-fault
 baseline.  The in-process view (:data:`~...utils.gameday.LAST_RUN`)
-wins; with no run this process, the committed ``CHAOS_r02.json``
+wins; with no run this process, the newest committed ``CHAOS_r*.json``
 artifact at the repo root is served instead, so the panel is useful on
 a fresh operator node too.  ``format=json`` exports the full artifact.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 
@@ -23,31 +24,44 @@ from ...utils import gameday
 from ..objects import ServerObjects, escape_json
 from . import servlet
 
-_ARTIFACT = "CHAOS_r02.json"
-
 GATES = ("detected", "attributed", "answered", "slo_recovery",
          "bit_identical")
 
 
-def _artifact_path() -> str:
+def _newest_artifact() -> str | None:
+    """Newest committed ``CHAOS_r*.json`` that actually has a fault
+    schedule (every --game-day run commits the next round; pre-M90
+    residues without a schedule don't qualify)."""
     here = os.path.dirname(os.path.abspath(__file__))
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.dirname(here))))
-    return os.path.join(root, _ARTIFACT)
+    for path in sorted(glob.glob(os.path.join(root, "CHAOS_r*.json")),
+                       reverse=True):
+        try:
+            with open(path, encoding="utf-8") as f:
+                if json.load(f).get("schedule"):
+                    return path
+        except (OSError, ValueError):
+            continue
+    return None
 
 
 def gameday_view() -> dict:
     """The newest game-day result: this process's LAST_RUN if a run
-    happened here, else the committed artifact, else an empty shell."""
+    happened here, else the newest committed artifact, else an empty
+    shell."""
     if gameday.LAST_RUN is not None:
         return {"source": "live", **gameday.LAST_RUN}
-    path = _artifact_path()
-    try:
-        with open(path, encoding="utf-8") as f:
-            return {"source": _ARTIFACT, **json.load(f)}
-    except (OSError, ValueError):
-        return {"source": "none", "schedule": [], "overlaps": [],
-                "verdict_summary": {}, "workload": {}}
+    path = _newest_artifact()
+    if path is not None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                return {"source": os.path.basename(path),
+                        **json.load(f)}
+        except (OSError, ValueError):
+            pass
+    return {"source": "none", "schedule": [], "overlaps": [],
+            "verdict_summary": {}, "workload": {}}
 
 
 @servlet("Performance_GameDay_p")
@@ -70,6 +84,11 @@ def respond_gameday(header: dict, post: ServerObjects,
     wl = view.get("workload", {})
     prop.put("queries_total", wl.get("queries_total", 0))
     prop.put("duration_s", wl.get("duration_s", 0))
+    trend = view.get("trend") or {}
+    prop.put("trend_prev", escape_json(
+        str(trend.get("prev_artifact", "-"))))
+    prop.put("trend_regressions", trend.get("regressions", 0))
+    prop.put("trend_improvements", trend.get("improvements", 0))
 
     overlaps = view.get("overlaps", [])
     prop.put("overlaps", len(overlaps))

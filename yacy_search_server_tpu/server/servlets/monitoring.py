@@ -765,6 +765,37 @@ def prometheus_text(sb, include_buckets: bool = True,
     p.sample("yacy_tail_verdicts_total",
              tailattr.ATTR.counters()["classified_total"])
 
+    # -- whitebox profiler (ISSUE 20): sampler counters + per-role
+    # sample totals ZERO-FILLED over the profiling.ROLES canon (the
+    # fleet digest's top-role index maps into these, so the series must
+    # resolve on every node before any sampling happens)
+    from ...utils import profiling
+    pstats = profiling.stats()
+    p.family("yacy_prof_samples_total", "counter",
+             "thread-stack samples folded by the in-process profiler")
+    p.sample("yacy_prof_samples_total", pstats["samples_total"])
+    p.family("yacy_prof_capture_windows_total", "counter",
+             "triggered high-rate deep-capture windows completed")
+    p.sample("yacy_prof_capture_windows_total",
+             pstats["capture_windows_total"])
+    p.family("yacy_prof_holder_captures_total", "counter",
+             "over-p95 lock holds whose holder stack was captured")
+    p.sample("yacy_prof_holder_captures_total",
+             pstats["holder_captures_total"])
+    p.family("yacy_prof_sampler_hz", "gauge",
+             "current profiler sampling cadence (burst while a "
+             "capture window is armed)")
+    p.sample("yacy_prof_sampler_hz", round(pstats["sampler_hz"], 1))
+    p.family("yacy_prof_role_samples_total", "counter",
+             "profiler samples by thread role (named-pool canon; "
+             "windowed over the retained sample ring)")
+    samp = profiling.sampler()
+    roles = samp.role_samples() if samp is not None \
+        else {r: 0 for r in profiling.ROLES}
+    for role in profiling.ROLES:
+        p.sample("yacy_prof_role_samples_total", roles.get(role, 0),
+                 {"role": role})
+
     p.family("yacy_traces_retained", "gauge",
              "completed traces in the tracing ring")
     p.sample("yacy_traces_retained", len(tracing.traces(tracing.MAX_TRACES)))
@@ -914,4 +945,128 @@ def respond_metrics(header: dict, post: ServerObjects,
     prop.raw_ctype = (
         "application/openmetrics-text; version=1.0.0; charset=utf-8"
         if om else "text/plain; version=0.0.4; charset=utf-8")
+    return prop
+
+
+# -- whitebox profiler dashboard (ISSUE 20) -----------------------------------
+
+
+def _flame_png(stacks: list, w: int = 800, h: int = 360) -> bytes:
+    """Icicle-layout flamegraph over the top folded stacks: row 0 is
+    all samples, each deeper row splits a frame's width among its
+    children proportionally to sample counts.  Rendered on the raster
+    layer like the roofline/waterfall charts."""
+    from ...visualization.raster import RasterPlotter
+
+    img = RasterPlotter(w, h, background=(10, 10, 30))
+    total = sum(s.get("count", 0) for s in stacks)
+    if total <= 0:
+        img.text(16, 16, "NO SAMPLES", (200, 200, 200))
+        return img.png_bytes()
+    row_h = 16
+    max_depth = (h - 24) // row_h
+
+    # prefix tree: node = {count, children{frame: node}}
+    root = {"count": total, "children": {}}
+    for s in stacks:
+        node = root
+        for frame in s["stack"].split(";")[:max_depth]:
+            kids = node["children"]
+            if frame not in kids:
+                kids[frame] = {"count": 0, "children": {}}
+            node = kids[frame]
+            node["count"] += s["count"]
+
+    palette = [(205, 92, 52), (224, 138, 56), (198, 66, 66),
+               (226, 170, 62), (182, 102, 38)]
+
+    def draw(node, depth, x0, x1):
+        if depth >= max_depth or x1 - x0 < 2:
+            return
+        x = x0
+        for i, (frame, child) in enumerate(sorted(
+                node["children"].items(),
+                key=lambda kv: -kv[1]["count"])):
+            width = (x1 - x0) * child["count"] / max(1, node["count"])
+            cx1 = min(x1, x + width)
+            if cx1 - x >= 2:
+                color = palette[(depth + i) % len(palette)]
+                y = 20 + depth * row_h
+                img.rect(int(x), y, int(cx1) - 1, y + row_h - 2,
+                         color, fill=True)
+                label = frame.split(":")[-1] if depth else frame
+                if (cx1 - x) >= 6 * len(label[:10]) + 4:
+                    img.text(int(x) + 2, y + 4, label[:24], (0, 0, 0))
+                draw(child, depth + 1, x, cx1)
+            x = cx1
+    img.text(16, 4, f"PROFILE {total} SAMPLES", (220, 220, 220))
+    draw(root, 0, 16, w - 16)
+    return img.png_bytes()
+
+
+@servlet("Performance_Prof_p")
+def respond_prof(header: dict, post: ServerObjects, sb) -> ServerObjects:
+    """Whitebox profiler dashboard (ISSUE 20): top folded stacks with
+    role tags, the per-lock wait/hold table with recent over-p95
+    holder stacks, and the last triggered deep capture.  `format=json`
+    exports the full wire snapshot (what do_profsnap ships);
+    `format=png` renders the raster flamegraph."""
+    import json as _json
+
+    from ...utils import profiling
+
+    n = post.get_int("n", 12)
+    snap = profiling.snapshot(n)
+    fmt = post.get("format", "")
+    if fmt == "png":
+        prop = ServerObjects()
+        prop.raw_body = _flame_png(snap["stacks"])
+        prop.raw_ctype = "image/png"
+        return prop
+    if fmt == "json":
+        prop = ServerObjects()
+        prop.raw_body = _json.dumps(snap, indent=1)
+        prop.raw_ctype = "application/json; charset=utf-8"
+        return prop
+    prop = ServerObjects()
+    prop.put("enabled", 1 if snap["enabled"] else 0)
+    prop.put("sampler_hz", snap["sampler_hz"])
+    prop.put("samples_total", snap["samples_total"])
+    prop.put("capture_windows_total", snap["capture_windows_total"])
+    prop.put("holder_captures_total", snap["holder_captures_total"])
+    prop.put("stacks", len(snap["stacks"]))
+    for i, st in enumerate(snap["stacks"]):
+        p = f"stacks_{i}_"
+        prop.put(p + "role", escape_json(st["role"]))
+        prop.put(p + "count", st["count"])
+        prop.put(p + "stack", escape_json(st["stack"]))
+    for role in profiling.ROLES:
+        prop.put(f"role_{role.replace('-', '_')}_samples",
+                 snap["roles"].get(role, 0))
+    prop.put("locks", len(snap["locks"]))
+    for i, row in enumerate(snap["locks"]):
+        p = f"locks_{i}_"
+        prop.put(p + "name", escape_json(row["name"]))
+        prop.put(p + "contended_total", row["contended_total"])
+        prop.put(p + "wait_count", row["wait"]["count"])
+        prop.put(p + "wait_p50_ms", row["wait"]["p50_ms"])
+        prop.put(p + "wait_p95_ms", row["wait"]["p95_ms"])
+        prop.put(p + "hold_count", row["hold"]["count"])
+        prop.put(p + "hold_p50_ms", row["hold"]["p50_ms"])
+        prop.put(p + "hold_p95_ms", row["hold"]["p95_ms"])
+        prop.put(p + "holder_stacks", len(row["holder_stacks"]))
+        for k, hs in enumerate(row["holder_stacks"]):
+            prop.put(f"{p}holder_{k}_hold_ms", hs["hold_ms"])
+            prop.put(f"{p}holder_{k}_stack", escape_json(hs["stack"]))
+    cap = snap.get("last_capture")
+    prop.put("capture", 1 if cap else 0)
+    if cap:
+        prop.put("capture_reason", escape_json(cap["reason"]))
+        prop.put("capture_samples", cap["samples"])
+        prop.put("capture_stacks", len(cap["stacks"]))
+        for i, st in enumerate(cap["stacks"]):
+            p = f"capture_stacks_{i}_"
+            prop.put(p + "role", escape_json(st["role"]))
+            prop.put(p + "count", st["count"])
+            prop.put(p + "stack", escape_json(st["stack"]))
     return prop

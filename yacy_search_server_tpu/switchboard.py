@@ -295,6 +295,13 @@ class Switchboard:
         from .utils import tailattr
         tailattr.configure(self.config)
 
+        # whitebox profiler (ISSUE 20): the always-on sampler thread +
+        # lock-wait observatory knobs.  configure() starts the process-
+        # global sampler (idempotent — one daemon thread per process,
+        # shared by every switchboard like the histogram registry)
+        from .utils import profiling
+        profiling.configure(self.config)
+
         # actuator layer (ISSUE 9): the rules above only OBSERVE — this
         # closes the loop.  Admission token buckets, the serving
         # degradation ladder, batcher auto-tuning and the remote-search

@@ -45,7 +45,7 @@ import json
 import threading
 import time
 
-from . import histogram, tailattr
+from . import histogram, profiling, tailattr
 
 # payload key carrying the digest on every in-band transport (the
 # fleet-table analogue of tracing.PAYLOAD_KEY); the Java wire carries it
@@ -139,6 +139,13 @@ def digest_series(digest: dict) -> dict:
         out["act.l"] = "yacy_degrade_level"
         out["act.c"] = ('yacy_tail_cause_total{cause="'
                         + decode_act_cause(digest["act"]) + '"}')
+        if "p" in digest["act"]:
+            # whitebox top-role index (ISSUE 20d): resolves against the
+            # zero-filled per-role sample counters; version skew (an
+            # old digest without the field) simply omits the mapping
+            out["act.p"] = (
+                'yacy_prof_role_samples_total{role="'
+                + profiling.decode_role(digest["act"].get("p")) + '"}')
     if "tiers" in digest:
         # compact tier occupancy (ISSUE 8): KiB per residency tier +
         # total promotions — the mesh view of who is paging
@@ -279,6 +286,12 @@ class FleetTable:
                 "l": int(act.effective_level())
                 if act is not None else 0,
                 "c": tailattr.CAUSES.index(tailattr.top_cause()),
+                # whitebox top-frame role (ISSUE 20d): which thread
+                # role this node burns most samples in, as an index
+                # into the zero-filled profiling.ROLES canon — a peer
+                # whose dispatcher pool pegs is visible fleet-wide
+                # before it straggles (~8 bytes, the act.c model)
+                "p": profiling.top_role_index(),
             },
             "epoch": int(c.get("arena_epoch", 0)),
             # tier occupancy in KiB (compact: ~30 B inside the 2 KiB

@@ -635,6 +635,13 @@ class HealthEngine:
                 import logging
                 logging.getLogger("health").warning(
                     "actuator tick failed", exc_info=True)
+        if entered_critical:
+            # whitebox deep capture (ISSUE 20c): the ok->critical edge
+            # arms one bounded high-rate profiler window — the NEXT
+            # incident (or servlet read) embeds what the process was
+            # doing while the rule burned.  Rate-limited inside.
+            from . import profiling
+            profiling.trigger(f"health.{entered_critical[0]}")
         if do_dump:
             with self._lock:
                 self._dump_incident_locked(now, entered_critical)
@@ -724,6 +731,13 @@ class HealthEngine:
         for crumb in _ta.conviction_breadcrumbs():
             lines.append(json.dumps(
                 {"kind": "straggler_convicted", **crumb}))
+        # whitebox profile (ISSUE 20c): the incident embeds the top
+        # folded stacks + per-lock wait/hold table + the last triggered
+        # deep capture — the postmortem reads WHAT the process was doing
+        # next to the burn, like the cause histogram above reads WHY
+        from . import profiling
+        lines.append(json.dumps(
+            {"kind": "profile", **profiling.report()}))
         # actuator breadcrumbs (ISSUE 9): the incident names every
         # actuation around the edge — which ladder rung, which tuning
         # step, which peers were avoided — so a postmortem reads the

@@ -466,6 +466,28 @@ CANONICAL = {
                      "tier (serves from placed blocks)",
     "ingest.backpressure": "writer wall blocked in the bounded RWI RAM "
                            "buffer (counted backpressure)",
+    # lock-wait observatory (ISSUE 20b, utils/profiling.py): wait+hold
+    # walls per instrumented hot lock — one wait/hold pair per entry of
+    # profiling.HOT_LOCK_CENSUS (a hygiene test pins the mirror), so
+    # the yacy_lock_wait_*/yacy_lock_hold_* series zero-fill before any
+    # contention ever happens
+    "lock.wait.devstore": "acquisition wait on the devstore store lock",
+    "lock.hold.devstore": "hold wall on the devstore store lock",
+    "lock.wait.devstore_tune": "acquisition wait on the batcher tune lock",
+    "lock.hold.devstore_tune": "hold wall on the batcher tune lock",
+    "lock.wait.rwi": "acquisition wait on the RWI store lock",
+    "lock.hold.rwi": "hold wall on the RWI store lock",
+    "lock.wait.dense_fwd": "acquisition wait on the dense forward-block "
+                           "upload lock",
+    "lock.hold.dense_fwd": "hold wall on the dense forward-block "
+                           "upload lock",
+    "lock.wait.mesh_plock": "acquisition wait on the mesh member's "
+                            "pending-step lock",
+    "lock.hold.mesh_plock": "hold wall on the mesh member's "
+                            "pending-step lock",
+    "lock.wait.search_cache": "acquisition wait on the search-event "
+                              "cache lock",
+    "lock.hold.search_cache": "hold wall on the search-event cache lock",
 }
 
 for _name, _help in CANONICAL.items():

@@ -239,8 +239,13 @@ def _class_locks(cls: ast.ClassDef) -> set[str]:
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             f = dotted(node.value.func)
             # a Condition wraps (or is) a lock: `with self._not_empty:`
-            # acquires it, so it guards exactly like a Lock
-            if f.split(".")[-1] in ("Lock", "RLock", "Condition"):
+            # acquires it, so it guards exactly like a Lock; the
+            # ObservedLock/ObservedRLock wrappers (utils/profiling.py,
+            # ISSUE 20b) ARE locks and must keep guarding, or swapping
+            # a raw lock for its observed twin would silently retire
+            # every lockset/counter-lock rule over the class
+            if f.split(".")[-1] in ("Lock", "RLock", "Condition",
+                                    "ObservedLock", "ObservedRLock"):
                 for t in node.targets:
                     a = _self_attr(t)
                     if a:
@@ -1002,4 +1007,88 @@ def check_tail_reach(repo: Repo, stats: dict):
                 f"classifier) or annotate `# lint: tail-ok(reason)`"))
     stats["servlet_observed_families"] = observed
     stats["classifier_families"] = len(fams)
+    return findings
+
+
+# -- 12. raw lock on the instrumented-lock census (ISSUE 20b) -----------------
+
+
+@checker("raw-hot-lock", "rawlock-ok")
+def check_raw_hot_lock(repo: Repo, stats: dict):
+    """Police the lock-wait observatory's census: every
+    ``file::Class::attr`` key of ``HOT_LOCK_CENSUS``
+    (utils/profiling.py) must be constructed as
+    ``ObservedLock``/``ObservedRLock`` in that class — a raw
+    ``threading.Lock/RLock`` on a census name is a hot lock whose
+    wait/hold walls silently vanish from ``yacy_lock_wait_*`` and from
+    the tail classifier's lock-wait markers.  A census entry matching
+    NOTHING is also a finding (the census cannot rot as code moves).
+    Escape hatch: ``# lint: rawlock-ok(reason)`` on the assignment."""
+    findings = []
+    census: dict[str, str] = {}     # key -> rel of the census literal
+    for ctx in repo.under("yacy_search_server_tpu/"):
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            if not any(isinstance(t, ast.Name)
+                       and t.id == "HOT_LOCK_CENSUS"
+                       for t in node.targets):
+                continue
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str):
+                    census[k.value] = ctx.rel
+    observed = raw = 0
+    for key, src in sorted(census.items()):
+        parts = key.split("::")
+        if len(parts) != 3:
+            findings.append(Finding(
+                "raw-hot-lock", src, 1,
+                f"malformed HOT_LOCK_CENSUS key {key!r} "
+                f"(want 'file::Class::attr')"))
+            continue
+        rel, clsname, attr = parts
+        ctx = repo.get(rel)
+        cls = None
+        if ctx is not None:
+            cls = next((n for n in ast.walk(ctx.tree)
+                        if isinstance(n, ast.ClassDef)
+                        and n.name == clsname), None)
+        if cls is None:
+            findings.append(Finding(
+                "raw-hot-lock", src, 1,
+                f"HOT_LOCK_CENSUS entry {key!r} matches no class — "
+                f"the census rotted; update or remove the entry"))
+            continue
+        assigns = [n for n in ast.walk(cls)
+                   if isinstance(n, ast.Assign)
+                   and isinstance(n.value, ast.Call)
+                   and any(_self_attr(t) == attr for t in n.targets)]
+        if not assigns:
+            findings.append(Finding(
+                "raw-hot-lock", src, 1,
+                f"HOT_LOCK_CENSUS entry {key!r} matches no "
+                f"constructor assignment in {clsname} — the census "
+                f"rotted; update or remove the entry"))
+            continue
+        for node in assigns:
+            tail = dotted(node.value.func).split(".")[-1]
+            if tail in ("ObservedLock", "ObservedRLock"):
+                observed += 1
+                continue
+            if tail not in ("Lock", "RLock"):
+                continue        # some other factory: not this rule's call
+            if ctx.exempt(("rawlock-ok",), [node.lineno, cls.lineno]):
+                continue
+            raw += 1
+            findings.append(Finding(
+                "raw-hot-lock", ctx.rel, node.lineno,
+                f"{clsname}.{attr} is on the instrumented-lock census "
+                f"but is a raw threading.{tail} — use "
+                f"profiling.ObservedLock/ObservedRLock so its "
+                f"wait/hold walls record, or annotate "
+                f"`# lint: rawlock-ok(reason)`"))
+    stats["census_entries"] = len(census)
+    stats["observed_locks"] = observed
     return findings

@@ -366,6 +366,14 @@ class TailAttributor:
             if v.member:
                 self.straggler_totals[v.member] = \
                     self.straggler_totals.get(v.member, 0) + 1
+        # whitebox deep capture (ISSUE 20c): a contention/queueing/
+        # straggler verdict arms one bounded high-rate profiler window
+        # so the NEXT incident embeds what the process was doing while
+        # the tail burned.  Lazy import (profiling imports this module);
+        # trigger() is rate-limited and a no-op when disabled.
+        if v.cause in ("lock_wait", "queue_wait", "collective_straggler"):
+            from . import profiling
+            profiling.trigger(f"tail.{v.cause}")
 
     # -- reading -------------------------------------------------------------
 
@@ -712,6 +720,12 @@ class ConvictionTracker:
         self._streaks: dict[str, int] = {}
         self.totals: dict[str, int] = {}
         self.breadcrumbs: deque = deque(maxlen=64)
+        # conviction hook (ISSUE 20d): the coordinator registers a
+        # callable(crumb) here; observe() drives it OUTSIDE the tracker
+        # lock on every conviction edge so the hook may do wire RPCs
+        # (fetch the convicted member's profile snapshot) and attach
+        # evidence to the crumb before it rides the flight recorder
+        self._on_convicted = None
 
     def configure(self, cfg) -> None:
         self.window_s = cfg.get_float("tail.convictionWindowS",
@@ -771,7 +785,24 @@ class ConvictionTracker:
                     # breaks.  Absent members keep theirs — no evidence
                     # either way.
                     self._streaks.pop(member, None)
+        hook = self._on_convicted
+        if hook is not None:
+            for crumb in convicted:
+                try:
+                    hook(crumb)
+                except Exception:   # lint: broad-except-ok(a failing
+                    # evidence fetch must never break the conviction
+                    # edge itself — the crumb still records)
+                    log.exception("conviction hook failed: %s",
+                                  crumb.get("member"))
         return convicted
+
+    def set_conviction_hook(self, fn) -> None:
+        """Register the coordinator's conviction-edge callback (ISSUE
+        20d): called with each fresh conviction crumb, outside the
+        tracker lock, before health embeds the crumb in an incident —
+        the hook may mutate the crumb (attach the member's profile)."""
+        self._on_convicted = fn
 
     def known_members(self) -> list[str]:
         """The zero-fill domain: every member the timeline ever
@@ -798,6 +829,7 @@ class ConvictionTracker:
             self.totals.clear()
             self.breadcrumbs.clear()
             self._last_eval = 0.0
+            self._on_convicted = None
 
 
 # -- process-global singletons (the histogram-registry model) ----------------
